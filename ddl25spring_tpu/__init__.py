@@ -1,0 +1,33 @@
+"""ddl25spring_tpu — a TPU-native (JAX/XLA/pjit/Pallas) distributed & federated
+deep-learning framework with the capabilities of the DDL25Spring lab stack.
+
+Instead of the reference's process-per-rank PyTorch+gloo design
+(/root/reference/lab, see SURVEY.md), everything here runs as single SPMD
+programs over a `jax.sharding.Mesh`:
+
+- horizontal FL: simulated clients are vmapped over a leading client axis and
+  sharded across cores; FedAvg/FedSGD aggregation is a weighted mean that XLA
+  lowers to an all-reduce over ICI (reference: hfl_complete.py:260-390).
+- data parallelism: `shard_map` + `jax.lax.pmean` on gradients
+  (reference: tutorial_1b/DP/gradient_aggr/intro_DP_GA.py:53-67).
+- pipeline parallelism: stage-sharded params + `jax.lax.ppermute` activation
+  rotation inside `lax.scan` microbatch schedules
+  (reference: tutorial_1b/PP/1F1B/*.py).
+- vertical FL: party-sharded feature columns; the activation concat cut
+  (reference: tutorial_2b/vfl.py:36) becomes an all_gather over ICI.
+
+Subpackages
+-----------
+- ``utils``     pytree ops, RNG discipline, RunResult metrics, checkpointing
+- ``data``      MNIST/CIFAR/heart loaders (+ deterministic synthetic fallbacks),
+                IID / non-IID client splitters, token streams
+- ``models``    flax.linen model zoo (MnistCnn, ResNet, MLPs, VAEs, LLaMA stages)
+- ``ops``       losses, attention (incl. ring attention), pallas kernels
+- ``fl``        horizontal federated learning servers (FedSGD / FedAvg / ...)
+- ``robust``    Byzantine-robust aggregators and attack models
+- ``parallel``  mesh construction, DP/PP/TP/hybrid trainers
+- ``vfl``       vertical FL (split-NN, split-VAE)
+- ``gen``       generative modeling (tabular VAE) + TSTR evaluation
+"""
+
+__version__ = "0.1.0"

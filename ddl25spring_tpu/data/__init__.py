@@ -1,0 +1,35 @@
+from .split import (
+    split_indices,
+    split_dataset,
+    stack_client_datasets,
+    ClientDatasets,
+)
+from .mnist import load_mnist, synthetic_image_dataset, ImageDataset
+from .cifar import load_cifar10
+from .heart import (
+    load_heart_df,
+    load_heart_classification,
+    synthetic_heart_df,
+    one_hot_encode,
+    HeartData,
+    CATEGORICAL,
+    NUMERICAL,
+)
+
+__all__ = [
+    "split_indices",
+    "split_dataset",
+    "stack_client_datasets",
+    "ClientDatasets",
+    "load_mnist",
+    "synthetic_image_dataset",
+    "ImageDataset",
+    "load_cifar10",
+    "load_heart_df",
+    "load_heart_classification",
+    "synthetic_heart_df",
+    "one_hot_encode",
+    "HeartData",
+    "CATEGORICAL",
+    "NUMERICAL",
+]

@@ -1,0 +1,78 @@
+"""CIFAR-10 loader with deterministic synthetic fallback.
+
+CIFAR-10 is the north-star FL benchmark dataset (BASELINE.json: FedAvg,
+256 clients, ResNet-18).  The reference never ships it (it targets MNIST);
+we follow the same real-if-present / synthetic-otherwise policy as
+:mod:`ddl25spring_tpu.data.mnist`.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .mnist import ImageDataset, candidate_data_dirs, synthetic_image_dataset
+
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], dtype=np.float32)
+CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], dtype=np.float32)
+
+_candidate_dirs = candidate_data_dirs
+
+
+def _normalize(x_uint8: np.ndarray) -> np.ndarray:
+    x = x_uint8.astype(np.float32) / 255.0
+    return (x - CIFAR_MEAN) / CIFAR_STD
+
+
+def _try_load_real() -> ImageDataset | None:
+    for root in _candidate_dirs():
+        npz = root / "cifar10.npz"
+        if npz.exists():
+            d = np.load(npz)
+            return ImageDataset(
+                train_x=_normalize(d["train_x"]),
+                train_y=d["train_y"].astype(np.int32),
+                test_x=_normalize(d["test_x"]),
+                test_y=d["test_y"].astype(np.int32),
+                synthetic=False,
+            )
+        batch_dir = root / "cifar-10-batches-py"
+        if (batch_dir / "data_batch_1").exists():
+            def load_batch(p):
+                with open(p, "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+                return x, np.array(d[b"labels"], dtype=np.int32)
+
+            xs, ys = zip(*[load_batch(batch_dir / f"data_batch_{i}") for i in range(1, 6)])
+            test_x, test_y = load_batch(batch_dir / "test_batch")
+            return ImageDataset(
+                train_x=_normalize(np.concatenate(xs)),
+                train_y=np.concatenate(ys),
+                test_x=_normalize(test_x),
+                test_y=test_y,
+                synthetic=False,
+            )
+    return None
+
+
+def load_cifar10(
+    synthetic_fallback: bool = True,
+    n_train: int = 50000,
+    n_test: int = 10000,
+    seed: int = 1,
+) -> ImageDataset:
+    real = _try_load_real()
+    if real is not None:
+        return real
+    if not synthetic_fallback:
+        raise FileNotFoundError(
+            "CIFAR-10 not found; set DDL25_DATA_DIR to a directory containing "
+            "cifar10.npz or cifar-10-batches-py"
+        )
+    return synthetic_image_dataset(
+        n_train=n_train, n_test=n_test, size=32, nr_classes=10,
+        channels=3, noise=0.3, max_shift=4, seed=seed,
+        mean=CIFAR_MEAN, std=CIFAR_STD,
+    )

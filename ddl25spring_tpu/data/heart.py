@@ -1,0 +1,101 @@
+"""Heart-disease tabular dataset (UCI Cleveland derivative).
+
+The reference uses ``lab/tutorial_2a/heart.csv`` (1025 rows) for the
+centralized classifier (centralized.py:32), the tabular VAE
+(generative-modeling.py:133-140) and all VFL experiments (vfl.py:108).
+We load the same CSV when present (the read-only reference mount or
+``$DDL25_DATA_DIR``), else generate a deterministic synthetic table with the
+same schema: 5 numeric + 8 categorical feature columns + binary ``target``.
+
+Preprocessing mirrors the reference pipelines:
+- one-hot encode the categorical columns (pandas ``get_dummies``,
+  centralized.py:33-34) → 30 feature columns total for the standard CSV;
+- MinMax scaling of numerics for the classifier/VFL path (vfl.py:111),
+  StandardScaler over everything for the VAE path (generative-modeling.py:141).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+CATEGORICAL = ["sex", "cp", "fbs", "restecg", "exang", "slope", "ca", "thal"]
+NUMERICAL = ["age", "trestbps", "chol", "thalach", "oldpeak"]
+# cardinalities of the categorical columns in the real CSV
+_CARDINALITIES = {
+    "sex": 2, "cp": 4, "fbs": 2, "restecg": 3,
+    "exang": 2, "slope": 3, "ca": 5, "thal": 4,
+}
+
+
+def _candidate_paths():
+    env = os.environ.get("DDL25_DATA_DIR")
+    if env:
+        yield Path(env) / "heart.csv"
+    yield Path.home() / ".cache" / "ddl25spring" / "heart.csv"
+    yield Path("/root/reference/lab/tutorial_2a/heart.csv")
+    yield Path("/root/reference/lab/tutorial_2b/heart-dataset/heart.csv")
+
+
+def synthetic_heart_df(n: int = 1025, seed: int = 7) -> pd.DataFrame:
+    """Deterministic table with the heart.csv schema and a learnable target."""
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame()
+    df["age"] = rng.integers(29, 78, n)
+    df["trestbps"] = rng.integers(94, 201, n)
+    df["chol"] = rng.integers(126, 565, n)
+    df["thalach"] = rng.integers(71, 203, n)
+    df["oldpeak"] = np.round(rng.uniform(0, 6.2, n), 1)
+    for col, card in _CARDINALITIES.items():
+        df[col] = rng.integers(0, card, n)
+    # target correlated with a few features so classifiers have signal
+    logit = (
+        0.04 * (df["thalach"] - 150)
+        - 0.03 * (df["age"] - 54)
+        - 0.8 * (df["exang"])
+        + 0.5 * (df["cp"] > 0).astype(float)
+        - 0.7 * (df["oldpeak"] - 1)
+    )
+    p = 1 / (1 + np.exp(-logit))
+    df["target"] = (rng.uniform(size=n) < p).astype(np.int64)
+    return df
+
+
+def load_heart_df() -> tuple[pd.DataFrame, bool]:
+    """Return (dataframe, synthetic flag)."""
+    for p in _candidate_paths():
+        if p.exists():
+            return pd.read_csv(p), False
+    return synthetic_heart_df(), True
+
+
+def one_hot_encode(df: pd.DataFrame) -> pd.DataFrame:
+    """One-hot the categorical columns; keeps column-name convention
+    ``<col>_<value>`` used by the reference's per-client feature expansion
+    (vfl.py:131-139)."""
+    return pd.get_dummies(df, columns=CATEGORICAL)
+
+
+@dataclass
+class HeartData:
+    x: np.ndarray            # (n, d) float32 features
+    y: np.ndarray            # (n,) int32 labels
+    feature_names: list      # length d, post-one-hot
+    synthetic: bool
+
+
+def load_heart_classification(minmax: bool = True) -> HeartData:
+    """One-hot + (optionally) MinMax-scaled features, int labels."""
+    df, synthetic = load_heart_df()
+    encoded = one_hot_encode(df)
+    x_df = encoded.drop(columns=["target"])
+    x = x_df.to_numpy(dtype=np.float32)
+    if minmax:
+        lo, hi = x.min(axis=0), x.max(axis=0)
+        x = (x - lo) / np.maximum(hi - lo, 1e-8)
+    y = encoded["target"].to_numpy(dtype=np.int32)
+    return HeartData(x=x, y=y, feature_names=list(x_df.columns), synthetic=synthetic)

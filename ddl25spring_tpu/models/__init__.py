@@ -1,0 +1,3 @@
+from .cnn import MnistCnn
+
+__all__ = ["MnistCnn"]

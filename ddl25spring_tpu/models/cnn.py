@@ -1,0 +1,32 @@
+"""MnistCnn — the HFL workhorse model.
+
+Architecture matches the reference (hfl_complete.py:39-64): two 3x3 valid
+convs (32, 64), 2x2 max-pool, dropout 0.25, dense 128, dropout 0.5, dense 10,
+log-softmax output.  Input layout is NHWC (TPU-native), i.e. (B, 28, 28, 1);
+the flattened conv output is 12*12*64 = 9216 exactly as in the reference's
+``nn.Linear(9216, 128)``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCnn(nn.Module):
+    nr_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = nn.Conv(32, (3, 3), padding="VALID", name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="VALID", name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train, name="dropout1")(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train, name="dropout2")(x)
+        x = nn.Dense(self.nr_classes, name="fc2")(x)
+        return nn.log_softmax(x, axis=-1)

@@ -1,0 +1,13 @@
+from .losses import (
+    nll_loss,
+    cross_entropy_logits,
+    causal_lm_loss,
+    accuracy,
+)
+
+__all__ = [
+    "nll_loss",
+    "cross_entropy_logits",
+    "causal_lm_loss",
+    "accuracy",
+]

@@ -1,0 +1,62 @@
+"""Loss functions.
+
+- ``nll_loss``: negative log-likelihood over log-probabilities, the reference's
+  ``F.nll_loss`` (hfl_complete.py:78) with optional sample masking — masking is
+  how the SPMD FL engine handles padded client shards and partial batches
+  without dynamic shapes.
+- ``cross_entropy_logits``: softmax CE from logits (reference
+  ``nn.CrossEntropyLoss``, vfl.py:51, centralized.py:46).
+- ``causal_lm_loss``: next-token CE, the reference's
+  ``simplellm.losses.causalLLMLoss`` (used at tutorial_1b/primer/intro.py:29).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import nn as jnn
+
+
+def _masked_mean(values, mask):
+    if mask is None:
+        return jnp.mean(values)
+    mask = mask.astype(values.dtype)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(values * mask) / denom
+
+
+def nll_loss(log_probs, labels, mask=None):
+    """Mean NLL of int ``labels`` under ``log_probs`` (..., classes)."""
+    picked = jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    return _masked_mean(-picked, mask)
+
+
+def cross_entropy_logits(logits, labels, mask=None):
+    """Mean softmax cross-entropy from logits; ``labels`` int or one-hot."""
+    logp = jnn.log_softmax(logits, axis=-1)
+    if labels.ndim == logits.ndim:  # one-hot / soft labels
+        per_ex = -jnp.sum(labels * logp, axis=-1)
+    else:
+        per_ex = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return _masked_mean(per_ex, mask)
+
+
+def causal_lm_loss(logits, tokens, ignore_index: int | None = None):
+    """Next-token cross-entropy.
+
+    ``logits``: (B, T, V); ``tokens``: (B, T).  Predicts token t+1 from
+    position t; the final position has no target and is dropped.
+    """
+    shift_logits = logits[:, :-1, :]
+    targets = tokens[:, 1:]
+    mask = None
+    if ignore_index is not None:
+        mask = (targets != ignore_index)
+    return cross_entropy_logits(shift_logits, targets, mask)
+
+
+def accuracy(scores, labels):
+    """Fraction of argmax predictions equal to int labels, in percent
+    (matches the reference's ``100. * correct / n`` reporting,
+    hfl_complete.py:183)."""
+    pred = jnp.argmax(scores, axis=-1)
+    return 100.0 * jnp.mean((pred == labels).astype(jnp.float32))

@@ -1,0 +1,33 @@
+from .trees import (
+    tree_stack,
+    tree_unstack,
+    tree_weighted_mean,
+    tree_select,
+    tree_add,
+    tree_sub,
+    tree_scale,
+    tree_zeros_like,
+    tree_vector,
+    tree_l2_norm,
+    tree_size,
+)
+from .rng import client_round_key, epoch_key, seed_key
+from .metrics import RunResult
+
+__all__ = [
+    "tree_stack",
+    "tree_unstack",
+    "tree_weighted_mean",
+    "tree_select",
+    "tree_add",
+    "tree_sub",
+    "tree_scale",
+    "tree_zeros_like",
+    "tree_vector",
+    "tree_l2_norm",
+    "tree_size",
+    "client_round_key",
+    "epoch_key",
+    "seed_key",
+    "RunResult",
+]

@@ -1,0 +1,52 @@
+"""Run metrics.
+
+``RunResult`` keeps the exact schema of the reference's metric dataclass
+(hfl_complete.py:113-138) — algorithm, n, c, b, e, lr, seed plus per-round
+wall_time / message_count / test_accuracy — because that schema *is* the
+output format of the homework experiments and the north-star benchmark.
+``as_df`` reproduces the reference's presentation quirks (lr column shown as
+the Greek eta, b == -1 rendered as the infinity glyph).
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+
+ETA = "\N{GREEK SMALL LETTER ETA}"
+INF = "\N{INFINITY}"
+
+
+@dataclass
+class RunResult:
+    algorithm: str
+    n: int
+    c: float
+    b: int  # batch size; -1 means full-batch (rendered as infinity)
+    e: int  # local epochs
+    lr: float
+    seed: int
+    wall_time: list = field(default_factory=list)
+    message_count: list = field(default_factory=list)
+    test_accuracy: list = field(default_factory=list)
+
+    def record_round(self, wall_time: float, message_count: int, test_accuracy: float):
+        self.wall_time.append(round(float(wall_time), 1))
+        self.message_count.append(int(message_count))
+        self.test_accuracy.append(float(test_accuracy))
+
+    def as_df(self, skip_wtime: bool = True):
+        from pandas import DataFrame
+
+        cols = {
+            k.capitalize().replace("_", " "): v for k, v in asdict(self).items()
+        }
+        if cols["B"] == -1:
+            cols["B"] = INF
+        df = DataFrame({"Round": range(1, len(self.wall_time) + 1), **cols})
+        df = df.rename(columns={"Lr": ETA})
+        if skip_wtime:
+            df = df.drop(columns=["Wall time"])
+        return df
+
+    def as_dict(self) -> dict:
+        return asdict(self)

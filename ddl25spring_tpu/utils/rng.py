@@ -1,0 +1,26 @@
+"""RNG key discipline.
+
+The reference derives deterministic per-round, per-client seeds:
+``seed + ind + 1 + round * clients_per_round`` (hfl_complete.py:289,368) and
+reseeds loaders per epoch (hfl_complete.py:209,327).  We mirror the *structure*
+(reproducible per-client/per-round/per-epoch streams) with `jax.random.fold_in`
+chains rather than trying to bit-match torch's generators.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def seed_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def client_round_key(base: jax.Array, round_idx, client_idx) -> jax.Array:
+    """Key for client ``client_idx``'s local work in round ``round_idx``."""
+    return jax.random.fold_in(jax.random.fold_in(base, round_idx), client_idx)
+
+
+def epoch_key(client_key: jax.Array, epoch_idx) -> jax.Array:
+    """Key for one local epoch's shuffle/dropout within a client update."""
+    return jax.random.fold_in(client_key, epoch_idx)

@@ -1,0 +1,85 @@
+"""Pytree manipulation helpers.
+
+The FL engine represents "N clients' models/updates" as one pytree whose leaves
+carry a leading client axis (shape ``(N, ...)``).  Aggregation (the reference's
+``torch.stack(x, dim=0).sum(dim=0)`` over per-client tensors,
+hfl_complete.py:298-299,377-378) becomes a weighted mean over that axis — which
+XLA turns into an all-reduce over ICI when the axis is sharded across devices.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def tree_stack(trees):
+    """Stack a list of identically-structured pytrees along a new leading axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def tree_unstack(tree):
+    """Inverse of :func:`tree_stack`: split the leading axis into a list."""
+    leaves, treedef = jax.tree.flatten(tree)
+    n = leaves[0].shape[0]
+    return [treedef.unflatten([leaf[i] for leaf in leaves]) for i in range(n)]
+
+
+def tree_weighted_mean(stacked, weights):
+    """Weighted combination over the leading (client) axis.
+
+    ``weights`` has shape ``(N,)`` and is used as-is — pass normalized weights
+    (summing to 1 over the participating clients) to reproduce the reference's
+    ``n_k / sum(n_k)`` weighting (hfl_complete.py:291-293,370-372).  Zero
+    weights implement client sampling with static shapes.
+    """
+    weights = jnp.asarray(weights)
+
+    def combine(leaf):
+        w = weights.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+        return jnp.sum(leaf * w, axis=0)
+
+    return jax.tree.map(combine, stacked)
+
+
+def tree_select(pred, a, b):
+    """Elementwise ``jnp.where(pred, a, b)`` over two pytrees (scalar pred)."""
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a, b):
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(a, s):
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def tree_zeros_like(a):
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+def tree_vector(tree):
+    """Flatten a pytree to a single 1-D vector (and return the unravel fn).
+
+    TPU-native analogue of the reference's manual flatten/unflatten around its
+    gradient all-reduce (intro_DP_GA.py:55-66) — here used by the robust
+    aggregators, which operate on ``(N, D)`` stacked update matrices.
+    """
+    return ravel_pytree(tree)
+
+
+def tree_l2_norm(tree):
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(leaf)) for leaf in jax.tree.leaves(tree))
+    )
+
+
+def tree_size(tree):
+    """Total number of scalar elements across all leaves."""
+    return sum(leaf.size for leaf in jax.tree.leaves(tree))

@@ -1,0 +1,35 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference fakes a cluster by forking gloo processes on loopback
+(tutorial_1b/PP/1F1B/run.sh); our analogue is XLA's host-platform device
+override, which gives every parallelism test N real (virtual) devices without
+TPU hardware.  Must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# This image pre-imports jax at interpreter startup (sitecustomize) with
+# JAX_PLATFORMS=axon, so the env var alone is too late — override the live
+# config too (safe: no backend has been initialized yet at conftest time).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+assert len(jax.devices()) >= 8, (
+    "expected the 8-device virtual CPU mesh; got " + repr(jax.devices())
+)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

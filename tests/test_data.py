@@ -1,0 +1,77 @@
+import numpy as np
+
+from ddl25spring_tpu.data import (
+    split_indices,
+    split_dataset,
+    load_mnist,
+    load_heart_classification,
+    synthetic_image_dataset,
+)
+
+
+def test_split_iid_partitions_everything():
+    labels = np.random.default_rng(0).integers(0, 10, 1000)
+    subsets = split_indices(labels, nr_clients=7, iid=True, seed=42)
+    all_idx = np.concatenate(subsets)
+    assert sorted(all_idx.tolist()) == list(range(1000))
+    sizes = [len(s) for s in subsets]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_split_iid_seeded_deterministic():
+    labels = np.zeros(100, dtype=np.int64)
+    a = split_indices(labels, 4, True, 7)
+    b = split_indices(labels, 4, True, 7)
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+
+
+def test_split_noniid_two_shards_per_client():
+    # non-IID: sort by label -> 2N shards -> 2 shards/client
+    # (hfl_complete.py:97-102). Each client should see at most ~2 label groups.
+    rng = np.random.default_rng(3)
+    labels = rng.integers(0, 10, 2000)
+    subsets = split_indices(labels, nr_clients=10, iid=False, seed=42)
+    all_idx = np.concatenate(subsets)
+    assert sorted(all_idx.tolist()) == list(range(2000))
+    for s in subsets:
+        # 2 contiguous sorted shards -> few distinct labels per client
+        assert len(np.unique(labels[s])) <= 4
+
+
+def test_stacked_layout_and_counts():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((103, 4)).astype(np.float32)
+    y = rng.integers(0, 3, 103)
+    ds = split_dataset(x, y, nr_clients=4, iid=True, seed=1, pad_multiple=10)
+    assert ds.x.shape[0] == 4
+    assert ds.x.shape[1] % 10 == 0
+    assert ds.counts.sum() == 103
+    # padding rows are zero
+    for i in range(4):
+        assert np.all(ds.x[i, ds.counts[i]:] == 0)
+
+
+def test_synthetic_mnist_shapes_and_determinism():
+    ds1 = synthetic_image_dataset(n_train=200, n_test=50, seed=0)
+    ds2 = synthetic_image_dataset(n_train=200, n_test=50, seed=0)
+    assert ds1.train_x.shape == (200, 28, 28, 1)
+    assert ds1.test_y.shape == (50,)
+    assert np.array_equal(ds1.train_x, ds2.train_x)
+    assert set(np.unique(ds1.train_y)) <= set(range(10))
+
+
+def test_load_mnist_fallback_works():
+    ds = load_mnist(n_train=100, n_test=20)
+    assert ds.train_x.shape[1:] == (28, 28, 1)
+
+
+def test_heart_classification_schema():
+    d = load_heart_classification()
+    assert d.x.ndim == 2
+    assert d.x.shape[0] == d.y.shape[0]
+    # one-hot + minmax => all features in [0, 1]
+    assert d.x.min() >= -1e-6 and d.x.max() <= 1 + 1e-6
+    assert set(np.unique(d.y)) <= {0, 1}
+    # 5 numeric + one-hot categorical = 30 for the real CSV schema
+    assert len(d.feature_names) == 30

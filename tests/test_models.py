@@ -1,0 +1,44 @@
+import jax
+import jax.numpy as jnp
+
+from ddl25spring_tpu.models import MnistCnn
+from ddl25spring_tpu.ops import nll_loss, accuracy
+
+
+def test_mnist_cnn_shapes_and_logprobs():
+    model = MnistCnn()
+    x = jnp.zeros((4, 28, 28, 1))
+    params = model.init(jax.random.key(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (4, 10)
+    # log_softmax rows sum to 1 in prob space
+    assert jnp.allclose(jnp.exp(out).sum(-1), 1.0, atol=1e-4)
+    # flattened conv trunk is 9216-dim, matching the reference fc1
+    assert params["params"]["fc1"]["kernel"].shape == (9216, 128)
+
+
+def test_dropout_active_only_in_train_mode():
+    model = MnistCnn()
+    x = jnp.ones((2, 28, 28, 1))
+    params = model.init(jax.random.key(0), x)
+    out1 = model.apply(params, x)
+    out2 = model.apply(params, x)
+    assert jnp.allclose(out1, out2)
+    d1 = model.apply(params, x, train=True, rngs={"dropout": jax.random.key(1)})
+    d2 = model.apply(params, x, train=True, rngs={"dropout": jax.random.key(2)})
+    assert not jnp.allclose(d1, d2)
+
+
+def test_nll_loss_masking():
+    logp = jnp.log(jnp.full((4, 3), 1 / 3))
+    labels = jnp.array([0, 1, 2, 0])
+    full = nll_loss(logp, labels)
+    masked = nll_loss(logp, labels, mask=jnp.array([1, 1, 0, 0]))
+    assert jnp.allclose(full, masked)  # uniform logp -> same value
+    assert jnp.allclose(full, jnp.log(3.0), atol=1e-4)
+
+
+def test_accuracy_percent():
+    scores = jnp.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4], [0.3, 0.7]])
+    labels = jnp.array([0, 1, 1, 1])
+    assert jnp.allclose(accuracy(scores, labels), 75.0)

@@ -1,0 +1,67 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddl25spring_tpu.utils import (
+    tree_stack,
+    tree_unstack,
+    tree_weighted_mean,
+    tree_vector,
+    tree_size,
+    client_round_key,
+    seed_key,
+    RunResult,
+)
+
+
+def test_tree_stack_roundtrip():
+    trees = [
+        {"a": jnp.ones((2, 3)) * i, "b": (jnp.arange(4.0) + i,)} for i in range(5)
+    ]
+    stacked = tree_stack(trees)
+    assert stacked["a"].shape == (5, 2, 3)
+    back = tree_unstack(stacked)
+    for orig, rec in zip(trees, back):
+        assert jnp.allclose(orig["a"], rec["a"])
+        assert jnp.allclose(orig["b"][0], rec["b"][0])
+
+
+def test_tree_weighted_mean_matches_manual():
+    stacked = {"w": jnp.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])}
+    weights = jnp.array([0.5, 0.5, 0.0])  # third client not sampled
+    out = tree_weighted_mean(stacked, weights)
+    assert jnp.allclose(out["w"], jnp.array([2.0, 3.0]))
+
+
+def test_tree_vector_roundtrip():
+    tree = {"a": jnp.ones((3, 2)), "b": jnp.zeros(5)}
+    vec, unravel = tree_vector(tree)
+    assert vec.shape == (11,)
+    assert tree_size(tree) == 11
+    rec = unravel(vec * 2)
+    assert jnp.allclose(rec["a"], 2.0)
+
+
+def test_key_discipline_deterministic_and_distinct():
+    base = seed_key(10)
+    k1 = client_round_key(base, 0, 3)
+    k1b = client_round_key(base, 0, 3)
+    k2 = client_round_key(base, 1, 3)
+    k3 = client_round_key(base, 0, 4)
+    assert jnp.array_equal(jax.random.key_data(k1), jax.random.key_data(k1b))
+    assert not jnp.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+    assert not jnp.array_equal(jax.random.key_data(k1), jax.random.key_data(k3))
+
+
+def test_run_result_schema():
+    rr = RunResult("FedAvg", 100, 0.1, 100, 1, 0.01, 10)
+    for r in range(3):
+        rr.record_round(1.5 * r, 2 * (r + 1) * 10, 50.0 + r)
+    df = rr.as_df()
+    assert list(df["Round"]) == [1, 2, 3]
+    assert "\N{GREEK SMALL LETTER ETA}" in df.columns
+    assert "Wall time" not in df.columns
+    assert df["Test accuracy"].iloc[-1] == 52.0
+    rr_inf = RunResult("FedSGDGradient", 10, 0.1, -1, 1, 0.01, 10)
+    rr_inf.record_round(0.0, 2, 10.0)
+    assert rr_inf.as_df()["B"].iloc[0] == "\N{INFINITY}"
