@@ -1,0 +1,33 @@
+from .engine import (
+    make_local_sgd_update,
+    make_full_batch_grad,
+    make_fl_round,
+    make_evaluator,
+    sample_clients,
+)
+from .task import Task, classification_task, mnist_task
+from .servers import (
+    Server,
+    CentralizedServer,
+    DecentralizedServer,
+    FedSgdGradientServer,
+    FedSgdWeightServer,
+    FedAvgServer,
+)
+
+__all__ = [
+    "make_local_sgd_update",
+    "make_full_batch_grad",
+    "make_fl_round",
+    "make_evaluator",
+    "sample_clients",
+    "Task",
+    "classification_task",
+    "mnist_task",
+    "Server",
+    "CentralizedServer",
+    "DecentralizedServer",
+    "FedSgdGradientServer",
+    "FedSgdWeightServer",
+    "FedAvgServer",
+]

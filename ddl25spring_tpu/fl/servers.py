@@ -1,0 +1,183 @@
+"""Horizontal-FL servers.
+
+Class and constructor shapes mirror the reference's server family
+(hfl_complete.py:159-390) — Centralized, FedSGD-gradient, FedAvg — plus the
+homework-1 A1 FedSGD-weight variant (lab/homework-1.ipynb cell 12).  The
+execution model is inverted, though: instead of a sequential Python loop over
+client objects, each round is ONE jitted SPMD program (see fl.engine) in which
+all sampled clients step in parallel via vmap and aggregation is a weighted
+mean over the client axis.
+
+Round accounting matches the reference exactly:
+- message_count is cumulative ``2 * (round+1) * clients_per_round``
+  (hfl_complete.py:309,387);
+- clients_per_round is ``max(1, round(C * N))`` (hfl_complete.py:228);
+- test accuracy is evaluated on the full test set each round
+  (hfl_complete.py:172-183).
+"""
+
+from __future__ import annotations
+
+from time import perf_counter
+
+import jax
+import jax.numpy as jnp
+
+from ..data.split import ClientDatasets
+from ..utils.metrics import RunResult
+from ..utils.rng import seed_key
+from .engine import (
+    make_fl_round,
+    make_full_batch_grad,
+    make_local_sgd_update,
+)
+from .task import Task
+
+
+class Server:
+    def __init__(self, task: Task, lr: float, batch_size: int, seed: int):
+        self.task = task
+        self.lr = lr
+        self.batch_size = batch_size
+        self.seed = seed
+        self.base_key = seed_key(seed)
+        init_key, self.run_key = jax.random.split(self.base_key)
+        self.params = task.init(init_key)
+        self._evaluate = task.evaluator()
+
+    def test(self) -> float:
+        return float(self._evaluate(self.params))
+
+
+class CentralizedServer(Server):
+    """Plain minibatch SGD on the pooled dataset; one round == one epoch
+    (reference: hfl_complete.py:193-216)."""
+
+    def __init__(self, task: Task, lr: float, batch_size: int, seed: int,
+                 train_x=None, train_y=None):
+        super().__init__(task, lr, batch_size, seed)
+        n = train_y.shape[0]
+        pad_to = -(-n // batch_size) * batch_size
+        self._x = jnp.pad(
+            jnp.asarray(train_x), [(0, pad_to - n)] + [(0, 0)] * (train_x.ndim - 1)
+        )
+        self._y = jnp.pad(jnp.asarray(train_y), (0, pad_to - n))
+        self._count = n
+        update = make_local_sgd_update(task.loss_fn, lr, batch_size, 1)
+        self._epoch = jax.jit(
+            lambda params, key: update(params, self._x, self._y, self._count, key)
+        )
+
+    def run(self, nr_rounds: int) -> RunResult:
+        result = RunResult("Centralized", 1, 1, self.batch_size, 1, self.lr, self.seed)
+        elapsed = 0.0
+        for r in range(nr_rounds):
+            t0 = perf_counter()
+            epoch_key = jax.random.fold_in(self.run_key, r)
+            self.params = jax.block_until_ready(self._epoch(self.params, epoch_key))
+            elapsed += perf_counter() - t0
+            result.record_round(elapsed, 0, self.test())
+        return result
+
+
+class DecentralizedServer(Server):
+    def __init__(self, task: Task, lr: float, batch_size: int,
+                 client_data: ClientDatasets, client_fraction: float, seed: int):
+        super().__init__(task, lr, batch_size, seed)
+        self.client_data = client_data
+        self.nr_clients = client_data.nr_clients
+        self.client_fraction = client_fraction
+        self.nr_clients_per_round = max(1, round(client_fraction * self.nr_clients))
+        self.round_fn = None  # set by subclass
+        self.algorithm = "Decentralized"
+        self.nr_local_epochs = 1
+
+    def run(self, nr_rounds: int) -> RunResult:
+        result = RunResult(
+            self.algorithm, self.nr_clients, self.client_fraction,
+            self.batch_size, self.nr_local_epochs, self.lr, self.seed,
+        )
+        elapsed = 0.0
+        for r in range(nr_rounds):
+            t0 = perf_counter()
+            self.params = jax.block_until_ready(
+                self.round_fn(self.params, self.run_key, r)
+            )
+            elapsed += perf_counter() - t0
+            result.record_round(
+                elapsed, 2 * (r + 1) * self.nr_clients_per_round, self.test()
+            )
+        return result
+
+
+class FedSgdGradientServer(DecentralizedServer):
+    """FedSGD: clients return one full-batch gradient; the server applies the
+    n_k-weighted average with an SGD step (reference: hfl_complete.py:260-312).
+    """
+
+    def __init__(self, task: Task, lr: float, client_data: ClientDatasets,
+                 client_fraction: float, seed: int,
+                 aggregator=None, attack=None, malicious_mask=None):
+        super().__init__(task, lr, -1, client_data, client_fraction, seed)
+        self.algorithm = "FedSGDGradient"
+        client_update = make_full_batch_grad(task.loss_fn)
+        self.round_fn = make_fl_round(
+            client_update,
+            client_data.x, client_data.y, client_data.counts,
+            self.nr_clients_per_round,
+            aggregator=aggregator,
+            apply_aggregate=lambda params, g: jax.tree.map(
+                lambda p, gg: p - lr * gg, params, g
+            ),
+            attack=attack, malicious_mask=malicious_mask,
+        )
+
+
+class FedSgdWeightServer(DecentralizedServer):
+    """Homework-1 A1: clients take ONE local full-batch SGD step and return
+    *weights*; the server installs their weighted average.  Mathematically
+    identical to FedSgdGradientServer round-for-round (the homework shows a
+    0.0 accuracy delta; lab/homework-1.ipynb cells 13-18)."""
+
+    def __init__(self, task: Task, lr: float, client_data: ClientDatasets,
+                 client_fraction: float, seed: int,
+                 aggregator=None, attack=None, malicious_mask=None):
+        super().__init__(task, lr, -1, client_data, client_fraction, seed)
+        self.algorithm = "FedSGDWeight"
+        client_update = make_local_sgd_update(task.loss_fn, lr, -1, 1)
+        self.round_fn = make_fl_round(
+            client_update,
+            client_data.x, client_data.y, client_data.counts,
+            self.nr_clients_per_round,
+            aggregator=aggregator,
+            attack=attack, malicious_mask=malicious_mask,
+        )
+
+
+class FedAvgServer(DecentralizedServer):
+    """FedAvg: clients run E local epochs of minibatch SGD and return weights;
+    the server installs the n_k-weighted average
+    (reference: hfl_complete.py:336-390)."""
+
+    def __init__(self, task: Task, lr: float, batch_size: int,
+                 client_data: ClientDatasets, client_fraction: float,
+                 nr_local_epochs: int, seed: int,
+                 aggregator=None, attack=None, malicious_mask=None):
+        super().__init__(task, lr, batch_size, client_data, client_fraction, seed)
+        self.algorithm = "FedAvg"
+        self.nr_local_epochs = nr_local_epochs
+        if client_data.max_samples % batch_size != 0:
+            raise ValueError(
+                "client_data must be stacked with pad_multiple=batch_size "
+                f"(max_samples={client_data.max_samples}, batch={batch_size})"
+            )
+        client_update = make_local_sgd_update(
+            task.loss_fn, lr, batch_size, nr_local_epochs
+        )
+        self.round_fn = make_fl_round(
+            client_update,
+            client_data.x, client_data.y, client_data.counts,
+            self.nr_clients_per_round,
+            aggregator=aggregator,
+            attack=attack, malicious_mask=malicious_mask,
+        )

@@ -1,0 +1,58 @@
+"""Task bundles: model + loss + scorer + test set for the FL servers.
+
+The reference binds MNIST and MnistCnn as module globals
+(hfl_complete.py:26-31,146-166); here a ``Task`` makes the binding explicit so
+the same servers drive MNIST/MnistCnn, CIFAR/ResNet, or any flax model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..models.cnn import MnistCnn
+from ..ops.losses import nll_loss
+
+
+@dataclass
+class Task:
+    init: Callable  # key -> params
+    loss_fn: Callable  # (params, x, y, mask, key) -> scalar (train mode)
+    score_fn: Callable  # (params, x) -> (B, classes) scores (eval mode)
+    test_x: object
+    test_y: object
+    _evaluator: Callable = None
+
+    def evaluator(self):
+        """Shared jitted test-set evaluator (one compile per task, however
+        many servers use it)."""
+        if self._evaluator is None:
+            from .engine import make_evaluator
+
+            self._evaluator = make_evaluator(self.score_fn, self.test_x, self.test_y)
+        return self._evaluator
+
+
+def classification_task(model, input_shape, test_x, test_y, loss=nll_loss) -> Task:
+    """Task for a flax classifier whose __call__ takes ``train`` and uses a
+    'dropout' rng collection (as MnistCnn does)."""
+
+    def init(key):
+        return model.init(key, jnp.zeros((1,) + tuple(input_shape)))
+
+    def loss_fn(params, xb, yb, mask, key):
+        out = model.apply(params, xb, train=True, rngs={"dropout": key})
+        return loss(out, yb, mask)
+
+    def score_fn(params, x):
+        return model.apply(params, x)
+
+    return Task(init=init, loss_fn=loss_fn, score_fn=score_fn,
+                test_x=test_x, test_y=test_y)
+
+
+def mnist_task(test_x, test_y) -> Task:
+    return classification_task(MnistCnn(), (28, 28, 1), test_x, test_y)

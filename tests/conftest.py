@@ -23,6 +23,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# XLA:CPU compiles of grad-of-scan-of-conv programs take 10-20s each; cache
+# them persistently so repeated test runs pay compile cost only once.
+jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 assert len(jax.devices()) >= 8, (
     "expected the 8-device virtual CPU mesh; got " + repr(jax.devices())
 )

@@ -1,0 +1,129 @@
+"""HFL engine tests.
+
+Core oracles (SURVEY.md §4): seeded self-equivalences replace the reference's
+homework checks —
+- FedSGD-weight ≡ FedSGD-gradient round-for-round (homework-1 A1: exact 0.0
+  accuracy delta, lab/homework-1.ipynb cells 13-18);
+- C=1 FedSGD with one client ≡ a centralized full-batch step;
+- convergence: FedAvg improves test accuracy over rounds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.data import load_mnist, split_dataset
+from ddl25spring_tpu.fl import (
+    CentralizedServer,
+    FedAvgServer,
+    FedSgdGradientServer,
+    FedSgdWeightServer,
+    mnist_task,
+)
+
+
+@pytest.fixture(scope="module")
+def small_mnist():
+    return load_mnist(n_train=1024, n_test=256)
+
+
+@pytest.fixture(scope="module")
+def task(small_mnist):
+    ds = small_mnist
+    return mnist_task(ds.test_x, ds.test_y)
+
+
+def params_allclose(a, b, atol=1e-5):
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    return all(jnp.allclose(x, y, atol=atol) for x, y in zip(flat_a, flat_b))
+
+
+def test_fedsgd_weight_equals_gradient(small_mnist, task):
+    ds = small_mnist
+    clients = split_dataset(ds.train_x, ds.train_y, nr_clients=8, iid=True, seed=10)
+    g_server = FedSgdGradientServer(task, lr=0.05, client_data=clients,
+                                    client_fraction=0.5, seed=10)
+    w_server = FedSgdWeightServer(task, lr=0.05, client_data=clients,
+                                  client_fraction=0.5, seed=10)
+    rr_g = g_server.run(2)
+    rr_w = w_server.run(2)
+    assert params_allclose(g_server.params, w_server.params, atol=1e-5)
+    assert rr_g.test_accuracy == rr_w.test_accuracy
+    # message-count model: 2 * round * m, cumulative (hfl_complete.py:309)
+    assert rr_g.message_count == [2 * 4, 4 * 4]
+
+
+def test_fedsgd_c1_single_client_equals_centralized_step(small_mnist, task):
+    # one client holding everything, full batch, C=1: a FedSGD round is
+    # exactly one centralized full-batch SGD step
+    ds = small_mnist
+    clients = split_dataset(ds.train_x, ds.train_y, nr_clients=1, iid=True, seed=0)
+    server = FedSgdGradientServer(task, lr=0.05, client_data=clients,
+                                  client_fraction=1.0, seed=3)
+
+    p0 = server.params
+    p1 = server.round_fn(p0, server.run_key, 0)
+
+    # manual replication with the same key discipline
+    round_key = jax.random.fold_in(server.run_key, 0)
+    sel0 = jnp.int32(0)
+    ckey = jax.random.fold_in(round_key, sel0)
+    epoch_key = jax.random.split(ckey, 1)[0]
+    _, steps_key = jax.random.split(epoch_key)
+    step_key = jax.random.split(steps_key, 1)[0]
+    mask = jnp.arange(clients.max_samples) < clients.counts[0]
+    g = jax.grad(task.loss_fn)(p0, jnp.asarray(clients.x[0]),
+                               jnp.asarray(clients.y[0]), mask, step_key)
+    manual = jax.tree.map(lambda p, gg: p - 0.05 * gg, p0, g)
+    assert params_allclose(p1, manual, atol=1e-6)
+
+
+def test_fedavg_improves_and_schema(small_mnist, task):
+    ds = small_mnist
+    clients = split_dataset(ds.train_x, ds.train_y, nr_clients=8, iid=True,
+                            seed=10, pad_multiple=64)
+    server = FedAvgServer(task, lr=0.05, batch_size=64, client_data=clients,
+                          client_fraction=0.5, nr_local_epochs=2, seed=10)
+    first = server.test()
+    rr = server.run(3)
+    assert rr.algorithm == "FedAvg"
+    assert rr.e == 2
+    assert len(rr.test_accuracy) == 3
+    assert rr.test_accuracy[-1] > first + 10  # learns well above init (~10%)
+
+
+def test_fedavg_deterministic_given_seed(small_mnist, task):
+    ds = small_mnist
+    clients = split_dataset(ds.train_x, ds.train_y, nr_clients=4, iid=True,
+                            seed=1, pad_multiple=128)
+    runs = []
+    for _ in range(2):
+        s = FedAvgServer(task, lr=0.05, batch_size=128, client_data=clients,
+                         client_fraction=0.5, nr_local_epochs=1, seed=7)
+        rr = s.run(2)
+        runs.append((rr.test_accuracy, s.params))
+    assert runs[0][0] == runs[1][0]
+    assert params_allclose(runs[0][1], runs[1][1], atol=0)
+
+
+def test_noniid_fedavg_runs(small_mnist, task):
+    ds = small_mnist
+    clients = split_dataset(ds.train_x, ds.train_y, nr_clients=8, iid=False,
+                            seed=10, pad_multiple=64)
+    server = FedAvgServer(task, lr=0.05, batch_size=64, client_data=clients,
+                          client_fraction=0.25, nr_local_epochs=1, seed=10)
+    rr = server.run(2)
+    assert len(rr.test_accuracy) == 2
+
+
+def test_centralized_server_one_epoch_learns(small_mnist, task):
+    ds = small_mnist
+    server = CentralizedServer(task, lr=0.05, batch_size=128, seed=42,
+                               train_x=ds.train_x, train_y=ds.train_y)
+    acc0 = server.test()
+    rr = server.run(2)
+    assert rr.algorithm == "Centralized"
+    assert rr.message_count == [0, 0]
+    assert rr.test_accuracy[-1] > acc0
