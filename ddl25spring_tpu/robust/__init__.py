@@ -1,0 +1,21 @@
+from .aggregators import (
+    weighted_mean,
+    coordinate_median,
+    make_trimmed_mean,
+    make_krum,
+)
+from .attacks import (
+    make_gaussian_attack,
+    make_sign_flip_attack,
+    flip_labels,
+)
+
+__all__ = [
+    "weighted_mean",
+    "coordinate_median",
+    "make_trimmed_mean",
+    "make_krum",
+    "make_gaussian_attack",
+    "make_sign_flip_attack",
+    "flip_labels",
+]

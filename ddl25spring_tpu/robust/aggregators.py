@@ -1,0 +1,104 @@
+"""Byzantine-robust aggregators.
+
+The reference course plans an attacks & defenses part (lab/README.md:13-16)
+but ships no code for it; the only hook is the FedAvg server-side aggregation
+point (hfl_complete.py:377-383).  These are jit-compiled pure functions over
+the stacked client-update pytree, pluggable into ``make_fl_round``'s
+``aggregator=`` argument (fl/engine.py).
+
+All aggregators share the signature ``agg(stacked_updates, weights, key) ->
+update`` where ``stacked_updates`` has a leading client axis of size m and
+``weights`` are the n_k-normalized sample weights (ignored by the robust
+aggregators, which assume adversarial counts can't be trusted).
+
+References (public algorithms):
+- Krum / multi-Krum: Blanchard et al., "Machine Learning with Adversaries:
+  Byzantine Tolerant Gradient Descent", NeurIPS 2017.
+- Coordinate-wise trimmed mean / median: Yin et al., "Byzantine-Robust
+  Distributed Learning: Towards Optimal Statistical Rates", ICML 2018.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.trees import tree_weighted_mean
+
+
+def _stack_to_matrix(stacked):
+    """Flatten a stacked pytree (m, ...) into an (m, D) matrix plus a
+    function mapping a (D,) vector back to one update pytree."""
+    leaves = jax.tree.leaves(stacked)
+    m = leaves[0].shape[0]
+    mat = jnp.concatenate([leaf.reshape(m, -1) for leaf in leaves], axis=1)
+
+    treedef = jax.tree.structure(stacked)
+    shapes = [leaf.shape[1:] for leaf in leaves]
+    sizes = [math.prod(s) for s in shapes]
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+
+    def unflatten(vec):
+        parts = [
+            vec[offsets[i]:offsets[i + 1]].reshape(shapes[i])
+            for i in range(len(sizes))
+        ]
+        return jax.tree.unflatten(treedef, parts)
+
+    return mat, unflatten
+
+
+def weighted_mean(stacked, weights, key=None):
+    """The plain FedAvg aggregation (reference hfl_complete.py:377-378)."""
+    return tree_weighted_mean(stacked, weights)
+
+
+def coordinate_median(stacked, weights=None, key=None):
+    """Coordinate-wise median over the client axis."""
+    mat, unflatten = _stack_to_matrix(stacked)
+    return unflatten(jnp.median(mat, axis=0))
+
+
+def make_trimmed_mean(trim_ratio: float):
+    """Coordinate-wise mean after dropping the ``trim_ratio`` fraction of
+    smallest and largest values in every coordinate."""
+
+    def trimmed_mean(stacked, weights=None, key=None):
+        mat, unflatten = _stack_to_matrix(stacked)
+        m = mat.shape[0]
+        k = int(trim_ratio * m)
+        if 2 * k >= m:
+            raise ValueError(f"trim_ratio {trim_ratio} removes all {m} clients")
+        s = jnp.sort(mat, axis=0)
+        kept = s[k : m - k] if k > 0 else s
+        return unflatten(jnp.mean(kept, axis=0))
+
+    return trimmed_mean
+
+
+def make_krum(nr_byzantine: int, nr_selected: int = 1):
+    """(multi-)Krum: score each update by the sum of its m - f - 2 smallest
+    squared distances to the other updates; keep the ``nr_selected``
+    best-scoring updates and average them (``nr_selected=1`` is classic Krum).
+    """
+
+    def krum(stacked, weights=None, key=None):
+        mat, unflatten = _stack_to_matrix(stacked)
+        m = mat.shape[0]
+        nr_neighbors = m - nr_byzantine - 2
+        if nr_neighbors < 1:
+            raise ValueError(
+                f"krum needs m - f - 2 >= 1 (m={m}, f={nr_byzantine})"
+            )
+        sq = jnp.sum((mat[:, None, :] - mat[None, :, :]) ** 2, axis=-1)
+        sq = sq + jnp.diag(jnp.full(m, jnp.inf))  # exclude self-distance
+        neighbor_d = jnp.sort(sq, axis=1)[:, :nr_neighbors]
+        scores = jnp.sum(neighbor_d, axis=1)
+        chosen = jnp.argsort(scores)[:nr_selected]
+        return unflatten(jnp.mean(mat[chosen], axis=0))
+
+    return krum

@@ -1,0 +1,61 @@
+"""Byzantine attack models.
+
+Two kinds, matching the north-star requirement (BASELINE.json configs[4]:
+"label-flip + Gaussian Byzantine vs Krum/trimmed-mean at 256 clients"):
+
+- **update attacks** transform a malicious client's outgoing update; they plug
+  into ``make_fl_round``'s ``attack=``/``malicious_mask=`` arguments and run
+  inside the jitted round (signature ``attack(update, params, key) ->
+  update``).
+- **data attacks** poison a malicious client's local dataset before training;
+  they transform the stacked ``ClientDatasets`` up front (label flipping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.split import ClientDatasets
+
+
+def make_gaussian_attack(sigma: float = 1.0):
+    """Replace the update with pure Gaussian noise of scale ``sigma``."""
+
+    def attack(update, params, key):
+        leaves, treedef = jax.tree.flatten(update)
+        keys = jax.random.split(key, len(leaves))
+        noisy = [
+            sigma * jax.random.normal(k, leaf.shape, leaf.dtype)
+            for k, leaf in zip(keys, leaves)
+        ]
+        return jax.tree.unflatten(treedef, noisy)
+
+    return attack
+
+
+def make_sign_flip_attack(scale: float = 1.0):
+    """Send the negated (optionally scaled) honest update."""
+
+    def attack(update, params, key):
+        return jax.tree.map(lambda u: -scale * u, update)
+
+    return attack
+
+
+def flip_labels(
+    data: ClientDatasets, malicious: np.ndarray, nr_classes: int
+) -> ClientDatasets:
+    """Label-flip data poisoning: malicious clients relabel every sample
+    ``y -> (nr_classes - 1) - y`` (the canonical flip for MNIST/CIFAR).
+
+    ``malicious`` is a boolean (N,) mask over clients.
+    """
+    malicious = np.asarray(malicious, dtype=bool)
+    y = np.array(data.y)
+    flipped = (nr_classes - 1) - y
+    y[malicious] = flipped[malicious]
+    return dataclasses.replace(data, y=y)
