@@ -6,6 +6,12 @@ from .split import (
 )
 from .mnist import load_mnist, synthetic_image_dataset, ImageDataset
 from .cifar import load_cifar10
+from .text import (
+    ByteTokenizer,
+    TokenStream,
+    SyntheticStories,
+    load_stories,
+)
 from .heart import (
     load_heart_df,
     load_heart_classification,
@@ -25,6 +31,10 @@ __all__ = [
     "synthetic_image_dataset",
     "ImageDataset",
     "load_cifar10",
+    "ByteTokenizer",
+    "TokenStream",
+    "SyntheticStories",
+    "load_stories",
     "load_heart_df",
     "load_heart_classification",
     "synthetic_heart_df",

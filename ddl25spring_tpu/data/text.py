@@ -1,0 +1,185 @@
+"""Tokenizer + token stream for LLM training.
+
+The reference uses ``simplellm``'s SentencePiece tokenizer and TinyStories
+loader (``SPTokenizer``, ``TinyStories(tokenizer, batch_size, seq_l, skip)``;
+call sites at lab/tutorial_1b/primer/intro.py:15-19 and
+lab/tutorial_1b/DP/gradient_aggr/intro_DP_GA.py:26-29, where ``skip =
+rank * 5000`` offsets each DP shard's stream).
+
+TPU-native equivalents, zero external downloads:
+
+- ``ByteTokenizer`` — byte-level vocab (3 specials + 256 bytes), pure Python,
+  stands in for the C++ sentencepiece dependency; tokenization stays on host
+  either way.
+- ``SyntheticStories`` — a deterministic TinyStories-like corpus generated
+  from sentence templates and word banks; story i is a pure function of
+  (seed, i), so DP shards with different ``skip`` are reproducible and
+  disjoint.  If a real text corpus is available (``$DDL25_DATA_DIR/
+  tinystories.txt``), it is used instead, same interface.
+- ``TokenStream`` — iterable yielding dense ``(batch_size, seq_l)`` int32
+  token blocks from concatenated stories, with the reference's ``skip``
+  semantics (skip is measured in batches, matching ``TinyStories(...,
+  skip=rank*5000)`` usage where each rank skips whole batches).
+"""
+
+from __future__ import annotations
+
+import itertools
+from pathlib import Path
+
+import numpy as np
+
+from .mnist import candidate_data_dirs
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+_BYTE_OFFSET = 3
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer with the ``SPTokenizer`` surface the reference
+    uses: ``.vocab_size``, ``.pad_id``, ``encode``, ``decode``."""
+
+    pad_id = PAD_ID
+    bos_id = BOS_ID
+    eos_id = EOS_ID
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + _BYTE_OFFSET
+
+    def encode(self, text: str, bos: bool = True, eos: bool = True):
+        ids = [b + _BYTE_OFFSET for b in text.encode("utf-8")]
+        if bos:
+            ids = [BOS_ID] + ids
+        if eos:
+            ids = ids + [EOS_ID]
+        return ids
+
+    def decode(self, ids) -> str:
+        data = bytes(
+            i - _BYTE_OFFSET for i in ids if i >= _BYTE_OFFSET
+        )
+        return data.decode("utf-8", errors="replace")
+
+
+_NAMES = [
+    "Lily", "Tom", "Mia", "Ben", "Sue", "Max", "Ana", "Leo", "Ivy", "Sam",
+]
+_ANIMALS = [
+    "cat", "dog", "bird", "fox", "bear", "frog", "mouse", "owl", "duck", "pig",
+]
+_OBJECTS = [
+    "ball", "hat", "box", "kite", "cake", "book", "star", "leaf", "cup", "shell",
+]
+_PLACES = [
+    "park", "forest", "garden", "house", "river", "hill", "beach", "farm",
+    "school", "meadow",
+]
+_FEELINGS = [
+    "happy", "sad", "excited", "scared", "proud", "curious", "sleepy", "brave",
+    "shy", "surprised",
+]
+
+
+def synthetic_story(seed: int, index: int) -> str:
+    """Deterministic TinyStories-style story: pure function of (seed, index)."""
+    rng = np.random.Generator(np.random.Philox(key=seed, counter=[0, 0, 0, index]))
+    name = rng.choice(_NAMES)
+    animal = rng.choice(_ANIMALS)
+    obj = rng.choice(_OBJECTS)
+    place = rng.choice(_PLACES)
+    feel1, feel2 = rng.choice(_FEELINGS, size=2, replace=False)
+    friend = rng.choice(_NAMES)
+    sentences = [
+        f"Once upon a time, {name} the {animal} lived near a {place}.",
+        f"One day, {name} found a {obj} by the {place}.",
+        f"{name} felt very {feel1} and wanted to show the {obj} to {friend}.",
+        f"{friend} said, \"What a nice {obj}! Let us play with it together.\"",
+        f"They played with the {obj} all day at the {place}.",
+        f"At the end of the day, {name} felt {feel2} and went home to sleep.",
+    ]
+    nr = 3 + int(rng.integers(0, 4))
+    return " ".join(sentences[:nr])
+
+
+class SyntheticStories:
+    """Endless deterministic story corpus with the (seed, index) contract."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def story(self, index: int) -> str:
+        return synthetic_story(self.seed, index)
+
+    def __iter__(self):
+        for i in itertools.count():
+            yield self.story(i)
+
+
+class FileStories:
+    """Story-per-line text corpus (e.g. a real TinyStories dump), cycled."""
+
+    def __init__(self, path: Path):
+        self.lines = [
+            ln.strip() for ln in path.read_text().splitlines() if ln.strip()
+        ]
+
+    def story(self, index: int) -> str:
+        return self.lines[index % len(self.lines)]
+
+    def __iter__(self):
+        for i in itertools.count():
+            yield self.story(i)
+
+
+def load_stories(seed: int = 0):
+    for root in candidate_data_dirs():
+        p = root / "tinystories.txt"
+        if p.exists():
+            return FileStories(p)
+    return SyntheticStories(seed)
+
+
+class TokenStream:
+    """Dense (batch_size, seq_l) int32 blocks from concatenated stories.
+
+    Mirrors the reference's ``TinyStories(tokenizer, batch_size, seq_l=seq_l,
+    skip=...)`` iterable (intro_DP_GA.py:26-29): tokens from consecutive
+    stories are concatenated and chunked; ``skip`` fast-forwards whole
+    batches so DP ranks consume disjoint stream segments.
+    """
+
+    def __init__(self, tokenizer, batch_size: int, seq_l: int,
+                 skip: int = 0, seed: int = 0, stories=None):
+        self.tokenizer = tokenizer
+        self.batch_size = batch_size
+        self.seq_l = seq_l
+        self.stories = stories if stories is not None else load_stories(seed)
+        self._story_index = 0
+        self._buffer: list[int] = []
+        if skip:
+            self._skip_batches(skip)
+
+    def _next_tokens(self, n: int):
+        while len(self._buffer) < n:
+            text = self.stories.story(self._story_index)
+            self._story_index += 1
+            self._buffer.extend(self.tokenizer.encode(text))
+        out, self._buffer = self._buffer[:n], self._buffer[n:]
+        return out
+
+    def _skip_batches(self, nr_batches: int):
+        # fast-forward without materializing arrays
+        self._next_tokens(nr_batches * self.batch_size * self.seq_l)
+
+    def next_batch(self) -> np.ndarray:
+        flat = self._next_tokens(self.batch_size * self.seq_l)
+        return np.asarray(flat, dtype=np.int32).reshape(
+            self.batch_size, self.seq_l
+        )
+
+    def __iter__(self):
+        while True:
+            yield self.next_batch()

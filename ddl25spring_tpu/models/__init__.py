@@ -1,3 +1,23 @@
 from .cnn import MnistCnn
+from .llama import (
+    Llama,
+    LlamaConfig,
+    LlamaFirstStage,
+    LlamaMidStage,
+    LlamaLastStage,
+    make_stages,
+    split_stage_layers,
+    full_params_to_stage_params,
+)
 
-__all__ = ["MnistCnn"]
+__all__ = [
+    "MnistCnn",
+    "Llama",
+    "LlamaConfig",
+    "LlamaFirstStage",
+    "LlamaMidStage",
+    "LlamaLastStage",
+    "make_stages",
+    "split_stage_layers",
+    "full_params_to_stage_params",
+]

@@ -1,0 +1,253 @@
+"""LLaMA-style causal transformer, decomposable into pipeline stages.
+
+The reference's LLM experiments consume an external package, ``simplellm``
+(lab/requirements.txt:9), with this surface (SURVEY.md §2.3):
+
+- ``LLama(CausalLLama, vocab_size, dmodel, num_heads, ..., n_layers,
+  ctx_size)`` — full model (lab/tutorial_1b/primer/intro.py:17-18);
+- ``LLamaFirstStage(...)`` with a separate ``.embed(tokens)``
+  (intro_PP_1F1B.py:29-30,53), ``LLamaStage`` mid stages taking/returning
+  hidden states (:34-35), ``LLamaLastStage`` returning logits (:38-39).
+
+This module provides the TPU-native equivalent: flax modules built from
+RMSNorm + rotary-position attention + SwiGLU blocks (standard public LLaMA
+recipe), with a ``FirstStage / MidStage / LastStage`` decomposition whose
+composition is *exactly* the full model — the oracle the pipeline-parallel
+tests rely on.  All matmul-heavy ops run in a configurable compute dtype
+(bfloat16 by default on TPU to hit the MXU) with float32 params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import causal_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 4096
+    dmodel: int = 288          # primer default (tutorial_1b/primer/intro.py:8)
+    nr_heads: int = 6          # (intro.py:9)
+    nr_layers: int = 6         # (intro.py:12)
+    ctx_size: int = 256        # seq_l (intro.py:10)
+    hidden_mult: float = 8 / 3  # SwiGLU hidden = mult * dmodel, rounded
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32  # compute dtype; bfloat16 on TPU
+
+    @property
+    def head_dim(self) -> int:
+        assert self.dmodel % self.nr_heads == 0
+        return self.dmodel // self.nr_heads
+
+    @property
+    def hidden_dim(self) -> int:
+        h = int(self.hidden_mult * self.dmodel)
+        return ((h + 127) // 128) * 128  # round up to MXU lane multiple
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale).astype(x.dtype)
+
+
+def rope_angles(head_dim: int, positions: jax.Array, base: float = 10000.0):
+    """Rotary embedding cos/sin tables for given (T,) positions."""
+    inv_freq = 1.0 / (
+        base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (T, hd/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array):
+    """Rotate (B, T, H, hd) queries/keys by position."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+
+
+class Attention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        B, T, _ = x.shape
+        dense = lambda name: nn.Dense(
+            cfg.dmodel, use_bias=False, dtype=cfg.dtype, name=name
+        )
+        q = dense("wq")(x).reshape(B, T, cfg.nr_heads, cfg.head_dim)
+        k = dense("wk")(x).reshape(B, T, cfg.nr_heads, cfg.head_dim)
+        v = dense("wv")(x).reshape(B, T, cfg.nr_heads, cfg.head_dim)
+        cos, sin = rope_angles(cfg.head_dim, positions)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        out = causal_attention(q, k, v)
+        out = out.reshape(B, T, cfg.dmodel)
+        return dense("wo")(out)
+
+
+class SwiGLU(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = nn.Dense(cfg.hidden_dim, use_bias=False, dtype=cfg.dtype, name="w1")(x)
+        up = nn.Dense(cfg.hidden_dim, use_bias=False, dtype=cfg.dtype, name="w3")(x)
+        return nn.Dense(cfg.dmodel, use_bias=False, dtype=cfg.dtype, name="w2")(
+            nn.silu(gate) * up
+        )
+
+
+class Block(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions
+        )
+        x = x + SwiGLU(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        return x
+
+
+def _positions(T: int):
+    return jnp.arange(T)
+
+
+class LlamaFirstStage(nn.Module):
+    """Token embedding + the first ``nr_layers`` blocks.
+
+    ``embed_only=True`` reproduces the reference first stage's separate
+    ``.embed(tokens)`` entry point (intro_PP_1F1B.py:53)."""
+
+    config: LlamaConfig
+    nr_layers: int
+
+    @nn.compact
+    def __call__(self, tokens, embed_only: bool = False):
+        cfg = self.config
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.dmodel,
+            embedding_init=nn.initializers.normal(0.02),
+            dtype=cfg.dtype, name="embed",
+        )
+        x = emb(tokens)
+        if embed_only:
+            return x
+        pos = _positions(tokens.shape[1])
+        for i in range(self.nr_layers):
+            x = Block(cfg, name=f"block{i}")(x, pos)
+        return x
+
+
+class LlamaMidStage(nn.Module):
+    """``nr_layers`` blocks over hidden states (reference LLamaStage)."""
+
+    config: LlamaConfig
+    nr_layers: int
+
+    @nn.compact
+    def __call__(self, x):
+        pos = _positions(x.shape[1])
+        for i in range(self.nr_layers):
+            x = Block(self.config, name=f"block{i}")(x, pos)
+        return x
+
+
+class LlamaLastStage(nn.Module):
+    """``nr_layers`` blocks + final norm + LM head returning logits
+    (reference LLamaLastStage)."""
+
+    config: LlamaConfig
+    nr_layers: int
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        pos = _positions(x.shape[1])
+        for i in range(self.nr_layers):
+            x = Block(cfg, name=f"block{i}")(x, pos)
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+class Llama(nn.Module):
+    """Full causal LM (reference ``LLama``, primer/intro.py:17-18)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        x = nn.Embed(
+            cfg.vocab_size, cfg.dmodel,
+            embedding_init=nn.initializers.normal(0.02),
+            dtype=cfg.dtype, name="embed",
+        )(tokens)
+        pos = _positions(tokens.shape[1])
+        for i in range(cfg.nr_layers):
+            x = Block(cfg, name=f"block{i}")(x, pos)
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def split_stage_layers(nr_layers: int, nr_stages: int) -> list[int]:
+    """Near-even layer counts per pipeline stage."""
+    base, extra = divmod(nr_layers, nr_stages)
+    return [base + (1 if i < extra else 0) for i in range(nr_stages)]
+
+
+def make_stages(config: LlamaConfig, nr_stages: int):
+    """Stage module list [First, Mid..., Last] covering all layers."""
+    assert nr_stages >= 2
+    counts = split_stage_layers(config.nr_layers, nr_stages)
+    stages = [LlamaFirstStage(config, counts[0])]
+    for c in counts[1:-1]:
+        stages.append(LlamaMidStage(config, c))
+    stages.append(LlamaLastStage(config, counts[-1]))
+    return stages
+
+
+def full_params_to_stage_params(params, config: LlamaConfig, nr_stages: int):
+    """Re-key a full ``Llama`` param tree into per-stage param trees, so a
+    pipeline over stages can be checked exactly against the one-shot model."""
+    counts = split_stage_layers(config.nr_layers, nr_stages)
+    p = params["params"]
+    out = []
+    layer = 0
+    for s, c in enumerate(counts):
+        sp = {}
+        if s == 0:
+            sp["embed"] = p["embed"]
+        for i in range(c):
+            sp[f"block{i}"] = p[f"block{layer}"]
+            layer += 1
+        if s == nr_stages - 1:
+            sp["final_norm"] = p["final_norm"]
+            sp["lm_head"] = p["lm_head"]
+        out.append({"params": sp})
+    return out

@@ -4,10 +4,12 @@ from .losses import (
     causal_lm_loss,
     accuracy,
 )
+from .attention import causal_attention
 
 __all__ = [
     "nll_loss",
     "cross_entropy_logits",
     "causal_lm_loss",
     "accuracy",
+    "causal_attention",
 ]

@@ -1,0 +1,33 @@
+"""Attention ops.
+
+``causal_attention`` is the default XLA path: one fused softmax(QK^T)V with a
+causal mask — XLA handles the fusion; a Pallas flash kernel and a ring
+(sequence-parallel) variant plug in behind the same signature.  The reference
+has no attention code of its own (it lives inside the external ``simplellm``
+dep, SURVEY.md §2.3); long-context sequence parallelism is a capability the
+TPU rebuild adds (ring attention over a ``ppermute`` ring, see
+parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_attention(q, k, v, *, precision=None):
+    """Standard causal MHA core.
+
+    Shapes: q, k, v — (B, T, H, head_dim); returns (B, T, H, head_dim).
+    Softmax is computed in float32 regardless of input dtype (bfloat16-safe).
+    """
+    head_dim = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, precision=precision
+    ).astype(jnp.float32) * scale
+    T = q.shape[1]
+    mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+    logits = jnp.where(mask[None, None, :, :], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v, precision=precision)
