@@ -1,0 +1,23 @@
+from .mesh import make_mesh, replicated, sharded
+from .dp import make_dp_train_step, dp_data_sharding
+from .pp import (
+    pp_params_from_full,
+    pp_param_shardings,
+    make_pp_loss_fn,
+    make_pp_train_step,
+)
+from .tp import llama_tp_shardings, apply_shardings
+
+__all__ = [
+    "make_mesh",
+    "replicated",
+    "sharded",
+    "make_dp_train_step",
+    "dp_data_sharding",
+    "pp_params_from_full",
+    "pp_param_shardings",
+    "make_pp_loss_fn",
+    "make_pp_train_step",
+    "llama_tp_shardings",
+    "apply_shardings",
+]

@@ -1,0 +1,53 @@
+"""Device-mesh construction.
+
+Replaces the reference's L1+L2 layers wholesale (SURVEY.md §1): instead of N
+OS processes rendezvousing over gloo TCP (``init_process_group("gloo", rank,
+world_size)``, intro_DP_GA.py:12-15), parallelism is expressed as named axes
+of one ``jax.sharding.Mesh`` and programs are single SPMD jits.  The
+reference's process groups (``new_group([0,3])`` per pipeline stage,
+intro_PP_1F1B_MP.py:31-36) become mesh axes; its collectives become
+``psum``/``ppermute`` over those axes.
+
+Axis-name conventions used across the framework:
+- ``data``    — data-parallel replicas (DP) / batch sharding
+- ``stage``   — pipeline stages (PP)
+- ``model``   — tensor-parallel shards (TP)
+- ``seq``     — sequence/context parallelism (ring attention)
+- ``clients`` — federated simulated clients
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a mesh with the given ``{axis_name: size}`` layout.
+
+    With ``axes=None``, all devices go on a single ``data`` axis.  Axis sizes
+    must multiply to the number of devices used; trailing axis of size 1 is
+    allowed for single-device testing of multi-axis programs.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if axes is None:
+        axes = {"data": len(devices)}
+    total = math.prod(axes.values())
+    if total > len(devices):
+        raise ValueError(
+            f"mesh axes {axes} need {total} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[:total]).reshape(tuple(axes.values()))
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded(mesh: Mesh, *axis_names) -> NamedSharding:
+    """NamedSharding partitioning the leading dims along ``axis_names``."""
+    return NamedSharding(mesh, P(*axis_names))

@@ -1,0 +1,181 @@
+"""Pipeline parallelism as a single SPMD program.
+
+TPU-native rebuild of the reference's process-per-stage pipelines
+(lab/tutorial_1b/PP/1F1B/):
+
+- naive single-microbatch PP (intro_PP_1F1B.py:50-95),
+- GPipe-style microbatching (intro_PP_1F1B_MB.py:48-142),
+- hybrid DP x PP over a 2-D mesh (intro_PP_1F1B_MP.py:28-36 — the variant
+  that deadlocks in the reference, homework-1.ipynb cell 48).
+
+Design (SPMD pipelining over a ``stage`` mesh axis, the scaling-book /
+GSPMD-pipelining recipe):
+
+- Stages are **homogeneous**: ``nr_layers / S`` transformer Blocks each; the
+  token embedding and LM head run *outside* the rotating pipeline (they are
+  replicated and cheap).  Per-stage block params are stacked on a leading
+  (S, ...) axis sharded over ``stage``.
+- Activations rotate with a cyclic ``jax.lax.ppermute`` each tick; after the
+  rotation, stage 0 holds the last stage's output, which is how finished
+  microbatches are collected.  ``M + S - 1`` ticks push M microbatches
+  through (the S-1 extra ticks are the pipeline bubble).
+- The schedule is **differentiable**: the transpose of ``ppermute`` is the
+  reverse ``ppermute``, so ``jax.grad`` of this forward IS the backward
+  pipeline (all-forward-then-all-backward — exactly GPipe's schedule, with
+  gradient accumulation across microbatches falling out of autodiff instead
+  of the reference's manual ``retain_graph``/re-send dance,
+  intro_PP_1F1B_MB.py:99-137).  The deadlock class the reference fought
+  (blocking send/recv ordering) does not exist here.
+- Hybrid DP x PP: run the same program on a ``(data, stage)`` mesh with the
+  batch sharded over ``data`` — GSPMD inserts the gradient all-reduce that
+  the reference does by hand per stage group (intro_PP_1F1B_MP.py:232-235).
+
+Naive PP is ``nr_microbatches=1``; there is no separate code path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.llama import Block, LlamaConfig, RMSNorm
+from ..ops.losses import causal_lm_loss
+from ..utils.trees import tree_stack
+
+
+def pp_params_from_full(params, config: LlamaConfig, nr_stages: int):
+    """Re-key full ``Llama`` params into the pipeline layout:
+    {embed, stacked_blocks (S, L, ...), final_norm, lm_head}."""
+    if config.nr_layers % nr_stages != 0:
+        raise ValueError(
+            f"pipeline needs nr_layers % nr_stages == 0 "
+            f"({config.nr_layers} % {nr_stages})"
+        )
+    p = params["params"]
+    L = config.nr_layers // nr_stages
+    blocks = [p[f"block{i}"] for i in range(config.nr_layers)]
+    per_stage = [tree_stack(blocks[s * L:(s + 1) * L]) for s in range(nr_stages)]
+    return {
+        "embed": p["embed"],
+        "stacked_blocks": tree_stack(per_stage),
+        "final_norm": p["final_norm"],
+        "lm_head": p["lm_head"],
+    }
+
+
+def pp_param_shardings(mesh, pp_params, stage_axis: str = "stage"):
+    """Sharding tree: stacked blocks split over the stage axis, rest
+    replicated."""
+    stage = NamedSharding(mesh, P(stage_axis))
+    repl = NamedSharding(mesh, P())
+    return {
+        "embed": jax.tree.map(lambda _: repl, pp_params["embed"]),
+        "stacked_blocks": jax.tree.map(lambda _: stage, pp_params["stacked_blocks"]),
+        "final_norm": jax.tree.map(lambda _: repl, pp_params["final_norm"]),
+        "lm_head": jax.tree.map(lambda _: repl, pp_params["lm_head"]),
+    }
+
+
+def make_pp_loss_fn(
+    config: LlamaConfig,
+    mesh,
+    nr_stages: int,
+    nr_microbatches: int,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+):
+    """Build ``loss(pp_params, tokens) -> scalar`` running the rotating
+    pipeline.  ``tokens`` is (B, T) with B divisible by ``nr_microbatches``
+    (times the data-axis size when ``data_axis`` is set)."""
+    S = nr_stages
+    M = nr_microbatches
+    block = Block(config)
+
+    def stage_apply(stage_blocks, h):
+        # stage_blocks: (L, ...) params of this stage's blocks
+        pos = jnp.arange(h.shape[1])
+        L = jax.tree.leaves(stage_blocks)[0].shape[0]
+        for i in range(L):
+            lp = jax.tree.map(lambda x: x[i], stage_blocks)
+            h = block.apply({"params": lp}, h, pos)
+        return h
+
+    batch_spec = P(None, data_axis) if data_axis else P()
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(stage_axis), batch_spec),
+        out_specs=batch_spec,
+        check_vma=False,
+    )
+    def pipeline(stacked_blocks, microbatches):
+        # local shard of stacked_blocks: (1, L, ...) -> this stage's blocks
+        my_blocks = jax.tree.map(lambda x: x[0], stacked_blocks)
+        sid = jax.lax.axis_index(stage_axis)
+        mb_shape = microbatches.shape[1:]
+        recv = jnp.zeros(mb_shape, microbatches.dtype)
+        outputs = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+        for t in range(M + S - 1):
+            feed = microbatches[t] if t < M else jnp.zeros(mb_shape, microbatches.dtype)
+            inp = jnp.where(sid == 0, feed, recv)
+            h = stage_apply(my_blocks, inp)
+            recv = jax.lax.ppermute(h, stage_axis, perm)
+            # after the cyclic rotation, stage 0's recv is the LAST stage's
+            # output: collect finished microbatches there
+            out_idx = t - (S - 1)
+            if 0 <= out_idx < M:
+                outputs = outputs.at[out_idx].set(
+                    jnp.where(sid == 0, recv, jnp.zeros(mb_shape, recv.dtype))
+                )
+        # only stage 0's rows are non-zero; psum replicates them everywhere
+        return jax.lax.psum(outputs, stage_axis)
+
+    def loss(pp_params, tokens):
+        B, T = tokens.shape
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        emb = pp_params["embed"]["embedding"]
+        x = jnp.take(emb, tokens, axis=0).astype(config.dtype)  # (B, T, D)
+        micro = x.reshape(M, B // M, T, config.dmodel)
+        hidden = pipeline(pp_params["stacked_blocks"], micro)
+        h = hidden.reshape(B, T, config.dmodel)
+        h = RMSNorm(config.norm_eps).apply({"params": pp_params["final_norm"]}, h)
+        logits = (h @ pp_params["lm_head"]["kernel"].astype(config.dtype)).astype(
+            jnp.float32
+        )
+        return causal_lm_loss(logits, tokens)
+
+    return loss
+
+
+def make_pp_train_step(
+    config: LlamaConfig,
+    mesh,
+    optimizer,
+    nr_stages: int,
+    nr_microbatches: int,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+):
+    """Jitted ``step(pp_params, opt_state, tokens) -> (params, state, loss)``
+    with stage-sharded block params (and optionally data-sharded batch =
+    hybrid DP x PP)."""
+    loss_fn = make_pp_loss_fn(
+        config, mesh, nr_stages, nr_microbatches, stage_axis, data_axis
+    )
+
+    @jax.jit
+    def step(pp_params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(pp_params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, pp_params)
+        pp_params = optax.apply_updates(pp_params, updates)
+        return pp_params, opt_state, loss
+
+    return step

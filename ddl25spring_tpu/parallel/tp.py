@@ -1,0 +1,49 @@
+"""Tensor parallelism (Megatron-style shardings via GSPMD).
+
+The reference has no intra-layer sharding anywhere (SURVEY.md §2.2 marks TP
+absent); under pjit/GSPMD it costs only a sharding annotation, so the TPU
+framework provides it: column-parallel first matmuls (wq/wk/wv, SwiGLU
+w1/w3), row-parallel second matmuls (wo, w2), vocab-sharded embedding and LM
+head.  XLA inserts the all-reduces the Megatron paper does by hand.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# kernel name -> partition spec of its 2-D kernel (in_dim, out_dim)
+_COLUMN = {"wq", "wk", "wv", "w1", "w3"}   # shard output dim
+_ROW = {"wo", "w2"}                        # shard input dim
+
+
+def llama_tp_shardings(mesh, params, model_axis: str = "model"):
+    """Sharding pytree for full ``Llama`` params on a mesh with a
+    ``model`` axis; all non-matmul params replicated."""
+
+    col = NamedSharding(mesh, P(None, model_axis))
+    row = NamedSharding(mesh, P(model_axis, None))
+    repl = NamedSharding(mesh, P())
+    axis_size = mesh.shape[model_axis]
+
+    def divisible(leaf, dim):
+        return leaf.shape[dim] % axis_size == 0
+
+    def spec_for(path, leaf):
+        names = [getattr(k, "key", getattr(k, "name", "")) for k in path]
+        if "kernel" in names:
+            parent = names[-2] if len(names) >= 2 else ""
+            if (parent in _COLUMN or parent == "lm_head") and divisible(leaf, 1):
+                return col
+            if parent in _ROW and divisible(leaf, 0):
+                return row
+        if "embedding" in names and divisible(leaf, 1):
+            return NamedSharding(mesh, P(None, model_axis))
+        return repl
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def apply_shardings(params, shardings):
+    """Device-put a param tree onto its sharding tree."""
+    return jax.tree.map(jax.device_put, params, shardings)

@@ -1,0 +1,182 @@
+"""Parallelism equivalence oracles on the virtual 8-device CPU mesh
+(SURVEY.md §4): DP(W shards) == single-device step on the full batch;
+PP(S stages, M microbatches) == unpartitioned model; hybrid DP x PP == both;
+TP-sharded forward == replicated forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddl25spring_tpu.data import ByteTokenizer, TokenStream
+from ddl25spring_tpu.models import Llama, LlamaConfig
+from ddl25spring_tpu.ops import causal_lm_loss
+from ddl25spring_tpu.parallel import (
+    apply_shardings,
+    dp_data_sharding,
+    llama_tp_shardings,
+    make_dp_train_step,
+    make_mesh,
+    make_pp_loss_fn,
+    make_pp_train_step,
+    pp_param_shardings,
+    pp_params_from_full,
+)
+
+CFG = LlamaConfig(vocab_size=259, dmodel=64, nr_heads=4, nr_layers=4, ctx_size=32)
+
+
+@pytest.fixture(scope="module")
+def model_and_batch():
+    model = Llama(CFG)
+    tok = ByteTokenizer()
+    stream = TokenStream(tok, batch_size=16, seq_l=32, seed=0)
+    tokens = jnp.asarray(stream.next_batch())
+    params = model.init(jax.random.key(0), tokens[:1])
+    return model, params, tokens
+
+
+def loss_of(model):
+    return lambda params, tokens: causal_lm_loss(model.apply(params, tokens), tokens)
+
+
+def tree_allclose(a, b, atol=1e-4):
+    return all(
+        jnp.allclose(x, y, atol=atol)
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# ---------------------------------------------------------------- DP
+
+
+def test_dp_grad_equals_single_device(model_and_batch):
+    model, params, tokens = model_and_batch
+    loss_fn = loss_of(model)
+    opt = optax.sgd(0.1)
+    mesh = make_mesh({"data": 8})
+
+    step = make_dp_train_step(loss_fn, opt, mesh, mode="grad")
+    sharded_tokens = jax.device_put(tokens, dp_data_sharding(mesh))
+    p_dp, _, loss_dp = step(params, opt.init(params), sharded_tokens)
+
+    # single device reference
+    l, g = jax.value_and_grad(loss_fn)(params, tokens)
+    p_ref = jax.tree.map(lambda p, gg: p - 0.1 * gg, params, g)
+    assert jnp.allclose(loss_dp, l, atol=1e-5)
+    assert tree_allclose(p_dp, p_ref)
+
+
+def test_dp_weight_mode_equals_grad_mode_for_sgd(model_and_batch):
+    model, params, tokens = model_and_batch
+    loss_fn = loss_of(model)
+    opt = optax.sgd(0.1)
+    mesh = make_mesh({"data": 8})
+    tokens_sh = jax.device_put(tokens, dp_data_sharding(mesh))
+
+    pg, _, _ = make_dp_train_step(loss_fn, opt, mesh, mode="grad")(
+        params, opt.init(params), tokens_sh
+    )
+    pw, _, _ = make_dp_train_step(loss_fn, opt, mesh, mode="weight")(
+        params, opt.init(params), tokens_sh
+    )
+    # SGD is linear: averaging weights after local steps == stepping on the
+    # averaged gradient (the reference's WA intent, tutorial_1b/README.md:178)
+    assert tree_allclose(pg, pw)
+
+
+# ---------------------------------------------------------------- PP
+
+
+@pytest.mark.parametrize("nr_stages,nr_microbatches", [(2, 1), (2, 4), (4, 2)])
+def test_pp_loss_equals_full_model(model_and_batch, nr_stages, nr_microbatches):
+    model, params, tokens = model_and_batch
+    full_loss = loss_of(model)(params, tokens)
+
+    mesh = make_mesh({"stage": nr_stages})
+    pp_params = pp_params_from_full(params, CFG, nr_stages)
+    pp_params = apply_shardings(pp_params, pp_param_shardings(mesh, pp_params))
+    loss_fn = make_pp_loss_fn(CFG, mesh, nr_stages, nr_microbatches)
+    pp_loss = jax.jit(loss_fn)(pp_params, tokens)
+    assert jnp.allclose(pp_loss, full_loss, atol=1e-5), (
+        f"S={nr_stages} M={nr_microbatches}"
+    )
+
+
+def test_pp_grads_equal_full_model(model_and_batch):
+    model, params, tokens = model_and_batch
+    g_full = jax.grad(loss_of(model))(params, tokens)
+
+    nr_stages = 4
+    mesh = make_mesh({"stage": nr_stages})
+    pp_params = pp_params_from_full(params, CFG, nr_stages)
+    loss_fn = make_pp_loss_fn(CFG, mesh, nr_stages, nr_microbatches=4)
+    g_pp = jax.jit(jax.grad(loss_fn))(pp_params, tokens)
+
+    # embed + head grads
+    assert jnp.allclose(
+        g_pp["embed"]["embedding"],
+        g_full["params"]["embed"]["embedding"], atol=1e-4,
+    )
+    assert jnp.allclose(
+        g_pp["lm_head"]["kernel"],
+        g_full["params"]["lm_head"]["kernel"], atol=1e-4,
+    )
+    # block grads: stage s, slot l == full block{s*L+l}
+    L = CFG.nr_layers // nr_stages
+    w1_stacked = g_pp["stacked_blocks"]["mlp"]["w1"]["kernel"]
+    for s in range(nr_stages):
+        for l in range(L):
+            ref = g_full["params"][f"block{s * L + l}"]["mlp"]["w1"]["kernel"]
+            assert jnp.allclose(w1_stacked[s, l], ref, atol=1e-4), (s, l)
+
+
+def test_pp_train_step_learns(model_and_batch):
+    model, params, tokens = model_and_batch
+    mesh = make_mesh({"stage": 2})
+    pp_params = pp_params_from_full(params, CFG, 2)
+    opt = optax.adam(1e-3)
+    step = make_pp_train_step(CFG, mesh, opt, nr_stages=2, nr_microbatches=4)
+    state = opt.init(pp_params)
+    losses = []
+    for _ in range(8):
+        pp_params, state, loss = step(pp_params, state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_hybrid_dp_pp_equals_full_model(model_and_batch):
+    # 2 pipelines x 4 stages on a (data=2, stage=4) mesh — the topology the
+    # reference attempts and deadlocks on (intro_PP_1F1B_MP.py; homework-1
+    # cell 48). Here it is one jit; loss must equal the unpartitioned model.
+    model, params, tokens = model_and_batch
+    full_loss = loss_of(model)(params, tokens)
+
+    mesh = make_mesh({"data": 2, "stage": 4})
+    pp_params = pp_params_from_full(params, CFG, 4)
+    loss_fn = make_pp_loss_fn(CFG, mesh, 4, nr_microbatches=2, data_axis="data")
+    pp_loss = jax.jit(loss_fn)(pp_params, tokens)
+    assert jnp.allclose(pp_loss, full_loss, atol=1e-5)
+
+
+# ---------------------------------------------------------------- TP
+
+
+def test_tp_sharded_forward_matches_replicated(model_and_batch):
+    model, params, tokens = model_and_batch
+    mesh = make_mesh({"model": 8})
+    shardings = llama_tp_shardings(mesh, params)
+    params_tp = apply_shardings(params, shardings)
+
+    @jax.jit
+    def fwd(p, t):
+        return model.apply(p, t)
+
+    out_tp = fwd(params_tp, tokens)
+    out_ref = model.apply(params, tokens)
+    assert jnp.allclose(out_tp, out_ref, atol=1e-4)
+    # kernels really are sharded over the model axis
+    wq = params_tp["params"]["block0"]["attn"]["wq"]["kernel"]
+    assert "model" in str(wq.sharding.spec)
