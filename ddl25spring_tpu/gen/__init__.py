@@ -1,0 +1,15 @@
+from .vae_trainer import (
+    train_vae,
+    encode_posterior,
+    sample_synthetic,
+    train_evaluator,
+    tstr,
+)
+
+__all__ = [
+    "train_vae",
+    "encode_posterior",
+    "sample_synthetic",
+    "train_evaluator",
+    "tstr",
+]

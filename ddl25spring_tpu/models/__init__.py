@@ -1,4 +1,6 @@
 from .cnn import MnistCnn
+from .mlp import HeartDiseaseNN
+from .vae import TabularVAE, MLPEncoder, MLPDecoder, vae_loss, reparameterize
 from .llama import (
     Llama,
     LlamaConfig,
@@ -12,6 +14,12 @@ from .llama import (
 
 __all__ = [
     "MnistCnn",
+    "HeartDiseaseNN",
+    "TabularVAE",
+    "MLPEncoder",
+    "MLPDecoder",
+    "vae_loss",
+    "reparameterize",
     "Llama",
     "LlamaConfig",
     "LlamaFirstStage",

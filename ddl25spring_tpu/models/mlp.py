@@ -1,0 +1,23 @@
+"""Tabular MLPs.
+
+``HeartDiseaseNN`` matches the reference classifier
+(lab/tutorial_2a/centralized.py:13-28): 30 -> 64 -> 128 -> 256 -> 2 with
+LeakyReLU and dropout 0.1 before the output layer.  It doubles as the TSTR
+evaluator model (generative-modeling.py:167-211).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class HeartDiseaseNN(nn.Module):
+    nr_classes: int = 2
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = nn.leaky_relu(nn.Dense(64, name="fc1")(x))
+        x = nn.leaky_relu(nn.Dense(128, name="fc2")(x))
+        x = nn.leaky_relu(nn.Dense(256, name="fc3")(x))
+        x = nn.Dropout(0.1, deterministic=not train, name="dropout")(x)
+        return nn.Dense(self.nr_classes, name="fc4")(x)

@@ -1,0 +1,101 @@
+"""Tabular VAEs.
+
+``TabularVAE`` matches the reference ``Autoencoder``
+(lab/tutorial_2a/generative-modeling.py:13-118): a BatchNorm-heavy MLP
+encoder D_in -> H -> H2 -> H2 -> latent with separate mu / logvar heads, and
+a mirrored decoder whose output passes through a final BatchNorm.  The VFL
+split variant (client encoders/decoders + server VAE over concatenated
+latents, lab/tutorial_2b/exercise_3.py:10-138) is built from the same pieces.
+
+BatchNorm uses local batch statistics (flax ``batch_stats`` collection,
+``use_running_average`` only at eval), matching the reference's torch
+semantics; under party/client sharding the stats stay local by design
+(SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLPEncoder(nn.Module):
+    """x -> (mu, logvar): three BN+ReLU layers then BN'd latent trunk."""
+
+    hidden: int = 48
+    hidden2: int = 32
+    latent_dim: int = 16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        bn = lambda name: nn.BatchNorm(
+            use_running_average=not train, name=name
+        )
+        x = nn.relu(bn("bn1")(nn.Dense(self.hidden, name="lin1")(x)))
+        x = nn.relu(bn("bn2")(nn.Dense(self.hidden2, name="lin2")(x)))
+        x = nn.relu(bn("bn3")(nn.Dense(self.hidden2, name="lin3")(x)))
+        x = nn.relu(bn("bn_fc")(nn.Dense(self.latent_dim, name="fc")(x)))
+        mu = nn.Dense(self.latent_dim, name="mu")(x)
+        logvar = nn.Dense(self.latent_dim, name="logvar")(x)
+        return mu, logvar
+
+
+class MLPDecoder(nn.Module):
+    """z -> x_recon, final layer BatchNorm'd (reference decode,
+    generative-modeling.py:69-75)."""
+
+    out_dim: int
+    hidden: int = 48
+    hidden2: int = 32
+    latent_dim: int = 16
+
+    @nn.compact
+    def __call__(self, z, *, train: bool):
+        bn = lambda name: nn.BatchNorm(
+            use_running_average=not train, name=name
+        )
+        z = nn.relu(bn("bn_fc3")(nn.Dense(self.latent_dim, name="fc3")(z)))
+        z = nn.relu(bn("bn_fc4")(nn.Dense(self.hidden2, name="fc4")(z)))
+        z = nn.relu(bn("bn4")(nn.Dense(self.hidden2, name="lin4")(z)))
+        z = nn.relu(bn("bn5")(nn.Dense(self.hidden, name="lin5")(z)))
+        return bn("bn6")(nn.Dense(self.out_dim, name="lin6")(z))
+
+
+def reparameterize(key, mu, logvar, train: bool = True):
+    if not train:
+        return mu
+    std = jnp.exp(0.5 * logvar)
+    return mu + std * jax.random.normal(key, mu.shape)
+
+
+class TabularVAE(nn.Module):
+    """Full VAE (reference ``Autoencoder``)."""
+
+    d_in: int
+    hidden: int = 48
+    hidden2: int = 32
+    latent_dim: int = 16
+
+    def setup(self):
+        self.encoder = MLPEncoder(self.hidden, self.hidden2, self.latent_dim)
+        self.decoder = MLPDecoder(
+            self.d_in, self.hidden, self.hidden2, self.latent_dim
+        )
+
+    def __call__(self, x, *, train: bool = False, key=None):
+        mu, logvar = self.encoder(x, train=train)
+        z = reparameterize(key, mu, logvar, train) if train else mu
+        recon = self.decoder(z, train=train)
+        return recon, mu, logvar
+
+    def decode(self, z, *, train: bool = False):
+        return self.decoder(z, train=train)
+
+
+def vae_loss(recon, x, mu, logvar):
+    """Sum-MSE + KLD (reference ``customLoss``,
+    generative-modeling.py:121-130)."""
+    mse = jnp.sum(jnp.square(recon - x))
+    kld = -0.5 * jnp.sum(1 + logvar - jnp.square(mu) - jnp.exp(logvar))
+    return mse + kld

@@ -1,0 +1,25 @@
+from .splitnn import (
+    BottomModel,
+    TopModel,
+    VFLNetwork,
+    partition_features,
+)
+from .splitvae import (
+    ClientEncoder,
+    ClientDecoder,
+    ServerVAE,
+    VFLVAE,
+    combined_loss,
+)
+
+__all__ = [
+    "BottomModel",
+    "TopModel",
+    "VFLNetwork",
+    "partition_features",
+    "ClientEncoder",
+    "ClientDecoder",
+    "ServerVAE",
+    "VFLVAE",
+    "combined_loss",
+]

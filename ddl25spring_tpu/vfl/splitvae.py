@@ -1,0 +1,244 @@
+"""Vertical-FL split generative model (VFL-VAE).
+
+Reference: lab/tutorial_2b/exercise_3.py — per-client ``ClientEncoder``
+(input -> 48 -> 32 -> 32 -> client latent, all BatchNorm+ReLU, :10-31),
+latents concatenated at the server (:127-128), a ``ServerVAE`` over the
+concatenation (16-dim inner latent, :56-113), the reconstructed concat latent
+re-split per client and decoded by ``ClientDecoder`` (:129-137).
+``combined_loss`` = sum of client reconstruction MSEs + latent reconstruction
+MSE + KLD (:140-147); training is 1000 epochs of full-batch Adam (:191-203).
+
+The two activation cuts (encoders -> concat, re-split -> decoders) are the
+places where real VFL ships tensors between parties; here they are
+``jnp.concatenate`` / slicing inside one jit — party-shardable exactly like
+the split-NN cut (see vfl/splitnn.py docstring).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models.vae import MLPEncoder, MLPDecoder, reparameterize
+
+
+class ClientEncoder(nn.Module):
+    latent_dim: int = 8
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        bn = lambda name: nn.BatchNorm(use_running_average=not train, name=name)
+        x = nn.relu(bn("bn1")(nn.Dense(48, name="lin1")(x)))
+        x = nn.relu(bn("bn2")(nn.Dense(32, name="lin2")(x)))
+        x = nn.relu(bn("bn3")(nn.Dense(32, name="lin3")(x)))
+        return nn.relu(bn("bn_fc")(nn.Dense(self.latent_dim, name="fc")(x)))
+
+
+class ClientDecoder(nn.Module):
+    out_dim: int
+    latent_dim: int = 8
+
+    @nn.compact
+    def __call__(self, z, *, train: bool):
+        bn = lambda name: nn.BatchNorm(use_running_average=not train, name=name)
+        z = nn.relu(bn("bn1")(nn.Dense(self.latent_dim, name="lin1")(z)))
+        z = nn.relu(bn("bn2")(nn.Dense(32, name="lin2")(z)))
+        z = nn.relu(bn("bn3")(nn.Dense(48, name="lin3")(z)))
+        return bn("bn4")(nn.Dense(self.out_dim, name="lin4")(z))
+
+
+class ServerVAE(nn.Module):
+    """VAE over the concatenated client latents (reference ServerVAE)."""
+
+    d_in: int
+    latent_dim: int = 16
+
+    def setup(self):
+        self.encoder = MLPEncoder(48, 32, self.latent_dim)
+        self.decoder = MLPDecoder(self.d_in, 48, 32, self.latent_dim)
+
+    def __call__(self, x, *, train: bool, key=None):
+        mu, logvar = self.encoder(x, train=train)
+        z = reparameterize(key, mu, logvar, train) if train else mu
+        recon = self.decoder(z, train=train)
+        return recon, mu, logvar
+
+
+def combined_loss(x_clients, recon_clients, concat_latent, recon_concat, mu, logvar):
+    """Reference combined_loss (exercise_3.py:140-147)."""
+    client_loss = sum(
+        jnp.sum(jnp.square(r - o)) for r, o in zip(recon_clients, x_clients)
+    )
+    latent_loss = jnp.sum(jnp.square(recon_concat - concat_latent))
+    kld = -0.5 * jnp.sum(1 + logvar - jnp.square(mu) - jnp.exp(logvar))
+    return client_loss + latent_loss + kld
+
+
+@dataclass
+class VFLVAE:
+    """Client encoders + server VAE + client decoders, one jitted program."""
+
+    feature_slices: list      # per-party column index arrays
+    client_latent_dim: int = 8
+    server_latent_dim: int = 16
+    seed: int = 42
+    lr: float = 1e-3
+
+    def __post_init__(self):
+        P = len(self.feature_slices)
+        self.encoders = [ClientEncoder(self.client_latent_dim) for _ in range(P)]
+        self.decoders = [
+            ClientDecoder(len(sl), self.client_latent_dim)
+            for sl in self.feature_slices
+        ]
+        self.server = ServerVAE(
+            P * self.client_latent_dim, self.server_latent_dim
+        )
+        key = jax.random.key(self.seed)
+        ks = jax.random.split(key, 2 * P + 2)
+        variables = {"encoders": [], "decoders": []}
+        for i, sl in enumerate(self.feature_slices):
+            variables["encoders"].append(
+                self.encoders[i].init(ks[i], jnp.zeros((2, len(sl))), train=True)
+            )
+            variables["decoders"].append(
+                self.decoders[i].init(
+                    ks[P + i], jnp.zeros((2, self.client_latent_dim)), train=True
+                )
+            )
+        variables["server"] = self.server.init(
+            ks[-2], jnp.zeros((2, P * self.client_latent_dim)),
+            train=True, key=ks[-1],
+        )
+        self.variables = variables
+        self.rng = ks[-1]
+        self.optimizer = optax.adam(self.lr)
+        self._step = self._build_step()
+
+    def forward(self, variables, x_clients, *, train: bool, key=None):
+        P = len(self.encoders)
+        new_stats = {"encoders": [], "decoders": [], "server": None}
+        latents = []
+        for i in range(P):
+            out = self.encoders[i].apply(
+                variables["encoders"][i], x_clients[i], train=train,
+                mutable=["batch_stats"] if train else False,
+            )
+            if train:
+                z, st = out
+                new_stats["encoders"].append(st)
+            else:
+                z = out
+            latents.append(z)
+        concat = jnp.concatenate(latents, axis=1)  # cut #1: clients -> server
+
+        out = self.server.apply(
+            variables["server"], concat, train=train, key=key,
+            mutable=["batch_stats"] if train else False,
+        )
+        if train:
+            (recon_concat, mu, logvar), st = out
+            new_stats["server"] = st
+        else:
+            recon_concat, mu, logvar = out
+
+        recons = []
+        for i in range(P):  # cut #2: server -> clients (re-split latent)
+            part = recon_concat[
+                :, i * self.client_latent_dim:(i + 1) * self.client_latent_dim
+            ]
+            out = self.decoders[i].apply(
+                variables["decoders"][i], part, train=train,
+                mutable=["batch_stats"] if train else False,
+            )
+            if train:
+                r, st = out
+                new_stats["decoders"].append(st)
+            else:
+                r = out
+            recons.append(r)
+        return recons, mu, logvar, concat, recon_concat, new_stats
+
+    def _merge_stats(self, variables, new_stats):
+        out = {"encoders": [], "decoders": [], "server": None}
+        for k in ("encoders", "decoders"):
+            for v, st in zip(variables[k], new_stats[k]):
+                out[k].append({**v, **st})
+        out["server"] = {**variables["server"], **new_stats["server"]}
+        return out
+
+    def _build_step(self):
+        def loss_fn(params_tree, variables, x_clients, key):
+            # params_tree holds only 'params'; batch_stats come from variables
+            merged = _set_params(variables, params_tree)
+            recons, mu, logvar, concat, recon_concat, new_stats = self.forward(
+                merged, x_clients, train=True, key=key
+            )
+            loss = combined_loss(x_clients, recons, concat, recon_concat, mu, logvar)
+            return loss, new_stats
+
+        @jax.jit
+        def step(params_tree, variables, opt_state, x_clients, key):
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params_tree, variables, x_clients, key)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params_tree
+            )
+            params_tree = optax.apply_updates(params_tree, updates)
+            return params_tree, opt_state, loss, new_stats
+
+        return step
+
+    def train(self, x_clients, epochs: int = 1000, verbose_every: int = 0):
+        """Full-batch Adam, the reference schedule (exercise_3.py:191-203)."""
+        x_clients = [jnp.asarray(x, jnp.float32) for x in x_clients]
+        params_tree = _get_params(self.variables)
+        opt_state = self.optimizer.init(params_tree)
+        losses = []
+        for epoch in range(epochs):
+            key = jax.random.fold_in(self.rng, epoch)
+            params_tree, opt_state, loss, new_stats = self._step(
+                params_tree, self.variables, opt_state, x_clients, key
+            )
+            self.variables = self._merge_stats(
+                _set_params(self.variables, params_tree), new_stats
+            )
+            losses.append(float(loss))
+            if verbose_every and epoch % verbose_every == 0:
+                print(f"Epoch {epoch + 1}, Loss: {losses[-1]:.4f}")
+        return losses
+
+    def reconstruct(self, x_clients):
+        x_clients = [jnp.asarray(x, jnp.float32) for x in x_clients]
+        recons, *_ = self.forward(self.variables, x_clients, train=False)
+        return recons
+
+
+def _get_params(variables):
+    return {
+        "encoders": [{"params": v["params"]} for v in variables["encoders"]],
+        "decoders": [{"params": v["params"]} for v in variables["decoders"]],
+        "server": {"params": variables["server"]["params"]},
+    }
+
+
+def _set_params(variables, params_tree):
+    return {
+        "encoders": [
+            {**v, "params": p["params"]}
+            for v, p in zip(variables["encoders"], params_tree["encoders"])
+        ],
+        "decoders": [
+            {**v, "params": p["params"]}
+            for v, p in zip(variables["decoders"], params_tree["decoders"])
+        ],
+        "server": {
+            **variables["server"],
+            "params": params_tree["server"]["params"],
+        },
+    }
