@@ -1,5 +1,6 @@
 from .cnn import MnistCnn
 from .mlp import HeartDiseaseNN
+from .resnet import BasicBlock, ResNet, ResNet18
 from .vae import TabularVAE, MLPEncoder, MLPDecoder, vae_loss, reparameterize
 from .llama import (
     Llama,
@@ -15,6 +16,9 @@ from .llama import (
 __all__ = [
     "MnistCnn",
     "HeartDiseaseNN",
+    "BasicBlock",
+    "ResNet",
+    "ResNet18",
     "TabularVAE",
     "MLPEncoder",
     "MLPDecoder",

@@ -1,0 +1,80 @@
+"""ResNet-18 (CIFAR variant) — the north-star FL model.
+
+The reference repo has no ResNet; the driver's north star (BASELINE.json)
+specifies "FedAvg ... CIFAR-10, 256 clients, ResNet-18".  This is the
+standard CIFAR ResNet-18 recipe (He et al. 2016, public): a 3x3 stem (no
+7x7/maxpool — CIFAR images are 32x32), four groups of two BasicBlocks at
+widths 64/128/256/512 with strides 1/2/2/2, global average pool, linear head.
+
+Normalisation is **GroupNorm, not BatchNorm**, a deliberate TPU/FL-first
+deviation: BatchNorm carries mutable running statistics that (a) break the
+pure-functional vmap-over-clients FL engine and (b) are known to degrade
+FedAvg under non-IID splits (client batch statistics diverge).  GroupNorm is
+stateless, vmap-safe, and the standard substitution in federated ResNet work.
+
+Output is log-softmax, matching MnistCnn and the shared ``nll_loss``
+(hfl_complete.py:75 uses torch's F.nll_loss the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _norm(channels: int, dtype, name: str):
+    return nn.GroupNorm(num_groups=min(32, channels), dtype=dtype, name=name)
+
+
+class BasicBlock(nn.Module):
+    channels: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c, s, dt = self.channels, self.stride, self.dtype
+        y = nn.Conv(c, (3, 3), strides=(s, s), padding="SAME", use_bias=False,
+                    dtype=dt, name="conv1")(x)
+        y = _norm(c, dt, "norm1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(c, (3, 3), padding="SAME", use_bias=False,
+                    dtype=dt, name="conv2")(y)
+        y = _norm(c, dt, "norm2")(y)
+        if x.shape[-1] != c or s != 1:
+            x = nn.Conv(c, (1, 1), strides=(s, s), use_bias=False,
+                        dtype=dt, name="proj")(x)
+            x = _norm(c, dt, "proj_norm")(x)
+        return nn.relu(x + y)
+
+
+class ResNet(nn.Module):
+    """CIFAR-style ResNet; ``blocks_per_group=(2, 2, 2, 2)`` is ResNet-18."""
+
+    nr_classes: int = 10
+    blocks_per_group: Sequence[int] = (2, 2, 2, 2)
+    widths: Sequence[int] = (64, 128, 256, 512)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        dt = self.dtype
+        x = x.astype(dt)
+        x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False,
+                    dtype=dt, name="stem")(x)
+        x = nn.relu(_norm(self.widths[0], dt, "stem_norm")(x))
+        for g, (blocks, width) in enumerate(zip(self.blocks_per_group, self.widths)):
+            for b in range(blocks):
+                stride = 2 if (b == 0 and g > 0) else 1
+                x = BasicBlock(width, stride, dt, name=f"group{g}_block{b}")(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.nr_classes, dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32)
+        )
+        return nn.log_softmax(x, axis=-1)
+
+
+def ResNet18(nr_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet(nr_classes=nr_classes, dtype=dtype)

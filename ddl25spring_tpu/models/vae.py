@@ -85,7 +85,7 @@ class TabularVAE(nn.Module):
 
     def __call__(self, x, *, train: bool = False, key=None):
         mu, logvar = self.encoder(x, train=train)
-        z = reparameterize(key, mu, logvar, train) if train else mu
+        z = reparameterize(key, mu, logvar, train)
         recon = self.decoder(z, train=train)
         return recon, mu, logvar
 

@@ -130,6 +130,7 @@ class VFLNetwork:
             keys[-2], jnp.concatenate(dummy_acts, axis=1)
         )
         self.params = params
+        self.opt_state = self.optimizer.init(params)
         self.dropout_key = keys[-1]
         self._step = self._build_step()
         self._fwd = jax.jit(lambda p, x: self.forward(p, x, train=False))
@@ -172,15 +173,16 @@ class VFLNetwork:
         y = jnp.asarray(y_onehot, jnp.float32)
         n = x.shape[0]
         nr_batches = -(-n // batch_size)
-        opt_state = self.optimizer.init(self.params)
         history = []
         for epoch in range(epochs):
             total = 0.0
             for b in range(nr_batches):
                 sl = slice(b * batch_size, min((b + 1) * batch_size, n))
-                key = jax.random.fold_in(self.dropout_key, epoch * nr_batches + b)
-                self.params, opt_state, loss = self._step(
-                    self.params, opt_state, x[sl], y[sl], key
+                # persistent opt state + advancing key: a second call resumes
+                # training instead of resetting Adam moments / dropout masks
+                key, self.dropout_key = jax.random.split(self.dropout_key)
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, x[sl], y[sl], key
                 )
                 total += float(loss)
             history.append(total / nr_batches)

@@ -63,7 +63,7 @@ class ServerVAE(nn.Module):
 
     def __call__(self, x, *, train: bool, key=None):
         mu, logvar = self.encoder(x, train=train)
-        z = reparameterize(key, mu, logvar, train) if train else mu
+        z = reparameterize(key, mu, logvar, train)
         recon = self.decoder(z, train=train)
         return recon, mu, logvar
 

@@ -42,3 +42,36 @@ def test_accuracy_percent():
     scores = jnp.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4], [0.3, 0.7]])
     labels = jnp.array([0, 1, 1, 1])
     assert jnp.allclose(accuracy(scores, labels), 75.0)
+
+
+def test_resnet18_shapes_and_param_count():
+    from ddl25spring_tpu.models import ResNet18
+
+    model = ResNet18()
+    x = jnp.zeros((2, 32, 32, 3))
+    params = model.init(jax.random.key(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (2, 10)
+    assert jnp.allclose(jnp.exp(out).sum(-1), 1.0, atol=1e-4)
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    # torchvision resnet18 has 11.69M params (ImageNet stem/head); the CIFAR
+    # 3x3-stem GroupNorm variant lands close to 11.2M
+    assert 10_000_000 < n_params < 12_500_000
+
+
+def test_resnet18_trains_one_step():
+    from ddl25spring_tpu.models import ResNet18
+    from ddl25spring_tpu.ops import nll_loss
+
+    model = ResNet18()
+    x = jax.random.normal(jax.random.key(1), (8, 32, 32, 3))
+    y = jnp.arange(8) % 10
+    params = model.init(jax.random.key(0), x)
+
+    def loss(p):
+        return nll_loss(model.apply(p, x, train=True,
+                                    rngs={"dropout": jax.random.key(2)}), y)
+
+    l0, grads = jax.value_and_grad(loss)(params)
+    params2 = jax.tree.map(lambda p, g: p - 0.01 * g, params, grads)
+    assert loss(params2) < l0

@@ -9,7 +9,6 @@ from ddl25spring_tpu.data import (
     CATEGORICAL,
     load_heart_classification,
     load_heart_df,
-    one_hot_encode,
 )
 from ddl25spring_tpu.gen import (
     encode_posterior,
