@@ -116,6 +116,7 @@ class VFLVAE:
         )
         self.variables = variables
         self.rng = ks[-1]
+        self.opt_state = None  # lazily created on first train() call
         self.optimizer = optax.adam(self.lr)
         self._step = self._build_step()
 
@@ -198,12 +199,15 @@ class VFLVAE:
         """Full-batch Adam, the reference schedule (exercise_3.py:191-203)."""
         x_clients = [jnp.asarray(x, jnp.float32) for x in x_clients]
         params_tree = _get_params(self.variables)
-        opt_state = self.optimizer.init(params_tree)
+        if self.opt_state is None:
+            self.opt_state = self.optimizer.init(params_tree)
         losses = []
         for epoch in range(epochs):
-            key = jax.random.fold_in(self.rng, epoch)
-            params_tree, opt_state, loss, new_stats = self._step(
-                params_tree, self.variables, opt_state, x_clients, key
+            # advancing key + persistent opt state: a second call resumes
+            # training instead of resetting Adam moments / replaying keys
+            key, self.rng = jax.random.split(self.rng)
+            params_tree, self.opt_state, loss, new_stats = self._step(
+                params_tree, self.variables, self.opt_state, x_clients, key
             )
             self.variables = self._merge_stats(
                 _set_params(self.variables, params_tree), new_stats
