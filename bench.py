@@ -1,0 +1,102 @@
+"""North-star benchmark: FedAvg rounds/sec, CIFAR-10, 256 clients, ResNet-18.
+
+The driver's BASELINE.json metric.  One FedAvg round = sample 26 of 256
+clients (C=0.1), each runs E=1 local epoch of minibatch SGD (B=50) on its
+~195-image IID shard of CIFAR-10 with ResNet-18, then the server installs the
+n_k-weighted average — all of it ONE jitted SPMD program (vmap over clients),
+vs the reference architecture's sequential per-client Python loop
+(hfl_complete.py:365-373).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": rounds/sec, "unit": "rounds/sec", "vs_baseline": x}
+
+``vs_baseline`` is the speedup over the single-process CPU architecture on
+this container's CPU (the closest stand-in for the reference's laptop-CPU
+execution; no published reference number exists, BASELINE.md).  Re-measure it
+with ``python bench.py --measure-cpu-baseline``.
+
+Usage: python bench.py [--rounds N] [--measure-cpu-baseline]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+# Single-process JAX-CPU rounds/sec of the same config on this container;
+# None until measured (run --measure-cpu-baseline and paste the value here).
+# While None, vs_baseline is emitted as null.
+CPU_BASELINE_ROUNDS_PER_SEC = None
+
+
+def build_server(seed: int = 10):
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.data import load_cifar10, split_dataset
+    from ddl25spring_tpu.fl import FedAvgServer
+    from ddl25spring_tpu.fl.task import classification_task
+    from ddl25spring_tpu.models import ResNet18
+
+    ds = load_cifar10()
+    client_data = split_dataset(
+        ds.train_x, ds.train_y, nr_clients=256, iid=True, seed=seed,
+        pad_multiple=50,
+    )
+    task = classification_task(
+        ResNet18(dtype=jnp.bfloat16), (32, 32, 3), ds.test_x, ds.test_y
+    )
+    return FedAvgServer(
+        task, lr=0.05, batch_size=50, client_data=client_data,
+        client_fraction=0.1, nr_local_epochs=1, seed=seed,
+    )
+
+
+def timed_rounds(server, nr_rounds: int) -> float:
+    """Rounds/sec over ``nr_rounds`` after a compile warmup round."""
+    import jax
+
+    params = server.round_fn(server.params, server.run_key, 0)  # warmup/compile
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for r in range(1, nr_rounds + 1):
+        params = server.round_fn(params, server.run_key, r)
+    jax.block_until_ready(params)
+    server.params = params
+    return nr_rounds / (time.perf_counter() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--measure-cpu-baseline", action="store_true")
+    args = ap.parse_args()
+
+    if args.measure_cpu_baseline:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        server = build_server()
+        rps = timed_rounds(server, max(2, min(args.rounds, 3)))
+        print(f"CPU baseline: {rps:.6f} rounds/sec "
+              f"(paste into CPU_BASELINE_ROUNDS_PER_SEC)", file=sys.stderr)
+        return
+
+    server = build_server()
+    rps = timed_rounds(server, args.rounds)
+    vs = (
+        round(rps / CPU_BASELINE_ROUNDS_PER_SEC, 2)
+        if CPU_BASELINE_ROUNDS_PER_SEC
+        else None
+    )
+    print(json.dumps({
+        "metric": "fedavg_cifar10_resnet18_256clients_rounds_per_sec",
+        "value": round(rps, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": vs,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,7 +26,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import causal_attention
+from ..ops.attention import causal_attention, ring_causal_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +39,8 @@ class LlamaConfig:
     hidden_mult: float = 8 / 3  # SwiGLU hidden = mult * dmodel, rounded
     norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.float32  # compute dtype; bfloat16 on TPU
+    attn_impl: str = "dense"   # "dense" (XLA fused) | "ring" (sequence-parallel)
+    seq_axis: str = "seq"      # mesh axis for attn_impl="ring"
 
     @property
     def head_dim(self) -> int:
@@ -97,7 +99,10 @@ class Attention(nn.Module):
         cos, sin = rope_angles(cfg.head_dim, positions)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        out = causal_attention(q, k, v)
+        if cfg.attn_impl == "ring":
+            out = ring_causal_attention(q, k, v, cfg.seq_axis)
+        else:
+            out = causal_attention(q, k, v)
         out = out.reshape(B, T, cfg.dmodel)
         return dense("wo")(out)
 
@@ -198,14 +203,16 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None):
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.dmodel,
             embedding_init=nn.initializers.normal(0.02),
             dtype=cfg.dtype, name="embed",
         )(tokens)
-        pos = _positions(tokens.shape[1])
+        # explicit positions support sequence sharding, where a device's
+        # local block starts at a nonzero global offset (parallel/sp.py)
+        pos = _positions(tokens.shape[1]) if positions is None else positions
         for i in range(cfg.nr_layers):
             x = Block(cfg, name=f"block{i}")(x, pos)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)

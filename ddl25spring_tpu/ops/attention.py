@@ -6,7 +6,7 @@ causal mask — XLA handles the fusion; a Pallas flash kernel and a ring
 has no attention code of its own (it lives inside the external ``simplellm``
 dep, SURVEY.md §2.3); long-context sequence parallelism is a capability the
 TPU rebuild adds (ring attention over a ``ppermute`` ring, see
-parallel/ring_attention.py).
+parallel/sp.py).
 """
 
 from __future__ import annotations
@@ -31,3 +31,71 @@ def causal_attention(q, k, v, *, precision=None):
     logits = jnp.where(mask[None, None, :, :], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v, precision=precision)
+
+
+def ring_causal_attention(q, k, v, axis_name: str, *, precision=None):
+    """Sequence-parallel causal attention over a ``ppermute`` ring.
+
+    Must be called inside ``shard_map`` with the sequence dimension sharded
+    over ``axis_name``: q, k, v are the LOCAL blocks (B, T/S, H, head_dim) of
+    a global length-T sequence on an S-device ring.  Each of S steps attends
+    the resident queries to the currently held KV block (blockwise softmax
+    accumulated online, flash-attention style), then rotates the KV block to
+    the next device.  Peak memory is O(T²/S²) per device instead of O(T²),
+    and the rotation rides the ICI ring — the standard Ring Attention
+    construction (Liu et al. 2023, public).
+
+    The reference has no long-context mechanism at all (SURVEY.md §5,
+    seq fixed at 256, primer/intro.py:10); this is a new TPU-native
+    capability.  Differentiable: the transpose of a ``ppermute`` ring is the
+    reverse ring, so ``jax.grad`` yields the backward ring pass.
+    """
+    S = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Tl, H, head_dim = q.shape
+    scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
+    q_pos = idx * Tl + jnp.arange(Tl)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def accumulate(acc, k_blk, v_blk, src):
+        """Fold one KV block into the online-softmax state (o, m, l)."""
+        o, m, l = acc
+        k_pos = src * Tl + jnp.arange(Tl)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_blk, precision=precision
+        ).astype(jnp.float32) * scale
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask[None, None, :, :], logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # rows with no unmasked key yet have m_new == -inf; pin the shift to 0
+        # there so exp(-inf - 0) = 0 instead of exp(-inf - -inf) = nan
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(logits - m_safe[..., None])
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32),
+            precision=precision,
+        )
+        return o, m_new, l
+
+    acc = (
+        jnp.zeros((B, H, Tl, head_dim), jnp.float32),
+        jnp.full((B, H, Tl), -jnp.inf, jnp.float32),  # running row max
+        jnp.zeros((B, H, Tl), jnp.float32),           # running row sum
+    )
+    # resident (diagonal) block first, then S-1 permute-then-compute steps —
+    # no collective whose result would be discarded
+    acc = accumulate(acc, k, v, idx)
+
+    def body(carry, step):
+        acc, k_blk, v_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        acc = accumulate(acc, k_blk, v_blk, (idx - step) % S)
+        return (acc, k_blk, v_blk), None
+
+    (acc, _, _), _ = jax.lax.scan(body, (acc, k, v), jnp.arange(1, S))
+    o, m, l = acc
+    out = o / l[..., None]  # every causal row attends at least to itself
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(v.dtype)

@@ -7,8 +7,12 @@ from .pp import (
     make_pp_train_step,
 )
 from .tp import llama_tp_shardings, apply_shardings
+from .sp import make_sp_forward, make_sp_train_step, sp_data_sharding
 
 __all__ = [
+    "make_sp_forward",
+    "make_sp_train_step",
+    "sp_data_sharding",
     "make_mesh",
     "replicated",
     "sharded",

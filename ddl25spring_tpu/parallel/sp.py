@@ -1,0 +1,81 @@
+"""Sequence/context parallelism (ring attention over a ``seq`` mesh axis).
+
+Long-context training the reference cannot do at all: its context is fixed at
+seq_l=256 (lab/tutorial_1b/primer/intro.py:10) and it has no sequence-scaling
+mechanism (SURVEY.md §5).  Here the sequence dimension of every activation is
+sharded over a ``seq`` mesh axis; attention runs blockwise over a ppermute
+ring (ops.attention.ring_causal_attention), so per-device attention memory is
+O(T²/S²) and KV blocks ride the ICI ring.  Everything else in the block
+(RMSNorm, SwiGLU, QKV projections) is pointwise over the sequence, so it
+needs no communication at all.
+
+Composes with data parallelism on a 2-D ``(data, seq)`` mesh: batch sharded
+over ``data``, sequence over ``seq``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.llama import Llama, LlamaConfig
+from ..ops.losses import causal_lm_loss
+
+
+def make_sp_forward(config: LlamaConfig, mesh, seq_axis: str = "seq",
+                    data_axis: str | None = None):
+    """``forward(params, tokens) -> logits`` with the sequence dimension of
+    ``tokens``/activations sharded over ``seq_axis``; params replicated.
+
+    ``tokens`` is global (B, T); T must divide by the seq-axis size.
+    """
+    sp_config = dataclasses.replace(config, attn_impl="ring", seq_axis=seq_axis)
+    model = Llama(sp_config)
+    batch = data_axis  # None -> replicated batch
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(batch, seq_axis)),
+        out_specs=P(batch, seq_axis),
+        check_vma=False,
+    )
+    def forward(params, tokens):
+        Tl = tokens.shape[1]
+        offset = jax.lax.axis_index(seq_axis) * Tl
+        return model.apply(params, tokens, positions=offset + jnp.arange(Tl))
+
+    return forward
+
+
+def make_sp_train_step(config: LlamaConfig, mesh, optimizer,
+                       seq_axis: str = "seq", data_axis: str | None = None):
+    """Jitted ``step(params, opt_state, tokens) -> (params, opt_state, loss)``
+    training over sequence-sharded activations (optionally batch-sharded too:
+    hybrid DP x SP).  The causal next-token shift in the loss crosses shard
+    boundaries; it runs on the global logits so GSPMD inserts the halo
+    exchange."""
+    forward = make_sp_forward(config, mesh, seq_axis, data_axis)
+
+    def loss_fn(params, tokens):
+        return causal_lm_loss(forward(params, tokens), tokens)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def sp_data_sharding(mesh, seq_axis: str = "seq",
+                     data_axis: str | None = None) -> NamedSharding:
+    """Sharding for the (B, T) token batch consumed by the SP step."""
+    return NamedSharding(mesh, P(data_axis, seq_axis))

@@ -1,0 +1,107 @@
+"""Sequence parallelism (ring attention) oracles.
+
+Core test idea (SURVEY.md §4 seeded-equivalence strategy): the ring-attention
+SP program over S devices must match the plain single-device dense-attention
+program on the same global batch — forward logits, loss, and one full
+training step.
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ddl25spring_tpu.models import Llama, LlamaConfig
+from ddl25spring_tpu.ops import causal_lm_loss
+from ddl25spring_tpu.ops.attention import causal_attention, ring_causal_attention
+from ddl25spring_tpu.parallel import (
+    make_mesh,
+    make_sp_forward,
+    make_sp_train_step,
+    sp_data_sharding,
+)
+
+CFG = LlamaConfig(vocab_size=64, dmodel=32, nr_heads=2, nr_layers=2,
+                  ctx_size=32)
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    return jax.random.randint(jax.random.key(0), (4, CFG.ctx_size), 0,
+                              CFG.vocab_size)
+
+
+def test_ring_attention_matches_dense():
+    mesh = make_mesh({"seq": 8})
+    B, T, H, D = 2, 32, 2, 16
+    ks = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, H, D))
+    v = jax.random.normal(ks[2], (B, T, H, D))
+
+    ring = partial(
+        shard_map, mesh=mesh, in_specs=P(None, "seq"),
+        out_specs=P(None, "seq"), check_vma=False,
+    )(lambda q, k, v: ring_causal_attention(q, k, v, "seq"))
+    out_ring = ring(q, k, v)
+    out_dense = causal_attention(q, k, v)
+    assert jnp.allclose(out_ring, out_dense, atol=1e-5)
+
+
+def test_ring_attention_grads_match_dense():
+    mesh = make_mesh({"seq": 4})
+    B, T, H, D = 1, 16, 2, 8
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, H, D))
+    v = jax.random.normal(ks[2], (B, T, H, D))
+
+    ring = partial(
+        shard_map, mesh=mesh, in_specs=P(None, "seq"),
+        out_specs=P(None, "seq"), check_vma=False,
+    )(lambda q, k, v: ring_causal_attention(q, k, v, "seq"))
+
+    g_ring = jax.grad(lambda q, k, v: jnp.sum(ring(q, k, v) ** 2), (0, 1, 2))(
+        q, k, v
+    )
+    g_dense = jax.grad(
+        lambda q, k, v: jnp.sum(causal_attention(q, k, v) ** 2), (0, 1, 2)
+    )(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        assert jnp.allclose(gr, gd, atol=1e-4)
+
+
+def test_sp_forward_matches_single_device(tokens):
+    mesh = make_mesh({"seq": 8})
+    model = Llama(CFG)
+    params = model.init(jax.random.key(3), tokens)
+    logits_ref = model.apply(params, tokens)
+    logits_sp = make_sp_forward(CFG, mesh)(params, tokens)
+    assert jnp.allclose(logits_sp, logits_ref, atol=1e-4)
+
+
+def test_sp_train_step_matches_single_device(tokens):
+    mesh = make_mesh({"data": 2, "seq": 4})
+    model = Llama(CFG)
+    params = model.init(jax.random.key(4), tokens)
+    opt = optax.sgd(0.1)
+
+    # single-device oracle
+    def loss_ref(p, t):
+        return causal_lm_loss(model.apply(p, t), t)
+
+    l_ref, g_ref = jax.value_and_grad(loss_ref)(params, tokens)
+    p_ref = optax.apply_updates(params, opt.update(g_ref, opt.init(params))[0])
+
+    step = make_sp_train_step(CFG, mesh, opt, data_axis="data")
+    sharded_tokens = jax.device_put(tokens, sp_data_sharding(mesh, data_axis="data"))
+    p_sp, _, l_sp = step(params, opt.init(params), sharded_tokens)
+
+    assert jnp.allclose(l_sp, l_ref, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_sp), jax.tree.leaves(p_ref)):
+        assert jnp.allclose(a, b, atol=1e-4)
