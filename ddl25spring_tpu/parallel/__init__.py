@@ -8,8 +8,11 @@ from .pp import (
 )
 from .tp import llama_tp_shardings, apply_shardings
 from .sp import make_sp_forward, make_sp_train_step, sp_data_sharding
+from .pp_1f1b import make_1f1b_grad_fn, make_1f1b_train_step
 
 __all__ = [
+    "make_1f1b_grad_fn",
+    "make_1f1b_train_step",
     "make_sp_forward",
     "make_sp_train_step",
     "sp_data_sharding",

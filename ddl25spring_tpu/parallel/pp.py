@@ -48,6 +48,26 @@ from ..ops.losses import causal_lm_loss
 from ..utils.trees import tree_stack
 
 
+def stage_apply(config: LlamaConfig, stage_blocks, h):
+    """Run one pipeline stage: its (L, ...) stacked block params over hidden
+    states ``h`` (mb, T, D).  Shared by the GPipe and 1F1B schedules."""
+    block = Block(config)
+    pos = jnp.arange(h.shape[1])
+    L = jax.tree.leaves(stage_blocks)[0].shape[0]
+    for i in range(L):
+        lp = jax.tree.map(lambda x: x[i], stage_blocks)
+        h = block.apply({"params": lp}, h, pos)
+    return h
+
+
+def head_loss(config: LlamaConfig, norm_params, head_kernel, h, tokens):
+    """Final norm + LM head + causal loss — the model tail after the last
+    pipeline stage.  Shared by the GPipe and 1F1B schedules."""
+    hn = RMSNorm(config.norm_eps).apply({"params": norm_params}, h)
+    logits = (hn @ head_kernel.astype(config.dtype)).astype(jnp.float32)
+    return causal_lm_loss(logits, tokens)
+
+
 def pp_params_from_full(params, config: LlamaConfig, nr_stages: int):
     """Re-key full ``Llama`` params into the pipeline layout:
     {embed, stacked_blocks (S, L, ...), final_norm, lm_head}."""
@@ -94,17 +114,6 @@ def make_pp_loss_fn(
     (times the data-axis size when ``data_axis`` is set)."""
     S = nr_stages
     M = nr_microbatches
-    block = Block(config)
-
-    def stage_apply(stage_blocks, h):
-        # stage_blocks: (L, ...) params of this stage's blocks
-        pos = jnp.arange(h.shape[1])
-        L = jax.tree.leaves(stage_blocks)[0].shape[0]
-        for i in range(L):
-            lp = jax.tree.map(lambda x: x[i], stage_blocks)
-            h = block.apply({"params": lp}, h, pos)
-        return h
-
     batch_spec = P(None, data_axis) if data_axis else P()
     perm = [(i, (i + 1) % S) for i in range(S)]
 
@@ -125,7 +134,7 @@ def make_pp_loss_fn(
         for t in range(M + S - 1):
             feed = microbatches[t] if t < M else jnp.zeros(mb_shape, microbatches.dtype)
             inp = jnp.where(sid == 0, feed, recv)
-            h = stage_apply(my_blocks, inp)
+            h = stage_apply(config, my_blocks, inp)
             recv = jax.lax.ppermute(h, stage_axis, perm)
             # after the cyclic rotation, stage 0's recv is the LAST stage's
             # output: collect finished microbatches there
@@ -146,11 +155,10 @@ def make_pp_loss_fn(
         micro = x.reshape(M, B // M, T, config.dmodel)
         hidden = pipeline(pp_params["stacked_blocks"], micro)
         h = hidden.reshape(B, T, config.dmodel)
-        h = RMSNorm(config.norm_eps).apply({"params": pp_params["final_norm"]}, h)
-        logits = (h @ pp_params["lm_head"]["kernel"].astype(config.dtype)).astype(
-            jnp.float32
+        return head_loss(
+            config, pp_params["final_norm"], pp_params["lm_head"]["kernel"],
+            h, tokens,
         )
-        return causal_lm_loss(logits, tokens)
 
     return loss
 

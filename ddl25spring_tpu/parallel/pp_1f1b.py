@@ -1,0 +1,241 @@
+"""True 1F1B pipeline schedule as one SPMD program.
+
+The reference *attempted* interleaved 1F1B with per-rank blocking send/recv
+(lab/tutorial_1b/PP/1F1B/intro_PP_1F1B_MP.py:87-144) and reports that it
+deadlocks (lab/homework-1.ipynb cell 48; empty out_MP1/3/4.txt logs).  The
+deadlock class cannot exist here: every stage runs the SAME jitted program in
+lockstep, and all communication is a pair of ``ppermute`` rings (activations
+rotate down, gradients rotate up) — there is no send without its matching
+recv by construction.
+
+Schedule (classic non-interleaved 1F1B, expressed as lockstep ticks):
+
+- forward of microbatch ``f`` runs on stage ``s`` at tick ``f + s``;
+- backward of microbatch ``b`` runs on stage ``s`` at tick
+  ``b + 2(S-1) - s`` (the last stage backpropagates a microbatch in the same
+  tick as its forward);
+- total ticks: ``M + 2S - 2``.
+
+Why bother, when autodiff of the GPipe loop (parallel/pp.py) already yields a
+correct backward?  Memory: GPipe-via-autodiff stores activations for all M
+microbatches; 1F1B keeps at most ``2(S-1-s)+1`` microbatches in flight on
+stage ``s`` (bounded by the pipeline depth, independent of M), and the
+backward **recomputes** the stage forward from the saved stage *input*
+(jax.vjp at use time — rematerialisation, the standard TPU trade of FLOPs
+for HBM).  Steady-state cost per tick is one forward + one recomputed
+forward-backward, exactly a grad-accumulation step with remat.
+
+Gradients across microbatches accumulate in-place, matching the reference's
+microbatch semantics (loss scaled by 1/M, intro_PP_1F1B_MB.py:99).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models.llama import LlamaConfig
+from .pp import head_loss, stage_apply
+
+
+def make_1f1b_grad_fn(
+    config: LlamaConfig,
+    mesh,
+    nr_stages: int,
+    nr_microbatches: int,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+):
+    """Build ``grads_and_loss(pp_params, tokens) -> (grads, loss)`` running
+    the 1F1B schedule.  ``pp_params`` uses the pipeline layout of
+    ``pp.pp_params_from_full``; ``tokens`` is (B, T), B divisible by
+    ``nr_microbatches`` (times the data-axis size when set)."""
+    S = nr_stages
+    M = nr_microbatches
+    D = config.dmodel
+    buf_size = 2 * S  # in-flight bound: 2(S-1-s)+1 <= 2S-1 < buf_size
+
+    def stage_fwd(stage_blocks, h):
+        return stage_apply(config, stage_blocks, h)
+
+    def last_stage_loss(stage_blocks, norm_p, head_kernel, h_in, tok):
+        """Stage forward + model tail — the last stage's tick program."""
+        return head_loss(
+            config, norm_p, head_kernel, stage_fwd(stage_blocks, h_in), tok
+        )
+
+    batch_spec = P(None, data_axis) if data_axis else P()
+    down = [(i, (i + 1) % S) for i in range(S)]   # activations: s -> s+1
+    up = [(i, (i - 1) % S) for i in range(S)]     # gradients:  s -> s-1
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            {"embed": P(), "stacked_blocks": P(stage_axis),
+             "final_norm": P(), "lm_head": P()},
+            batch_spec,
+        ),
+        out_specs=(
+            {"embed": P(), "stacked_blocks": P(stage_axis),
+             "final_norm": P(), "lm_head": P()},
+            P(),
+        ),
+        check_vma=False,
+    )
+    def grads_and_loss(pp_params, micro_tokens):
+        # micro_tokens: (M, mb, T) local shard
+        my_blocks = jax.tree.map(lambda x: x[0], pp_params["stacked_blocks"])
+        emb = pp_params["embed"]["embedding"]
+        norm_p = pp_params["final_norm"]
+        head_k = pp_params["lm_head"]["kernel"]
+        sid = jax.lax.axis_index(stage_axis)
+        mb, T = micro_tokens.shape[1:]
+
+        zero_g = jax.tree.map(jnp.zeros_like, my_blocks)
+        zero_fn = jax.tree.map(jnp.zeros_like, norm_p)
+
+        def mid_pullback(x_saved, g_recv):
+            _, vjp = jax.vjp(stage_fwd, my_blocks, x_saved)
+            gb, gx = vjp(g_recv)
+            return gb, zero_fn, jnp.zeros_like(head_k), gx, jnp.float32(0)
+
+        def last_pullback(x_saved, tok):
+            loss, vjp = jax.vjp(
+                last_stage_loss, my_blocks, norm_p, head_k, x_saved, tok
+            )
+            gb, gfn, gh, gx, _ = vjp(jnp.float32(1))
+            return gb, gfn, gh, gx, loss
+
+        init = dict(
+            in_buf=jnp.zeros((buf_size, mb, T, D), config.dtype),
+            fwd_recv=jnp.zeros((mb, T, D), config.dtype),
+            bwd_recv=jnp.zeros((mb, T, D), config.dtype),
+            g_blocks=zero_g,
+            g_embed=jnp.zeros_like(emb),
+            g_norm=zero_fn,
+            g_head=jnp.zeros_like(head_k),
+            loss_sum=jnp.float32(0),
+        )
+
+        def tick(state, t):
+            # ---- forward slot: microbatch f = t - sid ----
+            f = t - sid
+            valid_f = (f >= 0) & (f < M)
+            f_c = jnp.clip(f, 0, M - 1)
+            tok_f = micro_tokens[f_c]
+            emb_f = jnp.take(emb, tok_f, axis=0).astype(config.dtype)
+            inp = jnp.where(sid == 0, emb_f, state["fwd_recv"])
+            h_out = stage_fwd(my_blocks, inp)
+            in_buf = jax.lax.dynamic_update_index_in_dim(
+                state["in_buf"],
+                jnp.where(valid_f, inp,
+                          jax.lax.dynamic_index_in_dim(
+                              state["in_buf"], f_c % buf_size, keepdims=False)),
+                f_c % buf_size, axis=0,
+            )
+
+            # ---- backward slot: microbatch b = t - 2(S-1) + sid ----
+            b = t - 2 * (S - 1) + sid
+            valid_b = (b >= 0) & (b < M)
+            b_c = jnp.clip(b, 0, M - 1)
+            x_saved = jax.lax.dynamic_index_in_dim(
+                in_buf, b_c % buf_size, keepdims=False
+            )
+            tok_b = micro_tokens[b_c]
+            gb, gfn, gh, gx, loss = jax.lax.cond(
+                sid == S - 1,
+                lambda: last_pullback(x_saved, tok_b),
+                lambda: mid_pullback(x_saved, state["bwd_recv"]),
+            )
+
+            msk = valid_b.astype(jnp.float32)
+            g_blocks = jax.tree.map(
+                lambda a, g: a + msk * g, state["g_blocks"], gb
+            )
+            g_norm = jax.tree.map(lambda a, g: a + msk * g, state["g_norm"], gfn)
+            g_head = state["g_head"] + msk * gh
+            # stage 0's gx is d(embedding rows); mask the small gx, then
+            # scatter-add by token id
+            msk0 = jnp.where(valid_b & (sid == 0), 1.0, 0.0)
+            g_embed = state["g_embed"].at[tok_b.reshape(-1)].add(
+                (msk0 * gx).reshape(-1, D).astype(emb.dtype)
+            )
+            loss_sum = state["loss_sum"] + msk * loss
+
+            # ---- rotate: activations down, gradients up ----
+            fwd_recv = jax.lax.ppermute(
+                jnp.where(valid_f, h_out, jnp.zeros_like(h_out)),
+                stage_axis, down,
+            )
+            bwd_recv = jax.lax.ppermute(
+                jnp.where(valid_b, gx, jnp.zeros_like(gx)), stage_axis, up
+            )
+            return dict(
+                in_buf=in_buf, fwd_recv=fwd_recv, bwd_recv=bwd_recv,
+                g_blocks=g_blocks, g_embed=g_embed, g_norm=g_norm,
+                g_head=g_head, loss_sum=loss_sum,
+            ), None
+
+        nr_ticks = M + 2 * S - 2
+        state, _ = jax.lax.scan(tick, init, jnp.arange(nr_ticks))
+
+        inv_m = 1.0 / M
+        grads = {
+            # only the owning stage accumulated these; psum replicates
+            "embed": {"embedding": jax.lax.psum(
+                state["g_embed"] * inv_m, stage_axis)},
+            "stacked_blocks": jax.tree.map(
+                lambda g: (g * inv_m)[None], state["g_blocks"]
+            ),
+            "final_norm": jax.tree.map(
+                lambda g: jax.lax.psum(g * inv_m, stage_axis), state["g_norm"]
+            ),
+            "lm_head": {"kernel": jax.lax.psum(
+                state["g_head"] * inv_m, stage_axis)},
+        }
+        if data_axis is not None:
+            grads = jax.lax.pmean(grads, data_axis)
+        loss = jax.lax.psum(state["loss_sum"] * inv_m, stage_axis)
+        if data_axis is not None:
+            loss = jax.lax.pmean(loss, data_axis)
+        return grads, loss
+
+    def wrapped(pp_params, tokens):
+        B, T = tokens.shape
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        micro = tokens.reshape(M, B // M, T)
+        return grads_and_loss(pp_params, micro)
+
+    return wrapped
+
+
+def make_1f1b_train_step(
+    config: LlamaConfig,
+    mesh,
+    optimizer,
+    nr_stages: int,
+    nr_microbatches: int,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+):
+    """Jitted ``step(pp_params, opt_state, tokens)`` using the 1F1B schedule
+    (drop-in for ``pp.make_pp_train_step``, hybrid DP x PP included)."""
+    grad_fn = make_1f1b_grad_fn(
+        config, mesh, nr_stages, nr_microbatches, stage_axis, data_axis
+    )
+
+    @jax.jit
+    def step(pp_params, opt_state, tokens):
+        grads, loss = grad_fn(pp_params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, pp_params)
+        pp_params = optax.apply_updates(pp_params, updates)
+        return pp_params, opt_state, loss
+
+    return step

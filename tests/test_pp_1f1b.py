@@ -1,0 +1,95 @@
+"""1F1B schedule oracles.
+
+The 1F1B grads must equal (a) the single-device full-model grads under the
+same 1/M microbatch loss scaling and (b) the GPipe pipeline's grads — the
+seeded-equivalence strategy of SURVEY.md §4 applied to the schedule the
+reference never got working (lab/homework-1.ipynb cell 48)."""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from ddl25spring_tpu.models import Llama, LlamaConfig
+from ddl25spring_tpu.ops import causal_lm_loss
+from ddl25spring_tpu.parallel import (
+    make_1f1b_grad_fn,
+    make_1f1b_train_step,
+    make_mesh,
+    make_pp_loss_fn,
+    pp_params_from_full,
+)
+
+CFG = LlamaConfig(vocab_size=64, dmodel=32, nr_heads=2, nr_layers=4,
+                  ctx_size=16)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = Llama(CFG)
+    tokens = jax.random.randint(jax.random.key(0), (8, CFG.ctx_size), 0,
+                                CFG.vocab_size)
+    params = model.init(jax.random.key(1), tokens)
+    return model, params, tokens
+
+
+def _flat_grads(tree):
+    return jax.tree.leaves(tree)
+
+
+def test_1f1b_matches_single_device(setup):
+    model, params, tokens = setup
+    mesh = make_mesh({"stage": 4})
+    pp_params = pp_params_from_full(params, CFG, 4)
+    grad_fn = make_1f1b_grad_fn(CFG, mesh, nr_stages=4, nr_microbatches=4)
+    grads, loss = grad_fn(pp_params, tokens)
+
+    # oracle: full model, mean over the same 4 microbatches
+    def ref_loss(p):
+        micro = tokens.reshape(4, 2, CFG.ctx_size)
+        losses = jax.vmap(
+            lambda t: causal_lm_loss(model.apply(p, t), t)
+        )(micro)
+        return jnp.mean(losses)
+
+    l_ref, g_ref = jax.value_and_grad(ref_loss)(params)
+    g_ref_pp = pp_params_from_full(
+        jax.tree.map(lambda x: x, {"params": g_ref["params"]}), CFG, 4
+    )
+    assert jnp.allclose(loss, l_ref, atol=1e-5)
+    for a, b in zip(_flat_grads(grads), _flat_grads(g_ref_pp)):
+        assert jnp.allclose(a, b, atol=2e-4), (a.shape, jnp.abs(a - b).max())
+
+
+def test_1f1b_matches_gpipe(setup):
+    model, params, tokens = setup
+    mesh = make_mesh({"stage": 4})
+    pp_params = pp_params_from_full(params, CFG, 4)
+
+    g_1f1b, l_1f1b = make_1f1b_grad_fn(
+        CFG, mesh, nr_stages=4, nr_microbatches=4
+    )(pp_params, tokens)
+
+    gpipe_loss = make_pp_loss_fn(CFG, mesh, nr_stages=4, nr_microbatches=4)
+    l_gp, g_gp = jax.value_and_grad(gpipe_loss)(pp_params, tokens)
+
+    assert jnp.allclose(l_1f1b, l_gp, atol=1e-5)
+    for a, b in zip(_flat_grads(g_1f1b), _flat_grads(g_gp)):
+        assert jnp.allclose(a, b, atol=2e-4)
+
+
+def test_1f1b_hybrid_dp_pp_trains(setup):
+    model, params, tokens = setup
+    mesh = make_mesh({"data": 2, "stage": 4})
+    pp_params = pp_params_from_full(params, CFG, 4)
+    opt = optax.sgd(0.1)
+    step = make_1f1b_train_step(
+        CFG, mesh, opt, nr_stages=4, nr_microbatches=2, data_axis="data"
+    )
+    state = opt.init(pp_params)
+    losses = []
+    p = pp_params
+    for i in range(3):
+        p, state, loss = step(p, state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
