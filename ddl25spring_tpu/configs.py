@@ -1,0 +1,76 @@
+"""Experiment configuration dataclasses + CLI plumbing.
+
+The reference configures experiments with module-level constants and
+positional argv (rank = argv[1], world size hardcoded; intro_DP_GA.py:11-22)
+or notebook cells (homework-1.ipynb cell 6).  Here every experiment is a
+typed config dataclass with the reference's canonical defaults
+(N=100, lr=0.01, C=0.1, E=1, B=100, rounds=10, IID, seed=10 —
+lab/homework-1.ipynb cells 5-6), constructible from the command line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class HflConfig:
+    """Horizontal-FL experiment (tutorial_1a / homework-1 family)."""
+
+    algorithm: str = "fedavg"  # centralized | fedsgd | fedsgd-weight | fedavg
+    dataset: str = "mnist"     # mnist | cifar10
+    nr_clients: int = 100      # N
+    client_fraction: float = 0.1  # C
+    nr_local_epochs: int = 1   # E
+    batch_size: int = 100      # B
+    lr: float = 0.01
+    iid: bool = True
+    seed: int = 10
+    nr_rounds: int = 10
+    # robust aggregation (the missing course part 3; SURVEY.md §2.2)
+    aggregator: str = "mean"   # mean | krum | multi-krum | trimmed-mean | median
+    attack: str = "none"       # none | label-flip | gaussian
+    nr_malicious: int = 0
+    # harness
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0  # rounds; 0 = off
+    metrics_path: str | None = None
+
+
+@dataclass(frozen=True)
+class LmConfig:
+    """LLM-parallelism experiment (tutorial_1b family)."""
+
+    strategy: str = "dp"       # single | dp | dp-weight | pp | 1f1b | dp-pp | tp | sp
+    nr_devices: int = 0        # 0 = all
+    batch_size: int = 6
+    seq_l: int = 256           # primer/intro.py:10
+    dmodel: int = 288          # primer/intro.py:8
+    nr_heads: int = 6
+    nr_layers: int = 6
+    lr: float = 8e-4           # primer/intro.py: Adam lr
+    nr_iters: int = 100
+    nr_microbatches: int = 3   # intro_PP_1F1B_MB.py microbatch count
+    seed: int = 0
+
+
+def _add_dataclass_args(parser: argparse.ArgumentParser, cls) -> None:
+    for f in dataclasses.fields(cls):
+        name = "--" + f.name.replace("_", "-")
+        if f.type in ("bool", bool):
+            parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                default=f.default)
+        elif f.default is None or "None" in str(f.type):
+            parser.add_argument(name, default=f.default)
+        else:
+            parser.add_argument(name, type=type(f.default), default=f.default)
+
+
+def parse_config(cls, argv=None):
+    """Build a ``cls`` instance from command-line flags (one flag per field)."""
+    parser = argparse.ArgumentParser()
+    _add_dataclass_args(parser, cls)
+    ns = parser.parse_args(argv)
+    return cls(**{f.name: getattr(ns, f.name) for f in dataclasses.fields(cls)})

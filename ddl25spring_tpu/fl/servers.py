@@ -68,15 +68,18 @@ class CentralizedServer(Server):
             lambda params, key: update(params, self._x, self._y, self._count, key)
         )
 
-    def run(self, nr_rounds: int) -> RunResult:
+    def run(self, nr_rounds: int, start_round: int = 0,
+            on_round=None) -> RunResult:
         result = RunResult("Centralized", 1, 1, self.batch_size, 1, self.lr, self.seed)
         elapsed = 0.0
-        for r in range(nr_rounds):
+        for r in range(start_round, start_round + nr_rounds):
             t0 = perf_counter()
             epoch_key = jax.random.fold_in(self.run_key, r)
             self.params = jax.block_until_ready(self._epoch(self.params, epoch_key))
             elapsed += perf_counter() - t0
             result.record_round(elapsed, 0, self.test())
+            if on_round is not None:
+                on_round(r, result)
         return result
 
 
@@ -92,13 +95,19 @@ class DecentralizedServer(Server):
         self.algorithm = "Decentralized"
         self.nr_local_epochs = 1
 
-    def run(self, nr_rounds: int) -> RunResult:
+    def run(self, nr_rounds: int, start_round: int = 0,
+            on_round=None) -> RunResult:
+        """Run rounds ``start_round .. start_round + nr_rounds - 1``.  Round
+        keys and message counts derive from the GLOBAL round index, so a
+        resumed run (``start_round > 0``) continues the exact key/accounting
+        sequence of an uninterrupted one.  ``on_round(global_round, result)``
+        fires after each round (streaming metrics / periodic checkpoints)."""
         result = RunResult(
             self.algorithm, self.nr_clients, self.client_fraction,
             self.batch_size, self.nr_local_epochs, self.lr, self.seed,
         )
         elapsed = 0.0
-        for r in range(nr_rounds):
+        for r in range(start_round, start_round + nr_rounds):
             t0 = perf_counter()
             self.params = jax.block_until_ready(
                 self.round_fn(self.params, self.run_key, r)
@@ -107,6 +116,8 @@ class DecentralizedServer(Server):
             result.record_round(
                 elapsed, 2 * (r + 1) * self.nr_clients_per_round, self.test()
             )
+            if on_round is not None:
+                on_round(r, result)
         return result
 
 

@@ -1,0 +1,139 @@
+"""CLI runner for horizontal-FL experiments.
+
+    python -m ddl25spring_tpu.run_hfl --algorithm fedavg --nr-clients 10 \
+        --client-fraction 0.1 --nr-rounds 10
+
+reproduces the homework-1 experiment grid (lab/homework-1.ipynb cell 22) and
+prints the RunResult table; Byzantine attack/defense configs (the missing
+course part 3, SURVEY.md §2.2) plug in via --aggregator/--attack flags.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .configs import HflConfig, parse_config
+from .data import load_cifar10, load_mnist, split_dataset
+from .fl import (
+    CentralizedServer,
+    FedAvgServer,
+    FedSgdGradientServer,
+    FedSgdWeightServer,
+)
+from .fl.task import classification_task
+from .models import MnistCnn, ResNet18
+from .robust import (
+    coordinate_median,
+    flip_labels,
+    make_gaussian_attack,
+    make_krum,
+    make_trimmed_mean,
+)
+from .utils import Checkpointer, MetricsLogger
+
+
+def build_aggregator(cfg: HflConfig):
+    sampled = max(1, round(cfg.client_fraction * cfg.nr_clients))
+    if cfg.aggregator == "mean":
+        return None
+    if cfg.aggregator == "median":
+        return coordinate_median
+    if cfg.aggregator == "trimmed-mean":
+        return make_trimmed_mean(min(0.45, max(1, cfg.nr_malicious) / sampled))
+    if cfg.aggregator == "krum":
+        return make_krum(cfg.nr_malicious, 1)
+    if cfg.aggregator == "multi-krum":
+        return make_krum(cfg.nr_malicious,
+                         max(1, sampled - 2 * cfg.nr_malicious))
+    raise ValueError(f"unknown aggregator {cfg.aggregator!r}")
+
+
+def build_server(cfg: HflConfig):
+    if cfg.dataset == "mnist":
+        ds = load_mnist()
+        task = classification_task(MnistCnn(), (28, 28, 1), ds.test_x, ds.test_y)
+    elif cfg.dataset == "cifar10":
+        ds = load_cifar10()
+        task = classification_task(ResNet18(), (32, 32, 3), ds.test_x, ds.test_y)
+    else:
+        raise ValueError(f"unknown dataset {cfg.dataset!r}")
+
+    if cfg.algorithm == "centralized":
+        return CentralizedServer(task, cfg.lr, cfg.batch_size, cfg.seed,
+                                 train_x=ds.train_x, train_y=ds.train_y)
+
+    pad = cfg.batch_size if cfg.algorithm == "fedavg" else 1
+    client_data = split_dataset(ds.train_x, ds.train_y, cfg.nr_clients,
+                                cfg.iid, cfg.seed, pad_multiple=pad)
+
+    malicious = np.zeros(cfg.nr_clients, dtype=bool)
+    if cfg.nr_malicious:
+        malicious[np.random.default_rng(cfg.seed).choice(
+            cfg.nr_clients, cfg.nr_malicious, replace=False)] = True
+
+    attack = None
+    if cfg.attack == "gaussian":
+        attack = make_gaussian_attack()
+    elif cfg.attack == "label-flip":
+        client_data = flip_labels(client_data, malicious, nr_classes=10)
+    elif cfg.attack != "none":
+        raise ValueError(f"unknown attack {cfg.attack!r}")
+
+    kw = dict(aggregator=build_aggregator(cfg), attack=attack,
+              malicious_mask=malicious if attack is not None else None)
+    if cfg.algorithm == "fedsgd":
+        return FedSgdGradientServer(task, cfg.lr, client_data,
+                                    cfg.client_fraction, cfg.seed, **kw)
+    if cfg.algorithm == "fedsgd-weight":
+        return FedSgdWeightServer(task, cfg.lr, client_data,
+                                  cfg.client_fraction, cfg.seed, **kw)
+    if cfg.algorithm == "fedavg":
+        return FedAvgServer(task, cfg.lr, cfg.batch_size, client_data,
+                            cfg.client_fraction, cfg.nr_local_epochs,
+                            cfg.seed, **kw)
+    raise ValueError(f"unknown algorithm {cfg.algorithm!r}")
+
+
+def run(cfg: HflConfig):
+    server = build_server(cfg)
+    logger = MetricsLogger(cfg.metrics_path) if cfg.metrics_path else None
+    ckpt = (Checkpointer(cfg.checkpoint_dir)
+            if cfg.checkpoint_dir and cfg.checkpoint_every else None)
+
+    start_round = 0
+    if ckpt is not None and ckpt.latest_step() is not None:
+        restored = ckpt.restore({"params": server.params, "round": 0})
+        server.params = restored["params"]
+        start_round = int(restored["round"])
+
+    def on_round(r, result):
+        # stream metrics and checkpoint as rounds complete, so a crashed run
+        # resumes from the last saved round instead of restarting at zero
+        if logger is not None:
+            logger.log("round", idx=r + 1,
+                       wall_time=result.wall_time[-1],
+                       message_count=result.message_count[-1],
+                       test_accuracy=result.test_accuracy[-1])
+        if ckpt is not None and (r + 1) % cfg.checkpoint_every == 0:
+            ckpt.save(r + 1, {"params": server.params, "round": r + 1})
+
+    nr_remaining = max(0, cfg.nr_rounds - start_round)
+    result = server.run(nr_remaining, start_round=start_round,
+                        on_round=on_round)
+
+    if logger is not None:
+        logger.close()
+    if ckpt is not None:
+        ckpt.close()
+    return result
+
+
+def main(argv=None):
+    cfg = parse_config(HflConfig, argv)
+    result = run(cfg)
+    print(result.as_df().to_string(index=False))
+    return result
+
+
+if __name__ == "__main__":
+    main()

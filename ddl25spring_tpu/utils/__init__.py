@@ -13,8 +13,15 @@ from .trees import (
 )
 from .rng import client_round_key, epoch_key, seed_key
 from .metrics import RunResult
+from .checkpoint import Checkpointer
+from .logging import MetricsLogger, profile_trace, read_jsonl, timed
 
 __all__ = [
+    "Checkpointer",
+    "MetricsLogger",
+    "profile_trace",
+    "read_jsonl",
+    "timed",
     "tree_stack",
     "tree_unstack",
     "tree_weighted_mean",

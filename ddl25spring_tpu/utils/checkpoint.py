@@ -1,0 +1,70 @@
+"""Checkpoint / resume.
+
+The reference has no persistence at all: its only "checkpoint" is an
+in-memory best-weights restore (lab/tutorial_2a/centralized.py:51,67-70), and
+a crashed run restarts from zero.  Here any training pytree — params,
+optimizer state, round/step counter — is saved atomically via orbax (the
+standard JAX checkpoint layer) and restored with sharding preserved, so a
+multi-chip run resumes onto the same mesh layout.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+
+class Checkpointer:
+    """Thin orbax CheckpointManager wrapper: numbered steps, keep-N,
+    atomic writes.
+
+    ``state`` can be any pytree of arrays/scalars (e.g. ``{"params": ...,
+    "opt_state": ..., "round": r}``).  ``restore`` needs a ``template`` pytree
+    of matching structure (typically the freshly initialised state) so orbax
+    can rebuild dtypes/shardings.
+    """
+
+    def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._mngr = ocp.CheckpointManager(
+            os.path.abspath(os.fspath(directory)),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, wait: bool = True) -> None:
+        self._mngr.save(step, args=self._ocp.args.StandardSave(state))
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def restore(self, template: Any, step: int | None = None) -> Any:
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint to restore")
+        abstract = jax.tree.map(
+            lambda x: x if not hasattr(x, "shape")
+            else jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=_sharding(x)),
+            template,
+        )
+        return self._mngr.restore(
+            step, args=self._ocp.args.StandardRestore(abstract)
+        )
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def all_steps(self):
+        return self._mngr.all_steps()
+
+    def close(self):
+        self._mngr.close()
+
+
+def _sharding(x):
+    return getattr(x, "sharding", None)

@@ -1,0 +1,74 @@
+"""Structured JSONL metrics + profiler hooks.
+
+The reference logs by ``print`` to per-rank out files (run.sh:8 redirects
+stdout to out<rank>.txt) and keeps metrics in the RunResult dataclass only.
+Here every metric event is one JSON line — machine-readable, append-only,
+crash-safe — and profiling is one context manager around ``jax.profiler``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager
+from pathlib import Path
+
+
+class MetricsLogger:
+    """Append-only JSONL event log.  Each ``log`` call writes one line with a
+    wall-clock timestamp; values must be JSON-serialisable scalars."""
+
+    def __init__(self, path: str | Path, echo: bool = False):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._echo = echo
+        self._fh = self.path.open("a")
+
+    def log(self, event: str, **fields):
+        rec = {"ts": round(time.time(), 3), "event": event, **fields}
+        line = json.dumps(rec)
+        self._fh.write(line + "\n")
+        self._fh.flush()
+        if self._echo:
+            print(line)
+
+    def close(self):
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_jsonl(path: str | Path):
+    """Load a JSONL metrics file back into a list of dicts."""
+    with Path(path).open() as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+@contextmanager
+def profile_trace(log_dir: str | Path):
+    """Capture a ``jax.profiler`` trace (view with TensorBoard/XProf) around
+    the enclosed block — the TPU upgrade of the reference's hand-rolled
+    ``perf_counter`` segments (hfl_complete.py:354-385)."""
+    import jax
+
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextmanager
+def timed(logger: MetricsLogger | None, event: str, **fields):
+    """Wall-clock a block and log it as ``event`` with ``seconds``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if logger is not None:
+            logger.log(event, seconds=round(dt, 4), **fields)

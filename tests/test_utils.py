@@ -65,3 +65,72 @@ def test_run_result_schema():
     rr_inf = RunResult("FedSGDGradient", 10, 0.1, -1, 1, 0.01, 10)
     rr_inf.record_round(0.0, 2, 10.0)
     assert rr_inf.as_df()["B"].iloc[0] == "\N{INFINITY}"
+
+
+def test_checkpointer_save_restore(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ddl25spring_tpu.utils import Checkpointer
+
+    params = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.zeros(3)}
+    opt = optax.adam(1e-3)
+    state = {"params": params, "opt_state": opt.init(params), "round": 7}
+
+    ckpt = Checkpointer(tmp_path / "ckpt", max_to_keep=2)
+    ckpt.save(7, state)
+    ckpt.save(9, jax.tree.map(lambda x: x, state))
+    assert ckpt.latest_step() == 9
+
+    template = {
+        "params": jax.tree.map(jnp.zeros_like, params),
+        "opt_state": opt.init(jax.tree.map(jnp.zeros_like, params)),
+        "round": 0,
+    }
+    restored = ckpt.restore(template)
+    assert restored["round"] == 7 or restored["round"] == 9
+    assert jnp.allclose(restored["params"]["w"], params["w"])
+    # keep-N pruning: step 7 still present with max_to_keep=2
+    assert set(ckpt.all_steps()) == {7, 9}
+    ckpt.close()
+
+
+def test_metrics_logger_roundtrip(tmp_path):
+    from ddl25spring_tpu.utils import MetricsLogger, read_jsonl, timed
+
+    path = tmp_path / "m.jsonl"
+    with MetricsLogger(path) as log:
+        log.log("round", idx=1, acc=93.2)
+        with timed(log, "block", tag="x"):
+            pass
+    recs = read_jsonl(path)
+    assert recs[0]["event"] == "round" and recs[0]["acc"] == 93.2
+    assert recs[1]["event"] == "block" and "seconds" in recs[1]
+
+
+def test_hfl_cli_runs_and_checkpoints(tmp_path):
+    from ddl25spring_tpu.run_hfl import main
+
+    result = main([
+        "--algorithm", "fedavg", "--nr-clients", "100", "--client-fraction",
+        "0.02", "--nr-rounds", "2", "--batch-size", "100",
+        "--metrics-path", str(tmp_path / "m.jsonl"),
+        "--checkpoint-dir", str(tmp_path / "ck"), "--checkpoint-every", "1",
+    ])
+    assert len(result.test_accuracy) == 2
+
+    from ddl25spring_tpu.utils import read_jsonl
+
+    recs = read_jsonl(tmp_path / "m.jsonl")
+    assert len(recs) == 2 and recs[-1]["event"] == "round"
+    assert (tmp_path / "ck").exists()
+
+    # resume path: rerunning the identical command finds round 2 checkpointed
+    # and runs 0 further rounds (no silent double-training)
+    result2 = main([
+        "--algorithm", "fedavg", "--nr-clients", "100", "--client-fraction",
+        "0.02", "--nr-rounds", "2", "--batch-size", "100",
+        "--checkpoint-dir", str(tmp_path / "ck"), "--checkpoint-every", "1",
+    ])
+    assert len(result2.test_accuracy) == 0
