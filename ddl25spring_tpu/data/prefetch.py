@@ -1,0 +1,49 @@
+"""Background batch prefetching.
+
+The reference's loaders are synchronous (the training loop blocks on
+``next(iter_ds)``, intro_DP_GA.py:43).  On TPU the host should prepare batch
+N+1 while the device runs step N; ``PrefetchStream`` wraps any
+``next_batch()`` source with a bounded producer thread (the native C++ packer
+releases the GIL inside ctypes calls, so producer and consumer overlap)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class PrefetchStream:
+    """Bounded background prefetcher over any ``next_batch()`` stream."""
+
+    def __init__(self, stream, depth: int = 2):
+        self.stream = stream
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    def _producer(self):
+        while not self._stop.is_set():
+            batch = self.stream.next_batch()
+            while not self._stop.is_set():
+                try:
+                    self._q.put(batch, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def next_batch(self):
+        return self._q.get()
+
+    def __iter__(self):
+        while True:
+            yield self.next_batch()
+
+    def close(self):
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=2)

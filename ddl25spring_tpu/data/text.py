@@ -183,3 +183,26 @@ class TokenStream:
     def __iter__(self):
         while True:
             yield self.next_batch()
+
+
+def token_stream(batch_size: int, seq_l: int, skip: int = 0, seed: int = 0,
+                 stories=None, native: bool | None = None):
+    """Build the fastest available token stream (C++ packer when the native
+    lib builds, pure Python otherwise).  ``native=None`` auto-selects;
+    ``True`` forces native (raises if unavailable); ``False`` forces Python.
+    Both produce bit-identical batches (tests/test_native.py)."""
+    if stories is None:
+        stories = load_stories(seed)
+    if native is not False:
+        try:
+            from ..native import NativeTokenStream, native_available
+
+            if native or native_available():
+                # forced mode constructs directly so a build failure raises
+                # with the captured compiler diagnostic
+                return NativeTokenStream(batch_size, seq_l, stories, skip=skip)
+        except ImportError:
+            if native:
+                raise
+    return TokenStream(ByteTokenizer(), batch_size, seq_l, skip=skip,
+                       seed=seed, stories=stories)

@@ -1,0 +1,138 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Built lazily with g++ on first use and cached next to the package; every
+consumer degrades gracefully to the pure-Python implementation when no
+compiler is available (``native_available()`` reports which path is live).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).parent / "src" / "tokenstream.cpp"
+_LIB = Path(__file__).parent / "_tokenstream.so"
+_lock = threading.Lock()
+_lib = None
+_build_error: str | None = None
+
+
+def _build() -> bool:
+    global _build_error
+    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return True
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             str(_SRC), "-o", str(_LIB)],
+            check=True, capture_output=True, text=True, timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        _build_error = getattr(e, "stderr", None) or str(e)
+        return False
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not _build():
+            return None
+        lib = ctypes.CDLL(str(_LIB))
+        lib.ddl_encode.restype = ctypes.c_long
+        lib.ddl_encode.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+        ]
+        lib.ddl_stream_new.restype = ctypes.c_void_p
+        lib.ddl_stream_new.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.ddl_stream_free.argtypes = [ctypes.c_void_p]
+        lib.ddl_stream_feed.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+        ]
+        lib.ddl_stream_available.restype = ctypes.c_long
+        lib.ddl_stream_available.argtypes = [ctypes.c_void_p]
+        lib.ddl_stream_next.restype = ctypes.c_int
+        lib.ddl_stream_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ddl_stream_skip.restype = ctypes.c_long
+        lib.ddl_stream_skip.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> str | None:
+    return _build_error
+
+
+def encode(text: str, bos: bool = True, eos: bool = True) -> np.ndarray:
+    """Native byte-level encode (ByteTokenizer-equivalent ids)."""
+    lib = _load()
+    data = text.encode("utf-8")
+    out = np.empty(len(data) + 2, dtype=np.int32)
+    n = lib.ddl_encode(
+        data, len(data),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        int(bos), int(eos),
+    )
+    return out[:n]
+
+
+class NativeTokenStream:
+    """C++-backed (batch_size, seq_l) int32 block stream.
+
+    Same contract as data.text.TokenStream (BOS story EOS concatenation,
+    skip measured in whole batches); story text is pulled lazily from the
+    Python ``stories`` source and fed to the native packer.
+    """
+
+    def __init__(self, batch_size: int, seq_l: int, stories,
+                 skip: int = 0):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError(
+                f"native tokenstream unavailable: {_build_error}"
+            )
+        self.batch_size = batch_size
+        self.seq_l = seq_l
+        self.stories = stories
+        self._story_index = 0
+        self._h = ctypes.c_void_p(self._lib.ddl_stream_new(batch_size, seq_l))
+        if skip:
+            self._fill(skip + 1)
+            self._lib.ddl_stream_skip(self._h, skip)
+
+    def _fill(self, nr_batches: int = 1):
+        while self._lib.ddl_stream_available(self._h) < nr_batches:
+            text = self.stories.story(self._story_index).encode("utf-8")
+            self._story_index += 1
+            self._lib.ddl_stream_feed(self._h, text, len(text))
+
+    def next_batch(self) -> np.ndarray:
+        self._fill(1)
+        out = np.empty((self.batch_size, self.seq_l), dtype=np.int32)
+        ok = self._lib.ddl_stream_next(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        )
+        assert ok == 1
+        return out
+
+    def __iter__(self):
+        while True:
+            yield self.next_batch()
+
+    def __del__(self):
+        if getattr(self, "_h", None) and self._lib is not None:
+            self._lib.ddl_stream_free(self._h)
+            self._h = None

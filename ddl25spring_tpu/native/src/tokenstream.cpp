@@ -1,0 +1,108 @@
+// Native token-stream core: byte-level encoding + dense batch packing.
+//
+// TPU-native equivalent of the reference's C++ data substrate: the reference
+// tokenizes with sentencepiece (C++, behind simplellm's SPTokenizer swig
+// proxy — lab/Abgabe/outputs/out_MB0.txt:3 shows the swig object) and packs
+// (batch, seq_l) blocks in its TinyStories loader.  Here the hot host-side
+// loop — UTF-8 bytes -> token ids -> ring buffer -> dense int32 batches with
+// DP shard skip — is C++ behind a C ABI (ctypes-loaded, no pybind11 in this
+// image); story TEXT generation stays in Python (it is cold; the per-byte
+// encode/pack loop is the hot part).
+//
+// Contract (tested for exact equality against the pure-Python TokenStream in
+// tests/test_native.py): token ids are byte+3 with BOS=1 / EOS=2 wrapped
+// around every story, matching data/text.py ByteTokenizer.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kBos = 1;
+constexpr int32_t kEos = 2;
+constexpr int32_t kByteOffset = 3;
+
+struct Stream {
+  int batch;
+  int seql;
+  std::vector<int32_t> buf;  // flat token ring (head-compacted vector)
+  size_t head = 0;
+
+  size_t pending() const { return buf.size() - head; }
+
+  void compact() {
+    // amortized: drop consumed prefix once it dominates the vector
+    if (head > 1u << 20 && head * 2 > buf.size()) {
+      buf.erase(buf.begin(), buf.begin() + static_cast<long>(head));
+      head = 0;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Encode UTF-8 bytes into int32 token ids; returns the token count.
+// `out` must have room for n + 2 entries.
+long ddl_encode(const uint8_t* text, long n, int32_t* out, int bos, int eos) {
+  long k = 0;
+  if (bos) out[k++] = kBos;
+  for (long i = 0; i < n; ++i) out[k++] = static_cast<int32_t>(text[i]) + kByteOffset;
+  if (eos) out[k++] = kEos;
+  return k;
+}
+
+void* ddl_stream_new(int batch, int seql) {
+  auto* s = new Stream;
+  s->batch = batch;
+  s->seql = seql;
+  return s;
+}
+
+void ddl_stream_free(void* h) { delete static_cast<Stream*>(h); }
+
+// Feed one story's UTF-8 bytes (BOS/EOS wrapped, like ByteTokenizer.encode).
+void ddl_stream_feed(void* h, const uint8_t* text, long n) {
+  auto* s = static_cast<Stream*>(h);
+  s->buf.reserve(s->buf.size() + static_cast<size_t>(n) + 2);
+  s->buf.push_back(kBos);
+  for (long i = 0; i < n; ++i)
+    s->buf.push_back(static_cast<int32_t>(text[i]) + kByteOffset);
+  s->buf.push_back(kEos);
+}
+
+// Number of complete (batch, seql) blocks currently buffered.
+long ddl_stream_available(void* h) {
+  auto* s = static_cast<Stream*>(h);
+  return static_cast<long>(s->pending() / (static_cast<size_t>(s->batch) * s->seql));
+}
+
+// Pop one dense (batch, seql) int32 block into `out`; returns 1 on success,
+// 0 if not enough tokens are buffered.
+int ddl_stream_next(void* h, int32_t* out) {
+  auto* s = static_cast<Stream*>(h);
+  const size_t need = static_cast<size_t>(s->batch) * s->seql;
+  if (s->pending() < need) return 0;
+  std::memcpy(out, s->buf.data() + s->head, need * sizeof(int32_t));
+  s->head += need;
+  s->compact();
+  return 1;
+}
+
+// Drop `nr_batches` whole batches (DP shard skip, intro_DP_GA.py:29
+// semantics); returns how many were actually dropped.
+long ddl_stream_skip(void* h, long nr_batches) {
+  auto* s = static_cast<Stream*>(h);
+  const size_t need = static_cast<size_t>(s->batch) * s->seql;
+  long dropped = 0;
+  while (dropped < nr_batches && s->pending() >= need) {
+    s->head += need;
+    ++dropped;
+  }
+  s->compact();
+  return dropped;
+}
+
+}  // extern "C"
