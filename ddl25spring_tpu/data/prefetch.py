@@ -24,16 +24,26 @@ class PrefetchStream:
 
     def _producer(self):
         while not self._stop.is_set():
-            batch = self.stream.next_batch()
+            try:
+                item = ("batch", self.stream.next_batch())
+            except BaseException as e:  # noqa: BLE001 — relayed to consumer
+                item = ("error", e)
             while not self._stop.is_set():
                 try:
-                    self._q.put(batch, timeout=0.1)
+                    self._q.put(item, timeout=0.1)
                     break
                 except queue.Full:
                     continue
+            if item[0] == "error":
+                return
 
     def next_batch(self):
-        return self._q.get()
+        if self._stop.is_set():
+            raise RuntimeError("PrefetchStream is closed")
+        kind, payload = self._q.get()
+        if kind == "error":
+            raise payload
+        return payload
 
     def __iter__(self):
         while True:

@@ -101,6 +101,10 @@ class Attention(nn.Module):
         k = apply_rope(k, cos, sin)
         if cfg.attn_impl == "ring":
             out = ring_causal_attention(q, k, v, cfg.seq_axis)
+        elif cfg.attn_impl == "flash":
+            from ..ops.flash_attention import flash_causal_attention
+
+            out = flash_causal_attention(q, k, v)
         else:
             out = causal_attention(q, k, v)
         out = out.reshape(B, T, cfg.dmodel)

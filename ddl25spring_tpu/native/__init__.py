@@ -18,13 +18,17 @@ _SRC = Path(__file__).parent / "src" / "tokenstream.cpp"
 _LIB = Path(__file__).parent / "_tokenstream.so"
 _lock = threading.Lock()
 _lib = None
+_load_failed = False  # sticky: one failed build/load is not retried
 _build_error: str | None = None
 
 
 def _build() -> bool:
     global _build_error
-    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
-        return True
+    try:
+        if _LIB.exists() and _LIB.stat().st_mtime > _SRC.stat().st_mtime:
+            return True
+    except OSError:
+        pass  # e.g. source missing; fall through to (re)build attempt
     try:
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
@@ -38,13 +42,22 @@ def _build() -> bool:
 
 
 def _load():
-    global _lib
+    global _lib, _load_failed, _build_error
     with _lock:
         if _lib is not None:
             return _lib
-        if not _build():
+        if _load_failed:
             return None
-        lib = ctypes.CDLL(str(_LIB))
+        if not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+        except OSError as e:
+            # e.g. a stale/foreign binary from another platform
+            _build_error = str(e)
+            _load_failed = True
+            return None
         lib.ddl_encode.restype = ctypes.c_long
         lib.ddl_encode.argtypes = [
             ctypes.c_char_p, ctypes.c_long,
@@ -79,6 +92,8 @@ def build_error() -> str | None:
 def encode(text: str, bos: bool = True, eos: bool = True) -> np.ndarray:
     """Native byte-level encode (ByteTokenizer-equivalent ids)."""
     lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native tokenstream unavailable: {_build_error}")
     data = text.encode("utf-8")
     out = np.empty(len(data) + 2, dtype=np.int32)
     n = lib.ddl_encode(

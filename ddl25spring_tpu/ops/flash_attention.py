@@ -1,0 +1,271 @@
+"""Pallas TPU flash-attention (causal) — forward and backward kernels.
+
+The hot op of the LLM path (SURVEY.md §2.3: attention lives inside the
+reference's ``simplellm`` dependency, running whatever torch does; here it is
+a hand-tiled TPU kernel).  Standard flash-attention construction (Dao et al.,
+public): the (T, T) score matrix is never materialised — each q-block streams
+over its causal k/v-blocks in VMEM, maintaining the online-softmax running
+max/sum, and the backward recomputes block scores from the saved per-row
+logsumexp instead of storing probabilities.
+
+Complexities: O(T²) compute (halved by causal block skipping), O(T) memory.
+The XLA fallback (ops.attention.causal_attention) materialises the full
+(B, H, T, T) score tensor.
+
+Layout: kernels tile over a fused (B*H) leading axis; block shapes keep the
+lane dimension = head_dim (<=128) and sublane = the q/kv block length.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _pick_block(t: int, target: int = 128) -> int:
+    b = min(t, target)
+    while t % b:
+        b -= 1
+    return b
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
+                scale, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, d)
+    d = q.shape[-1]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    o = jnp.zeros((block_q, d), jnp.float32)
+
+    # causal: only k blocks at/below the diagonal (ceil so a partial overlap
+    # still includes the diagonal block when block_q != block_k)
+    nr_kv = -((qi + 1) * block_q // -block_k)
+
+    def body(j, carry):
+        m, l, o = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[:, None] + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l, o
+
+    m, l, o = jax.lax.fori_loop(0, nr_kv, body, (m, l, o))
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, *, block_q, block_k, interpret):
+    BH, T, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    grid = (BH, T // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale, seq_len=T
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_q, block_k, scale):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    nr_kv = -((qi + 1) * block_q // -block_k)  # ceil: include diagonal block
+    dq = jnp.zeros_like(q)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nr_kv, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, block_k, scale, seq_len):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                  # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    nr_q = seq_len // block_q
+    first_q = ki * block_k // block_q  # first q block that sees this k block
+    dk = jnp.zeros_like(k)
+    dv = jnp.zeros_like(v)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(first_q, nr_q, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, block_q, block_k, interpret):
+    BH, T, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale),
+        grid=(BH, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, seq_len=T),
+        grid=(BH, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, T, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, T, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public op (custom VJP over (B, T, H, d) layout)
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_bthd(q, k, v, interpret):
+    o, _ = _flash_core(q, k, v, interpret)
+    return o
+
+
+def _flash_core(q, k, v, interpret):
+    B, T, H, d = q.shape
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+    block_q = block_k = _pick_block(T)
+    o, lse = _flash_fwd(to_bh(q), to_bh(k), to_bh(v),
+                        block_q=block_q, block_k=block_k, interpret=interpret)
+    return o.reshape(B, H, T, d).transpose(0, 2, 1, 3), (o, lse)
+
+
+def _flash_fwd_rule(q, k, v, interpret):
+    out, (o_bh, lse) = _flash_core(q, k, v, interpret)
+    return out, (q, k, v, o_bh, lse)
+
+
+def _flash_bwd_rule(interpret, res, g):
+    q, k, v, o_bh, lse = res
+    B, T, H, d = q.shape
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+    from_bh = lambda x: x.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+    block_q = block_k = _pick_block(T)
+    dq, dk, dv = _flash_bwd(
+        to_bh(q), to_bh(k), to_bh(v), o_bh, lse, to_bh(g),
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return from_bh(dq), from_bh(dk), from_bh(dv)
+
+
+_flash_bthd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_causal_attention(q, k, v, *, interpret: bool | None = None):
+    """Causal MHA via the Pallas flash kernels.
+
+    Same signature/semantics as ``causal_attention`` — q, k, v are
+    (B, T, H, head_dim).  ``interpret=None`` auto-selects: compiled on TPU,
+    interpreter elsewhere (so the op works — slowly — in CPU tests).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_bthd(q, k, v, interpret)
