@@ -25,10 +25,10 @@ import json
 import sys
 import time
 
-# Single-process JAX-CPU rounds/sec of the same config on this container;
-# None until measured (run --measure-cpu-baseline and paste the value here).
-# While None, vs_baseline is emitted as null.
-CPU_BASELINE_ROUNDS_PER_SEC = None
+# Measured on this container 2026-07-29 with --measure-cpu-baseline
+# (sequential reference architecture, jitted per-client updates, JAX CPU):
+# 693.8 s/round.
+CPU_BASELINE_ROUNDS_PER_SEC = 0.001441
 
 
 def build_server(seed: int = 10):
@@ -67,20 +67,60 @@ def timed_rounds(server, nr_rounds: int) -> float:
     return nr_rounds / (time.perf_counter() - t0)
 
 
+def measure_cpu_baseline():
+    """Rounds/sec of the REFERENCE architecture on this container's CPU: a
+    sequential Python loop over the 26 sampled clients (hfl_complete.py's
+    simulated parallelism, :365-373), each client a jitted single-client
+    local-SGD update, plus the weighted-average aggregation.  This is the
+    honest CPU anchor — the reference never runs clients concurrently."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+    from ddl25spring_tpu.data import load_cifar10, split_dataset
+    from ddl25spring_tpu.fl.engine import make_local_sgd_update
+    from ddl25spring_tpu.fl.task import classification_task
+    from ddl25spring_tpu.models import ResNet18
+    from ddl25spring_tpu.utils.trees import tree_weighted_mean
+
+    ds = load_cifar10()
+    cd = split_dataset(ds.train_x, ds.train_y, 256, True, 10, pad_multiple=50)
+    task = classification_task(ResNet18(), (32, 32, 3), ds.test_x, ds.test_y)
+    params = task.init(jax.random.key(0))
+    update = jax.jit(make_local_sgd_update(task.loss_fn, 0.05, 50, 1))
+
+    sampled = list(range(26))
+    # compile once on the first client (excluded from timing)
+    jax.block_until_ready(update(params, jnp.asarray(cd.x[0]),
+                                 jnp.asarray(cd.y[0]),
+                                 jnp.int32(cd.counts[0]), jax.random.key(0)))
+    t0 = time.perf_counter()
+    updates = []
+    for i in sampled:
+        u = update(params, jnp.asarray(cd.x[i]), jnp.asarray(cd.y[i]),
+                   jnp.int32(cd.counts[i]), jax.random.fold_in(jax.random.key(1), i))
+        updates.append(jax.block_until_ready(u))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *updates)
+    w = jnp.asarray(cd.counts[sampled], jnp.float32)
+    agg = tree_weighted_mean(stacked, w / w.sum())
+    jax.block_until_ready(agg)
+    dt = time.perf_counter() - t0
+    print(f"CPU baseline (sequential reference architecture): "
+          f"{dt:.1f} s/round -> {1 / dt:.6f} rounds/sec "
+          f"(paste into CPU_BASELINE_ROUNDS_PER_SEC)", file=sys.stderr)
+
+
 def main():
+    from ddl25spring_tpu.utils.platform import select_platform
+
+    select_platform()
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--measure-cpu-baseline", action="store_true")
     args = ap.parse_args()
 
     if args.measure_cpu_baseline:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        server = build_server()
-        rps = timed_rounds(server, max(2, min(args.rounds, 3)))
-        print(f"CPU baseline: {rps:.6f} rounds/sec "
-              f"(paste into CPU_BASELINE_ROUNDS_PER_SEC)", file=sys.stderr)
+        measure_cpu_baseline()
         return
 
     server = build_server()

@@ -19,6 +19,7 @@ class PrefetchStream:
         self.stream = stream
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self._error: BaseException | None = None
         self._thread = threading.Thread(target=self._producer, daemon=True)
         self._thread.start()
 
@@ -27,6 +28,7 @@ class PrefetchStream:
             try:
                 item = ("batch", self.stream.next_batch())
             except BaseException as e:  # noqa: BLE001 — relayed to consumer
+                self._error = e
                 item = ("error", e)
             while not self._stop.is_set():
                 try:
@@ -35,15 +37,24 @@ class PrefetchStream:
                 except queue.Full:
                     continue
             if item[0] == "error":
-                return
+                return  # producer ends; consumers re-raise via _error
 
     def next_batch(self):
-        if self._stop.is_set():
-            raise RuntimeError("PrefetchStream is closed")
-        kind, payload = self._q.get()
-        if kind == "error":
-            raise payload
-        return payload
+        while True:
+            if self._stop.is_set():
+                raise RuntimeError("PrefetchStream is closed")
+            try:
+                kind, payload = self._q.get(timeout=0.5)
+            except queue.Empty:
+                # don't hang forever if the producer died (its error —
+                # already delivered or not — is sticky in self._error)
+                if not self._thread.is_alive():
+                    raise (self._error or
+                           RuntimeError("prefetch producer exited"))
+                continue
+            if kind == "error":
+                raise payload
+            return payload
 
     def __iter__(self):
         while True:

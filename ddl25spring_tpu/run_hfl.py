@@ -129,6 +129,9 @@ def run(cfg: HflConfig):
 
 
 def main(argv=None):
+    from .utils.platform import select_platform
+
+    select_platform()
     cfg = parse_config(HflConfig, argv)
     result = run(cfg)
     print(result.as_df().to_string(index=False))

@@ -1,0 +1,29 @@
+"""Runtime platform selection.
+
+Some images pre-import jax at interpreter startup with a pinned platform (a
+sitecustomize that registers a TPU tunnel), which makes the ``JAX_PLATFORMS``
+environment variable alone ineffective.  ``select_platform`` applies the
+``DDL25_PLATFORM`` env var (or an explicit argument) through ``jax.config``
+before the backend initialises — call it first thing in any entry point.
+
+    DDL25_PLATFORM=cpu python examples/homework1.py --quick
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def select_platform(platform: str | None = None) -> None:
+    """Force the jax platform (``cpu`` / ``tpu`` / ...) if requested via
+    argument or the ``DDL25_PLATFORM`` env var; no-op otherwise.  Must run
+    before any jax backend query (``jax.devices``, first op, ...)."""
+    platform = platform or os.environ.get("DDL25_PLATFORM")
+    if not platform:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+    except RuntimeError:
+        pass  # backend already initialised; too late to switch

@@ -1,0 +1,54 @@
+"""Byzantine attacks vs robust aggregation (the missing course part 3,
+SURVEY.md §2.2; north-star config[4] in BASELINE.json).
+
+Grid: {no attack, label-flip, gaussian} x {mean, krum, multi-krum,
+trimmed-mean, median} on FedSGD over MNIST, reporting final accuracy —
+robust aggregators should hold accuracy under attack where the plain mean
+collapses.
+
+Run:  python examples/robust_fl.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from ddl25spring_tpu.utils.platform import select_platform  # noqa: E402
+
+select_platform()
+
+from ddl25spring_tpu.run_hfl import build_server  # noqa: E402
+from ddl25spring_tpu.configs import HflConfig  # noqa: E402
+
+
+def main(quick=False):
+    rounds = 3 if quick else 10
+    nr_clients = 20 if quick else 50
+    nr_malicious = 4 if quick else 10
+    attacks = ["none", "label-flip"] if quick else \
+        ["none", "label-flip", "gaussian"]
+    aggs = ["mean", "krum", "median"] if quick else \
+        ["mean", "krum", "multi-krum", "trimmed-mean", "median"]
+    print(f"{'attack':12s} {'aggregator':14s} final acc")
+    for attack in attacks:
+        for agg in aggs:
+            cfg = HflConfig(
+                algorithm="fedsgd", nr_clients=nr_clients,
+                client_fraction=0.5, lr=0.05, seed=10,
+                aggregator=agg, attack=attack,
+                nr_malicious=0 if attack == "none" else nr_malicious,
+                nr_rounds=rounds,
+            )
+            server = build_server(cfg)
+            result = server.run(rounds)
+            print(f"{attack:12s} {agg:14s} {result.test_accuracy[-1]:6.2f}%")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    main(ap.parse_args().quick)

@@ -58,3 +58,28 @@ def test_prefetch_stream_delivers_in_order():
                                           direct.next_batch())
     finally:
         pre.close()
+
+
+def test_prefetch_stream_relays_producer_error():
+    import pytest as _pytest
+
+    from ddl25spring_tpu.data.prefetch import PrefetchStream
+
+    class Boom:
+        def __init__(self):
+            self.n = 0
+
+        def next_batch(self):
+            if self.n >= 1:
+                raise ValueError("source exploded")
+            self.n += 1
+            return self.n
+
+    pre = PrefetchStream(Boom())
+    assert pre.next_batch() == 1
+    with _pytest.raises(ValueError, match="source exploded"):
+        pre.next_batch()
+    # subsequent calls keep raising instead of hanging
+    with _pytest.raises(ValueError, match="source exploded"):
+        pre.next_batch()
+    pre.close()

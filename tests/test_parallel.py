@@ -180,3 +180,17 @@ def test_tp_sharded_forward_matches_replicated(model_and_batch):
     # kernels really are sharded over the model axis
     wq = params_tp["params"]["block0"]["attn"]["wq"]["kernel"]
     assert "model" in str(wq.sharding.spec)
+
+
+def test_run_lm_cli_all_strategies_converge():
+    """Every parallelism strategy in the LM CLI runs and reduces loss on the
+    8-device virtual mesh (the SPMD rebuild of tutorial_1b's run.sh fleet)."""
+    from ddl25spring_tpu.configs import LmConfig
+    from ddl25spring_tpu.run_lm import run
+
+    base = dict(batch_size=8, seq_l=32, dmodel=32, nr_heads=2, nr_layers=4,
+                nr_iters=6, nr_microbatches=2, lr=3e-3)
+    for strategy in ["single", "dp", "dp-weight", "pp", "1f1b", "dp-pp",
+                     "tp", "sp"]:
+        losses = run(LmConfig(strategy=strategy, **base), log_every=5)
+        assert losses[-1] < losses[0], (strategy, losses)
