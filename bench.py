@@ -32,12 +32,14 @@ CPU_BASELINE_ROUNDS_PER_SEC = 0.001441
 
 
 def build_server(seed: int = 10):
+    import jax
     import jax.numpy as jnp
 
     from ddl25spring_tpu.data import load_cifar10, split_dataset
     from ddl25spring_tpu.fl import FedAvgServer
     from ddl25spring_tpu.fl.task import classification_task
     from ddl25spring_tpu.models import ResNet18
+    from ddl25spring_tpu.parallel import make_mesh
 
     ds = load_cifar10()
     client_data = split_dataset(
@@ -47,9 +49,13 @@ def build_server(seed: int = 10):
     task = classification_task(
         ResNet18(dtype=jnp.bfloat16), (32, 32, 3), ds.test_x, ds.test_y
     )
+    # shard the sampled-client axis across every available chip (the
+    # one-core-per-simulated-client north star); single-chip runs unsharded
+    nr_devices = len(jax.devices())
+    mesh = make_mesh({"clients": nr_devices}) if nr_devices > 1 else None
     return FedAvgServer(
         task, lr=0.05, batch_size=50, client_data=client_data,
-        client_fraction=0.1, nr_local_epochs=1, seed=seed,
+        client_fraction=0.1, nr_local_epochs=1, seed=seed, mesh=mesh,
     )
 
 

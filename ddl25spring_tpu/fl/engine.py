@@ -159,6 +159,8 @@ def make_fl_round(
     apply_aggregate=None,
     attack=None,
     malicious_mask=None,
+    mesh=None,
+    clients_axis: str = "clients",
 ):
     """Build the jitted one-round function of a decentralized server.
 
@@ -173,11 +175,33 @@ def make_fl_round(
 
     ``attack(update_i, params, key_i) -> update_i`` optionally corrupts the
     updates of clients where ``malicious_mask`` is set (Byzantine simulation).
+
+    With ``mesh``, the sampled-client axis is sharded over ``clients_axis`` —
+    the north-star execution model (BASELINE.json: "one core per simulated
+    client", generalised to clients-per-core): client datasets live sharded
+    in device memory, every device runs its shard of the vmapped local
+    updates, and the weighted-mean aggregation lowers to one all-reduce over
+    ICI.  Without ``mesh`` the same program runs on one device.
     """
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     counts = jnp.asarray(counts)
     nr_clients = x.shape[0]
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        cshard = NamedSharding(mesh, PartitionSpec(clients_axis))
+        x = jax.device_put(x, cshard)
+        y = jax.device_put(y, cshard)
+        counts = jax.device_put(counts, cshard)
+
+        def constrain(t):
+            return jax.tree.map(
+                lambda a: jax.lax.with_sharding_constraint(a, cshard), t
+            )
+    else:
+        constrain = lambda t: t
 
     if aggregator is None:
         aggregator = lambda updates, weights, key: tree_weighted_mean(
@@ -192,9 +216,9 @@ def make_fl_round(
         sample_key, agg_key = jax.random.split(round_key)
         sel = sample_clients(sample_key, nr_clients, nr_sampled)
 
-        xs = jnp.take(x, sel, axis=0)
-        ys = jnp.take(y, sel, axis=0)
-        cs = jnp.take(counts, sel, axis=0)
+        xs = constrain(jnp.take(x, sel, axis=0))
+        ys = constrain(jnp.take(y, sel, axis=0))
+        cs = constrain(jnp.take(counts, sel, axis=0))
         # per-(round, client-id) keys: same discipline as the reference's
         # client_round_seed (hfl_complete.py:368), JAX-native derivation
         keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(sel)
@@ -202,6 +226,7 @@ def make_fl_round(
         updates = jax.vmap(client_update, in_axes=(None, 0, 0, 0, 0))(
             params, xs, ys, cs, keys
         )
+        updates = constrain(updates)
 
         if attack is not None:
             mal = jnp.take(jnp.asarray(malicious_mask), sel, axis=0)

@@ -85,11 +85,13 @@ class CentralizedServer(Server):
 
 class DecentralizedServer(Server):
     def __init__(self, task: Task, lr: float, batch_size: int,
-                 client_data: ClientDatasets, client_fraction: float, seed: int):
+                 client_data: ClientDatasets, client_fraction: float, seed: int,
+                 mesh=None):
         super().__init__(task, lr, batch_size, seed)
         self.client_data = client_data
         self.nr_clients = client_data.nr_clients
         self.client_fraction = client_fraction
+        self.mesh = mesh  # shard the sampled-client axis over this mesh
         self.nr_clients_per_round = max(1, round(client_fraction * self.nr_clients))
         self.round_fn = None  # set by subclass
         self.algorithm = "Decentralized"
@@ -128,8 +130,9 @@ class FedSgdGradientServer(DecentralizedServer):
 
     def __init__(self, task: Task, lr: float, client_data: ClientDatasets,
                  client_fraction: float, seed: int,
-                 aggregator=None, attack=None, malicious_mask=None):
-        super().__init__(task, lr, -1, client_data, client_fraction, seed)
+                 aggregator=None, attack=None, malicious_mask=None, mesh=None):
+        super().__init__(task, lr, -1, client_data, client_fraction, seed,
+                         mesh=mesh)
         self.algorithm = "FedSGDGradient"
         client_update = make_full_batch_grad(task.loss_fn)
         self.round_fn = make_fl_round(
@@ -141,6 +144,7 @@ class FedSgdGradientServer(DecentralizedServer):
                 lambda p, gg: p - lr * gg, params, g
             ),
             attack=attack, malicious_mask=malicious_mask,
+            mesh=mesh,
         )
 
 
@@ -152,8 +156,9 @@ class FedSgdWeightServer(DecentralizedServer):
 
     def __init__(self, task: Task, lr: float, client_data: ClientDatasets,
                  client_fraction: float, seed: int,
-                 aggregator=None, attack=None, malicious_mask=None):
-        super().__init__(task, lr, -1, client_data, client_fraction, seed)
+                 aggregator=None, attack=None, malicious_mask=None, mesh=None):
+        super().__init__(task, lr, -1, client_data, client_fraction, seed,
+                         mesh=mesh)
         self.algorithm = "FedSGDWeight"
         client_update = make_local_sgd_update(task.loss_fn, lr, -1, 1)
         self.round_fn = make_fl_round(
@@ -162,6 +167,7 @@ class FedSgdWeightServer(DecentralizedServer):
             self.nr_clients_per_round,
             aggregator=aggregator,
             attack=attack, malicious_mask=malicious_mask,
+            mesh=mesh,
         )
 
 
@@ -173,8 +179,9 @@ class FedAvgServer(DecentralizedServer):
     def __init__(self, task: Task, lr: float, batch_size: int,
                  client_data: ClientDatasets, client_fraction: float,
                  nr_local_epochs: int, seed: int,
-                 aggregator=None, attack=None, malicious_mask=None):
-        super().__init__(task, lr, batch_size, client_data, client_fraction, seed)
+                 aggregator=None, attack=None, malicious_mask=None, mesh=None):
+        super().__init__(task, lr, batch_size, client_data, client_fraction,
+                         seed, mesh=mesh)
         self.algorithm = "FedAvg"
         self.nr_local_epochs = nr_local_epochs
         if client_data.max_samples % batch_size != 0:
@@ -191,4 +198,5 @@ class FedAvgServer(DecentralizedServer):
             self.nr_clients_per_round,
             aggregator=aggregator,
             attack=attack, malicious_mask=malicious_mask,
+            mesh=mesh,
         )

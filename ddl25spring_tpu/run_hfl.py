@@ -79,8 +79,15 @@ def build_server(cfg: HflConfig):
     elif cfg.attack != "none":
         raise ValueError(f"unknown attack {cfg.attack!r}")
 
+    import jax
+
+    from .parallel import make_mesh
+
+    nr_devices = len(jax.devices())
+    mesh = make_mesh({"clients": nr_devices}) if nr_devices > 1 else None
     kw = dict(aggregator=build_aggregator(cfg), attack=attack,
-              malicious_mask=malicious if attack is not None else None)
+              malicious_mask=malicious if attack is not None else None,
+              mesh=mesh)
     if cfg.algorithm == "fedsgd":
         return FedSgdGradientServer(task, cfg.lr, client_data,
                                     cfg.client_fraction, cfg.seed, **kw)

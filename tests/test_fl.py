@@ -127,3 +127,30 @@ def test_centralized_server_one_epoch_learns(small_mnist, task):
     assert rr.algorithm == "Centralized"
     assert rr.message_count == [0, 0]
     assert rr.test_accuracy[-1] > acc0
+
+
+def test_fl_round_client_sharded_matches_single_device(small_mnist):
+    """North-star execution model: the same jitted round with the sampled
+    clients sharded over a ``clients`` mesh axis must produce the SAME params
+    as the unsharded round (aggregation becomes an all-reduce over the mesh,
+    numerics unchanged)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.data import split_dataset
+    from ddl25spring_tpu.fl import FedAvgServer
+    from ddl25spring_tpu.fl.task import mnist_task
+    from ddl25spring_tpu.parallel import make_mesh
+
+    ds = small_mnist
+    task = mnist_task(ds.test_x, ds.test_y)
+    data = split_dataset(ds.train_x, ds.train_y, 16, True, 3, pad_multiple=20)
+
+    plain = FedAvgServer(task, 0.05, 20, data, 0.5, 1, seed=3)
+    mesh = make_mesh({"clients": 8})
+    sharded = FedAvgServer(task, 0.05, 20, data, 0.5, 1, seed=3, mesh=mesh)
+
+    p1 = plain.round_fn(plain.params, plain.run_key, 0)
+    p2 = sharded.round_fn(sharded.params, sharded.run_key, 0)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        assert jnp.allclose(a, b, atol=1e-5)
