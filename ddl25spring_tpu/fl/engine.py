@@ -188,13 +188,30 @@ def make_fl_round(
     counts = jnp.asarray(counts)
     nr_clients = x.shape[0]
 
+    # Sharding needs the vmapped axis divisible by the mesh axis; pad the
+    # sampled set with zero-weighted duplicates (harmless under the default
+    # weighted mean).  Distance-based robust aggregators would be distorted
+    # by duplicates, so a custom aggregator that needs padding falls back to
+    # the unsharded path.
+    nr_shard = nr_sampled
+    if mesh is not None:
+        axis = mesh.shape[clients_axis]
+        padded = -(-nr_sampled // axis) * axis
+        if padded != nr_sampled and aggregator is not None:
+            mesh = None
+        elif padded > nr_clients:
+            mesh = None
+        else:
+            nr_shard = padded
+
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
         cshard = NamedSharding(mesh, PartitionSpec(clients_axis))
-        x = jax.device_put(x, cshard)
-        y = jax.device_put(y, cshard)
-        counts = jax.device_put(counts, cshard)
+        if nr_clients % mesh.shape[clients_axis] == 0:
+            x = jax.device_put(x, cshard)
+            y = jax.device_put(y, cshard)
+            counts = jax.device_put(counts, cshard)
 
         def constrain(t):
             return jax.tree.map(
@@ -214,7 +231,10 @@ def make_fl_round(
     def round_fn(params, base_key, round_idx):
         round_key = jax.random.fold_in(base_key, round_idx)
         sample_key, agg_key = jax.random.split(round_key)
-        sel = sample_clients(sample_key, nr_clients, nr_sampled)
+        sel = sample_clients(sample_key, nr_clients, nr_shard)
+        # entries beyond nr_sampled are shard padding: real clients that run
+        # a local update but contribute weight 0 to the aggregate
+        live = jnp.arange(nr_shard) < nr_sampled
 
         xs = constrain(jnp.take(x, sel, axis=0))
         ys = constrain(jnp.take(y, sel, axis=0))
@@ -241,7 +261,7 @@ def make_fl_round(
                 updates,
             )
 
-        weights = cs.astype(jnp.float32)
+        weights = jnp.where(live, cs.astype(jnp.float32), 0.0)
         weights = weights / jnp.sum(weights)
         aggregate = aggregator(updates, weights, agg_key)
         return apply_aggregate(params, aggregate)

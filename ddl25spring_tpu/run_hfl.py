@@ -84,7 +84,11 @@ def build_server(cfg: HflConfig):
     from .parallel import make_mesh
 
     nr_devices = len(jax.devices())
-    mesh = make_mesh({"clients": nr_devices}) if nr_devices > 1 else None
+    clients_per_round = max(1, round(cfg.client_fraction * cfg.nr_clients))
+    # shard clients over the mesh only when there are at least as many
+    # sampled clients as devices — below that, padding wastes compute
+    mesh = (make_mesh({"clients": nr_devices})
+            if nr_devices > 1 and clients_per_round >= nr_devices else None)
     kw = dict(aggregator=build_aggregator(cfg), attack=attack,
               malicious_mask=malicious if attack is not None else None,
               mesh=mesh)

@@ -154,3 +154,28 @@ def test_fl_round_client_sharded_matches_single_device(small_mnist):
     p2 = sharded.round_fn(sharded.params, sharded.run_key, 0)
     for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
         assert jnp.allclose(a, b, atol=1e-5)
+
+
+def test_fl_round_sharded_with_padding_matches(small_mnist):
+    """Sampled count not divisible by the mesh axis: the round pads with
+    zero-weighted duplicates; params must still match the unsharded round."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.data import split_dataset
+    from ddl25spring_tpu.fl import FedSgdGradientServer
+    from ddl25spring_tpu.fl.task import mnist_task
+    from ddl25spring_tpu.parallel import make_mesh
+
+    ds = small_mnist
+    task = mnist_task(ds.test_x, ds.test_y)
+    data = split_dataset(ds.train_x, ds.train_y, 20, True, 3)
+
+    plain = FedSgdGradientServer(task, 0.05, data, 0.5, seed=3)  # 10 sampled
+    mesh = make_mesh({"clients": 8})
+    sharded = FedSgdGradientServer(task, 0.05, data, 0.5, seed=3, mesh=mesh)
+
+    p1 = plain.round_fn(plain.params, plain.run_key, 0)
+    p2 = sharded.round_fn(sharded.params, sharded.run_key, 0)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        assert jnp.allclose(a, b, atol=1e-5)
