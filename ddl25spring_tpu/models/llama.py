@@ -41,6 +41,8 @@ class LlamaConfig:
     dtype: jnp.dtype = jnp.float32  # compute dtype; bfloat16 on TPU
     attn_impl: str = "dense"   # "dense" (XLA fused) | "ring" (sequence-parallel)
     seq_axis: str = "seq"      # mesh axis for attn_impl="ring"
+    nr_experts: int = 0        # 0 = dense SwiGLU MLP; >0 = top-k MoE
+    expert_topk: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -133,8 +135,13 @@ class Block(nn.Module):
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions
         )
-        x = x + SwiGLU(cfg, name="mlp")(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
-        return x
+        h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
+        if cfg.nr_experts:
+            from .moe import MoEMLP  # local import avoids a module cycle
+
+            return x + MoEMLP(cfg, cfg.nr_experts, cfg.expert_topk,
+                              name="moe")(h)
+        return x + SwiGLU(cfg, name="mlp")(h)
 
 
 def _positions(T: int):

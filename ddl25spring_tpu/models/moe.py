@@ -1,0 +1,98 @@
+"""Mixture-of-Experts layer + expert parallelism (EP).
+
+The reference has no MoE at all (SURVEY.md §2.2 marks EP absent); this is a
+new TPU-native capability rounding out the parallelism matrix (DP/PP/TP/SP/
+EP).  Construction (standard public top-k MoE, Shazeer et al.):
+
+- a linear router scores ``nr_experts`` experts per token; the top-k gates
+  are renormalised and every non-top-k gate is zero;
+- experts are SwiGLU MLPs whose parameters are STACKED on a leading
+  ``(E, ...)`` axis, and expert computation is expressed as einsums carrying
+  the ``E`` dimension — so expert parallelism is nothing but a sharding
+  annotation ``P("expert")`` on the stacked params: XLA partitions the
+  expert einsums across the mesh and inserts the combine reduction.
+
+This is the *dense-dispatch* formulation: every expert processes every token
+and the top-k mask zeroes the rest.  It trades FLOPs (E/k× the sparse
+dispatch) for zero host-side gather/scatter and perfect static shapes — the
+right starting point on TPU, where einsums ride the MXU; a capacity-based
+sparse dispatch is a later optimisation behind the same module interface.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed mixture of SwiGLU experts (drop-in for the dense MLP)."""
+
+    config: LlamaConfig
+    nr_experts: int
+    topk: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        E, k = self.nr_experts, self.topk
+        D, H = cfg.dmodel, cfg.hidden_dim
+        dt = cfg.dtype
+
+        # router in float32 for numerically stable softmax/top-k
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          name="router")(x.astype(jnp.float32))  # (B,T,E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_v, top_i = jax.lax.top_k(probs, k)                   # (B,T,k)
+        top_v = top_v / jnp.sum(top_v, axis=-1, keepdims=True)
+        gates = jnp.sum(
+            jax.nn.one_hot(top_i, E, dtype=jnp.float32)
+            * top_v[..., None],
+            axis=-2,
+        )                                                        # (B,T,E)
+
+        init = nn.initializers.lecun_normal()
+        w1 = self.param("w1", init, (E, D, H)).astype(dt)
+        w3 = self.param("w3", init, (E, D, H)).astype(dt)
+        w2 = self.param("w2", init, (E, H, D)).astype(dt)
+
+        # dense dispatch: E carried as a tensor dim -> shardable over "expert"
+        xe = x.astype(dt)
+        gate_h = jnp.einsum("btd,edh->ebth", xe, w1)
+        up_h = jnp.einsum("btd,edh->ebth", xe, w3)
+        expert_out = jnp.einsum(
+            "ebth,ehd->ebtd", nn.silu(gate_h) * up_h, w2
+        )                                                        # (E,B,T,D)
+        out = jnp.einsum(
+            "ebtd,bte->btd", expert_out.astype(jnp.float32), gates
+        )
+        return out.astype(x.dtype)
+
+
+def moe_aux_load(gates_probs):
+    """Switch-style load-balancing auxiliary loss input hook (mean gate prob
+    per expert); exposed for trainers that want to regularise routing."""
+    return jnp.mean(gates_probs, axis=(0, 1))
+
+
+def llama_moe_ep_shardings(mesh, params, expert_axis: str = "expert"):
+    """Sharding tree for a params pytree containing MoEMLP experts: stacked
+    expert kernels (rank-3 ``w1``/``w2``/``w3`` under an ``moe`` scope)
+    sharded on their leading expert dim; everything else replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    esh = NamedSharding(mesh, P(expert_axis))
+    repl = NamedSharding(mesh, P())
+    axis_size = mesh.shape[expert_axis]
+
+    def spec_for(path, leaf):
+        names = [getattr(kk, "key", getattr(kk, "name", "")) for kk in path]
+        if (names and names[-1] in ("w1", "w2", "w3") and leaf.ndim == 3
+                and leaf.shape[0] % axis_size == 0):
+            return esh
+        return repl
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -135,6 +135,32 @@ def build_trainer(cfg: LmConfig):
         shard = lambda x: jax.device_put(x, dp_data_sharding(mesh))
         return step, params, optimizer.init(params), shard
 
+    if cfg.strategy == "ep":
+        from .models import llama_moe_ep_shardings
+
+        nr_experts = max(2, n)
+        moe_cfg = LlamaConfig(
+            vocab_size=259, dmodel=cfg.dmodel, nr_heads=cfg.nr_heads,
+            nr_layers=cfg.nr_layers, ctx_size=cfg.seq_l, dtype=mcfg.dtype,
+            nr_experts=nr_experts,
+        )
+        model = Llama(moe_cfg)
+        params = model.init(jax.random.key(cfg.seed), tokens0)
+        mesh = make_mesh({"expert": n}, devices=devices)
+        params = apply_shardings(params,
+                                 llama_moe_ep_shardings(mesh, params))
+
+        def moe_loss(p, batch):
+            return causal_lm_loss(model.apply(p, batch), batch)
+
+        @jax.jit
+        def step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(moe_loss)(params, tokens)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return step, params, optimizer.init(params), identity
+
     if cfg.strategy == "sp":
         seq = _largest_divisor(cfg.seq_l, n)
         mesh = make_mesh({"seq": seq}, devices=devices[:seq])

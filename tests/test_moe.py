@@ -1,0 +1,103 @@
+"""Mixture-of-Experts + expert parallelism oracles."""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from ddl25spring_tpu.models import Llama, LlamaConfig, llama_moe_ep_shardings
+from ddl25spring_tpu.ops import causal_lm_loss
+from ddl25spring_tpu.parallel import apply_shardings, make_mesh
+
+CFG = LlamaConfig(vocab_size=64, dmodel=32, nr_heads=2, nr_layers=2,
+                  ctx_size=16, nr_experts=8, expert_topk=2)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    tokens = jax.random.randint(jax.random.key(0), (4, CFG.ctx_size), 0,
+                                CFG.vocab_size)
+    model = Llama(CFG)
+    params = model.init(jax.random.key(1), tokens)
+    return model, params, tokens
+
+
+def test_moe_gates_topk(setup):
+    from ddl25spring_tpu.models.moe import MoEMLP
+
+    x = jax.random.normal(jax.random.key(2), (2, 8, CFG.dmodel))
+    moe = MoEMLP(CFG, nr_experts=8, topk=2)
+    p = moe.init(jax.random.key(3), x)
+    # recompute gates the same way the layer does, verify top-k structure
+    logits = x.astype(jnp.float32) @ p["params"]["router"]["kernel"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_v, top_i = jax.lax.top_k(probs, 2)
+    gates = jnp.sum(
+        jax.nn.one_hot(top_i, 8) * (top_v / top_v.sum(-1, keepdims=True))[..., None],
+        axis=-2,
+    )
+    assert jnp.allclose(gates.sum(-1), 1.0, atol=1e-5)
+    assert int(jnp.max(jnp.sum(gates > 0, axis=-1))) <= 2
+
+
+def test_moe_llama_trains(setup):
+    model, params, tokens = setup
+    opt = optax.adam(3e-3)
+
+    @jax.jit
+    def step(p, s, t):
+        loss, g = jax.value_and_grad(
+            lambda p: causal_lm_loss(model.apply(p, t), t)
+        )(p)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    s = opt.init(params)
+    p = params
+    losses = []
+    for _ in range(5):
+        p, s, loss = step(p, s, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_ep_sharded_step_matches_replicated(setup):
+    """Expert-sharded training step must equal the unsharded one — EP is a
+    pure layout change."""
+    model, params, tokens = setup
+    opt = optax.sgd(0.1)
+
+    def loss_fn(p, t):
+        return causal_lm_loss(model.apply(p, t), t)
+
+    l_ref, g_ref = jax.value_and_grad(loss_fn)(params, tokens)
+    p_ref = optax.apply_updates(params, opt.update(g_ref, opt.init(params))[0])
+
+    mesh = make_mesh({"expert": 8})
+    shardings = llama_moe_ep_shardings(mesh, params)
+    # stacked expert kernels must actually be expert-sharded, not replicated
+    specs = jax.tree_util.tree_leaves_with_path(shardings)
+    assert any("w1" in str(path) and s.spec != () and s.spec[0] == "expert"
+               for path, s in specs)
+    p_sh = apply_shardings(params, shardings)
+
+    @jax.jit
+    def step(p, s, t):
+        loss, g = jax.value_and_grad(loss_fn)(p, t)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    p_ep, _, l_ep = step(p_sh, opt.init(p_sh), tokens)
+    assert jnp.allclose(l_ep, l_ref, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_ep), jax.tree.leaves(p_ref)):
+        assert jnp.allclose(a, b, atol=1e-4)
+
+
+def test_run_lm_ep_strategy_converges():
+    from ddl25spring_tpu.configs import LmConfig
+    from ddl25spring_tpu.run_lm import run
+
+    losses = run(LmConfig(strategy="ep", batch_size=8, seq_l=32, dmodel=32,
+                          nr_heads=2, nr_layers=2, nr_iters=6, lr=3e-3),
+                 log_every=5)
+    assert losses[-1] < losses[0]
