@@ -59,12 +59,22 @@ def build_server(seed: int = 10):
     )
 
 
+def _stamp(msg: str):
+    print(f"[bench +{time.perf_counter() - _T0:.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+_T0 = time.perf_counter()
+
+
 def timed_rounds(server, nr_rounds: int) -> float:
     """Rounds/sec over ``nr_rounds`` after a compile warmup round."""
     import jax
 
+    _stamp("warmup round (jit compile) ...")
     params = server.round_fn(server.params, server.run_key, 0)  # warmup/compile
     jax.block_until_ready(params)
+    _stamp("warmup done; timing ...")
     t0 = time.perf_counter()
     for r in range(1, nr_rounds + 1):
         params = server.round_fn(params, server.run_key, r)
@@ -129,8 +139,10 @@ def main():
         measure_cpu_baseline()
         return
 
+    _stamp("building server (data + mesh + jit round_fn) ...")
     server = build_server()
     rps = timed_rounds(server, args.rounds)
+    _stamp("timed rounds done")
     vs = (
         round(rps / CPU_BASELINE_ROUNDS_PER_SEC, 2)
         if CPU_BASELINE_ROUNDS_PER_SEC

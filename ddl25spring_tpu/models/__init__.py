@@ -1,7 +1,7 @@
 from .cnn import MnistCnn
 from .mlp import HeartDiseaseNN
 from .resnet import BasicBlock, ResNet, ResNet18
-from .moe import MoEMLP, llama_moe_ep_shardings
+from .moe import MoEMLP
 from .vae import TabularVAE, MLPEncoder, MLPDecoder, vae_loss, reparameterize
 from .llama import (
     Llama,
@@ -21,7 +21,6 @@ __all__ = [
     "ResNet",
     "ResNet18",
     "MoEMLP",
-    "llama_moe_ep_shardings",
     "TabularVAE",
     "MLPEncoder",
     "MLPDecoder",

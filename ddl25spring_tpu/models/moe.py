@@ -39,6 +39,10 @@ class MoEMLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         E, k = self.nr_experts, self.topk
+        if k > E:
+            raise ValueError(
+                f"expert_topk={k} exceeds nr_experts={E}; need topk <= E"
+            )
         D, H = cfg.dmodel, cfg.hidden_dim
         dt = cfg.dtype
 
@@ -46,6 +50,9 @@ class MoEMLP(nn.Module):
         logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                           name="router")(x.astype(jnp.float32))  # (B,T,E)
         probs = jax.nn.softmax(logits, axis=-1)
+        # expose routing to trainers (mutable=["intermediates"]) for the
+        # load-balancing auxiliary loss (moe_aux_load)
+        self.sow("intermediates", "router_probs", probs)
         top_v, top_i = jax.lax.top_k(probs, k)                   # (B,T,k)
         top_v = top_v / jnp.sum(top_v, axis=-1, keepdims=True)
         gates = jnp.sum(
@@ -54,7 +61,10 @@ class MoEMLP(nn.Module):
             axis=-2,
         )                                                        # (B,T,E)
 
-        init = nn.initializers.lecun_normal()
+        # batch_axis=0: the expert dim is a batch of independent kernels, not
+        # receptive field — without it fan_in would be E*D and every expert
+        # would start sqrt(E) too small (and vary with the mesh size)
+        init = nn.initializers.lecun_normal(batch_axis=0)
         w1 = self.param("w1", init, (E, D, H)).astype(dt)
         w3 = self.param("w3", init, (E, D, H)).astype(dt)
         w2 = self.param("w2", init, (E, H, D)).astype(dt)
@@ -66,33 +76,42 @@ class MoEMLP(nn.Module):
         expert_out = jnp.einsum(
             "ebth,ehd->ebtd", nn.silu(gate_h) * up_h, w2
         )                                                        # (E,B,T,D)
+        # combine in the compute dtype with fp32 accumulation — an fp32
+        # upcast of (E,B,T,D) would double the layer's peak activation
         out = jnp.einsum(
-            "ebtd,bte->btd", expert_out.astype(jnp.float32), gates
+            "ebtd,bte->btd", expert_out, gates.astype(dt),
+            preferred_element_type=jnp.float32,
         )
         return out.astype(x.dtype)
 
 
-def moe_aux_load(gates_probs):
-    """Switch-style load-balancing auxiliary loss input hook (mean gate prob
-    per expert); exposed for trainers that want to regularise routing."""
-    return jnp.mean(gates_probs, axis=(0, 1))
+def moe_aux_load(params_or_intermediates):
+    """Switch-style load-balancing auxiliary loss over every MoE layer's sown
+    router probabilities.
+
+    Run the model with ``model.apply(params, x, mutable=["intermediates"])``,
+    pass the returned intermediates tree here, and add
+    ``aux_weight * moe_aux_load(intermediates)`` to the training loss.  The
+    loss is ``E * Σ_e mean_prob_e²`` per layer (minimised at uniform routing,
+    where it equals 1), averaged over layers.
+    """
+    probs = [
+        leaf
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            params_or_intermediates
+        )
+        if any(
+            getattr(kk, "key", getattr(kk, "name", "")) == "router_probs"
+            for kk in path
+        )
+    ]
+    if not probs:
+        raise ValueError("no 'router_probs' intermediates found; apply the "
+                         "model with mutable=['intermediates']")
+    per_layer = [
+        p.shape[-1] * jnp.sum(jnp.mean(p, axis=tuple(range(p.ndim - 1))) ** 2)
+        for p in probs
+    ]
+    return jnp.mean(jnp.stack(per_layer))
 
 
-def llama_moe_ep_shardings(mesh, params, expert_axis: str = "expert"):
-    """Sharding tree for a params pytree containing MoEMLP experts: stacked
-    expert kernels (rank-3 ``w1``/``w2``/``w3`` under an ``moe`` scope)
-    sharded on their leading expert dim; everything else replicated."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    esh = NamedSharding(mesh, P(expert_axis))
-    repl = NamedSharding(mesh, P())
-    axis_size = mesh.shape[expert_axis]
-
-    def spec_for(path, leaf):
-        names = [getattr(kk, "key", getattr(kk, "name", "")) for kk in path]
-        if (names and names[-1] in ("w1", "w2", "w3") and leaf.ndim == 3
-                and leaf.shape[0] % axis_size == 0):
-            return esh
-        return repl
-
-    return jax.tree_util.tree_map_with_path(spec_for, params)

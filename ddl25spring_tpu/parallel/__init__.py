@@ -7,6 +7,7 @@ from .pp import (
     make_pp_train_step,
 )
 from .tp import llama_tp_shardings, apply_shardings
+from .ep import llama_moe_ep_shardings
 from .sp import make_sp_forward, make_sp_train_step, sp_data_sharding
 from .pp_1f1b import make_1f1b_grad_fn, make_1f1b_train_step
 
@@ -26,5 +27,6 @@ __all__ = [
     "make_pp_loss_fn",
     "make_pp_train_step",
     "llama_tp_shardings",
+    "llama_moe_ep_shardings",
     "apply_shardings",
 ]

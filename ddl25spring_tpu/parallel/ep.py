@@ -1,0 +1,43 @@
+"""Expert parallelism (EP): shard stacked MoE expert kernels over the mesh.
+
+The reference has no MoE/EP at all (SURVEY.md §2.2); this completes the
+DP/PP/TP/SP/EP parallelism matrix.  :class:`~ddl25spring_tpu.models.moe.MoEMLP`
+stacks its expert kernels on a leading ``(E, ...)`` axis and expresses expert
+compute as einsums carrying ``E``, so EP is purely a sharding annotation:
+``P("expert")`` on those kernels lets GSPMD partition the expert einsums
+across devices and insert the combine all-reduce over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def llama_moe_ep_shardings(mesh, params, expert_axis: str = "expert"):
+    """Sharding tree for a params pytree containing MoEMLP experts: stacked
+    expert kernels (rank-3 ``w1``/``w2``/``w3`` under a ``moe`` scope)
+    sharded on their leading expert dim; everything else replicated.
+
+    Raises if an expert-stacked kernel cannot be split evenly over the
+    ``expert_axis`` — silently replicating would turn EP into a no-op that
+    only profiling could catch.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    esh = NamedSharding(mesh, P(expert_axis))
+    repl = NamedSharding(mesh, P())
+    axis_size = mesh.shape[expert_axis]
+
+    def spec_for(path, leaf):
+        names = [getattr(kk, "key", getattr(kk, "name", "")) for kk in path]
+        if names and names[-1] in ("w1", "w2", "w3") and leaf.ndim == 3:
+            if leaf.shape[0] % axis_size != 0:
+                raise ValueError(
+                    f"nr_experts={leaf.shape[0]} not divisible by "
+                    f"{expert_axis!r} mesh axis of size {axis_size} at "
+                    f"{'/'.join(names)}"
+                )
+            return esh
+        return repl
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -7,9 +7,10 @@ Strategies map to the reference's scripts — ``single`` (primer/intro.py),
 microbatching, PP/1F1B/intro_PP_1F1B_MB.py), ``1f1b`` (the interleaved
 schedule the reference never got working), ``dp-pp`` (the hybrid 2x3 MP
 topology), ``tp`` (absent from the reference; free under GSPMD), ``sp``
-(ring-attention sequence parallelism; absent from the reference) — but every
-one of them is a single SPMD program over a device mesh instead of N OS
-processes over gloo.
+(ring-attention sequence parallelism; absent from the reference), ``ep``
+(top-k MoE with experts sharded over the mesh; absent from the reference) —
+but every one of them is a single SPMD program over a device mesh instead of
+N OS processes over gloo.
 """
 
 from __future__ import annotations
@@ -27,6 +28,7 @@ from .ops import causal_lm_loss
 from .parallel import (
     apply_shardings,
     dp_data_sharding,
+    llama_moe_ep_shardings,
     llama_tp_shardings,
     make_1f1b_train_step,
     make_dp_train_step,
@@ -62,13 +64,35 @@ def build_trainer(cfg: LmConfig):
     """Return (step_fn, params, opt_state, batch_shard_fn) for the chosen
     strategy.  ``step(params, opt_state, tokens) -> (params, opt_state,
     loss)`` everywhere."""
+    import dataclasses as _dc
+
     mcfg = _model_config(cfg)
-    model = Llama(mcfg)
     devices = jax.devices()
     n = cfg.nr_devices or len(devices)
     devices = devices[:n]
     optimizer = optax.adam(cfg.lr)
     tokens0 = jnp.zeros((cfg.batch_size, cfg.seq_l), jnp.int32)
+
+    if cfg.strategy == "ep":
+        moe_cfg = _dc.replace(mcfg, nr_experts=max(2, n))
+        model = Llama(moe_cfg)
+        params = model.init(jax.random.key(cfg.seed), tokens0)
+        mesh = make_mesh({"expert": n}, devices=devices)
+        params = apply_shardings(params,
+                                 llama_moe_ep_shardings(mesh, params))
+
+        def moe_loss(p, batch):
+            return causal_lm_loss(model.apply(p, batch), batch)
+
+        @jax.jit
+        def ep_step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(moe_loss)(params, tokens)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return ep_step, params, optimizer.init(params), lambda x: x
+
+    model = Llama(mcfg)
     params = model.init(jax.random.key(cfg.seed), tokens0)
 
     def loss_fn(p, batch):
@@ -134,32 +158,6 @@ def build_trainer(cfg: LmConfig):
 
         shard = lambda x: jax.device_put(x, dp_data_sharding(mesh))
         return step, params, optimizer.init(params), shard
-
-    if cfg.strategy == "ep":
-        from .models import llama_moe_ep_shardings
-
-        nr_experts = max(2, n)
-        moe_cfg = LlamaConfig(
-            vocab_size=259, dmodel=cfg.dmodel, nr_heads=cfg.nr_heads,
-            nr_layers=cfg.nr_layers, ctx_size=cfg.seq_l, dtype=mcfg.dtype,
-            nr_experts=nr_experts,
-        )
-        model = Llama(moe_cfg)
-        params = model.init(jax.random.key(cfg.seed), tokens0)
-        mesh = make_mesh({"expert": n}, devices=devices)
-        params = apply_shardings(params,
-                                 llama_moe_ep_shardings(mesh, params))
-
-        def moe_loss(p, batch):
-            return causal_lm_loss(model.apply(p, batch), batch)
-
-        @jax.jit
-        def step(params, opt_state, tokens):
-            loss, grads = jax.value_and_grad(moe_loss)(params, tokens)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
-        return step, params, optimizer.init(params), identity
 
     if cfg.strategy == "sp":
         seq = _largest_divisor(cfg.seq_l, n)

@@ -5,9 +5,9 @@ import jax.numpy as jnp
 import optax
 import pytest
 
-from ddl25spring_tpu.models import Llama, LlamaConfig, llama_moe_ep_shardings
+from ddl25spring_tpu.models import Llama, LlamaConfig
 from ddl25spring_tpu.ops import causal_lm_loss
-from ddl25spring_tpu.parallel import apply_shardings, make_mesh
+from ddl25spring_tpu.parallel import apply_shardings, llama_moe_ep_shardings, make_mesh
 
 CFG = LlamaConfig(vocab_size=64, dmodel=32, nr_heads=2, nr_layers=2,
                   ctx_size=16, nr_experts=8, expert_topk=2)
@@ -22,22 +22,68 @@ def setup():
     return model, params, tokens
 
 
-def test_moe_gates_topk(setup):
+def test_moe_single_expert_equals_swiglu():
+    """With E=1, k=1 the gate is exactly 1, so the layer's output must equal
+    the plain SwiGLU computed by hand from its own params — an end-to-end
+    check of the dense-dispatch einsums."""
     from ddl25spring_tpu.models.moe import MoEMLP
+    import flax.linen as nn
+
+    x = jax.random.normal(jax.random.key(2), (2, 8, CFG.dmodel))
+    moe = MoEMLP(CFG, nr_experts=1, topk=1)
+    p = moe.init(jax.random.key(3), x)
+    out = moe.apply(p, x)
+    w = p["params"]
+    expected = (nn.silu(x @ w["w1"][0]) * (x @ w["w3"][0])) @ w["w2"][0]
+    assert jnp.allclose(out, expected, atol=1e-5)
+
+
+def test_moe_topk_sparsity_and_aux_load():
+    """The layer's own sown router probs must be a distribution, the output
+    must change only through the top-k experts, and moe_aux_load over the
+    sown intermediates must hit its uniform-routing minimum (1.0) when the
+    router is unbiased."""
+    from ddl25spring_tpu.models.moe import MoEMLP, moe_aux_load
 
     x = jax.random.normal(jax.random.key(2), (2, 8, CFG.dmodel))
     moe = MoEMLP(CFG, nr_experts=8, topk=2)
     p = moe.init(jax.random.key(3), x)
-    # recompute gates the same way the layer does, verify top-k structure
-    logits = x.astype(jnp.float32) @ p["params"]["router"]["kernel"]
-    probs = jax.nn.softmax(logits, axis=-1)
-    top_v, top_i = jax.lax.top_k(probs, 2)
-    gates = jnp.sum(
-        jax.nn.one_hot(top_i, 8) * (top_v / top_v.sum(-1, keepdims=True))[..., None],
-        axis=-2,
+    out, inter = moe.apply(p, x, mutable=["intermediates"])
+    probs = inter["intermediates"]["router_probs"][0]
+    assert probs.shape == (2, 8, 8)
+    assert jnp.allclose(probs.sum(-1), 1.0, atol=1e-5)
+    aux = moe_aux_load(inter)
+    assert aux >= 1.0 - 1e-5  # E * sum(mean_e^2) is minimised at uniform
+
+    # a zeroed router gives exactly uniform probs -> aux == 1
+    p0 = jax.tree.map(lambda a: a, p)
+    p0["params"]["router"]["kernel"] = jnp.zeros_like(
+        p["params"]["router"]["kernel"]
     )
-    assert jnp.allclose(gates.sum(-1), 1.0, atol=1e-5)
-    assert int(jnp.max(jnp.sum(gates > 0, axis=-1))) <= 2
+    _, inter0 = moe.apply(p0, x, mutable=["intermediates"])
+    assert jnp.allclose(moe_aux_load(inter0), 1.0, atol=1e-5)
+
+    # with topk=2, zeroing the two selected experts' outputs for a token must
+    # zero that token's output: verify output is a combination of <=2 experts
+    top_i = jax.lax.top_k(probs, 2)[1]
+    w = dict(p["params"])
+    out_full = moe.apply({"params": w}, x)
+    # kill every expert NOT in token (0,0)'s top-2; its output must not move
+    keep = set(int(e) for e in top_i[0, 0])
+    w_kill = dict(w)
+    for name in ("w1", "w2", "w3"):
+        mask = jnp.array([1.0 if e in keep else 0.0 for e in range(8)])
+        w_kill[name] = w[name] * mask.reshape(-1, 1, 1)
+    out_kill = moe.apply({"params": w_kill}, x)
+    assert jnp.allclose(out_kill[0, 0], out_full[0, 0], atol=1e-5)
+
+
+def test_moe_topk_validation():
+    from ddl25spring_tpu.models.moe import MoEMLP
+
+    x = jnp.zeros((1, 4, CFG.dmodel))
+    with pytest.raises(ValueError, match="expert_topk"):
+        MoEMLP(CFG, nr_experts=1, topk=2).init(jax.random.key(0), x)
 
 
 def test_moe_llama_trains(setup):
