@@ -19,7 +19,7 @@ from dataclasses import dataclass
 class HflConfig:
     """Horizontal-FL experiment (tutorial_1a / homework-1 family)."""
 
-    algorithm: str = "fedavg"  # centralized | fedsgd | fedsgd-weight | fedavg
+    algorithm: str = "fedavg"  # centralized | fedsgd | fedsgd-weight | fedavg | fedprox | fedopt
     dataset: str = "mnist"     # mnist | cifar10
     nr_clients: int = 100      # N
     client_fraction: float = 0.1  # C
@@ -29,6 +29,11 @@ class HflConfig:
     iid: bool = True
     seed: int = 10
     nr_rounds: int = 10
+    # FL extensions beyond the reference
+    prox_mu: float = 0.0       # FedProx proximal coefficient (fedprox)
+    server_optimizer: str = "adam"  # fedopt: sgd | avgm | adam | yogi
+    server_lr: float = 0.02    # fedopt server-side learning rate
+    dropout_rate: float = 0.0  # per-round client failure probability
     # robust aggregation (the missing course part 3; SURVEY.md §2.2)
     aggregator: str = "mean"   # mean | krum | multi-krum | trimmed-mean | median
     attack: str = "none"       # none | label-flip | gaussian
@@ -53,6 +58,7 @@ class LmConfig:
     lr: float = 8e-4           # primer/intro.py: Adam lr
     nr_iters: int = 100
     nr_microbatches: int = 3   # intro_PP_1F1B_MB.py microbatch count
+    moe_aux_weight: float = 0.01  # ep: load-balancing aux loss weight
     seed: int = 0
 
 

@@ -13,6 +13,7 @@ from .servers import (
     FedSgdGradientServer,
     FedSgdWeightServer,
     FedAvgServer,
+    FedOptServer,
 )
 
 __all__ = [
@@ -30,4 +31,5 @@ __all__ = [
     "FedSgdGradientServer",
     "FedSgdWeightServer",
     "FedAvgServer",
+    "FedOptServer",
 ]

@@ -43,6 +43,7 @@ def make_local_sgd_update(
     batch_size: int,
     nr_epochs: int,
     unroll_threshold: int | None = None,
+    prox_mu: float = 0.0,
 ):
     """Build a single-client local-update function.
 
@@ -66,11 +67,17 @@ def make_local_sgd_update(
     platform-dependent: 32 on CPU, 0 (always scan) elsewhere.  The rng key
     derivation chain is identical on both paths, so results do not depend on
     which one is taken.
+
+    ``prox_mu > 0`` adds the FedProx proximal term μ/2·‖w − w_global‖² to
+    every local step (w_global = the params the client received at round
+    start), damping client drift on heterogeneous data; μ = 0 is exactly
+    FedAvg's local SGD.
     """
     if unroll_threshold is None:
         unroll_threshold = 32 if jax.default_backend() == "cpu" else 0
 
     def update(params, x, y, count, key):
+        global_params = params  # round-start anchor for the proximal term
         max_n = y.shape[0]
         bsz = max_n if batch_size == -1 else batch_size
         if max_n % bsz != 0:
@@ -85,6 +92,11 @@ def make_local_sgd_update(
             yb = jnp.take(y, idx, axis=0)
             mask = idx < count
             grads = jax.grad(loss_fn)(params, xb, yb, mask, step_key)
+            if prox_mu:
+                grads = jax.tree.map(
+                    lambda g, p, p0: g + prox_mu * (p - p0),
+                    grads, params, global_params,
+                )
             return jax.tree.map(lambda p, g: p - lr * g, params, grads)
 
         def epoch_perm_and_keys(epoch_key):
@@ -161,6 +173,7 @@ def make_fl_round(
     malicious_mask=None,
     mesh=None,
     clients_axis: str = "clients",
+    dropout_rate: float = 0.0,
 ):
     """Build the jitted one-round function of a decentralized server.
 
@@ -176,6 +189,18 @@ def make_fl_round(
     ``attack(update_i, params, key_i) -> update_i`` optionally corrupts the
     updates of clients where ``malicious_mask`` is set (Byzantine simulation).
 
+    ``dropout_rate`` simulates client failures/stragglers — the failure class
+    the reference has no handling for (SURVEY.md §5: no retry, no straggler
+    handling): each sampled client independently drops out of the round with
+    this probability and the aggregation renormalises over the survivors, so
+    a round never blocks on a dead client.  If every client drops, the round
+    falls back to keeping all updates (the server would otherwise re-run the
+    round; keeping shapes static matters more here than modelling that
+    retry).  Dropout works by zero-weighting, so it cannot combine with a
+    custom ``aggregator`` — the robust aggregators deliberately ignore
+    weights (no n_k weighting a Byzantine client could lie about), which
+    would make dropout a silent no-op; that combination raises instead.
+
     With ``mesh``, the sampled-client axis is sharded over ``clients_axis`` —
     the north-star execution model (BASELINE.json: "one core per simulated
     client", generalised to clients-per-core): client datasets live sharded
@@ -183,6 +208,12 @@ def make_fl_round(
     updates, and the weighted-mean aggregation lowers to one all-reduce over
     ICI.  Without ``mesh`` the same program runs on one device.
     """
+    if dropout_rate and aggregator is not None:
+        raise ValueError(
+            "dropout_rate cannot combine with a custom aggregator: robust "
+            "aggregators ignore aggregation weights, so zero-weight dropout "
+            "would silently not exclude anyone"
+        )
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     counts = jnp.asarray(counts)
@@ -230,7 +261,7 @@ def make_fl_round(
     @jax.jit
     def round_fn(params, base_key, round_idx):
         round_key = jax.random.fold_in(base_key, round_idx)
-        sample_key, agg_key = jax.random.split(round_key)
+        sample_key, agg_key, drop_key = jax.random.split(round_key, 3)
         sel = sample_clients(sample_key, nr_clients, nr_shard)
         # entries beyond nr_sampled are shard padding: real clients that run
         # a local update but contribute weight 0 to the aggregate
@@ -262,6 +293,15 @@ def make_fl_round(
             )
 
         weights = jnp.where(live, cs.astype(jnp.float32), 0.0)
+        if dropout_rate:
+            survived = (
+                jax.random.uniform(drop_key, (nr_shard,)) >= dropout_rate
+            )
+            # all-dropped fallback: keep everyone rather than divide by zero
+            survived = jnp.where(
+                jnp.any(survived & live), survived, jnp.ones_like(survived)
+            )
+            weights = jnp.where(survived, weights, 0.0)
         weights = weights / jnp.sum(weights)
         aggregate = aggregator(updates, weights, agg_key)
         return apply_aggregate(params, aggregate)

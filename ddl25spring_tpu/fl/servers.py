@@ -48,6 +48,34 @@ class Server:
     def test(self) -> float:
         return float(self._evaluate(self.params))
 
+    def extra_state(self):
+        """Cross-round server state beyond ``params`` that a checkpoint must
+        carry for exact resume (e.g. FedOpt's optimizer moments).  The dict
+        doubles as the restore template; empty for stateless servers."""
+        return {}
+
+    def restore_extra_state(self, state) -> None:
+        if state:
+            raise ValueError(
+                f"{type(self).__name__} has no extra state to restore"
+            )
+
+
+def _make_weight_client_update(task: Task, lr: float, batch_size: int,
+                               nr_local_epochs: int,
+                               client_data: ClientDatasets,
+                               prox_mu: float = 0.0):
+    """Shared FedAvg-family construction: validate the padded client layout
+    against the batch size and build the E-local-epochs SGD client update."""
+    if client_data.max_samples % batch_size != 0:
+        raise ValueError(
+            "client_data must be stacked with pad_multiple=batch_size "
+            f"(max_samples={client_data.max_samples}, batch={batch_size})"
+        )
+    return make_local_sgd_update(
+        task.loss_fn, lr, batch_size, nr_local_epochs, prox_mu=prox_mu
+    )
+
 
 class CentralizedServer(Server):
     """Plain minibatch SGD on the pooled dataset; one round == one epoch
@@ -174,23 +202,26 @@ class FedSgdWeightServer(DecentralizedServer):
 class FedAvgServer(DecentralizedServer):
     """FedAvg: clients run E local epochs of minibatch SGD and return weights;
     the server installs the n_k-weighted average
-    (reference: hfl_complete.py:336-390)."""
+    (reference: hfl_complete.py:336-390).
+
+    Extensions beyond the reference:
+    - ``prox_mu > 0`` turns local training into FedProx (proximal term
+      against the round-start weights; Li et al., MLSys 2020);
+    - ``dropout_rate > 0`` simulates per-round client failures with
+      survivor renormalisation (see fl.engine.make_fl_round).
+    """
 
     def __init__(self, task: Task, lr: float, batch_size: int,
                  client_data: ClientDatasets, client_fraction: float,
                  nr_local_epochs: int, seed: int,
-                 aggregator=None, attack=None, malicious_mask=None, mesh=None):
+                 aggregator=None, attack=None, malicious_mask=None, mesh=None,
+                 prox_mu: float = 0.0, dropout_rate: float = 0.0):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
-        self.algorithm = "FedAvg"
+        self.algorithm = "FedAvg" if prox_mu == 0.0 else "FedProx"
         self.nr_local_epochs = nr_local_epochs
-        if client_data.max_samples % batch_size != 0:
-            raise ValueError(
-                "client_data must be stacked with pad_multiple=batch_size "
-                f"(max_samples={client_data.max_samples}, batch={batch_size})"
-            )
-        client_update = make_local_sgd_update(
-            task.loss_fn, lr, batch_size, nr_local_epochs
+        client_update = _make_weight_client_update(
+            task, lr, batch_size, nr_local_epochs, client_data, prox_mu
         )
         self.round_fn = make_fl_round(
             client_update,
@@ -198,5 +229,83 @@ class FedAvgServer(DecentralizedServer):
             self.nr_clients_per_round,
             aggregator=aggregator,
             attack=attack, malicious_mask=malicious_mask,
-            mesh=mesh,
+            mesh=mesh, dropout_rate=dropout_rate,
         )
+
+
+class FedOptServer(DecentralizedServer):
+    """FedOpt (Reddi et al., 2021): the round's n_k-weighted client average
+    is turned into a pseudo-gradient Δ = w_server − w_avg and fed to a
+    server-side optax optimizer — FedAvgM (SGD+momentum), FedAdam, FedYogi.
+    New capability beyond the reference, which only ever overwrites server
+    params with the average (hfl_complete.py:380-383); ``sgd`` with
+    ``server_lr=1.0`` reproduces exactly that.
+
+    The client phase is the same one jitted SPMD program as FedAvg; the
+    server step is a second tiny jit whose optimizer state lives on device
+    between rounds.
+    """
+
+    OPTIMIZERS = ("sgd", "avgm", "adam", "yogi")
+
+    def __init__(self, task: Task, lr: float, batch_size: int,
+                 client_data: ClientDatasets, client_fraction: float,
+                 nr_local_epochs: int, seed: int,
+                 server_optimizer: str = "adam", server_lr: float = 1e-2,
+                 aggregator=None, attack=None, malicious_mask=None, mesh=None,
+                 prox_mu: float = 0.0, dropout_rate: float = 0.0):
+        super().__init__(task, lr, batch_size, client_data, client_fraction,
+                         seed, mesh=mesh)
+        if server_optimizer not in self.OPTIMIZERS:
+            raise ValueError(
+                f"server_optimizer={server_optimizer!r} not in "
+                f"{self.OPTIMIZERS}"
+            )
+        import optax
+
+        self.algorithm = f"FedOpt-{server_optimizer}"
+        self.nr_local_epochs = nr_local_epochs
+        # eps here is the FedOpt paper's tau (adaptivity floor); the Adam
+        # default 1e-8 turns every coordinate update into +-server_lr, which
+        # destroys convergence at FL's round counts
+        opt = {
+            "sgd": lambda: optax.sgd(server_lr),
+            "avgm": lambda: optax.sgd(server_lr, momentum=0.9),
+            "adam": lambda: optax.adam(server_lr, eps=1e-3),
+            "yogi": lambda: optax.yogi(server_lr, eps=1e-3),
+        }[server_optimizer]()
+        self._opt_state = opt.init(self.params)
+
+        client_update = _make_weight_client_update(
+            task, lr, batch_size, nr_local_epochs, client_data, prox_mu
+        )
+        aggregate_fn = make_fl_round(
+            client_update,
+            client_data.x, client_data.y, client_data.counts,
+            self.nr_clients_per_round,
+            aggregator=aggregator,
+            apply_aggregate=lambda params, agg: agg,  # return w_avg itself
+            attack=attack, malicious_mask=malicious_mask,
+            mesh=mesh, dropout_rate=dropout_rate,
+        )
+
+        @jax.jit
+        def server_step(params, opt_state, w_avg):
+            delta = jax.tree.map(jnp.subtract, params, w_avg)
+            updates, opt_state = opt.update(delta, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        def round_fn(params, base_key, round_idx):
+            w_avg = aggregate_fn(params, base_key, round_idx)
+            params, self._opt_state = server_step(
+                params, self._opt_state, w_avg
+            )
+            return params
+
+        self.round_fn = round_fn
+
+    def extra_state(self):
+        return {"server_opt_state": self._opt_state}
+
+    def restore_extra_state(self, state) -> None:
+        self._opt_state = state["server_opt_state"]

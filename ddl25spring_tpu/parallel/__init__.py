@@ -8,6 +8,13 @@ from .pp import (
 )
 from .tp import llama_tp_shardings, apply_shardings
 from .ep import llama_moe_ep_shardings
+from .compress import (
+    init_compression_state,
+    make_compressed_dp_train_step,
+    quantize_int8,
+    topk_sparsify,
+)
+from .multihost import initialize_multihost, make_multihost_mesh
 from .sp import make_sp_forward, make_sp_train_step, sp_data_sharding
 from .pp_1f1b import make_1f1b_grad_fn, make_1f1b_train_step
 
@@ -29,4 +36,10 @@ __all__ = [
     "llama_tp_shardings",
     "llama_moe_ep_shardings",
     "apply_shardings",
+    "init_compression_state",
+    "make_compressed_dp_train_step",
+    "quantize_int8",
+    "topk_sparsify",
+    "initialize_multihost",
+    "make_multihost_mesh",
 ]

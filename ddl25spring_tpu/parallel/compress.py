@@ -1,0 +1,141 @@
+"""Communication-compressed data parallelism.
+
+The reference ships every full-precision gradient through its all-reduce
+(intro_DP_GA.py:55-63 flattens ALL grads into one fp32 vector before
+``all_reduce``); it has no compression of any kind.  This module adds the two
+standard gradient-compression families as drop-in DP trainers, both expressed
+as pure jit transforms so the whole round stays one SPMD program:
+
+- **top-k sparsification with error feedback** (Deep Gradient Compression,
+  Lin et al., ICLR 2018): each shard keeps only the largest-magnitude k
+  fraction of its gradient, accumulates what it dropped into a residual, and
+  adds the residual back next step — the residual makes compressed SGD track
+  uncompressed SGD instead of silently losing mass.
+- **int8 stochastic quantization** (QSGD-style, Alistarh et al., 2017):
+  per-tensor symmetric scale, stochastic rounding so the quantizer is
+  unbiased in expectation.
+
+A note on what "compression" means on a TPU mesh: the collective still moves
+dense arrays (XLA has no sparse all-reduce), so these trainers model the
+*algorithm* (what the update loses / how error feedback recovers it) rather
+than the wire format.  That is exactly what the correctness oracles need —
+and on real multi-host DCN the same transforms feed an 8-bit
+``psum`` by casting the quantized values, which IS a wire-format win.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def topk_sparsify(tree, ratio: float):
+    """Keep the largest-magnitude ``ratio`` fraction of entries per leaf
+    (at least 1), zero the rest.  Returns (sparse_tree, dropped_tree)."""
+    if not 0.0 < ratio <= 1.0:
+        raise ValueError(f"ratio must be in (0, 1], got {ratio}")
+
+    def one(leaf):
+        flat = leaf.reshape(-1)
+        k = max(1, int(ratio * flat.size))
+        thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
+        mask = (jnp.abs(flat) >= thresh).reshape(leaf.shape)
+        sparse = jnp.where(mask, leaf, 0)
+        return sparse, leaf - sparse
+
+    pairs = jax.tree.map(one, tree)
+    return (jax.tree.map(lambda p: p[0], pairs, is_leaf=lambda x: isinstance(x, tuple)),
+            jax.tree.map(lambda p: p[1], pairs, is_leaf=lambda x: isinstance(x, tuple)))
+
+
+def quantize_int8(tree, key):
+    """Stochastically round each leaf to int8 on a per-tensor symmetric
+    scale; returns the dequantized tree (unbiased: E[q(x)] == x)."""
+
+    def one(leaf, k):
+        scale = jnp.maximum(jnp.max(jnp.abs(leaf)), 1e-12) / 127.0
+        scaled = leaf / scale
+        low = jnp.floor(scaled)
+        p_up = scaled - low
+        up = jax.random.uniform(k, leaf.shape) < p_up
+        q = jnp.clip(low + up, -127, 127).astype(jnp.int8)
+        return q.astype(leaf.dtype) * scale
+
+    leaves, treedef = jax.tree.flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    return jax.tree.unflatten(
+        treedef, [one(l, k) for l, k in zip(leaves, keys)]
+    )
+
+
+def init_compression_state(params, mesh, axis: str = "data"):
+    """Zero error-feedback residual: one residual per shard, stored with an
+    explicit leading shard axis (leaf shape ``(W,) + param.shape``) and
+    sharded over ``axis`` — each device's slice is ITS residual.  The
+    leading axis makes the per-device divergence visible in the type
+    instead of hiding divergent buffers behind a fake replicated sharding,
+    so the residual survives checkpointing/host round-trips intact."""
+    from jax.sharding import NamedSharding
+
+    w = mesh.shape[axis]
+    return jax.tree.map(
+        lambda p: jax.device_put(
+            jnp.zeros((w,) + p.shape, p.dtype),
+            NamedSharding(mesh, P(axis)),
+        ),
+        params,
+    )
+
+
+def make_compressed_dp_train_step(
+    loss_fn,
+    optimizer,
+    mesh,
+    axis: str = "data",
+    method: str = "topk",
+    ratio: float = 0.01,
+):
+    """Build ``step(params, opt_state, residual, batch, key) ->
+    (params, opt_state, residual, loss)`` — DP gradient aggregation where
+    each shard compresses its gradient before the cross-device mean.
+
+    ``method='topk'``: top-``ratio`` sparsification + error-feedback
+    residual (init with :func:`init_compression_state`; pass the returned
+    residual back in each step).
+    ``method='int8'``: stochastic int8 quantization (unbiased, stateless —
+    the residual is threaded but unused so both methods share a signature).
+    """
+    if method not in ("topk", "int8"):
+        raise ValueError(f"unknown compression method {method!r}")
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis), P()),
+        out_specs=(P(), P(), P(axis), P()),
+        check_vma=False,
+    )
+    def spmd_step(params, opt_state, residual, batch, key):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        # decorrelate shards' stochastic rounding
+        key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        if method == "topk":
+            # residual leaves arrive as this shard's (1, ...) slice
+            grads = jax.tree.map(
+                lambda g, r: g + r[0], grads, residual
+            )
+            grads, dropped = topk_sparsify(grads, ratio)
+            residual = jax.tree.map(lambda d: d[None], dropped)
+        else:
+            grads = quantize_int8(grads, key)
+        grads = jax.lax.pmean(grads, axis)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, residual, jax.lax.pmean(loss, axis)
+
+    return jax.jit(spmd_step)

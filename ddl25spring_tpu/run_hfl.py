@@ -6,6 +6,11 @@
 reproduces the homework-1 experiment grid (lab/homework-1.ipynb cell 22) and
 prints the RunResult table; Byzantine attack/defense configs (the missing
 course part 3, SURVEY.md §2.2) plug in via --aggregator/--attack flags.
+
+Beyond the reference: ``--algorithm fedprox --prox-mu 0.1`` (proximal local
+SGD), ``--algorithm fedopt --server-optimizer adam|yogi|avgm`` (adaptive
+server optimizers over the round delta), and ``--dropout-rate`` (per-round
+client failure simulation with survivor renormalisation).
 """
 
 from __future__ import annotations
@@ -17,6 +22,7 @@ from .data import load_cifar10, load_mnist, split_dataset
 from .fl import (
     CentralizedServer,
     FedAvgServer,
+    FedOptServer,
     FedSgdGradientServer,
     FedSgdWeightServer,
 )
@@ -62,7 +68,7 @@ def build_server(cfg: HflConfig):
         return CentralizedServer(task, cfg.lr, cfg.batch_size, cfg.seed,
                                  train_x=ds.train_x, train_y=ds.train_y)
 
-    pad = cfg.batch_size if cfg.algorithm == "fedavg" else 1
+    pad = cfg.batch_size if cfg.algorithm in ("fedavg", "fedprox", "fedopt") else 1
     client_data = split_dataset(ds.train_x, ds.train_y, cfg.nr_clients,
                                 cfg.iid, cfg.seed, pad_multiple=pad)
 
@@ -98,10 +104,20 @@ def build_server(cfg: HflConfig):
     if cfg.algorithm == "fedsgd-weight":
         return FedSgdWeightServer(task, cfg.lr, client_data,
                                   cfg.client_fraction, cfg.seed, **kw)
-    if cfg.algorithm == "fedavg":
+    if cfg.algorithm in ("fedavg", "fedprox"):
+        prox_mu = cfg.prox_mu if cfg.algorithm == "fedprox" else 0.0
+        if cfg.algorithm == "fedprox" and prox_mu <= 0:
+            raise ValueError("fedprox needs --prox-mu > 0")
         return FedAvgServer(task, cfg.lr, cfg.batch_size, client_data,
                             cfg.client_fraction, cfg.nr_local_epochs,
-                            cfg.seed, **kw)
+                            cfg.seed, prox_mu=prox_mu,
+                            dropout_rate=cfg.dropout_rate, **kw)
+    if cfg.algorithm == "fedopt":
+        return FedOptServer(task, cfg.lr, cfg.batch_size, client_data,
+                            cfg.client_fraction, cfg.nr_local_epochs,
+                            cfg.seed, server_optimizer=cfg.server_optimizer,
+                            server_lr=cfg.server_lr, prox_mu=cfg.prox_mu,
+                            dropout_rate=cfg.dropout_rate, **kw)
     raise ValueError(f"unknown algorithm {cfg.algorithm!r}")
 
 
@@ -113,8 +129,17 @@ def run(cfg: HflConfig):
 
     start_round = 0
     if ckpt is not None and ckpt.latest_step() is not None:
-        restored = ckpt.restore({"params": server.params, "round": 0})
+        # "extra" (server optimizer state etc.) joins the template only when
+        # the server has some, so stateless servers keep reading checkpoints
+        # written before the field existed
+        template = {"params": server.params, "round": 0}
+        extra = server.extra_state()
+        if extra:
+            template["extra"] = extra
+        restored = ckpt.restore(template)
         server.params = restored["params"]
+        if extra:
+            server.restore_extra_state(restored["extra"])
         start_round = int(restored["round"])
 
     def on_round(r, result):
@@ -126,7 +151,11 @@ def run(cfg: HflConfig):
                        message_count=result.message_count[-1],
                        test_accuracy=result.test_accuracy[-1])
         if ckpt is not None and (r + 1) % cfg.checkpoint_every == 0:
-            ckpt.save(r + 1, {"params": server.params, "round": r + 1})
+            payload = {"params": server.params, "round": r + 1}
+            extra = server.extra_state()
+            if extra:
+                payload["extra"] = extra
+            ckpt.save(r + 1, payload)
 
     nr_remaining = max(0, cfg.nr_rounds - start_round)
     result = server.run(nr_remaining, start_round=start_round,

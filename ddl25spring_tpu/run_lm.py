@@ -74,6 +74,8 @@ def build_trainer(cfg: LmConfig):
     tokens0 = jnp.zeros((cfg.batch_size, cfg.seq_l), jnp.int32)
 
     if cfg.strategy == "ep":
+        from .models.moe import moe_aux_load
+
         moe_cfg = _dc.replace(mcfg, nr_experts=max(2, n))
         model = Llama(moe_cfg)
         params = model.init(jax.random.key(cfg.seed), tokens0)
@@ -82,7 +84,12 @@ def build_trainer(cfg: LmConfig):
                                  llama_moe_ep_shardings(mesh, params))
 
         def moe_loss(p, batch):
-            return causal_lm_loss(model.apply(p, batch), batch)
+            # Switch-style load balancing keeps the router from collapsing
+            # onto a few experts (which would idle the expert-sharded devices)
+            logits, inter = model.apply(p, batch,
+                                        mutable=["intermediates"])
+            return (causal_lm_loss(logits, batch)
+                    + cfg.moe_aux_weight * moe_aux_load(inter))
 
         @jax.jit
         def ep_step(params, opt_state, tokens):

@@ -17,13 +17,27 @@ import os
 def select_platform(platform: str | None = None) -> None:
     """Force the jax platform (``cpu`` / ``tpu`` / ...) if requested via
     argument or the ``DDL25_PLATFORM`` env var; no-op otherwise.  Must run
-    before any jax backend query (``jax.devices``, first op, ...)."""
-    platform = platform or os.environ.get("DDL25_PLATFORM")
-    if not platform:
-        return
+    before any jax backend query (``jax.devices``, first op, ...).
+
+    Also enables jax's persistent compilation cache (override the location
+    with ``DDL25_COMPILE_CACHE``; set it empty to disable) — big FL/LLM
+    programs can take minutes to compile, and remote-compile setups pay that
+    over the wire, so every entry point should reuse compiled executables
+    across process restarts."""
     import jax
 
-    try:
-        jax.config.update("jax_platforms", platform)
-    except RuntimeError:
-        pass  # backend already initialised; too late to switch
+    platform = platform or os.environ.get("DDL25_PLATFORM")
+    if platform:
+        try:
+            jax.config.update("jax_platforms", platform)
+        except RuntimeError:
+            pass  # backend already initialised; too late to switch
+
+    cache_dir = os.environ.get(
+        "DDL25_COMPILE_CACHE",
+        os.path.expanduser("~/.cache/ddl25spring_tpu_compile"),
+    )
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,60 @@
+"""Multi-host mesh helpers on the single-process virtual mesh.
+
+Real multi-process rendezvous needs multiple hosts; what IS testable here is
+the single-host degeneration contract: initialize_multihost must be a no-op
+without a coordinator config, and make_multihost_mesh must produce a mesh
+whose outer dcn axis is 1 so multi-host-shaped programs run unchanged — the
+same oracle style as the fake-mesh DP/PP tests (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddl25spring_tpu.parallel import (
+    initialize_multihost,
+    make_multihost_mesh,
+)
+
+
+def test_initialize_multihost_noop_without_config(monkeypatch):
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert initialize_multihost() is False
+
+
+def test_multihost_mesh_single_process_shape():
+    mesh = make_multihost_mesh({"data": 2, "model": 4})
+    assert mesh.axis_names == ("dcn", "data", "model")
+    assert mesh.shape == {"dcn": 1, "data": 2, "model": 4}
+
+
+def test_multihost_mesh_default_axes():
+    mesh = make_multihost_mesh()
+    assert mesh.axis_names == ("dcn", "data")
+    assert mesh.shape["dcn"] == 1
+    assert mesh.shape["data"] == len(jax.devices())
+
+
+def test_multihost_mesh_rejects_uneven_ici():
+    with pytest.raises(ValueError, match="ici axes"):
+        make_multihost_mesh({"data": 3})
+
+
+def test_dp_program_runs_on_multihost_layout():
+    """A psum-over-(dcn, data) gradient step — the multi-host DP shape —
+    must execute on the degenerate single-host mesh."""
+    mesh = make_multihost_mesh({"data": 8})
+    x = jax.device_put(
+        jnp.arange(32.0).reshape(32, 1),
+        NamedSharding(mesh, P(("dcn", "data"))),
+    )
+
+    @jax.jit
+    def mean_sq(x):
+        return jnp.mean(x ** 2)
+
+    out = mean_sq(x)
+    assert jnp.allclose(out, jnp.mean(jnp.arange(32.0) ** 2))
