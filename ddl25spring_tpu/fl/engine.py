@@ -208,6 +208,11 @@ def make_fl_round(
     updates, and the weighted-mean aggregation lowers to one all-reduce over
     ICI.  Without ``mesh`` the same program runs on one device.
     """
+    if not 0.0 <= dropout_rate <= 1.0:
+        raise ValueError(
+            f"dropout_rate={dropout_rate} outside [0, 1] — it is a per-round "
+            "failure probability, not a percentage"
+        )
     if dropout_rate and aggregator is not None:
         raise ValueError(
             "dropout_rate cannot combine with a custom aggregator: robust "

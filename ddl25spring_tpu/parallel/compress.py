@@ -99,6 +99,7 @@ def make_compressed_dp_train_step(
     axis: str = "data",
     method: str = "topk",
     ratio: float = 0.01,
+    donate: bool = False,
 ):
     """Build ``step(params, opt_state, residual, batch, key) ->
     (params, opt_state, residual, loss)`` — DP gradient aggregation where
@@ -138,4 +139,4 @@ def make_compressed_dp_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, residual, jax.lax.pmean(loss, axis)
 
-    return jax.jit(spmd_step)
+    return jax.jit(spmd_step, donate_argnums=(0, 1, 2) if donate else ())

@@ -34,12 +34,14 @@ def initialize_multihost(
     multi-process runtime was initialised, False for the single-host no-op.
 
     Arguments default to the standard env vars (``JAX_COORDINATOR_ADDRESS``,
-    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``); on managed TPU pods
-    (GKE/Cloud TPU VMs) all three are auto-detected by jax.distributed and
-    may be omitted entirely.  Single host without env vars: returns False
-    and leaves jax untouched, so every entry point can call this
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``).  With no config at all this
+    returns False and leaves jax untouched, so every entry point can call it
     unconditionally — the reference's MASTER_ADDR plumbing collapses into
-    one optional call.
+    one optional call.  A PARTIAL config raises: silently falling back to
+    single-host would make N processes train independently (duplicated
+    work, divergent params) with no error in sight.  On managed TPU pods
+    (GKE/Cloud TPU VMs), where jax auto-detects the topology, call
+    ``jax.distributed.initialize()`` directly instead.
     """
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
@@ -51,8 +53,21 @@ def initialize_multihost(
     if process_id is None and pid_str:
         process_id = int(pid_str)
 
-    if coordinator_address is None and num_processes is None:
+    provided = {
+        "coordinator_address": coordinator_address,
+        "num_processes": num_processes,
+        "process_id": process_id,
+    }
+    missing = [name for name, v in provided.items() if v is None]
+    if len(missing) == 3:
         return False  # single host; nothing to rendezvous
+    if missing:
+        raise ValueError(
+            f"partial multi-host config: {missing} unset while "
+            f"{[n for n in provided if n not in missing]} set — refusing to "
+            "fall back to single-host (N processes would train "
+            "independently); set all three or none"
+        )
 
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

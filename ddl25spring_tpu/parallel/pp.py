@@ -171,19 +171,21 @@ def make_pp_train_step(
     nr_microbatches: int,
     stage_axis: str = "stage",
     data_axis: str | None = None,
+    donate: bool = False,
 ):
     """Jitted ``step(pp_params, opt_state, tokens) -> (params, state, loss)``
     with stage-sharded block params (and optionally data-sharded batch =
-    hybrid DP x PP)."""
+    hybrid DP x PP).  ``donate=True`` reuses the params/opt-state buffers
+    for the outputs (halves their HBM footprint) — callers must not touch
+    the donated inputs afterwards, so it stays opt-in."""
     loss_fn = make_pp_loss_fn(
         config, mesh, nr_stages, nr_microbatches, stage_axis, data_axis
     )
 
-    @jax.jit
     def step(pp_params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(pp_params, tokens)
         updates, opt_state = optimizer.update(grads, opt_state, pp_params)
         pp_params = optax.apply_updates(pp_params, updates)
         return pp_params, opt_state, loss
 
-    return step
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

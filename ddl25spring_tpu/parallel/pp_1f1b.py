@@ -224,6 +224,7 @@ def make_1f1b_train_step(
     nr_microbatches: int,
     stage_axis: str = "stage",
     data_axis: str | None = None,
+    donate: bool = False,
 ):
     """Jitted ``step(pp_params, opt_state, tokens)`` using the 1F1B schedule
     (drop-in for ``pp.make_pp_train_step``, hybrid DP x PP included)."""
@@ -231,11 +232,10 @@ def make_1f1b_train_step(
         config, mesh, nr_stages, nr_microbatches, stage_axis, data_axis
     )
 
-    @jax.jit
     def step(pp_params, opt_state, tokens):
         grads, loss = grad_fn(pp_params, tokens)
         updates, opt_state = optimizer.update(grads, opt_state, pp_params)
         pp_params = optax.apply_updates(pp_params, updates)
         return pp_params, opt_state, loss
 
-    return step
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

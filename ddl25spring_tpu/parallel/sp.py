@@ -55,7 +55,8 @@ def make_sp_forward(config: LlamaConfig, mesh, seq_axis: str = "seq",
 
 
 def make_sp_train_step(config: LlamaConfig, mesh, optimizer,
-                       seq_axis: str = "seq", data_axis: str | None = None):
+                       seq_axis: str = "seq", data_axis: str | None = None,
+                       donate: bool = False):
     """Jitted ``step(params, opt_state, tokens) -> (params, opt_state, loss)``
     training over sequence-sharded activations (optionally batch-sharded too:
     hybrid DP x SP).  The causal next-token shift in the loss crosses shard
@@ -66,13 +67,12 @@ def make_sp_train_step(config: LlamaConfig, mesh, optimizer,
     def loss_fn(params, tokens):
         return causal_lm_loss(forward(params, tokens), tokens)
 
-    @jax.jit
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    return step
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
 def sp_data_sharding(mesh, seq_axis: str = "seq",

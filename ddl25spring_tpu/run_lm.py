@@ -16,6 +16,7 @@ N OS processes over gloo.
 from __future__ import annotations
 
 import time
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -60,6 +61,20 @@ def _largest_divisor(value: int, limit: int) -> int:
     return d
 
 
+def _donated_local_step(loss_fn, optimizer):
+    """Shared replicated-params training step (donated buffers): used by the
+    single, tp, and ep strategies, whose sharding lives entirely in the
+    params/batch layout rather than the step body."""
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
 def build_trainer(cfg: LmConfig):
     """Return (step_fn, params, opt_state, batch_shard_fn) for the chosen
     strategy.  ``step(params, opt_state, tokens) -> (params, opt_state,
@@ -91,13 +106,8 @@ def build_trainer(cfg: LmConfig):
             return (causal_lm_loss(logits, batch)
                     + cfg.moe_aux_weight * moe_aux_load(inter))
 
-        @jax.jit
-        def ep_step(params, opt_state, tokens):
-            loss, grads = jax.value_and_grad(moe_loss)(params, tokens)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
-        return ep_step, params, optimizer.init(params), lambda x: x
+        step = _donated_local_step(moe_loss, optimizer)
+        return step, params, optimizer.init(params), lambda x: x
 
     model = Llama(mcfg)
     params = model.init(jax.random.key(cfg.seed), tokens0)
@@ -108,12 +118,7 @@ def build_trainer(cfg: LmConfig):
     identity = lambda x: x
 
     if cfg.strategy == "single":
-        @jax.jit
-        def step(params, opt_state, tokens):
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
+        step = _donated_local_step(loss_fn, optimizer)
         return step, params, optimizer.init(params), identity
 
     if cfg.strategy in ("dp", "dp-weight"):
@@ -121,7 +126,7 @@ def build_trainer(cfg: LmConfig):
         mesh = make_mesh({"data": data}, devices=devices[:data])
         step = make_dp_train_step(
             loss_fn, optimizer, mesh,
-            mode="grad" if cfg.strategy == "dp" else "weight",
+            mode="grad" if cfg.strategy == "dp" else "weight", donate=True,
         )
         shard = lambda x: jax.device_put(x, dp_data_sharding(mesh))
         return step, params, optimizer.init(params), shard
@@ -147,7 +152,7 @@ def build_trainer(cfg: LmConfig):
             else make_pp_train_step
         step = maker(mcfg, mesh, optimizer, nr_stages=stages,
                      nr_microbatches=cfg.nr_microbatches,
-                     data_axis="data" if dp > 1 else None)
+                     data_axis="data" if dp > 1 else None, donate=True)
         return step, pp_params, optimizer.init(pp_params), identity
 
     if cfg.strategy == "tp":
@@ -156,20 +161,14 @@ def build_trainer(cfg: LmConfig):
         mesh = make_mesh({"data": data, "model": tp},
                          devices=devices[: data * tp])
         params = apply_shardings(params, llama_tp_shardings(mesh, params))
-
-        @jax.jit
-        def step(params, opt_state, tokens):
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
+        step = _donated_local_step(loss_fn, optimizer)
         shard = lambda x: jax.device_put(x, dp_data_sharding(mesh))
         return step, params, optimizer.init(params), shard
 
     if cfg.strategy == "sp":
         seq = _largest_divisor(cfg.seq_l, n)
         mesh = make_mesh({"seq": seq}, devices=devices[:seq])
-        step = make_sp_train_step(mcfg, mesh, optimizer)
+        step = make_sp_train_step(mcfg, mesh, optimizer, donate=True)
         shard = lambda x: jax.device_put(x, sp_data_sharding(mesh))
         return step, params, optimizer.init(params), shard
 

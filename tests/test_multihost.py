@@ -25,6 +25,17 @@ def test_initialize_multihost_noop_without_config(monkeypatch):
     assert initialize_multihost() is False
 
 
+def test_initialize_multihost_partial_config_raises(monkeypatch):
+    """A typo'd coordinator var with a per-host process id set must fail
+    loudly, not let N processes silently train as independent single
+    hosts."""
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    with pytest.raises(ValueError, match="partial multi-host config"):
+        initialize_multihost()
+
+
 def test_multihost_mesh_single_process_shape():
     mesh = make_multihost_mesh({"data": 2, "model": 4})
     assert mesh.axis_names == ("dcn", "data", "model")
