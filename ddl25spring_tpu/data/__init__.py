@@ -12,6 +12,7 @@ from .text import (
     SyntheticStories,
     load_stories,
 )
+from .bpe import BpeTokenizer
 from .heart import (
     load_heart_df,
     load_heart_classification,
@@ -32,6 +33,7 @@ __all__ = [
     "ImageDataset",
     "load_cifar10",
     "ByteTokenizer",
+    "BpeTokenizer",
     "TokenStream",
     "SyntheticStories",
     "load_stories",

@@ -1,0 +1,235 @@
+"""Trainable byte-level BPE tokenizer.
+
+The reference tokenizes through ``simplellm.tokenizers.SPTokenizer`` — a
+pretrained SentencePiece model exposing ``vocab_size`` and ``pad_id``
+(lab/tutorial_1b/primer/intro.py:15-18).  A pretrained model file cannot be
+assumed in a zero-egress build, so this is the self-contained equivalent: a
+byte-level BPE you *train* on your corpus (e.g. the synthetic TinyStories
+stream) and then use exactly like the reference's tokenizer.  Byte fallback
+means no unknown-token id is ever needed.
+
+Algorithm (standard BPE, Sennrich et al. 2016, byte-level variant):
+
+- words are whitespace-delimited; each word carries its preceding space as a
+  leading byte (GPT-2 style), so decode is exact concatenation;
+- training counts adjacent symbol pairs across the corpus word multiset and
+  greedily merges the most frequent pair until ``vocab_size`` is reached;
+  ties break on the lexicographically smallest (left, right) id pair so
+  training is deterministic — the C++ twin (native/src/bpe.cpp) implements
+  the identical rule and the equivalence test pins them together;
+- encoding applies learned merges in rank order within each word.
+
+Ids: 0=pad, 1=bos, 2=eos, 3..258 = bytes 0..255, 259+ = merges (the same
+layout as data.text.ByteTokenizer, which this is a strict superset of).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+NR_SPECIALS = 3
+PAD_ID, BOS_ID, EOS_ID = 0, 1, 2
+BYTE_OFFSET = NR_SPECIALS  # byte b -> id b + BYTE_OFFSET
+BASE_VOCAB = NR_SPECIALS + 256
+
+
+def _words(text: bytes) -> list[bytes]:
+    """Split into words, each keeping its preceding whitespace bytes —
+    decode is then the exact concatenation of word bytes."""
+    words = []
+    current = bytearray()
+    seen_non_space = False
+    for b in text:
+        is_space = b in (0x20, 0x09, 0x0A, 0x0D)
+        if is_space and seen_non_space:
+            words.append(bytes(current))
+            current = bytearray()
+            seen_non_space = False
+        current.append(b)
+        if not is_space:
+            seen_non_space = True
+    if current:
+        words.append(bytes(current))
+    return words
+
+
+class BpeTokenizer:
+    """Byte-level BPE with the reference tokenizer's API surface
+    (``vocab_size``, ``pad_id``, plus bos/eos ids and encode/decode)."""
+
+    def __init__(self, merges: list[tuple[int, int]]):
+        self.merges = list(merges)
+        self._rank = {pair: i for i, pair in enumerate(self.merges)}
+        self._native_merges = None  # lazily-cached array for native encode
+        # id -> byte expansion, for O(1) decode
+        self._expansion = [b""] * NR_SPECIALS + [
+            bytes([b]) for b in range(256)
+        ]
+        for left, right in self.merges:
+            self._expansion.append(
+                self._expansion[left] + self._expansion[right]
+            )
+
+    # -- training ----------------------------------------------------------
+
+    @classmethod
+    def train(cls, corpus: str | bytes, vocab_size: int,
+              native: bool | None = None) -> "BpeTokenizer":
+        """Learn ``vocab_size - 259`` merges from ``corpus``.
+
+        ``native=None`` auto-selects the C++ trainer when it builds (the
+        two are merge-identical, tests/test_bpe.py); ``True`` forces native
+        (raises if unavailable); ``False`` forces pure Python."""
+        if vocab_size < BASE_VOCAB:
+            raise ValueError(
+                f"vocab_size must be >= {BASE_VOCAB} (specials + bytes), "
+                f"got {vocab_size}"
+            )
+        data = corpus.encode("utf-8") if isinstance(corpus, str) else corpus
+        if native is not False:
+            try:
+                from ..native import bpe_native_available, bpe_train
+
+                if native or bpe_native_available():
+                    return cls([tuple(m) for m in
+                                bpe_train(data, vocab_size).tolist()])
+            except ImportError:
+                if native:
+                    raise
+        word_counts = Counter(_words(data))
+        words = [
+            ([b + BYTE_OFFSET for b in word], count)
+            for word, count in word_counts.items()
+        ]
+        # incremental pair bookkeeping: recounting the whole corpus per merge
+        # would be O(num_merges x corpus); instead only words containing the
+        # merged pair are touched (their old pair multiset is subtracted and
+        # the post-merge one added — exact, so the learned merges are
+        # identical to a full recount, which the C++ twin also guarantees)
+        pair_counts: Counter = Counter()
+        pair_words: dict[tuple[int, int], list[int]] = {}
+
+        def count_word(symbols, count, wi, sign):
+            for pair in zip(symbols, symbols[1:]):
+                pair_counts[pair] += sign * count
+                if sign > 0:
+                    pair_words.setdefault(pair, []).append(wi)
+
+        for wi, (symbols, count) in enumerate(words):
+            count_word(symbols, count, wi, +1)
+
+        merges: list[tuple[int, int]] = []
+        next_id = BASE_VOCAB
+        while next_id < vocab_size and pair_counts:
+            best_count = max(pair_counts.values())
+            if best_count < 2:
+                break  # nothing left worth merging
+            best = min(p for p, c in pair_counts.items() if c == best_count)
+            merges.append(best)
+            # pair_words may hold stale entries (word no longer contains the
+            # pair); for those old == new and the delta cancels to zero
+            for wi in pair_words.pop(best, ()):
+                symbols, count = words[wi]
+                merged = _merge_word(symbols, best, next_id)
+                if len(merged) == len(symbols):
+                    continue
+                count_word(symbols, count, wi, -1)
+                count_word(merged, count, wi, +1)
+                words[wi] = (merged, count)
+            for pair in [p for p, c in pair_counts.items() if c <= 0]:
+                del pair_counts[pair]
+                pair_words.pop(pair, None)
+            next_id += 1
+        return cls(merges)
+
+    # -- encode / decode ---------------------------------------------------
+
+    @property
+    def vocab_size(self) -> int:
+        return BASE_VOCAB + len(self.merges)
+
+    pad_id = PAD_ID
+    bos_id = BOS_ID
+    eos_id = EOS_ID
+
+    def encode(self, text: str, bos: bool = True, eos: bool = True,
+               native: bool | None = None) -> list[int]:
+        """Ids for ``text``; like train(), auto-selects the C++ encoder when
+        it builds (id-identical to the Python path, tests/test_bpe.py)."""
+        data = text.encode("utf-8")
+        if native is not False:
+            try:
+                from ..native import bpe_encode, bpe_native_available
+
+                if native or bpe_native_available():
+                    if self._native_merges is None:
+                        import numpy as np
+
+                        self._native_merges = np.asarray(
+                            self.merges, dtype=np.int32
+                        ).reshape(-1, 2)
+                    return bpe_encode(
+                        self._native_merges, data, bos, eos
+                    ).tolist()
+            except ImportError:
+                if native:
+                    raise
+        ids = [BOS_ID] if bos else []
+        for word in _words(data):
+            symbols = [b + BYTE_OFFSET for b in word]
+            while len(symbols) > 1:
+                ranked = [
+                    (self._rank[p], i)
+                    for i, p in enumerate(zip(symbols, symbols[1:]))
+                    if p in self._rank
+                ]
+                if not ranked:
+                    break
+                rank, i = min(ranked)
+                pair = self.merges[rank]
+                symbols = _merge_word(symbols, pair, BASE_VOCAB + rank)
+            ids.extend(symbols)
+        if eos:
+            ids.append(EOS_ID)
+        return ids
+
+    def decode(self, ids) -> str:
+        out = bytearray()
+        for i in ids:
+            i = int(i)
+            if 0 <= i < len(self._expansion):
+                out.extend(self._expansion[i])
+        return out.decode("utf-8", errors="replace")
+
+    # -- (de)serialisation -------------------------------------------------
+
+    def save(self, path) -> None:
+        with open(path, "w") as f:
+            for left, right in self.merges:
+                f.write(f"{left} {right}\n")
+
+    @classmethod
+    def load(cls, path) -> "BpeTokenizer":
+        merges = []
+        with open(path) as f:
+            for line in f:
+                left, right = line.split()
+                merges.append((int(left), int(right)))
+        return cls(merges)
+
+
+def _merge_word(symbols: list[int], pair: tuple[int, int],
+                new_id: int) -> list[int]:
+    """Replace every non-overlapping occurrence of ``pair`` (left-to-right)
+    with ``new_id``."""
+    out = []
+    i = 0
+    while i < len(symbols):
+        if (i + 1 < len(symbols)
+                and symbols[i] == pair[0] and symbols[i + 1] == pair[1]):
+            out.append(new_id)
+            i += 2
+        else:
+            out.append(symbols[i])
+            i += 1
+    return out

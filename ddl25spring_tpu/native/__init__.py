@@ -16,29 +16,46 @@ import numpy as np
 
 _SRC = Path(__file__).parent / "src" / "tokenstream.cpp"
 _LIB = Path(__file__).parent / "_tokenstream.so"
+_BPE_SRC = Path(__file__).parent / "src" / "bpe.cpp"
+_BPE_LIB = Path(__file__).parent / "_bpe.so"
 _lock = threading.Lock()
 _lib = None
 _load_failed = False  # sticky: one failed build/load is not retried
 _build_error: str | None = None
+_bpe_lib = None
+_bpe_load_failed = False
+_bpe_build_error: str | None = None
+# id layout base: 3 specials + 256 bytes; must match data/bpe.py BASE_VOCAB
+# and src/bpe.cpp kBaseVocab
+BPE_BASE_VOCAB = 259
 
 
-def _build() -> bool:
-    global _build_error
+def _compile(src: Path, lib: Path) -> str | None:
+    """g++ ``src`` into shared lib ``lib`` unless already fresh; returns an
+    error string on failure, None on success."""
     try:
-        if _LIB.exists() and _LIB.stat().st_mtime > _SRC.stat().st_mtime:
-            return True
+        if lib.exists() and lib.stat().st_mtime > src.stat().st_mtime:
+            return None
     except OSError:
         pass  # e.g. source missing; fall through to (re)build attempt
     try:
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-             str(_SRC), "-o", str(_LIB)],
+             str(src), "-o", str(lib)],
             check=True, capture_output=True, text=True, timeout=120,
         )
-        return True
+        return None
     except (OSError, subprocess.SubprocessError) as e:
-        _build_error = getattr(e, "stderr", None) or str(e)
+        return getattr(e, "stderr", None) or str(e)
+
+
+def _build() -> bool:
+    global _build_error
+    err = _compile(_SRC, _LIB)
+    if err is not None:
+        _build_error = err
         return False
+    return True
 
 
 def _load():
@@ -151,3 +168,83 @@ class NativeTokenStream:
         if getattr(self, "_h", None) and self._lib is not None:
             self._lib.ddl_stream_free(self._h)
             self._h = None
+
+
+# ---------------------------------------------------------------------------
+# BPE tokenizer (native trainer + encoder; see src/bpe.cpp and the pure-
+# Python twin in data/bpe.py — the equivalence test pins them together)
+# ---------------------------------------------------------------------------
+
+
+def _load_bpe():
+    global _bpe_lib, _bpe_load_failed, _bpe_build_error
+    with _lock:
+        if _bpe_lib is not None:
+            return _bpe_lib
+        if _bpe_load_failed:
+            return None
+        err = _compile(_BPE_SRC, _BPE_LIB)
+        if err is not None:
+            _bpe_build_error = err
+            _bpe_load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(_BPE_LIB))
+        except OSError as e:
+            _bpe_build_error = str(e)
+            _bpe_load_failed = True
+            return None
+        lib.ddl_bpe_train.restype = ctypes.c_long
+        lib.ddl_bpe_train.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ddl_bpe_encode.restype = ctypes.c_long
+        lib.ddl_bpe_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+        ]
+        _bpe_lib = lib
+        return lib
+
+
+def bpe_native_available() -> bool:
+    return _load_bpe() is not None
+
+
+def bpe_build_error() -> str | None:
+    return _bpe_build_error
+
+
+def bpe_train(corpus: bytes, vocab_size: int) -> np.ndarray:
+    """Native BPE training; returns the learned merges as an (N, 2) int32
+    array (N <= vocab_size - BPE_BASE_VOCAB)."""
+    lib = _load_bpe()
+    if lib is None:
+        raise RuntimeError(f"native bpe unavailable: {_bpe_build_error}")
+    capacity = max(0, vocab_size - BPE_BASE_VOCAB)
+    out = np.empty((capacity, 2), dtype=np.int32)
+    n = lib.ddl_bpe_train(
+        corpus, len(corpus), vocab_size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out[:n].copy()
+
+
+def bpe_encode(merges: np.ndarray, text: bytes, bos: bool = True,
+               eos: bool = True) -> np.ndarray:
+    """Native BPE encode with ``merges`` from :func:`bpe_train` (or the
+    Python trainer — the two are id-identical)."""
+    lib = _load_bpe()
+    if lib is None:
+        raise RuntimeError(f"native bpe unavailable: {_bpe_build_error}")
+    merges = np.ascontiguousarray(merges, dtype=np.int32)
+    out = np.empty(len(text) + 2, dtype=np.int32)
+    n = lib.ddl_bpe_encode(
+        merges.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(merges), text, len(text),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        int(bos), int(eos),
+    )
+    return out[:n]

@@ -1,0 +1,177 @@
+// Byte-level BPE trainer + encoder (C ABI, loaded via ctypes).
+//
+// Exact twin of the pure-Python ddl25spring_tpu/data/bpe.py — same word
+// splitting (words carry their preceding whitespace), same training rule
+// (most frequent adjacent pair; ties -> lexicographically smallest
+// (left, right) id pair; stop below count 2), same encode (repeatedly apply
+// the lowest-rank applicable merge, leftmost first).  The Python/C++
+// equivalence test pins the two implementations to identical ids, which is
+// what lets the Python fallback substitute transparently when no compiler
+// is available.
+//
+// Id layout: 0=pad, 1=bos, 2=eos, 3..258 = bytes, 259+ = merges.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace {
+
+constexpr int kByteOffset = 3;
+constexpr int kBaseVocab = 259;
+
+inline bool is_space(unsigned char b) {
+  return b == 0x20 || b == 0x09 || b == 0x0A || b == 0x0D;
+}
+
+// Split into words, each keeping its preceding whitespace bytes.
+std::vector<std::vector<int32_t>> split_words(const unsigned char* data,
+                                              long n) {
+  std::vector<std::vector<int32_t>> words;
+  std::vector<int32_t> current;
+  bool seen_non_space = false;
+  for (long i = 0; i < n; ++i) {
+    unsigned char b = data[i];
+    if (is_space(b) && seen_non_space) {
+      words.push_back(current);
+      current.clear();
+      seen_non_space = false;
+    }
+    current.push_back(int32_t(b) + kByteOffset);
+    if (!is_space(b)) seen_non_space = true;
+  }
+  if (!current.empty()) words.push_back(current);
+  return words;
+}
+
+void merge_word(std::vector<int32_t>& symbols, int32_t left, int32_t right,
+                int32_t new_id) {
+  size_t out = 0, i = 0;
+  while (i < symbols.size()) {
+    if (i + 1 < symbols.size() && symbols[i] == left &&
+        symbols[i + 1] == right) {
+      symbols[out++] = new_id;
+      i += 2;
+    } else {
+      symbols[out++] = symbols[i++];
+    }
+  }
+  symbols.resize(out);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Learn up to (vocab_size - 259) merges from data[0..n); writes pairs as
+// (left, right) into out_merges (capacity 2 * (vocab_size - 259)).
+// Returns the number of merges learned.
+long ddl_bpe_train(const char* data, long n, int vocab_size,
+                   int32_t* out_merges) {
+  auto raw = split_words(reinterpret_cast<const unsigned char*>(data), n);
+  // collapse identical words into (symbols, count)
+  std::map<std::vector<int32_t>, long> word_counts;
+  for (auto& w : raw) word_counts[w] += 1;
+  std::vector<std::pair<std::vector<int32_t>, long>> words(
+      word_counts.begin(), word_counts.end());
+
+  // incremental pair bookkeeping (mirrors data/bpe.py exactly): per merge,
+  // only the words containing the merged pair have their old pair multiset
+  // subtracted and post-merge multiset added — counts stay exact, so the
+  // learned merges equal a full per-iteration recount.
+  using Pair = std::pair<int32_t, int32_t>;
+  std::map<Pair, long> pair_counts;  // ordered: ascending-key iteration
+  std::unordered_map<int64_t, std::vector<int>> pair_words;
+  auto key_of = [](const Pair& p) {
+    return (int64_t(p.first) << 32) | uint32_t(p.second);
+  };
+  auto count_word = [&](const std::vector<int32_t>& symbols, long count,
+                        int wi, int sign) {
+    for (size_t i = 0; i + 1 < symbols.size(); ++i) {
+      Pair p{symbols[i], symbols[i + 1]};
+      pair_counts[p] += sign * count;
+      if (sign > 0) pair_words[key_of(p)].push_back(wi);
+    }
+  };
+  for (size_t wi = 0; wi < words.size(); ++wi)
+    count_word(words[wi].first, words[wi].second, int(wi), +1);
+
+  long nr_merges = 0;
+  for (int next_id = kBaseVocab;
+       next_id < vocab_size && !pair_counts.empty(); ++next_id) {
+    // max count; ties -> smallest (left, right) — ascending iteration with
+    // strict > keeps the first (smallest) maximum
+    Pair best{0, 0};
+    long best_count = 0;
+    for (auto& [pair, count] : pair_counts)
+      if (count > best_count) {
+        best_count = count;
+        best = pair;
+      }
+    if (best_count < 2) break;
+    out_merges[2 * nr_merges] = best.first;
+    out_merges[2 * nr_merges + 1] = best.second;
+    ++nr_merges;
+    auto it = pair_words.find(key_of(best));
+    if (it != pair_words.end()) {
+      std::vector<int> touched = std::move(it->second);
+      pair_words.erase(it);
+      for (int wi : touched) {  // stale entries merge to a no-op
+        auto& [symbols, count] = words[wi];
+        std::vector<int32_t> merged = symbols;
+        merge_word(merged, best.first, best.second, next_id);
+        if (merged.size() == symbols.size()) continue;
+        count_word(symbols, count, wi, -1);
+        count_word(merged, count, wi, +1);
+        symbols = std::move(merged);
+      }
+    }
+    for (auto pc = pair_counts.begin(); pc != pair_counts.end();) {
+      if (pc->second <= 0) {
+        pair_words.erase(key_of(pc->first));
+        pc = pair_counts.erase(pc);
+      } else {
+        ++pc;
+      }
+    }
+  }
+  return nr_merges;
+}
+
+// Encode text[0..n) with nr_merges learned pairs; writes ids to out
+// (capacity n + 2) and returns the id count.
+long ddl_bpe_encode(const int32_t* merges, int nr_merges, const char* text,
+                    long n, int32_t* out, int bos, int eos) {
+  std::unordered_map<int64_t, int> rank;
+  rank.reserve(size_t(nr_merges) * 2);
+  for (int r = 0; r < nr_merges; ++r) {
+    int64_t key = (int64_t(merges[2 * r]) << 32) |
+                  uint32_t(merges[2 * r + 1]);
+    rank.emplace(key, r);
+  }
+  long m = 0;
+  if (bos) out[m++] = 1;
+  auto words = split_words(reinterpret_cast<const unsigned char*>(text), n);
+  for (auto& symbols : words) {
+    while (symbols.size() > 1) {
+      int best_rank = nr_merges;
+      for (size_t i = 0; i + 1 < symbols.size(); ++i) {
+        int64_t key = (int64_t(symbols[i]) << 32) | uint32_t(symbols[i + 1]);
+        auto it = rank.find(key);
+        if (it != rank.end() && it->second < best_rank)
+          best_rank = it->second;  // lowest rank; leftmost via merge_word
+      }
+      if (best_rank == nr_merges) break;
+      merge_word(symbols, merges[2 * best_rank], merges[2 * best_rank + 1],
+                 kBaseVocab + best_rank);
+    }
+    for (int32_t s : symbols) out[m++] = s;
+  }
+  if (eos) out[m++] = 2;
+  return m;
+}
+
+}  // extern "C"

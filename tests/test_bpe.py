@@ -1,0 +1,94 @@
+"""BPE tokenizer oracles: round-trip, compression, determinism, and exact
+Python ≡ C++ equivalence (the same oracle style that pins the native token
+stream to its Python twin, SURVEY.md §4)."""
+
+import pytest
+
+from ddl25spring_tpu.data.bpe import BASE_VOCAB, BpeTokenizer
+from ddl25spring_tpu.native import (
+    bpe_build_error,
+    bpe_encode,
+    bpe_native_available,
+    bpe_train,
+)
+
+CORPUS = (
+    "once upon a time there was a little robot. the little robot liked "
+    "to read stories. once upon a time, said the robot, there was a "
+    "little reader who liked robots. the stories were little and the "
+    "time was little but the robot read on and on. "
+) * 4
+
+
+@pytest.fixture(scope="module")
+def tok():
+    # pin the pure-Python trainer: these tests specify ITS behavior, and
+    # the native trainer is separately pinned to it in the equivalence test
+    return BpeTokenizer.train(CORPUS, vocab_size=BASE_VOCAB + 64,
+                              native=False)
+
+
+def test_bpe_learns_merges_and_compresses(tok):
+    assert tok.vocab_size > BASE_VOCAB
+    text = "the little robot read stories"
+    ids = tok.encode(text, bos=False, eos=False)
+    assert len(ids) < len(text.encode())  # merges actually fire
+    assert any(i >= BASE_VOCAB for i in ids)
+
+
+def test_bpe_roundtrip(tok):
+    for text in (
+        "once upon a time",
+        "completely unseen words zyx!",
+        "  leading and   multiple   spaces ",
+        "unicode: héllo wörld 🤖",
+    ):
+        ids = tok.encode(text)
+        assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+        assert tok.decode(ids) == text
+
+
+def test_bpe_deterministic():
+    a = BpeTokenizer.train(CORPUS, vocab_size=BASE_VOCAB + 32, native=False)
+    # second run through whichever path auto-select picks: same merges
+    b = BpeTokenizer.train(CORPUS, vocab_size=BASE_VOCAB + 32)
+    assert a.merges == b.merges
+
+
+def test_bpe_save_load(tok, tmp_path):
+    path = tmp_path / "merges.txt"
+    tok.save(path)
+    loaded = BpeTokenizer.load(path)
+    assert loaded.merges == tok.merges
+    text = "the robot read"
+    assert loaded.encode(text) == tok.encode(text)
+
+
+def test_bpe_vocab_too_small_raises():
+    with pytest.raises(ValueError, match="vocab_size"):
+        BpeTokenizer.train(CORPUS, vocab_size=100)
+
+
+def test_bpe_empty_and_degenerate():
+    tok = BpeTokenizer.train("aa bb aa", vocab_size=BASE_VOCAB + 8,
+                             native=False)
+    assert tok.decode(tok.encode("")) == ""
+    assert tok.encode("", bos=False, eos=False) == []
+
+
+def test_native_bpe_matches_python():
+    if not bpe_native_available():
+        pytest.skip(f"no native bpe: {bpe_build_error()}")
+    vocab = BASE_VOCAB + 48
+    py = BpeTokenizer.train(CORPUS, vocab_size=vocab, native=False)
+    native_merges = bpe_train(CORPUS.encode(), vocab)
+    assert [tuple(m) for m in native_merges.tolist()] == py.merges
+
+    for text in (
+        "the little robot read stories",
+        "unseen zyx words",
+        "once upon a time there was",
+        "unicode: héllo 🤖",
+    ):
+        ids_native = bpe_encode(native_merges, text.encode()).tolist()
+        assert ids_native == py.encode(text)
