@@ -59,6 +59,9 @@ class LmConfig:
     nr_iters: int = 100
     nr_microbatches: int = 3   # intro_PP_1F1B_MB.py microbatch count
     moe_aux_weight: float = 0.01  # ep: load-balancing aux loss weight
+    tokenizer: str = "byte"    # byte | bpe (SentencePiece-equivalent)
+    bpe_vocab_size: int = 1024  # bpe: target vocab (specials+bytes+merges)
+    bpe_train_stories: int = 500  # bpe: corpus prefix used for training
     seed: int = 0
 
 

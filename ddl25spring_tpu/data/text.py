@@ -186,14 +186,19 @@ class TokenStream:
 
 
 def token_stream(batch_size: int, seq_l: int, skip: int = 0, seed: int = 0,
-                 stories=None, native: bool | None = None):
+                 stories=None, native: bool | None = None, tokenizer=None):
     """Build the fastest available token stream (C++ packer when the native
     lib builds, pure Python otherwise).  ``native=None`` auto-selects;
     ``True`` forces native (raises if unavailable); ``False`` forces Python.
-    Both produce bit-identical batches (tests/test_native.py)."""
+    Both produce bit-identical batches (tests/test_native.py).
+
+    ``tokenizer`` defaults to the byte tokenizer (which is what the C++
+    packer implements); passing any other tokenizer (e.g. a trained
+    ``BpeTokenizer``) selects the Python stream with identical
+    skip/stories semantics."""
     if stories is None:
         stories = load_stories(seed)
-    if native is not False:
+    if tokenizer is None and native is not False:
         try:
             from ..native import NativeTokenStream, native_available
 
@@ -204,5 +209,5 @@ def token_stream(batch_size: int, seq_l: int, skip: int = 0, seed: int = 0,
         except ImportError:
             if native:
                 raise
-    return TokenStream(ByteTokenizer(), batch_size, seq_l, skip=skip,
-                       seed=seed, stories=stories)
+    return TokenStream(tokenizer or ByteTokenizer(), batch_size, seq_l,
+                       skip=skip, seed=seed, stories=stories)

@@ -18,84 +18,108 @@ _SRC = Path(__file__).parent / "src" / "tokenstream.cpp"
 _LIB = Path(__file__).parent / "_tokenstream.so"
 _BPE_SRC = Path(__file__).parent / "src" / "bpe.cpp"
 _BPE_LIB = Path(__file__).parent / "_bpe.so"
-_lock = threading.Lock()
-_lib = None
-_load_failed = False  # sticky: one failed build/load is not retried
-_build_error: str | None = None
-_bpe_lib = None
-_bpe_load_failed = False
-_bpe_build_error: str | None = None
 # id layout base: 3 specials + 256 bytes; must match data/bpe.py BASE_VOCAB
 # and src/bpe.cpp kBaseVocab
 BPE_BASE_VOCAB = 259
 
 
-def _compile(src: Path, lib: Path) -> str | None:
-    """g++ ``src`` into shared lib ``lib`` unless already fresh; returns an
-    error string on failure, None on success."""
-    try:
-        if lib.exists() and lib.stat().st_mtime > src.stat().st_mtime:
+class _LazyLib:
+    """Build-on-first-use shared library with sticky failure: one failed
+    compile/load is remembered (with its diagnostic) and never retried, so
+    a box without g++ pays the probe exactly once."""
+
+    def __init__(self, src: Path, lib_path: Path, configure):
+        self._src = src
+        self._lib_path = lib_path
+        self._configure = configure  # declares restype/argtypes on the lib
+        self._lock = threading.Lock()
+        self._lib = None
+        self._failed = False
+        self.error: str | None = None
+
+    def _compile(self) -> str | None:
+        try:
+            if (self._lib_path.exists()
+                    and self._lib_path.stat().st_mtime
+                    > self._src.stat().st_mtime):
+                return None
+        except OSError:
+            pass  # e.g. source missing; fall through to (re)build attempt
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 str(self._src), "-o", str(self._lib_path)],
+                check=True, capture_output=True, text=True, timeout=120,
+            )
             return None
-    except OSError:
-        pass  # e.g. source missing; fall through to (re)build attempt
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-             str(src), "-o", str(lib)],
-            check=True, capture_output=True, text=True, timeout=120,
-        )
-        return None
-    except (OSError, subprocess.SubprocessError) as e:
-        return getattr(e, "stderr", None) or str(e)
+        except (OSError, subprocess.SubprocessError) as e:
+            return getattr(e, "stderr", None) or str(e)
+
+    def load(self):
+        with self._lock:
+            if self._lib is not None:
+                return self._lib
+            if self._failed:
+                return None
+            err = self._compile()
+            if err is not None:
+                self.error = err
+                self._failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(str(self._lib_path))
+            except OSError as e:
+                # e.g. a stale/foreign binary from another platform
+                self.error = str(e)
+                self._failed = True
+                return None
+            self._configure(lib)
+            self._lib = lib
+            return lib
 
 
-def _build() -> bool:
-    global _build_error
-    err = _compile(_SRC, _LIB)
-    if err is not None:
-        _build_error = err
-        return False
-    return True
+def _configure_tokenstream(lib):
+    lib.ddl_encode.restype = ctypes.c_long
+    lib.ddl_encode.argtypes = [
+        ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+    ]
+    lib.ddl_stream_new.restype = ctypes.c_void_p
+    lib.ddl_stream_new.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.ddl_stream_free.argtypes = [ctypes.c_void_p]
+    lib.ddl_stream_feed.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+    ]
+    lib.ddl_stream_available.restype = ctypes.c_long
+    lib.ddl_stream_available.argtypes = [ctypes.c_void_p]
+    lib.ddl_stream_next.restype = ctypes.c_int
+    lib.ddl_stream_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.ddl_stream_skip.restype = ctypes.c_long
+    lib.ddl_stream_skip.argtypes = [ctypes.c_void_p, ctypes.c_long]
+
+
+def _configure_bpe(lib):
+    lib.ddl_bpe_train.restype = ctypes.c_long
+    lib.ddl_bpe_train.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.ddl_bpe_encode.restype = ctypes.c_long
+    lib.ddl_bpe_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+    ]
+
+
+_tokenstream = _LazyLib(_SRC, _LIB, _configure_tokenstream)
+_bpe = _LazyLib(_BPE_SRC, _BPE_LIB, _configure_bpe)
 
 
 def _load():
-    global _lib, _load_failed, _build_error
-    with _lock:
-        if _lib is not None:
-            return _lib
-        if _load_failed:
-            return None
-        if not _build():
-            _load_failed = True
-            return None
-        try:
-            lib = ctypes.CDLL(str(_LIB))
-        except OSError as e:
-            # e.g. a stale/foreign binary from another platform
-            _build_error = str(e)
-            _load_failed = True
-            return None
-        lib.ddl_encode.restype = ctypes.c_long
-        lib.ddl_encode.argtypes = [
-            ctypes.c_char_p, ctypes.c_long,
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
-        ]
-        lib.ddl_stream_new.restype = ctypes.c_void_p
-        lib.ddl_stream_new.argtypes = [ctypes.c_int, ctypes.c_int]
-        lib.ddl_stream_free.argtypes = [ctypes.c_void_p]
-        lib.ddl_stream_feed.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
-        ]
-        lib.ddl_stream_available.restype = ctypes.c_long
-        lib.ddl_stream_available.argtypes = [ctypes.c_void_p]
-        lib.ddl_stream_next.restype = ctypes.c_int
-        lib.ddl_stream_next.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.ddl_stream_skip.restype = ctypes.c_long
-        lib.ddl_stream_skip.argtypes = [ctypes.c_void_p, ctypes.c_long]
-        _lib = lib
-        return lib
+    return _tokenstream.load()
 
 
 def native_available() -> bool:
@@ -103,14 +127,16 @@ def native_available() -> bool:
 
 
 def build_error() -> str | None:
-    return _build_error
+    return _tokenstream.error
 
 
 def encode(text: str, bos: bool = True, eos: bool = True) -> np.ndarray:
     """Native byte-level encode (ByteTokenizer-equivalent ids)."""
     lib = _load()
     if lib is None:
-        raise RuntimeError(f"native tokenstream unavailable: {_build_error}")
+        raise RuntimeError(
+            f"native tokenstream unavailable: {_tokenstream.error}"
+        )
     data = text.encode("utf-8")
     out = np.empty(len(data) + 2, dtype=np.int32)
     n = lib.ddl_encode(
@@ -134,7 +160,7 @@ class NativeTokenStream:
         self._lib = _load()
         if self._lib is None:
             raise RuntimeError(
-                f"native tokenstream unavailable: {_build_error}"
+                f"native tokenstream unavailable: {_tokenstream.error}"
             )
         self.batch_size = batch_size
         self.seq_l = seq_l
@@ -177,36 +203,7 @@ class NativeTokenStream:
 
 
 def _load_bpe():
-    global _bpe_lib, _bpe_load_failed, _bpe_build_error
-    with _lock:
-        if _bpe_lib is not None:
-            return _bpe_lib
-        if _bpe_load_failed:
-            return None
-        err = _compile(_BPE_SRC, _BPE_LIB)
-        if err is not None:
-            _bpe_build_error = err
-            _bpe_load_failed = True
-            return None
-        try:
-            lib = ctypes.CDLL(str(_BPE_LIB))
-        except OSError as e:
-            _bpe_build_error = str(e)
-            _bpe_load_failed = True
-            return None
-        lib.ddl_bpe_train.restype = ctypes.c_long
-        lib.ddl_bpe_train.argtypes = [
-            ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.ddl_bpe_encode.restype = ctypes.c_long
-        lib.ddl_bpe_encode.argtypes = [
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
-            ctypes.c_char_p, ctypes.c_long,
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
-        ]
-        _bpe_lib = lib
-        return lib
+    return _bpe.load()
 
 
 def bpe_native_available() -> bool:
@@ -214,7 +211,7 @@ def bpe_native_available() -> bool:
 
 
 def bpe_build_error() -> str | None:
-    return _bpe_build_error
+    return _bpe.error
 
 
 def bpe_train(corpus: bytes, vocab_size: int) -> np.ndarray:
@@ -222,7 +219,7 @@ def bpe_train(corpus: bytes, vocab_size: int) -> np.ndarray:
     array (N <= vocab_size - BPE_BASE_VOCAB)."""
     lib = _load_bpe()
     if lib is None:
-        raise RuntimeError(f"native bpe unavailable: {_bpe_build_error}")
+        raise RuntimeError(f"native bpe unavailable: {_bpe.error}")
     capacity = max(0, vocab_size - BPE_BASE_VOCAB)
     out = np.empty((capacity, 2), dtype=np.int32)
     n = lib.ddl_bpe_train(
@@ -238,7 +235,7 @@ def bpe_encode(merges: np.ndarray, text: bytes, bos: bool = True,
     Python trainer — the two are id-identical)."""
     lib = _load_bpe()
     if lib is None:
-        raise RuntimeError(f"native bpe unavailable: {_bpe_build_error}")
+        raise RuntimeError(f"native bpe unavailable: {_bpe.error}")
     merges = np.ascontiguousarray(merges, dtype=np.int32)
     out = np.empty(len(text) + 2, dtype=np.int32)
     n = lib.ddl_bpe_encode(

@@ -11,6 +11,11 @@ topology), ``tp`` (absent from the reference; free under GSPMD), ``sp``
 (top-k MoE with experts sharded over the mesh; absent from the reference) —
 but every one of them is a single SPMD program over a device mesh instead of
 N OS processes over gloo.
+
+``--tokenizer bpe`` swaps the byte-level tokenizer for a BPE trained on the
+story corpus at startup (``--bpe-vocab-size``, ``--bpe-train-stories``) —
+the train-on-the-fly equivalent of the reference's pretrained SentencePiece
+model.
 """
 
 from __future__ import annotations
@@ -43,9 +48,26 @@ from .parallel import (
 from .utils import MetricsLogger
 
 
-def _model_config(cfg: LmConfig) -> LlamaConfig:
+def _tokenizer(cfg: LmConfig, stories):
+    """Tokenizer for the run: byte-level (259 ids, None so the stream keeps
+    its native fast path) or a BPE trained on a prefix of the story corpus
+    (the reference's pretrained SentencePiece, SURVEY.md §2.3, becomes
+    train-on-the-fly in a zero-download build)."""
+    if cfg.tokenizer == "byte":
+        return None
+    if cfg.tokenizer == "bpe":
+        from .data.bpe import BpeTokenizer
+
+        corpus = " ".join(
+            stories.story(i) for i in range(cfg.bpe_train_stories)
+        )
+        return BpeTokenizer.train(corpus, cfg.bpe_vocab_size)
+    raise ValueError(f"unknown tokenizer {cfg.tokenizer!r}")
+
+
+def _model_config(cfg: LmConfig, vocab_size: int = 259) -> LlamaConfig:
     return LlamaConfig(
-        vocab_size=259,  # ByteTokenizer vocab (3 specials + 256 bytes)
+        vocab_size=vocab_size,  # 259 = ByteTokenizer (3 specials + 256 bytes)
         dmodel=cfg.dmodel, nr_heads=cfg.nr_heads, nr_layers=cfg.nr_layers,
         ctx_size=cfg.seq_l,
         dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
@@ -75,13 +97,13 @@ def _donated_local_step(loss_fn, optimizer):
     return step
 
 
-def build_trainer(cfg: LmConfig):
+def build_trainer(cfg: LmConfig, vocab_size: int = 259):
     """Return (step_fn, params, opt_state, batch_shard_fn) for the chosen
     strategy.  ``step(params, opt_state, tokens) -> (params, opt_state,
     loss)`` everywhere."""
     import dataclasses as _dc
 
-    mcfg = _model_config(cfg)
+    mcfg = _model_config(cfg, vocab_size)
     devices = jax.devices()
     n = cfg.nr_devices or len(devices)
     devices = devices[:n]
@@ -176,8 +198,15 @@ def build_trainer(cfg: LmConfig):
 
 
 def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
-    step, params, opt_state, shard = build_trainer(cfg)
-    stream = token_stream(cfg.batch_size, cfg.seq_l, seed=cfg.seed)
+    from .data.text import load_stories
+
+    stories = load_stories(cfg.seed)
+    tok = _tokenizer(cfg, stories)
+    step, params, opt_state, shard = build_trainer(
+        cfg, tok.vocab_size if tok is not None else 259
+    )
+    stream = token_stream(cfg.batch_size, cfg.seq_l, seed=cfg.seed,
+                          stories=stories, tokenizer=tok)
     logger = MetricsLogger(metrics_path) if metrics_path else None
     losses = []
     t0 = time.perf_counter()

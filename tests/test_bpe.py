@@ -76,6 +76,19 @@ def test_bpe_empty_and_degenerate():
     assert tok.encode("", bos=False, eos=False) == []
 
 
+def test_run_lm_bpe_tokenizer_converges():
+    """The LM runner trains against a BPE-trained vocab end-to-end (the
+    reference's SPTokenizer wiring, primer/intro.py:15-18)."""
+    from ddl25spring_tpu.configs import LmConfig
+    from ddl25spring_tpu.run_lm import run
+
+    losses = run(LmConfig(strategy="single", tokenizer="bpe",
+                          bpe_vocab_size=384, bpe_train_stories=50,
+                          batch_size=4, seq_l=32, dmodel=32, nr_heads=2,
+                          nr_layers=2, nr_iters=8, lr=3e-3), log_every=7)
+    assert losses[-1] < losses[0]
+
+
 def test_native_bpe_matches_python():
     if not bpe_native_available():
         pytest.skip(f"no native bpe: {bpe_build_error()}")
