@@ -198,6 +198,11 @@ def token_stream(batch_size: int, seq_l: int, skip: int = 0, seed: int = 0,
     skip/stories semantics."""
     if stories is None:
         stories = load_stories(seed)
+    if tokenizer is not None and native:
+        raise ValueError(
+            "native=True requires the byte tokenizer (the C++ packer "
+            "implements byte-level ids only); pass tokenizer=None"
+        )
     if tokenizer is None and native is not False:
         try:
             from ..native import NativeTokenStream, native_available

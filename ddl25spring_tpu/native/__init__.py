@@ -68,12 +68,14 @@ class _LazyLib:
                 return None
             try:
                 lib = ctypes.CDLL(str(self._lib_path))
-            except OSError as e:
-                # e.g. a stale/foreign binary from another platform
+                self._configure(lib)
+            except (OSError, AttributeError) as e:
+                # stale/foreign binary, or a fresh-mtime .so missing a newly
+                # added export — both fail sticky instead of crashing every
+                # auto-select call
                 self.error = str(e)
                 self._failed = True
                 return None
-            self._configure(lib)
             self._lib = lib
             return lib
 

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import optax
 
 from .configs import LmConfig, parse_config
+from .data.bpe import BASE_VOCAB
 from .data.text import token_stream
 from .models import Llama, LlamaConfig
 from .ops import causal_lm_loss
@@ -65,9 +66,9 @@ def _tokenizer(cfg: LmConfig, stories):
     raise ValueError(f"unknown tokenizer {cfg.tokenizer!r}")
 
 
-def _model_config(cfg: LmConfig, vocab_size: int = 259) -> LlamaConfig:
+def _model_config(cfg: LmConfig, vocab_size: int = BASE_VOCAB) -> LlamaConfig:
     return LlamaConfig(
-        vocab_size=vocab_size,  # 259 = ByteTokenizer (3 specials + 256 bytes)
+        vocab_size=vocab_size,  # BASE_VOCAB = byte ids (3 specials + 256)
         dmodel=cfg.dmodel, nr_heads=cfg.nr_heads, nr_layers=cfg.nr_layers,
         ctx_size=cfg.seq_l,
         dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
@@ -97,7 +98,7 @@ def _donated_local_step(loss_fn, optimizer):
     return step
 
 
-def build_trainer(cfg: LmConfig, vocab_size: int = 259):
+def build_trainer(cfg: LmConfig, vocab_size: int = BASE_VOCAB):
     """Return (step_fn, params, opt_state, batch_shard_fn) for the chosen
     strategy.  ``step(params, opt_state, tokens) -> (params, opt_state,
     loss)`` everywhere."""
@@ -203,7 +204,7 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
     stories = load_stories(cfg.seed)
     tok = _tokenizer(cfg, stories)
     step, params, opt_state, shard = build_trainer(
-        cfg, tok.vocab_size if tok is not None else 259
+        cfg, tok.vocab_size if tok is not None else BASE_VOCAB
     )
     stream = token_stream(cfg.batch_size, cfg.seq_l, seed=cfg.seed,
                           stories=stories, tokenizer=tok)
