@@ -48,7 +48,7 @@ class HflConfig:
 class LmConfig:
     """LLM-parallelism experiment (tutorial_1b family)."""
 
-    strategy: str = "dp"       # single | dp | dp-weight | pp | 1f1b | dp-pp | tp | sp | ep
+    strategy: str = "dp"       # single | dp | dp-weight | dp-zero | pp | 1f1b | dp-pp | tp | sp | ep
     nr_devices: int = 0        # 0 = all
     batch_size: int = 6
     seq_l: int = 256           # primer/intro.py:10

@@ -15,6 +15,7 @@ from .compress import (
     topk_sparsify,
 )
 from .multihost import initialize_multihost, make_multihost_mesh
+from .zero import make_zero_dp_train_step
 from .sp import make_sp_forward, make_sp_train_step, sp_data_sharding
 from .pp_1f1b import make_1f1b_grad_fn, make_1f1b_train_step
 
@@ -42,4 +43,5 @@ __all__ = [
     "topk_sparsify",
     "initialize_multihost",
     "make_multihost_mesh",
+    "make_zero_dp_train_step",
 ]

@@ -3,7 +3,8 @@
     python -m ddl25spring_tpu.run_lm --strategy dp --nr-iters 100
 
 Strategies map to the reference's scripts — ``single`` (primer/intro.py),
-``dp``/``dp-weight`` (DP/gradient_aggr, DP/weight_aggr), ``pp`` (GPipe
+``dp``/``dp-weight`` (DP/gradient_aggr, DP/weight_aggr), ``dp-zero``
+(ZeRO-sharded optimizer state over the data axis; PAPERS.md), ``pp`` (GPipe
 microbatching, PP/1F1B/intro_PP_1F1B_MB.py), ``1f1b`` (the interleaved
 schedule the reference never got working), ``dp-pp`` (the hybrid 2x3 MP
 topology), ``tp`` (absent from the reference; free under GSPMD), ``sp``
@@ -42,6 +43,7 @@ from .parallel import (
     make_mesh,
     make_pp_train_step,
     make_sp_train_step,
+    make_zero_dp_train_step,
     pp_param_shardings,
     pp_params_from_full,
     sp_data_sharding,
@@ -144,14 +146,19 @@ def build_trainer(cfg: LmConfig, vocab_size: int = BASE_VOCAB):
         step = _donated_local_step(loss_fn, optimizer)
         return step, params, optimizer.init(params), identity
 
-    if cfg.strategy in ("dp", "dp-weight"):
+    if cfg.strategy in ("dp", "dp-weight", "dp-zero"):
         data = _largest_divisor(cfg.batch_size, n)
         mesh = make_mesh({"data": data}, devices=devices[:data])
+        shard = lambda x: jax.device_put(x, dp_data_sharding(mesh))
+        if cfg.strategy == "dp-zero":
+            step, opt_state = make_zero_dp_train_step(
+                loss_fn, optimizer, mesh, params, donate=True
+            )
+            return step, params, opt_state, shard
         step = make_dp_train_step(
             loss_fn, optimizer, mesh,
             mode="grad" if cfg.strategy == "dp" else "weight", donate=True,
         )
-        shard = lambda x: jax.device_put(x, dp_data_sharding(mesh))
         return step, params, optimizer.init(params), shard
 
     if cfg.strategy in ("pp", "1f1b", "dp-pp"):

@@ -1,0 +1,97 @@
+"""ZeRO weight-update sharding oracle: element-identical to unsharded DP.
+
+For elementwise optimizers the sharded update computes exactly the same
+numbers as the replicated one, so the test demands near-bitwise agreement
+with make_dp_train_step across steps — the same equivalence style as
+DP ≡ single-device (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from ddl25spring_tpu.models import MnistCnn
+from ddl25spring_tpu.ops import nll_loss
+from ddl25spring_tpu.parallel import (
+    make_dp_train_step,
+    make_mesh,
+    make_zero_dp_train_step,
+)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    model = MnistCnn()
+    x = jax.random.normal(jax.random.key(0), (16, 28, 28, 1))
+    y = jax.random.randint(jax.random.key(1), (16,), 0, 10)
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        out = model.apply(params, xb, train=False)
+        return nll_loss(out, yb, jnp.ones_like(yb, bool))
+
+    params = model.init(jax.random.key(2), x[:1])
+    return loss_fn, params, (x, y)
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+def test_zero_dp_matches_plain_dp(problem, opt_name):
+    loss_fn, params, batch = problem
+    opt = {"sgd": lambda: optax.sgd(0.05),
+           "adam": lambda: optax.adam(1e-3)}[opt_name]()
+    mesh = make_mesh({"data": 8})
+
+    plain = make_dp_train_step(loss_fn, opt, mesh)
+    zero, z_state = make_zero_dp_train_step(loss_fn, opt, mesh, params)
+
+    p_a, s_a = params, opt.init(params)
+    p_b = params
+    for _ in range(5):
+        p_a, s_a, l_a = plain(p_a, s_a, batch)
+        p_b, z_state, l_b = zero(p_b, z_state, batch)
+    assert jnp.allclose(l_a, l_b, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_a), jax.tree.leaves(p_b)):
+        assert jnp.allclose(a, b, atol=1e-5), "params diverged"
+
+
+def test_zero_opt_state_is_sharded(problem):
+    """The point of ZeRO: every device holds 1/W of each Adam moment, not a
+    replica — the state leaves must carry the (W, chunk) shard layout."""
+    loss_fn, params, batch = problem
+    mesh = make_mesh({"data": 8})
+    opt = optax.adam(1e-3)
+    _, z_state = make_zero_dp_train_step(loss_fn, opt, mesh, params)
+
+    total = sum(p.size for p in jax.tree.leaves(params))
+    chunk = -(-total // 8)
+    arrays = [l for l in jax.tree.leaves(z_state)
+              if hasattr(l, "ndim") and l.ndim > 0]
+    assert arrays, "expected sharded moment arrays"
+    for leaf in arrays:
+        assert leaf.shape == (8, chunk)
+        spec = leaf.sharding.spec
+        assert spec and spec[0] == "data"
+
+
+def test_zero_rejects_non_elementwise_optimizer(problem):
+    """Global-norm clipping mixes coordinates, so ZeRO sharding would
+    silently change the dynamics — the factory must refuse it."""
+    loss_fn, params, _ = problem
+    mesh = make_mesh({"data": 8})
+    opt = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
+    with pytest.raises(ValueError, match="elementwise"):
+        make_zero_dp_train_step(loss_fn, opt, mesh, params)
+
+
+def test_zero_trains(problem):
+    loss_fn, params, batch = problem
+    mesh = make_mesh({"data": 8})
+    opt = optax.adam(3e-3)
+    zero, z_state = make_zero_dp_train_step(loss_fn, opt, mesh, params)
+    losses = []
+    p = params
+    for _ in range(8):
+        p, z_state, loss = zero(p, z_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
