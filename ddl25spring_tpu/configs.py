@@ -35,8 +35,8 @@ class HflConfig:
     server_lr: float = 0.02    # fedopt server-side learning rate
     dropout_rate: float = 0.0  # per-round client failure probability
     # robust aggregation (the missing course part 3; SURVEY.md §2.2)
-    aggregator: str = "mean"   # mean | krum | multi-krum | trimmed-mean | median
-    attack: str = "none"       # none | label-flip | gaussian
+    aggregator: str = "mean"   # mean | krum | multi-krum | trimmed-mean | median | consensus (fedsgd only)
+    attack: str = "none"       # none | label-flip | gaussian | sign-flip
     nr_malicious: int = 0
     # harness
     checkpoint_dir: str | None = None

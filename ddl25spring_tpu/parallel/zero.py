@@ -92,22 +92,23 @@ def make_zero_dp_train_step(loss_fn, optimizer, mesh, params,
     pad = (-n) % W
     chunk = (n + pad) // W
 
-    # sharded optimizer state: init on one zero slice, then give every
-    # array leaf a leading shard axis placed on the mesh
-    slice_state = optimizer.init(jnp.zeros((chunk,), flat0.dtype))
+    # sharded optimizer state: init each shard's state from ITS param slice
+    # (some elementwise optimizers store params in init(), e.g. lookahead —
+    # a zero-vector init would silently diverge from plain DP), then place
+    # the leading shard axis on the mesh; scalar leaves (step counters) are
+    # identical across shards and stay replicated
+    ref_state = optimizer.init(jnp.zeros((chunk,), flat0.dtype))
+    p_slices = jnp.pad(flat0, (0, pad)).reshape(W, chunk)
+    stacked_state = jax.vmap(optimizer.init)(p_slices)
 
-    def expand(leaf):
-        leaf = jnp.asarray(leaf)
-        if leaf.ndim == 0:
-            return leaf  # step counters etc. stay replicated
-        return jax.device_put(
-            jnp.broadcast_to(leaf[None], (W,) + leaf.shape),
-            NamedSharding(mesh, P(axis)),
-        )
+    def place(ref, leaf):
+        if jnp.asarray(ref).ndim == 0:
+            return leaf[0]
+        return jax.device_put(leaf, NamedSharding(mesh, P(axis)))
 
-    opt_state0 = jax.tree.map(expand, slice_state)
+    opt_state0 = jax.tree.map(place, ref_state, stacked_state)
     state_spec = jax.tree.map(
-        lambda leaf: P(axis) if jnp.asarray(leaf).ndim else P(), slice_state
+        lambda leaf: P(axis) if jnp.asarray(leaf).ndim else P(), ref_state
     )
 
     @partial(

@@ -2,6 +2,7 @@ from .aggregators import (
     weighted_mean,
     coordinate_median,
     make_trimmed_mean,
+    make_consensus,
     make_krum,
 )
 from .attacks import (
@@ -14,6 +15,7 @@ __all__ = [
     "weighted_mean",
     "coordinate_median",
     "make_trimmed_mean",
+    "make_consensus",
     "make_krum",
     "make_gaussian_attack",
     "make_sign_flip_attack",

@@ -16,6 +16,11 @@ References (public algorithms):
   Byzantine Tolerant Gradient Descent", NeurIPS 2017.
 - Coordinate-wise trimmed mean / median: Yin et al., "Byzantine-Robust
   Distributed Learning: Towards Optimal Statistical Rates", ICML 2018.
+- Consensus-weighted aggregation: agreement-based adaptive weighting in the
+  spirit of Alkhulaifi et al., "Adaptive Consensus Gradients Aggregation
+  for Scaled Distributed Training", 2024 (PAPERS.md) — weights derive from
+  each update's alignment with the consensus direction rather than from
+  client-reported sample counts.
 """
 
 from __future__ import annotations
@@ -78,6 +83,45 @@ def make_trimmed_mean(trim_ratio: float):
         return unflatten(jnp.mean(kept, axis=0))
 
     return trimmed_mean
+
+
+def make_consensus(nr_iterations: int = 2, temperature: float = 4.0):
+    """Adaptive consensus-weighted mean: seed the consensus direction from
+    the coordinate-wise median (a mean seed is unsafe — a scaled sign-flip
+    coalition can cancel or invert it), then re-weight every client by
+    (softmax-sharpened, non-negative) cosine alignment with the current
+    consensus and iterate.
+
+    Clients pulling against the consensus direction (sign-flip attackers,
+    heavy label-flip) get weight ~0 without any Byzantine-count parameter —
+    the practical advantage over Krum/trimmed-mean, which must be told f.
+    Gradient-direction agreement is the robust signal; magnitudes and
+    client-reported sample counts are never trusted.
+
+    Meant for GRADIENT-type updates (FedSgdGradientServer, DP gradients),
+    where direction carries the signal.  FedAvg-style weight vectors all
+    point along the shared parameters, so their cosines are ~1 for honest
+    and Byzantine clients alike — use Krum/trimmed-mean/median there.
+    """
+
+    def consensus(stacked, weights=None, key=None):
+        mat, unflatten = _stack_to_matrix(stacked)
+        norms = jnp.linalg.norm(mat, axis=1, keepdims=True) + 1e-12
+        unit = mat / norms
+        # robust anchor: a scaled sign-flip attack can cancel (or invert)
+        # the uniform mean, making a mean-seeded iteration lock onto the
+        # attackers; the coordinate-wise median survives any <50% coalition
+        center = jnp.median(mat, axis=0)
+        for _ in range(nr_iterations):
+            center = center / (jnp.linalg.norm(center) + 1e-12)
+            cos = unit @ center                       # (m,) in [-1, 1]
+            w = jax.nn.softmax(temperature * cos)
+            w = jnp.where(cos > 0.0, w, 0.0)          # hard-zero opposers
+            w = w / (jnp.sum(w) + 1e-12)
+            center = w @ mat
+        return unflatten(center)
+
+    return consensus
 
 
 def make_krum(nr_byzantine: int, nr_selected: int = 1):

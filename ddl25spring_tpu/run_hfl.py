@@ -30,9 +30,11 @@ from .fl.task import classification_task
 from .models import MnistCnn, ResNet18
 from .robust import (
     coordinate_median,
+    make_consensus,
     flip_labels,
     make_gaussian_attack,
     make_krum,
+    make_sign_flip_attack,
     make_trimmed_mean,
 )
 from .utils import Checkpointer, MetricsLogger
@@ -44,6 +46,13 @@ def build_aggregator(cfg: HflConfig):
         return None
     if cfg.aggregator == "median":
         return coordinate_median
+    if cfg.aggregator == "consensus":
+        if cfg.algorithm not in ("fedsgd",):
+            raise ValueError(
+                "consensus aggregation needs gradient-type updates; use "
+                "--algorithm fedsgd"
+            )
+        return make_consensus()
     if cfg.aggregator == "trimmed-mean":
         return make_trimmed_mean(min(0.45, max(1, cfg.nr_malicious) / sampled))
     if cfg.aggregator == "krum":
@@ -80,6 +89,8 @@ def build_server(cfg: HflConfig):
     attack = None
     if cfg.attack == "gaussian":
         attack = make_gaussian_attack()
+    elif cfg.attack == "sign-flip":
+        attack = make_sign_flip_attack()
     elif cfg.attack == "label-flip":
         client_data = flip_labels(client_data, malicious, nr_classes=10)
     elif cfg.attack != "none":

@@ -30,6 +30,7 @@ import optax
 
 from .configs import LmConfig, parse_config
 from .data.bpe import BASE_VOCAB
+from .data.prefetch import PrefetchStream
 from .data.text import token_stream
 from .models import Llama, LlamaConfig
 from .ops import causal_lm_loss
@@ -213,23 +214,30 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
     step, params, opt_state, shard = build_trainer(
         cfg, tok.vocab_size if tok is not None else BASE_VOCAB
     )
-    stream = token_stream(cfg.batch_size, cfg.seq_l, seed=cfg.seed,
-                          stories=stories, tokenizer=tok)
+    stream = PrefetchStream(
+        token_stream(cfg.batch_size, cfg.seq_l, seed=cfg.seed,
+                     stories=stories, tokenizer=tok)
+    )
     logger = MetricsLogger(metrics_path) if metrics_path else None
     losses = []
     t0 = time.perf_counter()
-    for it in range(cfg.nr_iters):
-        tokens = shard(jnp.asarray(stream.next_batch()))
-        params, opt_state, loss = step(params, opt_state, tokens)
-        if it % log_every == 0 or it == cfg.nr_iters - 1:
-            loss = float(loss)
-            losses.append(loss)
-            print(f"iter {it} loss {loss:.4f}", flush=True)
-            if logger:
-                logger.log("iter", idx=it, loss=loss,
-                           seconds=round(time.perf_counter() - t0, 3))
-    if logger:
-        logger.close()
+    try:
+        for it in range(cfg.nr_iters):
+            # host tokenization runs in the prefetch thread; jax's async
+            # dispatch overlaps the device step with the next host batch
+            tokens = shard(jnp.asarray(stream.next_batch()))
+            params, opt_state, loss = step(params, opt_state, tokens)
+            if it % log_every == 0 or it == cfg.nr_iters - 1:
+                loss = float(loss)
+                losses.append(loss)
+                print(f"iter {it} loss {loss:.4f}", flush=True)
+                if logger:
+                    logger.log("iter", idx=it, loss=loss,
+                               seconds=round(time.perf_counter() - t0, 3))
+    finally:
+        stream.close()
+        if logger:
+            logger.close()
     return losses
 
 

@@ -1,10 +1,10 @@
 """Byzantine attacks vs robust aggregation (the missing course part 3,
 SURVEY.md §2.2; north-star config[4] in BASELINE.json).
 
-Grid: {no attack, label-flip, gaussian} x {mean, krum, multi-krum,
-trimmed-mean, median} on FedSGD over MNIST, reporting final accuracy —
-robust aggregators should hold accuracy under attack where the plain mean
-collapses.
+Grid: {no attack, label-flip, gaussian, sign-flip} x {mean, krum,
+multi-krum, trimmed-mean, median, consensus} on FedSGD over MNIST,
+reporting final accuracy — robust aggregators should hold accuracy under
+attack where the plain mean collapses.
 
 Run:  python examples/robust_fl.py [--quick]
 """
@@ -30,9 +30,9 @@ def main(quick=False):
     nr_clients = 20 if quick else 50
     nr_malicious = 4 if quick else 10
     attacks = ["none", "label-flip"] if quick else \
-        ["none", "label-flip", "gaussian"]
-    aggs = ["mean", "krum", "median"] if quick else \
-        ["mean", "krum", "multi-krum", "trimmed-mean", "median"]
+        ["none", "label-flip", "gaussian", "sign-flip"]
+    aggs = ["mean", "krum", "median", "consensus"] if quick else \
+        ["mean", "krum", "multi-krum", "trimmed-mean", "median", "consensus"]
     print(f"{'attack':12s} {'aggregator':14s} final acc")
     for attack in attacks:
         for agg in aggs:

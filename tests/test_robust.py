@@ -104,3 +104,48 @@ def test_end_to_end_krum_resists_gaussian_attack():
     rr_u = undefended.run(3)
     # krum filters the noise; plain mean is dragged far off the minimum
     assert rr_d.test_accuracy[-1] > rr_u.test_accuracy[-1] + 5
+
+
+def test_consensus_downweights_sign_flippers():
+    """Unit oracle: with honest updates clustered around a direction and
+    sign-flipped attackers, the consensus aggregate must stay close to the
+    honest mean while the plain mean is dragged toward zero."""
+    from ddl25spring_tpu.robust import make_consensus
+
+    rng = np.random.default_rng(1)
+    honest = rng.standard_normal(6).astype(np.float32)
+    mat = np.stack([honest + 0.1 * rng.standard_normal(6) for _ in range(6)]
+                   + [-2.0 * honest, -2.0 * honest])  # 2 of 8 sign-flipped
+    agg = make_consensus()(as_tree(mat))
+    flat = np.concatenate([np.ravel(agg["a"]), np.ravel(agg["b"])])
+    honest_mean = mat[:6].mean(0)
+    plain_mean = mat.mean(0)
+    assert np.linalg.norm(flat - honest_mean) < 0.2
+    assert np.linalg.norm(plain_mean - honest_mean) > 0.5  # mean IS corrupted
+    cos = float(np.dot(flat, honest) /
+                (np.linalg.norm(flat) * np.linalg.norm(honest)))
+    assert cos > 0.95
+
+
+def test_end_to_end_consensus_resists_sign_flip():
+    from ddl25spring_tpu.robust import make_consensus
+
+    ds = load_mnist(n_train=1024, n_test=256)
+    task = mnist_task(ds.test_x, ds.test_y)
+    clients = split_dataset(ds.train_x, ds.train_y, nr_clients=8, iid=True, seed=10)
+    mal = np.zeros(8, bool)
+    mal[:2] = True
+
+    def build(aggregator):
+        return FedSgdGradientServer(
+            task, lr=0.1, client_data=clients, client_fraction=1.0, seed=10,
+            aggregator=aggregator,
+            attack=make_sign_flip_attack(3.0), malicious_mask=mal,
+        )
+
+    # scaled sign-flip nearly cancels the plain mean (the server barely
+    # moves off its init), while consensus weighting recovers the honest
+    # direction and learns
+    rr_d = build(make_consensus()).run(6)
+    rr_u = build(None).run(6)
+    assert rr_d.test_accuracy[-1] > rr_u.test_accuracy[-1] + 10
