@@ -67,18 +67,25 @@ def _stamp(msg: str):
 _T0 = time.perf_counter()
 
 
+def _sync(tree):
+    # lazy import: bench must call select_platform() before anything pulls
+    # in jax; device_sync's docstring explains why block_until_ready alone
+    # is not a barrier here
+    from ddl25spring_tpu.utils.platform import device_sync
+
+    device_sync(tree)
+
+
 def timed_rounds(server, nr_rounds: int) -> float:
     """Rounds/sec over ``nr_rounds`` after a compile warmup round."""
-    import jax
-
     _stamp("warmup round (jit compile) ...")
     params = server.round_fn(server.params, server.run_key, 0)  # warmup/compile
-    jax.block_until_ready(params)
+    _sync(params)
     _stamp("warmup done; timing ...")
     t0 = time.perf_counter()
     for r in range(1, nr_rounds + 1):
         params = server.round_fn(params, server.run_key, r)
-    jax.block_until_ready(params)
+    _sync(params)
     server.params = params
     return nr_rounds / (time.perf_counter() - t0)
 

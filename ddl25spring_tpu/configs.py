@@ -56,9 +56,15 @@ class LmConfig:
     nr_heads: int = 6
     nr_layers: int = 6
     lr: float = 8e-4           # primer/intro.py: Adam lr
+    lr_schedule: str = "const"  # const | cosine | warmup-cosine
+    warmup_iters: int = 0      # warmup-cosine: linear warmup length
+    grad_clip: float = 0.0     # global-norm gradient clipping; 0 = off
     nr_iters: int = 100
     nr_microbatches: int = 3   # intro_PP_1F1B_MB.py microbatch count
     moe_aux_weight: float = 0.01  # ep: load-balancing aux loss weight
+    remat: bool = False        # gradient-checkpoint each block (HBM ↓, FLOPs ↑)
+    generate_tokens: int = 0   # after training, sample this many tokens
+    generate_temperature: float = 0.8
     tokenizer: str = "byte"    # byte | bpe (SentencePiece-equivalent)
     bpe_vocab_size: int = 1024  # bpe: target vocab (specials+bytes+merges)
     bpe_train_stories: int = 500  # bpe: corpus prefix used for training

@@ -263,8 +263,18 @@ def make_fl_round(
     if apply_aggregate is None:
         apply_aggregate = lambda params, agg: agg
 
+    mal_mask = (
+        jnp.asarray(malicious_mask) if attack is not None else jnp.zeros((0,))
+    )
+
+    # Client data enters the jitted program as ARGUMENTS, not closure
+    # captures: a captured concrete array is baked into the lowered HLO as a
+    # constant, which bloats the executable with the whole stacked dataset
+    # (256 CIFAR clients ≈ 150 MB) — slow to compile anywhere and an outright
+    # compile-upload failure on remote-compile TPU frontends.  As arguments
+    # they stay resident device buffers reused every round.
     @jax.jit
-    def round_fn(params, base_key, round_idx):
+    def _round(params, base_key, round_idx, x, y, counts, mal_mask):
         round_key = jax.random.fold_in(base_key, round_idx)
         sample_key, agg_key, drop_key = jax.random.split(round_key, 3)
         sel = sample_clients(sample_key, nr_clients, nr_shard)
@@ -285,7 +295,7 @@ def make_fl_round(
         updates = constrain(updates)
 
         if attack is not None:
-            mal = jnp.take(jnp.asarray(malicious_mask), sel, axis=0)
+            mal = jnp.take(mal_mask, sel, axis=0)
             attacked = jax.vmap(attack, in_axes=(0, None, 0))(
                 updates, params, keys
             )
@@ -311,6 +321,9 @@ def make_fl_round(
         aggregate = aggregator(updates, weights, agg_key)
         return apply_aggregate(params, aggregate)
 
+    def round_fn(params, base_key, round_idx):
+        return _round(params, base_key, round_idx, x, y, counts, mal_mask)
+
     return round_fn
 
 
@@ -335,8 +348,10 @@ def make_evaluator(score_fn, x, y, batch_size: int = 10000):
     yb = y_p.reshape((nr_batches, batch_size))
     vb = valid.reshape((nr_batches, batch_size))
 
+    # test set as jit arguments, not closure constants (same reasoning as
+    # make_fl_round: captured arrays get baked into the compiled program)
     @jax.jit
-    def evaluate(params):
+    def _evaluate(params, xb, yb, vb):
         def body(carry, inp):
             xi, yi, vi = inp
             pred = jnp.argmax(score_fn(params, xi), axis=-1)
@@ -345,5 +360,8 @@ def make_evaluator(score_fn, x, y, batch_size: int = 10000):
 
         correct, _ = jax.lax.scan(body, jnp.int32(0), (xb, yb, vb))
         return 100.0 * correct / n
+
+    def evaluate(params):
+        return _evaluate(params, xb, yb, vb)
 
     return evaluate

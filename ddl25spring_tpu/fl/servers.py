@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..data.split import ClientDatasets
 from ..utils.metrics import RunResult
+from ..utils.platform import device_sync
 from ..utils.rng import seed_key
 from .engine import (
     make_fl_round,
@@ -90,10 +91,13 @@ class CentralizedServer(Server):
             jnp.asarray(train_x), [(0, pad_to - n)] + [(0, 0)] * (train_x.ndim - 1)
         )
         self._y = jnp.pad(jnp.asarray(train_y), (0, pad_to - n))
-        self._count = n
+        self._count = jnp.int32(n)
         update = make_local_sgd_update(task.loss_fn, lr, batch_size, 1)
-        self._epoch = jax.jit(
-            lambda params, key: update(params, self._x, self._y, self._count, key)
+        # dataset as jit arguments, not closure constants (see
+        # engine.make_fl_round): keeps the pooled train set out of the HLO
+        jitted = jax.jit(update)
+        self._epoch = lambda params, key: jitted(
+            params, self._x, self._y, self._count, key
         )
 
     def run(self, nr_rounds: int, start_round: int = 0,
@@ -103,7 +107,7 @@ class CentralizedServer(Server):
         for r in range(start_round, start_round + nr_rounds):
             t0 = perf_counter()
             epoch_key = jax.random.fold_in(self.run_key, r)
-            self.params = jax.block_until_ready(self._epoch(self.params, epoch_key))
+            self.params = device_sync(self._epoch(self.params, epoch_key))
             elapsed += perf_counter() - t0
             result.record_round(elapsed, 0, self.test())
             if on_round is not None:
@@ -139,7 +143,7 @@ class DecentralizedServer(Server):
         elapsed = 0.0
         for r in range(start_round, start_round + nr_rounds):
             t0 = perf_counter()
-            self.params = jax.block_until_ready(
+            self.params = device_sync(
                 self.round_fn(self.params, self.run_key, r)
             )
             elapsed += perf_counter() - t0

@@ -13,8 +13,10 @@ from .llama import (
     split_stage_layers,
     full_params_to_stage_params,
 )
+from .generate import generate
 
 __all__ = [
+    "generate",
     "MnistCnn",
     "HeartDiseaseNN",
     "BasicBlock",

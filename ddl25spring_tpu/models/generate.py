@@ -1,0 +1,117 @@
+"""Autoregressive text generation with a KV cache.
+
+The reference never samples from its LMs — training loss is its only output
+(lab/tutorial_1b/primer/intro.py trains and logs loss, nothing decodes).  A
+complete LM framework needs inference, so this module adds it TPU-first:
+
+- the KV cache is a **fixed-size** ``cache`` collection inside the model
+  (models/llama.py ``Attention._decode_attention``) — static shapes, one
+  ``dynamic_update_slice`` per step, no retracing as the sequence grows;
+- the decode loop is a ``lax.scan`` over step index — ONE compiled program
+  for the whole generation, not a Python loop of dispatches;
+- prompt prefill is a single batched forward (all prompt positions at once),
+  then scan takes over token by token.
+
+Greedy decoding equals iterated full-forward argmax exactly — the oracle
+``tests/test_llama.py::test_generate_matches_full_forward`` checks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .llama import Llama, LlamaConfig
+
+
+def generate(
+    config: LlamaConfig,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+):
+    """Generate ``max_new_tokens`` continuations of ``prompt``.
+
+    ``prompt`` is (B, T0) int32 with T0 >= 1; returns (B, T0 +
+    max_new_tokens).  ``temperature == 0`` decodes greedily (deterministic);
+    otherwise logits are divided by the temperature and sampled
+    categorically with per-step keys folded from ``key``.
+
+    The model's ``ctx_size`` bounds the total length; the rotary embedding is
+    position-exact because every step passes its global position explicitly.
+    """
+    B, T0 = prompt.shape
+    if max_new_tokens == 0:
+        return prompt
+    total = T0 + max_new_tokens
+    if total > config.ctx_size:
+        raise ValueError(
+            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"ctx_size ({config.ctx_size})"
+        )
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        key = jax.random.key(0)  # unused on the greedy path
+
+    decode = _decode_fn(config, T0, total, float(temperature))
+    return decode(params, prompt, key)
+
+
+@functools.cache
+def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float):
+    """Compiled prefill+scan decoder, cached on (config, shape, temperature)
+    so repeated ``generate`` calls with the same geometry reuse the jitted
+    program instead of rebuilding a fresh closure (and recompiling) per call.
+    """
+    model = Llama(dataclasses.replace(
+        config, decode=True, attn_impl="dense", remat=False
+    ))
+
+    @jax.jit
+    def decode(params, prompt, key):
+        # prefill: score the whole prompt in one forward, populating the cache
+        logits, state = model.apply(
+            params, prompt, jnp.arange(T0), mutable=["cache"]
+        )
+        cache = state["cache"]
+
+        def pick(logits_last, step_key):
+            if temperature == 0.0:
+                return jnp.argmax(logits_last, axis=-1).astype(prompt.dtype)
+            return jax.random.categorical(
+                step_key, logits_last / temperature, axis=-1
+            ).astype(prompt.dtype)
+
+        first = pick(logits[:, -1], jax.random.fold_in(key, 0))
+
+        def step(carry, i):
+            cache, tok = carry
+            logits, state = model.apply(
+                {**params, "cache": cache}, tok[:, None], i[None],
+                mutable=["cache"],
+            )
+            nxt = pick(logits[:, -1], jax.random.fold_in(key, i))
+            return (state["cache"], nxt), tok
+
+        # prefill already produced the first generated token, so the scan
+        # runs the remaining max_new_tokens - 1 steps
+        (_, last), toks = jax.lax.scan(
+            step, (cache, first), jnp.arange(T0, total - 1)
+        )
+        # toks holds the input token of each step: generated[0..n-2]; append
+        # the final step's output to complete the n generated tokens
+        gen = jnp.concatenate(
+            [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1
+        )
+        return jnp.concatenate([prompt, gen], axis=1)
+
+    return decode

@@ -43,6 +43,8 @@ class LlamaConfig:
     seq_axis: str = "seq"      # mesh axis for attn_impl="ring"
     nr_experts: int = 0        # 0 = dense SwiGLU MLP; >0 = top-k MoE
     expert_topk: int = 2
+    remat: bool = False        # rematerialize blocks in backward (HBM ↓, FLOPs ↑)
+    decode: bool = False       # KV-cache autoregressive decoding (models.generate)
 
     @property
     def head_dim(self) -> int:
@@ -101,7 +103,9 @@ class Attention(nn.Module):
         cos, sin = rope_angles(cfg.head_dim, positions)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if cfg.attn_impl == "ring":
+        if cfg.decode:
+            out = self._decode_attention(q, k, v, positions)
+        elif cfg.attn_impl == "ring":
             out = ring_causal_attention(q, k, v, cfg.seq_axis)
         elif cfg.attn_impl == "flash":
             from ..ops.flash_attention import flash_causal_attention
@@ -111,6 +115,38 @@ class Attention(nn.Module):
             out = causal_attention(q, k, v)
         out = out.reshape(B, T, cfg.dmodel)
         return dense("wo")(out)
+
+    def _decode_attention(self, q, k, v, positions):
+        """Attention against a fixed-size KV cache (``cache`` collection).
+
+        The cache keeps static shape (B, ctx_size, H, hd) — TPU-friendly: no
+        growing tensors, one ``dynamic_update_slice`` per step — and the
+        write offset is the first query position, so the same code serves the
+        prompt prefill (T = prompt length, offset 0) and each single-token
+        decode step (T = 1, offset = tokens seen so far)."""
+        cfg = self.config
+        B, T = q.shape[:2]
+        S = cfg.ctx_size
+        zeros = lambda: jnp.zeros((B, S, cfg.nr_heads, cfg.head_dim), q.dtype)
+        ck = self.variable("cache", "k", zeros)
+        cv = self.variable("cache", "v", zeros)
+        offset = positions[0]
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+        # scores in float32 BEFORE scaling, matching ops.attention's dense
+        # path exactly — in bf16 compute, near-tied logits would otherwise
+        # round differently here than in the full-forward oracle and greedy
+        # decode would diverge from it
+        scale = 1.0 / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+        scores = jnp.einsum("bthd,bshd->bhts", q, ck.value).astype(
+            jnp.float32
+        ) * scale
+        # key j visible to query at global position p iff j <= p; unwritten
+        # cache rows are masked out by the same comparison
+        visible = jnp.arange(S)[None, :] <= positions[:, None]  # (T, S)
+        scores = jnp.where(visible[None, None], scores, -jnp.inf)
+        att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhts,bshd->bthd", att, cv.value)
 
 
 class SwiGLU(nn.Module):
@@ -148,6 +184,15 @@ def _positions(T: int):
     return jnp.arange(T)
 
 
+def _block_cls(cfg: LlamaConfig):
+    """``Block``, wrapped in ``nn.remat`` when ``cfg.remat`` is set: block
+    activations are discarded after the forward pass and recomputed during
+    backward, cutting activation HBM from O(nr_layers) to O(1) blocks at the
+    cost of one extra forward — the standard TPU memory/FLOPs trade for long
+    contexts (the reference, capped at seq_l=256, never needs it)."""
+    return nn.remat(Block) if cfg.remat else Block
+
+
 class LlamaFirstStage(nn.Module):
     """Token embedding + the first ``nr_layers`` blocks.
 
@@ -170,7 +215,7 @@ class LlamaFirstStage(nn.Module):
             return x
         pos = _positions(tokens.shape[1])
         for i in range(self.nr_layers):
-            x = Block(cfg, name=f"block{i}")(x, pos)
+            x = _block_cls(cfg)(cfg, name=f"block{i}")(x, pos)
         return x
 
 
@@ -184,7 +229,7 @@ class LlamaMidStage(nn.Module):
     def __call__(self, x):
         pos = _positions(x.shape[1])
         for i in range(self.nr_layers):
-            x = Block(self.config, name=f"block{i}")(x, pos)
+            x = _block_cls(self.config)(self.config, name=f"block{i}")(x, pos)
         return x
 
 
@@ -200,7 +245,7 @@ class LlamaLastStage(nn.Module):
         cfg = self.config
         pos = _positions(x.shape[1])
         for i in range(self.nr_layers):
-            x = Block(cfg, name=f"block{i}")(x, pos)
+            x = _block_cls(cfg)(cfg, name=f"block{i}")(x, pos)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
@@ -225,7 +270,7 @@ class Llama(nn.Module):
         # local block starts at a nonzero global offset (parallel/sp.py)
         pos = _positions(tokens.shape[1]) if positions is None else positions
         for i in range(cfg.nr_layers):
-            x = Block(cfg, name=f"block{i}")(x, pos)
+            x = _block_cls(cfg)(cfg, name=f"block{i}")(x, pos)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"

@@ -73,7 +73,7 @@ def _model_config(cfg: LmConfig, vocab_size: int = BASE_VOCAB) -> LlamaConfig:
     return LlamaConfig(
         vocab_size=vocab_size,  # BASE_VOCAB = byte ids (3 specials + 256)
         dmodel=cfg.dmodel, nr_heads=cfg.nr_heads, nr_layers=cfg.nr_layers,
-        ctx_size=cfg.seq_l,
+        ctx_size=cfg.seq_l, remat=cfg.remat,
         dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
     )
 
@@ -101,6 +101,26 @@ def _donated_local_step(loss_fn, optimizer):
     return step
 
 
+def _make_optimizer(cfg: LmConfig):
+    """Adam with optional LR schedule and global-norm clipping (the usual LM
+    training guards; the reference trains at a fixed lr with no clipping,
+    primer/intro.py:22)."""
+    if cfg.lr_schedule == "const":
+        lr = cfg.lr
+    elif cfg.lr_schedule == "cosine":
+        lr = optax.cosine_decay_schedule(cfg.lr, max(cfg.nr_iters, 1))
+    elif cfg.lr_schedule == "warmup-cosine":
+        lr = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.lr, cfg.warmup_iters, max(cfg.nr_iters, cfg.warmup_iters + 1)
+        )
+    else:
+        raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
+    opt = optax.adam(lr)
+    if cfg.grad_clip:
+        opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), opt)
+    return opt
+
+
 def build_trainer(cfg: LmConfig, vocab_size: int = BASE_VOCAB):
     """Return (step_fn, params, opt_state, batch_shard_fn) for the chosen
     strategy.  ``step(params, opt_state, tokens) -> (params, opt_state,
@@ -111,7 +131,7 @@ def build_trainer(cfg: LmConfig, vocab_size: int = BASE_VOCAB):
     devices = jax.devices()
     n = cfg.nr_devices or len(devices)
     devices = devices[:n]
-    optimizer = optax.adam(cfg.lr)
+    optimizer = _make_optimizer(cfg)
     tokens0 = jnp.zeros((cfg.batch_size, cfg.seq_l), jnp.int32)
 
     if cfg.strategy == "ep":
@@ -238,7 +258,31 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
         stream.close()
         if logger:
             logger.close()
+    if cfg.generate_tokens:
+        _sample_text(cfg, params, tok)
     return losses
+
+
+def _sample_text(cfg: LmConfig, params, tok):
+    """Greedy/temperature sampling from the trained model (models.generate);
+    only strategies that keep a full-model param tree can decode directly."""
+    from .data import ByteTokenizer
+    from .models import generate
+
+    if cfg.strategy in ("pp", "1f1b", "dp-pp", "ep"):
+        print(f"[generate] skipped: strategy {cfg.strategy!r} shards params "
+              "away from the full-model tree")
+        return
+    tok = tok if tok is not None else ByteTokenizer()
+    mcfg = _model_config(cfg, tok.vocab_size)
+    prompt = jnp.asarray([[tok.bos_id]], jnp.int32)
+    out = generate(
+        mcfg, params, prompt,
+        min(cfg.generate_tokens, mcfg.ctx_size - 1),
+        temperature=cfg.generate_temperature,
+        key=jax.random.key(cfg.seed),
+    )
+    print("[generate]", repr(tok.decode([int(t) for t in out[0, 1:]])))
 
 
 def main(argv=None):

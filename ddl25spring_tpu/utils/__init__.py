@@ -15,8 +15,15 @@ from .rng import client_round_key, epoch_key, seed_key
 from .metrics import RunResult
 from .checkpoint import Checkpointer
 from .logging import MetricsLogger, profile_trace, read_jsonl, timed
+from .plots import plot_accuracy_curves, plot_jsonl_metric, plot_loss_curves
+from .platform import device_sync, select_platform
 
 __all__ = [
+    "device_sync",
+    "select_platform",
+    "plot_accuracy_curves",
+    "plot_jsonl_metric",
+    "plot_loss_curves",
     "Checkpointer",
     "MetricsLogger",
     "profile_trace",

@@ -41,3 +41,22 @@ def select_platform(platform: str | None = None) -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def device_sync(tree):
+    """Completion barrier that works on every backend.
+
+    ``jax.block_until_ready`` is a no-op on fully-async remote backends (the
+    axon TPU tunnel hands out futures that report ready immediately), which
+    silently turns wall-clock timing into dispatch timing.  Reading one
+    output leaf back to the host cannot return before everything it depends
+    on has executed, so timing loops should end with this.  Returns ``tree``.
+    """
+    import numpy as np
+    import jax
+
+    jax.block_until_ready(tree)
+    leaves = jax.tree.leaves(tree)
+    if leaves:
+        np.asarray(leaves[0])
+    return tree

@@ -1,0 +1,101 @@
+"""Convergence-curve plotting.
+
+The reference presents every experiment as a plot: test-accuracy-vs-round
+lines for the HFL servers (lab/tutorial_1a/horizontal-federated-learning.ipynb
+cell 37, seaborn lineplot over RunResult frames), loss curves per feature
+permutation (lab/tutorial_2b/exercise_1.py:157-163), and accuracy-vs-clients
+(exercise_2.py:174-180).  These helpers produce the same figures from
+:class:`~ddl25spring_tpu.utils.metrics.RunResult` objects, plain loss lists,
+or a JSONL metrics file — headless (Agg) so they work on the TPU container,
+written straight to PNG/SVG instead of into a notebook.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Mapping, Sequence
+
+from .metrics import RunResult
+
+
+def _axes(title: str, xlabel: str, ylabel: str):
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 4.5), dpi=120)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(True, alpha=0.3)
+    return fig, ax
+
+
+def _finish(fig, ax, path: str | Path) -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(path)
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
+    return path
+
+
+def plot_accuracy_curves(
+    results: Mapping[str, RunResult],
+    path: str | Path,
+    title: str = "Test accuracy per round",
+) -> Path:
+    """Accuracy-vs-round lines, one per labelled run (the HFL comparison
+    figure, horizontal-federated-learning.ipynb cell 37)."""
+    fig, ax = _axes(title, "Round", "Test accuracy [%]")
+    for label, rr in results.items():
+        rounds = range(1, len(rr.test_accuracy) + 1)
+        ax.plot(rounds, rr.test_accuracy, marker="o", label=label)
+    return _finish(fig, ax, path)
+
+
+def plot_loss_curves(
+    losses: Mapping[str, Sequence[float]],
+    path: str | Path,
+    title: str = "Training loss",
+    xlabel: str = "Epoch",
+    logy: bool = False,
+) -> Path:
+    """Loss-vs-step lines, one per labelled run (the VFL permutation figure,
+    exercise_1.py:157-163; set ``logy`` for VAE-scale losses)."""
+    fig, ax = _axes(title, xlabel, "Loss")
+    for label, ys in losses.items():
+        ax.plot(range(1, len(ys) + 1), list(map(float, ys)), label=label)
+    if logy:
+        ax.set_yscale("log")
+    return _finish(fig, ax, path)
+
+
+def plot_jsonl_metric(
+    jsonl_path: str | Path,
+    path: str | Path,
+    y: str,
+    x: str = "round",
+    event: str | None = None,
+    title: str | None = None,
+) -> Path:
+    """Plot field ``y`` against field ``x`` from a
+    :class:`~ddl25spring_tpu.utils.logging.MetricsLogger` JSONL file,
+    optionally filtered to one ``event`` type."""
+    from .logging import read_jsonl
+
+    recs = [
+        r for r in read_jsonl(jsonl_path)
+        if (event is None or r.get("event") == event)
+        and x in r and y in r
+    ]
+    if not recs:
+        raise ValueError(f"no records with fields {x!r}/{y!r} in {jsonl_path}")
+    fig, ax = _axes(title or f"{y} vs {x}", x, y)
+    ax.plot([r[x] for r in recs], [r[y] for r in recs],
+            marker="o", label=event or y)
+    return _finish(fig, ax, path)

@@ -57,11 +57,12 @@ def part_a1(rounds=5):
               f"{[round(d, 4) for d in deltas]}")
 
 
-def part_a2(rounds=10, quick=False):
+def part_a2(rounds=10, quick=False, plot_dir=None):
     """The homework table: FedSGD vs FedAvg over (N, C)."""
     print("== A2: N/C sweep (reference table: homework-1.ipynb cell 22) ==")
     grid = [(10, 0.1), (50, 0.1)] if quick else [
         (10, 0.1), (50, 0.1), (100, 0.1), (100, 0.01), (100, 0.2)]
+    curves = {}
     for n, c in grid:
         task, data = setup(n, True, seed=10)
         sgd = FedSgdGradientServer(task, 0.01, data, c, seed=10).run(rounds)
@@ -70,27 +71,49 @@ def part_a2(rounds=10, quick=False):
         print(f"N={n:4d} C={c:4.2f}: FedSGD {sgd.test_accuracy[-1]:6.2f}%  "
               f"FedAvg {avg.test_accuracy[-1]:6.2f}%  "
               f"(messages {avg.message_count[-1]})")
+        curves[f"FedSGD N={n} C={c}"] = sgd
+        curves[f"FedAvg N={n} C={c}"] = avg
+    if plot_dir:
+        from ddl25spring_tpu.utils import plot_accuracy_curves
+
+        out = plot_accuracy_curves(
+            curves, Path(plot_dir) / "hw1_a2_accuracy.png",
+            title="FedSGD vs FedAvg (homework-1 A2)",
+        )
+        print(f"wrote {out}")
 
 
-def part_a3(rounds=10, quick=False):
+def part_a3(rounds=10, quick=False, plot_dir=None):
     """Local epochs and non-IID degradation."""
     print("== A3: E sweep, IID vs non-IID ==")
+    curves = {}
     for iid in (True, False):
         for e in ([1, 2] if quick else [1, 2, 4]):
             task, data = setup(100, iid, seed=10, pad=100)
             r = FedAvgServer(task, 0.01, 100, data, 0.1, e, seed=10).run(rounds)
             print(f"iid={iid} E={e}: final acc {r.test_accuracy[-1]:6.2f}%")
+            curves[f"{'IID' if iid else 'non-IID'} E={e}"] = r
+    if plot_dir:
+        from ddl25spring_tpu.utils import plot_accuracy_curves
+
+        out = plot_accuracy_curves(
+            curves, Path(plot_dir) / "hw1_a3_accuracy.png",
+            title="FedAvg: local epochs and IID vs non-IID (homework-1 A3)",
+        )
+        print(f"wrote {out}")
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--part", default="all")
+    ap.add_argument("--plot-dir", default=None,
+                    help="write the reference's convergence figures here")
     args = ap.parse_args()
     rounds = 3 if args.quick else None
     if args.part in ("A1", "all"):
         part_a1(rounds or 5)
     if args.part in ("A2", "all"):
-        part_a2(rounds or 10, args.quick)
+        part_a2(rounds or 10, args.quick, args.plot_dir)
     if args.part in ("A3", "all"):
-        part_a3(rounds or 10, args.quick)
+        part_a3(rounds or 10, args.quick, args.plot_dir)

@@ -40,25 +40,35 @@ def make_slices(feature_names, client_cols):
 def train_net(slices, x, y1h, epochs, split):
     net = VFLNetwork(feature_slices=slices,
                      outs_per_party=[2 * len(s) for s in slices])
-    net.train_with_settings(epochs, 64, x[:split], y1h[:split])
+    history = net.train_with_settings(epochs, 64, x[:split], y1h[:split])
     acc, _ = net.test(x[split:], y1h[split:])
-    return float(acc)
+    return float(acc), history
 
 
-def ex1(epochs):
+def ex1(epochs, plot_dir=None):
     print("== Ex1: feature-permutation sensitivity (4 clients) ==")
     df, _ = load_heart_df()
     d = load_heart_classification()
     raw = [c for c in df.columns if c != "target"]
     y1h = np.eye(2, dtype=np.float32)[d.y]
     split = int(0.8 * len(d.y))
+    curves = {}
     for seed in (0, 1, 2):
         perm = np.random.default_rng(seed).permutation(len(raw))
         parts = partition_features(raw, d.feature_names, CATEGORICAL, 4,
                                    permutation=perm)
-        acc = train_net(make_slices(d.feature_names, parts), d.x, y1h,
-                        epochs, split)
+        acc, history = train_net(make_slices(d.feature_names, parts), d.x,
+                                 y1h, epochs, split)
         print(f"permutation seed {seed}: test acc {acc * 100:.2f}%")
+        curves[f"permutation {seed}"] = history
+    if plot_dir:
+        from ddl25spring_tpu.utils import plot_loss_curves
+
+        out = plot_loss_curves(
+            curves, Path(plot_dir) / "hw2_ex1_loss.png",
+            title="VFL loss per feature permutation (exercise_1.py:157-163)",
+        )
+        print(f"wrote {out}")
 
 
 def ex2(epochs):
@@ -70,12 +80,12 @@ def ex2(epochs):
     split = int(0.8 * len(d.y))
     for nr in (2, 4, 6, 8):
         parts = partition_features(raw, d.feature_names, CATEGORICAL, nr)
-        acc = train_net(make_slices(d.feature_names, parts), d.x, y1h,
-                        epochs, split)
+        acc, _ = train_net(make_slices(d.feature_names, parts), d.x, y1h,
+                           epochs, split)
         print(f"{nr} clients: test acc {acc * 100:.2f}%")
 
 
-def ex3(epochs):
+def ex3(epochs, plot_dir=None):
     print("== Ex3: split VFL-VAE (reference: 114,118 -> ~13,900) ==")
     df, _ = load_heart_df()
     d = load_heart_classification()
@@ -87,12 +97,23 @@ def ex3(epochs):
     losses = vae.train(x_clients, epochs=epochs)
     print(f"combined loss: {losses[0]:.0f} -> {losses[-1]:.0f} "
           f"({len(losses)} epochs)")
+    if plot_dir:
+        from ddl25spring_tpu.utils import plot_loss_curves
+
+        out = plot_loss_curves(
+            {"VFL-VAE combined": losses},
+            Path(plot_dir) / "hw2_ex3_loss.png",
+            title="Split VFL-VAE combined loss (homework-2 ex3)", logy=True,
+        )
+        print(f"wrote {out}")
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--plot-dir", default=None,
+                    help="write the reference's convergence figures here")
     args = ap.parse_args()
-    ex1(30 if args.quick else 300)
+    ex1(30 if args.quick else 300, args.plot_dir)
     ex2(30 if args.quick else 300)
-    ex3(100 if args.quick else 1000)
+    ex3(100 if args.quick else 1000, args.plot_dir)

@@ -194,3 +194,16 @@ def test_run_lm_cli_all_strategies_converge():
                      "tp", "sp"]:
         losses = run(LmConfig(strategy=strategy, **base), log_every=5)
         assert losses[-1] < losses[0], (strategy, losses)
+
+
+def test_run_lm_schedule_clip_remat():
+    """LR schedule + grad clipping + block remat compose with the runner."""
+    from ddl25spring_tpu.configs import LmConfig
+    from ddl25spring_tpu.run_lm import run
+
+    losses = run(LmConfig(
+        strategy="single", batch_size=4, seq_l=32, dmodel=32, nr_heads=2,
+        nr_layers=2, nr_iters=6, lr=3e-3, lr_schedule="warmup-cosine",
+        warmup_iters=2, grad_clip=1.0, remat=True,
+    ), log_every=5)
+    assert losses[-1] < losses[0], losses

@@ -134,3 +134,28 @@ def test_hfl_cli_runs_and_checkpoints(tmp_path):
         "--checkpoint-dir", str(tmp_path / "ck"), "--checkpoint-every", "1",
     ])
     assert len(result2.test_accuracy) == 0
+
+
+def test_plots_write_figures(tmp_path):
+    from ddl25spring_tpu.utils import (
+        MetricsLogger,
+        RunResult,
+        plot_accuracy_curves,
+        plot_jsonl_metric,
+        plot_loss_curves,
+    )
+
+    rr = RunResult("FedAvg", 10, 0.1, 100, 1, 0.01, 10)
+    for r in range(3):
+        rr.record_round(1.0, 2 * (r + 1), 50.0 + 10 * r)
+    p1 = plot_accuracy_curves({"FedAvg": rr}, tmp_path / "acc.png")
+    p2 = plot_loss_curves({"perm0": [3.0, 2.0, 1.5]}, tmp_path / "loss.png",
+                          logy=True)
+    jl = tmp_path / "m.jsonl"
+    with MetricsLogger(jl) as log:
+        for r in range(3):
+            log.log("round", round=r, accuracy=60.0 + r)
+    p3 = plot_jsonl_metric(jl, tmp_path / "jl.png", y="accuracy",
+                           event="round")
+    for p in (p1, p2, p3):
+        assert p.exists() and p.stat().st_size > 1000
