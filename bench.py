@@ -149,7 +149,12 @@ def main():
     _stamp("building server (data + mesh + jit round_fn) ...")
     server = build_server()
     rps = timed_rounds(server, args.rounds)
-    _stamp("timed rounds done")
+    _stamp("timed rounds done; evaluating ...")
+    # the north star is rounds/sec AND final accuracy (BASELINE.md): report
+    # test accuracy after the timed rounds (real CIFAR when available;
+    # deterministic synthetic data on the zero-egress container)
+    final_acc = server.test()
+    _stamp("eval done")
     vs = (
         round(rps / CPU_BASELINE_ROUNDS_PER_SEC, 2)
         if CPU_BASELINE_ROUNDS_PER_SEC
@@ -160,6 +165,8 @@ def main():
         "value": round(rps, 4),
         "unit": "rounds/sec",
         "vs_baseline": vs,
+        "final_test_accuracy_pct": round(final_acc, 2),
+        "rounds_timed": args.rounds,
     }))
 
 

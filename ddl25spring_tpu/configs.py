@@ -69,6 +69,10 @@ class LmConfig:
     bpe_vocab_size: int = 1024  # bpe: target vocab (specials+bytes+merges)
     bpe_train_stories: int = 500  # bpe: corpus prefix used for training
     seed: int = 0
+    # harness (same crash-safe pattern as HflConfig)
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0  # iterations; 0 = off
+    metrics_path: str | None = None
 
 
 def _add_dataclass_args(parser: argparse.ArgumentParser, cls) -> None:

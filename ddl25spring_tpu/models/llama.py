@@ -214,8 +214,9 @@ class LlamaFirstStage(nn.Module):
         if embed_only:
             return x
         pos = _positions(tokens.shape[1])
+        block = _block_cls(cfg)
         for i in range(self.nr_layers):
-            x = _block_cls(cfg)(cfg, name=f"block{i}")(x, pos)
+            x = block(cfg, name=f"block{i}")(x, pos)
         return x
 
 
@@ -228,8 +229,9 @@ class LlamaMidStage(nn.Module):
     @nn.compact
     def __call__(self, x):
         pos = _positions(x.shape[1])
+        block = _block_cls(self.config)
         for i in range(self.nr_layers):
-            x = _block_cls(self.config)(self.config, name=f"block{i}")(x, pos)
+            x = block(self.config, name=f"block{i}")(x, pos)
         return x
 
 
@@ -244,8 +246,9 @@ class LlamaLastStage(nn.Module):
     def __call__(self, x):
         cfg = self.config
         pos = _positions(x.shape[1])
+        block = _block_cls(cfg)
         for i in range(self.nr_layers):
-            x = _block_cls(cfg)(cfg, name=f"block{i}")(x, pos)
+            x = block(cfg, name=f"block{i}")(x, pos)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
@@ -269,8 +272,9 @@ class Llama(nn.Module):
         # explicit positions support sequence sharding, where a device's
         # local block starts at a nonzero global offset (parallel/sp.py)
         pos = _positions(tokens.shape[1]) if positions is None else positions
+        block = _block_cls(cfg)
         for i in range(cfg.nr_layers):
-            x = _block_cls(cfg)(cfg, name=f"block{i}")(x, pos)
+            x = block(cfg, name=f"block{i}")(x, pos)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"

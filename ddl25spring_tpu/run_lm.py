@@ -234,15 +234,35 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
     step, params, opt_state, shard = build_trainer(
         cfg, tok.vocab_size if tok is not None else BASE_VOCAB
     )
+
+    # crash-safe checkpoint/resume (same pattern as run_hfl): params,
+    # optimizer state and the NEXT iteration index; the stream resumes at
+    # the same position via its skip offset, so a resumed run consumes the
+    # exact batches an uninterrupted one would
+    ckpt = None
+    start_iter = 0
+    if cfg.checkpoint_dir and cfg.checkpoint_every:
+        from .utils import Checkpointer
+        from .utils.checkpoint import uncommit_restored
+
+        ckpt = Checkpointer(cfg.checkpoint_dir)
+        if ckpt.latest_step() is not None:
+            restored = ckpt.restore(
+                {"params": params, "opt_state": opt_state, "iteration": 0}
+            )
+            params = uncommit_restored(restored["params"])
+            opt_state = uncommit_restored(restored["opt_state"])
+            start_iter = int(restored["iteration"])
+
     stream = PrefetchStream(
-        token_stream(cfg.batch_size, cfg.seq_l, seed=cfg.seed,
-                     stories=stories, tokenizer=tok)
+        token_stream(cfg.batch_size, cfg.seq_l, skip=start_iter,
+                     seed=cfg.seed, stories=stories, tokenizer=tok)
     )
     logger = MetricsLogger(metrics_path) if metrics_path else None
     losses = []
     t0 = time.perf_counter()
     try:
-        for it in range(cfg.nr_iters):
+        for it in range(start_iter, cfg.nr_iters):
             # host tokenization runs in the prefetch thread; jax's async
             # dispatch overlaps the device step with the next host batch
             tokens = shard(jnp.asarray(stream.next_batch()))
@@ -254,10 +274,15 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
                 if logger:
                     logger.log("iter", idx=it, loss=loss,
                                seconds=round(time.perf_counter() - t0, 3))
+            if ckpt is not None and (it + 1) % cfg.checkpoint_every == 0:
+                ckpt.save(it + 1, {"params": params, "opt_state": opt_state,
+                                   "iteration": it + 1})
     finally:
         stream.close()
         if logger:
             logger.close()
+        if ckpt is not None:
+            ckpt.close()
     if cfg.generate_tokens:
         _sample_text(cfg, params, tok)
     return losses
@@ -290,7 +315,7 @@ def main(argv=None):
 
     select_platform()
     cfg = parse_config(LmConfig, argv)
-    return run(cfg)
+    return run(cfg, metrics_path=cfg.metrics_path)
 
 
 if __name__ == "__main__":

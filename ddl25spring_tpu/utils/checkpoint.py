@@ -68,3 +68,23 @@ class Checkpointer:
 
 def _sharding(x):
     return getattr(x, "sharding", None)
+
+
+def uncommit_restored(tree):
+    """Strip device commitment from single-device restored arrays.
+
+    Orbax restores an unsharded template leaf COMMITTED to one device; a
+    later jit then refuses to mix it with mesh-sharded inputs ("incompatible
+    devices").  Freshly-initialised params are uncommitted (jit replicates
+    them freely across a mesh), so resumed state must be too.  Mesh-sharded
+    leaves (pipeline stages, TP shards, ZeRO slices — restored with their
+    sharding preserved) span several devices and pass through untouched."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    def fix(a):
+        if isinstance(a, jax.Array) and len(a.devices()) == 1:
+            return jnp.asarray(np.asarray(a))
+        return a
+
+    return jax.tree.map(fix, tree)

@@ -159,3 +159,20 @@ def test_plots_write_figures(tmp_path):
                            event="round")
     for p in (p1, p2, p3):
         assert p.exists() and p.stat().st_size > 1000
+
+
+def test_hfl_cli_mesh_checkpoint_resume(tmp_path):
+    """Resume must work when the round is MESH-SHARDED: restored params come
+    back committed to one device and have to be un-committed before the jit
+    that mixes them with client data sharded over the 8-device mesh."""
+    from ddl25spring_tpu.run_hfl import main
+
+    args = [
+        "--algorithm", "fedavg", "--nr-clients", "80", "--client-fraction",
+        "0.1", "--batch-size", "100", "--checkpoint-dir",
+        str(tmp_path / "ck"), "--checkpoint-every", "1",
+    ]
+    r1 = main(args + ["--nr-rounds", "1"])
+    assert len(r1.test_accuracy) == 1
+    r2 = main(args + ["--nr-rounds", "2"])  # resumes at round 1, runs 1 more
+    assert len(r2.test_accuracy) == 1
