@@ -140,6 +140,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--measure-cpu-baseline", action="store_true")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of the timed rounds "
+                         "into DIR (view with xprof/tensorboard)")
     args = ap.parse_args()
 
     if args.measure_cpu_baseline:
@@ -148,7 +151,14 @@ def main():
 
     _stamp("building server (data + mesh + jit round_fn) ...")
     server = build_server()
-    rps = timed_rounds(server, args.rounds)
+    if args.profile:
+        from ddl25spring_tpu.utils import profile_trace
+
+        with profile_trace(args.profile):
+            rps = timed_rounds(server, args.rounds)
+        _stamp(f"profiler trace written to {args.profile}")
+    else:
+        rps = timed_rounds(server, args.rounds)
     _stamp("timed rounds done; evaluating ...")
     # the north star is rounds/sec AND final accuracy (BASELINE.md): report
     # test accuracy after the timed rounds (real CIFAR when available;

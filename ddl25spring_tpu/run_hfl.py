@@ -147,14 +147,10 @@ def run(cfg: HflConfig):
         extra = server.extra_state()
         if extra:
             template["extra"] = extra
-        from .utils.checkpoint import uncommit_restored
-
         restored = ckpt.restore(template)
-        # un-commit: restored leaves land pinned to one device, which a
-        # mesh-sharded round_fn (client data sharded over "clients") rejects
-        server.params = uncommit_restored(restored["params"])
+        server.params = restored["params"]
         if extra:
-            server.restore_extra_state(uncommit_restored(restored["extra"]))
+            server.restore_extra_state(restored["extra"])
         start_round = int(restored["round"])
 
     def on_round(r, result):

@@ -243,15 +243,14 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
     start_iter = 0
     if cfg.checkpoint_dir and cfg.checkpoint_every:
         from .utils import Checkpointer
-        from .utils.checkpoint import uncommit_restored
 
         ckpt = Checkpointer(cfg.checkpoint_dir)
         if ckpt.latest_step() is not None:
             restored = ckpt.restore(
                 {"params": params, "opt_state": opt_state, "iteration": 0}
             )
-            params = uncommit_restored(restored["params"])
-            opt_state = uncommit_restored(restored["opt_state"])
+            params = restored["params"]
+            opt_state = restored["opt_state"]
             start_iter = int(restored["iteration"])
 
     stream = PrefetchStream(

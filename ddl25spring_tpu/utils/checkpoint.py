@@ -52,9 +52,9 @@ class Checkpointer:
             else jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=_sharding(x)),
             template,
         )
-        return self._mngr.restore(
+        return uncommit_restored(self._mngr.restore(
             step, args=self._ocp.args.StandardRestore(abstract)
-        )
+        ))
 
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
@@ -71,7 +71,8 @@ def _sharding(x):
 
 
 def uncommit_restored(tree):
-    """Strip device commitment from single-device restored arrays.
+    """Strip device commitment from single-device restored arrays (applied by
+    ``Checkpointer.restore`` to everything it returns).
 
     Orbax restores an unsharded template leaf COMMITTED to one device; a
     later jit then refuses to mix it with mesh-sharded inputs ("incompatible
