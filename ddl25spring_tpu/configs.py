@@ -59,12 +59,15 @@ class LmConfig:
     lr_schedule: str = "const"  # const | cosine | warmup-cosine
     warmup_iters: int = 0      # warmup-cosine: linear warmup length
     grad_clip: float = 0.0     # global-norm gradient clipping; 0 = off
+    accum_steps: int = 1       # gradient accumulation: apply every N steps
     nr_iters: int = 100
     nr_microbatches: int = 3   # intro_PP_1F1B_MB.py microbatch count
     moe_aux_weight: float = 0.01  # ep: load-balancing aux loss weight
     remat: bool = False        # gradient-checkpoint each block (HBM ↓, FLOPs ↑)
     generate_tokens: int = 0   # after training, sample this many tokens
     generate_temperature: float = 0.8
+    eval_every: int = 0        # held-out eval every N iters; 0 = off
+    eval_batches: int = 8      # held-out set size, in batches
     tokenizer: str = "byte"    # byte | bpe (SentencePiece-equivalent)
     bpe_vocab_size: int = 1024  # bpe: target vocab (specials+bytes+merges)
     bpe_train_stories: int = 500  # bpe: corpus prefix used for training

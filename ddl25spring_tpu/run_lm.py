@@ -52,6 +52,12 @@ from .parallel import (
 from .utils import MetricsLogger
 
 
+# strategies whose parameters do NOT remain a full-model pytree (stage- or
+# expert-sharded layouts): generation and held-out eval score with the plain
+# model and skip these
+SHARDED_PARAM_STRATEGIES = ("pp", "1f1b", "dp-pp", "ep")
+
+
 def _tokenizer(cfg: LmConfig, stories):
     """Tokenizer for the run: byte-level (259 ids, None so the stream keeps
     its native fast path) or a BPE trained on a prefix of the story corpus
@@ -105,19 +111,31 @@ def _make_optimizer(cfg: LmConfig):
     """Adam with optional LR schedule and global-norm clipping (the usual LM
     training guards; the reference trains at a fixed lr with no clipping,
     primer/intro.py:22)."""
+    # schedules advance once per OPTIMIZER step; under gradient
+    # accumulation that is once per accum_steps iterations, so horizons
+    # configured in iterations must shrink accordingly or cosine decay
+    # would never complete (and warmup would stretch accum_steps-fold)
+    accum = max(cfg.accum_steps, 1)
+    horizon = -(-cfg.nr_iters // accum)
+    warmup = -(-cfg.warmup_iters // accum)
     if cfg.lr_schedule == "const":
         lr = cfg.lr
     elif cfg.lr_schedule == "cosine":
-        lr = optax.cosine_decay_schedule(cfg.lr, max(cfg.nr_iters, 1))
+        lr = optax.cosine_decay_schedule(cfg.lr, max(horizon, 1))
     elif cfg.lr_schedule == "warmup-cosine":
         lr = optax.warmup_cosine_decay_schedule(
-            0.0, cfg.lr, cfg.warmup_iters, max(cfg.nr_iters, cfg.warmup_iters + 1)
+            0.0, cfg.lr, warmup, max(horizon, warmup + 1)
         )
     else:
         raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
     opt = optax.adam(lr)
     if cfg.grad_clip:
         opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), opt)
+    if cfg.accum_steps > 1:
+        # gradient accumulation: the optimizer buffers grads and applies the
+        # averaged update every accum_steps calls — an effective-batch
+        # multiplier that composes with every strategy's step function
+        opt = optax.MultiSteps(opt, every_k_schedule=cfg.accum_steps)
     return opt
 
 
@@ -172,6 +190,14 @@ def build_trainer(cfg: LmConfig, vocab_size: int = BASE_VOCAB):
         mesh = make_mesh({"data": data}, devices=devices[:data])
         shard = lambda x: jax.device_put(x, dp_data_sharding(mesh))
         if cfg.strategy == "dp-zero":
+            if cfg.accum_steps > 1:
+                raise ValueError(
+                    "dp-zero cannot combine with accum_steps > 1: the "
+                    "MultiSteps wrapper hides inner transforms from ZeRO's "
+                    "elementwise-optimizer check, so a global-norm clip "
+                    "would silently clip per-shard norms instead of failing "
+                    "loudly"
+                )
             step, opt_state = make_zero_dp_train_step(
                 loss_fn, optimizer, mesh, params, donate=True
             )
@@ -231,9 +257,8 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
 
     stories = load_stories(cfg.seed)
     tok = _tokenizer(cfg, stories)
-    step, params, opt_state, shard = build_trainer(
-        cfg, tok.vocab_size if tok is not None else BASE_VOCAB
-    )
+    vocab = tok.vocab_size if tok is not None else BASE_VOCAB
+    step, params, opt_state, shard = build_trainer(cfg, vocab)
 
     # crash-safe checkpoint/resume (same pattern as run_hfl): params,
     # optimizer state and the NEXT iteration index; the stream resumes at
@@ -257,6 +282,7 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
         token_stream(cfg.batch_size, cfg.seq_l, skip=start_iter,
                      seed=cfg.seed, stories=stories, tokenizer=tok)
     )
+    evaluate = _build_evaluator(cfg, tok, shard, stories, vocab)
     logger = MetricsLogger(metrics_path) if metrics_path else None
     losses = []
     t0 = time.perf_counter()
@@ -273,6 +299,14 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
                 if logger:
                     logger.log("iter", idx=it, loss=loss,
                                seconds=round(time.perf_counter() - t0, 3))
+            if evaluate is not None and (it + 1) % cfg.eval_every == 0:
+                val_loss = evaluate(params)
+                ppl = float(jnp.exp(val_loss))
+                print(f"iter {it} val_loss {val_loss:.4f} ppl {ppl:.2f}",
+                      flush=True)
+                if logger:
+                    logger.log("eval", idx=it, val_loss=float(val_loss),
+                               perplexity=ppl)
             if ckpt is not None and (it + 1) % cfg.checkpoint_every == 0:
                 ckpt.save(it + 1, {"params": params, "opt_state": opt_state,
                                    "iteration": it + 1})
@@ -287,13 +321,57 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
     return losses
 
 
+def _build_evaluator(cfg: LmConfig, tok, shard, stories, vocab):
+    """Held-out evaluation (mean next-token loss + perplexity) on a fixed
+    set of batches positioned past the end of the training stream, so the
+    eval text is never trained on.
+
+    Only strategies whose params stay a full-model tree can score with the
+    plain model; pipeline/expert-sharded layouts are skipped (their loss is
+    already reported every training step)."""
+    if not cfg.eval_every:
+        return None
+    if cfg.strategy in SHARDED_PARAM_STRATEGIES:
+        print(f"[eval] skipped: strategy {cfg.strategy!r} shards params away "
+              "from the full-model tree")
+        return None
+    if cfg.eval_batches < 1:
+        raise ValueError(
+            f"eval_every={cfg.eval_every} needs eval_batches >= 1 "
+            f"(got {cfg.eval_batches})"
+        )
+    model = Llama(_model_config(cfg, vocab))
+    # held out by POSITION, not by seed: batches nr_iters.. can never be
+    # consumed by a training run of nr_iters iterations, and the offset is
+    # corpus-agnostic (a real corpus file ignores the stream seed, so a
+    # seed-shifted "validation" stream would replay the training text)
+    eval_stream = token_stream(
+        cfg.batch_size, cfg.seq_l, skip=cfg.nr_iters, seed=cfg.seed,
+        stories=stories, tokenizer=tok,
+    )
+    batches = [shard(jnp.asarray(eval_stream.next_batch()))
+               for _ in range(cfg.eval_batches)]
+
+    @jax.jit
+    def batch_loss(params, tokens):
+        return causal_lm_loss(model.apply(params, tokens), tokens)
+
+    def evaluate(params):
+        total = 0.0
+        for b in batches:
+            total += float(batch_loss(params, b))
+        return total / len(batches)
+
+    return evaluate
+
+
 def _sample_text(cfg: LmConfig, params, tok):
     """Greedy/temperature sampling from the trained model (models.generate);
     only strategies that keep a full-model param tree can decode directly."""
     from .data import ByteTokenizer
     from .models import generate
 
-    if cfg.strategy in ("pp", "1f1b", "dp-pp", "ep"):
+    if cfg.strategy in SHARDED_PARAM_STRATEGIES:
         print(f"[generate] skipped: strategy {cfg.strategy!r} shards params "
               "away from the full-model tree")
         return

@@ -231,3 +231,24 @@ def test_run_lm_checkpoint_resume(tmp_path):
     # uninterrupted logs iters 0..3; the resumed run logs 2..3
     assert abs(full[-1] - resumed[-1]) < 1e-6, (full, resumed)
     assert len(resumed) == 2
+
+
+def test_run_lm_eval_and_accumulation(tmp_path):
+    """Held-out eval (val loss + perplexity events) and gradient
+    accumulation compose with the runner."""
+    from ddl25spring_tpu.configs import LmConfig
+    from ddl25spring_tpu.run_lm import run
+    from ddl25spring_tpu.utils import read_jsonl
+
+    mp = tmp_path / "m.jsonl"
+    losses = run(LmConfig(
+        strategy="single", batch_size=4, seq_l=32, dmodel=32, nr_heads=2,
+        nr_layers=2, nr_iters=8, lr=3e-3, accum_steps=2, eval_every=4,
+        eval_batches=2,
+    ), log_every=4, metrics_path=str(mp))
+    assert losses[-1] < losses[0]
+    evals = [r for r in read_jsonl(mp) if r["event"] == "eval"]
+    assert len(evals) == 2
+    assert all(r["perplexity"] > 1.0 for r in evals)
+    # eval loss should improve as training progresses
+    assert evals[-1]["val_loss"] < evals[0]["val_loss"]
