@@ -45,10 +45,25 @@ class HflConfig:
 
 
 @dataclass(frozen=True)
+class VflConfig:
+    """Vertical-FL experiment (tutorial_2b family)."""
+
+    mode: str = "classify"     # classify (split-NN) | vae (split VFL-VAE)
+    nr_clients: int = 4        # feature-partitioned parties (exercise_2: 2/4/6/8)
+    epochs: int = 300          # reference: 300 (classify), 1000 (vae)
+    batch_size: int = 64       # classify; vae trains full-batch
+    permutation_seed: int = -1  # -1 = natural feature order (exercise_1 perms)
+    seed: int = 0
+    metrics_path: str | None = None
+    plot_dir: str | None = None
+
+
+@dataclass(frozen=True)
 class LmConfig:
     """LLM-parallelism experiment (tutorial_1b family)."""
 
-    strategy: str = "dp"       # single | dp | dp-weight | dp-zero | pp | 1f1b | dp-pp | tp | sp | ep
+    strategy: str = "dp"       # single | dp | dp-weight | dp-zero | dp-topk | dp-int8 | pp | 1f1b | dp-pp | tp | sp | ep
+    compress_ratio: float = 0.01  # dp-topk: fraction of gradient entries kept
     nr_devices: int = 0        # 0 = all
     batch_size: int = 6
     seq_l: int = 256           # primer/intro.py:10

@@ -3,7 +3,9 @@
     python -m ddl25spring_tpu.run_lm --strategy dp --nr-iters 100
 
 Strategies map to the reference's scripts — ``single`` (primer/intro.py),
-``dp``/``dp-weight`` (DP/gradient_aggr, DP/weight_aggr), ``dp-zero``
+``dp``/``dp-weight`` (DP/gradient_aggr, DP/weight_aggr), ``dp-topk``/``dp-int8``
+(communication-compressed DP: top-k error feedback / stochastic int8),
+``dp-zero``
 (ZeRO-sharded optimizer state over the data axis; PAPERS.md), ``pp`` (GPipe
 microbatching, PP/1F1B/intro_PP_1F1B_MB.py), ``1f1b`` (the interleaved
 schedule the reference never got working), ``dp-pp`` (the hybrid 2x3 MP
@@ -185,10 +187,44 @@ def build_trainer(cfg: LmConfig, vocab_size: int = BASE_VOCAB):
         step = _donated_local_step(loss_fn, optimizer)
         return step, params, optimizer.init(params), identity
 
-    if cfg.strategy in ("dp", "dp-weight", "dp-zero"):
+    if cfg.strategy in ("dp", "dp-weight", "dp-zero", "dp-topk", "dp-int8"):
         data = _largest_divisor(cfg.batch_size, n)
         mesh = make_mesh({"data": data}, devices=devices[:data])
         shard = lambda x: jax.device_put(x, dp_data_sharding(mesh))
+        if cfg.strategy in ("dp-topk", "dp-int8"):
+            # communication-compressed DP: each shard sparsifies (top-k with
+            # error feedback) or stochastically int8-quantizes its gradient
+            # before the cross-device mean
+            from .parallel import (
+                init_compression_state,
+                make_compressed_dp_train_step,
+            )
+
+            raw_step = make_compressed_dp_train_step(
+                loss_fn, optimizer, mesh,
+                method=cfg.strategy.removeprefix("dp-"),
+                ratio=cfg.compress_ratio, donate=True,
+            )
+            carry = {
+                "residual": init_compression_state(params, mesh),
+                "it": 0,
+            }
+            base_key = jax.random.key(cfg.seed)
+
+            def step(params, opt_state, tokens):
+                # the error-feedback residual and quantization key are
+                # threaded here so the runner keeps its uniform
+                # step(params, opt_state, tokens) contract; the residual is
+                # NOT checkpointed — a resumed run restarts error feedback
+                # from zero, which only costs a few re-warmup steps
+                key = jax.random.fold_in(base_key, carry["it"])
+                carry["it"] += 1
+                params, opt_state, carry["residual"], loss = raw_step(
+                    params, opt_state, carry["residual"], tokens, key
+                )
+                return params, opt_state, loss
+
+            return step, params, optimizer.init(params), shard
         if cfg.strategy == "dp-zero":
             if cfg.accum_steps > 1:
                 raise ValueError(

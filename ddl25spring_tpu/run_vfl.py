@@ -1,0 +1,108 @@
+"""CLI runner for vertical-FL experiments (the tutorial_2b family).
+
+    python -m ddl25spring_tpu.run_vfl --mode classify --nr-clients 4
+    python -m ddl25spring_tpu.run_vfl --mode vae --epochs 1000
+
+``classify`` trains the split-NN (per-party bottom models, server top —
+lab/tutorial_2b/vfl.py) on heart.csv and reports test accuracy; ``vae``
+trains the split VFL-VAE (per-party encoders/decoders, server VAE over the
+concatenated latent — lab/tutorial_2b/exercise_3.py) and reports the
+combined-loss trajectory.  ``--nr-clients`` reproduces the exercise-2
+client-scaling grid point; ``--permutation-seed`` the exercise-1 feature
+permutations.  heart.csv loads real from the reference mount, so accuracies
+are directly comparable to the homework-2 outputs (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .configs import VflConfig, parse_config
+from .utils import MetricsLogger
+
+
+def _partitions(cfg: VflConfig):
+    from .data import load_heart_classification, load_heart_df
+    from .data.heart import CATEGORICAL
+    from .vfl.splitnn import partition_features
+
+    df, _ = load_heart_df()
+    d = load_heart_classification()
+    raw = [c for c in df.columns if c != "target"]
+    perm = (
+        None if cfg.permutation_seed < 0
+        else np.random.default_rng(cfg.permutation_seed).permutation(len(raw))
+    )
+    parts = partition_features(raw, d.feature_names, CATEGORICAL,
+                               cfg.nr_clients, permutation=perm)
+    idx = {n: i for i, n in enumerate(d.feature_names)}
+    slices = [np.array([idx[c] for c in cols]) for cols in parts]
+    return d, slices
+
+
+def run(cfg: VflConfig):
+    from .vfl import VFLNetwork, VFLVAE
+
+    d, slices = _partitions(cfg)
+    logger = MetricsLogger(cfg.metrics_path) if cfg.metrics_path else None
+    log = (
+        (lambda epoch, loss: logger.log("epoch", idx=epoch, loss=loss))
+        if logger else None
+    )
+
+    try:
+        if cfg.mode == "classify":
+            y1h = np.eye(2, dtype=np.float32)[d.y]
+            split = int(0.8 * len(d.y))
+            net = VFLNetwork(feature_slices=slices,
+                             outs_per_party=[2 * len(s) for s in slices])
+            history = net.train_with_settings(
+                cfg.epochs, cfg.batch_size, d.x[:split], y1h[:split],
+                log_loss=log,
+            )
+            acc, loss = net.test(d.x[split:], y1h[split:])
+            print(f"{cfg.nr_clients} clients: test acc {acc * 100:.2f}% "
+                  f"(test loss {loss:.4f})")
+            curves = {f"{cfg.nr_clients} clients": history}
+            result = acc
+        elif cfg.mode == "vae":
+            x_clients = [d.x[:, s] for s in slices]
+            vae = VFLVAE(feature_slices=slices)
+            history = vae.train(x_clients, epochs=cfg.epochs)
+            if logger:
+                for e, l in enumerate(history):
+                    logger.log("epoch", idx=e, loss=l)
+            print(f"combined loss: {history[0]:.0f} -> {history[-1]:.0f} "
+                  f"({len(history)} epochs)")
+            curves = {"VFL-VAE combined": history}
+            result = history[-1]
+        else:
+            raise ValueError(f"unknown mode {cfg.mode!r}")
+    finally:
+        if logger:
+            logger.close()
+
+    if cfg.plot_dir:
+        from pathlib import Path
+
+        from .utils import plot_loss_curves
+
+        out = plot_loss_curves(
+            curves, Path(cfg.plot_dir) / f"vfl_{cfg.mode}_loss.png",
+            title=f"VFL {cfg.mode} training loss "
+                  f"({cfg.nr_clients} parties)",
+            logy=cfg.mode == "vae",
+        )
+        print(f"wrote {out}")
+    return result
+
+
+def main(argv=None):
+    from .utils.platform import select_platform
+
+    select_platform()
+    return run(parse_config(VflConfig, argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,7 @@ from ddl25spring_tpu.gen.vae_trainer import (  # noqa: E402
 )
 
 
-def main(quick=False):
+def main(quick=False, plot_dir=None):
     d = load_heart_classification()
     n = d.x.shape[0]
     split = int(0.8 * n)
@@ -40,6 +40,15 @@ def main(quick=False):
     epochs = 30 if quick else 200
     model, variables, losses = train_vae(xy[:split], epochs=epochs, seed=0)
     print(f"VAE loss: {losses[0]:.1f} -> {losses[-1]:.1f} ({epochs} epochs)")
+    if plot_dir:
+        from ddl25spring_tpu.utils import plot_loss_curves
+
+        out = plot_loss_curves(
+            {"VAE (MSE+KLD)": losses}, Path(plot_dir) / "vae_loss.png",
+            title="Tabular VAE training loss (generative-modeling.py)",
+            logy=True,
+        )
+        print(f"wrote {out}")
 
     mu, logvar = encode_posterior(model, variables, xy[:split])
     synth = sample_synthetic(model, variables, mu, logvar, nr_samples=split)
@@ -55,4 +64,6 @@ def main(quick=False):
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    main(ap.parse_args().quick)
+    ap.add_argument("--plot-dir", default=None)
+    args = ap.parse_args()
+    main(args.quick, args.plot_dir)

@@ -25,7 +25,7 @@ from ddl25spring_tpu.run_hfl import build_server  # noqa: E402
 from ddl25spring_tpu.configs import HflConfig  # noqa: E402
 
 
-def main(quick=False):
+def main(quick=False, plot_dir=None):
     rounds = 3 if quick else 10
     nr_clients = 20 if quick else 50
     nr_malicious = 4 if quick else 10
@@ -35,6 +35,7 @@ def main(quick=False):
         ["mean", "krum", "multi-krum", "trimmed-mean", "median", "consensus"]
     print(f"{'attack':12s} {'aggregator':14s} final acc")
     for attack in attacks:
+        curves = {}
         for agg in aggs:
             cfg = HflConfig(
                 algorithm="fedsgd", nr_clients=nr_clients,
@@ -46,9 +47,20 @@ def main(quick=False):
             server = build_server(cfg)
             result = server.run(rounds)
             print(f"{attack:12s} {agg:14s} {result.test_accuracy[-1]:6.2f}%")
+            curves[agg] = result
+        if plot_dir:
+            from ddl25spring_tpu.utils import plot_accuracy_curves
+
+            out = plot_accuracy_curves(
+                curves, Path(plot_dir) / f"robust_{attack}.png",
+                title=f"Robust aggregation under {attack} attack",
+            )
+            print(f"wrote {out}")
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    main(ap.parse_args().quick)
+    ap.add_argument("--plot-dir", default=None)
+    args = ap.parse_args()
+    main(args.quick, args.plot_dir)

@@ -252,3 +252,17 @@ def test_run_lm_eval_and_accumulation(tmp_path):
     assert all(r["perplexity"] > 1.0 for r in evals)
     # eval loss should improve as training progresses
     assert evals[-1]["val_loss"] < evals[0]["val_loss"]
+
+
+def test_run_lm_compressed_dp_strategies():
+    """CLI-exposed compressed DP (top-k error feedback, stochastic int8)
+    trains and reduces loss on the virtual mesh."""
+    from ddl25spring_tpu.configs import LmConfig
+    from ddl25spring_tpu.run_lm import run
+
+    for strategy in ("dp-topk", "dp-int8"):
+        losses = run(LmConfig(
+            strategy=strategy, batch_size=8, seq_l=32, dmodel=32, nr_heads=2,
+            nr_layers=2, nr_iters=8, lr=3e-3, compress_ratio=0.05,
+        ), log_every=4)
+        assert losses[-1] < losses[0], (strategy, losses)

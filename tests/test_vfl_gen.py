@@ -135,3 +135,22 @@ def test_evaluator_learns(heart):
     )
     assert best > 0.6
     assert history[-1][0] > history[0][0]  # train acc improves
+
+
+def test_run_vfl_cli_both_modes(tmp_path):
+    """The VFL CLI trains both the split-NN and the split VFL-VAE, logs
+    JSONL, and writes the loss figure."""
+    from ddl25spring_tpu.run_vfl import main
+    from ddl25spring_tpu.utils import read_jsonl
+
+    acc = main(["--mode", "classify", "--epochs", "15", "--nr-clients", "3",
+                "--metrics-path", str(tmp_path / "c.jsonl"),
+                "--plot-dir", str(tmp_path)])
+    assert 0.4 <= acc <= 1.0
+    assert (tmp_path / "vfl_classify_loss.png").exists()
+    recs = read_jsonl(tmp_path / "c.jsonl")
+    assert len(recs) == 15 and recs[-1]["loss"] < recs[0]["loss"]
+
+    final = main(["--mode", "vae", "--epochs", "30",
+                  "--plot-dir", str(tmp_path)])
+    assert final > 0 and (tmp_path / "vfl_vae_loss.png").exists()
