@@ -19,7 +19,7 @@ from dataclasses import dataclass
 class HflConfig:
     """Horizontal-FL experiment (tutorial_1a / homework-1 family)."""
 
-    algorithm: str = "fedavg"  # centralized | fedsgd | fedsgd-weight | fedavg | fedprox | fedopt
+    algorithm: str = "fedavg"  # centralized | fedsgd | fedsgd-weight | fedavg | fedprox | fedopt | fedbuff
     dataset: str = "mnist"     # mnist | cifar10
     nr_clients: int = 100      # N
     client_fraction: float = 0.1  # C
@@ -33,6 +33,9 @@ class HflConfig:
     prox_mu: float = 0.0       # FedProx proximal coefficient (fedprox)
     server_optimizer: str = "adam"  # fedopt: sgd | avgm | adam | yogi
     server_lr: float = 0.02    # fedopt server-side learning rate
+    staleness_window: int = 4  # fedbuff: versions a client can lag behind
+    staleness_exp: float = 0.5  # fedbuff: delta weight (1+staleness)^-exp
+    server_eta: float = 1.0    # fedbuff: server application rate
     dropout_rate: float = 0.0  # per-round client failure probability
     # robust aggregation (the missing course part 3; SURVEY.md §2.2)
     aggregator: str = "mean"   # mean | krum | multi-krum | trimmed-mean | median | consensus (fedsgd only)
@@ -42,6 +45,7 @@ class HflConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # rounds; 0 = off
     metrics_path: str | None = None
+    plot_dir: str | None = None  # write the accuracy-vs-round figure here
 
 
 @dataclass(frozen=True)

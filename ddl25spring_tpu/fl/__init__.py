@@ -5,6 +5,7 @@ from .engine import (
     make_evaluator,
     sample_clients,
 )
+from .fedbuff import FedBuffServer, init_history, make_fedbuff_round
 from .task import Task, classification_task, mnist_task
 from .servers import (
     Server,
@@ -32,4 +33,7 @@ __all__ = [
     "FedSgdWeightServer",
     "FedAvgServer",
     "FedOptServer",
+    "FedBuffServer",
+    "init_history",
+    "make_fedbuff_round",
 ]

@@ -1,0 +1,169 @@
+"""Asynchronous FL: FedBuff-style staleness-weighted buffered aggregation.
+
+The reference's servers are strictly synchronous — every sampled client
+finishes before the round closes (hfl_complete.py:365-373), so a slow client
+stalls the round.  Real federated systems aggregate asynchronously: the
+server applies a buffer of K client *deltas* as they arrive, each computed
+against whatever (stale) model version its client last pulled (FedBuff,
+Nguyen et al., AISTATS 2022 — public recipe).
+
+TPU-native simulation, one jitted SPMD program per tick:
+
+- the server keeps the last ``staleness_window`` param versions as ONE
+  stacked pytree (leading version axis — static shape, no Python history);
+- each tick samples K clients and a staleness ``d_i ∈ [0, window)`` per
+  client; client i trains from version ``d_i`` ticks ago (a per-client
+  gather over the version axis, vmapped like everything else);
+- deltas are combined with weights ``n_k / (1 + d_i)^staleness_exp`` —
+  stale work counts less — and applied with server rate ``server_eta``;
+- the new params are pushed into the version stack (roll + overwrite).
+
+With ``staleness_window=1`` every client trains on the current params and
+the tick reduces EXACTLY to a synchronous FedAvg round (the oracle
+``tests/test_fl_extensions.py`` pins, same key discipline as
+``engine.make_fl_round``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.trees import tree_weighted_mean
+from .engine import sample_clients
+
+
+def make_fedbuff_round(
+    client_update,
+    x,
+    y,
+    counts,
+    nr_sampled: int,
+    staleness_window: int = 4,
+    staleness_exp: float = 0.5,
+    server_eta: float = 1.0,
+):
+    """Build ``tick(history, base_key, tick_idx) -> history`` where
+    ``history`` is the params pytree with a leading ``staleness_window``
+    version axis (index 0 = current).  ``client_update`` has the engine
+    contract ``(params, x_i, y_i, count_i, key_i) -> local_params``.
+    """
+    if staleness_window < 1:
+        raise ValueError(f"staleness_window must be >= 1, got {staleness_window}")
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    counts = jnp.asarray(counts)
+    nr_clients = x.shape[0]
+    W = staleness_window
+
+    # client data enters as ARGUMENTS, not closure captures (see
+    # engine.make_fl_round: captured arrays are baked into the HLO as
+    # constants — slow compiles, and a compile-upload failure on
+    # remote-compile TPU frontends for CIFAR-sized client stacks)
+    @jax.jit
+    def _tick(history, base_key, tick_idx, x, y, counts):
+        round_key = jax.random.fold_in(base_key, tick_idx)
+        # same split arity as engine.make_fl_round so the W=1 oracle samples
+        # the exact same clients as a synchronous FedAvg round
+        sample_key, stale_key, _ = jax.random.split(round_key, 3)
+        sel = sample_clients(sample_key, nr_clients, nr_sampled)
+        # staleness 0 for the window=1 oracle; otherwise per-client uniform
+        stale = (
+            jnp.zeros((nr_sampled,), jnp.int32)
+            if W == 1
+            else jax.random.randint(stale_key, (nr_sampled,), 0, W)
+        )
+
+        xs = jnp.take(x, sel, axis=0)
+        ys = jnp.take(y, sel, axis=0)
+        cs = jnp.take(counts, sel, axis=0)
+        keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(sel)
+
+        def one_client(d, x_i, y_i, c_i, k_i):
+            base = jax.tree.map(lambda h: h[d], history)
+            local = client_update(base, x_i, y_i, c_i, k_i)
+            return jax.tree.map(jnp.subtract, local, base)
+
+        deltas = jax.vmap(one_client)(stale, xs, ys, cs, keys)
+
+        weights = cs.astype(jnp.float32) / (1.0 + stale.astype(jnp.float32)) ** staleness_exp
+        weights = weights / jnp.sum(weights)
+        delta = tree_weighted_mean(deltas, weights)
+
+        current = jax.tree.map(lambda h: h[0], history)
+        new = jax.tree.map(lambda p, d: p + server_eta * d, current, delta)
+        # push the new version: roll the axis and overwrite slot 0
+        return jax.tree.map(
+            lambda h, n: jnp.roll(h, 1, axis=0).at[0].set(n), history, new
+        )
+
+    def tick(history, base_key, tick_idx):
+        return _tick(history, base_key, tick_idx, x, y, counts)
+
+    return tick
+
+
+def init_history(params, staleness_window: int):
+    """Stack ``params`` into the version-axis layout ``tick`` consumes
+    (every slot starts at the initial params, like a fleet that all pulled
+    version 0)."""
+    return jax.tree.map(
+        lambda p: jnp.broadcast_to(p[None], (staleness_window,) + p.shape),
+        params,
+    )
+
+
+class FedBuffServer:
+    """Asynchronous-FL server with the same run/metrics surface as the
+    synchronous family (fl.servers): ``run(nr_rounds)`` returns a
+    ``RunResult`` whose message-count model still counts 2 messages per
+    sampled client per tick (pull + push)."""
+
+    def __init__(self, task, lr: float, batch_size: int, client_data,
+                 client_fraction: float, nr_local_epochs: int, seed: int,
+                 staleness_window: int = 4, staleness_exp: float = 0.5,
+                 server_eta: float = 1.0):
+        from .engine import make_local_sgd_update
+        from .servers import DecentralizedServer
+
+        # reuse the synchronous server's bookkeeping via composition (the
+        # run loop is identical; only round_fn and params layout differ)
+        self._inner = DecentralizedServer(
+            task, lr, batch_size, client_data, client_fraction, seed
+        )
+        self._inner.algorithm = "FedBuff"
+        self._inner.nr_local_epochs = nr_local_epochs
+        update = make_local_sgd_update(
+            task.loss_fn, lr, batch_size, nr_local_epochs
+        )
+        tick = make_fedbuff_round(
+            update, client_data.x, client_data.y, client_data.counts,
+            self._inner.nr_clients_per_round,
+            staleness_window=staleness_window,
+            staleness_exp=staleness_exp, server_eta=server_eta,
+        )
+        history = init_history(self._inner.params, staleness_window)
+
+        evaluate = self._inner._evaluate
+
+        def round_fn(history, base_key, round_idx):
+            return tick(history, base_key, round_idx)
+
+        self._inner.round_fn = round_fn
+        self._inner.params = history
+        # evaluate the CURRENT version (slot 0) of the stacked history
+        self._inner._evaluate = lambda h: evaluate(
+            jax.tree.map(lambda l: l[0], h)
+        )
+
+    def run(self, nr_rounds: int, start_round: int = 0, on_round=None):
+        return self._inner.run(nr_rounds, start_round=start_round,
+                               on_round=on_round)
+
+    @property
+    def params(self):
+        """Current (slot-0) params, unstacked."""
+        return jax.tree.map(lambda l: l[0], self._inner.params)
+
+    def test(self) -> float:
+        return self._inner.test()

@@ -77,6 +77,27 @@ def build_server(cfg: HflConfig):
         return CentralizedServer(task, cfg.lr, cfg.batch_size, cfg.seed,
                                  train_x=ds.train_x, train_y=ds.train_y)
 
+    if cfg.algorithm == "fedbuff":
+        # async server: deltas + staleness weights; robust aggregators and
+        # attacks operate on whole updates and are not defined for it here
+        if cfg.aggregator != "mean" or cfg.attack != "none" or cfg.dropout_rate:
+            raise ValueError(
+                "fedbuff does not combine with robust aggregators, attacks, "
+                "or dropout_rate (async staleness already models lag; "
+                "failure simulation is not wired into the delta buffer)"
+            )
+        from .fl import FedBuffServer
+
+        client_data = split_dataset(ds.train_x, ds.train_y, cfg.nr_clients,
+                                    cfg.iid, cfg.seed,
+                                    pad_multiple=cfg.batch_size)
+        return FedBuffServer(
+            task, cfg.lr, cfg.batch_size, client_data, cfg.client_fraction,
+            cfg.nr_local_epochs, cfg.seed,
+            staleness_window=cfg.staleness_window,
+            staleness_exp=cfg.staleness_exp, server_eta=cfg.server_eta,
+        )
+
     pad = cfg.batch_size if cfg.algorithm in ("fedavg", "fedprox", "fedopt") else 1
     client_data = split_dataset(ds.train_x, ds.train_y, cfg.nr_clients,
                                 cfg.iid, cfg.seed, pad_multiple=pad)
@@ -133,6 +154,13 @@ def build_server(cfg: HflConfig):
 
 
 def run(cfg: HflConfig):
+    # fail before any dataset load / server build / checkpoint-dir creation
+    if (cfg.algorithm == "fedbuff" and cfg.checkpoint_dir
+            and cfg.checkpoint_every):
+        raise ValueError(
+            "checkpointing is not supported for fedbuff yet (its state is "
+            "the stacked version history, not a flat params tree)"
+        )
     server = build_server(cfg)
     logger = MetricsLogger(cfg.metrics_path) if cfg.metrics_path else None
     ckpt = (Checkpointer(cfg.checkpoint_dir)
@@ -176,6 +204,19 @@ def run(cfg: HflConfig):
         logger.close()
     if ckpt is not None:
         ckpt.close()
+    if cfg.plot_dir and result.test_accuracy:
+        from pathlib import Path
+
+        from .utils import plot_accuracy_curves
+
+        label = f"{result.algorithm} N={cfg.nr_clients} C={cfg.client_fraction}"
+        out = plot_accuracy_curves(
+            {label: result},
+            Path(cfg.plot_dir) / f"hfl_{cfg.algorithm}_accuracy.png",
+            title="Test accuracy per round "
+                  "(horizontal-federated-learning.ipynb cell 37)",
+        )
+        print(f"wrote {out}")
     return result
 
 

@@ -55,7 +55,8 @@ def run(cfg: VflConfig):
             y1h = np.eye(2, dtype=np.float32)[d.y]
             split = int(0.8 * len(d.y))
             net = VFLNetwork(feature_slices=slices,
-                             outs_per_party=[2 * len(s) for s in slices])
+                             outs_per_party=[2 * len(s) for s in slices],
+                             seed=cfg.seed)
             history = net.train_with_settings(
                 cfg.epochs, cfg.batch_size, d.x[:split], y1h[:split],
                 log_loss=log,
@@ -67,7 +68,7 @@ def run(cfg: VflConfig):
             result = acc
         elif cfg.mode == "vae":
             x_clients = [d.x[:, s] for s in slices]
-            vae = VFLVAE(feature_slices=slices)
+            vae = VFLVAE(feature_slices=slices, seed=cfg.seed)
             history = vae.train(x_clients, epochs=cfg.epochs)
             if logger:
                 for e, l in enumerate(history):

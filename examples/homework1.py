@@ -4,9 +4,10 @@ A1 — FedSGD weight-update ≡ gradient-update (cells 13-18: the reference
      shows a 0.0 accuracy delta over 5 rounds in two configs);
 A2 — N/C sweep with the FedAvg-vs-FedSGD table (cell 22 ground truth:
      e.g. N=10 C=0.1 -> FedAvg 93.22%, FedSGD 43.23% on real MNIST);
-A3 — local-epochs sweep E in {1, 2, 4} and IID vs non-IID.
+A3 — local-epochs sweep E in {1, 2, 4} and IID vs non-IID;
+B  — microbatched PP and hybrid DPxPP (cells 41-48) via the LM runner.
 
-Run:  python examples/homework1.py [--quick] [--part A1|A2|A3]
+Run:  python examples/homework1.py [--quick] [--part A1|A2|A3|B]
 
 Numbers match the reference's table only with real MNIST available
 (DDL25_DATA_DIR); on the zero-egress container the synthetic fallback shows
@@ -83,6 +84,35 @@ def part_a2(rounds=10, quick=False, plot_dir=None):
         print(f"wrote {out}")
 
 
+def part_b(quick=False):
+    """B1/B2 — microbatched pipeline parallelism and the hybrid DP x PP
+    topology (homework-1.ipynb cells 41-48).  The reference's B2 deadlocks
+    (author's note, cell 48); here both are single SPMD programs over an
+    8-device mesh and just train."""
+    import jax
+
+    from ddl25spring_tpu.configs import LmConfig
+    from ddl25spring_tpu.run_lm import run
+
+    if len(jax.devices()) < 6:
+        print("== B skipped: pipeline parts need >= 6 devices; run with "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+              "JAX_PLATFORMS=cpu for the virtual mesh ==")
+        return
+    iters = 6 if quick else 60
+    base = dict(batch_size=12, seq_l=64 if quick else 256,
+                dmodel=32 if quick else 288, nr_heads=2 if quick else 6,
+                nr_layers=6, nr_iters=iters, nr_microbatches=3, lr=3e-3)
+    print("== B1: microbatched (GPipe) pipeline, 3 stages ==")
+    losses = run(LmConfig(strategy="pp", **base), log_every=max(1, iters // 4))
+    print(f"loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+    print("== B2: hybrid DP x PP (2 pipelines x 3 stages; reference "
+          "deadlocks here) ==")
+    losses = run(LmConfig(strategy="dp-pp", **base),
+                 log_every=max(1, iters // 4))
+    print(f"loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+
 def part_a3(rounds=10, quick=False, plot_dir=None):
     """Local epochs and non-IID degradation."""
     print("== A3: E sweep, IID vs non-IID ==")
@@ -117,3 +147,5 @@ if __name__ == "__main__":
         part_a2(rounds or 10, args.quick, args.plot_dir)
     if args.part in ("A3", "all"):
         part_a3(rounds or 10, args.quick, args.plot_dir)
+    if args.part in ("B", "all"):
+        part_b(args.quick)

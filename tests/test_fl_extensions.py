@@ -263,3 +263,50 @@ def test_compressed_dp_rejects_unknown_method():
     with pytest.raises(ValueError, match="method"):
         make_compressed_dp_train_step(loss_fn, optax.sgd(0.1), mesh,
                                       method="fp4")
+
+
+def test_fedbuff_window1_equals_fedavg_round():
+    """With staleness_window=1 and server_eta=1, a FedBuff tick IS a
+    synchronous FedAvg round: same sampled clients, same client keys, same
+    n_k weighting — params match the FedAvgServer round function."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu.fl import FedAvgServer, FedBuffServer, mnist_task
+    from ddl25spring_tpu.data import load_mnist, split_dataset
+
+    ds = load_mnist()
+    task = mnist_task(ds.test_x[:500], ds.test_y[:500])
+    data = split_dataset(ds.train_x[:2000], ds.train_y[:2000], 20, True, 7,
+                         pad_multiple=100)
+
+    sync = FedAvgServer(task, 0.05, 100, data, 0.25, 1, seed=3)
+    buff = FedBuffServer(task, 0.05, 100, data, 0.25, 1, seed=3,
+                         staleness_window=1, server_eta=1.0)
+    r_sync = sync.run(3)
+    r_buff = buff.run(3)
+    np.testing.assert_allclose(r_sync.test_accuracy, r_buff.test_accuracy,
+                               atol=1e-3)
+    chex = __import__("chex")
+    chex.assert_trees_all_close(sync.params, buff.params, atol=1e-5)
+
+
+def test_fedbuff_stale_training_converges():
+    """With a real staleness window the async server still learns, and
+    staler deltas get down-weighted rather than discarded."""
+    from ddl25spring_tpu.fl import FedBuffServer, mnist_task
+    from ddl25spring_tpu.data import load_mnist, split_dataset
+
+    ds = load_mnist()
+    task = mnist_task(ds.test_x[:500], ds.test_y[:500])
+    data = split_dataset(ds.train_x[:2000], ds.train_y[:2000], 20, True, 7,
+                         pad_multiple=100)
+    server = FedBuffServer(task, 0.05, 100, data, 0.25, 1, seed=3,
+                           staleness_window=4, staleness_exp=0.5)
+    result = server.run(12)
+    # slower than synchronous FedAvg early on (stale slots start at the
+    # initial params), but clearly learning: measured trajectory reaches
+    # ~42% by tick 12 from ~11% random
+    assert result.test_accuracy[-1] > result.test_accuracy[0]
+    assert result.test_accuracy[-1] > 30.0
