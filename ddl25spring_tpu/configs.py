@@ -33,6 +33,8 @@ class HflConfig:
     prox_mu: float = 0.0       # FedProx proximal coefficient (fedprox)
     server_optimizer: str = "adam"  # fedopt: sgd | avgm | adam | yogi
     server_lr: float = 0.02    # fedopt server-side learning rate
+    dp_clip: float = 0.0       # fedavg/fedprox: client-delta L2 clip (DP-FedAvg)
+    dp_noise_mult: float = 0.0  # fedavg/fedprox: Gaussian noise multiplier
     staleness_window: int = 4  # fedbuff: versions a client can lag behind
     staleness_exp: float = 0.5  # fedbuff: delta weight (1+staleness)^-exp
     server_eta: float = 1.0    # fedbuff: server application rate

@@ -174,6 +174,8 @@ def make_fl_round(
     mesh=None,
     clients_axis: str = "clients",
     dropout_rate: float = 0.0,
+    dp_clip: float = 0.0,
+    dp_noise_mult: float = 0.0,
 ):
     """Build the jitted one-round function of a decentralized server.
 
@@ -201,6 +203,20 @@ def make_fl_round(
     weights (no n_k weighting a Byzantine client could lie about), which
     would make dropout a silent no-op; that combination raises instead.
 
+    ``dp_clip > 0`` turns the round into client-level DP-FedAvg (the public
+    McMahan et al. 2018 recipe): each client's *delta* from the round-start
+    params is L2-clipped to ``dp_clip``, deltas are averaged UNIFORMLY
+    (n_k weights would make the sensitivity data-dependent, breaking the DP
+    accounting), and Gaussian noise with per-coordinate std
+    ``dp_noise_mult * dp_clip / nr_contributing`` is added to the averaged
+    delta (``nr_contributing`` = clients with nonzero weight — the survivor
+    count under ``dropout_rate``, since the mean's sensitivity is
+    clip / #contributors).
+    ``dp_noise_mult = 0`` gives pure clipping (useful on its own against
+    magnitude-based poisoning).  Incompatible with a custom ``aggregator``
+    (robust rules operate on raw updates) and with ``apply_aggregate``
+    consumers that expect gradients rather than parameters.
+
     With ``mesh``, the sampled-client axis is sharded over ``clients_axis`` —
     the north-star execution model (BASELINE.json: "one core per simulated
     client", generalised to clients-per-core): client datasets live sharded
@@ -218,6 +234,19 @@ def make_fl_round(
             "dropout_rate cannot combine with a custom aggregator: robust "
             "aggregators ignore aggregation weights, so zero-weight dropout "
             "would silently not exclude anyone"
+        )
+    if dp_clip < 0 or dp_noise_mult < 0:
+        raise ValueError("dp_clip and dp_noise_mult must be >= 0")
+    if dp_noise_mult and not dp_clip:
+        raise ValueError(
+            "dp_noise_mult needs dp_clip > 0: the noise scale is calibrated "
+            "to the clip bound (sensitivity), unbounded deltas have no DP "
+            "guarantee"
+        )
+    if dp_clip and aggregator is not None:
+        raise ValueError(
+            "dp_clip cannot combine with a custom aggregator: DP clips and "
+            "noises the uniform delta mean, robust rules consume raw updates"
         )
     x = jnp.asarray(x)
     y = jnp.asarray(y)
@@ -307,7 +336,26 @@ def make_fl_round(
                 updates,
             )
 
-        weights = jnp.where(live, cs.astype(jnp.float32), 0.0)
+        if dp_clip:
+            # client-level DP: clip each client's delta from the round-start
+            # params to L2 <= dp_clip; uniform weights (n_k would leak)
+            deltas = jax.tree.map(lambda u, p: u - p, updates, params)
+            sq = sum(
+                jnp.sum(jnp.square(l).reshape(l.shape[0], -1), axis=1)
+                for l in jax.tree.leaves(deltas)
+            )
+            scale = jnp.minimum(
+                1.0, dp_clip / jnp.maximum(jnp.sqrt(sq), 1e-12)
+            )
+            updates = jax.tree.map(
+                lambda d, p: p + d * scale.reshape(
+                    (-1,) + (1,) * (d.ndim - 1)
+                ),
+                deltas, params,
+            )
+            weights = jnp.where(live, 1.0, 0.0)
+        else:
+            weights = jnp.where(live, cs.astype(jnp.float32), 0.0)
         if dropout_rate:
             survived = (
                 jax.random.uniform(drop_key, (nr_shard,)) >= dropout_rate
@@ -317,8 +365,21 @@ def make_fl_round(
                 jnp.any(survived & live), survived, jnp.ones_like(survived)
             )
             weights = jnp.where(survived, weights, 0.0)
+        nr_contributing = jnp.sum(weights > 0)
         weights = weights / jnp.sum(weights)
         aggregate = aggregator(updates, weights, agg_key)
+        if dp_clip and dp_noise_mult:
+            # Gaussian mechanism on the delta mean: per-coordinate std
+            # noise_mult * sensitivity, sensitivity = clip / #contributors
+            std = dp_noise_mult * dp_clip / nr_contributing
+            leaves, treedef = jax.tree.flatten(aggregate)
+            noisy = [
+                l + std * jax.random.normal(
+                    jax.random.fold_in(agg_key, i), l.shape, l.dtype
+                )
+                for i, l in enumerate(leaves)
+            ]
+            aggregate = jax.tree.unflatten(treedef, noisy)
         return apply_aggregate(params, aggregate)
 
     def round_fn(params, base_key, round_idx):

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from ..utils.trees import tree_weighted_mean
 from .engine import sample_clients
+from .servers import DecentralizedServer as _DecentralizedServer
 
 
 def make_fedbuff_round(
@@ -113,57 +114,47 @@ def init_history(params, staleness_window: int):
     )
 
 
-class FedBuffServer:
-    """Asynchronous-FL server with the same run/metrics surface as the
-    synchronous family (fl.servers): ``run(nr_rounds)`` returns a
-    ``RunResult`` whose message-count model still counts 2 messages per
-    sampled client per tick (pull + push)."""
+def _current(history):
+    """Slot-0 (newest) version of the stacked history."""
+    return jax.tree.map(lambda l: l[0], history)
+
+
+class FedBuffServer(_DecentralizedServer):
+    """Asynchronous-FL server, a regular :class:`DecentralizedServer`
+    subclass: same ``run``/``RunResult`` surface, message-count model (2
+    messages per sampled client per tick), and — because ``self.params``
+    IS the server state like everywhere else — generic checkpoint/resume.
+
+    The one layout difference: ``self.params`` is the stacked
+    version-history pytree (leading ``staleness_window`` axis), since that
+    is the state an async server genuinely carries.  Use
+    :attr:`current_params` for the newest (slot-0) model."""
 
     def __init__(self, task, lr: float, batch_size: int, client_data,
                  client_fraction: float, nr_local_epochs: int, seed: int,
                  staleness_window: int = 4, staleness_exp: float = 0.5,
                  server_eta: float = 1.0):
         from .engine import make_local_sgd_update
-        from .servers import DecentralizedServer
 
-        # reuse the synchronous server's bookkeeping via composition (the
-        # run loop is identical; only round_fn and params layout differ)
-        self._inner = DecentralizedServer(
-            task, lr, batch_size, client_data, client_fraction, seed
-        )
-        self._inner.algorithm = "FedBuff"
-        self._inner.nr_local_epochs = nr_local_epochs
+        super().__init__(task, lr, batch_size, client_data, client_fraction,
+                         seed)
+        self.algorithm = "FedBuff"
+        self.nr_local_epochs = nr_local_epochs
         update = make_local_sgd_update(
             task.loss_fn, lr, batch_size, nr_local_epochs
         )
-        tick = make_fedbuff_round(
+        self.round_fn = make_fedbuff_round(
             update, client_data.x, client_data.y, client_data.counts,
-            self._inner.nr_clients_per_round,
+            self.nr_clients_per_round,
             staleness_window=staleness_window,
             staleness_exp=staleness_exp, server_eta=server_eta,
         )
-        history = init_history(self._inner.params, staleness_window)
-
-        evaluate = self._inner._evaluate
-
-        def round_fn(history, base_key, round_idx):
-            return tick(history, base_key, round_idx)
-
-        self._inner.round_fn = round_fn
-        self._inner.params = history
-        # evaluate the CURRENT version (slot 0) of the stacked history
-        self._inner._evaluate = lambda h: evaluate(
-            jax.tree.map(lambda l: l[0], h)
-        )
-
-    def run(self, nr_rounds: int, start_round: int = 0, on_round=None):
-        return self._inner.run(nr_rounds, start_round=start_round,
-                               on_round=on_round)
+        self.params = init_history(self.params, staleness_window)
+        # evaluate the CURRENT version of the stacked history
+        base_evaluate = self._evaluate
+        self._evaluate = lambda h: base_evaluate(_current(h))
 
     @property
-    def params(self):
-        """Current (slot-0) params, unstacked."""
-        return jax.tree.map(lambda l: l[0], self._inner.params)
-
-    def test(self) -> float:
-        return self._inner.test()
+    def current_params(self):
+        """Newest (slot-0) params, unstacked."""
+        return _current(self.params)

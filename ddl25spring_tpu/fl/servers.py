@@ -219,10 +219,13 @@ class FedAvgServer(DecentralizedServer):
                  client_data: ClientDatasets, client_fraction: float,
                  nr_local_epochs: int, seed: int,
                  aggregator=None, attack=None, malicious_mask=None, mesh=None,
-                 prox_mu: float = 0.0, dropout_rate: float = 0.0):
+                 prox_mu: float = 0.0, dropout_rate: float = 0.0,
+                 dp_clip: float = 0.0, dp_noise_mult: float = 0.0):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         self.algorithm = "FedAvg" if prox_mu == 0.0 else "FedProx"
+        if dp_clip:
+            self.algorithm = "DP-" + self.algorithm
         self.nr_local_epochs = nr_local_epochs
         client_update = _make_weight_client_update(
             task, lr, batch_size, nr_local_epochs, client_data, prox_mu
@@ -234,6 +237,7 @@ class FedAvgServer(DecentralizedServer):
             aggregator=aggregator,
             attack=attack, malicious_mask=malicious_mask,
             mesh=mesh, dropout_rate=dropout_rate,
+            dp_clip=dp_clip, dp_noise_mult=dp_noise_mult,
         )
 
 

@@ -64,6 +64,13 @@ def build_aggregator(cfg: HflConfig):
 
 
 def build_server(cfg: HflConfig):
+    if ((cfg.dp_clip or cfg.dp_noise_mult)
+            and cfg.algorithm not in ("fedavg", "fedprox")):
+        raise ValueError(
+            "--dp-clip/--dp-noise-mult are implemented for fedavg/fedprox "
+            f"only; algorithm {cfg.algorithm!r} would silently train "
+            "without privacy"
+        )
     if cfg.dataset == "mnist":
         ds = load_mnist()
         task = classification_task(MnistCnn(), (28, 28, 1), ds.test_x, ds.test_y)
@@ -143,7 +150,9 @@ def build_server(cfg: HflConfig):
         return FedAvgServer(task, cfg.lr, cfg.batch_size, client_data,
                             cfg.client_fraction, cfg.nr_local_epochs,
                             cfg.seed, prox_mu=prox_mu,
-                            dropout_rate=cfg.dropout_rate, **kw)
+                            dropout_rate=cfg.dropout_rate,
+                            dp_clip=cfg.dp_clip,
+                            dp_noise_mult=cfg.dp_noise_mult, **kw)
     if cfg.algorithm == "fedopt":
         return FedOptServer(task, cfg.lr, cfg.batch_size, client_data,
                             cfg.client_fraction, cfg.nr_local_epochs,
@@ -154,13 +163,6 @@ def build_server(cfg: HflConfig):
 
 
 def run(cfg: HflConfig):
-    # fail before any dataset load / server build / checkpoint-dir creation
-    if (cfg.algorithm == "fedbuff" and cfg.checkpoint_dir
-            and cfg.checkpoint_every):
-        raise ValueError(
-            "checkpointing is not supported for fedbuff yet (its state is "
-            "the stacked version history, not a flat params tree)"
-        )
     server = build_server(cfg)
     logger = MetricsLogger(cfg.metrics_path) if cfg.metrics_path else None
     ckpt = (Checkpointer(cfg.checkpoint_dir)
