@@ -46,6 +46,14 @@ class LlamaConfig:
     remat: bool = False        # rematerialize blocks in backward (HBM ↓, FLOPs ↑)
     decode: bool = False       # KV-cache autoregressive decoding (models.generate)
 
+    def __post_init__(self):
+        if self.attn_impl not in ("dense", "ring", "flash"):
+            raise ValueError(
+                f"attn_impl={self.attn_impl!r} not in ('dense', 'ring', "
+                "'flash') — a typo here would otherwise silently fall "
+                "through to dense attention"
+            )
+
     @property
     def head_dim(self) -> int:
         assert self.dmodel % self.nr_heads == 0

@@ -4,16 +4,25 @@ The hot op of the LLM path (SURVEY.md §2.3: attention lives inside the
 reference's ``simplellm`` dependency, running whatever torch does; here it is
 a hand-tiled TPU kernel).  Standard flash-attention construction (Dao et al.,
 public): the (T, T) score matrix is never materialised — each q-block streams
-over its causal k/v-blocks in VMEM, maintaining the online-softmax running
-max/sum, and the backward recomputes block scores from the saved per-row
-logsumexp instead of storing probabilities.
+over its causal k/v-blocks, maintaining the online-softmax running max/sum,
+and the backward recomputes block scores from the saved per-row logsumexp
+instead of storing probabilities.
 
-Complexities: O(T²) compute (halved by causal block skipping), O(T) memory.
-The XLA fallback (ops.attention.causal_attention) materialises the full
+Every kernel tiles K/V (and in the dk/dv pass, Q) over the innermost GRID
+axis with float32 accumulators in VMEM scratch, so VMEM use is bounded by
+the block sizes alone — sequence length only grows the grid.  (An earlier
+revision kept the whole K/V window resident in VMEM, which capped T at ~8k
+on v5e; this construction has no such cap.)  Causality is exploited by
+masking the diagonal block and skipping fully-masked blocks via ``pl.when``.
+
+Complexities: O(T²) compute (halved by causal skipping), O(T) memory.  The
+XLA fallback (ops.attention.causal_attention) materialises the full
 (B, H, T, T) score tensor.
 
-Layout: kernels tile over a fused (B*H) leading axis; block shapes keep the
-lane dimension = head_dim (<=128) and sublane = the q/kv block length.
+Layout notes: kernels fuse (B*H) into the leading grid axis; the per-row
+logsumexp rides as (BH, 1, T) so its (1, 1, block) tiles keep the trailing
+(sublane, lane) shape Mosaic-legal — a 2-D (1, block) tile of a (BH, T)
+array is rejected on real TPUs (interpret mode never checks this).
 """
 
 from __future__ import annotations
@@ -23,6 +32,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -38,66 +48,80 @@ def _pick_block(t: int, target: int = 128) -> int:
 # forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
-                scale, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                *, block_q, block_k, scale, nr_kv):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, d)
-    d = q.shape[-1]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
+    j = pl.program_id(2)
 
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    o = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc[...] = jnp.zeros_like(acc)
 
-    # causal: only k blocks at/below the diagonal (ceil so a partial overlap
-    # still includes the diagonal block when block_q != block_k)
-    nr_kv = -((qi + 1) * block_q // -block_k)
-
-    def body(j, carry):
-        m, l, o = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    # causal: block j contributes iff its first key position is visible to
+    # the q block's last query position
+    @pl.when(j * block_k < (qi + 1) * block_q)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale      # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)              # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_old = m_scr[...]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1)
-        o = o * corr[:, None] + jnp.dot(p, v, preferred_element_type=jnp.float32)
-        return m_new, l, o
+        corr = jnp.exp(m_old - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1)
+        acc[...] = acc[...] * corr[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
 
-    m, l, o = jax.lax.fori_loop(0, nr_kv, body, (m, l, o))
-    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    @pl.when(j == nr_kv - 1)
+    def _finalize():
+        l = l_scr[...]
+        o_ref[0] = (acc[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, *, block_q, block_k, interpret):
     BH, T, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    grid = (BH, T // block_q)
+    nr_kv = T // block_k
+    grid = (BH, T // block_q, nr_kv)
     kernel = functools.partial(
-        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale, seq_len=T
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
+        nr_kv=nr_kv,
     )
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            # lse rides as (BH, 1, T): a (1, 1, block_q) block keeps the
+            # trailing (sublane, lane) = (1, block_q) legal for Mosaic
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, d), q.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, T), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -109,110 +133,134 @@ def _flash_fwd(q, k, v, *, block_q, block_k, interpret):
 # --------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_q, block_k, scale):
+                   dq_scr, *, block_q, block_k, scale, nr_kv):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
-    nr_kv = -((qi + 1) * block_q // -block_k)  # ceil: include diagonal block
-    dq = jnp.zeros_like(q)
+    j = pl.program_id(2)
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(j * block_k < (qi + 1) * block_q)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
         p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[...] = dq_scr[...] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
 
-    dq = jax.lax.fori_loop(0, nr_kv, body, dq)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(j == nr_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q, block_k, scale, seq_len):
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, block_q, block_k, scale, nr_q):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                  # (block_k, d)
-    v = v_ref[0].astype(jnp.float32)
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
-    nr_q = seq_len // block_q
-    first_q = ki * block_k // block_q  # first q block that sees this k block
-    dk = jnp.zeros_like(k)
-    dv = jnp.zeros_like(v)
+    i = pl.program_id(2)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # q block i sees k block ki iff its last query >= the block's first key
+    @pl.when((i + 1) * block_q > ki * block_k)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)              # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)              # (block_q, d)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         q_pos = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
         p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv_scr[...] = dv_scr[...] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32
+        )
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_scr[...] = dk_scr[...] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
 
-    dk, dv = jax.lax.fori_loop(first_q, nr_q, body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == nr_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, o, lse, do, *, block_q, block_k, interpret):
     BH, T, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )[:, None, :]  # (BH, 1, T), matching lse's Mosaic-legal layout
+    nr_q = T // block_q
+    nr_kv = T // block_k
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale),
-        grid=(BH, T // block_q),
+                          scale=scale, nr_kv=nr_kv),
+        grid=(BH, nr_q, nr_kv),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, seq_len=T),
-        grid=(BH, T // block_k),
+                          scale=scale, nr_q=nr_q),
+        grid=(BH, nr_kv, nr_q),
         in_specs=[
-            pl.BlockSpec((1, T, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, T, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, d), q.dtype),
             jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)

@@ -81,7 +81,7 @@ def _model_config(cfg: LmConfig, vocab_size: int = BASE_VOCAB) -> LlamaConfig:
     return LlamaConfig(
         vocab_size=vocab_size,  # BASE_VOCAB = byte ids (3 specials + 256)
         dmodel=cfg.dmodel, nr_heads=cfg.nr_heads, nr_layers=cfg.nr_layers,
-        ctx_size=cfg.seq_l, remat=cfg.remat,
+        ctx_size=cfg.seq_l, remat=cfg.remat, attn_impl=cfg.attn_impl,
         dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
     )
 
