@@ -133,6 +133,29 @@ def measure_cpu_baseline():
           f"(paste into CPU_BASELINE_ROUNDS_PER_SEC)", file=sys.stderr)
 
 
+def _probe_device(timeout_s: float = 120.0) -> bool:
+    """True iff a trivial op completes on the default backend within the
+    timeout.  The TPU here rides a remote tunnel; when that tunnel is down,
+    every op BLOCKS forever with no error (observed 2026-07-30), which would
+    hang the whole benchmark run.  The probe runs in a daemon thread so a
+    wedged backend can't take the process with it."""
+    import threading
+
+    ok = threading.Event()
+
+    def attempt():
+        import numpy as np
+        import jax.numpy as jnp
+
+        np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+        ok.set()
+
+    t = threading.Thread(target=attempt, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return ok.is_set()
+
+
 def main():
     from ddl25spring_tpu.utils.platform import select_platform
 
@@ -148,6 +171,25 @@ def main():
     if args.measure_cpu_baseline:
         measure_cpu_baseline()
         return
+
+    _stamp("probing device ...")
+    if not _probe_device():
+        # one well-formed JSON line either way: a hung tunnel must not hang
+        # the driver, and value 0 is unambiguous about what happened
+        print(json.dumps({
+            "metric": "fedavg_cifar10_resnet18_256clients_rounds_per_sec",
+            "value": 0.0,
+            "unit": "rounds/sec",
+            "vs_baseline": 0.0,
+            "error": "device unreachable: trivial op did not complete in "
+                     "120s (remote TPU tunnel down?)",
+        }))
+        import os
+        import sys
+
+        sys.stdout.flush()  # os._exit skips interpreter shutdown/flushing
+        sys.stderr.flush()
+        os._exit(0)  # daemon probe thread may be wedged in the backend
 
     _stamp("building server (data + mesh + jit round_fn) ...")
     server = build_server()

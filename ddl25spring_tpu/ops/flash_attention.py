@@ -44,6 +44,19 @@ def _pick_block(t: int, target: int = 128) -> int:
     return b
 
 
+def _kv_clamp(i, j, block_q, block_k):
+    """KV block index for (q block i, step j): masked upper-triangle steps
+    clamp to the diagonal block, so the pipeline sees a repeated index and
+    skips the DMA (the ``pl.when`` guard already skips the compute)."""
+    return jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
+
+
+def _q_clamp(i, j, block_q, block_k):
+    """Q block index for (k block j, step i): steps before the first
+    contributing q block clamp to it, skipping their DMA."""
+    return jnp.maximum(i, (j * block_k) // block_q)
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
@@ -105,8 +118,13 @@ def _flash_fwd(q, k, v, *, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            # clamp masked upper-triangle steps to the diagonal block: the
+            # pipeline skips the DMA when the block index repeats, so causal
+            # skipping saves K/V bandwidth, not just compute
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b, _kv_clamp(i, j, block_q, block_k), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b, _kv_clamp(i, j, block_q, block_k), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -226,8 +244,10 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q, block_k, interpret):
         grid=(BH, nr_q, nr_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b, _kv_clamp(i, j, block_q, block_k), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b, _kv_clamp(i, j, block_q, block_k), 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -243,12 +263,16 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q, block_k, interpret):
                           scale=scale, nr_q=nr_q),
         grid=(BH, nr_kv, nr_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, j, i: (b, _q_clamp(i, j, block_q, block_k), 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, j, i: (b, _q_clamp(i, j, block_q, block_k), 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, j, i: (b, 0, _q_clamp(i, j, block_q, block_k))),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, j, i: (b, 0, _q_clamp(i, j, block_q, block_k))),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),

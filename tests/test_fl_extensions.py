@@ -324,10 +324,13 @@ def _small_fl_setup():
         from ddl25spring_tpu.data import load_mnist, split_dataset
         from ddl25spring_tpu.fl import mnist_task
 
+        # slice EXPLICITLY: the n_train/n_test kwargs only size the
+        # synthetic fallback — with real MNIST on disk they are ignored and
+        # the calibrated thresholds would silently run on 60k samples
         ds = load_mnist(n_train=2000, n_test=500)
-        task = mnist_task(ds.test_x, ds.test_y)
-        data = split_dataset(ds.train_x, ds.train_y, 20, True, 7,
-                             pad_multiple=100)
+        task = mnist_task(ds.test_x[:500], ds.test_y[:500])
+        data = split_dataset(ds.train_x[:2000], ds.train_y[:2000], 20, True,
+                             7, pad_multiple=100)
         _SETUP_CACHE["v"] = (task, data)
     return _SETUP_CACHE["v"]
 
