@@ -156,6 +156,27 @@ def _probe_device(timeout_s: float = 120.0) -> bool:
     return ok.is_set()
 
 
+def _probe_device_with_retry(attempts: int = 6, timeout_s: float = 90.0,
+                             pause_s: float = 20.0) -> bool:
+    """Probe the device repeatedly over a multi-minute window.
+
+    A transient tunnel outage must not cost the whole round's perf evidence
+    (it did in round 1: BENCH_r01.json recorded 0.0 off a single 120 s shot).
+    Worst case this burns ~11 min, well inside what the driver allows.  Each
+    attempt leaves at most one wedged daemon thread behind; the process exits
+    via os._exit on the failure path so they can't keep it alive."""
+    for i in range(attempts):
+        _stamp(f"device probe attempt {i + 1}/{attempts} "
+               f"(timeout {timeout_s:.0f}s) ...")
+        if _probe_device(timeout_s):
+            _stamp("device reachable")
+            return True
+        if i < attempts - 1:
+            _stamp(f"probe timed out; retrying in {pause_s:.0f}s")
+            time.sleep(pause_s)
+    return False
+
+
 def main():
     from ddl25spring_tpu.utils.platform import select_platform
 
@@ -173,7 +194,7 @@ def main():
         return
 
     _stamp("probing device ...")
-    if not _probe_device():
+    if not _probe_device_with_retry():
         # one well-formed JSON line either way: a hung tunnel must not hang
         # the driver, and value 0 is unambiguous about what happened
         print(json.dumps({
@@ -181,15 +202,17 @@ def main():
             "value": 0.0,
             "unit": "rounds/sec",
             "vs_baseline": 0.0,
-            "error": "device unreachable: trivial op did not complete in "
-                     "120s (remote TPU tunnel down?)",
+            "error": "device unreachable: trivial op never completed across "
+                     "6 probe attempts over ~10 min (remote TPU tunnel "
+                     "down?)",
         }))
         import os
-        import sys
 
         sys.stdout.flush()  # os._exit skips interpreter shutdown/flushing
         sys.stderr.flush()
-        os._exit(0)  # daemon probe thread may be wedged in the backend
+        # nonzero so scripts/CI keyed on exit status see the failure; daemon
+        # probe threads may be wedged in the backend, so skip shutdown
+        os._exit(1)
 
     _stamp("building server (data + mesh + jit round_fn) ...")
     server = build_server()

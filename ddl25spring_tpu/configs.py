@@ -15,6 +15,18 @@ import dataclasses
 from dataclasses import dataclass
 
 
+def _check_checkpoint_pair(checkpoint_dir, checkpoint_every):
+    """A dir without an interval silently disables checkpointing — the run
+    looks crash-safe but never writes anything; fail at construction, before
+    any data loading or trainer build."""
+    if checkpoint_dir and not checkpoint_every:
+        raise ValueError(
+            "checkpoint_dir is set but checkpoint_every is 0 — no "
+            "checkpoint would ever be written; pass --checkpoint-every N "
+            "(or unset --checkpoint-dir)"
+        )
+
+
 @dataclass(frozen=True)
 class HflConfig:
     """Horizontal-FL experiment (tutorial_1a / homework-1 family)."""
@@ -48,6 +60,9 @@ class HflConfig:
     checkpoint_every: int = 0  # rounds; 0 = off
     metrics_path: str | None = None
     plot_dir: str | None = None  # write the accuracy-vs-round figure here
+
+    def __post_init__(self):
+        _check_checkpoint_pair(self.checkpoint_dir, self.checkpoint_every)
 
 
 @dataclass(frozen=True)
@@ -98,6 +113,9 @@ class LmConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # iterations; 0 = off
     metrics_path: str | None = None
+
+    def __post_init__(self):
+        _check_checkpoint_pair(self.checkpoint_dir, self.checkpoint_every)
 
 
 def _add_dataclass_args(parser: argparse.ArgumentParser, cls) -> None:

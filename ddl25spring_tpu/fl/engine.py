@@ -305,7 +305,13 @@ def make_fl_round(
     @jax.jit
     def _round(params, base_key, round_idx, x, y, counts, mal_mask):
         round_key = jax.random.fold_in(base_key, round_idx)
-        sample_key, agg_key, drop_key = jax.random.split(round_key, 3)
+        # noise_key is dedicated to the DP Gaussian mechanism: the aggregator
+        # also receives agg_key, so deriving noise from agg_key would
+        # correlate the two randomness streams if a key-consuming aggregator
+        # were ever allowed alongside dp_clip
+        sample_key, agg_key, drop_key, noise_key = jax.random.split(
+            round_key, 4
+        )
         sel = sample_clients(sample_key, nr_clients, nr_shard)
         # entries beyond nr_sampled are shard padding: real clients that run
         # a local update but contribute weight 0 to the aggregate
@@ -375,7 +381,7 @@ def make_fl_round(
             leaves, treedef = jax.tree.flatten(aggregate)
             noisy = [
                 l + std * jax.random.normal(
-                    jax.random.fold_in(agg_key, i), l.shape, l.dtype
+                    jax.random.fold_in(noise_key, i), l.shape, l.dtype
                 )
                 for i, l in enumerate(leaves)
             ]

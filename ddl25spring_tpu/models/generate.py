@@ -66,11 +66,13 @@ def generate(
     return decode(params, prompt, key)
 
 
-@functools.cache
+@functools.lru_cache(maxsize=16)
 def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float):
     """Compiled prefill+scan decoder, cached on (config, shape, temperature)
     so repeated ``generate`` calls with the same geometry reuse the jitted
     program instead of rebuilding a fresh closure (and recompiling) per call.
+    Bounded (LRU, 16 geometries) so long-lived processes that decode many
+    distinct prompt lengths don't retain every compiled program forever.
     """
     model = Llama(dataclasses.replace(
         config, decode=True, attn_impl="dense", remat=False

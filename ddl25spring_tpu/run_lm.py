@@ -302,6 +302,7 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
     # exact batches an uninterrupted one would
     ckpt = None
     start_iter = 0
+    # checkpoint_dir-without-interval is rejected at LmConfig construction
     if cfg.checkpoint_dir and cfg.checkpoint_every:
         from .utils import Checkpointer
 

@@ -1,3 +1,4 @@
+from .sharded import PartyShardedVFL, stack_party_inputs
 from .splitnn import (
     BottomModel,
     TopModel,
@@ -13,6 +14,8 @@ from .splitvae import (
 )
 
 __all__ = [
+    "PartyShardedVFL",
+    "stack_party_inputs",
     "BottomModel",
     "TopModel",
     "VFLNetwork",

@@ -1,0 +1,213 @@
+"""Party-sharded vertical FL: the activation cut as an ICI all-gather.
+
+The reference's VFL concatenates per-party bottom activations in-process
+(``torch.cat(local_outs, dim=1)``, lab/tutorial_2b/vfl.py:36).  In a real
+deployment that concat is the network boundary: each party ships its
+activation block to the server.  The TPU-native rendering (SURVEY.md §2.2)
+puts each party on its own slice of a ``party`` mesh axis: bottoms run
+party-parallel on their local feature shards, and the concat lowers to ONE
+XLA all-gather over ICI, inserted by GSPMD at the sharding boundary between
+the party-sharded activation stack and the replicated top model.
+
+Differences from :class:`~ddl25spring_tpu.vfl.splitnn.VFLNetwork` (the
+in-process simulation, kept for reference-shaped heterogeneous parties):
+
+- Party bottoms share one architecture and a common padded feature width, so
+  parameters stack into a leading party axis and shard cleanly.  Padded
+  feature columns are constant zero, so their Dense weight rows neither
+  affect the forward nor receive gradient — padding is exact, not
+  approximate (``tests/test_vfl.py::test_padded_equals_heterogeneous``).
+- Execution is identical with or without a mesh: the mesh only adds
+  ``with_sharding_constraint`` annotations, so the sharded program is
+  bit-equivalent to the local one
+  (``tests/test_vfl.py::test_party_sharded_equals_local``).
+
+Backward pass: ``jax.grad`` through the gather gives each party exactly the
+gradient block of its own activations (the transpose of all-gather is
+reduce-scatter) — the server->client gradient message of real split
+learning, again as one collective over ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.lax import with_sharding_constraint
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.losses import cross_entropy_logits
+from .splitnn import BottomModel, TopModel
+
+
+def stack_party_inputs(x, feature_slices, pad_to: int | None = None):
+    """Stack per-party feature blocks into one ``(P, B, f_pad)`` array.
+
+    ``x`` is the full ``(B, F)`` table; each party's columns (its
+    ``feature_slices`` entry) land left-aligned in a zero-padded row of
+    width ``pad_to`` (default: the widest party).  Zero padding is exact for
+    Dense bottoms (zero inputs contribute nothing forward or backward).
+    """
+    x = np.asarray(x, np.float32)
+    widths = [len(sl) for sl in feature_slices]
+    f_pad = max(widths) if pad_to is None else pad_to
+    if f_pad < max(widths):
+        raise ValueError(f"pad_to={pad_to} < widest party ({max(widths)})")
+    out = np.zeros((len(feature_slices), x.shape[0], f_pad), np.float32)
+    for i, sl in enumerate(feature_slices):
+        out[i, :, : widths[i]] = x[:, sl]
+    return jnp.asarray(out)
+
+
+@dataclass
+class PartyShardedVFL:
+    """Split network with bottoms sharded over a ``party`` mesh axis.
+
+    ``mesh`` must carry a ``party`` axis whose size divides the number of
+    parties (parties fold onto devices in equal groups).  ``mesh=None`` runs
+    the identical program unsharded — the test oracle.
+    """
+
+    feature_slices: list  # per-party column index arrays into x
+    out_dim: int = 32  # shared bottom output width
+    nr_classes: int = 2
+    seed: int = 42
+    lr: float = 1e-3
+    mesh: Mesh | None = None
+    bottom: BottomModel = field(init=False)
+    top: TopModel = field(init=False)
+
+    def __post_init__(self):
+        self.nr_parties = len(self.feature_slices)
+        self.f_pad = max(len(sl) for sl in self.feature_slices)
+        if self.mesh is not None:
+            if "party" not in self.mesh.axis_names:
+                raise ValueError("mesh needs a 'party' axis")
+            if self.nr_parties % self.mesh.shape["party"]:
+                raise ValueError(
+                    f"{self.nr_parties} parties not divisible by party-axis "
+                    f"size {self.mesh.shape['party']}"
+                )
+        self.bottom = BottomModel(self.out_dim)
+        self.top = TopModel(self.nr_classes)
+        self.optimizer = optax.adamw(self.lr)
+
+        key = jax.random.key(self.seed)
+        bkeys = jax.random.split(key, self.nr_parties + 2)
+        dummy = jnp.zeros((1, self.f_pad))
+        per_party = [self.bottom.init(bkeys[i], dummy)
+                     for i in range(self.nr_parties)]
+        bottoms = jax.tree.map(lambda *xs: jnp.stack(xs), *per_party)
+        top = self.top.init(
+            bkeys[-2], jnp.zeros((1, self.nr_parties * self.out_dim))
+        )
+        self.params = {"bottoms": bottoms, "top": top}
+        self.opt_state = self.optimizer.init(self.params)
+        self.dropout_key = bkeys[-1]
+        self._step = jax.jit(self._make_step())
+        self._fwd = jax.jit(
+            lambda p, xs: self._forward(p, xs, train=False, key=None)
+        )
+
+    # -- sharding annotations ------------------------------------------------
+    def _party(self, tree):
+        """Constrain leading (party) axis onto the mesh; no-op without one."""
+        if self.mesh is None:
+            return tree
+        s = NamedSharding(self.mesh, P("party"))
+        return jax.tree.map(lambda a: with_sharding_constraint(a, s), tree)
+
+    def _repl(self, tree):
+        if self.mesh is None:
+            return tree
+        s = NamedSharding(self.mesh, P())
+        return jax.tree.map(lambda a: with_sharding_constraint(a, s), tree)
+
+    # -- the split forward ---------------------------------------------------
+    def _forward(self, params, x_stacked, *, train: bool, key):
+        """``x_stacked``: (P, B, f_pad).  Party-parallel bottoms, all-gather
+        cut, replicated top."""
+        bottoms = self._party(params["bottoms"])
+        xs = self._party(x_stacked)
+        if train:
+            pkeys = jax.vmap(
+                lambda i: jax.random.fold_in(key, i)
+            )(jnp.arange(self.nr_parties))
+
+            def one(bp, xp, k):
+                return self.bottom.apply(
+                    bp, xp, train=True, rngs={"dropout": k}
+                )
+
+            acts = jax.vmap(one)(bottoms, xs, pkeys)
+        else:
+            acts = jax.vmap(
+                lambda bp, xp: self.bottom.apply(bp, xp, train=False)
+            )(bottoms, xs)
+        acts = self._party(acts)  # (P, B, out) party-sharded: pre-cut state
+        # THE CUT: party-major flatten to (B, P*out).  The operand is
+        # party-sharded, the result consumed replicated — GSPMD lowers the
+        # resharding to one all-gather over the party axis (ICI), the exact
+        # analogue of each party shipping its activation block to the server
+        # (reference torch.cat, vfl.py:36).
+        concat = acts.transpose(1, 0, 2).reshape(
+            acts.shape[1], self.nr_parties * self.out_dim
+        )
+        concat = self._repl(concat)
+        kw = (
+            {"rngs": {"dropout": jax.random.fold_in(key, self.nr_parties)}}
+            if train else {}
+        )
+        return self.top.apply(params["top"], concat, train=train, **kw)
+
+    def _make_step(self):
+        def loss_fn(params, xs, y_onehot, key):
+            logits = self._forward(params, xs, train=True, key=key)
+            return cross_entropy_logits(logits, y_onehot)
+
+        def step(params, opt_state, xs, y_onehot, key):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, xs, y_onehot, key
+            )
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params
+            )
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return step
+
+    # -- reference-shaped API ------------------------------------------------
+    def train_with_settings(self, epochs: int, batch_size: int, x, y_onehot,
+                            log_every: int = 0, log_loss=None):
+        """Sequential minibatches, no shuffling (vfl.py:53-85 shape)."""
+        xs = stack_party_inputs(x, self.feature_slices, self.f_pad)
+        y = jnp.asarray(y_onehot, jnp.float32)
+        n = xs.shape[1]
+        nr_batches = -(-n // batch_size)
+        history = []
+        for epoch in range(epochs):
+            total = 0.0
+            for b in range(nr_batches):
+                sl = slice(b * batch_size, min((b + 1) * batch_size, n))
+                key, self.dropout_key = jax.random.split(self.dropout_key)
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, xs[:, sl], y[sl], key
+                )
+                total += float(loss)
+            history.append(total / nr_batches)
+            if log_loss is not None:
+                log_loss(epoch, history[-1])
+            if log_every and epoch % log_every == 0:
+                print(f"Epoch: {epoch} Loss: {history[-1]:.3f}")
+        return history
+
+    def test(self, x, y_onehot):
+        xs = stack_party_inputs(x, self.feature_slices, self.f_pad)
+        y = jnp.asarray(y_onehot, jnp.float32)
+        logits = self._fwd(self.params, xs)
+        pred = jnp.argmax(logits, axis=1)
+        acc = jnp.mean((pred == jnp.argmax(y, axis=1)).astype(jnp.float32))
+        return float(acc), float(cross_entropy_logits(logits, y))

@@ -9,9 +9,11 @@ TPU-native shape: the whole multi-party forward/backward is ONE jit.  Party
 feature widths are trace-time constants, so heterogeneous bottoms are Python
 level modules inside the jit; their computations are independent and XLA
 schedules them in parallel.  The activation concat (vfl.py:36) is the logical
-client->server cut: under a mesh, annotate the stacked bottom activations
-with a ``party`` sharding and GSPMD turns the concat into an all-gather over
-ICI (see ``tests/test_vfl.py::test_party_sharded_equals_local``).
+client->server cut; the party-sharded execution of that cut — stacked bottom
+activations annotated with a ``party`` mesh sharding so GSPMD lowers the
+concat to an all-gather over ICI — lives in
+:class:`ddl25spring_tpu.vfl.sharded.PartyShardedVFL`
+(equivalence oracle: ``tests/test_vfl.py::test_party_sharded_equals_local``).
 
 A single global AdamW is *exactly* per-party AdamW (elementwise optimizer, no
 cross-parameter coupling), so the reference's centralized-optimizer
