@@ -31,7 +31,7 @@ import time
 CPU_BASELINE_ROUNDS_PER_SEC = 0.001441
 
 
-def build_server(seed: int = 10):
+def build_server(seed: int = 10, norm_impl: str = "flax"):
     import jax
     import jax.numpy as jnp
 
@@ -47,7 +47,8 @@ def build_server(seed: int = 10):
         pad_multiple=50,
     )
     task = classification_task(
-        ResNet18(dtype=jnp.bfloat16), (32, 32, 3), ds.test_x, ds.test_y
+        ResNet18(dtype=jnp.bfloat16, norm_impl=norm_impl), (32, 32, 3),
+        ds.test_x, ds.test_y
     )
     # shard the sampled-client axis across every available chip (the
     # one-core-per-simulated-client north star); single-chip runs unsharded
@@ -183,6 +184,8 @@ def main():
     select_platform()
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--norm-impl", default="flax", choices=["flax", "lean"],
+                    help="GroupNorm implementation A/B (ops/norm.py)")
     ap.add_argument("--measure-cpu-baseline", action="store_true")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the timed rounds "
@@ -215,7 +218,7 @@ def main():
         os._exit(1)
 
     _stamp("building server (data + mesh + jit round_fn) ...")
-    server = build_server()
+    server = build_server(norm_impl=args.norm_impl)
     if args.profile:
         from ddl25spring_tpu.utils import profile_trace
 

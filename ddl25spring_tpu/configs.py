@@ -16,14 +16,20 @@ from dataclasses import dataclass
 
 
 def _check_checkpoint_pair(checkpoint_dir, checkpoint_every):
-    """A dir without an interval silently disables checkpointing — the run
-    looks crash-safe but never writes anything; fail at construction, before
-    any data loading or trainer build."""
+    """Half-configured checkpointing silently disables it — the run looks
+    crash-safe but never writes anything; fail at construction, before any
+    data loading or trainer build.  Both halves are required together."""
     if checkpoint_dir and not checkpoint_every:
         raise ValueError(
             "checkpoint_dir is set but checkpoint_every is 0 — no "
             "checkpoint would ever be written; pass --checkpoint-every N "
             "(or unset --checkpoint-dir)"
+        )
+    if checkpoint_every and not checkpoint_dir:
+        raise ValueError(
+            "checkpoint_every is set but checkpoint_dir is empty — no "
+            "checkpoint would ever be written; pass --checkpoint-dir DIR "
+            "(or drop --checkpoint-every)"
         )
 
 
@@ -70,6 +76,8 @@ class VflConfig:
     """Vertical-FL experiment (tutorial_2b family)."""
 
     mode: str = "classify"     # classify (split-NN) | vae (split VFL-VAE)
+    sharded: bool = False      # classify: run parties sharded over a 'party'
+                               # mesh axis (vfl.sharded.PartyShardedVFL)
     nr_clients: int = 4        # feature-partitioned parties (exercise_2: 2/4/6/8)
     epochs: int = 300          # reference: 300 (classify), 1000 (vae)
     batch_size: int = 64       # classify; vae trains full-batch
@@ -83,7 +91,8 @@ class VflConfig:
 class LmConfig:
     """LLM-parallelism experiment (tutorial_1b family)."""
 
-    strategy: str = "dp"       # single | dp | dp-weight | dp-zero | dp-topk | dp-int8 | pp | 1f1b | dp-pp | tp | sp | ep
+    strategy: str = "dp"       # single | dp | dp-weight | dp-zero | dp-topk | dp-int8 | pp | 1f1b | 1f1b-int | dp-pp | tp | sp | ep
+    nr_chunks: int = 2         # 1f1b-int: virtual stage chunks per device
     compress_ratio: float = 0.01  # dp-topk: fraction of gradient entries kept
     nr_devices: int = 0        # 0 = all
     batch_size: int = 6

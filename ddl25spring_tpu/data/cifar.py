@@ -12,7 +12,12 @@ import pickle
 
 import numpy as np
 
-from .mnist import ImageDataset, candidate_data_dirs, synthetic_image_dataset
+from .mnist import (
+    ImageDataset,
+    announce_synthetic_fallback,
+    candidate_data_dirs,
+    synthetic_image_dataset,
+)
 
 CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], dtype=np.float32)
 CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], dtype=np.float32)
@@ -71,6 +76,7 @@ def load_cifar10(
             "CIFAR-10 not found; set DDL25_DATA_DIR to a directory containing "
             "cifar10.npz or cifar-10-batches-py"
         )
+    announce_synthetic_fallback("cifar10")
     return synthetic_image_dataset(
         n_train=n_train, n_test=n_test, size=32, nr_classes=10,
         channels=3, noise=0.3, max_shift=4, seed=seed,

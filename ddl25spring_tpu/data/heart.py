@@ -67,9 +67,12 @@ def synthetic_heart_df(n: int = 1025, seed: int = 7) -> pd.DataFrame:
 
 def load_heart_df() -> tuple[pd.DataFrame, bool]:
     """Return (dataframe, synthetic flag)."""
+    from .mnist import announce_synthetic_fallback
+
     for p in _candidate_paths():
         if p.exists():
             return pd.read_csv(p), False
+    announce_synthetic_fallback("heart")
     return synthetic_heart_df(), True
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 import gzip
 import os
 import struct
+import sys
 from dataclasses import dataclass
 from pathlib import Path
 
@@ -25,6 +26,23 @@ import numpy as np
 
 MNIST_MEAN = 0.1307
 MNIST_STD = 0.3081
+
+_announced: set[str] = set()
+
+
+def announce_synthetic_fallback(dataset: str) -> None:
+    """Loud once-per-process stderr banner when a run falls back to the
+    synthetic dataset, so no CLI/benchmark result can be mistaken for a
+    real-data number (absolute accuracies won't match the homework tables)."""
+    if dataset in _announced:
+        return
+    _announced.add(dataset)
+    print(
+        f"[ddl25spring_tpu] SYNTHETIC-DATA FALLBACK: real {dataset} not "
+        f"found (set DDL25_DATA_DIR to point at it) — results are "
+        f"deterministic but NOT comparable to real-data tables",
+        file=sys.stderr, flush=True,
+    )
 
 
 @dataclass
@@ -193,4 +211,5 @@ def load_mnist(
             "MNIST not found on disk and synthetic fallback disabled; "
             "set DDL25_DATA_DIR to a directory containing mnist.npz or MNIST/raw"
         )
+    announce_synthetic_fallback("mnist")
     return synthetic_image_dataset(n_train=n_train, n_test=n_test, seed=seed)

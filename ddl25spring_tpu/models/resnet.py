@@ -24,7 +24,12 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
-def _norm(channels: int, dtype, name: str):
+def _norm(channels: int, dtype, name: str, impl: str = "flax"):
+    if impl == "lean":
+        from ..ops.norm import LeanGroupNorm
+
+        return LeanGroupNorm(num_groups=min(32, channels), dtype=dtype,
+                             name=name)
     return nn.GroupNorm(num_groups=min(32, channels), dtype=dtype, name=name)
 
 
@@ -32,21 +37,23 @@ class BasicBlock(nn.Module):
     channels: int
     stride: int = 1
     dtype: jnp.dtype = jnp.float32
+    norm_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x):
         c, s, dt = self.channels, self.stride, self.dtype
+        ni = self.norm_impl
         y = nn.Conv(c, (3, 3), strides=(s, s), padding="SAME", use_bias=False,
                     dtype=dt, name="conv1")(x)
-        y = _norm(c, dt, "norm1")(y)
+        y = _norm(c, dt, "norm1", ni)(y)
         y = nn.relu(y)
         y = nn.Conv(c, (3, 3), padding="SAME", use_bias=False,
                     dtype=dt, name="conv2")(y)
-        y = _norm(c, dt, "norm2")(y)
+        y = _norm(c, dt, "norm2", ni)(y)
         if x.shape[-1] != c or s != 1:
             x = nn.Conv(c, (1, 1), strides=(s, s), use_bias=False,
                         dtype=dt, name="proj")(x)
-            x = _norm(c, dt, "proj_norm")(x)
+            x = _norm(c, dt, "proj_norm", ni)(x)
         return nn.relu(x + y)
 
 
@@ -57,6 +64,7 @@ class ResNet(nn.Module):
     blocks_per_group: Sequence[int] = (2, 2, 2, 2)
     widths: Sequence[int] = (64, 128, 256, 512)
     dtype: jnp.dtype = jnp.float32
+    norm_impl: str = "flax"  # flax | lean (ops.norm.LeanGroupNorm, same params)
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -64,11 +72,12 @@ class ResNet(nn.Module):
         x = x.astype(dt)
         x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False,
                     dtype=dt, name="stem")(x)
-        x = nn.relu(_norm(self.widths[0], dt, "stem_norm")(x))
+        x = nn.relu(_norm(self.widths[0], dt, "stem_norm", self.norm_impl)(x))
         for g, (blocks, width) in enumerate(zip(self.blocks_per_group, self.widths)):
             for b in range(blocks):
                 stride = 2 if (b == 0 and g > 0) else 1
-                x = BasicBlock(width, stride, dt, name=f"group{g}_block{b}")(x)
+                x = BasicBlock(width, stride, dt, norm_impl=self.norm_impl,
+                               name=f"group{g}_block{b}")(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.nr_classes, dtype=jnp.float32, name="head")(
             x.astype(jnp.float32)
@@ -76,5 +85,6 @@ class ResNet(nn.Module):
         return nn.log_softmax(x, axis=-1)
 
 
-def ResNet18(nr_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet(nr_classes=nr_classes, dtype=dtype)
+def ResNet18(nr_classes: int = 10, dtype=jnp.float32,
+             norm_impl: str = "flax") -> ResNet:
+    return ResNet(nr_classes=nr_classes, dtype=dtype, norm_impl=norm_impl)

@@ -23,6 +23,12 @@ Layout notes: kernels fuse (B*H) into the leading grid axis; the per-row
 logsumexp rides as (BH, 1, T) so its (1, 1, block) tiles keep the trailing
 (sublane, lane) shape Mosaic-legal — a 2-D (1, block) tile of a (BH, T)
 array is rejected on real TPUs (interpret mode never checks this).
+
+Throughput notes: per-step pipeline overhead dominates at small blocks (the
+128-block revision spent ~500 ms at T=32k on ~400k grid steps of ~3 MFLOP
+each), so blocks default to 512 (``BLOCK_TARGET``); matmul operands stay in
+their storage dtype (bf16 in the LM path) with f32 ``preferred_element_type``
+accumulation — the MXU's native mode — instead of upcasting to f32 first.
 """
 
 from __future__ import annotations
@@ -36,8 +42,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Default q/k block edge.  Bigger blocks are the single largest throughput
+# lever on TPU: total grid steps = BH * (T/bq) * (T/bk) and each step has a
+# fixed pipeline cost, so 128->512 cuts step count 16x while each step's
+# matmuls grow into solidly MXU-shaped (512, d)x(d, 512) tiles.  512 keeps
+# the worst-case VMEM residency (bwd dkv: four operand blocks + two f32
+# accumulators + the (bq, bk) f32 score/prob intermediates) around 4 MB at
+# head_dim 128 — comfortably inside a v5e core's ~16 MB shared VMEM with
+# double buffering.
+BLOCK_TARGET = 512
 
-def _pick_block(t: int, target: int = 128) -> int:
+
+def _pick_block(t: int, target: int = BLOCK_TARGET) -> int:
     b = min(t, target)
     while t % b:
         b -= 1
@@ -76,10 +92,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
     # the q block's last query position
     @pl.when(j * block_k < (qi + 1) * block_q)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale      # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)              # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        # matmul operands stay in their storage dtype (bf16 from the model):
+        # the MXU natively accumulates bf16 x bf16 into f32
+        # (preferred_element_type), which is both faster than upcast-then-f32
+        # matmul and just as accurate where it matters (the accumulator)
+        q = q_ref[0]                                  # (block_q, d)
+        k = k_ref[0]                                  # (block_k, d)
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
@@ -94,7 +114,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
         m_scr[...] = m_new
         l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1)
         acc[...] = acc[...] * corr[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
 
     @pl.when(j == nr_kv - 1)
@@ -161,12 +181,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(j * block_k < (qi + 1) * block_q)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -178,7 +198,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
         dq_scr[...] = dq_scr[...] + jnp.dot(
-            ds, k, preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
 
     @pl.when(j == nr_kv - 1)
@@ -200,10 +220,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # q block i sees k block ki iff its last query >= the block's first key
     @pl.when((i + 1) * block_q > ki * block_k)
     def _compute():
-        k = k_ref[0].astype(jnp.float32)              # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)              # (block_q, d)
-        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]                                  # (block_k, d)
+        v = v_ref[0]
+        q = q_ref[0]                                  # (block_q, d)
+        do = do_ref[0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
@@ -215,12 +235,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
         p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
         dv_scr[...] = dv_scr[...] + jnp.dot(
-            p.T, do, preferred_element_type=jnp.float32
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
         )
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
         dk_scr[...] = dk_scr[...] + jnp.dot(
-            ds.T, q, preferred_element_type=jnp.float32
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
         )
 
     @pl.when(i == nr_q - 1)

@@ -18,10 +18,20 @@ from .multihost import initialize_multihost, make_multihost_mesh
 from .zero import make_zero_dp_train_step
 from .sp import make_sp_forward, make_sp_train_step, sp_data_sharding
 from .pp_1f1b import make_1f1b_grad_fn, make_1f1b_train_step
+from .pp_interleaved import (
+    bubble_fraction,
+    interleave_pp_params,
+    make_interleaved_1f1b_grad_fn,
+    make_interleaved_1f1b_train_step,
+)
 
 __all__ = [
     "make_1f1b_grad_fn",
     "make_1f1b_train_step",
+    "bubble_fraction",
+    "interleave_pp_params",
+    "make_interleaved_1f1b_grad_fn",
+    "make_interleaved_1f1b_train_step",
     "make_sp_forward",
     "make_sp_train_step",
     "sp_data_sharding",

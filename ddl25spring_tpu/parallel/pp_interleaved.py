@@ -1,0 +1,323 @@
+"""Interleaved-1F1B pipeline schedule (virtual stage chunks) as one SPMD jit.
+
+Extension of :mod:`.pp_1f1b` (the classic schedule the reference's own
+attempt at deadlocked, lab/tutorial_1b/PP/1F1B/intro_PP_1F1B_MP.py:87-144):
+each device hosts ``V`` *chunks* of ``nr_layers/(V*S)`` layers instead of one
+stage of ``nr_layers/S``, so a microbatch laps the device ring ``V`` times.
+Virtual stage ``k = c*S + s`` (chunk ``c`` on device ``s``); the activation
+hand-off between consecutive virtual stages is ALWAYS device ``s -> s+1 mod
+S`` — the same single down-``ppermute`` ring as the classic schedule, with
+the wrap ``S-1 -> 0`` carrying the activation into the next chunk.
+
+Lockstep schedule (microbatches in groups of ``S``; ``g = f // S``,
+``r = f % S``):
+
+- forward of microbatch ``f`` at virtual stage ``k = c*S+s`` runs at tick
+  ``t = s + c*S + r + V*S*g``;
+- backward runs at tick ``t = (2*V*S - 1 - s) + V*S*g + r - c*S``
+  (the loss chunk's backward follows its forward by one tick).
+
+Both maps are bijections per (device, tick) — solving each for fixed
+``(t, s)`` yields a unique ``(f, c)`` slot — so every device executes exactly
+one chunk-forward and one chunk-backward per tick, no slot ever collides,
+and the deadlock-free-by-construction argument of the classic schedule
+carries over unchanged.
+
+Why interleave: the pipeline ramp costs ``V*S + S - 1`` *chunk*-ticks of
+1/V a stage each, so the bubble shrinks from the classic ``2S - 2`` stage
+units to ``(V*S + S - 1)/V ≈ S + S/V``; the price is V× the in-flight
+activation memory and V× the ppermute messages (each 1/V the payload... same
+bytes, more latency terms).  ``bubble_fraction`` below computes both models
+so the trade is explicit (docs/BENCHMARKS.md table).
+
+Constraints: ``nr_layers % (V*S) == 0``, ``M % S == 0`` (microbatches travel
+in ring-sized groups).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models.llama import LlamaConfig
+from .pp import head_loss, pp_params_from_full, stage_apply
+
+
+def interleave_pp_params(params, config: LlamaConfig, nr_stages: int,
+                         nr_chunks: int):
+    """Pipeline layout for the interleaved schedule: ``stacked_blocks``
+    leaves are (S, V, layers_per_chunk, ...) with chunk ``c`` of device ``s``
+    holding virtual stage ``c*S + s``."""
+    flat = pp_params_from_full(params, config, nr_stages * nr_chunks)
+    S, V = nr_stages, nr_chunks
+
+    def regroup(leaf):  # (V*S, L, ...) -> (S, V, L, ...)
+        per_dev = [
+            jnp.stack([leaf[c * S + s] for c in range(V)]) for s in range(S)
+        ]
+        return jnp.stack(per_dev)
+
+    return {
+        "embed": flat["embed"],
+        "stacked_blocks": jax.tree.map(regroup, flat["stacked_blocks"]),
+        "final_norm": flat["final_norm"],
+        "lm_head": flat["lm_head"],
+    }
+
+
+def bubble_fraction(nr_stages: int, nr_microbatches: int,
+                    nr_chunks: int = 1) -> float:
+    """Idle fraction of the schedule, in stage-time units.
+
+    Classic (V=1): ticks = M + 2S - 2, useful = M.
+    Interleaved:   chunk-ticks = V*M + V*S + S - 1 at 1/V stage each,
+                   useful = M stage units.
+    """
+    S, M, V = nr_stages, nr_microbatches, nr_chunks
+    if V == 1:
+        total = M + 2 * S - 2
+    else:
+        total = (V * M + V * S + S - 1) / V
+    return (total - M) / total
+
+
+def make_interleaved_1f1b_grad_fn(
+    config: LlamaConfig,
+    mesh,
+    nr_stages: int,
+    nr_microbatches: int,
+    nr_chunks: int = 2,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+):
+    """Build ``grads_and_loss(int_params, tokens) -> (grads, loss)`` running
+    the interleaved schedule.  ``int_params`` uses the layout of
+    :func:`interleave_pp_params`."""
+    S = nr_stages
+    M = nr_microbatches
+    V = nr_chunks
+    D = config.dmodel
+    if M % S:
+        raise ValueError(
+            f"interleaved schedule needs microbatches % stages == 0 "
+            f"({M} % {S})"
+        )
+    BUF = 2 * S + 2  # per-chunk in-flight bound (see module docstring)
+
+    def chunk_fwd(chunk_blocks, h):
+        return stage_apply(config, chunk_blocks, h)
+
+    def last_chunk_loss(chunk_blocks, norm_p, head_kernel, h_in, tok):
+        return head_loss(
+            config, norm_p, head_kernel, chunk_fwd(chunk_blocks, h_in), tok
+        )
+
+    batch_spec = P(None, data_axis) if data_axis else P()
+    down = [(i, (i + 1) % S) for i in range(S)]
+    up = [(i, (i - 1) % S) for i in range(S)]
+
+    def fwd_slot(t, sid):
+        """Unique forward slot (f, c, valid) of device ``sid`` at tick t."""
+        u = t - sid
+        uc = jnp.maximum(u, 0)
+        g = uc // (V * S)
+        rem = uc % (V * S)
+        c = rem // S
+        r = rem % S
+        f = g * S + r
+        return f, c, (u >= 0) & (f < M)
+
+    def bwd_slot(t, sid):
+        """Unique backward slot: solve t = (2VS-1-s) + VSg + r - cS.
+
+        ``ub = VSg - cS + r`` is legitimately NEGATIVE for early loss-side
+        chunks (c*S > VSg + r), so the inverse runs on signed ints — jnp's
+        floor division/mod round toward -inf, which is exactly what the
+        ceil-division recovery of (g, c) needs; validity is gated on g >= 0,
+        not ub >= 0."""
+        ub = t - (2 * V * S - 1) + sid
+        r = ub % S                 # non-negative also for negative ub
+        w = (ub - r) // S          # = V*g - c  (signed)
+        g = (w + V - 1) // V       # ceil(w / V), floor-div safe for w < 0
+        c = V * g - w
+        f = g * S + r
+        valid = (g >= 0) & (f < M) & (c >= 0) & (c < V)
+        return f, jnp.clip(c, 0, V - 1), valid
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            {"embed": P(), "stacked_blocks": P(stage_axis),
+             "final_norm": P(), "lm_head": P()},
+            batch_spec,
+        ),
+        out_specs=(
+            {"embed": P(), "stacked_blocks": P(stage_axis),
+             "final_norm": P(), "lm_head": P()},
+            P(),
+        ),
+        check_vma=False,
+    )
+    def grads_and_loss(int_params, micro_tokens):
+        # stacked_blocks local shard: (1, V, L, ...) -> chunks (V, L, ...)
+        my_chunks = jax.tree.map(
+            lambda x: x[0], int_params["stacked_blocks"]
+        )
+        emb = int_params["embed"]["embedding"]
+        norm_p = int_params["final_norm"]
+        head_k = int_params["lm_head"]["kernel"]
+        sid = jax.lax.axis_index(stage_axis)
+        mb, T = micro_tokens.shape[1:]
+
+        def chunk_params(c):
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, c, keepdims=False),
+                my_chunks,
+            )
+
+        zero_g = jax.tree.map(jnp.zeros_like, my_chunks)  # (V, L, ...)
+        zero_fn = jax.tree.map(jnp.zeros_like, norm_p)
+
+        def mid_pullback(cp, x_saved, g_recv):
+            _, vjp = jax.vjp(chunk_fwd, cp, x_saved)
+            gb, gx = vjp(g_recv)
+            return gb, zero_fn, jnp.zeros_like(head_k), gx, jnp.float32(0)
+
+        def last_pullback(cp, x_saved, tok):
+            loss, vjp = jax.vjp(
+                last_chunk_loss, cp, norm_p, head_k, x_saved, tok
+            )
+            gb, gfn, gh, gx, _ = vjp(jnp.float32(1))
+            return gb, gfn, gh, gx, loss
+
+        init = dict(
+            in_buf=jnp.zeros((V, BUF, mb, T, D), config.dtype),
+            fwd_recv=jnp.zeros((mb, T, D), config.dtype),
+            bwd_recv=jnp.zeros((mb, T, D), config.dtype),
+            g_chunks=zero_g,
+            g_embed=jnp.zeros_like(emb),
+            g_norm=zero_fn,
+            g_head=jnp.zeros_like(head_k),
+            loss_sum=jnp.float32(0),
+        )
+
+        def tick(state, t):
+            # ---- forward slot ----
+            f, c, valid_f = fwd_slot(t, sid)
+            f = jnp.clip(f, 0, M - 1)
+            tok_f = micro_tokens[f]
+            emb_f = jnp.take(emb, tok_f, axis=0).astype(config.dtype)
+            # chunk 0 on device 0 ingests embeddings; everything else the ring
+            inp = jnp.where((sid == 0) & (c == 0), emb_f, state["fwd_recv"])
+            h_out = chunk_fwd(chunk_params(c), inp)
+            old = state["in_buf"][c, f % BUF]
+            in_buf = state["in_buf"].at[c, f % BUF].set(
+                jnp.where(valid_f, inp, old)
+            )
+
+            # ---- backward slot ----
+            b, bc, valid_b = bwd_slot(t, sid)
+            b = jnp.clip(b, 0, M - 1)
+            x_saved = in_buf[bc, b % BUF]
+            tok_b = micro_tokens[b]
+            cp_b = chunk_params(bc)
+            gb, gfn, gh, gx, loss = jax.lax.cond(
+                (sid == S - 1) & (bc == V - 1),
+                lambda: last_pullback(cp_b, x_saved, tok_b),
+                lambda: mid_pullback(cp_b, x_saved, state["bwd_recv"]),
+            )
+
+            msk = valid_b.astype(jnp.float32)
+            g_chunks = jax.tree.map(
+                lambda a, g: a.at[bc].add(msk * g), state["g_chunks"], gb
+            )
+            g_norm = jax.tree.map(
+                lambda a, g: a + msk * g, state["g_norm"], gfn
+            )
+            g_head = state["g_head"] + msk * gh
+            # chunk 0 / device 0's gx is d(embedding rows)
+            msk0 = jnp.where(valid_b & (sid == 0) & (bc == 0), 1.0, 0.0)
+            g_embed = state["g_embed"].at[tok_b.reshape(-1)].add(
+                (msk0 * gx).reshape(-1, D).astype(emb.dtype)
+            )
+            loss_sum = state["loss_sum"] + msk * loss
+
+            # ---- rotate: activations down, gradients up ----
+            fwd_recv = jax.lax.ppermute(
+                jnp.where(valid_f, h_out, jnp.zeros_like(h_out)),
+                stage_axis, down,
+            )
+            bwd_recv = jax.lax.ppermute(
+                jnp.where(valid_b, gx, jnp.zeros_like(gx)), stage_axis, up
+            )
+            return dict(
+                in_buf=in_buf, fwd_recv=fwd_recv, bwd_recv=bwd_recv,
+                g_chunks=g_chunks, g_embed=g_embed, g_norm=g_norm,
+                g_head=g_head, loss_sum=loss_sum,
+            ), None
+
+        nr_ticks = V * M + V * S + S - 1
+        state, _ = jax.lax.scan(tick, init, jnp.arange(nr_ticks))
+
+        inv_m = 1.0 / M
+        grads = {
+            "embed": {"embedding": jax.lax.psum(
+                state["g_embed"] * inv_m, stage_axis)},
+            "stacked_blocks": jax.tree.map(
+                lambda g: (g * inv_m)[None], state["g_chunks"]
+            ),
+            "final_norm": jax.tree.map(
+                lambda g: jax.lax.psum(g * inv_m, stage_axis),
+                state["g_norm"],
+            ),
+            "lm_head": {"kernel": jax.lax.psum(
+                state["g_head"] * inv_m, stage_axis)},
+        }
+        if data_axis is not None:
+            grads = jax.lax.pmean(grads, data_axis)
+        loss = jax.lax.psum(state["loss_sum"] * inv_m, stage_axis)
+        if data_axis is not None:
+            loss = jax.lax.pmean(loss, data_axis)
+        return grads, loss
+
+    def wrapped(int_params, tokens):
+        B, T = tokens.shape
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        micro = tokens.reshape(M, B // M, T)
+        return grads_and_loss(int_params, micro)
+
+    return wrapped
+
+
+def make_interleaved_1f1b_train_step(
+    config: LlamaConfig,
+    mesh,
+    optimizer,
+    nr_stages: int,
+    nr_microbatches: int,
+    nr_chunks: int = 2,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+    donate: bool = False,
+):
+    """Jitted ``step(int_params, opt_state, tokens)`` on the interleaved
+    schedule (params from :func:`interleave_pp_params`)."""
+    grad_fn = make_interleaved_1f1b_grad_fn(
+        config, mesh, nr_stages, nr_microbatches, nr_chunks, stage_axis,
+        data_axis,
+    )
+
+    def step(int_params, opt_state, tokens):
+        grads, loss = grad_fn(int_params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, int_params)
+        int_params = optax.apply_updates(int_params, updates)
+        return int_params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

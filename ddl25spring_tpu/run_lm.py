@@ -7,8 +7,9 @@ Strategies map to the reference's scripts — ``single`` (primer/intro.py),
 (communication-compressed DP: top-k error feedback / stochastic int8),
 ``dp-zero``
 (ZeRO-sharded optimizer state over the data axis; PAPERS.md), ``pp`` (GPipe
-microbatching, PP/1F1B/intro_PP_1F1B_MB.py), ``1f1b`` (the interleaved
-schedule the reference never got working), ``dp-pp`` (the hybrid 2x3 MP
+microbatching, PP/1F1B/intro_PP_1F1B_MB.py), ``1f1b`` (the schedule the
+reference never got working), ``1f1b-int`` (interleaved virtual-stage 1F1B,
+``--nr-chunks`` chunks per device), ``dp-pp`` (the hybrid 2x3 MP
 topology), ``tp`` (absent from the reference; free under GSPMD), ``sp``
 (ring-attention sequence parallelism; absent from the reference), ``ep``
 (top-k MoE with experts sharded over the mesh; absent from the reference) —
@@ -57,7 +58,7 @@ from .utils import MetricsLogger
 # strategies whose parameters do NOT remain a full-model pytree (stage- or
 # expert-sharded layouts): generation and held-out eval score with the plain
 # model and skip these
-SHARDED_PARAM_STRATEGIES = ("pp", "1f1b", "dp-pp", "ep")
+SHARDED_PARAM_STRATEGIES = ("pp", "1f1b", "1f1b-int", "dp-pp", "ep")
 
 
 def _tokenizer(cfg: LmConfig, stories):
@@ -243,6 +244,38 @@ def build_trainer(cfg: LmConfig, vocab_size: int = BASE_VOCAB):
             mode="grad" if cfg.strategy == "dp" else "weight", donate=True,
         )
         return step, params, optimizer.init(params), shard
+
+    if cfg.strategy == "1f1b-int":
+        # interleaved virtual-stage 1F1B: V chunks of nr_layers/(V*S) layers
+        # per device (parallel/pp_interleaved.py)
+        from .parallel import (
+            interleave_pp_params,
+            make_interleaved_1f1b_train_step,
+        )
+
+        V = cfg.nr_chunks
+        stages = min(n, mcfg.nr_layers // V)
+        while stages > 1 and (
+            mcfg.nr_layers % (stages * V) or cfg.nr_microbatches % stages
+        ):
+            stages -= 1
+        if stages < 2:
+            raise ValueError(
+                f"1f1b-int needs a stage count >= 2 with nr_layers % "
+                f"(S*{V}) == 0 and nr_microbatches % S == 0 "
+                f"(layers {mcfg.nr_layers}, microbatches "
+                f"{cfg.nr_microbatches}, devices {n})"
+            )
+        mesh = make_mesh({"stage": stages}, devices=devices[:stages])
+        int_params = interleave_pp_params(params, mcfg, stages, V)
+        int_params = apply_shardings(
+            int_params, pp_param_shardings(mesh, int_params)
+        )
+        step = make_interleaved_1f1b_train_step(
+            mcfg, mesh, optimizer, nr_stages=stages,
+            nr_microbatches=cfg.nr_microbatches, nr_chunks=V, donate=True,
+        )
+        return step, int_params, optimizer.init(int_params), identity
 
     if cfg.strategy in ("pp", "1f1b", "dp-pp"):
         dp = 2 if cfg.strategy == "dp-pp" else 1

@@ -54,9 +54,33 @@ def run(cfg: VflConfig):
         if cfg.mode == "classify":
             y1h = np.eye(2, dtype=np.float32)[d.y]
             split = int(0.8 * len(d.y))
-            net = VFLNetwork(feature_slices=slices,
-                             outs_per_party=[2 * len(s) for s in slices],
-                             seed=cfg.seed)
+            if cfg.sharded:
+                import math
+
+                import jax
+
+                from .parallel import make_mesh
+                from .vfl import PartyShardedVFL
+
+                # party-axis size: largest divisor of the party count that
+                # fits the devices (parties fold onto devices in equal
+                # groups; make_mesh happily uses a device subset)
+                nd = len(jax.devices())
+                axis = max(d for d in range(1, nd + 1)
+                           if cfg.nr_clients % d == 0)
+                mesh = make_mesh({"party": axis}) if axis > 1 else None
+                if mesh is None:
+                    print(f"note: cannot split {cfg.nr_clients} parties "
+                          f"across {nd} device(s); running unsharded")
+                net = PartyShardedVFL(
+                    feature_slices=slices,
+                    out_dim=2 * max(len(s) for s in slices),
+                    seed=cfg.seed, mesh=mesh,
+                )
+            else:
+                net = VFLNetwork(feature_slices=slices,
+                                 outs_per_party=[2 * len(s) for s in slices],
+                                 seed=cfg.seed)
             history = net.train_with_settings(
                 cfg.epochs, cfg.batch_size, d.x[:split], y1h[:split],
                 log_loss=log,

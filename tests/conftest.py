@@ -39,3 +39,32 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+# --- slow tier -------------------------------------------------------------
+# A handful of tests dominate wall time (the mesh checkpoint-resume round
+# trips and the 1F1B-vs-GPipe double compile were ~33 of 54 warm minutes);
+# their oracle value is preserved by cheaper siblings in the default run.
+# They are skipped unless --runslow is given, keeping `pytest -q` fast
+# (VERDICT round 1, item 8) while the full tier stays one flag away.
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="also run tests marked slow (compile-heavy resume/oracle tiers)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: compile-heavy test, skipped unless --runslow"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow (run with --runslow)")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -406,6 +406,7 @@ def test_dp_validation_errors():
                      dp_clip=1.0, aggregator=coordinate_median)
 
 
+@pytest.mark.slow  # test_fedbuff_window1_equals_fedavg_round pins the tick math by default
 def test_fedbuff_checkpoint_resume(tmp_path):
     """FedBuff's stacked version history round-trips through the generic
     CLI checkpoint path: a resumed run reproduces the uninterrupted

@@ -52,3 +52,35 @@ def test_flash_in_llama_forward():
     flash_model = Llama(dataclasses.replace(cfg, attn_impl="flash"))
     out = flash_model.apply(params, tokens)
     assert jnp.allclose(out, ref, atol=2e-4), jnp.abs(out - ref).max()
+
+
+def test_flash_bf16_matches_f32_reference(qkv):
+    """The kernels keep matmul operands in their storage dtype (bf16 on the
+    LM path) with f32 accumulators — the only behavior the storage-dtype
+    path changes vs the f32 tests above, so it needs its own oracle: bf16
+    flash output and grads must track the float32 dense reference to bf16
+    tolerance."""
+    q32, k32, v32 = qkv
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+
+    out = flash_causal_attention(q, k, v, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = causal_attention(q32, k32, v32)
+    # bf16 has ~3 decimal digits; the online softmax + f32 accumulation must
+    # not add error beyond input-rounding scale
+    assert jnp.max(jnp.abs(out.astype(jnp.float32) - ref)) < 0.03
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_causal_attention(q, k, v, interpret=True)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, (0, 1, 2))(q32, k32, v32)
+    for a, b in zip(g_flash, g_dense):
+        assert a.dtype == jnp.bfloat16
+        err = jnp.max(jnp.abs(a.astype(jnp.float32) - b))
+        scale = jnp.max(jnp.abs(b)) + 1e-6
+        assert err / scale < 0.05, (err, scale)

@@ -75,3 +75,45 @@ def test_resnet18_trains_one_step():
     l0, grads = jax.value_and_grad(loss)(params)
     params2 = jax.tree.map(lambda p, g: p - 0.01 * g, params, grads)
     assert loss(params2) < l0
+
+
+def test_lean_groupnorm_matches_flax():
+    """ops.norm.LeanGroupNorm: f32 stats + bf16 elementwise must agree with
+    flax's all-f32 GroupNorm to bf16 rounding, with an identical param tree
+    (so ResNet(norm_impl=...) can switch freely on existing checkpoints)."""
+    import flax.linen as nn
+    import numpy as np
+
+    from ddl25spring_tpu.ops.norm import LeanGroupNorm
+
+    x = jax.random.normal(jax.random.key(0), (4, 8, 8, 64), jnp.bfloat16)
+    lean = LeanGroupNorm(num_groups=32, dtype=jnp.bfloat16)
+    ref = nn.GroupNorm(num_groups=32, dtype=jnp.bfloat16, epsilon=1e-6)
+    p_lean = lean.init(jax.random.key(1), x)
+    p_ref = ref.init(jax.random.key(1), x)
+    assert jax.tree.structure(p_lean) == jax.tree.structure(p_ref)
+    assert all(
+        a.shape == b.shape
+        for a, b in zip(jax.tree.leaves(p_lean), jax.tree.leaves(p_ref))
+    )
+    # non-trivial affine so the folded mul/add path is exercised
+    p = {"params": {
+        "scale": jnp.linspace(0.5, 1.5, 64),
+        "bias": jnp.linspace(-0.2, 0.2, 64),
+    }}
+    got = np.asarray(lean.apply(p, x), np.float32)
+    want = np.asarray(ref.apply(p, x), np.float32)
+    np.testing.assert_allclose(got, want, atol=0.04, rtol=0.02)
+
+
+def test_resnet_norm_impls_share_params():
+    from ddl25spring_tpu.models import ResNet18
+
+    x = jnp.zeros((2, 32, 32, 3))
+    a = ResNet18(dtype=jnp.bfloat16).init(jax.random.key(0), x)
+    b = ResNet18(dtype=jnp.bfloat16, norm_impl="lean").init(
+        jax.random.key(0), x
+    )
+    assert jax.tree.structure(a) == jax.tree.structure(b)
+    out = ResNet18(dtype=jnp.bfloat16, norm_impl="lean").apply(b, x)
+    assert out.shape == (2, 10)

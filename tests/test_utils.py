@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ddl25spring_tpu.utils import (
     tree_stack,
@@ -161,6 +162,7 @@ def test_plots_write_figures(tmp_path):
         assert p.exists() and p.stat().st_size > 1000
 
 
+@pytest.mark.slow  # the cheap sibling test_hfl_cli_runs_and_checkpoints keeps default resume coverage
 def test_hfl_cli_mesh_checkpoint_resume(tmp_path):
     """Resume must work when the round is MESH-SHARDED: restored params come
     back committed to one device and have to be un-committed before the jit
