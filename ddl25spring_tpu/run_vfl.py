@@ -55,8 +55,6 @@ def run(cfg: VflConfig):
             y1h = np.eye(2, dtype=np.float32)[d.y]
             split = int(0.8 * len(d.y))
             if cfg.sharded:
-                import math
-
                 import jax
 
                 from .parallel import make_mesh

@@ -10,9 +10,9 @@ teaches must hold and are pinned here:
 - A3: more local epochs speed up early FedAvg convergence; the non-IID
   2-shard split degrades accuracy vs IID.
 
-The committed artifact run (results/homework1_output.txt) records the full
-sweep; this test keeps the orderings from regressing between rounds with a
-small config (N=10, 3 rounds).
+The artifact run recorded under results/ (homework1_output.txt) holds the
+full sweep; this test keeps the orderings from regressing between rounds
+with a small config (N=10, 3 rounds).
 """
 
 import pytest
