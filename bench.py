@@ -36,19 +36,28 @@ def build_server(seed: int = 10, norm_impl: str = "flax"):
     import jax.numpy as jnp
 
     from ddl25spring_tpu.data import load_cifar10, split_dataset
+    from ddl25spring_tpu.data.cifar import cifar_input_transform
     from ddl25spring_tpu.fl import FedAvgServer
     from ddl25spring_tpu.fl.task import classification_task
     from ddl25spring_tpu.models import ResNet18
     from ddl25spring_tpu.parallel import make_mesh
 
-    ds = load_cifar10()
+    # raw uint8 dataset + on-device normalization: the stacked 256-client
+    # CIFAR array crosses the (slow, remote-tunnel) host->device boundary as
+    # ~157 MB instead of ~630 MB f32; the cast+normalize fuses into the stem
+    # conv (data/mnist.py raw_dataset)
+    ds = load_cifar10(raw=True)
+    _stamp("dataset generated/loaded (host)")
     client_data = split_dataset(
         ds.train_x, ds.train_y, nr_clients=256, iid=True, seed=seed,
         pad_multiple=50,
     )
+    _stamp("client split done; building task + jit round_fn "
+           "(device transfer happens here) ...")
     task = classification_task(
         ResNet18(dtype=jnp.bfloat16, norm_impl=norm_impl), (32, 32, 3),
-        ds.test_x, ds.test_y
+        ds.test_x, ds.test_y,
+        input_transform=cifar_input_transform(jnp.bfloat16),
     )
     # shard the sampled-client axis across every available chip (the
     # one-core-per-simulated-client north star); single-chip runs unsharded

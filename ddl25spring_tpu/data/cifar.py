@@ -16,6 +16,7 @@ from .mnist import (
     ImageDataset,
     announce_synthetic_fallback,
     candidate_data_dirs,
+    raw_dataset,
     synthetic_image_dataset,
 )
 
@@ -30,11 +31,22 @@ def _normalize(x_uint8: np.ndarray) -> np.ndarray:
     return (x - CIFAR_MEAN) / CIFAR_STD
 
 
-def _try_load_real() -> ImageDataset | None:
+def cifar_input_transform(dtype=None):
+    """On-device normalizer for ``load_cifar10(raw=True)`` uint8 batches
+    (see data.mnist.make_input_transform / raw_dataset)."""
+    from .mnist import make_input_transform
+
+    return make_input_transform(CIFAR_MEAN, CIFAR_STD, dtype)
+
+
+def _try_load_real(raw: bool = False) -> ImageDataset | None:
     for root in _candidate_dirs():
         npz = root / "cifar10.npz"
         if npz.exists():
             d = np.load(npz)
+            if raw:
+                return raw_dataset(d["train_x"], d["train_y"],
+                                   d["test_x"], d["test_y"], synthetic=False)
             return ImageDataset(
                 train_x=_normalize(d["train_x"]),
                 train_y=d["train_y"].astype(np.int32),
@@ -52,6 +64,9 @@ def _try_load_real() -> ImageDataset | None:
 
             xs, ys = zip(*[load_batch(batch_dir / f"data_batch_{i}") for i in range(1, 6)])
             test_x, test_y = load_batch(batch_dir / "test_batch")
+            if raw:
+                return raw_dataset(np.concatenate(xs), np.concatenate(ys),
+                                   test_x, test_y, synthetic=False)
             return ImageDataset(
                 train_x=_normalize(np.concatenate(xs)),
                 train_y=np.concatenate(ys),
@@ -67,8 +82,12 @@ def load_cifar10(
     n_train: int = 50000,
     n_test: int = 10000,
     seed: int = 1,
+    raw: bool = False,
 ) -> ImageDataset:
-    real = _try_load_real()
+    """``raw=True`` returns uint8 images (no normalization) — same pixels,
+    same rng stream as the normalized dataset for a given seed; normalize
+    on device with :func:`cifar_input_transform`."""
+    real = _try_load_real(raw=raw)
     if real is not None:
         return real
     if not synthetic_fallback:
@@ -80,5 +99,5 @@ def load_cifar10(
     return synthetic_image_dataset(
         n_train=n_train, n_test=n_test, size=32, nr_classes=10,
         channels=3, noise=0.3, max_shift=4, seed=seed,
-        mean=CIFAR_MEAN, std=CIFAR_STD,
+        mean=CIFAR_MEAN, std=CIFAR_STD, raw=raw,
     )

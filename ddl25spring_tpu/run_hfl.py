@@ -71,12 +71,23 @@ def build_server(cfg: HflConfig):
             f"only; algorithm {cfg.algorithm!r} would silently train "
             "without privacy"
         )
+    # datasets ship as raw uint8 and are normalized on device inside the
+    # jitted loss/score fns — 4x less host->device transfer, which matters
+    # on the remote-tunnel TPU (data/mnist.py raw_dataset)
     if cfg.dataset == "mnist":
-        ds = load_mnist()
-        task = classification_task(MnistCnn(), (28, 28, 1), ds.test_x, ds.test_y)
+        from .data.mnist import mnist_input_transform
+
+        ds = load_mnist(raw=True)
+        task = classification_task(MnistCnn(), (28, 28, 1), ds.test_x,
+                                   ds.test_y,
+                                   input_transform=mnist_input_transform())
     elif cfg.dataset == "cifar10":
-        ds = load_cifar10()
-        task = classification_task(ResNet18(), (32, 32, 3), ds.test_x, ds.test_y)
+        from .data.cifar import cifar_input_transform
+
+        ds = load_cifar10(raw=True)
+        task = classification_task(ResNet18(), (32, 32, 3), ds.test_x,
+                                   ds.test_y,
+                                   input_transform=cifar_input_transform())
     else:
         raise ValueError(f"unknown dataset {cfg.dataset!r}")
 

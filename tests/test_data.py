@@ -130,7 +130,11 @@ def test_load_mnist_real_idx_gz(tmp_path, monkeypatch):
     ds = load_mnist()
     assert not ds.synthetic
     assert ds.train_x.shape == (6, 28, 28, 1)
+    assert ds.train_x.dtype == np.float32  # raw=False must normalize
     assert np.array_equal(ds.test_y, ey.astype(np.int32))
+    ds_raw = load_mnist(raw=True)
+    assert ds_raw.train_x.dtype == np.uint8
+    assert np.array_equal(ds_raw.train_x[..., 0], tx)
 
 
 def test_load_cifar10_real_npz(tmp_path, monkeypatch):
@@ -158,3 +162,75 @@ def test_synthetic_fallback_banner(monkeypatch, capsys, tmp_path):
     # once per process, not per call
     load_mnist(n_train=10, n_test=5)
     assert "SYNTHETIC-DATA FALLBACK" not in capsys.readouterr().err
+
+
+# --- raw (uint8) dataset path + on-device normalization ---------------------
+# (bench.py ships the 256-client CIFAR stack as uint8 — 4x less tunnel
+# transfer — and normalizes inside the jitted loss; data/mnist.py raw_dataset)
+
+def test_cifar_raw_matches_normalized_synthetic():
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.data import load_cifar10
+    from ddl25spring_tpu.data.cifar import cifar_input_transform
+
+    a = load_cifar10(n_train=64, n_test=16)
+    b = load_cifar10(n_train=64, n_test=16, raw=True)
+    assert b.train_x.dtype == np.uint8 and b.test_x.dtype == np.uint8
+    assert b.train_x.shape == a.train_x.shape  # same pixels, same rng stream
+    assert np.array_equal(b.train_y, a.train_y)
+    got = np.asarray(cifar_input_transform()(jnp.asarray(b.train_x)))
+    np.testing.assert_allclose(got, a.train_x, atol=1e-5)
+
+
+def test_cifar_raw_real_npz(tmp_path, monkeypatch):
+    from ddl25spring_tpu.data import load_cifar10
+
+    tx, ty = _tiny_images(10, 32, 3, 6)
+    ex, ey = _tiny_images(5, 32, 3, 7)
+    np.savez(tmp_path / "cifar10.npz", train_x=tx, train_y=ty,
+             test_x=ex, test_y=ey)
+    monkeypatch.setenv("DDL25_DATA_DIR", str(tmp_path))
+    ds = load_cifar10(raw=True)
+    assert not ds.synthetic
+    assert ds.train_x.dtype == np.uint8
+    assert np.array_equal(ds.train_x, tx)
+    assert np.array_equal(ds.test_y, ey.astype(np.int32))
+
+
+def test_mnist_raw_synthetic_uint8(tmp_path, monkeypatch):
+    monkeypatch.setenv("DDL25_DATA_DIR", str(tmp_path))  # force synthetic
+    ds = load_mnist(n_train=12, n_test=4)  # normalized baseline
+    raw = synthetic_image_dataset(n_train=12, n_test=4, raw=True)
+    assert raw.train_x.dtype == np.uint8
+    assert raw.train_x.shape == (12, 28, 28, 1)
+    # same pixels: normalizing raw reproduces the float dataset
+    want = (raw.train_x.astype(np.float32) / 255.0 - 0.1307) / 0.3081
+    np.testing.assert_allclose(want, ds.train_x, atol=1e-5)
+
+
+def test_task_input_transform_equivalence():
+    """Loss through (uint8 data + on-device transform) == loss through
+    pre-normalized f32 data, on a small model (task.classification_task)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.data import load_cifar10
+    from ddl25spring_tpu.data.cifar import cifar_input_transform
+    from ddl25spring_tpu.fl.task import classification_task
+    from ddl25spring_tpu.models import MnistCnn
+
+    a = load_cifar10(n_train=32, n_test=8)
+    b = load_cifar10(n_train=32, n_test=8, raw=True)
+    model = MnistCnn()
+    t_f32 = classification_task(model, (32, 32, 3), a.test_x, a.test_y)
+    t_raw = classification_task(model, (32, 32, 3), b.test_x, b.test_y,
+                                input_transform=cifar_input_transform())
+    params = t_f32.init(jax.random.key(0))
+    key = jax.random.key(1)
+    mask = jnp.ones(8, bool)
+    l1 = t_f32.loss_fn(params, jnp.asarray(a.train_x[:8]),
+                       jnp.asarray(a.train_y[:8]), mask, key)
+    l2 = t_raw.loss_fn(params, jnp.asarray(b.train_x[:8]),
+                       jnp.asarray(b.train_y[:8]), mask, key)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
