@@ -37,26 +37,59 @@ def build_server(seed: int = 10, norm_impl: str = "flax"):
 
     from ddl25spring_tpu.data import load_cifar10, split_dataset
     from ddl25spring_tpu.data.cifar import cifar_input_transform
+    from ddl25spring_tpu.data.mnist import announce_synthetic_fallback
+    from ddl25spring_tpu.data.synth_device import device_synthetic_clients
     from ddl25spring_tpu.fl import FedAvgServer
     from ddl25spring_tpu.fl.task import classification_task
     from ddl25spring_tpu.models import ResNet18
     from ddl25spring_tpu.parallel import make_mesh
+    from ddl25spring_tpu.utils.transfer import chunked_device_put
 
-    # raw uint8 dataset + on-device normalization: the stacked 256-client
-    # CIFAR array crosses the (slow, remote-tunnel) host->device boundary as
-    # ~157 MB instead of ~630 MB f32; the cast+normalize fuses into the stem
-    # conv (data/mnist.py raw_dataset)
-    ds = load_cifar10(raw=True)
-    _stamp("dataset generated/loaded (host)")
-    client_data = split_dataset(
-        ds.train_x, ds.train_y, nr_clients=256, iid=True, seed=seed,
-        pad_multiple=50,
-    )
-    _stamp("client split done; building task + jit round_fn "
-           "(device transfer happens here) ...")
+    # Two dataset paths, both designed around the remote tunnel's fragility
+    # with bulk host->device copies (a monolithic 157 MB put wedged at
+    # 0 bytes/s on 2026-07-31; see utils/transfer.py):
+    #   real CIFAR present  -> host load, raw uint8 (4x smaller than f32),
+    #                          CHUNKED device_put with progress stamps;
+    #   synthetic fallback  -> generate directly ON DEVICE (one jitted
+    #                          program, data/synth_device.py) — the only
+    #                          tunnel traffic is kilobytes of HLO.
+    from ddl25spring_tpu.data.mnist import DatasetNotFound
+
+    try:
+        ds = load_cifar10(raw=True, synthetic_fallback=False)
+    except DatasetNotFound:
+        # dataset absent -> on-device synthetic; a PARTIAL/corrupt real
+        # dataset raises plain FileNotFoundError and stays loud
+        ds = None
+    if ds is not None:
+        _stamp("real CIFAR-10 loaded (host)")
+        client_data = split_dataset(
+            ds.train_x, ds.train_y, nr_clients=256, iid=True, seed=seed,
+            pad_multiple=50,
+        )
+        _stamp("client split done; chunked transfer to device ...")
+        from ddl25spring_tpu.data import ClientDatasets
+
+        client_data = ClientDatasets(
+            x=chunked_device_put(client_data.x, label="clients.x"),
+            y=chunked_device_put(client_data.y, label="clients.y"),
+            counts=client_data.counts,
+        )
+        test_x = chunked_device_put(ds.test_x, label="test.x")
+        test_y = chunked_device_put(ds.test_y, label="test.y")
+    else:
+        announce_synthetic_fallback("cifar10")
+        _stamp("generating synthetic CIFAR on device (no bulk transfer) ...")
+        client_data, test_x, test_y = device_synthetic_clients(
+            nr_clients=256, n_train=50000, n_test=10000, seed=seed,
+            pad_multiple=50,
+        )
+        jax.block_until_ready(client_data.x)
+        _stamp("on-device dataset ready")
+    _stamp("building task + jit round_fn ...")
     task = classification_task(
         ResNet18(dtype=jnp.bfloat16, norm_impl=norm_impl), (32, 32, 3),
-        ds.test_x, ds.test_y,
+        test_x, test_y,
         input_transform=cifar_input_transform(jnp.bfloat16),
     )
     # shard the sampled-client axis across every available chip (the
@@ -187,6 +220,52 @@ def _probe_device_with_retry(attempts: int = 6, timeout_s: float = 90.0,
     return False
 
 
+METRIC = "fedavg_cifar10_resnet18_256clients_rounds_per_sec"
+
+
+def _emit_json(value: float, *, error: str | None = None, **extra):
+    """The driver contract: exactly ONE well-formed JSON line on stdout.
+    Shared by the success, probe-failure and watchdog paths so the schema
+    can't drift between them."""
+    line = {
+        "metric": METRIC,
+        "value": round(value, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": (
+            round(value / CPU_BASELINE_ROUNDS_PER_SEC, 2)
+            if CPU_BASELINE_ROUNDS_PER_SEC
+            else None
+        ),
+    }
+    if error is not None:
+        line["error"] = error
+    line.update(extra)
+    print(json.dumps(line))
+    sys.stdout.flush()
+    sys.stderr.flush()
+
+
+def _arm_watchdog(deadline_s: float):
+    """Emit the error JSON and kill the process if the bench hasn't finished
+    by ``deadline_s``.  The probe only proves a trivial op completes; the
+    tunnel can still wedge mid-run on a bigger op (observed 2026-07-31: a
+    bulk transfer froze at 0 bytes/s minutes after a successful probe), and a
+    silently hung bench would burn the driver's whole budget."""
+    import os
+    import threading
+
+    def fire():
+        _emit_json(0.0, error=f"bench deadline ({deadline_s:.0f}s) exceeded: "
+                              "device op wedged after a successful probe "
+                              "(remote TPU tunnel stalled mid-run?)")
+        os._exit(2)
+
+    t = threading.Timer(deadline_s, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
     from ddl25spring_tpu.utils.platform import select_platform
 
@@ -199,6 +278,10 @@ def main():
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the timed rounds "
                          "into DIR (view with xprof/tensorboard)")
+    ap.add_argument("--deadline-s", type=float, default=1500.0,
+                    help="hard wall-clock cap after the device probe; a "
+                         "mid-run tunnel wedge emits the error JSON and "
+                         "exits 2 instead of hanging the driver")
     args = ap.parse_args()
 
     if args.measure_cpu_baseline:
@@ -209,23 +292,16 @@ def main():
     if not _probe_device_with_retry():
         # one well-formed JSON line either way: a hung tunnel must not hang
         # the driver, and value 0 is unambiguous about what happened
-        print(json.dumps({
-            "metric": "fedavg_cifar10_resnet18_256clients_rounds_per_sec",
-            "value": 0.0,
-            "unit": "rounds/sec",
-            "vs_baseline": 0.0,
-            "error": "device unreachable: trivial op never completed across "
-                     "6 probe attempts over ~10 min (remote TPU tunnel "
-                     "down?)",
-        }))
+        _emit_json(0.0, error="device unreachable: trivial op never "
+                              "completed across 6 probe attempts over "
+                              "~10 min (remote TPU tunnel down?)")
         import os
 
-        sys.stdout.flush()  # os._exit skips interpreter shutdown/flushing
-        sys.stderr.flush()
         # nonzero so scripts/CI keyed on exit status see the failure; daemon
         # probe threads may be wedged in the backend, so skip shutdown
         os._exit(1)
 
+    watchdog = _arm_watchdog(args.deadline_s)
     _stamp("building server (data + mesh + jit round_fn) ...")
     server = build_server(norm_impl=args.norm_impl)
     if args.profile:
@@ -242,19 +318,9 @@ def main():
     # deterministic synthetic data on the zero-egress container)
     final_acc = server.test()
     _stamp("eval done")
-    vs = (
-        round(rps / CPU_BASELINE_ROUNDS_PER_SEC, 2)
-        if CPU_BASELINE_ROUNDS_PER_SEC
-        else None
-    )
-    print(json.dumps({
-        "metric": "fedavg_cifar10_resnet18_256clients_rounds_per_sec",
-        "value": round(rps, 4),
-        "unit": "rounds/sec",
-        "vs_baseline": vs,
-        "final_test_accuracy_pct": round(final_acc, 2),
-        "rounds_timed": args.rounds,
-    }))
+    watchdog.cancel()
+    _emit_json(rps, final_test_accuracy_pct=round(final_acc, 2),
+               rounds_timed=args.rounds)
 
 
 if __name__ == "__main__":

@@ -13,6 +13,7 @@ import pickle
 import numpy as np
 
 from .mnist import (
+    DatasetNotFound,
     ImageDataset,
     announce_synthetic_fallback,
     candidate_data_dirs,
@@ -91,7 +92,7 @@ def load_cifar10(
     if real is not None:
         return real
     if not synthetic_fallback:
-        raise FileNotFoundError(
+        raise DatasetNotFound(
             "CIFAR-10 not found; set DDL25_DATA_DIR to a directory containing "
             "cifar10.npz or cifar-10-batches-py"
         )

@@ -30,6 +30,14 @@ MNIST_STD = 0.3081
 _announced: set[str] = set()
 
 
+class DatasetNotFound(FileNotFoundError):
+    """Raised by loaders with ``synthetic_fallback=False`` when the dataset is
+    absent from every candidate root.  A dedicated type so callers opting into
+    their own fallback don't also swallow a *partial/corrupt* real dataset's
+    ``FileNotFoundError`` (e.g. an interrupted copy missing one CIFAR batch),
+    which should stay loud."""
+
+
 def announce_synthetic_fallback(dataset: str) -> None:
     """Loud once-per-process stderr banner when a run falls back to the
     synthetic dataset, so no CLI/benchmark result can be mistaken for a
@@ -260,7 +268,7 @@ def load_mnist(
     if real is not None:
         return real
     if not synthetic_fallback:
-        raise FileNotFoundError(
+        raise DatasetNotFound(
             "MNIST not found on disk and synthetic fallback disabled; "
             "set DDL25_DATA_DIR to a directory containing mnist.npz or MNIST/raw"
         )

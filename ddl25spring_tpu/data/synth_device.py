@@ -1,0 +1,160 @@
+"""On-device synthetic image datasets: zero host->device bulk transfer.
+
+The north-star bench runs on a remote-tunnel TPU where bulk host->device
+copies are the startup bottleneck AND a reliability hazard: round 1 lost its
+entire perf evidence to a tunnel outage, and round 2 observed a single
+monolithic 157 MB ``device_put`` wedge forever (0 bytes/s, no error) while a
+trivial-op probe succeeded moments earlier.  When the dataset is synthetic
+anyway (zero-egress container, data.mnist docstring), there is no reason to
+ship bytes at all: this module re-creates the synthetic generator of
+:func:`ddl25spring_tpu.data.mnist.synthetic_image_dataset` as ONE jitted JAX
+program, so the only tunnel traffic is the lowered HLO (kilobytes) and the
+arrays materialise directly in HBM.
+
+Same construction, jax.random instead of numpy Philox: smooth per-class
+prototype fields, per-sample random shifts, pixel noise, uint8 storage.  The
+pixel stream therefore differs from the host generator for a given seed (the
+two RNGs are unrelated), but the distribution, shapes, label structure and
+learnability are identical — bench rounds/sec is unaffected and final-accuracy
+stays an apples-to-apples synthetic-data number (documented in
+docs/BENCHMARKS.md).
+
+The client split mirrors ``split_indices`` IID semantics (reference
+hfl_complete.py:91-104 via np.array_split): near-equal shards, first
+``n % nr_clients`` clients one sample larger.  Since every synthetic sample is
+iid anyway, generating each client's shard directly is distributionally
+identical to permute-then-split.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .split import ClientDatasets
+
+
+def iid_split_counts(n: int, nr_clients: int) -> np.ndarray:
+    """Shard sizes of ``np.array_split(range(n), nr_clients)`` (split.py)."""
+    base, rem = divmod(n, nr_clients)
+    return np.asarray(
+        [base + 1] * rem + [base] * (nr_clients - rem), np.int32
+    )
+
+
+def _smooth_protos(key, nr_classes, size, channels):
+    """Low-frequency random fields in [0, 1] — the jax twin of
+    data.mnist._smooth_field (coarse 7x7 grid, nearest upsample, box blur,
+    per-(class, channel) min-max normalise)."""
+    coarse = jax.random.uniform(key, (nr_classes, 7, 7, channels))
+    grid = jnp.minimum(jnp.arange(size) * 7 // size, 6)
+    fine = coarse[:, grid][:, :, grid]  # (classes, size, size, C)
+    k = 3
+    padded = jnp.pad(fine, ((0, 0), (k, k), (k, k), (0, 0)), mode="edge")
+    out = jnp.zeros_like(fine)
+    for dy in range(2 * k + 1):
+        for dx in range(2 * k + 1):
+            out = out + padded[:, dy : dy + size, dx : dx + size]
+    out = out / (2 * k + 1) ** 2
+    lo = out.min(axis=(1, 2), keepdims=True)
+    hi = out.max(axis=(1, 2), keepdims=True)
+    return (out - lo) / jnp.maximum(hi - lo, 1e-8)
+
+
+def _make_samples(key, protos, shape, *, size, nr_classes, noise, max_shift):
+    """uint8 images + labels for an arbitrary leading ``shape``.
+
+    Gather-free on purpose: per-sample advanced-indexing rolls lower to XLA
+    gathers whose scalar-loop codegen took minutes at bench scale (51k
+    samples) on both CPU and TPU.  Class selection and the circular shift are
+    instead expressed as one-hot matmuls / batched permutation matmuls —
+    dense dot_generals the MXU (and host BLAS) eat for breakfast: ~20 GFLOP
+    total at bench scale, sub-second on a v5e."""
+    ky, ks, kn = jax.random.split(key, 3)
+    y = jax.random.randint(ky, shape, 0, nr_classes)
+    yf = y.reshape(-1)
+    n = yf.shape[0]
+    # class selection: (n, classes) @ (classes, size*size*C)
+    oh = jax.nn.one_hot(yf, nr_classes, dtype=jnp.float32)
+    x = (oh @ protos.reshape(nr_classes, -1)).reshape(n, size, size, -1)
+    # circular roll by per-sample (dr, dc): out[i] = in[(i - d) % size] as a
+    # permutation matmul P[i, j] = [j == (i - d) mod size]
+    shifts = jax.random.randint(ks, (n, 2), -max_shift, max_shift + 1)
+    idx = jnp.arange(size)
+    diff = idx[None, :, None] - idx[None, None, :]  # (1, size, size) = i - j
+    pr = (jnp.mod(diff - shifts[:, 0, None, None], size) == 0).astype(
+        jnp.float32
+    )
+    pc = (jnp.mod(diff - shifts[:, 1, None, None], size) == 0).astype(
+        jnp.float32
+    )
+    x = jnp.einsum("nij,njwc->niwc", pr, x)   # roll rows
+    x = jnp.einsum("nwj,nhjc->nhwc", pc, x)   # roll cols
+    x = x + noise * jax.random.normal(kn, x.shape)
+    x = jnp.clip(x, 0.0, 1.0)
+    x = (255.0 * x).astype(jnp.uint8)
+    return x.reshape(shape + x.shape[1:]), y.astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "nr_clients", "max_n", "n_test", "size", "channels", "nr_classes",
+        "noise", "max_shift",
+    ),
+)
+def _gen_all(key, counts, *, nr_clients, max_n, n_test, size, channels,
+             nr_classes, noise, max_shift):
+    kp, ktrain, ktest = jax.random.split(key, 3)
+    protos = _smooth_protos(kp, nr_classes, size, channels)
+    x, y = _make_samples(
+        ktrain, protos, (nr_clients, max_n),
+        size=size, nr_classes=nr_classes, noise=noise, max_shift=max_shift,
+    )
+    # stacked/padded layout contract (split.ClientDatasets): rows beyond
+    # counts[i] are zero padding, labels there are 0 (masked out by counts)
+    valid = jnp.arange(max_n)[None, :] < counts[:, None]
+    x = jnp.where(valid[:, :, None, None, None], x, 0)
+    y = jnp.where(valid, y, 0)
+    test_x, test_y = _make_samples(
+        ktest, protos, (n_test,),
+        size=size, nr_classes=nr_classes, noise=noise, max_shift=max_shift,
+    )
+    return x, y, test_x, test_y
+
+
+def device_synthetic_clients(
+    nr_clients: int,
+    n_train: int = 50000,
+    n_test: int = 10000,
+    size: int = 32,
+    channels: int = 3,
+    nr_classes: int = 10,
+    noise: float = 0.3,
+    max_shift: int = 4,
+    seed: int = 1,
+    pad_multiple: int = 1,
+):
+    """IID-split synthetic clients generated directly in device memory.
+
+    Returns ``(ClientDatasets, test_x, test_y)`` whose arrays are device
+    (uint8 images / int32 labels); pair with
+    ``data.mnist.make_input_transform`` exactly like a ``raw=True`` host
+    dataset.  The FL engine's ``jnp.asarray`` calls are no-ops on these, so
+    nothing large ever crosses the host->device boundary.
+    """
+    counts = iid_split_counts(n_train, nr_clients)
+    max_n = int(counts.max())
+    if pad_multiple > 1:
+        max_n = int(np.ceil(max_n / pad_multiple) * pad_multiple)
+    x, y, test_x, test_y = _gen_all(
+        jax.random.key(seed), jnp.asarray(counts),
+        nr_clients=nr_clients, max_n=max_n, n_test=n_test, size=size,
+        channels=channels, nr_classes=nr_classes, noise=float(noise),
+        max_shift=max_shift,
+    )
+    cd = ClientDatasets(x=x, y=y, counts=counts)
+    return cd, test_x, test_y

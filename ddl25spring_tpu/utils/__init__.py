@@ -17,10 +17,12 @@ from .checkpoint import Checkpointer
 from .logging import MetricsLogger, profile_trace, read_jsonl, timed
 from .plots import plot_accuracy_curves, plot_jsonl_metric, plot_loss_curves
 from .platform import device_sync, select_platform
+from .transfer import chunked_device_put
 
 __all__ = [
     "device_sync",
     "select_platform",
+    "chunked_device_put",
     "plot_accuracy_curves",
     "plot_jsonl_metric",
     "plot_loss_curves",

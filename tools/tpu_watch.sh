@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe the remote TPU tunnel every ~100s; append status lines to
+# /tmp/tpu_status.log.  Used while building to know the moment the tunnel
+# comes back so benches can run immediately.
+while true; do
+  ts=$(date +%H:%M:%S)
+  if timeout 60 python - <<'EOF' >/dev/null 2>&1
+import numpy as np, jax.numpy as jnp
+np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+EOF
+  then
+    echo "$ts UP" >> /tmp/tpu_status.log
+  else
+    echo "$ts down" >> /tmp/tpu_status.log
+  fi
+  sleep 100
+done
