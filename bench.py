@@ -70,13 +70,16 @@ def build_server(seed: int = 10, norm_impl: str = "flax"):
         _stamp("client split done; chunked transfer to device ...")
         from ddl25spring_tpu.data import ClientDatasets
 
+        touch = (lambda: _WATCHDOG.touch()) if _WATCHDOG else None
         client_data = ClientDatasets(
-            x=chunked_device_put(client_data.x, label="clients.x"),
-            y=chunked_device_put(client_data.y, label="clients.y"),
+            x=chunked_device_put(client_data.x, label="clients.x",
+                                 on_chunk=touch),
+            y=chunked_device_put(client_data.y, label="clients.y",
+                                 on_chunk=touch),
             counts=client_data.counts,
         )
-        test_x = chunked_device_put(ds.test_x, label="test.x")
-        test_y = chunked_device_put(ds.test_y, label="test.y")
+        test_x = chunked_device_put(ds.test_x, label="test.x", on_chunk=touch)
+        test_y = chunked_device_put(ds.test_y, label="test.y", on_chunk=touch)
     else:
         announce_synthetic_fallback("cifar10")
         _stamp("generating synthetic CIFAR on device (no bulk transfer) ...")
@@ -105,9 +108,12 @@ def build_server(seed: int = 10, norm_impl: str = "flax"):
 def _stamp(msg: str):
     print(f"[bench +{time.perf_counter() - _T0:.1f}s] {msg}", file=sys.stderr,
           flush=True)
+    if _WATCHDOG is not None:
+        _WATCHDOG.touch()
 
 
 _T0 = time.perf_counter()
+_WATCHDOG = None
 
 
 def _sync(tree):
@@ -221,12 +227,21 @@ def _probe_device_with_retry(attempts: int = 6, timeout_s: float = 90.0,
 
 
 METRIC = "fedavg_cifar10_resnet18_256clients_rounds_per_sec"
+_EMIT_LOCK = None  # created lazily (threading import stays local)
 
 
-def _emit_json(value: float, *, error: str | None = None, **extra):
+def _emit_json(value: float, *, error: str | None = None, **extra) -> bool:
     """The driver contract: exactly ONE well-formed JSON line on stdout.
     Shared by the success, probe-failure and watchdog paths so the schema
-    can't drift between them."""
+    can't drift between them — and guarded so a watchdog firing in the same
+    instant the main thread finishes can't print a second line."""
+    import threading
+
+    global _EMIT_LOCK
+    if _EMIT_LOCK is None:
+        _EMIT_LOCK = threading.Lock()
+    if not _EMIT_LOCK.acquire(blocking=False):
+        return False  # another path already emitted (or is emitting)
     line = {
         "metric": METRIC,
         "value": round(value, 4),
@@ -243,27 +258,52 @@ def _emit_json(value: float, *, error: str | None = None, **extra):
     print(json.dumps(line))
     sys.stdout.flush()
     sys.stderr.flush()
+    return True
 
 
-def _arm_watchdog(deadline_s: float):
-    """Emit the error JSON and kill the process if the bench hasn't finished
-    by ``deadline_s``.  The probe only proves a trivial op completes; the
-    tunnel can still wedge mid-run on a bigger op (observed 2026-07-31: a
-    bulk transfer froze at 0 bytes/s minutes after a successful probe), and a
-    silently hung bench would burn the driver's whole budget."""
-    import os
-    import threading
+class _Watchdog:
+    """Inactivity watchdog: emits the error JSON and kills the process when
+    NO progress stamp lands for ``idle_s`` seconds.
 
-    def fire():
-        _emit_json(0.0, error=f"bench deadline ({deadline_s:.0f}s) exceeded: "
-                              "device op wedged after a successful probe "
-                              "(remote TPU tunnel stalled mid-run?)")
-        os._exit(2)
+    The probe only proves a trivial op completes; the tunnel can still wedge
+    mid-run on a bigger op (observed 2026-07-31: a bulk transfer froze at
+    0 bytes/s minutes after a successful probe), and a silently hung bench
+    would burn the driver's whole budget.  Keyed on *inactivity*, not total
+    wall clock, so a slow-but-visibly-progressing run (chunked transfer
+    stamps, _stamp milestones) is never mistaken for a wedge."""
 
-    t = threading.Timer(deadline_s, fire)
-    t.daemon = True
-    t.start()
-    return t
+    def __init__(self, idle_s: float):
+        import threading
+
+        self.idle_s = idle_s
+        self._last = time.monotonic()
+        self._done = False
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def touch(self):
+        self._last = time.monotonic()
+
+    def cancel(self):
+        self._done = True
+
+    def _run(self):
+        import os
+
+        while not self._done:
+            time.sleep(2.0)
+            idle = time.monotonic() - self._last
+            if not self._done and idle > self.idle_s:
+                emitted = _emit_json(
+                    0.0,
+                    error=f"bench made no progress for {idle:.0f}s "
+                          f"(idle cap {self.idle_s:.0f}s): device op wedged "
+                          "after a successful probe (remote TPU tunnel "
+                          "stalled mid-run?)",
+                )
+                if emitted:
+                    os._exit(2)
+                return  # success path won the race; let main finish
 
 
 def main():
@@ -279,9 +319,11 @@ def main():
                     help="capture a jax.profiler trace of the timed rounds "
                          "into DIR (view with xprof/tensorboard)")
     ap.add_argument("--deadline-s", type=float, default=1500.0,
-                    help="hard wall-clock cap after the device probe; a "
-                         "mid-run tunnel wedge emits the error JSON and "
-                         "exits 2 instead of hanging the driver")
+                    help="no-progress (idle) cap after the device probe: if "
+                         "no milestone or transfer-chunk stamp lands for "
+                         "this long, the bench emits the error JSON and "
+                         "exits 2 instead of hanging the driver; slow but "
+                         "visibly progressing runs are unaffected")
     args = ap.parse_args()
 
     if args.measure_cpu_baseline:
@@ -301,7 +343,8 @@ def main():
         # probe threads may be wedged in the backend, so skip shutdown
         os._exit(1)
 
-    watchdog = _arm_watchdog(args.deadline_s)
+    global _WATCHDOG
+    _WATCHDOG = _Watchdog(args.deadline_s)
     _stamp("building server (data + mesh + jit round_fn) ...")
     server = build_server(norm_impl=args.norm_impl)
     if args.profile:
@@ -318,7 +361,7 @@ def main():
     # deterministic synthetic data on the zero-egress container)
     final_acc = server.test()
     _stamp("eval done")
-    watchdog.cancel()
+    _WATCHDOG.cancel()
     _emit_json(rps, final_test_accuracy_pct=round(final_acc, 2),
                rounds_timed=args.rounds)
 

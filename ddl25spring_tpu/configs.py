@@ -109,7 +109,9 @@ class LmConfig:
     nr_microbatches: int = 3   # intro_PP_1F1B_MB.py microbatch count
     moe_aux_weight: float = 0.01  # ep: load-balancing aux loss weight
     remat: bool = False        # gradient-checkpoint each block (HBM ↓, FLOPs ↑)
-    attn_impl: str = "dense"   # dense (XLA) | flash (Pallas kernels); sp forces ring
+    attn_impl: str = "dense"   # dense (XLA) | flash (Pallas); under
+    #                            --strategy sp: dense -> einsum ring,
+    #                            flash -> Pallas ring (ops/ring_flash.py)
     generate_tokens: int = 0   # after training, sample this many tokens
     generate_temperature: float = 0.8
     eval_every: int = 0        # held-out eval every N iters; 0 = off

@@ -39,19 +39,20 @@ class LlamaConfig:
     hidden_mult: float = 8 / 3  # SwiGLU hidden = mult * dmodel, rounded
     norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.float32  # compute dtype; bfloat16 on TPU
-    attn_impl: str = "dense"   # "dense" (XLA fused) | "ring" (sequence-parallel)
-    seq_axis: str = "seq"      # mesh axis for attn_impl="ring"
+    attn_impl: str = "dense"   # dense (XLA) | flash (Pallas) | ring |
+    #                            ring-flash (Pallas kernels inside the ring)
+    seq_axis: str = "seq"      # mesh axis for the ring attn_impls
     nr_experts: int = 0        # 0 = dense SwiGLU MLP; >0 = top-k MoE
     expert_topk: int = 2
     remat: bool = False        # rematerialize blocks in backward (HBM ↓, FLOPs ↑)
     decode: bool = False       # KV-cache autoregressive decoding (models.generate)
 
     def __post_init__(self):
-        if self.attn_impl not in ("dense", "ring", "flash"):
+        if self.attn_impl not in ("dense", "ring", "flash", "ring-flash"):
             raise ValueError(
                 f"attn_impl={self.attn_impl!r} not in ('dense', 'ring', "
-                "'flash') — a typo here would otherwise silently fall "
-                "through to dense attention"
+                "'flash', 'ring-flash') — a typo here would otherwise "
+                "silently fall through to dense attention"
             )
 
     @property
@@ -115,6 +116,10 @@ class Attention(nn.Module):
             out = self._decode_attention(q, k, v, positions)
         elif cfg.attn_impl == "ring":
             out = ring_causal_attention(q, k, v, cfg.seq_axis)
+        elif cfg.attn_impl == "ring-flash":
+            from ..ops.ring_flash import ring_flash_causal_attention
+
+            out = ring_flash_causal_attention(q, k, v, cfg.seq_axis)
         elif cfg.attn_impl == "flash":
             from ..ops.flash_attention import flash_causal_attention
 

@@ -4,7 +4,26 @@ from .losses import (
     causal_lm_loss,
     accuracy,
 )
-from .attention import causal_attention
+from .attention import causal_attention, ring_causal_attention
+
+# The Pallas ops resolve lazily (PEP 562) so `from ddl25spring_tpu.ops
+# import causal_lm_loss` — every FL/data path — doesn't pull
+# jax.experimental.pallas into processes that never touch a kernel.
+_LAZY = {
+    "flash_causal_attention": "flash_attention",
+    "flash_block_attention": "flash_attention",
+    "ring_flash_causal_attention": "ring_flash",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "nll_loss",
@@ -12,4 +31,8 @@ __all__ = [
     "causal_lm_loss",
     "accuracy",
     "causal_attention",
+    "ring_causal_attention",
+    "flash_causal_attention",
+    "flash_block_attention",
+    "ring_flash_causal_attention",
 ]

@@ -73,12 +73,20 @@ def _q_clamp(i, j, block_q, block_k):
     return jnp.maximum(i, (j * block_k) // block_q)
 
 
+# Every kernel takes a static ``causal`` flag.  causal=True is the standard
+# single-device op (diagonal masking, upper-triangle compute+DMA skipping);
+# causal=False computes FULL attention of q against this k/v — the building
+# block of the sequence-parallel ring (ops/ring_flash.py), where a device's
+# queries attend to an earlier device's keys with no masking at all.  The
+# flag is resolved at trace time, so the False path carries no mask code.
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
-                *, block_q, block_k, scale, nr_kv):
+                *, block_q, block_k, scale, nr_kv, causal):
     qi = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -89,8 +97,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
         acc[...] = jnp.zeros_like(acc)
 
     # causal: block j contributes iff its first key position is visible to
-    # the q block's last query position
-    @pl.when(j * block_k < (qi + 1) * block_q)
+    # the q block's last query position (non-causal: every block contributes,
+    # so the guard disappears at trace time)
     def _compute():
         # matmul operands stay in their storage dtype (bf16 from the model):
         # the MXU natively accumulates bf16 x bf16 into f32
@@ -100,13 +108,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
         k = k_ref[0]                                  # (block_k, d)
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_old = m_scr[...]
         m_new = jnp.maximum(m_old, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
@@ -117,6 +126,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
 
+    if causal:
+        pl.when(j * block_k < (qi + 1) * block_q)(_compute)
+    else:
+        _compute()
+
     @pl.when(j == nr_kv - 1)
     def _finalize():
         l = l_scr[...]
@@ -124,27 +138,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
         lse_ref[0, 0] = m_scr[...] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, *, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, *, block_q, block_k, interpret, causal):
     BH, T, d = q.shape
+    Tk = k.shape[1]
     scale = 1.0 / (d ** 0.5)
-    nr_kv = T // block_k
+    nr_kv = Tk // block_k
     grid = (BH, T // block_q, nr_kv)
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
-        nr_kv=nr_kv,
+        nr_kv=nr_kv, causal=causal,
     )
+    if causal:
+        # clamp masked upper-triangle steps to the diagonal block: the
+        # pipeline skips the DMA when the block index repeats, so causal
+        # skipping saves K/V bandwidth, not just compute
+        kv_map = lambda b, i, j: (b, _kv_clamp(i, j, block_q, block_k), 0)
+    else:
+        kv_map = lambda b, i, j: (b, j, 0)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            # clamp masked upper-triangle steps to the diagonal block: the
-            # pipeline skips the DMA when the block index repeats, so causal
-            # skipping saves K/V bandwidth, not just compute
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b, _kv_clamp(i, j, block_q, block_k), 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b, _kv_clamp(i, j, block_q, block_k), 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -171,7 +188,7 @@ def _flash_fwd(q, k, v, *, block_q, block_k, interpret):
 # --------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, block_q, block_k, scale, nr_kv):
+                   dq_scr, *, block_q, block_k, scale, nr_kv, causal):
     qi = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -179,7 +196,6 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    @pl.when(j * block_k < (qi + 1) * block_q)
     def _compute():
         q = q_ref[0]
         do = do_ref[0]
@@ -188,18 +204,25 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0]
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
         dq_scr[...] = dq_scr[...] + jnp.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
+
+    if causal:
+        pl.when(j * block_k < (qi + 1) * block_q)(_compute)
+    else:
+        _compute()
 
     @pl.when(j == nr_kv - 1)
     def _finalize():
@@ -208,7 +231,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, block_q, block_k, scale, nr_q):
+                    *, block_q, block_k, scale, nr_q, causal):
     ki = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -217,8 +240,6 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    # q block i sees k block ki iff its last query >= the block's first key
-    @pl.when((i + 1) * block_q > ki * block_k)
     def _compute():
         k = k_ref[0]                                  # (block_k, d)
         v = v_ref[0]
@@ -227,13 +248,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        q_pos = i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
         dv_scr[...] = dv_scr[...] + jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
         )
@@ -243,31 +266,50 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
         )
 
+    if causal:
+        # q block i sees k block ki iff its last query >= the block's first key
+        pl.when((i + 1) * block_q > ki * block_k)(_compute)
+    else:
+        _compute()
+
     @pl.when(i == nr_q - 1)
     def _finalize():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, *, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, o, lse, do, dlse, *, block_q, block_k, interpret,
+               causal):
     BH, T, d = q.shape
+    Tk = k.shape[1]
     scale = 1.0 / (d ** 0.5)
+    # delta_i = do_i . o_i - dlse_i: the softmax-backward row correction.
+    # With lse exposed as a real output (the ring merge consumes it), its
+    # cotangent enters ds_ij = p_ij (do_i . v_j - delta_i) through the same
+    # rowwise term — dlse of zeros recovers the classic flash backward.
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    )[:, None, :]  # (BH, 1, T), matching lse's Mosaic-legal layout
+    )[:, None, :] - dlse  # (BH, 1, T), matching lse's Mosaic-legal layout
     nr_q = T // block_q
-    nr_kv = T // block_k
+    nr_kv = Tk // block_k
+
+    if causal:
+        kv_map = lambda b, i, j: (b, _kv_clamp(i, j, block_q, block_k), 0)
+        q_map = lambda b, j, i: (b, _q_clamp(i, j, block_q, block_k), 0)
+        q_row_map = lambda b, j, i: (b, 0, _q_clamp(i, j, block_q, block_k))
+    else:
+        kv_map = lambda b, i, j: (b, j, 0)
+        q_map = lambda b, j, i: (b, i, 0)
+        q_row_map = lambda b, j, i: (b, 0, i)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, nr_kv=nr_kv),
+                          scale=scale, nr_kv=nr_kv, causal=causal),
         grid=(BH, nr_q, nr_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b, _kv_clamp(i, j, block_q, block_k), 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b, _kv_clamp(i, j, block_q, block_k), 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -280,27 +322,23 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q, block_k, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
-                          scale=scale, nr_q=nr_q),
+                          scale=scale, nr_q=nr_q, causal=causal),
         grid=(BH, nr_kv, nr_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d),
-                         lambda b, j, i: (b, _q_clamp(i, j, block_q, block_k), 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d),
-                         lambda b, j, i: (b, _q_clamp(i, j, block_q, block_k), 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, j, i: (b, 0, _q_clamp(i, j, block_q, block_k))),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, j, i: (b, 0, _q_clamp(i, j, block_q, block_k))),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_q), q_row_map),
+            pl.BlockSpec((1, 1, block_q), q_row_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
-            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, d), q.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -312,43 +350,69 @@ def _flash_bwd(q, k, v, o, lse, do, *, block_q, block_k, interpret):
 
 
 # --------------------------------------------------------------------------
-# public op (custom VJP over (B, T, H, d) layout)
+# public ops (custom VJP over (B, T, H, d) layout)
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_bthd(q, k, v, interpret):
-    o, _ = _flash_core(q, k, v, interpret)
-    return o
+def _to_bh(x):
+    B, T, H, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
 
 
-def _flash_core(q, k, v, interpret):
+def _from_bh(x, B, H):
+    BH, T, d = x.shape
+    return x.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_block(q, k, v, causal, interpret):
+    """(o, lse) of q attending to k/v — causal (Tq == Tk) or full.
+
+    ``lse`` (B, H, Tq) is a REAL output with a real gradient path (the ring
+    merge differentiates through it), not just a backward residual."""
+    out, lse, _ = _block_core(q, k, v, causal, interpret)
+    return out, lse
+
+
+def _block_core(q, k, v, causal, interpret):
     B, T, H, d = q.shape
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
-    block_q = block_k = _pick_block(T)
-    o, lse = _flash_fwd(to_bh(q), to_bh(k), to_bh(v),
-                        block_q=block_q, block_k=block_k, interpret=interpret)
-    return o.reshape(B, H, T, d).transpose(0, 2, 1, 3), (o, lse)
+    block_q = _pick_block(T)
+    block_k = _pick_block(k.shape[1])
+    if causal:
+        block_q = block_k = min(block_q, block_k)
+    o, lse = _flash_fwd(_to_bh(q), _to_bh(k), _to_bh(v),
+                        block_q=block_q, block_k=block_k,
+                        interpret=interpret, causal=causal)
+    return _from_bh(o, B, H), lse.reshape(B, H, T), (o, lse)
 
 
-def _flash_fwd_rule(q, k, v, interpret):
-    out, (o_bh, lse) = _flash_core(q, k, v, interpret)
-    return out, (q, k, v, o_bh, lse)
+def _flash_block_fwd_rule(q, k, v, causal, interpret):
+    out, lse_bht, (o_bh, lse) = _block_core(q, k, v, causal, interpret)
+    return (out, lse_bht), (q, k, v, o_bh, lse)
 
 
-def _flash_bwd_rule(interpret, res, g):
+def _flash_block_bwd_rule(causal, interpret, res, g):
+    do, dlse = g
     q, k, v, o_bh, lse = res
     B, T, H, d = q.shape
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
-    from_bh = lambda x: x.reshape(B, H, T, d).transpose(0, 2, 1, 3)
-    block_q = block_k = _pick_block(T)
+    block_q = _pick_block(T)
+    block_k = _pick_block(k.shape[1])
+    if causal:
+        block_q = block_k = min(block_q, block_k)
     dq, dk, dv = _flash_bwd(
-        to_bh(q), to_bh(k), to_bh(v), o_bh, lse, to_bh(g),
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        _to_bh(q), _to_bh(k), _to_bh(v), o_bh, lse, _to_bh(do),
+        dlse.reshape(B * H, 1, T).astype(jnp.float32),
+        block_q=block_q, block_k=block_k, interpret=interpret, causal=causal,
     )
-    return from_bh(dq), from_bh(dk), from_bh(dv)
+    return _from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H)
 
 
-_flash_bthd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+_flash_block.defvjp(_flash_block_fwd_rule, _flash_block_bwd_rule)
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
 
 
 def flash_causal_attention(q, k, v, *, interpret: bool | None = None):
@@ -358,6 +422,25 @@ def flash_causal_attention(q, k, v, *, interpret: bool | None = None):
     (B, T, H, head_dim).  ``interpret=None`` auto-selects: compiled on TPU,
     interpreter elsewhere (so the op works — slowly — in CPU tests).
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash_bthd(q, k, v, interpret)
+    o, _ = _flash_block(q, k, v, True, _resolve_interpret(interpret))
+    return o
+
+
+def flash_block_attention(q, k, v, *, causal: bool,
+                          interpret: bool | None = None):
+    """Blockwise attention returning ``(o, lse)`` — the ring building block.
+
+    ``causal=False`` computes FULL (unmasked) attention of the local queries
+    against a remote KV block (Tq and Tk may differ); ``lse`` (B, H, Tq)
+    feeds the online log-sum-exp merge that stitches per-block partial
+    results into exact global attention (ops.ring_flash).  Gradients flow
+    through BOTH outputs.
+    """
+    if causal and q.shape[1] != k.shape[1]:
+        # local 0-based q_pos >= k_pos masking is meaningless when the q
+        # block sits elsewhere in the key sequence — fail loudly instead of
+        # returning plausible-looking garbage
+        raise ValueError(
+            f"causal=True needs Tq == Tk (got {q.shape[1]} vs {k.shape[1]})"
+        )
+    return _flash_block(q, k, v, causal, _resolve_interpret(interpret))

@@ -1,0 +1,100 @@
+"""Ring flash attention: Pallas flash kernels inside the SP ppermute ring.
+
+``ops.attention.ring_causal_attention`` materialises a dense
+(B, H, Tl, Tl) float32 logits block per ring step — exact, but O(Tl²) memory
+and unfused XLA softmax math.  This variant runs each ring step through the
+Pallas flash kernels (ops.flash_attention), so per-step attention memory is
+O(Tl·d) VMEM-tiled state and the block matmuls hit the MXU at kernel
+granularity.  Construction:
+
+1. Each device holds local q/k/v blocks of a globally length-T sequence
+   (same contract as ring_causal_attention: called inside ``shard_map`` with
+   the sequence axis sharded over ``axis_name``).
+2. The resident (diagonal) block runs the CAUSAL flash kernel.
+3. Each of the S-1 ring steps rotates KV one hop (``ppermute``) and — only
+   when the arriving block is from an earlier shard, i.e. fully visible under
+   causality — runs the FULL (unmasked) flash kernel.  Invisible blocks skip
+   the kernel entirely via ``lax.cond`` (the dense ring spends real FLOPs
+   producing -inf logits for them: ~2x compute saved at the ring level).
+4. Per-step partial results (o_blk, lse_blk) merge into the running result
+   by the standard online log-sum-exp rule; gradients flow through o AND lse
+   (the kernels' VJP handles the dlse term), so ``jax.grad`` of the whole
+   ring — scan, ppermute, cond, kernels — just works, with the reverse ring
+   emerging from the ppermute transpose.
+
+Blockwise-parallel decomposition per Liu et al. 2023 (Ring Attention,
+public); the reference has no long-context mechanism at all (SURVEY.md §5,
+seq fixed at 256).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import flash_block_attention
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Online log-sum-exp merge of two normalised partial attentions.
+
+    Safe when lse2 == -inf everywhere (a skipped block: w2 == 0 exactly);
+    lse1 is always finite because the diagonal block seeds the accumulator
+    and every causal row attends at least to itself."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = w1 + w2
+    # weights ride (B, H, T); o rides (B, T, H, d)
+    a1 = (w1 / denom).transpose(0, 2, 1)[..., None]
+    a2 = (w2 / denom).transpose(0, 2, 1)[..., None]
+    return o1 * a1 + o2.astype(o1.dtype) * a2, m + jnp.log(denom)
+
+
+def ring_flash_causal_attention(q, k, v, axis_name: str, *,
+                                interpret: bool | None = None):
+    """Drop-in for ``ring_causal_attention`` backed by the flash kernels.
+
+    q, k, v: LOCAL (B, Tl, H, head_dim) blocks inside ``shard_map`` with the
+    sequence dimension sharded over ``axis_name``; returns the local output
+    block, exact (up to fp error) vs. single-device causal attention on the
+    gathered sequence.  Tl must divide by the kernel block size picker's
+    choice — any Tl that is a multiple of 512 (or a power of two >= 128)
+    is safe.
+    """
+    S = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    # resident (diagonal) block first — no collective result discarded
+    o_blk, lse_blk = flash_block_attention(q, k, v, causal=True,
+                                           interpret=interpret)
+    acc = (o_blk.astype(jnp.float32), lse_blk)
+
+    def body(carry, step):
+        (o, lse), k_blk, v_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = (idx - step) % S
+
+        def visible(q, kb, vb):
+            return flash_block_attention(q, kb, vb, causal=False,
+                                         interpret=interpret)
+
+        def masked(q, kb, vb):
+            B, Tl, H, _ = q.shape
+            return (
+                jnp.zeros(q.shape, q.dtype),
+                jnp.full((B, H, Tl), -jnp.inf, jnp.float32),
+            )
+
+        # blocks from later shards are fully invisible under causality:
+        # skip their kernels outright (each device branches on its own src)
+        o_blk, lse_blk = jax.lax.cond(src < idx, visible, masked, q, k_blk,
+                                      v_blk)
+        o, lse = _merge(o, lse, o_blk, lse_blk)
+        return ((o, lse), k_blk, v_blk), None
+
+    (acc, _, _), _ = jax.lax.scan(body, (acc, k, v), jnp.arange(1, S))
+    o, _ = acc
+    return o.astype(v.dtype)

@@ -35,7 +35,14 @@ def make_sp_forward(config: LlamaConfig, mesh, seq_axis: str = "seq",
 
     ``tokens`` is global (B, T); T must divide by the seq-axis size.
     """
-    sp_config = dataclasses.replace(config, attn_impl="ring", seq_axis=seq_axis)
+    # "flash" (or explicit "ring-flash") upgrades the ring's per-step block
+    # attention from dense XLA einsums to the Pallas kernels
+    # (ops/ring_flash.py); "dense"/"ring" keep the einsum ring.
+    ring_impl = (
+        "ring-flash" if config.attn_impl in ("flash", "ring-flash") else "ring"
+    )
+    sp_config = dataclasses.replace(config, attn_impl=ring_impl,
+                                    seq_axis=seq_axis)
     model = Llama(sp_config)
     batch = data_axis  # None -> replicated batch
 

@@ -1,0 +1,147 @@
+"""Ring flash attention (ops/ring_flash.py) oracles.
+
+Same seeded-equivalence strategy as test_sp.py: the Pallas-kernel ring must
+match single-device dense attention on the gathered sequence — forward,
+gradients, and a full SP training step.  The full-block op's lse gradient
+path (the dlse term in the kernels' VJP) gets its own direct oracle, since
+the ring merge is the first consumer of lse as a differentiable output.
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ddl25spring_tpu.models import Llama, LlamaConfig
+from ddl25spring_tpu.ops import causal_lm_loss
+from ddl25spring_tpu.ops.attention import causal_attention
+from ddl25spring_tpu.ops.flash_attention import flash_block_attention
+from ddl25spring_tpu.ops.ring_flash import ring_flash_causal_attention
+from ddl25spring_tpu.parallel import (
+    make_mesh,
+    make_sp_train_step,
+    sp_data_sharding,
+)
+
+
+def _dense_full_with_lse(q, k, v):
+    """Unmasked attention + log-sum-exp, the XLA reference for the block op."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.float32(d)
+    )
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v), lse
+
+
+def test_flash_block_full_matches_dense():
+    B, Tq, Tk, H, D = 2, 16, 32, 2, 8
+    ks = jax.random.split(jax.random.key(0), 5)
+    q = jax.random.normal(ks[0], (B, Tq, H, D))
+    k = jax.random.normal(ks[1], (B, Tk, H, D))
+    v = jax.random.normal(ks[2], (B, Tk, H, D))
+    # random cotangent weights for BOTH outputs: wo exercises do, wl
+    # exercises the dlse correction in the backward delta
+    wo = jax.random.normal(ks[3], (B, Tq, H, D))
+    wl = jax.random.normal(ks[4], (B, H, Tq))
+
+    def loss_flash(q, k, v):
+        o, lse = flash_block_attention(q, k, v, causal=False)
+        return jnp.sum(o * wo) + jnp.sum(lse * wl)
+
+    def loss_dense(q, k, v):
+        o, lse = _dense_full_with_lse(q, k, v)
+        return jnp.sum(o * wo) + jnp.sum(lse * wl)
+
+    o_f, lse_f = flash_block_attention(q, k, v, causal=False)
+    o_d, lse_d = _dense_full_with_lse(q, k, v)
+    np.testing.assert_allclose(o_f, o_d, atol=1e-5)
+    np.testing.assert_allclose(lse_f, lse_d, atol=1e-5)
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_d):
+        np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+def test_ring_flash_matches_dense():
+    mesh = make_mesh({"seq": 8})
+    B, T, H, D = 2, 64, 2, 8
+    ks = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, H, D))
+    v = jax.random.normal(ks[2], (B, T, H, D))
+
+    ring = partial(
+        shard_map, mesh=mesh, in_specs=P(None, "seq"),
+        out_specs=P(None, "seq"), check_vma=False,
+    )(lambda q, k, v: ring_flash_causal_attention(q, k, v, "seq"))
+    np.testing.assert_allclose(
+        ring(q, k, v), causal_attention(q, k, v), atol=1e-5
+    )
+
+
+def test_ring_flash_grads_match_dense():
+    mesh = make_mesh({"seq": 4})
+    B, T, H, D = 1, 32, 2, 8
+    ks = jax.random.split(jax.random.key(2), 4)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, H, D))
+    v = jax.random.normal(ks[2], (B, T, H, D))
+    w = jax.random.normal(ks[3], (B, T, H, D))
+
+    ring = partial(
+        shard_map, mesh=mesh, in_specs=P(None, "seq"),
+        out_specs=P(None, "seq"), check_vma=False,
+    )(lambda q, k, v: ring_flash_causal_attention(q, k, v, "seq"))
+    g_ring = jax.grad(lambda q, k, v: jnp.sum(ring(q, k, v) * w),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(
+        lambda q, k, v: jnp.sum(causal_attention(q, k, v) * w),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+def test_sp_train_step_ring_flash_matches_single_device():
+    """One SP training step with attn_impl='flash' (-> Pallas ring) equals
+    the single-device dense step: params, loss, bit-for-bit semantics up to
+    fp tolerance.  Mirrors test_sp.py's dense-ring oracle."""
+    cfg = LlamaConfig(vocab_size=64, dmodel=32, nr_heads=2, nr_layers=2,
+                      ctx_size=32, attn_impl="flash")
+    tokens = jax.random.randint(jax.random.key(3), (2, cfg.ctx_size), 0,
+                                cfg.vocab_size)
+    single_cfg = dataclasses.replace(cfg, attn_impl="dense")
+    model = Llama(single_cfg)
+    params = model.init(
+        jax.random.key(4), tokens, positions=jnp.arange(cfg.ctx_size)
+    )
+    optimizer = optax.sgd(0.1)
+
+    def single_step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply(p, tokens,
+                                 positions=jnp.arange(cfg.ctx_size))
+            return causal_lm_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    mesh = make_mesh({"seq": 4})
+    sp_step = make_sp_train_step(cfg, mesh, optimizer)
+    sp_tokens = jax.device_put(tokens, sp_data_sharding(mesh))
+
+    p1, _, loss1 = single_step(params, optimizer.init(params), tokens)
+    p2, _, loss2 = sp_step(params, optimizer.init(params), sp_tokens)
+    np.testing.assert_allclose(loss1, loss2, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=2e-4)
