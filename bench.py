@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import threading
 import time
 
 # Measured on this container 2026-07-29 with --measure-cpu-baseline
@@ -188,8 +189,6 @@ def _probe_device(timeout_s: float = 120.0) -> bool:
     every op BLOCKS forever with no error (observed 2026-07-30), which would
     hang the whole benchmark run.  The probe runs in a daemon thread so a
     wedged backend can't take the process with it."""
-    import threading
-
     ok = threading.Event()
 
     def attempt():
@@ -227,7 +226,9 @@ def _probe_device_with_retry(attempts: int = 6, timeout_s: float = 90.0,
 
 
 METRIC = "fedavg_cifar10_resnet18_256clients_rounds_per_sec"
-_EMIT_LOCK = None  # created lazily (threading import stays local)
+# module-scope so the first two emitters can't each lazily create their own
+# lock and both slip past the guard (the exact race the guard exists for)
+_EMIT_LOCK = threading.Lock()
 
 
 def _emit_json(value: float, *, error: str | None = None, **extra) -> bool:
@@ -235,11 +236,6 @@ def _emit_json(value: float, *, error: str | None = None, **extra) -> bool:
     Shared by the success, probe-failure and watchdog paths so the schema
     can't drift between them — and guarded so a watchdog firing in the same
     instant the main thread finishes can't print a second line."""
-    import threading
-
-    global _EMIT_LOCK
-    if _EMIT_LOCK is None:
-        _EMIT_LOCK = threading.Lock()
     if not _EMIT_LOCK.acquire(blocking=False):
         return False  # another path already emitted (or is emitting)
     line = {
@@ -273,8 +269,6 @@ class _Watchdog:
     stamps, _stamp milestones) is never mistaken for a wedge."""
 
     def __init__(self, idle_s: float):
-        import threading
-
         self.idle_s = idle_s
         self._last = time.monotonic()
         self._done = False

@@ -71,9 +71,9 @@ def chunked_device_put(
     rows = max(1, chunk_bytes // row_bytes)
     if shards0 > 1:
         # keep every slab's leading dim divisible over the axis-0 shards
+        # (the tail slab inherits divisibility: shape[0] and rows are both
+        # multiples of shards0, so shape[0] % rows is too)
         rows = max(shards0, rows - rows % shards0)
-        if arr.shape[0] % rows and (arr.shape[0] % rows) % shards0:
-            return jax.device_put(arr, sharding)  # ragged tail: one put
     slabs = []
     total_mb = arr.nbytes / 2**20
     done = 0.0

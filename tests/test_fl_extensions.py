@@ -42,6 +42,7 @@ def test_fedprox_mu_zero_is_exactly_fedavg(small_fl):
     assert r_avg.test_accuracy == r_prox0.test_accuracy
 
 
+@pytest.mark.slow  # test_fedprox_mu_zero_is_exactly_fedavg pins the math by default
 def test_fedprox_converges_and_damps_drift(small_fl):
     cd, task = small_fl
     kw = dict(task=task, lr=0.05, batch_size=50, client_data=cd,
@@ -89,6 +90,7 @@ def test_fedopt_rejects_unknown_optimizer(small_fl):
                      server_optimizer="lamb")
 
 
+@pytest.mark.slow  # dropout renormalisation is pinned by the fast survivor-weights unit oracle
 def test_client_dropout_still_learns_and_changes_rounds(small_fl):
     cd, task = small_fl
     kw = dict(task=task, lr=0.05, batch_size=50, client_data=cd,
@@ -111,6 +113,7 @@ def test_dropout_with_robust_aggregator_raises(small_fl):
                      aggregator=coordinate_median, dropout_rate=0.3)
 
 
+@pytest.mark.slow  # fedopt-vs-fedavg equality stays fast; checkpoint roundtrip math by test_checkpointer_roundtrip
 def test_fedopt_extra_state_roundtrip(small_fl):
     """A resumed FedOpt run must continue with the saved server-optimizer
     moments, not restart them from zero (what {params, round}-only
@@ -293,6 +296,7 @@ def test_fedbuff_window1_equals_fedavg_round():
                                 atol=1e-5)
 
 
+@pytest.mark.slow  # test_fedbuff_window1_equals_fedavg_round pins the tick math by default
 def test_fedbuff_stale_training_converges():
     """With a real staleness window the async server still learns, and
     staler deltas get down-weighted rather than discarded."""

@@ -84,6 +84,7 @@ def test_flip_labels_only_on_malicious():
     assert np.all(poisoned.y[1:] == clients.y[1:])
 
 
+@pytest.mark.slow  # aggregator unit oracles stay fast; the dryrun executes a krum round on the mesh every driver round
 def test_end_to_end_krum_resists_gaussian_attack():
     ds = load_mnist(n_train=1024, n_test=256)
     task = mnist_task(ds.test_x, ds.test_y)
@@ -127,6 +128,7 @@ def test_consensus_downweights_sign_flippers():
     assert cos > 0.95
 
 
+@pytest.mark.slow  # aggregator unit oracles stay fast; krum end-to-end covers the attack-resistance integration
 def test_end_to_end_consensus_resists_sign_flip():
     from ddl25spring_tpu.robust import make_consensus
 

@@ -110,6 +110,7 @@ def test_metrics_logger_roundtrip(tmp_path):
     assert recs[1]["event"] == "block" and "seconds" in recs[1]
 
 
+@pytest.mark.slow  # CLI arg plumbing is covered by the fast servers/engine oracles; resume math by test_checkpointer_roundtrip
 def test_hfl_cli_runs_and_checkpoints(tmp_path):
     from ddl25spring_tpu.run_hfl import main
 

@@ -81,6 +81,7 @@ def test_vfl_network_trains(heart, heart_df, nr_clients):
     assert acc > 0.6  # well above chance on either real or synthetic heart
 
 
+@pytest.mark.slow  # test_splitvae_matches_monolithic_vae pins the construction exactly
 def test_vfl_vae_loss_decreases(heart):
     # standardize all columns incl. target, the reference's ex3 preprocessing
     x = heart.x.astype(np.float32)
@@ -96,6 +97,7 @@ def test_vfl_vae_loss_decreases(heart):
     assert recons[0].shape == x_clients[0].shape
 
 
+@pytest.mark.slow  # vae training + evaluator best-restore have their own fast oracles
 def test_vae_tstr_pipeline(heart):
     # join features+label as the VAE training table (reference :156-159)
     n = heart.x.shape[0]
@@ -137,6 +139,7 @@ def test_evaluator_learns(heart):
     assert history[-1][0] > history[0][0]  # train acc improves
 
 
+@pytest.mark.slow  # vfl network/vae convergence oracles cover the training paths; CLI plumbing is shared with the fast runs
 def test_run_vfl_cli_both_modes(tmp_path):
     """The VFL CLI trains both the split-NN and the split VFL-VAE, logs
     JSONL, and writes the loss figure."""
