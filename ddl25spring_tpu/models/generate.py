@@ -34,6 +34,8 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     key: jax.Array | None = None,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
@@ -41,7 +43,11 @@ def generate(
     ``prompt`` is (B, T0) int32 with T0 >= 1; returns (B, T0 +
     max_new_tokens).  ``temperature == 0`` decodes greedily (deterministic);
     otherwise logits are divided by the temperature and sampled
-    categorically with per-step keys folded from ``key``.
+    categorically with per-step keys folded from ``key``, optionally
+    truncated to the ``top_k`` highest-probability tokens (0 = off) and/or
+    the smallest nucleus whose cumulative probability reaches ``top_p``
+    (1.0 = off) — both standard decode-time filters, applied k-then-p when
+    combined.
 
     The model's ``ctx_size`` bounds the total length; the rotary embedding is
     position-exact because every step passes its global position explicitly.
@@ -59,20 +65,58 @@ def generate(
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"need top_k >= 0 and 0 < top_p <= 1 (got {top_k}, {top_p})"
+        )
     if key is None:
         key = jax.random.key(0)  # unused on the greedy path
 
-    decode = _decode_fn(config, T0, total, float(temperature))
+    if temperature == 0:
+        # the filters are dead under greedy decode; normalise them out of
+        # the cache key so greedy calls with different top_k/top_p settings
+        # share one compiled program instead of fragmenting the LRU
+        top_k, top_p = 0, 1.0
+    decode = _decode_fn(config, T0, total, float(temperature), int(top_k),
+                        float(top_p))
     return decode(params, prompt, key)
 
 
+def _filter_logits(logits, top_k: int, top_p: float):
+    """Set logits outside the top-k / nucleus-p candidate set to -inf.
+
+    Static shapes throughout (sort + cumsum + where), so the filter scans
+    cleanly inside the decode loop; vocab-sized sorts per step are noise next
+    to the model matmuls.
+    """
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens strictly inside the nucleus plus the first that
+        # crosses top_p (shift right so the crossing token survives)
+        keep_sorted = jnp.roll(cum < top_p, 1, axis=-1).at[..., 0].set(True)
+        # threshold = smallest kept logit; everything below it is cut
+        thresh = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True,
+        )
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return logits
+
+
 @functools.lru_cache(maxsize=16)
-def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float):
-    """Compiled prefill+scan decoder, cached on (config, shape, temperature)
-    so repeated ``generate`` calls with the same geometry reuse the jitted
-    program instead of rebuilding a fresh closure (and recompiling) per call.
-    Bounded (LRU, 16 geometries) so long-lived processes that decode many
-    distinct prompt lengths don't retain every compiled program forever.
+def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
+               top_k: int, top_p: float):
+    """Compiled prefill+scan decoder, cached on (config, shape, sampling
+    params) so repeated ``generate`` calls with the same geometry reuse the
+    jitted program instead of rebuilding a fresh closure (and recompiling)
+    per call.  Bounded (LRU, 16 geometries) so long-lived processes that
+    decode many distinct prompt lengths don't retain every compiled program
+    forever.
     """
     model = Llama(dataclasses.replace(
         config, decode=True, attn_impl="dense", remat=False
@@ -89,8 +133,12 @@ def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float):
         def pick(logits_last, step_key):
             if temperature == 0.0:
                 return jnp.argmax(logits_last, axis=-1).astype(prompt.dtype)
+            # temperature first, THEN the filters: top-k is monotone so the
+            # order only matters for top-p, whose nucleus is conventionally
+            # computed on the tempered distribution
+            filtered = _filter_logits(logits_last / temperature, top_k, top_p)
             return jax.random.categorical(
-                step_key, logits_last / temperature, axis=-1
+                step_key, filtered, axis=-1
             ).astype(prompt.dtype)
 
         first = pick(logits[:, -1], jax.random.fold_in(key, 0))

@@ -452,6 +452,7 @@ def _sample_text(cfg: LmConfig, params, tok):
         mcfg, params, prompt,
         min(cfg.generate_tokens, mcfg.ctx_size - 1),
         temperature=cfg.generate_temperature,
+        top_k=cfg.generate_top_k, top_p=cfg.generate_top_p,
         key=jax.random.key(cfg.seed),
     )
     print("[generate]", repr(tok.decode([int(t) for t in out[0, 1:]])))

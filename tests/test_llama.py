@@ -179,3 +179,51 @@ def test_generate_matches_full_forward():
 
     # max_new_tokens=0 is the identity
     assert jnp.array_equal(generate(CFG, params, prompt, 0), prompt)
+
+
+def test_filter_logits_topk_topp():
+    """Decode-time logit filters (models/generate.py): top-k keeps exactly
+    the k best, top-p keeps the smallest nucleus crossing p (the crossing
+    token survives), and both leave kept logits' values untouched."""
+    import numpy as np
+
+    from ddl25spring_tpu.models.generate import _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.1]]))
+
+    k2 = _filter_logits(logits, top_k=2, top_p=1.0)
+    np.testing.assert_allclose(k2[0, :2], logits[0, :2])
+    assert jnp.all(jnp.isneginf(k2[0, 2:]))
+
+    # nucleus at p=0.7: 0.5 < 0.7, 0.5+0.25 crosses -> keep exactly 2
+    p7 = _filter_logits(logits, top_k=0, top_p=0.7)
+    np.testing.assert_allclose(p7[0, :2], logits[0, :2])
+    assert jnp.all(jnp.isneginf(p7[0, 2:]))
+
+    # combined: k then p; k=3 then p=0.5 -> nucleus of the renormalised
+    # top-3 {0.555, 0.277, 0.166}: first crosses 0.5 -> keep 1
+    kp = _filter_logits(logits, top_k=3, top_p=0.5)
+    np.testing.assert_allclose(kp[0, :1], logits[0, :1])
+    assert jnp.all(jnp.isneginf(kp[0, 1:]))
+
+    # no-op settings change nothing
+    np.testing.assert_allclose(
+        _filter_logits(logits, top_k=0, top_p=1.0), logits
+    )
+
+
+def test_generate_topk1_equals_greedy():
+    """Sampling with top_k=1 collapses to greedy regardless of temperature."""
+    import numpy as np
+
+    from ddl25spring_tpu.models import generate
+
+    cfg = LlamaConfig(vocab_size=32, dmodel=16, nr_heads=2, nr_layers=1,
+                      ctx_size=16)
+    tokens = jnp.zeros((1, 1), jnp.int32)
+    params = Llama(cfg).init(jax.random.key(0), tokens,
+                             positions=jnp.arange(1))
+    greedy = generate(cfg, params, tokens, 8)
+    k1 = generate(cfg, params, tokens, 8, temperature=1.7, top_k=1,
+                  key=jax.random.key(5))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
