@@ -53,6 +53,7 @@ class HflConfig:
     server_lr: float = 0.02    # fedopt server-side learning rate
     dp_clip: float = 0.0       # fedavg/fedprox: client-delta L2 clip (DP-FedAvg)
     dp_noise_mult: float = 0.0  # fedavg/fedprox: Gaussian noise multiplier
+    dp_delta: float = 1e-5     # δ for the reported (ε, δ) budget (fl/privacy.py)
     staleness_window: int = 4  # fedbuff: versions a client can lag behind
     staleness_exp: float = 0.5  # fedbuff: delta weight (1+staleness)^-exp
     server_eta: float = 1.0    # fedbuff: server application rate

@@ -1,3 +1,8 @@
+from .privacy import (
+    dp_epsilon,
+    rdp_gaussian,
+    rdp_subsampled_gaussian,
+)
 from .engine import (
     make_local_sgd_update,
     make_full_batch_grad,
@@ -21,6 +26,9 @@ __all__ = [
     "make_local_sgd_update",
     "make_full_batch_grad",
     "make_fl_round",
+    "dp_epsilon",
+    "rdp_gaussian",
+    "rdp_subsampled_gaussian",
     "make_evaluator",
     "sample_clients",
     "Task",

@@ -429,3 +429,41 @@ def test_fedbuff_checkpoint_resume(tmp_path):
     resumed = main(args + ["--nr-rounds", "3"])  # runs only round 3
     assert len(resumed.test_accuracy) == 1
     assert abs(resumed.test_accuracy[-1] - full.test_accuracy[-1]) < 1e-4
+
+
+def test_rdp_accountant_properties():
+    """fl/privacy.py accountant sanity: closed-form q=1 case, subsampling
+    amplification, and monotonicity in every knob."""
+    import math
+
+    from ddl25spring_tpu.fl.privacy import (
+        dp_epsilon,
+        rdp_gaussian,
+        rdp_subsampled_gaussian,
+    )
+
+    # q=1 collapses to the plain Gaussian mechanism: eps equals the direct
+    # minimisation of T*a/(2s^2) + log(1/d)/(a-1) over the same orders
+    s, T, d = 2.0, 50, 1e-5
+    direct = min(
+        T * a / (2 * s * s) + math.log(1 / d) / (a - 1)
+        for a in list(range(2, 64)) + [80, 128, 256, 512]
+    )
+    assert abs(dp_epsilon(s, 1.0, T, d) - direct) < 1e-12
+
+    # subsampling amplifies: q=0.1 must be strictly cheaper than q=1
+    assert dp_epsilon(s, 0.1, T, d) < dp_epsilon(s, 1.0, T, d)
+
+    # monotone: more noise -> less eps; more rounds / larger q -> more eps
+    assert dp_epsilon(4.0, 0.1, T, d) < dp_epsilon(1.0, 0.1, T, d)
+    assert dp_epsilon(s, 0.1, 2 * T, d) > dp_epsilon(s, 0.1, T, d)
+    assert dp_epsilon(s, 0.2, T, d) > dp_epsilon(s, 0.1, T, d)
+    assert dp_epsilon(s, 0.1, 0, d) == 0.0
+
+    # per-order bound: subsampled RDP never exceeds the unsampled mechanism
+    for a in (2, 8, 32):
+        assert rdp_subsampled_gaussian(a, s, 0.05) <= rdp_gaussian(a, s) + 1e-12
+
+    # the reported budget is finite and positive for the bench-like config
+    eps = dp_epsilon(1.1, 0.1, 100, 1e-5)
+    assert 0 < eps < 50
