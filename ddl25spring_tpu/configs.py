@@ -70,6 +70,12 @@ class HflConfig:
 
     def __post_init__(self):
         _check_checkpoint_pair(self.checkpoint_dir, self.checkpoint_every)
+        # fail BEFORE training, not in the post-run ε report: a bad δ would
+        # otherwise kill an hours-long run at its final print
+        if not 0.0 < self.dp_delta < 1.0:
+            raise ValueError(
+                f"dp_delta must be in (0, 1), got {self.dp_delta}"
+            )
 
 
 @dataclass(frozen=True)
@@ -100,6 +106,7 @@ class LmConfig:
     seq_l: int = 256           # primer/intro.py:10
     dmodel: int = 288          # primer/intro.py:8
     nr_heads: int = 6
+    nr_kv_heads: int = 0       # 0 = MHA; fewer = GQA, 1 = MQA (models/llama.py)
     nr_layers: int = 6
     lr: float = 8e-4           # primer/intro.py: Adam lr
     lr_schedule: str = "const"  # const | cosine | warmup-cosine

@@ -51,6 +51,10 @@ def rdp_gaussian(alpha: float, noise_mult: float) -> float:
 
 def rdp_subsampled_gaussian(alpha: int, noise_mult: float, q: float) -> float:
     """Per-round RDP at integer order ``alpha`` with sampling rate ``q``."""
+    if noise_mult <= 0:
+        # same clean error on every q (the series below would otherwise
+        # raise a bare ZeroDivisionError for q < 1)
+        raise ValueError("noise_mult must be > 0 for a finite RDP bound")
     if not 0.0 < q <= 1.0:
         raise ValueError(f"sampling rate q must be in (0, 1], got {q}")
     if alpha < 2 or int(alpha) != alpha:

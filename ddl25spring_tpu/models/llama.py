@@ -42,6 +42,9 @@ class LlamaConfig:
     attn_impl: str = "dense"   # dense (XLA) | flash (Pallas) | ring |
     #                            ring-flash (Pallas kernels inside the ring)
     seq_axis: str = "seq"      # mesh axis for the ring attn_impls
+    nr_kv_heads: int = 0       # 0 = nr_heads (MHA); fewer = GQA, 1 = MQA —
+    #                            smaller wk/wv/KV-cache, repeated to
+    #                            nr_heads for the attention math
     nr_experts: int = 0        # 0 = dense SwiGLU MLP; >0 = top-k MoE
     expert_topk: int = 2
     remat: bool = False        # rematerialize blocks in backward (HBM ↓, FLOPs ↑)
@@ -54,11 +57,21 @@ class LlamaConfig:
                 "'flash', 'ring-flash') — a typo here would otherwise "
                 "silently fall through to dense attention"
             )
+        if self.nr_kv_heads and self.nr_heads % self.nr_kv_heads:
+            raise ValueError(
+                f"nr_kv_heads={self.nr_kv_heads} must divide "
+                f"nr_heads={self.nr_heads} (each KV head serves a "
+                "fixed-size group of query heads)"
+            )
 
     @property
     def head_dim(self) -> int:
         assert self.dmodel % self.nr_heads == 0
         return self.dmodel // self.nr_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.nr_kv_heads or self.nr_heads
 
     @property
     def hidden_dim(self) -> int:
@@ -103,18 +116,31 @@ class Attention(nn.Module):
     def __call__(self, x, positions):
         cfg = self.config
         B, T, _ = x.shape
-        dense = lambda name: nn.Dense(
-            cfg.dmodel, use_bias=False, dtype=cfg.dtype, name=name
+        dense = lambda name, features: nn.Dense(
+            features, use_bias=False, dtype=cfg.dtype, name=name
         )
-        q = dense("wq")(x).reshape(B, T, cfg.nr_heads, cfg.head_dim)
-        k = dense("wk")(x).reshape(B, T, cfg.nr_heads, cfg.head_dim)
-        v = dense("wv")(x).reshape(B, T, cfg.nr_heads, cfg.head_dim)
+        kv_dim = cfg.kv_heads * cfg.head_dim  # == dmodel for MHA; less (GQA)
+        q = dense("wq", cfg.dmodel)(x).reshape(B, T, cfg.nr_heads,
+                                               cfg.head_dim)
+        k = dense("wk", kv_dim)(x).reshape(B, T, cfg.kv_heads, cfg.head_dim)
+        v = dense("wv", kv_dim)(x).reshape(B, T, cfg.kv_heads, cfg.head_dim)
         cos, sin = rope_angles(cfg.head_dim, positions)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if cfg.decode:
             out = self._decode_attention(q, k, v, positions)
-        elif cfg.attn_impl == "ring":
+            out = out.reshape(B, T, cfg.dmodel)
+            return dense("wo", cfg.dmodel)(out)
+        # training paths: expand KV heads to the query heads so every
+        # attn_impl (dense einsum, flash kernels, both rings) sees plain MHA
+        # shapes.  GQA's wins live in the wk/wv params and the decode cache
+        # (kv_heads-sized); training activations pay the repeat, which XLA
+        # fuses into the consumer
+        if cfg.kv_heads != cfg.nr_heads:
+            group = cfg.nr_heads // cfg.kv_heads
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
+        if cfg.attn_impl == "ring":
             out = ring_causal_attention(q, k, v, cfg.seq_axis)
         elif cfg.attn_impl == "ring-flash":
             from ..ops.ring_flash import ring_flash_causal_attention
@@ -127,39 +153,46 @@ class Attention(nn.Module):
         else:
             out = causal_attention(q, k, v)
         out = out.reshape(B, T, cfg.dmodel)
-        return dense("wo")(out)
+        return dense("wo", cfg.dmodel)(out)
 
     def _decode_attention(self, q, k, v, positions):
         """Attention against a fixed-size KV cache (``cache`` collection).
 
-        The cache keeps static shape (B, ctx_size, H, hd) — TPU-friendly: no
-        growing tensors, one ``dynamic_update_slice`` per step — and the
+        The cache keeps static shape (B, ctx_size, Hkv, hd) — TPU-friendly:
+        no growing tensors, one ``dynamic_update_slice`` per step — and the
         write offset is the first query position, so the same code serves the
         prompt prefill (T = prompt length, offset 0) and each single-token
-        decode step (T = 1, offset = tokens seen so far)."""
+        decode step (T = 1, offset = tokens seen so far).  Under GQA the
+        cache holds only the kv_heads (the capability's whole point:
+        nr_heads/kv_heads times less cache HBM and read bandwidth per decode
+        step); queries ride a grouped einsum against it, no repeat."""
         cfg = self.config
         B, T = q.shape[:2]
         S = cfg.ctx_size
-        zeros = lambda: jnp.zeros((B, S, cfg.nr_heads, cfg.head_dim), q.dtype)
+        Hkv = cfg.kv_heads
+        zeros = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), q.dtype)
         ck = self.variable("cache", "k", zeros)
         cv = self.variable("cache", "v", zeros)
         offset = positions[0]
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+        # (B, T, Hkv, group, hd): query heads grouped by the KV head they share
+        qg = q.reshape(B, T, Hkv, cfg.nr_heads // Hkv, cfg.head_dim)
         # scores in float32 BEFORE scaling, matching ops.attention's dense
         # path exactly — in bf16 compute, near-tied logits would otherwise
         # round differently here than in the full-forward oracle and greedy
         # decode would diverge from it
         scale = 1.0 / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
-        scores = jnp.einsum("bthd,bshd->bhts", q, ck.value).astype(
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck.value).astype(
             jnp.float32
         ) * scale
         # key j visible to query at global position p iff j <= p; unwritten
         # cache rows are masked out by the same comparison
         visible = jnp.arange(S)[None, :] <= positions[:, None]  # (T, S)
-        scores = jnp.where(visible[None, None], scores, -jnp.inf)
+        scores = jnp.where(visible[None, None, None], scores, -jnp.inf)
         att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhts,bshd->bthd", att, cv.value)
+        out = jnp.einsum("bkgts,bskd->btkgd", att, cv.value)
+        return out.reshape(B, T, cfg.nr_heads, cfg.head_dim)
 
 
 class SwiGLU(nn.Module):

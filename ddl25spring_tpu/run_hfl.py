@@ -216,12 +216,11 @@ def run(cfg: HflConfig):
     if cfg.dp_noise_mult:
         from .fl.privacy import dp_epsilon
 
-        # the EFFECTIVE sampling rate, not the nominal fraction: servers
-        # sample max(1, round(C*N)) clients (servers.py nr_clients_per_round,
-        # reference hfl_complete.py:228), and the rounding can raise q —
-        # e.g. N=10, C=0.05 actually samples 1 client (q=0.1, 2x the
-        # nominal), which would understate the printed ε
-        q = max(1, round(cfg.client_fraction * cfg.nr_clients)) / cfg.nr_clients
+        # the EFFECTIVE sampling rate, not the nominal fraction: rounding
+        # can raise q (N=10, C=0.05 samples 1 client — q=0.1, 2x nominal),
+        # which would understate the printed ε.  Read the LIVE value off the
+        # server so the report can never drift from what the mechanism did.
+        q = server.nr_clients_per_round / cfg.nr_clients
         eps = dp_epsilon(cfg.dp_noise_mult, q, cfg.nr_rounds, cfg.dp_delta)
         print(f"[dp] client-level privacy spent: ε = {eps:.3f} at "
               f"δ = {cfg.dp_delta:g} (σ = {cfg.dp_noise_mult}, "

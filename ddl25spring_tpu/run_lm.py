@@ -82,6 +82,7 @@ def _model_config(cfg: LmConfig, vocab_size: int = BASE_VOCAB) -> LlamaConfig:
     return LlamaConfig(
         vocab_size=vocab_size,  # BASE_VOCAB = byte ids (3 specials + 256)
         dmodel=cfg.dmodel, nr_heads=cfg.nr_heads, nr_layers=cfg.nr_layers,
+        nr_kv_heads=cfg.nr_kv_heads,
         ctx_size=cfg.seq_l, remat=cfg.remat, attn_impl=cfg.attn_impl,
         dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
     )
@@ -303,6 +304,10 @@ def build_trainer(cfg: LmConfig, vocab_size: int = BASE_VOCAB):
 
     if cfg.strategy == "tp":
         tp = 2 if n % 2 == 0 else 1
+        # GQA/MQA compose freely with tp: llama_tp_shardings replicates any
+        # kernel whose dim doesn't divide the model axis (e.g. MQA's wk/wv),
+        # and sharding annotations never change program semantics — GSPMD
+        # inserts whatever collectives correctness needs
         data = _largest_divisor(cfg.batch_size, n // tp)
         mesh = make_mesh({"data": data, "model": tp},
                          devices=devices[: data * tp])

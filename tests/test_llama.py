@@ -227,3 +227,79 @@ def test_generate_topk1_equals_greedy():
     k1 = generate(cfg, params, tokens, 8, temperature=1.7, top_k=1,
                   key=jax.random.key(5))
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_gqa_param_shapes_and_training():
+    """GQA (models/llama.py nr_kv_heads): wk/wv shrink to kv_heads*hd, the
+    model still trains, and kv_heads == nr_heads is exactly MHA."""
+    import numpy as np
+    import optax
+
+    from ddl25spring_tpu.ops import causal_lm_loss
+
+    cfg = LlamaConfig(vocab_size=64, dmodel=48, nr_heads=6, nr_kv_heads=2,
+                      nr_layers=2, ctx_size=32)
+    tokens = jax.random.randint(jax.random.key(0), (4, 32), 0, 64)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(1), tokens, positions=jnp.arange(32))
+    wk = params["params"]["block0"]["attn"]["wk"]["kernel"]
+    wq = params["params"]["block0"]["attn"]["wq"]["kernel"]
+    assert wk.shape == (48, 2 * 8) and wq.shape == (48, 48)
+
+    opt = optax.adam(3e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s, t):
+        loss, g = jax.value_and_grad(
+            lambda p: causal_lm_loss(model.apply(p, t), t)
+        )(p)
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s, loss
+
+    first = last = None
+    for i in range(12):
+        params, state, loss = step(params, state, tokens)
+        first = float(loss) if first is None else first
+        last = float(loss)
+    assert last < first - 0.5, (first, last)
+
+    # explicit kv_heads == nr_heads produces identical params/loss to MHA
+    a = LlamaConfig(vocab_size=64, dmodel=48, nr_heads=6, nr_kv_heads=6,
+                    nr_layers=1, ctx_size=16)
+    b = LlamaConfig(vocab_size=64, dmodel=48, nr_heads=6, nr_layers=1,
+                    ctx_size=16)
+    t2 = jax.random.randint(jax.random.key(2), (2, 16), 0, 64)
+    pa = Llama(a).init(jax.random.key(3), t2, positions=jnp.arange(16))
+    pb = Llama(b).init(jax.random.key(3), t2, positions=jnp.arange(16))
+    np.testing.assert_array_equal(
+        Llama(a).apply(pa, t2), Llama(b).apply(pb, t2)
+    )
+
+    import pytest
+
+    with pytest.raises(ValueError, match="divide"):
+        LlamaConfig(vocab_size=64, dmodel=48, nr_heads=6, nr_kv_heads=4)
+
+
+def test_gqa_generate_matches_full_forward():
+    """The grouped-einsum KV cache decodes exactly like iterated full
+    forwards under GQA (same oracle as the MHA decode test)."""
+    import numpy as np
+
+    from ddl25spring_tpu.models import generate
+
+    cfg = LlamaConfig(vocab_size=32, dmodel=32, nr_heads=4, nr_kv_heads=2,
+                      nr_layers=2, ctx_size=24)
+    tokens = jnp.zeros((2, 3), jnp.int32)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), tokens, positions=jnp.arange(3))
+    out = generate(cfg, params, tokens, 10)
+
+    # oracle: grow the sequence with full forwards, argmax the last logit
+    seq = tokens
+    for _ in range(10):
+        logits = model.apply(params, seq, positions=jnp.arange(seq.shape[1]))
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(seq.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Wait for the remote TPU tunnel, then capture the round's measurement
+# battery exactly once:
+#   1. north-star bench (flax GroupNorm)      -> results/bench_tpu.json
+#   2. north-star bench (lean GroupNorm A/B)  -> results/bench_tpu_lean.json
+#   3. flash-attention microbench (+numerics) -> results/flash_tpu.txt
+# Stops the tpu_watch prober first so nothing else talks to the single-tenant
+# chip mid-measurement.  Logs to /tmp/measure.log.
+cd /root/repo || exit 1
+LOG=/tmp/measure.log
+echo "$(date +%H:%M:%S) sentinel started" >> "$LOG"
+while true; do
+  if timeout 60 python - <<'EOF' >/dev/null 2>&1
+import numpy as np, jax.numpy as jnp
+np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+EOF
+  then
+    echo "$(date +%H:%M:%S) tunnel UP — measuring" >> "$LOG"
+    pkill -f tpu_watch.sh 2>/dev/null
+    sleep 2
+    timeout 1800 python bench.py --deadline-s 900 \
+      > results/bench_tpu.json 2>> "$LOG"
+    echo "$(date +%H:%M:%S) bench flax done (exit $?)" >> "$LOG"
+    timeout 1800 python bench.py --deadline-s 900 --norm-impl lean \
+      > results/bench_tpu_lean.json 2>> "$LOG"
+    echo "$(date +%H:%M:%S) bench lean done (exit $?)" >> "$LOG"
+    timeout 2400 python examples/bench_flash.py --check \
+      > results/flash_tpu.txt 2>> "$LOG"
+    echo "$(date +%H:%M:%S) flash bench done (exit $?)" >> "$LOG"
+    nohup /root/repo/tools/tpu_watch.sh >/dev/null 2>&1 &
+    echo "$(date +%H:%M:%S) sentinel finished" >> "$LOG"
+    exit 0
+  fi
+  sleep 90
+done
