@@ -126,8 +126,46 @@ def _sync(tree):
     device_sync(tree)
 
 
-def timed_rounds(server, nr_rounds: int) -> float:
-    """Rounds/sec over ``nr_rounds`` after a compile warmup round."""
+def timed_rounds(server, nr_rounds: int, fused: bool = True) -> float:
+    """Rounds/sec over ``nr_rounds`` after a compile warmup round.
+
+    ``fused`` runs all timed rounds as ONE jitted ``lax.fori_loop`` dispatch
+    (engine round_fn.raw + .data keep the dataset as arguments, not HLO
+    constants), so per-dispatch RPC latency over the remote tunnel doesn't
+    pollute the measurement; ``fused=False`` keeps the one-dispatch-per-round
+    path for comparison (the gap IS the dispatch overhead)."""
+    import jax
+
+    rf = server.round_fn
+    if fused and hasattr(rf, "raw"):
+        import functools
+
+        @functools.partial(jax.jit, static_argnames=("nr",))
+        def run_n(params, key, nr, x, y, counts, mal):
+            return jax.lax.fori_loop(
+                0, nr,
+                lambda i, p: rf.raw(p, key, 1 + i, x, y, counts, mal),
+                params,
+            )
+
+        # warmup round 0 advances params exactly like the unfused path; the
+        # N-round program itself is AOT-compiled (lower().compile()) so the
+        # warmup never EXECUTES the loop — executing it would double the
+        # bench runtime and pollute --profile traces with a throwaway run
+        _stamp("warmup round 0 ...")
+        params = server.round_fn(server.params, server.run_key, 0)
+        _sync(params)
+        _stamp(f"AOT-compiling the fused {nr_rounds}-round program ...")
+        compiled = run_n.lower(
+            params, server.run_key, nr_rounds, *rf.data
+        ).compile()
+        _stamp("compile done; timing ...")
+        t0 = time.perf_counter()
+        params = compiled(params, server.run_key, *rf.data)
+        _sync(params)
+        server.params = params
+        return nr_rounds / (time.perf_counter() - t0)
+
     _stamp("warmup round (jit compile) ...")
     params = server.round_fn(server.params, server.run_key, 0)  # warmup/compile
     _sync(params)
@@ -308,6 +346,10 @@ def main():
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--norm-impl", default="flax", choices=["flax", "lean"],
                     help="GroupNorm implementation A/B (ops/norm.py)")
+    ap.add_argument("--no-fused", action="store_true",
+                    help="dispatch each timed round separately instead of "
+                         "one fused fori_loop program (the gap measures "
+                         "per-dispatch tunnel latency)")
     ap.add_argument("--measure-cpu-baseline", action="store_true")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the timed rounds "
@@ -345,10 +387,12 @@ def main():
         from ddl25spring_tpu.utils import profile_trace
 
         with profile_trace(args.profile):
-            rps = timed_rounds(server, args.rounds)
+            rps = timed_rounds(server, args.rounds,
+                               fused=not args.no_fused)
         _stamp(f"profiler trace written to {args.profile}")
     else:
-        rps = timed_rounds(server, args.rounds)
+        rps = timed_rounds(server, args.rounds,
+                           fused=not args.no_fused)
     _stamp("timed rounds done; evaluating ...")
     # the north star is rounds/sec AND final accuracy (BASELINE.md): report
     # test accuracy after the timed rounds (real CIFAR when available;

@@ -391,6 +391,16 @@ def make_fl_round(
     def round_fn(params, base_key, round_idx):
         return _round(params, base_key, round_idx, x, y, counts, mal_mask)
 
+    # expose the raw jitted step + its device-resident data so callers can
+    # compose rounds INSIDE one jit (e.g. bench.py fuses N timed rounds into
+    # a single lax.fori_loop dispatch: over a remote tunnel, per-round
+    # dispatch RPC latency would otherwise pollute rounds/sec).  Threading
+    # the data as explicit arguments keeps it out of the fused program's
+    # HLO — calling the closure under an outer jit would embed the stacked
+    # dataset as a compile-time constant (the exact failure the comment
+    # above _round documents).
+    round_fn.raw = _round
+    round_fn.data = (x, y, counts, mal_mask)
     return round_fn
 
 

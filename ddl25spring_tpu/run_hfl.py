@@ -207,11 +207,20 @@ def run(cfg: HflConfig):
             extra = server.extra_state()
             if extra:
                 payload["extra"] = extra
-            ckpt.save(r + 1, payload)
+            # async: the write overlaps the next round; close() drains it
+            ckpt.save(r + 1, payload, wait=False)
 
     nr_remaining = max(0, cfg.nr_rounds - start_round)
-    result = server.run(nr_remaining, start_round=start_round,
-                        on_round=on_round)
+    try:
+        result = server.run(nr_remaining, start_round=start_round,
+                            on_round=on_round)
+    finally:
+        # saves are async (on_round): drain + close even on a mid-run crash,
+        # or the newest checkpoint dies uncommitted with the process — the
+        # exact durability the per-round save exists to provide
+        if ckpt is not None:
+            ckpt.close()
+            ckpt = None
 
     if cfg.dp_noise_mult:
         from .fl.privacy import dp_epsilon
@@ -229,8 +238,6 @@ def run(cfg: HflConfig):
 
     if logger is not None:
         logger.close()
-    if ckpt is not None:
-        ckpt.close()
     if cfg.plot_dir and result.test_accuracy:
         from pathlib import Path
 

@@ -383,8 +383,10 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
                     logger.log("eval", idx=it, val_loss=float(val_loss),
                                perplexity=ppl)
             if ckpt is not None and (it + 1) % cfg.checkpoint_every == 0:
+                # async: the write overlaps the next training iterations;
+                # Checkpointer.close() (finally block) drains it
                 ckpt.save(it + 1, {"params": params, "opt_state": opt_state,
-                                   "iteration": it + 1})
+                                   "iteration": it + 1}, wait=False)
     finally:
         stream.close()
         if logger:

@@ -38,11 +38,22 @@ class Checkpointer:
         )
 
     def save(self, step: int, state: Any, *, wait: bool = True) -> None:
+        """``wait=False`` makes the save asynchronous: orbax snapshots the
+        arrays and writes in a background thread while training continues
+        (the next ``save``/``restore``/``close`` synchronises first, so
+        checkpoints can never interleave — orbax only drains on save/close
+        itself; ``restore`` drains explicitly below).  The training CLIs
+        save async and sync at close — a checkpoint write costs the round
+        that issues it nothing but the host snapshot."""
         self._mngr.save(step, args=self._ocp.args.StandardSave(state))
         if wait:
             self._mngr.wait_until_finished()
 
     def restore(self, template: Any, step: int | None = None) -> Any:
+        # drain any in-flight async save first: orbax's restore does NOT
+        # (verified, 0.11.x) — without this, latest_step() skips the
+        # still-uncommitted newest step and silently restores stale state
+        self._mngr.wait_until_finished()
         if step is None:
             step = self.latest_step()
         if step is None:
@@ -63,6 +74,9 @@ class Checkpointer:
         return self._mngr.all_steps()
 
     def close(self):
+        # drain any in-flight async save before closing: a dropped write
+        # would silently lose the newest checkpoint
+        self._mngr.wait_until_finished()
         self._mngr.close()
 
 
