@@ -74,9 +74,7 @@ class Checkpointer:
         return self._mngr.all_steps()
 
     def close(self):
-        # drain any in-flight async save before closing: a dropped write
-        # would silently lose the newest checkpoint
-        self._mngr.wait_until_finished()
+        # orbax's close() drains in-flight async saves itself (0.11.x)
         self._mngr.close()
 
 

@@ -1,0 +1,86 @@
+"""Generation throughput microbenchmark: tokens/sec from the KV-cache decoder.
+
+The reference never decodes at all (its LMs only log training loss,
+lab/tutorial_1b/primer/intro.py); this framework's scan-compiled KV-cache
+generation (models/generate.py) is a serving surface, so it gets its own
+measured number: prefill latency, per-token decode latency, and tokens/sec,
+across batch sizes and GQA settings (the KV cache — and so decode HBM
+traffic — shrinks by nr_heads/kv_heads; MQA is the bandwidth-optimal point).
+
+Usage:
+    python examples/bench_generate.py                       # primer config
+    python examples/bench_generate.py --batches 1,8 --kv-heads 6,2,1
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dmodel", type=int, default=288)
+    ap.add_argument("--heads", type=int, default=6)
+    ap.add_argument("--layers", type=int, default=6)
+    ap.add_argument("--ctx", type=int, default=1024)
+    ap.add_argument("--prompt", type=int, default=64)
+    ap.add_argument("--new-tokens", type=int, default=256)
+    ap.add_argument("--batches", default="1,8")
+    ap.add_argument("--kv-heads", default="6,1",
+                    help="comma list; each must divide --heads (0 = MHA)")
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    from ddl25spring_tpu.utils.platform import select_platform
+
+    select_platform()
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models import Llama, LlamaConfig, generate
+    from ddl25spring_tpu.utils.platform import device_sync
+
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    print(f"backend={jax.default_backend()} dtype={dt.__name__} "
+          f"dmodel={args.dmodel} layers={args.layers} ctx={args.ctx} "
+          f"prompt={args.prompt} new={args.new_tokens}", flush=True)
+    print(f"{'B':>3} {'kv_heads':>8} {'cache MB':>8} {'compile s':>9} "
+          f"{'total s':>8} {'tok/s':>8}")
+
+    for B in [int(b) for b in args.batches.split(",")]:
+        for kvh in [int(k) for k in args.kv_heads.split(",")]:
+            cfg = LlamaConfig(
+                vocab_size=259, dmodel=args.dmodel, nr_heads=args.heads,
+                nr_kv_heads=0 if kvh == args.heads else kvh,
+                nr_layers=args.layers, ctx_size=args.ctx, dtype=dt,
+            )
+            prompt = jnp.ones((B, args.prompt), jnp.int32)
+            params = Llama(cfg).init(
+                jax.random.key(0), prompt, positions=jnp.arange(args.prompt)
+            )
+            cache_mb = (
+                2 * B * args.ctx * cfg.kv_heads * cfg.head_dim
+                * args.layers * dt.dtype.itemsize / 2**20
+            )
+            t0 = time.perf_counter()
+            out = generate(cfg, params, prompt, args.new_tokens)
+            device_sync(out)
+            compile_s = time.perf_counter() - t0
+            best = float("inf")
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                out = generate(cfg, params, prompt, args.new_tokens)
+                device_sync(out)
+                best = min(best, time.perf_counter() - t0)
+            toks = B * args.new_tokens / best
+            print(f"{B:>3} {cfg.kv_heads:>8} {cache_mb:>8.1f} "
+                  f"{compile_s:>9.1f} {best:>8.3f} {toks:>8.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
