@@ -120,6 +120,11 @@ class LmConfig:
     attn_impl: str = "dense"   # dense (XLA) | flash (Pallas); under
     #                            --strategy sp: dense -> einsum ring,
     #                            flash -> Pallas ring (ops/ring_flash.py)
+    sp_zigzag: bool = False    # sp: load-balanced zigzag ring (chunk pairs
+    #                            (i, 2S-1-i) -> constant work per device);
+    #                            always uses the Pallas flash kernels,
+    #                            overriding attn_impl for the ring
+    #                            (ops/ring_flash.py is blockwise)
     generate_tokens: int = 0   # after training, sample this many tokens
     generate_temperature: float = 0.8
     generate_top_k: int = 0    # 0 = off; keep the k most likely tokens
@@ -137,6 +142,13 @@ class LmConfig:
 
     def __post_init__(self):
         _check_checkpoint_pair(self.checkpoint_dir, self.checkpoint_every)
+        if self.sp_zigzag and self.seq_l % 2:
+            # fail fast: zigzag splits the sequence into 2*S chunks, so an
+            # odd seq_l can never satisfy it and would only crash deep
+            # inside jit tracing
+            raise ValueError(
+                f"sp_zigzag needs an even seq_l (got {self.seq_l})"
+            )
 
 
 def _add_dataclass_args(parser: argparse.ArgumentParser, cls) -> None:

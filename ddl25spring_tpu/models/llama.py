@@ -51,11 +51,12 @@ class LlamaConfig:
     decode: bool = False       # KV-cache autoregressive decoding (models.generate)
 
     def __post_init__(self):
-        if self.attn_impl not in ("dense", "ring", "flash", "ring-flash"):
+        if self.attn_impl not in ("dense", "ring", "flash", "ring-flash",
+                                  "zigzag-flash"):
             raise ValueError(
                 f"attn_impl={self.attn_impl!r} not in ('dense', 'ring', "
-                "'flash', 'ring-flash') — a typo here would otherwise "
-                "silently fall through to dense attention"
+                "'flash', 'ring-flash', 'zigzag-flash') — a typo here would "
+                "otherwise silently fall through to dense attention"
             )
         if self.nr_kv_heads and self.nr_heads % self.nr_kv_heads:
             raise ValueError(
@@ -146,6 +147,12 @@ class Attention(nn.Module):
             from ..ops.ring_flash import ring_flash_causal_attention
 
             out = ring_flash_causal_attention(q, k, v, cfg.seq_axis)
+        elif cfg.attn_impl == "zigzag-flash":
+            from ..ops.ring_flash import zigzag_ring_flash_attention
+
+            # positions already carry the zigzag layout (parallel/sp.py);
+            # the op needs only the chunk-pair structure, RoPE the positions
+            out = zigzag_ring_flash_attention(q, k, v, cfg.seq_axis)
         elif cfg.attn_impl == "flash":
             from ..ops.flash_attention import flash_causal_attention
 

@@ -98,3 +98,101 @@ def ring_flash_causal_attention(q, k, v, axis_name: str, *,
     (acc, _, _), _ = jax.lax.scan(body, (acc, k, v), jnp.arange(1, S))
     o, _ = acc
     return o.astype(v.dtype)
+
+
+def zigzag_permutation(T: int, S: int):
+    """True-order -> zigzag-order gather indices (and the inverse).
+
+    The sequence is cut into 2S chunks; device i holds chunks (i, 2S-1-i)
+    concatenated.  ``perm[j]`` is the true position stored at zigzag slot j,
+    so ``x[:, perm]`` lays tokens out for an S-device zigzag mesh and
+    ``z[:, inv]`` restores true order."""
+    import numpy as np
+
+    if T % (2 * S):
+        raise ValueError(f"T={T} must divide into 2*S={2 * S} chunks")
+    Tc = T // (2 * S)
+    chunk = np.arange(Tc)
+    perm = np.concatenate([
+        np.concatenate([i * Tc + chunk, (2 * S - 1 - i) * Tc + chunk])
+        for i in range(S)
+    ])
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(T)
+    return perm, inv
+
+
+def zigzag_ring_flash_attention(q, k, v, axis_name: str, *,
+                                interpret: bool | None = None):
+    """Load-balanced causal ring attention (zigzag chunk pairing).
+
+    The plain causal ring is imbalanced: device i's queries see i+1 of the S
+    KV blocks, so the last device does S times the first one's work and the
+    lockstep ring runs at ~50% efficiency for large S.  Pairing chunks the
+    zigzag way — device i holds chunks (i, 2S-1-i) of 2S, so every device
+    owns one early and one late chunk — makes the visible-work count
+    CONSTANT: after the diagonal step, each ring step runs exactly TWO
+    full-block kernels per device, whatever its position:
+
+      - q_late x k_early(src) — visible for every src (the late chunk is
+        later than all S early chunks);
+      - plus exactly one of q_early x k_early(src) (src earlier) or
+        q_late x k_late(src) (src later) — ``lax.cond`` picks per device.
+
+    Inputs are the zigzag-LOCAL blocks (B, 2*Tc, H, d): the caller permutes
+    tokens with :func:`zigzag_permutation` before sharding (parallel/sp.py
+    does this and un-permutes the logits).  Exact vs dense causal attention
+    on the gathered true-order sequence; differentiable end-to-end (scan +
+    ppermute + cond + kernel VJPs).  Standard construction, e.g. Llama 3's
+    context parallelism (public).
+    """
+    S = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    B, Tl, H, d = q.shape
+    Tc = Tl // 2
+    qa, qb = q[:, :Tc], q[:, Tc:]
+
+    def blk(qc, kc, vc, causal):
+        return flash_block_attention(qc, kc, vc, causal=causal,
+                                     interpret=interpret)
+
+    # diagonal (resident) step: both chunks attend within themselves
+    # causally, and the late chunk sees the whole early chunk
+    ka, kb_ = k[:, :Tc], k[:, Tc:]
+    va, vb_ = v[:, :Tc], v[:, Tc:]
+    oa, la = blk(qa, ka, va, True)
+    oa = oa.astype(jnp.float32)
+    ob, lb = blk(qb, kb_, vb_, True)
+    o2, l2 = blk(qb, ka, va, False)
+    ob, lb = _merge(ob.astype(jnp.float32), lb, o2, l2)
+
+    def body(carry, step):
+        (oa, la, ob, lb), k_blk, v_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = (idx - step) % S
+        ka_s, kb_s = k_blk[:, :Tc], k_blk[:, Tc:]
+        va_s, vb_s = v_blk[:, :Tc], v_blk[:, Tc:]
+
+        # the late q chunk sees every early chunk — unconditionally
+        o3, l3 = blk(qb, ka_s, va_s, False)
+        ob, lb = _merge(ob, lb, o3, l3)
+
+        def early_src(oa, la, ob, lb):
+            o4, l4 = blk(qa, ka_s, va_s, False)
+            return _merge(oa, la, o4, l4) + (ob, lb)
+
+        def late_src(oa, la, ob, lb):
+            o4, l4 = blk(qb, kb_s, vb_s, False)
+            return (oa, la) + _merge(ob, lb, o4, l4)
+
+        oa, la, ob, lb = jax.lax.cond(
+            src < idx, early_src, late_src, oa, la, ob, lb
+        )
+        return ((oa, la, ob, lb), k_blk, v_blk), None
+
+    ((oa, _, ob, _), _, _), _ = jax.lax.scan(
+        body, ((oa, la, ob, lb), k, v), jnp.arange(1, S)
+    )
+    return jnp.concatenate([oa, ob], axis=1).astype(v.dtype)

@@ -29,22 +29,32 @@ from ..ops.losses import causal_lm_loss
 
 
 def make_sp_forward(config: LlamaConfig, mesh, seq_axis: str = "seq",
-                    data_axis: str | None = None):
+                    data_axis: str | None = None, zigzag: bool = False):
     """``forward(params, tokens) -> logits`` with the sequence dimension of
     ``tokens``/activations sharded over ``seq_axis``; params replicated.
 
     ``tokens`` is global (B, T); T must divide by the seq-axis size.
+    ``zigzag=True`` expects tokens ALREADY in zigzag order
+    (ops.ring_flash.zigzag_permutation) and returns zigzag-ordered logits —
+    each device then holds chunk pair (i, 2S-1-i), the load-balanced layout
+    of the zigzag ring (constant work per device vs the plain ring's i+1
+    blocks).  RoPE stays position-exact: the forward passes each slot's TRUE
+    global position.
     """
     # "flash" (or explicit "ring-flash") upgrades the ring's per-step block
     # attention from dense XLA einsums to the Pallas kernels
-    # (ops/ring_flash.py); "dense"/"ring" keep the einsum ring.
+    # (ops/ring_flash.py); "dense"/"ring" keep the einsum ring.  zigzag
+    # always runs the flash kernels (the construction is blockwise).
     ring_impl = (
-        "ring-flash" if config.attn_impl in ("flash", "ring-flash") else "ring"
+        "zigzag-flash" if zigzag
+        else "ring-flash" if config.attn_impl in ("flash", "ring-flash")
+        else "ring"
     )
     sp_config = dataclasses.replace(config, attn_impl=ring_impl,
                                     seq_axis=seq_axis)
     model = Llama(sp_config)
     batch = data_axis  # None -> replicated batch
+    S = mesh.shape[seq_axis]
 
     @partial(
         shard_map,
@@ -55,24 +65,49 @@ def make_sp_forward(config: LlamaConfig, mesh, seq_axis: str = "seq",
     )
     def forward(params, tokens):
         Tl = tokens.shape[1]
-        offset = jax.lax.axis_index(seq_axis) * Tl
-        return model.apply(params, tokens, positions=offset + jnp.arange(Tl))
+        idx = jax.lax.axis_index(seq_axis)
+        if zigzag:
+            Tc = Tl // 2
+            positions = jnp.concatenate([
+                idx * Tc + jnp.arange(Tc),
+                (2 * S - 1 - idx) * Tc + jnp.arange(Tc),
+            ])
+        else:
+            positions = idx * Tl + jnp.arange(Tl)
+        return model.apply(params, tokens, positions=positions)
 
     return forward
 
 
 def make_sp_train_step(config: LlamaConfig, mesh, optimizer,
                        seq_axis: str = "seq", data_axis: str | None = None,
-                       donate: bool = False):
+                       donate: bool = False, zigzag: bool = False):
     """Jitted ``step(params, opt_state, tokens) -> (params, opt_state, loss)``
     training over sequence-sharded activations (optionally batch-sharded too:
     hybrid DP x SP).  The causal next-token shift in the loss crosses shard
     boundaries; it runs on the global logits so GSPMD inserts the halo
-    exchange."""
-    forward = make_sp_forward(config, mesh, seq_axis, data_axis)
+    exchange.
 
-    def loss_fn(params, tokens):
-        return causal_lm_loss(forward(params, tokens), tokens)
+    ``zigzag=True`` runs the load-balanced zigzag ring: tokens stay in TRUE
+    order at the step boundary; the step permutes them into zigzag layout
+    (a static gather GSPMD lowers to an all-to-all over the seq axis),
+    forwards, and un-permutes the logits before the loss, so callers and
+    checkpoints never see the internal layout."""
+    forward = make_sp_forward(config, mesh, seq_axis, data_axis,
+                              zigzag=zigzag)
+
+    if zigzag:
+        from ..ops.ring_flash import zigzag_permutation
+
+        S = mesh.shape[seq_axis]
+
+        def loss_fn(params, tokens):
+            perm, inv = zigzag_permutation(tokens.shape[1], S)
+            logits_z = forward(params, tokens[:, perm])
+            return causal_lm_loss(logits_z[:, inv], tokens)
+    else:
+        def loss_fn(params, tokens):
+            return causal_lm_loss(forward(params, tokens), tokens)
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)

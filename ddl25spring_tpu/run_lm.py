@@ -317,9 +317,14 @@ def build_trainer(cfg: LmConfig, vocab_size: int = BASE_VOCAB):
         return step, params, optimizer.init(params), shard
 
     if cfg.strategy == "sp":
-        seq = _largest_divisor(cfg.seq_l, n)
+        if cfg.sp_zigzag:
+            # zigzag needs 2*S chunks: the seq axis must divide seq_l/2
+            seq = _largest_divisor(cfg.seq_l // 2, n)
+        else:
+            seq = _largest_divisor(cfg.seq_l, n)
         mesh = make_mesh({"seq": seq}, devices=devices[:seq])
-        step = make_sp_train_step(mcfg, mesh, optimizer, donate=True)
+        step = make_sp_train_step(mcfg, mesh, optimizer, donate=True,
+                                  zigzag=cfg.sp_zigzag)
         shard = lambda x: jax.device_put(x, sp_data_sharding(mesh))
         return step, params, optimizer.init(params), shard
 

@@ -74,7 +74,11 @@ class Checkpointer:
         return self._mngr.all_steps()
 
     def close(self):
-        # orbax's close() drains in-flight async saves itself (0.11.x)
+        # orbax >= 0.11 drains in-flight async saves in close() itself, but
+        # the declared dependency floor is older — drain explicitly (no-op
+        # when orbax already does it) so the newest checkpoint can never be
+        # dropped on any supported version
+        self._mngr.wait_until_finished()
         self._mngr.close()
 
 
