@@ -149,8 +149,7 @@ def zigzag_ring_flash_attention(q, k, v, axis_name: str, *,
     S = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % S) for i in range(S)]
-    B, Tl, H, d = q.shape
-    Tc = Tl // 2
+    Tc = q.shape[1] // 2
     qa, qb = q[:, :Tc], q[:, Tc:]
 
     def blk(qc, kc, vc, causal):

@@ -97,14 +97,29 @@ def make_sp_train_step(config: LlamaConfig, mesh, optimizer,
                               zigzag=zigzag)
 
     if zigzag:
+        from ..ops.losses import cross_entropy_logits
         from ..ops.ring_flash import zigzag_permutation
 
         S = mesh.shape[seq_axis]
 
         def loss_fn(params, tokens):
-            perm, inv = zigzag_permutation(tokens.shape[1], S)
+            T = tokens.shape[1]
+            perm, _ = zigzag_permutation(T, S)
             logits_z = forward(params, tokens[:, perm])
-            return causal_lm_loss(logits_z[:, inv], tokens)
+            # compute the loss IN zigzag space by permuting the int32
+            # targets (the next true token of each slot's true position),
+            # not by un-permuting the (B, T, V) float logits — the latter
+            # is a vocab-times-larger all-to-all over the seq axis, pure
+            # overhead in exactly the long-context regime zigzag targets
+            targets = jnp.concatenate(
+                [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
+            )[:, perm]
+            # full-shape mask: _masked_mean's denominator is sum(mask), so a
+            # broadcastable (1, T) mask would undercount by the batch factor
+            valid = jnp.broadcast_to(
+                jnp.asarray(perm != T - 1)[None, :], tokens.shape
+            )  # the true-last position predicts nothing
+            return cross_entropy_logits(logits_z, targets, valid)
     else:
         def loss_fn(params, tokens):
             return causal_lm_loss(forward(params, tokens), tokens)
