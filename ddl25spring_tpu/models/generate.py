@@ -37,6 +37,7 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     key: jax.Array | None = None,
+    prompt_lengths: jax.Array | None = None,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -49,12 +50,25 @@ def generate(
     (1.0 = off) — both standard decode-time filters, applied k-then-p when
     combined.
 
+    **Ragged batches**: ``prompt_lengths`` (B,) marks each row's true prompt
+    length; rows are right-padded in the input.  Internally every row is
+    left-aligned to the shared prompt window (the standard serving layout:
+    all rows' next-token logits sit at the same slot, decode stays lockstep,
+    pad slots are masked out of attention and rotary positions start at 0
+    per row).  The result comes back LEFT-padded: row i is
+    ``[pad..., prompt_i, continuation_i]``.  Each row decodes exactly as it
+    would alone (oracle-pinned in tests/test_llama.py).
+
     The model's ``ctx_size`` bounds the total length; the rotary embedding is
     position-exact because every step passes its global position explicitly.
     """
     B, T0 = prompt.shape
     if max_new_tokens == 0:
-        return prompt
+        if prompt_lengths is None:
+            return prompt
+        # honour the documented left-padded output layout even with nothing
+        # to generate
+        return _left_align(prompt, T0, prompt_lengths)[0]
     total = T0 + max_new_tokens
     if total > config.ctx_size:
         raise ValueError(
@@ -79,7 +93,21 @@ def generate(
         top_k, top_p = 0, 1.0
     decode = _decode_fn(config, T0, total, float(temperature), int(top_k),
                         float(top_p))
-    return decode(params, prompt, key)
+    if prompt_lengths is None:
+        return decode(params, prompt, key)
+    prompt_left, pad = _left_align(prompt, T0, prompt_lengths)
+    return decode(params, prompt_left, key, pad)
+
+
+def _left_align(prompt, T0: int, prompt_lengths):
+    """Right-padded ragged rows -> left-padded shared window + pad widths.
+    Pad slots hold token 0 (masked from attention AND zeroed in the output,
+    so pad-stripping consumers see actual pad ids, not token copies)."""
+    pad = T0 - jnp.asarray(prompt_lengths, jnp.int32)
+    src = jnp.maximum(jnp.arange(T0)[None, :] - pad[:, None], 0)
+    left = jnp.take_along_axis(prompt, src, axis=1)
+    left = jnp.where(jnp.arange(T0)[None, :] >= pad[:, None], left, 0)
+    return left, pad
 
 
 def _filter_logits(logits, top_k: int, top_p: float):
@@ -123,10 +151,12 @@ def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
     ))
 
     @jax.jit
-    def decode(params, prompt, key):
-        # prefill: score the whole prompt in one forward, populating the cache
+    def decode(params, prompt, key, pad=None):
+        # prefill: score the whole prompt in one forward, populating the
+        # cache; ragged rows are already left-aligned, so every row's
+        # next-token logits sit at the shared last slot
         logits, state = model.apply(
-            params, prompt, jnp.arange(T0), mutable=["cache"]
+            params, prompt, jnp.arange(T0), pad, mutable=["cache"]
         )
         cache = state["cache"]
 
@@ -146,7 +176,7 @@ def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
         def step(carry, i):
             cache, tok = carry
             logits, state = model.apply(
-                {**params, "cache": cache}, tok[:, None], i[None],
+                {**params, "cache": cache}, tok[:, None], i[None], pad,
                 mutable=["cache"],
             )
             nxt = pick(logits[:, -1], jax.random.fold_in(key, i))

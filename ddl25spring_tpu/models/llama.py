@@ -94,19 +94,24 @@ class RMSNorm(nn.Module):
 
 
 def rope_angles(head_dim: int, positions: jax.Array, base: float = 10000.0):
-    """Rotary embedding cos/sin tables for given (T,) positions."""
+    """Rotary embedding cos/sin tables for (T,) — or, for ragged batches
+    where every row sits at its own offset, (B, T) — positions."""
     inv_freq = 1.0 / (
         base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
-    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (T, hd/2)
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., hd/2)
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array):
-    """Rotate (B, T, H, hd) queries/keys by position."""
+    """Rotate (B, T, H, hd) queries/keys by position; cos/sin are
+    (T, hd/2) shared or (B, T, hd/2) per-row."""
     x1, x2 = jnp.split(x, 2, axis=-1)
-    c = cos[None, :, None, :].astype(x.dtype)
-    s = sin[None, :, None, :].astype(x.dtype)
+    if cos.ndim == 2:
+        cos = cos[None]
+        sin = sin[None]
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
 
 
@@ -114,7 +119,7 @@ class Attention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, pad=None):
         cfg = self.config
         B, T, _ = x.shape
         dense = lambda name, features: nn.Dense(
@@ -125,11 +130,19 @@ class Attention(nn.Module):
                                                cfg.head_dim)
         k = dense("wk", kv_dim)(x).reshape(B, T, cfg.kv_heads, cfg.head_dim)
         v = dense("wv", kv_dim)(x).reshape(B, T, cfg.kv_heads, cfg.head_dim)
-        cos, sin = rope_angles(cfg.head_dim, positions)
+        # ragged decode (models/generate.py left-padded batches): positions
+        # are shared cache SLOTS; each row's rotary position is its slot
+        # minus its pad width, so every prompt starts at rotary position 0.
+        # Pad slots clamp to 0 — they are masked out of attention anyway.
+        rope_pos = (
+            positions if pad is None
+            else jnp.maximum(positions[None, :] - pad[:, None], 0)
+        )
+        cos, sin = rope_angles(cfg.head_dim, rope_pos)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if cfg.decode:
-            out = self._decode_attention(q, k, v, positions)
+            out = self._decode_attention(q, k, v, positions, pad)
             out = out.reshape(B, T, cfg.dmodel)
             return dense("wo", cfg.dmodel)(out)
         # training paths: expand KV heads to the query heads so every
@@ -162,7 +175,7 @@ class Attention(nn.Module):
         out = out.reshape(B, T, cfg.dmodel)
         return dense("wo", cfg.dmodel)(out)
 
-    def _decode_attention(self, q, k, v, positions):
+    def _decode_attention(self, q, k, v, positions, pad=None):
         """Attention against a fixed-size KV cache (``cache`` collection).
 
         The cache keeps static shape (B, ctx_size, Hkv, hd) — TPU-friendly:
@@ -181,6 +194,15 @@ class Attention(nn.Module):
         ck = self.variable("cache", "k", zeros)
         cv = self.variable("cache", "v", zeros)
         offset = positions[0]
+        if pad is not None:
+            # scrub pad-slot K/V before they enter the cache: pad-slot
+            # QUERIES see no keys, so deeper layers' activations there are
+            # NaN, and a real query's exactly-zero attention weight times a
+            # NaN value is still NaN — zeroing at the write kills the
+            # poison at its source (jnp.where never multiplies)
+            real = (positions[None, :] >= pad[:, None])[..., None, None]
+            k = jnp.where(real, k, 0)
+            v = jnp.where(real, v, 0)
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
         # (B, T, Hkv, group, hd): query heads grouped by the KV head they share
@@ -193,10 +215,16 @@ class Attention(nn.Module):
         scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck.value).astype(
             jnp.float32
         ) * scale
-        # key j visible to query at global position p iff j <= p; unwritten
-        # cache rows are masked out by the same comparison
+        # key j visible to query at slot p iff j <= p; unwritten cache rows
+        # are masked out by the same comparison.  Ragged batches addition-
+        # ally hide each row's left-pad slots (j < pad[b]) — they hold
+        # garbage keys from the prefill of shorter prompts.
         visible = jnp.arange(S)[None, :] <= positions[:, None]  # (T, S)
-        scores = jnp.where(visible[None, None, None], scores, -jnp.inf)
+        visible = visible[None, None, None]  # (1, 1, 1, T, S)
+        if pad is not None:
+            real = jnp.arange(S)[None, :] >= pad[:, None]  # (B, S)
+            visible = visible & real[:, None, None, None, :]
+        scores = jnp.where(visible, scores, -jnp.inf)
         att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bkgts,bskd->btkgd", att, cv.value)
         return out.reshape(B, T, cfg.nr_heads, cfg.head_dim)
@@ -219,10 +247,10 @@ class Block(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, pad=None):
         cfg = self.config
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions, pad
         )
         h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
         if cfg.nr_experts:
@@ -315,7 +343,7 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, pad=None):
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.dmodel,
@@ -323,11 +351,12 @@ class Llama(nn.Module):
             dtype=cfg.dtype, name="embed",
         )(tokens)
         # explicit positions support sequence sharding, where a device's
-        # local block starts at a nonzero global offset (parallel/sp.py)
+        # local block starts at a nonzero global offset (parallel/sp.py);
+        # ``pad`` (B,) supports ragged left-padded decode (models/generate)
         pos = _positions(tokens.shape[1]) if positions is None else positions
         block = _block_cls(cfg)
         for i in range(cfg.nr_layers):
-            x = block(cfg, name=f"block{i}")(x, pos)
+            x = block(cfg, name=f"block{i}")(x, pos, pad)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"

@@ -90,9 +90,10 @@ def make_sp_train_step(config: LlamaConfig, mesh, optimizer,
 
     ``zigzag=True`` runs the load-balanced zigzag ring: tokens stay in TRUE
     order at the step boundary; the step permutes them into zigzag layout
-    (a static gather GSPMD lowers to an all-to-all over the seq axis),
-    forwards, and un-permutes the logits before the loss, so callers and
-    checkpoints never see the internal layout."""
+    (a static gather GSPMD lowers to an all-to-all over the seq axis) and
+    computes the loss IN zigzag space against equally-permuted int targets
+    (so the only extra all-to-all moves T int32s, never the (B, T, V) float
+    logits).  Callers and checkpoints never see the internal layout."""
     forward = make_sp_forward(config, mesh, seq_axis, data_axis,
                               zigzag=zigzag)
 
