@@ -59,7 +59,7 @@ class HflConfig:
     server_eta: float = 1.0    # fedbuff: server application rate
     dropout_rate: float = 0.0  # per-round client failure probability
     # robust aggregation (the missing course part 3; SURVEY.md §2.2)
-    aggregator: str = "mean"   # mean | krum | multi-krum | trimmed-mean | median | consensus (fedsgd only)
+    aggregator: str = "mean"   # mean | krum | multi-krum | bulyan | trimmed-mean | median | consensus (fedsgd only)
     attack: str = "none"       # none | label-flip | gaussian | sign-flip
     nr_malicious: int = 0
     # harness

@@ -4,6 +4,7 @@ from .aggregators import (
     make_trimmed_mean,
     make_consensus,
     make_krum,
+    make_bulyan,
 )
 from .attacks import (
     make_gaussian_attack,
@@ -17,6 +18,7 @@ __all__ = [
     "make_trimmed_mean",
     "make_consensus",
     "make_krum",
+    "make_bulyan",
     "make_gaussian_attack",
     "make_sign_flip_attack",
     "flip_labels",

@@ -146,3 +146,40 @@ def make_krum(nr_byzantine: int, nr_selected: int = 1):
         return unflatten(jnp.mean(mat[chosen], axis=0))
 
     return krum
+
+
+def make_bulyan(nr_byzantine: int):
+    """Bulyan (El Mhamdi et al., ICML 2018, public): Krum-select a
+    θ = m - 2f committee, then aggregate it with a per-coordinate trimmed
+    mean keeping the θ - 2f values closest to the committee's coordinate
+    median.  Combines Krum's distance-based outlier rejection with
+    coordinate-wise robustness (a single Krum winner can still carry a few
+    poisoned coordinates); needs m >= 4f + 3.
+    """
+
+    def bulyan(stacked, weights=None, key=None):
+        mat, unflatten = _stack_to_matrix(stacked)
+        m = mat.shape[0]
+        f = nr_byzantine
+        theta = m - 2 * f
+        beta = theta - 2 * f
+        if m < 4 * f + 3:
+            raise ValueError(
+                f"bulyan needs m >= 4f + 3 (m={m}, f={f})"
+            )
+        # selection stage: iteratively-selected Krum committee == the theta
+        # best-scoring updates under the same neighbor-distance score
+        nr_neighbors = m - f - 2
+        sq = jnp.sum((mat[:, None, :] - mat[None, :, :]) ** 2, axis=-1)
+        sq = sq + jnp.diag(jnp.full(m, jnp.inf))
+        scores = jnp.sum(jnp.sort(sq, axis=1)[:, :nr_neighbors], axis=1)
+        committee = mat[jnp.argsort(scores)[:theta]]  # (theta, d)
+        # aggregation stage: per-coordinate, keep the beta values nearest
+        # the committee median and average them
+        med = jnp.median(committee, axis=0)
+        dist = jnp.abs(committee - med[None, :])
+        nearest = jnp.argsort(dist, axis=0)[:beta]  # (beta, d)
+        kept = jnp.take_along_axis(committee, nearest, axis=0)
+        return unflatten(jnp.mean(kept, axis=0))
+
+    return bulyan

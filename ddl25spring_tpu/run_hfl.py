@@ -60,6 +60,10 @@ def build_aggregator(cfg: HflConfig):
     if cfg.aggregator == "multi-krum":
         return make_krum(cfg.nr_malicious,
                          max(1, sampled - 2 * cfg.nr_malicious))
+    if cfg.aggregator == "bulyan":
+        from .robust import make_bulyan
+
+        return make_bulyan(cfg.nr_malicious)
     raise ValueError(f"unknown aggregator {cfg.aggregator!r}")
 
 

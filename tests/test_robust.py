@@ -151,3 +151,34 @@ def test_end_to_end_consensus_resists_sign_flip():
     rr_d = build(make_consensus()).run(6)
     rr_u = build(None).run(6)
     assert rr_d.test_accuracy[-1] > rr_u.test_accuracy[-1] + 10
+
+
+def test_bulyan_resists_large_outliers():
+    """Bulyan (selection committee + coordinate trimmed mean) ignores f
+    arbitrarily-bad updates and stays near the honest mean; with f=0 and
+    all-equal updates it is exact."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu.robust import make_bulyan
+
+    m, d, f = 11, 16, 2  # needs m >= 4f + 3
+    key = jax.random.key(0)
+    honest = jax.random.normal(key, (m - f, d))
+    evil = 1e6 * jnp.ones((f, d))
+    stacked = {"w": jnp.concatenate([honest, evil])}
+    agg = make_bulyan(f)(stacked, None, None)["w"]
+    honest_mean = honest.mean(axis=0)
+    assert float(jnp.max(jnp.abs(agg - honest_mean))) < 1.0
+    assert float(jnp.max(jnp.abs(agg))) < 10.0  # nowhere near the outliers
+
+    same = {"w": jnp.ones((11, 4))}
+    np.testing.assert_allclose(
+        np.asarray(make_bulyan(2)(same, None, None)["w"]), 1.0, rtol=1e-6
+    )
+
+    import pytest
+
+    with pytest.raises(ValueError, match="4f"):
+        make_bulyan(3)({"w": jnp.ones((8, 4))}, None, None)
