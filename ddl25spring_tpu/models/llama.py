@@ -145,12 +145,14 @@ class Attention(nn.Module):
             out = self._decode_attention(q, k, v, positions, pad)
             out = out.reshape(B, T, cfg.dmodel)
             return dense("wo", cfg.dmodel)(out)
-        # training paths: expand KV heads to the query heads so every
-        # attn_impl (dense einsum, flash kernels, both rings) sees plain MHA
-        # shapes.  GQA's wins live in the wk/wv params and the decode cache
-        # (kv_heads-sized); training activations pay the repeat, which XLA
-        # fuses into the consumer
-        if cfg.kv_heads != cfg.nr_heads:
+        # single-device training paths: expand KV heads to the query heads
+        # so the dense einsum / flash kernels see plain MHA shapes (XLA
+        # fuses the repeat into the consumer).  The RING impls expand
+        # per-block INSIDE the op instead — the ppermuted KV blocks then
+        # ride the ICI at kv_heads size, cutting ring traffic by
+        # nr_heads/kv_heads under GQA.
+        ring = cfg.attn_impl in ("ring", "ring-flash", "zigzag-flash")
+        if cfg.kv_heads != cfg.nr_heads and not ring:
             group = cfg.nr_heads // cfg.kv_heads
             k = jnp.repeat(k, group, axis=2)
             v = jnp.repeat(v, group, axis=2)

@@ -15,6 +15,20 @@ import jax
 import jax.numpy as jnp
 
 
+def expand_kv_heads(q, kb, vb):
+    """GQA: expand KV-head blocks to the query heads (repeat per group).
+
+    Ring attention variants ship KV around the ICI ring at kv_heads size and
+    call this block-locally just before the score math, so ring traffic
+    stays nr_heads/kv_heads smaller; head order matches the decode cache's
+    grouped reshape (query head h reads KV head h // group)."""
+    if kb.shape[2] != q.shape[2]:
+        group = q.shape[2] // kb.shape[2]
+        kb = jnp.repeat(kb, group, axis=2)
+        vb = jnp.repeat(vb, group, axis=2)
+    return kb, vb
+
+
 def causal_attention(q, k, v, *, precision=None):
     """Standard causal MHA core.
 
@@ -60,6 +74,7 @@ def ring_causal_attention(q, k, v, axis_name: str, *, precision=None):
     def accumulate(acc, k_blk, v_blk, src):
         """Fold one KV block into the online-softmax state (o, m, l)."""
         o, m, l = acc
+        k_blk, v_blk = expand_kv_heads(q, k_blk, v_blk)
         k_pos = src * Tl + jnp.arange(Tl)
         logits = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k_blk, precision=precision

@@ -35,6 +35,9 @@ import jax.numpy as jnp
 from .flash_attention import flash_block_attention
 
 
+from .attention import expand_kv_heads as _expand_kv  # shared GQA expand
+
+
 def _merge(o1, lse1, o2, lse2):
     """Online log-sum-exp merge of two normalised partial attentions.
 
@@ -67,8 +70,8 @@ def ring_flash_causal_attention(q, k, v, axis_name: str, *,
     perm = [(i, (i + 1) % S) for i in range(S)]
 
     # resident (diagonal) block first — no collective result discarded
-    o_blk, lse_blk = flash_block_attention(q, k, v, causal=True,
-                                           interpret=interpret)
+    o_blk, lse_blk = flash_block_attention(q, *_expand_kv(q, k, v),
+                                           causal=True, interpret=interpret)
     acc = (o_blk.astype(jnp.float32), lse_blk)
 
     def body(carry, step):
@@ -78,8 +81,8 @@ def ring_flash_causal_attention(q, k, v, axis_name: str, *,
         src = (idx - step) % S
 
         def visible(q, kb, vb):
-            return flash_block_attention(q, kb, vb, causal=False,
-                                         interpret=interpret)
+            return flash_block_attention(q, *_expand_kv(q, kb, vb),
+                                         causal=False, interpret=interpret)
 
         def masked(q, kb, vb):
             B, Tl, H, _ = q.shape
@@ -153,8 +156,8 @@ def zigzag_ring_flash_attention(q, k, v, axis_name: str, *,
     qa, qb = q[:, :Tc], q[:, Tc:]
 
     def blk(qc, kc, vc, causal):
-        return flash_block_attention(qc, kc, vc, causal=causal,
-                                     interpret=interpret)
+        return flash_block_attention(qc, *_expand_kv(qc, kc, vc),
+                                     causal=causal, interpret=interpret)
 
     # diagonal (resident) step: both chunks attend within themselves
     # causally, and the late chunk sees the whole early chunk

@@ -155,6 +155,13 @@ def make_bulyan(nr_byzantine: int):
     median.  Combines Krum's distance-based outlier rejection with
     coordinate-wise robustness (a single Krum winner can still carry a few
     poisoned coordinates); needs m >= 4f + 3.
+
+    Selection note: the paper removes the Krum winner and RE-SCORES the
+    remaining set θ times; this implementation takes the θ best one-shot
+    Krum scores instead — one O(m²d) distance pass, jit-friendly, the common
+    deployed simplification — which can admit a different committee than
+    iterative re-scoring when a colluding clique reshapes the score
+    landscape mid-selection.  The coordinate-wise trimming stage is exact.
     """
 
     def bulyan(stacked, weights=None, key=None):
@@ -167,8 +174,8 @@ def make_bulyan(nr_byzantine: int):
             raise ValueError(
                 f"bulyan needs m >= 4f + 3 (m={m}, f={f})"
             )
-        # selection stage: iteratively-selected Krum committee == the theta
-        # best-scoring updates under the same neighbor-distance score
+        # selection stage: the theta best one-shot Krum scores (see the
+        # docstring's selection note vs the paper's iterative variant)
         nr_neighbors = m - f - 2
         sq = jnp.sum((mat[:, None, :] - mat[None, :, :]) ** 2, axis=-1)
         sq = sq + jnp.diag(jnp.full(m, jnp.inf))

@@ -30,6 +30,7 @@ from .fl.task import classification_task
 from .models import MnistCnn, ResNet18
 from .robust import (
     coordinate_median,
+    make_bulyan,
     make_consensus,
     flip_labels,
     make_gaussian_attack,
@@ -61,8 +62,6 @@ def build_aggregator(cfg: HflConfig):
         return make_krum(cfg.nr_malicious,
                          max(1, sampled - 2 * cfg.nr_malicious))
     if cfg.aggregator == "bulyan":
-        from .robust import make_bulyan
-
         return make_bulyan(cfg.nr_malicious)
     raise ValueError(f"unknown aggregator {cfg.aggregator!r}")
 

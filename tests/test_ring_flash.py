@@ -227,3 +227,41 @@ def test_sp_zigzag_train_step_matches_single_device():
     np.testing.assert_allclose(loss1, loss2, atol=1e-5)
     for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
         np.testing.assert_allclose(a, b, atol=2e-4)
+
+
+def test_sp_rings_with_gqa_match_single_device():
+    """GQA through both Pallas rings: KV blocks ride the ring at kv_heads
+    size (expanded per block inside the op), and the step still equals the
+    single-device dense GQA step."""
+    base = LlamaConfig(vocab_size=64, dmodel=32, nr_heads=4, nr_kv_heads=2,
+                       nr_layers=2, ctx_size=32)
+    tokens = jax.random.randint(jax.random.key(20), (2, base.ctx_size), 0,
+                                base.vocab_size)
+    model = Llama(base)
+    params = model.init(
+        jax.random.key(21), tokens, positions=jnp.arange(base.ctx_size)
+    )
+    optimizer = optax.sgd(0.1)
+
+    def single_step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply(p, tokens,
+                                 positions=jnp.arange(base.ctx_size))
+            return causal_lm_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    mesh = make_mesh({"seq": 4})
+    sp_tokens = jax.device_put(tokens, sp_data_sharding(mesh))
+    p_ref, _, loss_ref = single_step(params, optimizer.init(params), tokens)
+
+    flash_cfg = dataclasses.replace(base, attn_impl="flash")
+    for kwargs in ({}, {"zigzag": True}):
+        step = make_sp_train_step(flash_cfg, mesh, optimizer, **kwargs)
+        p2, _, loss2 = step(params, optimizer.init(params), sp_tokens)
+        np.testing.assert_allclose(loss_ref, loss2, atol=1e-5,
+                                   err_msg=str(kwargs))
+        for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p2)):
+            np.testing.assert_allclose(a, b, atol=2e-4, err_msg=str(kwargs))

@@ -105,3 +105,26 @@ def test_sp_train_step_matches_single_device(tokens):
     assert jnp.allclose(l_sp, l_ref, atol=1e-5)
     for a, b in zip(jax.tree.leaves(p_sp), jax.tree.leaves(p_ref)):
         assert jnp.allclose(a, b, atol=1e-4)
+
+
+def test_dense_ring_with_gqa_matches_dense():
+    """GQA through the DENSE einsum ring: KV rides the ring at kv_heads
+    size, expanded block-locally (ops.attention.expand_kv_heads)."""
+    import numpy as np
+
+    mesh = make_mesh({"seq": 4})
+    B, T, H, Hkv, D = 2, 32, 4, 2, 8
+    ks = jax.random.split(jax.random.key(9), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, Hkv, D))
+    v = jax.random.normal(ks[2], (B, T, Hkv, D))
+
+    ring = partial(
+        shard_map, mesh=mesh, in_specs=P(None, "seq"),
+        out_specs=P(None, "seq"), check_vma=False,
+    )(lambda q, k, v: ring_causal_attention(q, k, v, "seq"))
+    k_full = jnp.repeat(k, H // Hkv, axis=2)
+    v_full = jnp.repeat(v, H // Hkv, axis=2)
+    np.testing.assert_allclose(
+        ring(q, k, v), causal_attention(q, k_full, v_full), atol=1e-5
+    )
