@@ -129,6 +129,8 @@ class LmConfig:
     generate_temperature: float = 0.8
     generate_top_k: int = 0    # 0 = off; keep the k most likely tokens
     generate_top_p: float = 1.0  # 1.0 = off; nucleus (cumulative-p) cut
+    generate_int8: bool = False  # decode with int8 matmul weights
+    #                              (models/quant.py weight-only quantization)
     eval_every: int = 0        # held-out eval every N iters; 0 = off
     eval_batches: int = 8      # held-out set size, in batches
     tokenizer: str = "byte"    # byte | bpe (SentencePiece-equivalent)

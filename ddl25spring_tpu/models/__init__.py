@@ -14,9 +14,12 @@ from .llama import (
     full_params_to_stage_params,
 )
 from .generate import generate
+from .quant import QuantDense, quantize_llama_params
 
 __all__ = [
     "generate",
+    "QuantDense",
+    "quantize_llama_params",
     "MnistCnn",
     "HeartDiseaseNN",
     "BasicBlock",

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import causal_attention, ring_causal_attention
+from .quant import QuantDense
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,6 +50,9 @@ class LlamaConfig:
     expert_topk: int = 2
     remat: bool = False        # rematerialize blocks in backward (HBM ↓, FLOPs ↑)
     decode: bool = False       # KV-cache autoregressive decoding (models.generate)
+    weights_int8: bool = False  # serving: matmul kernels stored int8 with
+    #                             per-channel scales (models/quant.py);
+    #                             params come from quantize_llama_params
 
     def __post_init__(self):
         if self.attn_impl not in ("dense", "ring", "flash", "ring-flash",
@@ -63,6 +67,13 @@ class LlamaConfig:
                 f"nr_kv_heads={self.nr_kv_heads} must divide "
                 f"nr_heads={self.nr_heads} (each KV head serves a "
                 "fixed-size group of query heads)"
+            )
+        if self.weights_int8 and self.nr_experts:
+            raise ValueError(
+                "weights_int8 does not support MoE configs: expert weights "
+                "(the bulk of the params) live outside the Dense layers "
+                "quantize_llama_params converts, so int8 serving would "
+                "silently quantize only a few percent of the bytes"
             )
 
     @property
@@ -122,9 +133,8 @@ class Attention(nn.Module):
     def __call__(self, x, positions, pad=None):
         cfg = self.config
         B, T, _ = x.shape
-        dense = lambda name, features: nn.Dense(
-            features, use_bias=False, dtype=cfg.dtype, name=name
-        )
+        mk = _dense_cls(cfg)
+        dense = lambda name, features: mk(features, name)
         kv_dim = cfg.kv_heads * cfg.head_dim  # == dmodel for MHA; less (GQA)
         q = dense("wq", cfg.dmodel)(x).reshape(B, T, cfg.nr_heads,
                                                cfg.head_dim)
@@ -238,11 +248,10 @@ class SwiGLU(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        gate = nn.Dense(cfg.hidden_dim, use_bias=False, dtype=cfg.dtype, name="w1")(x)
-        up = nn.Dense(cfg.hidden_dim, use_bias=False, dtype=cfg.dtype, name="w3")(x)
-        return nn.Dense(cfg.dmodel, use_bias=False, dtype=cfg.dtype, name="w2")(
-            nn.silu(gate) * up
-        )
+        mk = _dense_cls(cfg)
+        gate = mk(cfg.hidden_dim, "w1")(x)
+        up = mk(cfg.hidden_dim, "w3")(x)
+        return mk(cfg.dmodel, "w2")(nn.silu(gate) * up)
 
 
 class Block(nn.Module):
@@ -265,6 +274,18 @@ class Block(nn.Module):
 
 def _positions(T: int):
     return jnp.arange(T)
+
+
+def _dense_cls(cfg: LlamaConfig):
+    """Matmul-layer factory: fp ``nn.Dense`` or, for int8-serving configs,
+    ``QuantDense`` over quantize_llama_params output (models/quant.py)."""
+    if cfg.weights_int8:
+        return lambda features, name: QuantDense(
+            features, dtype=cfg.dtype, name=name
+        )
+    return lambda features, name: nn.Dense(
+        features, use_bias=False, dtype=cfg.dtype, name=name
+    )
 
 
 def _block_cls(cfg: LlamaConfig):
@@ -333,9 +354,7 @@ class LlamaLastStage(nn.Module):
         for i in range(self.nr_layers):
             x = block(cfg, name=f"block{i}")(x, pos)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
-        logits = nn.Dense(
-            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
-        )(x)
+        logits = _dense_cls(cfg)(cfg.vocab_size, "lm_head")(x)
         return logits.astype(jnp.float32)
 
 
@@ -360,9 +379,7 @@ class Llama(nn.Module):
         for i in range(cfg.nr_layers):
             x = block(cfg, name=f"block{i}")(x, pos, pad)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
-        logits = nn.Dense(
-            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
-        )(x)
+        logits = _dense_cls(cfg)(cfg.vocab_size, "lm_head")(x)
         return logits.astype(jnp.float32)
 
 

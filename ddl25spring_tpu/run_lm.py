@@ -459,6 +459,13 @@ def _sample_text(cfg: LmConfig, params, tok):
         return
     tok = tok if tok is not None else ByteTokenizer()
     mcfg = _model_config(cfg, tok.vocab_size)
+    if cfg.generate_int8:
+        import dataclasses as _dc
+
+        from .models import quantize_llama_params
+
+        params = quantize_llama_params(params)
+        mcfg = _dc.replace(mcfg, weights_int8=True)
     prompt = jnp.asarray([[tok.bos_id]], jnp.int32)
     out = generate(
         mcfg, params, prompt,
