@@ -34,6 +34,9 @@ def main():
     ap.add_argument("--kv-heads", default="6,1",
                     help="comma list; each must divide --heads (0 = MHA)")
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--int8", action="store_true",
+                    help="also measure each config with int8 matmul weights "
+                         "(models/quant.py) — the weight-bandwidth A/B")
     args = ap.parse_args()
 
     from ddl25spring_tpu.utils.platform import select_platform
@@ -42,15 +45,43 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from ddl25spring_tpu.models import Llama, LlamaConfig, generate
+    import dataclasses
+
+    from ddl25spring_tpu.models import (
+        Llama,
+        LlamaConfig,
+        generate,
+        quantize_llama_params,
+    )
     from ddl25spring_tpu.utils.platform import device_sync
 
     dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
     print(f"backend={jax.default_backend()} dtype={dt.__name__} "
           f"dmodel={args.dmodel} layers={args.layers} ctx={args.ctx} "
           f"prompt={args.prompt} new={args.new_tokens}", flush=True)
-    print(f"{'B':>3} {'kv_heads':>8} {'cache MB':>8} {'compile s':>9} "
-          f"{'total s':>8} {'tok/s':>8}")
+    print(f"{'B':>3} {'kv_heads':>8} {'weights':>7} {'cache MB':>8} "
+          f"{'compile s':>9} {'total s':>8} {'tok/s':>8}")
+
+    def measure(cfg, params, B):
+        prompt = jnp.ones((B, args.prompt), jnp.int32)
+        cache_mb = (
+            2 * B * args.ctx * cfg.kv_heads * cfg.head_dim
+            * args.layers * dt.dtype.itemsize / 2**20
+        )
+        t0 = time.perf_counter()
+        out = generate(cfg, params, prompt, args.new_tokens)
+        device_sync(out)
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            out = generate(cfg, params, prompt, args.new_tokens)
+            device_sync(out)
+            best = min(best, time.perf_counter() - t0)
+        toks = B * args.new_tokens / best
+        wlabel = "int8" if cfg.weights_int8 else dt.__name__[:4]
+        print(f"{B:>3} {cfg.kv_heads:>8} {wlabel:>7} {cache_mb:>8.1f} "
+              f"{compile_s:>9.1f} {best:>8.3f} {toks:>8.0f}", flush=True)
 
     for B in [int(b) for b in args.batches.split(",")]:
         for kvh in [int(k) for k in args.kv_heads.split(",")]:
@@ -63,23 +94,10 @@ def main():
             params = Llama(cfg).init(
                 jax.random.key(0), prompt, positions=jnp.arange(args.prompt)
             )
-            cache_mb = (
-                2 * B * args.ctx * cfg.kv_heads * cfg.head_dim
-                * args.layers * dt.dtype.itemsize / 2**20
-            )
-            t0 = time.perf_counter()
-            out = generate(cfg, params, prompt, args.new_tokens)
-            device_sync(out)
-            compile_s = time.perf_counter() - t0
-            best = float("inf")
-            for _ in range(args.reps):
-                t0 = time.perf_counter()
-                out = generate(cfg, params, prompt, args.new_tokens)
-                device_sync(out)
-                best = min(best, time.perf_counter() - t0)
-            toks = B * args.new_tokens / best
-            print(f"{B:>3} {cfg.kv_heads:>8} {cache_mb:>8.1f} "
-                  f"{compile_s:>9.1f} {best:>8.3f} {toks:>8.0f}", flush=True)
+            measure(cfg, params, B)
+            if args.int8:
+                measure(dataclasses.replace(cfg, weights_int8=True),
+                        quantize_llama_params(params), B)
 
 
 if __name__ == "__main__":

@@ -27,7 +27,7 @@ EOF
     timeout 2400 python examples/bench_flash.py --check \
       > results/flash_tpu.txt 2>> "$LOG"
     echo "$(date +%H:%M:%S) flash bench done (exit $?)" >> "$LOG"
-    timeout 1200 python examples/bench_generate.py \
+    timeout 1200 python examples/bench_generate.py --int8 \
       > results/generate_tpu.txt 2>> "$LOG"
     echo "$(date +%H:%M:%S) generate bench done (exit $?)" >> "$LOG"
     nohup /root/repo/tools/tpu_watch.sh >/dev/null 2>&1 &
