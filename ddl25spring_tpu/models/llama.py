@@ -53,6 +53,9 @@ class LlamaConfig:
     weights_int8: bool = False  # serving: matmul kernels stored int8 with
     #                             per-channel scales (models/quant.py);
     #                             params come from quantize_llama_params
+    decode_impl: str = "xla"   # xla (einsum over the whole cache) |
+    #                            flash-decode (Pallas, reads only live
+    #                            cache blocks; ops/flash_decode.py)
 
     def __post_init__(self):
         if self.attn_impl not in ("dense", "ring", "flash", "ring-flash",
@@ -67,6 +70,11 @@ class LlamaConfig:
                 f"nr_kv_heads={self.nr_kv_heads} must divide "
                 f"nr_heads={self.nr_heads} (each KV head serves a "
                 "fixed-size group of query heads)"
+            )
+        if self.decode_impl not in ("xla", "flash-decode"):
+            raise ValueError(
+                f"decode_impl={self.decode_impl!r} not in ('xla', "
+                "'flash-decode')"
             )
         if self.weights_int8 and self.nr_experts:
             raise ValueError(
@@ -217,6 +225,15 @@ class Attention(nn.Module):
             v = jnp.where(real, v, 0)
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+        if cfg.decode_impl == "flash-decode" and T == 1:
+            # Pallas kernel streams only the LIVE cache prefix (scalar-
+            # prefetch-clamped DMA); prefill (T > 1) keeps the einsum below
+            from ..ops.flash_decode import flash_decode_attention
+
+            out = flash_decode_attention(
+                q[:, 0], ck.value, cv.value, offset, pad,
+            )
+            return out[:, None]  # (B, 1, H, hd)
         # (B, T, Hkv, group, hd): query heads grouped by the KV head they share
         qg = q.reshape(B, T, Hkv, cfg.nr_heads // Hkv, cfg.head_dim)
         # scores in float32 BEFORE scaling, matching ops.attention's dense

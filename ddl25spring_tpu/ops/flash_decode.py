@@ -1,0 +1,135 @@
+"""Pallas flash-decode: single-token KV-cache attention with live-block DMA.
+
+The XLA decode path (models/llama.py ``_decode_attention``) scores the query
+against the ENTIRE fixed-size cache every step and masks after the read —
+simple, but it streams all ``ctx_size`` rows of K and V from HBM per token
+even when only ``pos`` of them have ever been written.  Decode is
+bandwidth-bound, so at position p in a ctx-S cache that's an S/p waste
+(32x at p=1k in a 32k cache).
+
+This kernel reads only the live prefix: the current position arrives as a
+SCALAR-PREFETCH argument, so the K/V BlockSpec index maps clamp every grid
+step past ``pos // block_k`` to the last live block — the pipeline sees a
+repeated index and skips the DMA entirely (the same trick the causal
+training kernel plays with the upper triangle, ops/flash_attention.py).
+Masking inside the live blocks handles ``k_pos <= pos`` and the ragged
+batches' left-pad slots (``k_pos >= pad[b]``).
+
+GQA-native: the cache stays at kv_heads; each grid step scores one KV
+head's (group, hd) query tile — no head expansion anywhere.  Forward-only
+by design (generation never differentiates through decode), so no custom
+VJP is needed.
+
+Validated in interpret mode (oracle: tests/test_flash_decode.py pins it to
+the XLA decode path bit-for-bit-close, including ragged pads); OFF by
+default (``LlamaConfig.decode_impl="xla"``) until a live-TPU Mosaic run
+confirms the (group, hd) sub-tile layouts — flip with
+``decode_impl="flash-decode"`` / ``bench_generate --decode-impl``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, _pick_block
+
+
+def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
+            *, block_k, scale, nr_k):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    pos = pos_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    @pl.when(j * block_k <= pos)
+    def _compute():
+        q = q_ref[0, 0]                    # (g, hd)
+        k = k_ref[0, :, 0, :]              # (block_k, hd)
+        v = v_ref[0, :, 0, :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        valid = (k_pos <= pos) & (k_pos >= pad_ref[b])
+        s = jnp.where(valid, s, NEG_INF)
+        m_old = m_scr[...]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_old - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1)
+        acc[...] = acc[...] * corr[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nr_k - 1)
+    def _final():
+        o_ref[0, 0] = (acc[...] / l_scr[...][:, None]).astype(o_ref.dtype)
+
+
+def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
+                           interpret: bool | None = None):
+    """One decode step against the cache, reading only live blocks.
+
+    ``q``: (B, Hq, hd) this step's queries; ``cache_k``/``cache_v``:
+    (B, S, Hkv, hd) with Hq a multiple of Hkv (GQA); ``pos``: scalar int32
+    current slot (rows ``<= pos`` are live); ``pad``: (B,) left-pad widths
+    for ragged batches (None = all zeros).  Returns (B, Hq, hd).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, Hq, hd = q.shape
+    _, S, Hkv, _ = cache_k.shape
+    g = Hq // Hkv
+    block_k = _pick_block(S)
+    nr_k = S // block_k
+    scale = 1.0 / (hd ** 0.5)
+    if pad is None:
+        pad = jnp.zeros((B,), jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32).reshape(1)
+    qg = q.reshape(B, Hkv, g, hd)
+
+    def live(j, pos_v):
+        # clamp dead trailing blocks to the last live one: repeated index
+        # -> the pipeline skips the DMA
+        return jnp.minimum(j, pos_v[0] // block_k)
+
+    # index maps receive (*grid_indices, *scalar_prefetch_refs)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, nr_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, hd),
+                         lambda b, h, j, pos_v, pad_v: (b, h, 0, 0)),
+            pl.BlockSpec((1, block_k, 1, hd),
+                         lambda b, h, j, pos_v, pad_v:
+                         (b, live(j, pos_v), h, 0)),
+            pl.BlockSpec((1, block_k, 1, hd),
+                         lambda b, h, j, pos_v, pad_v:
+                         (b, live(j, pos_v), h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, hd),
+                               lambda b, h, j, pos_v, pad_v: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g,), jnp.float32),
+            pltpu.VMEM((g,), jnp.float32),
+            pltpu.VMEM((g, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_k=block_k, scale=scale, nr_k=nr_k),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, g, hd), q.dtype),
+        interpret=interpret,
+    )(pos, jnp.asarray(pad, jnp.int32), qg, cache_k, cache_v)
+    return out.reshape(B, Hq, hd)

@@ -37,6 +37,10 @@ def main():
     ap.add_argument("--int8", action="store_true",
                     help="also measure each config with int8 matmul weights "
                          "(models/quant.py) — the weight-bandwidth A/B")
+    ap.add_argument("--decode-impl", default="xla",
+                    choices=["xla", "flash-decode"],
+                    help="flash-decode = Pallas kernel reading only live "
+                         "cache blocks (ops/flash_decode.py)")
     args = ap.parse_args()
 
     from ddl25spring_tpu.utils.platform import select_platform
@@ -57,6 +61,7 @@ def main():
 
     dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
     print(f"backend={jax.default_backend()} dtype={dt.__name__} "
+          f"decode={args.decode_impl} "
           f"dmodel={args.dmodel} layers={args.layers} ctx={args.ctx} "
           f"prompt={args.prompt} new={args.new_tokens}", flush=True)
     print(f"{'B':>3} {'kv_heads':>8} {'weights':>7} {'cache MB':>8} "
@@ -89,6 +94,7 @@ def main():
                 vocab_size=259, dmodel=args.dmodel, nr_heads=args.heads,
                 nr_kv_heads=0 if kvh == args.heads else kvh,
                 nr_layers=args.layers, ctx_size=args.ctx, dtype=dt,
+                decode_impl=args.decode_impl,
             )
             prompt = jnp.ones((B, args.prompt), jnp.int32)
             params = Llama(cfg).init(
