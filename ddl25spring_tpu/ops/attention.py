@@ -107,7 +107,17 @@ def ring_causal_attention(q, k, v, axis_name: str, *, precision=None):
         acc, k_blk, v_blk = carry
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        acc = accumulate(acc, k_blk, v_blk, (idx - step) % S)
+        src = (idx - step) % S
+        # blocks from later shards are fully invisible under causality:
+        # skip their einsums outright instead of burning FLOPs producing
+        # -inf logits (each device branches on its own src; the ppermute
+        # above still runs — the ring never stalls)
+        acc = jax.lax.cond(
+            src < idx,
+            lambda a: accumulate(a, k_blk, v_blk, src),
+            lambda a: a,
+            acc,
+        )
         return (acc, k_blk, v_blk), None
 
     (acc, _, _), _ = jax.lax.scan(body, (acc, k, v), jnp.arange(1, S))
