@@ -38,6 +38,7 @@ def generate(
     top_p: float = 1.0,
     key: jax.Array | None = None,
     prompt_lengths: jax.Array | None = None,
+    eos_id: int | None = None,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -49,6 +50,13 @@ def generate(
     the smallest nucleus whose cumulative probability reaches ``top_p``
     (1.0 = off) — both standard decode-time filters, applied k-then-p when
     combined.
+
+    ``eos_id`` (optional) ends a row's generation at that token: the EOS
+    itself is kept, every later slot in that row becomes pad (0).  Shapes
+    stay static — all ``max_new_tokens`` positions are always produced
+    (prefill emits the first, the scan the rest); finished rows just decode
+    into masked-out pads (the standard fixed-length batch-serving
+    semantic).
 
     **Ragged batches**: ``prompt_lengths`` (B,) marks each row's true prompt
     length; rows are right-padded in the input.  Internally every row is
@@ -92,7 +100,8 @@ def generate(
         # share one compiled program instead of fragmenting the LRU
         top_k, top_p = 0, 1.0
     decode = _decode_fn(config, T0, total, float(temperature), int(top_k),
-                        float(top_p))
+                        float(top_p),
+                        -1 if eos_id is None else int(eos_id))
     if prompt_lengths is None:
         return decode(params, prompt, key)
     prompt_left, pad = _left_align(prompt, T0, prompt_lengths)
@@ -138,7 +147,7 @@ def _filter_logits(logits, top_k: int, top_p: float):
 
 @functools.lru_cache(maxsize=16)
 def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
-               top_k: int, top_p: float):
+               top_k: int, top_p: float, eos_id: int = -1):
     """Compiled prefill+scan decoder, cached on (config, shape, sampling
     params) so repeated ``generate`` calls with the same geometry reuse the
     jitted program instead of rebuilding a fresh closure (and recompiling)
@@ -172,20 +181,24 @@ def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
             ).astype(prompt.dtype)
 
         first = pick(logits[:, -1], jax.random.fold_in(key, 0))
+        done = first == eos_id  # eos_id=-1 (off) never matches a token id
 
         def step(carry, i):
-            cache, tok = carry
+            cache, tok, done = carry
             logits, state = model.apply(
                 {**params, "cache": cache}, tok[:, None], i[None], pad,
                 mutable=["cache"],
             )
             nxt = pick(logits[:, -1], jax.random.fold_in(key, i))
-            return (state["cache"], nxt), tok
+            # rows past their EOS decode into pad (0); the EOS itself is
+            # kept because done is updated AFTER the overwrite
+            nxt = jnp.where(done, jnp.zeros_like(nxt), nxt)
+            return (state["cache"], nxt, done | (nxt == eos_id)), tok
 
         # prefill already produced the first generated token, so the scan
         # runs the remaining max_new_tokens - 1 steps
-        (_, last), toks = jax.lax.scan(
-            step, (cache, first), jnp.arange(T0, total - 1)
+        (_, last, _), toks = jax.lax.scan(
+            step, (cache, first, done), jnp.arange(T0, total - 1)
         )
         # toks holds the input token of each step: generated[0..n-2]; append
         # the final step's output to complete the n generated tokens

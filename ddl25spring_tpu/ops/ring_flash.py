@@ -14,8 +14,9 @@ granularity.  Construction:
 3. Each of the S-1 ring steps rotates KV one hop (``ppermute``) and — only
    when the arriving block is from an earlier shard, i.e. fully visible under
    causality — runs the FULL (unmasked) flash kernel.  Invisible blocks skip
-   the kernel entirely via ``lax.cond`` (the dense ring spends real FLOPs
-   producing -inf logits for them: ~2x compute saved at the ring level).
+   the kernel entirely via ``lax.cond`` (the dense ring now skips them the
+   same way; this variant's win over it is the kernel-grade block math and
+   O(Tl·d) memory instead of a dense (B, H, Tl, Tl) f32 logits block).
 4. Per-step partial results (o_blk, lse_blk) merge into the running result
    by the standard online log-sum-exp rule; gradients flow through o AND lse
    (the kernels' VJP handles the dlse term), so ``jax.grad`` of the whole

@@ -388,3 +388,70 @@ def test_int8_weight_only_inference():
     fp_bytes = nbytes(params, QUANT_KERNELS)
     q_bytes = nbytes(qparams, QUANT_KERNELS)
     assert q_bytes < 0.3 * fp_bytes, (q_bytes, fp_bytes)
+
+
+def test_generate_eos_stops_row():
+    """eos_id masks everything after a row's EOS to pad while other rows
+    keep decoding; eos_id=None (off) is unchanged."""
+    import numpy as np
+
+    from ddl25spring_tpu.models import generate
+
+    cfg = LlamaConfig(vocab_size=16, dmodel=16, nr_heads=2, nr_layers=1,
+                      ctx_size=24)
+    prompt = jax.random.randint(jax.random.key(40), (3, 4), 1, 16)
+    params = Llama(cfg).init(jax.random.key(41), prompt,
+                             positions=jnp.arange(4))
+    base = np.asarray(generate(cfg, params, prompt, 12))
+    gen = base[:, 4:]
+    # pick an eos id that actually occurs mid-stream in some row
+    eos = None
+    for tok_id in range(1, 16):
+        hits = [list(r).index(tok_id) for r in gen if tok_id in r]
+        if hits and any(h < gen.shape[1] - 1 for h in hits):
+            eos = tok_id
+            break
+    assert eos is not None, "test model never repeats a token; reseed"
+    out = np.asarray(generate(cfg, params, prompt, 12, eos_id=eos))[:, 4:]
+    for r_base, r in zip(gen, out):
+        if eos in r_base:
+            cut = list(r).index(eos)
+            assert (r[: cut + 1] == r_base[: cut + 1]).all()
+            assert (r[cut + 1:] == 0).all()  # pads after EOS
+        else:
+            np.testing.assert_array_equal(r, r_base)
+
+
+def test_generate_eos_with_ragged_prompts():
+    """eos_id composes with prompt_lengths: left-pad pads and post-EOS pads
+    coexist, and unfinished ragged rows decode exactly as without eos_id."""
+    import numpy as np
+
+    from ddl25spring_tpu.models import generate
+
+    cfg = LlamaConfig(vocab_size=16, dmodel=16, nr_heads=2, nr_layers=1,
+                      ctx_size=24)
+    prompt = jax.random.randint(jax.random.key(44), (3, 5), 1, 16)
+    lengths = jnp.asarray([2, 4, 5])
+    params = Llama(cfg).init(jax.random.key(45), prompt,
+                             positions=jnp.arange(5))
+    base = np.asarray(generate(cfg, params, prompt, 10,
+                               prompt_lengths=lengths))
+    gen = base[:, 5:]
+    eos = None
+    for tok_id in range(1, 16):
+        if any(tok_id in r and list(r).index(tok_id) < gen.shape[1] - 1
+               for r in gen):
+            eos = tok_id
+            break
+    assert eos is not None
+    out = np.asarray(generate(cfg, params, prompt, 10,
+                              prompt_lengths=lengths, eos_id=eos))
+    np.testing.assert_array_equal(out[:, :5], base[:, :5])  # prompt region
+    for r_base, r in zip(gen, out[:, 5:]):
+        if eos in r_base:
+            cut = list(r_base).index(eos)
+            assert (r[: cut + 1] == r_base[: cut + 1]).all()
+            assert (r[cut + 1:] == 0).all()
+        else:
+            np.testing.assert_array_equal(r, r_base)
