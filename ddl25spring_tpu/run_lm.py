@@ -473,8 +473,12 @@ def _sample_text(cfg: LmConfig, params, tok):
         temperature=cfg.generate_temperature,
         top_k=cfg.generate_top_k, top_p=cfg.generate_top_p,
         key=jax.random.key(cfg.seed),
+        eos_id=tok.eos_id,
     )
-    print("[generate]", repr(tok.decode([int(t) for t in out[0, 1:]])))
+    ids = [int(t) for t in out[0, 1:]]
+    if tok.eos_id in ids:  # drop the post-EOS pad tail from the printout
+        ids = ids[: ids.index(tok.eos_id) + 1]
+    print("[generate]", repr(tok.decode(ids)))
 
 
 def main(argv=None):
