@@ -78,3 +78,20 @@ def test_generation_with_flash_decode_matches_default():
         np.asarray(generate(cfg, params, prompt, 6, prompt_lengths=lengths)),
         np.asarray(generate(fcfg, params, prompt, 6, prompt_lengths=lengths)),
     )
+
+
+def test_flash_decode_head_grouping_matrix():
+    """Kernel vs einsum across the head-grouping spectrum: MHA (g=1),
+    GQA (g=2), MQA (one KV head serving all queries)."""
+    B, S, hd = 2, 32, 8
+    ks = jax.random.split(jax.random.key(7), 3)
+    for Hq, Hkv in ((4, 4), (4, 2), (4, 1)):
+        q = jax.random.normal(ks[0], (B, Hq, hd))
+        ck = jax.random.normal(ks[1], (B, S, Hkv, hd))
+        cv = jax.random.normal(ks[2], (B, S, Hkv, hd))
+        pad = jnp.asarray([0, 5])
+        np.testing.assert_allclose(
+            flash_decode_attention(q, ck, cv, 17, pad),
+            _xla_decode(q, ck, cv, 17, pad),
+            atol=1e-5, err_msg=f"Hq={Hq} Hkv={Hkv}",
+        )

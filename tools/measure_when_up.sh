@@ -27,6 +27,10 @@ EOF
     timeout 2400 python examples/bench_flash.py --check \
       > results/flash_tpu.txt 2>> "$LOG"
     echo "$(date +%H:%M:%S) flash bench done (exit $?)" >> "$LOG"
+    timeout 1200 python examples/bench_flash.py --check --head-dim 128 \
+      --seq-lens 2048,8192 \
+      > results/flash_tpu_hd128.txt 2>> "$LOG"
+    echo "$(date +%H:%M:%S) flash hd128 done (exit $?)" >> "$LOG"
     timeout 1200 python examples/bench_generate.py --int8 \
       > results/generate_tpu.txt 2>> "$LOG"
     echo "$(date +%H:%M:%S) generate bench done (exit $?)" >> "$LOG"
