@@ -1,9 +1,12 @@
 #!/bin/bash
 # Wait for the remote TPU tunnel, then capture the round's measurement
-# battery exactly once:
+# battery exactly once, must-have first (the tunnel can wedge mid-battery —
+# round 2 lost its whole window that way):
 #   1. north-star bench (flax GroupNorm)      -> results/bench_tpu.json
 #   2. north-star bench (lean GroupNorm A/B)  -> results/bench_tpu_lean.json
-#   3. flash-attention microbench (+numerics) -> results/flash_tpu.txt
+#   3. Pallas kernel validation (Mosaic)      -> results/tpu_validate.txt
+#   4. flash-attention microbench (+numerics) -> results/flash_tpu.txt (+hd128)
+#   5. generation tokens/sec grid             -> results/generate_tpu.txt
 # Stops the tpu_watch prober first so nothing else talks to the single-tenant
 # chip mid-measurement.  Logs to /tmp/measure.log.
 cd /root/repo || exit 1
@@ -19,21 +22,24 @@ EOF
     pkill -f tpu_watch.sh 2>/dev/null
     sleep 2
     timeout 1800 python bench.py --deadline-s 900 \
-      > results/bench_tpu.json 2>> "$LOG"
-    echo "$(date +%H:%M:%S) bench flax done (exit $?)" >> "$LOG"
+      > results/bench_tpu.json 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) bench flax done (exit $rc)" >> "$LOG"
     timeout 1800 python bench.py --deadline-s 900 --norm-impl lean \
-      > results/bench_tpu_lean.json 2>> "$LOG"
-    echo "$(date +%H:%M:%S) bench lean done (exit $?)" >> "$LOG"
+      > results/bench_tpu_lean.json 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) bench lean done (exit $rc)" >> "$LOG"
+    timeout 2400 python tools/tpu_validate.py \
+      > results/tpu_validate.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) kernel validation done (exit $rc)" >> "$LOG"
     timeout 2400 python examples/bench_flash.py --check \
-      > results/flash_tpu.txt 2>> "$LOG"
-    echo "$(date +%H:%M:%S) flash bench done (exit $?)" >> "$LOG"
+      > results/flash_tpu.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) flash bench done (exit $rc)" >> "$LOG"
     timeout 1200 python examples/bench_flash.py --check --head-dim 128 \
       --seq-lens 2048,8192 \
-      > results/flash_tpu_hd128.txt 2>> "$LOG"
-    echo "$(date +%H:%M:%S) flash hd128 done (exit $?)" >> "$LOG"
+      > results/flash_tpu_hd128.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) flash hd128 done (exit $rc)" >> "$LOG"
     timeout 1200 python examples/bench_generate.py --int8 \
-      > results/generate_tpu.txt 2>> "$LOG"
-    echo "$(date +%H:%M:%S) generate bench done (exit $?)" >> "$LOG"
+      > results/generate_tpu.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) generate bench done (exit $rc)" >> "$LOG"
     nohup /root/repo/tools/tpu_watch.sh >/dev/null 2>&1 &
     echo "$(date +%H:%M:%S) sentinel finished" >> "$LOG"
     exit 0
