@@ -70,6 +70,15 @@ def quantize_llama_params(params):
             if name in QUANT_KERNELS and isinstance(sub, dict) \
                     and "kernel" in sub:
                 w = jnp.asarray(sub["kernel"], jnp.float32)
+                if w.ndim != 2:
+                    # name matching alone is too loose a key: a future tree
+                    # reusing one of these names for a non-matmul param
+                    # must fail here, not load garbage into QuantDense
+                    raise ValueError(
+                        f"quantize_llama_params: param {name!r} has shape "
+                        f"{w.shape}; expected a 2-D matmul kernel — the "
+                        f"tree does not look like a Llama param tree"
+                    )
                 scale = jnp.maximum(
                     jnp.max(jnp.abs(w), axis=0), 1e-8
                 ) / 127.0

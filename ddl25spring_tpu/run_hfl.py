@@ -237,7 +237,9 @@ def run(cfg: HflConfig):
         print(f"[dp] client-level privacy spent: ε = {eps:.3f} at "
               f"δ = {cfg.dp_delta:g} (σ = {cfg.dp_noise_mult}, "
               f"q = {q:.4g}, {cfg.nr_rounds} rounds; "
-              f"RDP accountant, fl/privacy.py)")
+              f"RDP accountant, fl/privacy.py — Poisson-subsampling "
+              f"approximation: the engine samples a FIXED-SIZE subset, so "
+              f"ε can be optimistic under replace-one adjacency)")
 
     if logger is not None:
         logger.close()

@@ -455,3 +455,33 @@ def test_generate_eos_with_ragged_prompts():
             assert (r[cut + 1:] == 0).all()
         else:
             np.testing.assert_array_equal(r, r_base)
+
+
+def test_generate_rejects_out_of_range_prompt_lengths():
+    """Advisor r2: out-of-range lengths must raise, not silently clamp into
+    shifted/duplicated rows (models/generate.py host-side check)."""
+    import pytest
+
+    from ddl25spring_tpu.models import generate
+
+    cfg = LlamaConfig(vocab_size=16, dmodel=16, nr_heads=2, nr_layers=1,
+                      ctx_size=24)
+    prompt = jax.random.randint(jax.random.key(7), (2, 5), 1, 16)
+    params = Llama(cfg).init(jax.random.key(8), prompt,
+                             positions=jnp.arange(5))
+    for bad in ([0, 5], [3, 6], [-1, 2]):
+        with pytest.raises(ValueError, match="prompt_lengths"):
+            generate(cfg, params, prompt, 4,
+                     prompt_lengths=jnp.asarray(bad))
+
+
+def test_quantize_rejects_non_matmul_kernels():
+    """Advisor r2: name-keyed quantization must fail loudly on a tree whose
+    matching names are not 2-D matmul kernels (models/quant.py)."""
+    import pytest
+
+    from ddl25spring_tpu.models.quant import quantize_llama_params
+
+    tree = {"params": {"layer": {"wq": {"kernel": jnp.ones((2, 3, 4))}}}}
+    with pytest.raises(ValueError, match="2-D matmul kernel"):
+        quantize_llama_params(tree)
