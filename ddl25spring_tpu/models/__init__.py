@@ -1,7 +1,7 @@
 from .cnn import MnistCnn
 from .mlp import HeartDiseaseNN
 from .resnet import BasicBlock, ResNet, ResNet18
-from .moe import MoEMLP
+from .moe import CapacityMoEMLP, MoEMLP, capacity_route, expert_capacity
 from .vae import TabularVAE, MLPEncoder, MLPDecoder, vae_loss, reparameterize
 from .llama import (
     Llama,
@@ -26,6 +26,9 @@ __all__ = [
     "ResNet",
     "ResNet18",
     "MoEMLP",
+    "CapacityMoEMLP",
+    "capacity_route",
+    "expert_capacity",
     "TabularVAE",
     "MLPEncoder",
     "MLPDecoder",

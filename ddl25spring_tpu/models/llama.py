@@ -48,6 +48,11 @@ class LlamaConfig:
     #                            nr_heads for the attention math
     nr_experts: int = 0        # 0 = dense SwiGLU MLP; >0 = top-k MoE
     expert_topk: int = 2
+    moe_dispatch: str = "dense"  # dense (every expert sees every token,
+    #                              mask zeroes the rest) | capacity
+    #                              (GShard: per-expert token budget,
+    #                              over-capacity tokens dropped+accounted)
+    moe_capacity_factor: float = 1.25  # capacity dispatch only
     remat: bool = False        # rematerialize blocks in backward (HBM ↓, FLOPs ↑)
     decode: bool = False       # KV-cache autoregressive decoding (models.generate)
     weights_int8: bool = False  # serving: matmul kernels stored int8 with
@@ -75,6 +80,11 @@ class LlamaConfig:
             raise ValueError(
                 f"decode_impl={self.decode_impl!r} not in ('xla', "
                 "'flash-decode')"
+            )
+        if self.moe_dispatch not in ("dense", "capacity"):
+            raise ValueError(
+                f"moe_dispatch={self.moe_dispatch!r} not in ('dense', "
+                "'capacity')"
             )
         if self.weights_int8 and self.nr_experts:
             raise ValueError(
@@ -282,7 +292,14 @@ class Block(nn.Module):
         )
         h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
         if cfg.nr_experts:
-            from .moe import MoEMLP  # local import avoids a module cycle
+            # local imports avoid a module cycle
+            if cfg.moe_dispatch == "capacity":
+                from .moe import CapacityMoEMLP
+
+                return x + CapacityMoEMLP(
+                    cfg, cfg.nr_experts, cfg.expert_topk,
+                    cfg.moe_capacity_factor, name="moe")(h)
+            from .moe import MoEMLP
 
             return x + MoEMLP(cfg, cfg.nr_experts, cfg.expert_topk,
                               name="moe")(h)

@@ -12,14 +12,25 @@ EP).  Construction (standard public top-k MoE, Shazeer et al.):
   annotation ``P("expert")`` on the stacked params: XLA partitions the
   expert einsums across the mesh and inserts the combine reduction.
 
-This is the *dense-dispatch* formulation: every expert processes every token
-and the top-k mask zeroes the rest.  It trades FLOPs (E/k× the sparse
-dispatch) for zero host-side gather/scatter and perfect static shapes — the
-right starting point on TPU, where einsums ride the MXU; a capacity-based
-sparse dispatch is a later optimisation behind the same module interface.
+Two dispatch formulations share one parameter layout (trees interchange):
+
+- :class:`MoEMLP` — *dense dispatch*: every expert processes every token and
+  the top-k mask zeroes the rest.  Trades FLOPs (E/k× the sparse dispatch)
+  for zero gather/scatter and perfect static shapes — the right starting
+  point on TPU, where einsums ride the MXU.
+- :class:`CapacityMoEMLP` — *capacity dispatch* (GShard/Switch): each expert
+  processes at most ``capacity`` tokens; beyond-capacity tokens are DROPPED
+  (their MoE contribution is zero — the Block's residual passes them
+  through).  Still static shapes: routing builds one-hot ``(N, E, C)``
+  dispatch/combine tensors, so compute per expert is bounded at
+  ``C = ceil(cf · N · k / E)`` whatever the routing skew — the formulation
+  that scales to E ≫ devices and feeds the explicit all-to-all EP path
+  (parallel/ep.py::moe_all_to_all).
 """
 
 from __future__ import annotations
+
+import math
 
 import flax.linen as nn
 import jax
@@ -83,6 +94,110 @@ class MoEMLP(nn.Module):
             preferred_element_type=jnp.float32,
         )
         return out.astype(x.dtype)
+
+
+def expert_capacity(nr_tokens: int, nr_experts: int, topk: int,
+                    capacity_factor: float) -> int:
+    """Per-expert token budget: ``ceil(cf · N · k / E)``, at least 1.
+
+    ``cf = 1`` holds exactly the uniform-routing load; the conventional
+    1.25-2 headroom absorbs routing skew before drops start.
+    """
+    return max(1, math.ceil(capacity_factor * nr_tokens * topk / nr_experts))
+
+
+def capacity_route(probs, topk: int, capacity: int):
+    """GShard-style capacity-bounded top-k routing (all shapes static).
+
+    ``probs`` (N, E) router softmax -> ``(dispatch, combine, nr_dropped)``:
+    ``dispatch`` (N, E, C) is 0/1 — token n occupies slot c of expert e;
+    ``combine`` is ``dispatch`` scaled by the renormalised top-k gate;
+    ``nr_dropped`` counts (token, choice) assignments that found their
+    expert full.
+
+    Priority is the standard two-level order (mesh-tf/gshard moe — public
+    construction): ALL first choices are placed before any second choice
+    (a token's k-th pick can't evict another's (k-1)-th), and within a
+    level earlier tokens win.  Per level: rank token attempts per expert
+    with a cumsum, keep ranks under the remaining capacity, and offset the
+    next level by the KEPT counts so dropped attempts never waste slots.
+    """
+    N, E = probs.shape
+    top_v, top_i = jax.lax.top_k(probs, topk)
+    top_v = top_v / jnp.sum(top_v, axis=-1, keepdims=True)
+
+    offset = jnp.zeros((E,), jnp.int32)
+    dispatch = jnp.zeros((N, E, capacity), probs.dtype)
+    combine = jnp.zeros((N, E, capacity), probs.dtype)
+    kept_total = jnp.int32(0)
+    for j in range(topk):  # k is small and static — unrolled
+        mask = jax.nn.one_hot(top_i[:, j], E, dtype=jnp.int32)    # (N, E)
+        pos = (jnp.cumsum(mask, axis=0) - 1) + offset[None, :]    # (N, E)
+        keep = mask * (pos < capacity)                            # (N, E)
+        offset = offset + jnp.sum(keep, axis=0)
+        kept_total = kept_total + jnp.sum(keep)
+        slot = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)   # (N, E, C)
+        slot = slot * keep[..., None].astype(probs.dtype)
+        dispatch = dispatch + slot
+        combine = combine + slot * top_v[:, j][:, None, None]
+    return dispatch, combine, topk * N - kept_total
+
+
+class CapacityMoEMLP(nn.Module):
+    """Capacity-bounded top-k MoE — parameter-compatible with MoEMLP.
+
+    Per-expert work is bounded at ``capacity`` tokens; over-capacity tokens
+    contribute zero (the caller's residual carries them).  Sows
+    ``router_probs`` (for :func:`moe_aux_load`) and ``dropped_fraction``
+    (dropped assignments / k·N) so trainers can watch routing health.
+    """
+
+    config: LlamaConfig
+    nr_experts: int
+    topk: int = 2
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        E, k = self.nr_experts, self.topk
+        if k > E:
+            raise ValueError(
+                f"expert_topk={k} exceeds nr_experts={E}; need topk <= E"
+            )
+        D, H = cfg.dmodel, cfg.hidden_dim
+        dt = cfg.dtype
+        B, T, _ = x.shape
+        N = B * T
+
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)                  # (B,T,E)
+        self.sow("intermediates", "router_probs", probs)
+
+        C = expert_capacity(N, E, k, self.capacity_factor)
+        dispatch, combine, dropped = capacity_route(
+            probs.reshape(N, E), k, C
+        )
+        self.sow("intermediates", "dropped_fraction",
+                 dropped.astype(jnp.float32) / (k * N))
+
+        init = nn.initializers.lecun_normal(batch_axis=0)
+        w1 = self.param("w1", init, (E, D, H)).astype(dt)
+        w3 = self.param("w3", init, (E, D, H)).astype(dt)
+        w2 = self.param("w2", init, (E, H, D)).astype(dt)
+
+        xe = jnp.einsum("nec,nd->ecd", dispatch.astype(dt),
+                        x.reshape(N, D).astype(dt))              # (E,C,D)
+        y = jnp.einsum(
+            "ech,ehd->ecd",
+            nn.silu(jnp.einsum("ecd,edh->ech", xe, w1))
+            * jnp.einsum("ecd,edh->ech", xe, w3),
+            w2,
+        )                                                        # (E,C,D)
+        out = jnp.einsum("nec,ecd->nd", combine.astype(dt), y,
+                         preferred_element_type=jnp.float32)
+        return out.reshape(B, T, D).astype(x.dtype)
 
 
 def moe_aux_load(params_or_intermediates):

@@ -7,7 +7,7 @@ from .pp import (
     make_pp_train_step,
 )
 from .tp import llama_tp_shardings, apply_shardings
-from .ep import llama_moe_ep_shardings
+from .ep import apply_moe_all_to_all, llama_moe_ep_shardings, moe_all_to_all
 from .compress import (
     init_compression_state,
     make_compressed_dp_train_step,
@@ -46,6 +46,8 @@ __all__ = [
     "make_pp_train_step",
     "llama_tp_shardings",
     "llama_moe_ep_shardings",
+    "apply_moe_all_to_all",
+    "moe_all_to_all",
     "apply_shardings",
     "init_compression_state",
     "make_compressed_dp_train_step",

@@ -1,16 +1,32 @@
 """Expert parallelism (EP): shard stacked MoE expert kernels over the mesh.
 
 The reference has no MoE/EP at all (SURVEY.md §2.2); this completes the
-DP/PP/TP/SP/EP parallelism matrix.  :class:`~ddl25spring_tpu.models.moe.MoEMLP`
-stacks its expert kernels on a leading ``(E, ...)`` axis and expresses expert
-compute as einsums carrying ``E``, so EP is purely a sharding annotation:
-``P("expert")`` on those kernels lets GSPMD partition the expert einsums
-across devices and insert the combine all-reduce over ICI.
+DP/PP/TP/SP/EP parallelism matrix.  Two complementary EP designs:
+
+1. **GSPMD einsum path** (:func:`llama_moe_ep_shardings`):
+   :class:`~ddl25spring_tpu.models.moe.MoEMLP` stacks expert kernels on a
+   leading ``(E, ...)`` axis and carries ``E`` through its einsums, so EP is
+   purely a sharding annotation — ``P("expert")`` on the stacked kernels
+   lets GSPMD partition the expert compute and insert the combine
+   all-reduce.  Zero routing logic, but with dense dispatch every device
+   still touches every token (activations are replicated over the expert
+   axis), so activation traffic grows with E.
+
+2. **Explicit all-to-all path** (:func:`moe_all_to_all`): tokens are
+   sharded over the expert axis; each device routes its LOCAL tokens,
+   packs capacity-bounded per-expert send buffers, and one
+   ``lax.all_to_all`` delivers every token to the device owning its
+   expert (a second one brings outputs home).  Per-device work and ICI
+   traffic are bounded at ``C = ceil(cf · n_local · k / E)`` tokens per
+   expert regardless of routing skew — the formulation that scales to
+   E ≫ devices and long sequences, at the price of token drops when an
+   expert overflows (accounted, never silent).
 """
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 
 def llama_moe_ep_shardings(mesh, params, expert_axis: str = "expert"):
@@ -41,3 +57,108 @@ def llama_moe_ep_shardings(mesh, params, expert_axis: str = "expert"):
         return repl
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def moe_all_to_all(x_local, router_kernel, w1, w2, w3, axis_name: str, *,
+                   topk: int = 2, capacity_factor: float = 1.25):
+    """Capacity-bounded MoE forward with explicit all-to-all dispatch.
+
+    Call INSIDE ``shard_map`` over the ``axis_name`` mesh axis (size S):
+    ``x_local`` (n_local, D) is this device's token shard; ``w1``/``w3``
+    (E_local, D, H) and ``w2`` (E_local, H, D) are its expert slices
+    (E = S·E_local); ``router_kernel`` (D, E) is replicated.  Returns
+    ``(out, nr_dropped)`` — out (n_local, D) is the combined expert output
+    for the local tokens (zero rows for dropped assignments; the caller's
+    residual carries them), nr_dropped counts this device's dropped
+    (token, choice) assignments (psum it for the global figure).
+
+    Wire protocol: per-sender capacity ``C = ceil(cf · n_local · k / E)``;
+    send buffer (S, E_local, C, D) -> ``all_to_all`` -> each device holds
+    (S senders × E_local experts × C, D), runs its SwiGLU experts on
+    S·C-token batches, and the reverse ``all_to_all`` returns outputs to
+    the token owners.  Everything is static-shaped; skew never inflates a
+    buffer, it only drops (accounted) assignments.
+
+    vs the GSPMD einsum path: this moves ``2 · k-ish · n_local · D`` bytes
+    per device over ICI instead of replicating every activation to every
+    expert shard, and bounds per-expert compute at C — the trade documented
+    in the module docstring.
+    """
+    from ddl25spring_tpu.models.moe import capacity_route, expert_capacity
+
+    S = jax.lax.psum(1, axis_name)
+    E_local, D, H = w1.shape
+    E = E_local * S
+    n_local = x_local.shape[0]
+
+    logits = x_local.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                      # (n, E)
+    C = expert_capacity(n_local, E, topk, capacity_factor)
+    dispatch, combine, dropped = capacity_route(probs, topk, C)
+
+    dt = x_local.dtype
+    send = jnp.einsum("nec,nd->ecd", dispatch.astype(dt), x_local)
+    send = send.reshape(S, E_local, C, D)
+    recv = jax.lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0)
+    xe = recv.transpose(1, 0, 2, 3).reshape(E_local, S * C, D)
+
+    import flax.linen as nn
+
+    y = jnp.einsum(
+        "ech,ehd->ecd",
+        nn.silu(jnp.einsum("ecd,edh->ech", xe, w1))
+        * jnp.einsum("ecd,edh->ech", xe, w3),
+        w2,
+    )                                                            # (El,S*C,D)
+    y = y.reshape(E_local, S, C, D).transpose(1, 0, 2, 3)
+    back = jax.lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0)
+    y_home = back.reshape(E, C, D)
+    out = jnp.einsum("nec,ecd->nd", combine.astype(dt), y_home,
+                     preferred_element_type=jnp.float32)
+    return out.astype(x_local.dtype), dropped
+
+
+def apply_moe_all_to_all(mesh, params, x, *, topk: int = 2,
+                         capacity_factor: float = 1.25,
+                         expert_axis: str = "expert"):
+    """Run :func:`moe_all_to_all` over a mesh from a MoEMLP param tree.
+
+    ``params`` is the ``{"params": {router: {kernel}, w1, w2, w3}}`` tree of
+    :class:`~ddl25spring_tpu.models.moe.MoEMLP` /
+    :class:`~ddl25spring_tpu.models.moe.CapacityMoEMLP` (full, unsharded);
+    ``x`` (B, T, D).  Tokens are sharded over ``expert_axis`` (B·T must
+    divide by the axis size), expert kernels are split over the same axis
+    (E must divide), the router is replicated.  Returns
+    ``(out (B, T, D), nr_dropped)`` with the global drop count.
+    """
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    p = params["params"] if "params" in params else params
+    router = p["router"]["kernel"]
+    w1, w2, w3 = p["w1"], p["w2"], p["w3"]
+    S = mesh.shape[expert_axis]
+    B, T, D = x.shape
+    if (B * T) % S or w1.shape[0] % S:
+        raise ValueError(
+            f"tokens ({B * T}) and experts ({w1.shape[0]}) must both "
+            f"divide the {expert_axis!r} axis size {S}"
+        )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(expert_axis), P(), P(expert_axis), P(expert_axis),
+                  P(expert_axis)),
+        out_specs=(P(expert_axis), P()),
+    )
+    def run(xs, router, w1, w2, w3):
+        out, dropped = moe_all_to_all(
+            xs, router, w1, w2, w3, expert_axis,
+            topk=topk, capacity_factor=capacity_factor,
+        )
+        return out, jax.lax.psum(dropped, expert_axis)
+
+    out, dropped = run(x.reshape(B * T, D), router, w1, w2, w3)
+    return out.reshape(B, T, D), dropped
