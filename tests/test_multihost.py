@@ -69,3 +69,28 @@ def test_dp_program_runs_on_multihost_layout():
 
     out = mean_sq(x)
     assert jnp.allclose(out, jnp.mean(jnp.arange(32.0) ** 2))
+
+
+def test_two_process_distributed_dryrun():
+    """The REAL multi-process path (VERDICT r2 #5): two coordinator-connected
+    processes x 4 virtual CPU devices run one DP step over the
+    ('dcn', 'data') mesh — rendezvous via initialize_multihost's env-var
+    path, a psum that crosses the process boundary (explicit and
+    autodiff-inserted), and bit-identical replicated params afterwards.
+    Delegates to tools/multihost_dryrun.py (subprocesses: the coordination
+    service can't run twice in one interpreter)."""
+    import pathlib
+    import subprocess
+    import sys
+
+    script = (pathlib.Path(__file__).parent.parent / "tools"
+              / "multihost_dryrun.py")
+    env = {k: v for k, v in __import__("os").environ.items()
+           if k not in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                        "JAX_PROCESS_ID")}
+    out = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("MULTIHOST-OK") == 2, out.stdout
