@@ -136,6 +136,10 @@ class LmConfig:
     tokenizer: str = "byte"    # byte | bpe (SentencePiece-equivalent)
     bpe_vocab_size: int = 1024  # bpe: target vocab (specials+bytes+merges)
     bpe_train_stories: int = 500  # bpe: corpus prefix used for training
+    real_corpus_required: bool = False  # refuse the synthetic-story
+    #                            fallback: only real-TinyStories numbers are
+    #                            comparable to the reference trajectories
+    #                            (lab/Abgabe/outputs/out_MB2.txt)
     seed: int = 0
     # harness (same crash-safe pattern as HflConfig)
     checkpoint_dir: str | None = None

@@ -335,6 +335,16 @@ def run(cfg: LmConfig, log_every: int = 10, metrics_path=None):
     from .data.text import load_stories
 
     stories = load_stories(cfg.seed)
+    if cfg.real_corpus_required:
+        from .data.text import SyntheticStories
+
+        if isinstance(stories, SyntheticStories):
+            raise FileNotFoundError(
+                "real_corpus_required: no tinystories.txt under "
+                "DDL25_DATA_DIR (ingest with tools/fetch_data.py) — "
+                "synthetic-corpus losses are not comparable to the "
+                "reference trajectories"
+            )
     tok = _tokenizer(cfg, stories)
     vocab = tok.vocab_size if tok is not None else BASE_VOCAB
     step, params, opt_state, shard = build_trainer(cfg, vocab)

@@ -36,8 +36,12 @@ from ddl25spring_tpu.fl import (  # noqa: E402
 from ddl25spring_tpu.fl.task import mnist_task  # noqa: E402
 
 
+REQUIRE_REAL = False  # set by --real-data-required: fail loudly instead of
+#                       silently falling back to the synthetic corpus
+
+
 def setup(nr_clients, iid, seed, pad=1):
-    ds = load_mnist()
+    ds = load_mnist(synthetic_fallback=not REQUIRE_REAL)
     task = mnist_task(ds.test_x, ds.test_y)
     data = split_dataset(ds.train_x, ds.train_y, nr_clients, iid, seed,
                          pad_multiple=pad)
@@ -139,7 +143,13 @@ if __name__ == "__main__":
     ap.add_argument("--part", default="all")
     ap.add_argument("--plot-dir", default=None,
                     help="write the reference's convergence figures here")
+    ap.add_argument("--real-data-required", action="store_true",
+                    help="refuse the synthetic-MNIST fallback: raise "
+                         "DatasetUnavailable unless real MNIST is ingested "
+                         "(tools/fetch_data.py) — the mode whose numbers "
+                         "are comparable to homework-1.ipynb cell 22")
     args = ap.parse_args()
+    REQUIRE_REAL = args.real_data_required
     rounds = 3 if args.quick else None
     if args.part in ("A1", "all"):
         part_a1(rounds or 5)

@@ -60,3 +60,90 @@ def test_a3_noniid_degrades(mnist):
         "IID should not trail the 2-shard non-IID split "
         f"(IID {iid.test_accuracy[-1]} vs non-IID {non.test_accuracy[-1]})"
     )
+
+
+# --- absolute-accuracy parity vs the instructor table (real MNIST only) ----
+
+# homework-1.ipynb cell 22 ground truth (N, C) -> (FedSGD %, FedAvg %,
+# message count), defaults N=100,C=0.1,E=1,B=100,lr=0.01,seed=10, 10 rounds
+REFERENCE_A2 = {
+    (10, 0.1): (43.23, 93.22, 20),
+    (50, 0.1): (43.11, 87.93, 100),
+    (100, 0.1): (43.17, 81.33, 200),
+    (100, 0.01): (41.90, 73.41, 20),
+    (100, 0.2): (42.88, 81.92, 400),
+}
+# Tolerance: the reference's own numbers move a couple of points across
+# seeds/frameworks (different init RNG, shuffle order, torch vs jax conv
+# defaults); 3.5 points catches any real regression (synthetic-fallback
+# numbers differ by >15) while not flaking on legitimate RNG drift.
+A2_TOL = 3.5
+
+
+def _real_mnist_or_skip():
+    from ddl25spring_tpu.data.mnist import DatasetNotFound
+
+    try:
+        ds = load_mnist(synthetic_fallback=False)
+    except DatasetNotFound:
+        pytest.skip(
+            "=== real MNIST absent: absolute-accuracy parity vs "
+            "homework-1.ipynb cell 22 NOT verified (orderings are, above). "
+            "Ingest real data with tools/fetch_data.py to arm this "
+            "assertion. ==="
+        )
+    return ds
+
+
+@pytest.mark.slow  # 5 configs x 10 rounds x full MNIST — assert-mode tier
+def test_a2_absolute_accuracy_matches_reference_table():
+    """VERDICT r2 #4: when real MNIST is present this asserts the actual
+    instructor numbers (within A2_TOL points) and exact message counts;
+    when absent it SKIPS with a banner instead of green-washing."""
+    ds = _real_mnist_or_skip()
+    rounds = 10
+    for (n, c), (ref_sgd, ref_avg, ref_msgs) in REFERENCE_A2.items():
+        task = mnist_task(ds.test_x, ds.test_y)
+        data = split_dataset(ds.train_x, ds.train_y, n, True, seed=10)
+        sgd = FedSgdGradientServer(task, 0.01, data, c, seed=10).run(rounds)
+        task2 = mnist_task(ds.test_x, ds.test_y)
+        data2 = split_dataset(ds.train_x, ds.train_y, n, True, seed=10,
+                              pad_multiple=100)
+        avg = FedAvgServer(task2, 0.01, 100, data2, c, 1, seed=10).run(rounds)
+        assert avg.message_count[-1] == ref_msgs, (n, c)
+        assert abs(sgd.test_accuracy[-1] - ref_sgd) <= A2_TOL, (
+            f"FedSGD N={n} C={c}: {sgd.test_accuracy[-1]:.2f}% vs "
+            f"reference {ref_sgd}% (tol {A2_TOL})"
+        )
+        assert abs(avg.test_accuracy[-1] - ref_avg) <= A2_TOL, (
+            f"FedAvg N={n} C={c}: {avg.test_accuracy[-1]:.2f}% vs "
+            f"reference {ref_avg}% (tol {A2_TOL})"
+        )
+
+
+@pytest.mark.slow  # arm-on-data LM anchor; skips instantly without corpus
+def test_lm_real_corpus_parity_anchor():
+    """VERDICT r2 #7: with real TinyStories ingested, the primer-matched
+    config must reproduce the reference's early trajectory shape (start
+    near ln(vocab) ~ 3.5-8.3 for bpe-4096, fall >25% within 300 iters —
+    out_MB2.txt falls 3.513 -> ~2.7 in its first log window).  Without the
+    corpus: skip with a banner, never a synthetic look-alike number."""
+    from ddl25spring_tpu.configs import LmConfig
+    from ddl25spring_tpu.data.text import SyntheticStories, load_stories
+    from ddl25spring_tpu.run_lm import run
+
+    if isinstance(load_stories(0), SyntheticStories):
+        pytest.skip(
+            "=== real TinyStories absent: LM loss parity vs "
+            "lab/Abgabe/outputs/out_MB2.txt NOT verified. Ingest "
+            "tinystories.txt via tools/fetch_data.py, then run "
+            "tools/lm_parity.py for the full matched-config row. ==="
+        )
+    losses = run(
+        LmConfig(strategy="single", batch_size=3, seq_l=256, dmodel=288,
+                 nr_heads=6, nr_layers=6, nr_iters=300, tokenizer="bpe",
+                 bpe_vocab_size=4096, real_corpus_required=True),
+        log_every=100,
+    )
+    assert losses[0] < 9.0
+    assert losses[-1] < 0.75 * losses[0]

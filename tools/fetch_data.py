@@ -1,0 +1,241 @@
+"""One-command real-dataset ingest into ``$DDL25_DATA_DIR``.
+
+The container is zero-egress, so this tool cannot download anything; what it
+CAN do is normalise real datasets from wherever they get mounted into the
+one layout every loader checks first (``$DDL25_DATA_DIR``, default
+``~/.cache/ddl25spring``):
+
+- **MNIST**  <- torchvision ``MNIST/raw`` idx files (plain or .gz), a
+  ``mnist.npz``, or loose ``train-images-idx3-ubyte``-style files
+  -> ``mnist.npz`` {train_x, train_y, test_x, test_y} (uint8)
+- **CIFAR-10** <- ``cifar-10-batches-py`` (torchvision pickle batches), a
+  ``cifar-10-python.tar.gz``, or a ``cifar10.npz`` -> ``cifar10.npz``
+- **TinyStories** <- ``tinystories.txt`` / ``TinyStories*.txt`` (the
+  simplellm corpus, reference lab/requirements.txt:9) -> ``tinystories.txt``
+
+Each dataset is shape-validated before it is written (60k/10k MNIST 28x28,
+50k/10k CIFAR 32x32x3) so a truncated mount can never masquerade as ground
+truth.  Re-running is idempotent (skips what the target already has).
+
+Run:  python tools/fetch_data.py [--source DIR ...] [--require mnist,...]
+      --require exits 1 unless every named dataset landed — wire it before
+      an assert-mode homework run (examples/homework1.py --real-data-required)
+      so the pipeline fails at ingest, not mid-experiment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+import tarfile
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from ddl25spring_tpu.data.mnist import (  # noqa: E402
+    _read_idx_images,
+    _read_idx_labels,
+)
+
+MNIST_STEMS = {
+    "train_x": "train-images-idx3-ubyte",
+    "train_y": "train-labels-idx1-ubyte",
+    "test_x": "t10k-images-idx3-ubyte",
+    "test_y": "t10k-labels-idx1-ubyte",
+}
+
+
+def default_sources():
+    for p in (
+        os.environ.get("DDL25_DATA_SRC"),
+        "/root/data",
+        "/data",
+        "/mnt/data",
+        str(Path.home() / "data"),
+        str(Path.home() / "Downloads"),
+        "./data",
+    ):
+        if p:
+            yield Path(p)
+
+
+def _find_mnist(src: Path):
+    """-> dict of arrays or None."""
+    npz = None
+    for cand in (src / "mnist.npz", src / "MNIST" / "mnist.npz"):
+        if cand.exists():
+            npz = cand
+            break
+    if npz is not None:
+        d = np.load(npz)
+        if all(k in d for k in MNIST_STEMS):
+            return {k: d[k] for k in MNIST_STEMS}
+    for idx_dir in (src / "MNIST" / "raw", src / "mnist", src):
+        found = {}
+        for key, stem in MNIST_STEMS.items():
+            for suffix in ("", ".gz"):
+                p = idx_dir / (stem + suffix)
+                if p.exists():
+                    found[key] = p
+                    break
+        if len(found) == 4:
+            return {
+                "train_x": _read_idx_images(found["train_x"]),
+                "train_y": _read_idx_labels(found["train_y"]),
+                "test_x": _read_idx_images(found["test_x"]),
+                "test_y": _read_idx_labels(found["test_y"]),
+            }
+    return None
+
+
+def _cifar_from_batches(batch_dir: Path):
+    def load_batch(p):
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x, np.array(d[b"labels"], dtype=np.uint8)
+
+    xs, ys = zip(*[load_batch(batch_dir / f"data_batch_{i}")
+                   for i in range(1, 6)])
+    test_x, test_y = load_batch(batch_dir / "test_batch")
+    return {
+        "train_x": np.concatenate(xs),
+        "train_y": np.concatenate(ys),
+        "test_x": test_x,
+        "test_y": test_y,
+    }
+
+
+def _find_cifar(src: Path):
+    npz = src / "cifar10.npz"
+    if npz.exists():
+        d = np.load(npz)
+        if all(k in d for k in MNIST_STEMS):
+            return {k: d[k] for k in MNIST_STEMS}
+    for batch_dir in (src / "cifar-10-batches-py",
+                      src / "CIFAR10" / "cifar-10-batches-py"):
+        if (batch_dir / "data_batch_1").exists():
+            return _cifar_from_batches(batch_dir)
+    for tgz in (src / "cifar-10-python.tar.gz",):
+        if tgz.exists():
+            with tempfile.TemporaryDirectory() as tmp:
+                with tarfile.open(tgz) as tf:
+                    tf.extractall(tmp, filter="data")
+                return _cifar_from_batches(
+                    Path(tmp) / "cifar-10-batches-py"
+                )
+    return None
+
+
+def _find_tinystories(src: Path):
+    for cand in sorted(src.glob("[Tt]iny[Ss]tories*.txt")) + [
+        src / "tinystories.txt"
+    ]:
+        if cand.exists() and cand.stat().st_size > 0:
+            return cand
+    return None
+
+
+def _validate_images(name, d, img_shape, n_train, n_test):
+    problems = []
+    for key, n in (("train", n_train), ("test", n_test)):
+        x, y = d[f"{key}_x"], d[f"{key}_y"]
+        if x.shape != (n,) + img_shape:
+            problems.append(f"{key}_x {x.shape} != {(n,) + img_shape}")
+        if y.shape != (n,):
+            problems.append(f"{key}_y {y.shape} != {(n,)}")
+        elif not (0 <= int(y.min()) and int(y.max()) <= 9):
+            problems.append(f"{key}_y labels outside 0..9")
+    if problems:
+        raise ValueError(f"{name}: refusing truncated/malformed data — "
+                         + "; ".join(problems))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("--source", action="append", default=[],
+                    help="extra directories to scan (repeatable); defaults "
+                         "also include /root/data, /data, /mnt/data, "
+                         "~/data, ~/Downloads, ./data, $DDL25_DATA_SRC")
+    ap.add_argument("--target", default=None,
+                    help="destination (default $DDL25_DATA_DIR or "
+                         "~/.cache/ddl25spring)")
+    ap.add_argument("--require", default="",
+                    help="comma-separated datasets that MUST land "
+                         "(mnist,cifar10,tinystories); exit 1 otherwise")
+    args = ap.parse_args()
+
+    target = Path(
+        args.target
+        or os.environ.get("DDL25_DATA_DIR")
+        or Path.home() / ".cache" / "ddl25spring"
+    )
+    target.mkdir(parents=True, exist_ok=True)
+    sources = [Path(s) for s in args.source] + list(default_sources())
+    sources = [s for s in sources if s.is_dir()]
+
+    landed = {}
+
+    def ingest(name, out_name, finder, validate, write):
+        out = target / out_name
+        if out.exists():
+            landed[name] = f"already present ({out})"
+            return
+        for src in [target] + sources:
+            try:
+                found = finder(src)
+            except Exception as e:  # malformed candidate: keep scanning
+                print(f"[fetch_data] {name}: skipping {src}: {e}")
+                continue
+            if found is None:
+                continue
+            try:
+                validate(found)
+            except ValueError as e:
+                print(f"[fetch_data] {e}")
+                continue
+            write(out, found)
+            landed[name] = f"ingested from {src} -> {out}"
+            return
+        landed[name] = None
+
+    ingest(
+        "mnist", "mnist.npz", _find_mnist,
+        lambda d: _validate_images("mnist", d, (28, 28), 60000, 10000),
+        lambda out, d: np.savez_compressed(out, **d),
+    )
+    ingest(
+        "cifar10", "cifar10.npz", _find_cifar,
+        lambda d: _validate_images("cifar10", d, (32, 32, 3), 50000, 10000),
+        lambda out, d: np.savez_compressed(out, **d),
+    )
+    ingest(
+        "tinystories", "tinystories.txt", _find_tinystories,
+        lambda p: None,
+        lambda out, p: out.write_bytes(p.read_bytes()),
+    )
+
+    for name, status in landed.items():
+        print(f"[fetch_data] {name}: {status or 'NOT FOUND'}")
+    print(f"[fetch_data] loaders will read {target} when "
+          f"DDL25_DATA_DIR={target} (set it if nonstandard)")
+
+    required = [r for r in args.require.split(",") if r]
+    missing = [r for r in required if not landed.get(r)]
+    if missing:
+        print(f"[fetch_data] REQUIRED datasets missing: {missing} — "
+              f"mount them under one of: "
+              + ", ".join(str(s) for s in sources))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""LM parity anchor vs the reference's TinyStories trajectory.
+
+The reference's primer LM (lab/tutorial_1b/primer/intro.py: dmodel 288,
+6 heads, 6 layers, seq_l 256, batch 3, SentencePiece on real TinyStories)
+logs a loss trajectory of 3.513 -> ~0.22 over its training run
+(lab/Abgabe/outputs/out_MB2.txt).  Those numbers are only comparable on the
+REAL corpus, which this zero-egress container lacks — so this tool is the
+arm-on-data-arrival hook (VERDICT r2 #7): the day ``tinystories.txt`` is
+ingested (tools/fetch_data.py), run it to record the matched-config
+trajectory next to the reference's in docs/BENCHMARKS.md.
+
+Run:  python tools/lm_parity.py [--iters 15000] [--out results/lm_parity.txt]
+Refuses the synthetic fallback (real_corpus_required) — it cannot produce a
+number that LOOKS comparable but isn't.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from ddl25spring_tpu.utils.platform import select_platform  # noqa: E402
+
+select_platform()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=15000,
+                    help="reference run length (out_MB2.txt logs ~15k)")
+    ap.add_argument("--out", default="results/lm_parity.txt")
+    args = ap.parse_args()
+
+    from ddl25spring_tpu.configs import LmConfig
+    from ddl25spring_tpu.run_lm import run
+
+    # primer/intro.py-matched config; BPE stands in for the pretrained
+    # SentencePiece model (also absent from the container) at the same
+    # 4096-symbol scale
+    cfg = LmConfig(
+        strategy="single", batch_size=3, seq_l=256, dmodel=288,
+        nr_heads=6, nr_layers=6, nr_iters=args.iters,
+        tokenizer="bpe", bpe_vocab_size=4096,
+        real_corpus_required=True,
+    )
+    try:
+        losses = run(cfg, log_every=max(1, args.iters // 100))
+    except FileNotFoundError as e:
+        print(f"REFUSED: {e}")
+        return 2
+
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    record = {
+        "config": "primer-matched (dmodel 288, heads 6, layers 6, "
+                  "seq 256, batch 3, bpe-4096, real TinyStories)",
+        "reference": "lab/Abgabe/outputs/out_MB2.txt: 3.513 -> ~0.22",
+        "iters": args.iters,
+        "first_loss": losses[0],
+        "last_loss": losses[-1],
+        "trajectory_every": max(1, args.iters // 100),
+        "trajectory": [round(float(x), 4) for x in losses],
+    }
+    out.write_text(json.dumps(record, indent=1))
+    print(f"loss {losses[0]:.3f} -> {losses[-1]:.3f} over {args.iters} "
+          f"iters; wrote {out} — add the row to docs/BENCHMARKS.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
