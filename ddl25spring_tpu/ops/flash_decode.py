@@ -20,10 +20,15 @@ head's (group, hd) query tile — no head expansion anywhere.  Forward-only
 by design (generation never differentiates through decode), so no custom
 VJP is needed.
 
+Layout: the group dim is padded to the f32 sublane multiple (>= 8) so the
+q tile is (g_pad, hd) and the running max/denominator scratches are 2-D
+(g_pad, 1) — vreg-native shapes rather than odd sub-sublane tiles whose
+acceptance only a real Mosaic lowering can confirm (advisor r2).
+
 Validated in interpret mode (oracle: tests/test_flash_decode.py pins it to
 the XLA decode path bit-for-bit-close, including ragged pads); OFF by
 default (``LlamaConfig.decode_impl="xla"``) until a live-TPU Mosaic run
-confirms the (group, hd) sub-tile layouts — flip with
+(tools/tpu_validate.py) confirms it — flip with
 ``decode_impl="flash-decode"`` / ``bench_generate --decode-impl``.
 """
 
@@ -53,7 +58,7 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
 
     @pl.when(j * block_k <= pos)
     def _compute():
-        q = q_ref[0, 0]                    # (g, hd)
+        q = q_ref[0, 0]                    # (g_pad, hd)
         k = k_ref[0, :, 0, :]              # (block_k, hd)
         v = v_ref[0, :, 0, :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
@@ -62,19 +67,22 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
         )
         valid = (k_pos <= pos) & (k_pos >= pad_ref[b])
         s = jnp.where(valid, s, NEG_INF)
+        # scratches are (g_pad, 1) 2-D — Mosaic-native sublane x lane
+        # layout; the zero-padded q rows just compute a uniform softmax
+        # over the valid keys (never NaN) and are sliced off by the caller
         m_old = m_scr[...]
-        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
         corr = jnp.exp(m_old - m_new)
         m_scr[...] = m_new
-        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1)
-        acc[...] = acc[...] * corr[:, None] + jnp.dot(
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[...] = acc[...] * corr + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
 
     @pl.when(j == nr_k - 1)
     def _final():
-        o_ref[0, 0] = (acc[...] / l_scr[...][:, None]).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc[...] / l_scr[...]).astype(o_ref.dtype)
 
 
 def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
@@ -98,6 +106,14 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
         pad = jnp.zeros((B,), jnp.int32)
     pos = jnp.asarray(pos, jnp.int32).reshape(1)
     qg = q.reshape(B, Hkv, g, hd)
+    # pad the group dim to the f32 sublane multiple: (g_pad, hd) q tiles
+    # and (g_pad, 1) scratches are vreg-native layouts Mosaic always
+    # accepts, where odd small g (1, 3, ...) relies on implicit padding the
+    # interpreter never checks (advisor r2).  Cost ~0: decode is bound by
+    # the K/V DMA, which is untouched; padded zero-rows are sliced off.
+    g_pad = max(8, ((g + 7) // 8) * 8)
+    if g_pad != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - g), (0, 0)))
 
     def live(j, pos_v):
         # clamp dead trailing blocks to the last live one: repeated index
@@ -109,7 +125,7 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
         num_scalar_prefetch=2,
         grid=(B, Hkv, nr_k),
         in_specs=[
-            pl.BlockSpec((1, 1, g, hd),
+            pl.BlockSpec((1, 1, g_pad, hd),
                          lambda b, h, j, pos_v, pad_v: (b, h, 0, 0)),
             pl.BlockSpec((1, block_k, 1, hd),
                          lambda b, h, j, pos_v, pad_v:
@@ -118,18 +134,18 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
                          lambda b, h, j, pos_v, pad_v:
                          (b, live(j, pos_v), h, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, g, hd),
+        out_specs=pl.BlockSpec((1, 1, g_pad, hd),
                                lambda b, h, j, pos_v, pad_v: (b, h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((g,), jnp.float32),
-            pltpu.VMEM((g,), jnp.float32),
-            pltpu.VMEM((g, hd), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, hd), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         functools.partial(_kernel, block_k=block_k, scale=scale, nr_k=nr_k),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, g, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, g_pad, hd), q.dtype),
         interpret=interpret,
     )(pos, jnp.asarray(pad, jnp.int32), qg, cache_k, cache_v)
-    return out.reshape(B, Hq, hd)
+    return out[:, :, :g].reshape(B, Hq, hd)
