@@ -126,13 +126,16 @@ def _sync(tree):
     device_sync(tree)
 
 
-def _aot_fused_rounds(server, nr_rounds: int):
-    """AOT-compile the fused N-round program; -> (compiled, warmed params).
+def _aot_fused_rounds(server, nr_rounds: int, run_warmup: bool = True):
+    """AOT-compile the fused N-round program; -> (compiled, params).
 
-    Runs warmup round 0 (which advances params exactly like the unfused
-    path and compiles the single-round program) but never EXECUTES the
-    fused loop — executing it would double the bench runtime and pollute
-    --profile traces with a throwaway run."""
+    With ``run_warmup`` it executes round 0 first (which advances params
+    exactly like the unfused path and compiles the single-round program)
+    but never EXECUTES the fused loop — executing it would double the
+    bench runtime and pollute --profile traces with a throwaway run.
+    ``run_warmup=False`` (the cost-analysis path) skips all execution:
+    lowering only needs abstract shapes, and server.params already has
+    them."""
     import functools
 
     import jax
@@ -147,9 +150,11 @@ def _aot_fused_rounds(server, nr_rounds: int):
             params,
         )
 
-    _stamp("warmup round 0 ...")
-    params = server.round_fn(server.params, server.run_key, 0)
-    _sync(params)
+    params = server.params
+    if run_warmup:
+        _stamp("warmup round 0 ...")
+        params = server.round_fn(params, server.run_key, 0)
+        _sync(params)
     _stamp(f"AOT-compiling the fused {nr_rounds}-round program ...")
     compiled = run_n.lower(
         params, server.run_key, nr_rounds, *rf.data
@@ -165,7 +170,7 @@ def cost_breakdown(server) -> dict:
     count.  Pairing these with the measured round time gives achieved
     FLOP/s and bytes/s to place the program against the chip's peaks —
     the evidence VERDICT r2 'weak #2' asks for (17% MXU claim)."""
-    compiled, _ = _aot_fused_rounds(server, 1)
+    compiled, _ = _aot_fused_rounds(server, 1, run_warmup=False)
     ca = compiled.cost_analysis()
     if isinstance(ca, list):  # older jax returns one dict per executable
         ca = ca[0] if ca else {}

@@ -116,6 +116,10 @@ class LmConfig:
     nr_iters: int = 100
     nr_microbatches: int = 3   # intro_PP_1F1B_MB.py microbatch count
     moe_aux_weight: float = 0.01  # ep: load-balancing aux loss weight
+    moe_dispatch: str = "dense"  # ep: dense (every expert sees every
+    #                              token) | capacity (GShard token budget,
+    #                              drops accounted; models/moe.py)
+    moe_capacity_factor: float = 1.25  # ep + capacity dispatch only
     remat: bool = False        # gradient-checkpoint each block (HBM ↓, FLOPs ↑)
     attn_impl: str = "dense"   # dense (XLA) | flash (Pallas); under
     #                            --strategy sp: dense -> einsum ring,

@@ -159,7 +159,9 @@ def build_trainer(cfg: LmConfig, vocab_size: int = BASE_VOCAB):
     if cfg.strategy == "ep":
         from .models.moe import moe_aux_load
 
-        moe_cfg = _dc.replace(mcfg, nr_experts=max(2, n))
+        moe_cfg = _dc.replace(mcfg, nr_experts=max(2, n),
+                              moe_dispatch=cfg.moe_dispatch,
+                              moe_capacity_factor=cfg.moe_capacity_factor)
         model = Llama(moe_cfg)
         params = model.init(jax.random.key(cfg.seed), tokens0)
         mesh = make_mesh({"expert": n}, devices=devices)
