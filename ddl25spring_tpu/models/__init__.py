@@ -14,10 +14,12 @@ from .llama import (
     full_params_to_stage_params,
 )
 from .generate import generate
+from .speculative import speculative_generate
 from .quant import QuantDense, quantize_llama_params
 
 __all__ = [
     "generate",
+    "speculative_generate",
     "QuantDense",
     "quantize_llama_params",
     "MnistCnn",

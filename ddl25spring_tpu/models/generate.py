@@ -93,20 +93,7 @@ def generate(
         )
     if key is None:
         key = jax.random.key(0)  # unused on the greedy path
-    if prompt_lengths is not None:
-        # host-side fail-fast: out-of-range lengths would silently clamp in
-        # _left_align's take_along_axis and decode shifted/duplicated rows.
-        # Only checkable when the lengths are concrete (the normal serving
-        # path); under an outer trace the documented 1 <= len <= T0
-        # contract stands unchecked.
-        pl = jnp.asarray(prompt_lengths)
-        if not isinstance(pl, jax.core.Tracer):
-            bad = (pl < 1) | (pl > T0)
-            if bool(jnp.any(bad)):
-                raise ValueError(
-                    f"prompt_lengths must satisfy 1 <= length <= {T0} "
-                    f"(prompt width); got {list(map(int, pl))}"
-                )
+    _check_prompt_lengths(prompt_lengths, T0)
 
     if temperature == 0:
         # the filters are dead under greedy decode; normalise them out of
@@ -120,6 +107,24 @@ def generate(
         return decode(params, prompt, key)
     prompt_left, pad = _left_align(prompt, T0, prompt_lengths)
     return decode(params, prompt_left, key, pad)
+
+
+def _check_prompt_lengths(prompt_lengths, T0: int) -> None:
+    """Host-side fail-fast: out-of-range lengths would silently clamp in
+    _left_align's take_along_axis and decode shifted/duplicated rows.
+    Only checkable when the lengths are concrete (the normal serving
+    path); under an outer trace the documented 1 <= len <= T0 contract
+    stands unchecked.  Shared by generate() and speculative_generate()."""
+    if prompt_lengths is None:
+        return
+    pl = jnp.asarray(prompt_lengths)
+    if not isinstance(pl, jax.core.Tracer):
+        bad = (pl < 1) | (pl > T0)
+        if bool(jnp.any(bad)):
+            raise ValueError(
+                f"prompt_lengths must satisfy 1 <= length <= {T0} "
+                f"(prompt width); got {list(map(int, pl))}"
+            )
 
 
 def _left_align(prompt, T0: int, prompt_lengths):

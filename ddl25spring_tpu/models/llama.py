@@ -162,10 +162,14 @@ class Attention(nn.Module):
         # are shared cache SLOTS; each row's rotary position is its slot
         # minus its pad width, so every prompt starts at rotary position 0.
         # Pad slots clamp to 0 — they are masked out of attention anyway.
-        rope_pos = (
-            positions if pad is None
-            else jnp.maximum(positions[None, :] - pad[:, None], 0)
-        )
+        # 2-D (B, T) positions give every ROW its own slots (speculative
+        # decoding, models/speculative.py, where rows commit at different
+        # rates); 1-D (T,) positions are shared across rows as before.
+        if pad is None:
+            rope_pos = positions  # rope_angles accepts either rank
+        else:
+            pos2d = positions if positions.ndim == 2 else positions[None, :]
+            rope_pos = jnp.maximum(pos2d - pad[:, None], 0)
         cos, sin = rope_angles(cfg.head_dim, rope_pos)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -223,19 +227,34 @@ class Attention(nn.Module):
         zeros = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), q.dtype)
         ck = self.variable("cache", "k", zeros)
         cv = self.variable("cache", "v", zeros)
-        offset = positions[0]
+        per_row = positions.ndim == 2  # (B, T) row-local slots (speculative)
         if pad is not None:
             # scrub pad-slot K/V before they enter the cache: pad-slot
             # QUERIES see no keys, so deeper layers' activations there are
             # NaN, and a real query's exactly-zero attention weight times a
             # NaN value is still NaN — zeroing at the write kills the
             # poison at its source (jnp.where never multiplies)
-            real = (positions[None, :] >= pad[:, None])[..., None, None]
+            pos2d = positions if per_row else positions[None, :]
+            real = (pos2d >= pad[:, None])[..., None, None]
             k = jnp.where(real, k, 0)
             v = jnp.where(real, v, 0)
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
-        if cfg.decode_impl == "flash-decode" and T == 1:
+        if per_row:
+            row_write = jax.vmap(
+                lambda c, blk, off: jax.lax.dynamic_update_slice(
+                    c, blk, (off, 0, 0)
+                )
+            )
+            ck.value = row_write(ck.value, k, positions[:, 0])
+            cv.value = row_write(cv.value, v, positions[:, 0])
+        else:
+            offset = positions[0]
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, offset, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, offset, 0, 0)
+            )
+        if cfg.decode_impl == "flash-decode" and T == 1 and not per_row:
             # Pallas kernel streams only the LIVE cache prefix (scalar-
             # prefetch-clamped DMA); prefill (T > 1) keeps the einsum below
             from ..ops.flash_decode import flash_decode_attention
@@ -255,11 +274,20 @@ class Attention(nn.Module):
             jnp.float32
         ) * scale
         # key j visible to query at slot p iff j <= p; unwritten cache rows
-        # are masked out by the same comparison.  Ragged batches addition-
-        # ally hide each row's left-pad slots (j < pad[b]) — they hold
-        # garbage keys from the prefill of shorter prompts.
-        visible = jnp.arange(S)[None, :] <= positions[:, None]  # (T, S)
-        visible = visible[None, None, None]  # (1, 1, 1, T, S)
+        # are masked out by the same comparison (this is also what makes
+        # speculative decoding's rejected-slot leftovers harmless: stale
+        # slots sit strictly above every committed query position and are
+        # rewritten before any later query exposes them).  Ragged batches
+        # additionally hide each row's left-pad slots (j < pad[b]) — they
+        # hold garbage keys from the prefill of shorter prompts.
+        if per_row:
+            visible = (
+                jnp.arange(S)[None, None, :] <= positions[:, :, None]
+            )  # (B, T, S)
+            visible = visible[:, None, None]  # (B, 1, 1, T, S)
+        else:
+            visible = jnp.arange(S)[None, :] <= positions[:, None]  # (T, S)
+            visible = visible[None, None, None]  # (1, 1, 1, T, S)
         if pad is not None:
             real = jnp.arange(S)[None, :] >= pad[:, None]  # (B, S)
             visible = visible & real[:, None, None, None, :]

@@ -1,0 +1,235 @@
+"""Greedy speculative decoding: draft proposes, target verifies in one pass.
+
+The reference never decodes at all (its LMs only log training loss,
+lab/tutorial_1b/primer/intro.py); this framework's serving stack already has
+KV-cache generation, GQA, int8 and flash-decode — speculative decoding is
+the remaining standard serving accelerator (Leviathan et al. / Chen et al.,
+public construction), TPU-first:
+
+- a small DRAFT model autoregressively proposes ``gamma`` tokens (cheap
+  sequential steps);
+- the TARGET verifies all of them in ONE batched forward over a
+  ``gamma+1``-token window — the expensive model runs a matmul-shaped
+  program every ~``a+1`` committed tokens instead of a bandwidth-bound
+  single-token decode every token;
+- greedy acceptance: the longest prefix of proposals matching the target's
+  own argmax is committed, plus the target's correction/bonus token, so the
+  OUTPUT IS EXACTLY THE TARGET'S GREEDY DECODE whatever the draft quality —
+  only the speed varies (oracle: tests/test_speculative.py, any draft).
+
+Batching: rows accept different counts per step, so their committed lengths
+diverge.  Everything stays static-shaped: each row tracks its own length
+``L_b`` and the model's decode path takes 2-D ``(B, T)`` positions (per-row
+cache slots, rotary offsets, visibility — models/llama.py).  The token
+buffer carries ``gamma`` permanent LEFT pads (so early windows never start
+below 0) and ``gamma`` TRAILING scratch slots (so late windows never hit
+the buffer end — ``dynamic_slice`` clamps out-of-range starts, which would
+silently shift a window).  Termination is a ``while_loop``: every step
+commits >= 1 token per live row.
+
+Cache-staleness invariant (why no rollback is needed): a rejected proposal
+leaves stale K/V above a row's committed length.  Visibility masks every
+slot above the query position, and the next round's draft steps / target
+window rewrite slots ``[L', L'+gamma)`` sequentially before exposing them
+— the stale region ``[L', L+gamma)`` is strictly inside it.  The one
+committed-but-stale draft slot (the correction token at ``L'-1``) is
+exactly the input of the next draft step, which rewrites it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .generate import _check_prompt_lengths, _left_align
+from .llama import Llama, LlamaConfig
+
+
+def _row_read(buf, idx, width: int):
+    """Per-row dynamic window: buf (B, N), idx (B,) -> (B, width)."""
+    return jax.vmap(
+        lambda row, i: jax.lax.dynamic_slice(row, (i,), (width,))
+    )(buf, idx)
+
+
+def _row_write_masked(buf, idx, vals, count):
+    """Write vals[b, j] to buf[b, idx[b]+j] for j < count[b] (static unroll
+    over the small gamma+1 width; masked writes keep shapes static)."""
+
+    def upd(row, s, v, m):
+        cur = jax.lax.dynamic_slice(row, (s,), (1,))
+        return jax.lax.dynamic_update_slice(
+            row, jnp.where(m, v[None], cur), (s,)
+        )
+
+    for j in range(vals.shape[1]):
+        buf = jax.vmap(upd)(buf, idx + j, vals[:, j], j < count)
+    return buf
+
+
+def speculative_generate(
+    target_config: LlamaConfig,
+    target_params,
+    draft_config: LlamaConfig,
+    draft_params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    gamma: int = 4,
+    prompt_lengths: jax.Array | None = None,
+):
+    """Greedy-decode ``max_new_tokens`` continuations via draft+verify.
+
+    Same contract as :func:`models.generate.generate` at ``temperature=0``
+    — and bit-identical output: ``prompt`` (B, T0) right-padded with
+    ``prompt_lengths`` marking true lengths; returns ``(tokens, rate)``
+    where ``tokens`` is (B, T0 + max_new_tokens) LEFT-padded and ``rate``
+    is the mean acceptance (accepted proposals / proposed), the serving-
+    side health metric.  ``gamma`` is the proposal depth; both models need
+    ``ctx_size >= gamma + T0 + max_new_tokens``.
+    """
+    if target_config.vocab_size != draft_config.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    B, T0 = prompt.shape
+    total = gamma + T0 + max_new_tokens  # committed region (incl. left pads)
+    for name, cfg in (("target", target_config), ("draft", draft_config)):
+        if total > cfg.ctx_size:
+            raise ValueError(
+                f"{name} ctx_size {cfg.ctx_size} < gamma + prompt + "
+                f"max_new_tokens = {total}"
+            )
+    _check_prompt_lengths(prompt_lengths, T0)
+    if max_new_tokens == 0:
+        if prompt_lengths is None:
+            return prompt, jnp.float32(0)
+        return _left_align(prompt, T0, prompt_lengths)[0], jnp.float32(0)
+
+    total_buf = total + gamma  # + trailing scratch: windows never clamp
+    tcfg = dataclasses.replace(target_config, decode=True,
+                               ctx_size=total_buf)
+    dcfg = dataclasses.replace(draft_config, decode=True,
+                               ctx_size=total_buf)
+    target, draft = Llama(tcfg), Llama(dcfg)
+    tparams = (target_params["params"] if "params" in target_params
+               else target_params)
+    dparams = (draft_params["params"] if "params" in draft_params
+               else draft_params)
+
+    if prompt_lengths is None:
+        prompt_left = prompt
+        pad0 = jnp.zeros((B,), jnp.int32)
+    else:
+        prompt_left, pad0 = _left_align(prompt, T0, prompt_lengths)
+    pad = pad0 + gamma  # the gamma spec slots are permanent left pads
+    tokens0 = jnp.zeros((B, total_buf), prompt.dtype)
+    tokens0 = jax.lax.dynamic_update_slice(tokens0, prompt_left, (0, gamma))
+
+    window = gamma + T0  # prefill width
+
+    @jax.jit
+    def run(tparams, dparams, tokens, pad):
+        prefill_pos = jnp.arange(window)
+        t_logits, tvars = target.apply(
+            {"params": tparams}, tokens[:, :window],
+            positions=prefill_pos, pad=pad, mutable=["cache"],
+        )
+        _, dvars = draft.apply(
+            {"params": dparams}, tokens[:, :window],
+            positions=prefill_pos, pad=pad, mutable=["cache"],
+        )
+        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(tokens.dtype)
+        tokens = _row_write_masked(
+            tokens, jnp.full((B,), window, jnp.int32), first[:, None],
+            jnp.ones((B,), jnp.int32),
+        )
+        L = jnp.full((B,), window + 1, jnp.int32)
+
+        def cond(carry):
+            return jnp.any(carry[3] < total)
+
+        def body(carry):
+            tokens, tcache, dcache, L, n_prop, n_acc = carry
+
+            # --- draft: 2-token catch-up + gamma-1 decode steps --------
+            # The catch-up window [L-2, L-1] closes the draft cache's one
+            # possible hole: after a full-accept round (commit = gamma+1)
+            # the last proposal p_gamma was emitted but never fed back, so
+            # its slot L'-2 has no K/V.  Both slots hold committed tokens,
+            # so the rewrite is value-identical where already valid.
+            catch = _row_read(tokens, L - 2, 2)
+            cpos = (L - 2)[:, None] + jnp.arange(2)[None, :]
+            clog, dv = draft.apply(
+                {"params": dparams, "cache": dcache},
+                catch, positions=cpos, pad=pad, mutable=["cache"],
+            )
+            dcache = dv["cache"]
+            p1 = jnp.argmax(clog[:, -1], axis=-1).astype(tokens.dtype)
+
+            def dstep(c, _):
+                dcache, cur_tok, cur_pos = c
+                logits, dv = draft.apply(
+                    {"params": dparams, "cache": dcache},
+                    cur_tok[:, None], positions=cur_pos[:, None], pad=pad,
+                    mutable=["cache"],
+                )
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tokens.dtype)
+                return (dv["cache"], nxt, cur_pos + 1), nxt
+
+            (dcache, _, _), rest = jax.lax.scan(
+                dstep, (dcache, p1, L), None, length=gamma - 1
+            )
+            props = jnp.concatenate([p1[:, None], rest.T], axis=1)
+            # (B, gamma): proposals for slots L..L+gamma-1
+
+            # --- verify: one (gamma+1)-window target forward -----------
+            tokens_p = _row_write_masked(
+                tokens, L, props, jnp.full((B,), gamma, jnp.int32)
+            )
+            win = _row_read(tokens_p, L - 1, gamma + 1)
+            pos = (L - 1)[:, None] + jnp.arange(gamma + 1)[None, :]
+            t_logits, tv = target.apply(
+                {"params": tparams, "cache": tcache},
+                win, positions=pos, pad=pad, mutable=["cache"],
+            )
+            tcache = tv["cache"]
+            tgt = jnp.argmax(t_logits, axis=-1).astype(tokens.dtype)
+            # tgt[:, j] = the target's greedy token for slot L+j
+
+            # --- greedy acceptance + commit ----------------------------
+            match = (props == tgt[:, :gamma]).astype(jnp.int32)  # (B, g)
+            a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)      # (B,)
+            corr = jnp.take_along_axis(tgt, a[:, None], axis=1)  # (B, 1)
+            cand = jnp.where(
+                jnp.arange(gamma + 1)[None, :] < a[:, None],
+                jnp.concatenate(
+                    [props, jnp.zeros((B, 1), props.dtype)], axis=1
+                ),
+                corr,
+            )  # (B, gamma+1): a accepted proposals then the correction
+            live = L < total
+            commit = jnp.where(live, jnp.minimum(a + 1, total - L), 0)
+            tokens = _row_write_masked(tokens, L, cand, commit)
+            # rate counts only IN-BUDGET proposals: ones falling past
+            # max_new_tokens are neither accepted nor rejected, and
+            # counting them would deflate the metric whenever the last
+            # round is clamped (self-draft must report exactly 1.0)
+            in_budget = jnp.minimum(gamma, total - L)
+            n_prop = n_prop + jnp.sum(jnp.where(live, in_budget, 0))
+            n_acc = n_acc + jnp.sum(
+                jnp.where(live, jnp.minimum(a, in_budget), 0)
+            )
+            return tokens, tcache, dcache, L + commit, n_prop, n_acc
+
+        tokens, _, _, _, n_prop, n_acc = jax.lax.while_loop(
+            cond, body,
+            (tokens, tvars["cache"], dvars["cache"], L,
+             jnp.int32(0), jnp.int32(0)),
+        )
+        rate = (n_acc / jnp.maximum(n_prop, 1)).astype(jnp.float32)
+        return tokens[:, gamma:total], rate
+
+    return run(tparams, dparams, tokens0, pad)

@@ -1,0 +1,103 @@
+"""Speculative decoding oracles (models/speculative.py).
+
+THE invariant of greedy speculative decoding: the output equals the
+target's plain greedy decode token-for-token, no matter what the draft
+proposes — a good draft only changes the speed (acceptance rate).
+Exactness is a property of this pinned test env (CPU, f32, highest
+matmul precision — conftest), the same regime the generate-vs-full-forward
+oracle relies on.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.models import generate
+from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+from ddl25spring_tpu.models.speculative import speculative_generate
+
+TARGET = LlamaConfig(vocab_size=48, dmodel=32, nr_heads=4, nr_kv_heads=2,
+                     nr_layers=2, ctx_size=64)
+DRAFT = LlamaConfig(vocab_size=48, dmodel=16, nr_heads=2, nr_layers=1,
+                    ctx_size=64)
+
+
+def _init(cfg, seed, T=5):
+    toks = jnp.zeros((2, T), jnp.int32)
+    return Llama(cfg).init(jax.random.key(seed), toks,
+                           positions=jnp.arange(T))
+
+
+@pytest.fixture(scope="module")
+def models():
+    return _init(TARGET, 0), _init(DRAFT, 1)
+
+
+def test_self_draft_accepts_everything(models):
+    """draft == target: every proposal matches, rate == 1, output equals
+    plain greedy decode — including when the final round is clamped by the
+    token budget (max_new=11 with gamma=3 commits 4+4+3: the out-of-budget
+    proposal must not count as a rejection)."""
+    tparams, _ = models
+    prompt = jax.random.randint(jax.random.key(2), (2, 5), 1, 48)
+    for max_new in (12, 11):
+        want = generate(TARGET, tparams, prompt, max_new)
+        got, rate = speculative_generate(TARGET, tparams, TARGET, tparams,
+                                         prompt, max_new, gamma=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert float(rate) == 1.0, max_new
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 8])
+def test_any_draft_matches_plain_greedy(models, gamma):
+    """An unrelated (randomly initialised) draft must still produce the
+    target's exact greedy output — only the acceptance rate differs."""
+    tparams, dparams = models
+    prompt = jax.random.randint(jax.random.key(3), (2, 5), 1, 48)
+    want = generate(TARGET, tparams, prompt, 14)
+    got, rate = speculative_generate(TARGET, tparams, DRAFT, dparams,
+                                     prompt, 14, gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert 0.0 <= float(rate) <= 1.0
+
+
+def test_ragged_prompts_match_plain_greedy(models):
+    """Per-row divergence is the hard part (2-D positions, per-row cache
+    writes): ragged prompts through an unrelated draft still reproduce the
+    ragged plain-greedy output, left-padded layout and all."""
+    tparams, dparams = models
+    prompt = jax.random.randint(jax.random.key(4), (3, 6), 1, 48)
+    lengths = jnp.asarray([2, 6, 4])
+    want = generate(TARGET, tparams, prompt[:3], 10,
+                    prompt_lengths=lengths)
+    got, _ = speculative_generate(TARGET, tparams, DRAFT,
+                                  _init(DRAFT, 7), prompt[:3], 10,
+                                  gamma=3, prompt_lengths=lengths)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_validation_and_edges(models):
+    tparams, dparams = models
+    prompt = jnp.ones((2, 4), jnp.int32)
+
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(
+            TARGET, tparams,
+            dataclasses.replace(DRAFT, vocab_size=32), dparams, prompt, 4,
+        )
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(TARGET, tparams, DRAFT, dparams, prompt, 4,
+                             gamma=0)
+    with pytest.raises(ValueError, match="ctx_size"):
+        speculative_generate(TARGET, tparams, DRAFT, dparams, prompt, 100)
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        speculative_generate(TARGET, tparams, DRAFT, dparams, prompt, 4,
+                             prompt_lengths=jnp.asarray([0, 2]))
+
+    out, rate = speculative_generate(TARGET, tparams, DRAFT, dparams,
+                                     prompt, 0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+    assert float(rate) == 0.0
