@@ -79,6 +79,7 @@ def speculative_generate(
     *,
     gamma: int = 4,
     prompt_lengths: jax.Array | None = None,
+    eos_id: int | None = None,
 ):
     """Greedy-decode ``max_new_tokens`` continuations via draft+verify.
 
@@ -89,6 +90,12 @@ def speculative_generate(
     is the mean acceptance (accepted proposals / proposed), the serving-
     side health metric.  ``gamma`` is the proposal depth; both models need
     ``ctx_size >= gamma + T0 + max_new_tokens``.
+
+    ``eos_id`` reproduces generate()'s semantics exactly: the EOS is kept,
+    every later generated slot becomes pad (0).  Here it is a post-pass —
+    decoding past a row's EOS costs a few wasted slots but keeps every
+    shape static, and the masked-out region is all zeros either way, so
+    the output still matches ``generate(..., eos_id=...)`` bit-for-bit.
     """
     if target_config.vocab_size != draft_config.vocab_size:
         raise ValueError("draft and target must share a vocabulary")
@@ -230,6 +237,15 @@ def speculative_generate(
              jnp.int32(0), jnp.int32(0)),
         )
         rate = (n_acc / jnp.maximum(n_prop, 1)).astype(jnp.float32)
-        return tokens[:, gamma:total], rate
+        out = tokens[:, gamma:total]
+        if eos_id is not None:
+            # post-EOS slots -> pad, generated region only (a prompt token
+            # equal to eos_id must not truncate, same as generate())
+            gen_slots = jnp.arange(out.shape[1])[None, :] >= T0
+            hit = (out == eos_id) & gen_slots                # (B, T0+new)
+            # slots strictly AFTER a row's first generated EOS become 0
+            hits = jnp.cumsum(hit.astype(jnp.int32), axis=1)
+            out = jnp.where(hits - hit.astype(jnp.int32) >= 1, 0, out)
+        return out, rate
 
     return run(tparams, dparams, tokens0, pad)

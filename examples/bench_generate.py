@@ -41,6 +41,12 @@ def main():
                     choices=["xla", "flash-decode"],
                     help="flash-decode = Pallas kernel reading only live "
                          "cache blocks (ops/flash_decode.py)")
+    ap.add_argument("--speculative", type=int, default=0, metavar="GAMMA",
+                    help="also measure speculative decoding at this "
+                         "proposal depth: self-draft (acceptance 1.0 — the "
+                         "ceiling: every verify commits gamma+1 tokens) "
+                         "and a 4x-smaller random draft (the overhead "
+                         "floor: near-random acceptance)")
     args = ap.parse_args()
 
     from ddl25spring_tpu.utils.platform import select_platform
@@ -104,6 +110,46 @@ def main():
             if args.int8:
                 measure(dataclasses.replace(cfg, weights_int8=True),
                         quantize_llama_params(params), B)
+            if args.speculative:
+                from ddl25spring_tpu.models import speculative_generate
+
+                def spec_measure(label, dcfg, dparams):
+                    g = args.speculative
+                    t0 = time.perf_counter()
+                    out, rate = speculative_generate(
+                        cfg, params, dcfg, dparams, prompt,
+                        args.new_tokens, gamma=g,
+                    )
+                    device_sync(out)
+                    compile_s = time.perf_counter() - t0
+                    best = float("inf")
+                    for _ in range(args.reps):
+                        t0 = time.perf_counter()
+                        out, rate = speculative_generate(
+                            cfg, params, dcfg, dparams, prompt,
+                            args.new_tokens, gamma=g,
+                        )
+                        device_sync(out)
+                        best = min(best, time.perf_counter() - t0)
+                    toks = B * args.new_tokens / best
+                    print(f"{B:>3} {cfg.kv_heads:>8} {label:>7} "
+                          f"{'—':>8} {compile_s:>9.1f} {best:>8.3f} "
+                          f"{toks:>8.0f}  (gamma={g}, "
+                          f"acceptance={float(rate):.2f})", flush=True)
+
+                spec_measure("spec=T", cfg, params)  # self-draft ceiling
+                small = LlamaConfig(
+                    vocab_size=cfg.vocab_size,
+                    dmodel=max(32, args.dmodel // 4),
+                    nr_heads=max(2, args.heads // 2),
+                    nr_layers=max(1, args.layers // 3),
+                    ctx_size=args.ctx, dtype=dt,
+                )
+                dparams = Llama(small).init(
+                    jax.random.key(1), prompt,
+                    positions=jnp.arange(args.prompt),
+                )
+                spec_measure("spec=S", small, dparams)  # overhead floor
 
 
 if __name__ == "__main__":

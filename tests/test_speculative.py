@@ -101,3 +101,25 @@ def test_validation_and_edges(models):
                                      prompt, 0)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
     assert float(rate) == 0.0
+
+
+def test_eos_semantics_match_generate(models):
+    """eos_id must reproduce generate()'s early-stop semantics exactly:
+    EOS kept, later generated slots pad (0) — even though speculative
+    decoding applies it as a post-pass."""
+    tparams, dparams = models
+    prompt = jax.random.randint(jax.random.key(9), (2, 5), 1, 48)
+    base = np.asarray(generate(TARGET, tparams, prompt, 12))
+    gen = base[:, 5:]
+    eos = None
+    for tok in range(1, 48):
+        if any(tok in r and list(r).index(tok) < gen.shape[1] - 1
+               for r in gen):
+            eos = tok
+            break
+    if eos is None:
+        pytest.skip("no mid-sequence token repeats to use as EOS")
+    want = generate(TARGET, tparams, prompt, 12, eos_id=eos)
+    got, _ = speculative_generate(TARGET, tparams, DRAFT, dparams,
+                                  prompt, 12, gamma=3, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

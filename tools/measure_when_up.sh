@@ -43,6 +43,10 @@ EOF
     timeout 1200 python examples/bench_generate.py --int8 \
       > results/generate_tpu.txt 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) generate bench done (exit $rc)" >> "$LOG"
+    timeout 1200 python examples/bench_generate.py --batches 1 \
+      --kv-heads 6 --speculative 4 \
+      > results/generate_spec_tpu.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) speculative bench done (exit $rc)" >> "$LOG"
     nohup /root/repo/tools/tpu_watch.sh >/dev/null 2>&1 &
     echo "$(date +%H:%M:%S) sentinel finished" >> "$LOG"
     exit 0
