@@ -1,4 +1,6 @@
-"""Greedy speculative decoding: draft proposes, target verifies in one pass.
+"""Speculative decoding: draft proposes, target verifies in one pass —
+greedy mode (bit-exact vs plain greedy decode) and sampling mode
+(distribution-exact modified rejection sampling).
 
 The reference never decodes at all (its LMs only log training loss,
 lab/tutorial_1b/primer/intro.py); this framework's serving stack already has
@@ -12,10 +14,15 @@ public construction), TPU-first:
   ``gamma+1``-token window — the expensive model runs a matmul-shaped
   program every ~``a+1`` committed tokens instead of a bandwidth-bound
   single-token decode every token;
-- greedy acceptance: the longest prefix of proposals matching the target's
-  own argmax is committed, plus the target's correction/bonus token, so the
-  OUTPUT IS EXACTLY THE TARGET'S GREEDY DECODE whatever the draft quality —
-  only the speed varies (oracle: tests/test_speculative.py, any draft).
+- greedy acceptance (``temperature=0``): the longest prefix of proposals
+  matching the target's own argmax is committed, plus the target's
+  correction/bonus token, so the OUTPUT IS EXACTLY THE TARGET'S GREEDY
+  DECODE whatever the draft quality — only the speed varies (oracle:
+  tests/test_speculative.py, any draft);
+- sampling acceptance (``temperature>0``): modified rejection sampling —
+  accept with :func:`acceptance_probs`, fall back to
+  :func:`residual_distribution` — whose induced marginal is EXACTLY the
+  target's sampling distribution (identity + statistical oracles).
 
 Batching: rows accept different counts per step, so their committed lengths
 diverge.  Everything stays static-shaped: each row tracks its own length
@@ -39,6 +46,7 @@ exactly the input of the next draft step, which rewrites it.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -69,6 +77,30 @@ def _row_write_masked(buf, idx, vals, count):
     return buf
 
 
+def acceptance_probs(qd, qt):
+    """Per-token acceptance probability ``min(1, qt/qd)`` (..., V).
+
+    The modified-rejection-sampling rule: a proposal ``x ~ qd`` is accepted
+    with this probability; together with :func:`residual_distribution` the
+    induced marginal is EXACTLY ``qt`` — the identity
+    ``qd(x)·min(1, qt(x)/qd(x)) + P_reject·res(x) = qt(x)``
+    (tests/test_speculative.py pins it numerically).
+    """
+    return jnp.minimum(1.0, qt / jnp.maximum(qd, 1e-38))
+
+
+def residual_distribution(qd, qt):
+    """Rejection fallback distribution ``norm(max(qt - qd, 0))`` (..., V).
+
+    Degenerate case ``qd >= qt`` everywhere means ``qd == qt`` (both
+    normalised), where rejection has probability 0 — return ``qt`` so the
+    branch still holds a valid distribution for the sampler.
+    """
+    res = jnp.maximum(qt - qd, 0.0)
+    s = jnp.sum(res, axis=-1, keepdims=True)
+    return jnp.where(s > 0, res / jnp.maximum(s, 1e-38), qt)
+
+
 def speculative_generate(
     target_config: LlamaConfig,
     target_params,
@@ -80,8 +112,12 @@ def speculative_generate(
     gamma: int = 4,
     prompt_lengths: jax.Array | None = None,
     eos_id: int | None = None,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
 ):
-    """Greedy-decode ``max_new_tokens`` continuations via draft+verify.
+    """Decode ``max_new_tokens`` continuations via draft+verify — greedy
+    (``temperature=0``, bit-identical to plain greedy decode) or sampling
+    (``temperature>0``, distribution-identical to plain sampling).
 
     Same contract as :func:`models.generate.generate` at ``temperature=0``
     — and bit-identical output: ``prompt`` (B, T0) right-padded with
@@ -96,6 +132,18 @@ def speculative_generate(
     decoding past a row's EOS costs a few wasted slots but keeps every
     shape static, and the masked-out region is all zeros either way, so
     the output still matches ``generate(..., eos_id=...)`` bit-for-bit.
+
+    ``temperature > 0`` switches to SAMPLING speculative decoding (modified
+    rejection sampling, the full Leviathan/Chen construction): the draft
+    samples proposals from its own temperature-scaled distribution, each
+    is accepted with :func:`acceptance_probs`' ``min(1, qt/qd)``, and a
+    rejection draws from :func:`residual_distribution` — the output
+    marginal is EXACTLY the target's temperature-``t`` sampling
+    distribution, whatever the draft (the token-level randomness stream
+    differs from ``generate``'s, so sequences are distribution-equal, not
+    bit-equal).  Needs ``key``; RNG is keyed per (row, slot, purpose) so
+    results are independent of round boundaries.  top-k/top-p filters are
+    not supported in this mode (plain temperature sampling only).
     """
     if target_config.vocab_size != draft_config.vocab_size:
         raise ValueError("draft and target must share a vocabulary")
@@ -110,17 +158,18 @@ def speculative_generate(
                 f"max_new_tokens = {total}"
             )
     _check_prompt_lengths(prompt_lengths, T0)
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    sampling = temperature > 0
+    if sampling and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        key = jax.random.key(0)  # unused on the greedy path
     if max_new_tokens == 0:
         if prompt_lengths is None:
             return prompt, jnp.float32(0)
         return _left_align(prompt, T0, prompt_lengths)[0], jnp.float32(0)
 
-    total_buf = total + gamma  # + trailing scratch: windows never clamp
-    tcfg = dataclasses.replace(target_config, decode=True,
-                               ctx_size=total_buf)
-    dcfg = dataclasses.replace(draft_config, decode=True,
-                               ctx_size=total_buf)
-    target, draft = Llama(tcfg), Llama(dcfg)
     tparams = (target_params["params"] if "params" in target_params
                else target_params)
     dparams = (draft_params["params"] if "params" in draft_params
@@ -132,13 +181,59 @@ def speculative_generate(
     else:
         prompt_left, pad0 = _left_align(prompt, T0, prompt_lengths)
     pad = pad0 + gamma  # the gamma spec slots are permanent left pads
-    tokens0 = jnp.zeros((B, total_buf), prompt.dtype)
+    tokens0 = jnp.zeros((B, total + gamma), prompt.dtype)
     tokens0 = jax.lax.dynamic_update_slice(tokens0, prompt_left, (0, gamma))
 
+    run = _spec_fn(target_config, draft_config, gamma, float(temperature),
+                   B, T0, max_new_tokens, eos_id)
+    return run(tparams, dparams, tokens0, pad, key)
+
+
+@functools.lru_cache(maxsize=32)
+def _spec_fn(target_config, draft_config, gamma, temperature, B, T0,
+             max_new_tokens, eos_id):
+    """Build (once per geometry/config) the jitted draft+verify program.
+
+    lru_cached for the same reason as generate._decode_fn: a fresh
+    ``jax.jit`` closure per call would retrace and recompile every time,
+    turning benchmark reps into compile measurements."""
+    sampling = temperature > 0
+    total = gamma + T0 + max_new_tokens
+    total_buf = total + gamma  # + trailing scratch: windows never clamp
     window = gamma + T0  # prefill width
+    tcfg = dataclasses.replace(target_config, decode=True,
+                               ctx_size=total_buf)
+    dcfg = dataclasses.replace(draft_config, decode=True,
+                               ctx_size=total_buf)
+    target, draft = Llama(tcfg), Llama(dcfg)
 
     @jax.jit
-    def run(tparams, dparams, tokens, pad):
+    def run(tparams, dparams, tokens, pad, key):
+        rows = jnp.arange(B)
+
+        def keys_for(slots, tag):
+            """Per-(row, slot, purpose) keys — independent of how rounds
+            happen to chunk the slots.  tag: 0 proposal, 1 accept-u,
+            2 correction/bonus."""
+
+            def one(r, s):
+                return jax.random.fold_in(
+                    jax.random.fold_in(key, r), s * 3 + tag
+                )
+
+            if slots.ndim == 1:
+                return jax.vmap(one)(rows, slots)
+            return jax.vmap(
+                lambda r, ss: jax.vmap(lambda s: one(r, s))(ss)
+            )(rows, slots)
+
+        def sample_rows(ks, logits):
+            """One categorical draw per row from temperature-scaled
+            logits; ks (B,) keys, logits (B, V)."""
+            return jax.vmap(
+                lambda k, l: jax.random.categorical(k, l / temperature)
+            )(ks, logits).astype(tokens.dtype)
+
         prefill_pos = jnp.arange(window)
         t_logits, tvars = target.apply(
             {"params": tparams}, tokens[:, :window],
@@ -148,7 +243,13 @@ def speculative_generate(
             {"params": dparams}, tokens[:, :window],
             positions=prefill_pos, pad=pad, mutable=["cache"],
         )
-        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(tokens.dtype)
+        if sampling:
+            first = sample_rows(
+                keys_for(jnp.full((B,), window, jnp.int32), 2),
+                t_logits[:, -1],
+            )
+        else:
+            first = jnp.argmax(t_logits[:, -1], axis=-1).astype(tokens.dtype)
         tokens = _row_write_masked(
             tokens, jnp.full((B,), window, jnp.int32), first[:, None],
             jnp.ones((B,), jnp.int32),
@@ -174,7 +275,12 @@ def speculative_generate(
                 catch, positions=cpos, pad=pad, mutable=["cache"],
             )
             dcache = dv["cache"]
-            p1 = jnp.argmax(clog[:, -1], axis=-1).astype(tokens.dtype)
+            if sampling:
+                p1 = sample_rows(keys_for(L, 0), clog[:, -1])
+                qd1 = jax.nn.softmax(clog[:, -1] / temperature, axis=-1)
+            else:
+                p1 = jnp.argmax(clog[:, -1], axis=-1).astype(tokens.dtype)
+                qd1 = jnp.zeros((B, 1))  # unused
 
             def dstep(c, _):
                 dcache, cur_tok, cur_pos = c
@@ -183,14 +289,28 @@ def speculative_generate(
                     cur_tok[:, None], positions=cur_pos[:, None], pad=pad,
                     mutable=["cache"],
                 )
-                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tokens.dtype)
-                return (dv["cache"], nxt, cur_pos + 1), nxt
+                if sampling:
+                    nxt = sample_rows(keys_for(cur_pos + 1, 0),
+                                      logits[:, 0])
+                    qd_row = jax.nn.softmax(logits[:, 0] / temperature,
+                                            axis=-1)
+                else:
+                    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(
+                        tokens.dtype
+                    )
+                    qd_row = jnp.zeros((B, 1))  # unused
+                return (dv["cache"], nxt, cur_pos + 1), (nxt, qd_row)
 
-            (dcache, _, _), rest = jax.lax.scan(
+            (dcache, _, _), (rest, qd_rest) = jax.lax.scan(
                 dstep, (dcache, p1, L), None, length=gamma - 1
             )
             props = jnp.concatenate([p1[:, None], rest.T], axis=1)
             # (B, gamma): proposals for slots L..L+gamma-1
+            if sampling:
+                # (B, gamma, V): the draft distribution at each proposal
+                qd = jnp.concatenate(
+                    [qd1[:, None], jnp.moveaxis(qd_rest, 0, 1)], axis=1
+                )
 
             # --- verify: one (gamma+1)-window target forward -----------
             tokens_p = _row_write_masked(
@@ -203,13 +323,47 @@ def speculative_generate(
                 win, positions=pos, pad=pad, mutable=["cache"],
             )
             tcache = tv["cache"]
-            tgt = jnp.argmax(t_logits, axis=-1).astype(tokens.dtype)
-            # tgt[:, j] = the target's greedy token for slot L+j
-
-            # --- greedy acceptance + commit ----------------------------
-            match = (props == tgt[:, :gamma]).astype(jnp.int32)  # (B, g)
-            a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)      # (B,)
-            corr = jnp.take_along_axis(tgt, a[:, None], axis=1)  # (B, 1)
+            if sampling:
+                # --- rejection-sampling acceptance ---------------------
+                qt = jax.nn.softmax(t_logits / temperature, axis=-1)
+                qtp = jnp.take_along_axis(
+                    qt[:, :gamma], props[..., None], axis=-1
+                )[..., 0]
+                qdp = jnp.take_along_axis(
+                    qd, props[..., None], axis=-1
+                )[..., 0]
+                alpha = acceptance_probs(qdp, qtp)
+                slots = L[:, None] + jnp.arange(gamma)[None, :]
+                u = jax.vmap(jax.vmap(jax.random.uniform))(
+                    keys_for(slots, 1)
+                )
+                accept = (u < alpha).astype(jnp.int32)          # (B, g)
+                a = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
+                # correction: residual at the reject position; the padded
+                # qd row is 0 at index gamma, so a full accept falls back
+                # to plain target sampling of the bonus token
+                qd_pad = jnp.concatenate(
+                    [qd, jnp.zeros((B, 1, qd.shape[-1]))], axis=1
+                )
+                qt_a = jnp.take_along_axis(
+                    qt, a[:, None, None], axis=1
+                )[:, 0]
+                qd_a = jnp.take_along_axis(
+                    qd_pad, a[:, None, None], axis=1
+                )[:, 0]
+                res = residual_distribution(qd_a, qt_a)
+                corr = jax.vmap(
+                    lambda k, p: jax.random.categorical(
+                        k, jnp.log(jnp.maximum(p, 1e-38))
+                    )
+                )(keys_for(L + a, 2), res).astype(tokens.dtype)[:, None]
+            else:
+                # --- greedy acceptance ---------------------------------
+                tgt = jnp.argmax(t_logits, axis=-1).astype(tokens.dtype)
+                # tgt[:, j] = the target's greedy token for slot L+j
+                match = (props == tgt[:, :gamma]).astype(jnp.int32)
+                a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # (B,)
+                corr = jnp.take_along_axis(tgt, a[:, None], axis=1)
             cand = jnp.where(
                 jnp.arange(gamma + 1)[None, :] < a[:, None],
                 jnp.concatenate(
@@ -248,4 +402,4 @@ def speculative_generate(
             out = jnp.where(hits - hit.astype(jnp.int32) >= 1, 0, out)
         return out, rate
 
-    return run(tparams, dparams, tokens0, pad)
+    return run

@@ -123,3 +123,79 @@ def test_eos_semantics_match_generate(models):
     got, _ = speculative_generate(TARGET, tparams, DRAFT, dparams,
                                   prompt, 12, gamma=3, eos_id=eos)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# sampling mode (modified rejection sampling)
+# ---------------------------------------------------------------------------
+
+
+def test_rejection_sampling_identity():
+    """The Leviathan identity the implementation is built on:
+    qd(x)*min(1, qt(x)/qd(x)) + P_reject * residual(x) == qt(x) for every
+    token — checked numerically on random distributions."""
+    from ddl25spring_tpu.models.speculative import (
+        acceptance_probs,
+        residual_distribution,
+    )
+
+    k1, k2 = jax.random.split(jax.random.key(0))
+    qd = jax.nn.softmax(jax.random.normal(k1, (5, 11)) * 2.0, -1)
+    qt = jax.nn.softmax(jax.random.normal(k2, (5, 11)) * 2.0, -1)
+    alpha = acceptance_probs(qd, qt)
+    res = residual_distribution(qd, qt)
+    p_reject = 1.0 - jnp.sum(qd * alpha, axis=-1, keepdims=True)
+    marginal = qd * alpha + p_reject * res
+    np.testing.assert_allclose(np.asarray(marginal), np.asarray(qt),
+                               atol=1e-6)
+    # degenerate case: qd == qt -> accept everywhere, residual stays valid
+    res_eq = residual_distribution(qd, qd)
+    np.testing.assert_allclose(np.asarray(res_eq.sum(-1)), 1.0, atol=1e-6)
+
+
+def test_sampling_self_draft_always_accepts(models):
+    """qd == qt bitwise (self-draft) makes every acceptance ratio exactly
+    1, so uniform draws in [0, 1) always accept: rate == 1.0."""
+    tparams, _ = models
+    prompt = jax.random.randint(jax.random.key(5), (2, 5), 1, 48)
+    out, rate = speculative_generate(
+        TARGET, tparams, TARGET, tparams, prompt, 12, gamma=3,
+        temperature=0.8, key=jax.random.key(11),
+    )
+    assert float(rate) == 1.0
+    assert out.shape == (2, 17)
+    assert np.asarray((out >= 0) & (out < 48)).all()
+
+
+def test_sampling_preserves_target_marginal(models):
+    """The whole point of rejection sampling: the SECOND generated token's
+    marginal (the first to pass through propose/accept/reject) must match
+    the analytic target marginal sum_t1 p(t1) p(t2|t1).  Deterministic
+    given the fixed seed; 1500 identical rows are the sample dimension
+    (per-row RNG keys differ)."""
+    tparams, dparams = models
+    N, V, temp = 1500, 48, 1.0
+    prompt1 = jax.random.randint(jax.random.key(6), (1, 5), 1, V)
+    prompt = jnp.tile(prompt1, (N, 1))
+
+    out, _ = speculative_generate(
+        TARGET, tparams, DRAFT, dparams, prompt, 3, gamma=2,
+        temperature=temp, key=jax.random.key(12),
+    )
+    tok2 = np.asarray(out[:, 6])  # slot T0+1: the first spec-round token
+
+    # analytic marginal: p(t1) from the prompt forward; p(t2|t1) from one
+    # batched forward over all V possible first tokens
+    model = Llama(TARGET)
+    logits1 = model.apply(tparams, prompt1, positions=jnp.arange(5))
+    p1 = np.asarray(jax.nn.softmax(logits1[0, -1] / temp))
+    seqs = jnp.concatenate(
+        [jnp.tile(prompt1, (V, 1)), jnp.arange(V)[:, None]], axis=1
+    )
+    logits2 = model.apply(tparams, seqs, positions=jnp.arange(6))
+    p2 = np.asarray(jax.nn.softmax(logits2[:, -1] / temp, axis=-1))
+    want = p1 @ p2  # (V,) marginal of token 2
+
+    hist = np.bincount(tok2, minlength=V) / N
+    tv = 0.5 * np.abs(hist - want).sum()
+    assert tv < 0.10, f"total variation {tv:.3f} (want {want[:6]}...)"
