@@ -254,13 +254,16 @@ class Attention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v, (0, offset, 0, 0)
             )
-        if cfg.decode_impl == "flash-decode" and T == 1 and not per_row:
+        if cfg.decode_impl == "flash-decode" and T == 1:
             # Pallas kernel streams only the LIVE cache prefix (scalar-
-            # prefetch-clamped DMA); prefill (T > 1) keeps the einsum below
+            # prefetch-clamped DMA); prefill (T > 1) keeps the einsum
+            # below.  Per-row positions pass as a (B,) pos vector — each
+            # row's DMA clamp and masks use its own slot.
             from ..ops.flash_decode import flash_decode_attention
 
             out = flash_decode_attention(
-                q[:, 0], ck.value, cv.value, offset, pad,
+                q[:, 0], ck.value, cv.value,
+                positions[:, 0] if per_row else offset, pad,
             )
             return out[:, None]  # (B, 1, H, hd)
         # (B, T, Hkv, group, hd): query heads grouped by the KV head they share

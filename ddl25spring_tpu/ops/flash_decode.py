@@ -48,7 +48,7 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
             *, block_k, scale, nr_k):
     b = pl.program_id(0)
     j = pl.program_id(2)
-    pos = pos_ref[0]
+    pos = pos_ref[b]  # per-row positions (speculative decode rows diverge)
 
     @pl.when(j == 0)
     def _init():
@@ -90,9 +90,12 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     """One decode step against the cache, reading only live blocks.
 
     ``q``: (B, Hq, hd) this step's queries; ``cache_k``/``cache_v``:
-    (B, S, Hkv, hd) with Hq a multiple of Hkv (GQA); ``pos``: scalar int32
-    current slot (rows ``<= pos`` are live); ``pad``: (B,) left-pad widths
-    for ragged batches (None = all zeros).  Returns (B, Hq, hd).
+    (B, S, Hkv, hd) with Hq a multiple of Hkv (GQA); ``pos``: the current
+    slot — scalar int32 (all rows lockstep, plain generation) or (B,)
+    int32 per-row slots (speculative decoding, where rows commit at
+    different rates; each row's DMA clamp and mask use its own value);
+    rows ``<= pos`` are live.  ``pad``: (B,) left-pad widths for ragged
+    batches (None = all zeros).  Returns (B, Hq, hd).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -104,7 +107,7 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     scale = 1.0 / (hd ** 0.5)
     if pad is None:
         pad = jnp.zeros((B,), jnp.int32)
-    pos = jnp.asarray(pos, jnp.int32).reshape(1)
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
     qg = q.reshape(B, Hkv, g, hd)
     # pad the group dim to the f32 sublane multiple: (g_pad, hd) q tiles
     # and (g_pad, 1) scratches are vreg-native layouts Mosaic always
@@ -115,10 +118,10 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     if g_pad != g:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - g), (0, 0)))
 
-    def live(j, pos_v):
-        # clamp dead trailing blocks to the last live one: repeated index
-        # -> the pipeline skips the DMA
-        return jnp.minimum(j, pos_v[0] // block_k)
+    def live(b, j, pos_v):
+        # clamp dead trailing blocks to the row's last live one: repeated
+        # index -> the pipeline skips the DMA
+        return jnp.minimum(j, pos_v[b] // block_k)
 
     # index maps receive (*grid_indices, *scalar_prefetch_refs)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -129,10 +132,10 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
                          lambda b, h, j, pos_v, pad_v: (b, h, 0, 0)),
             pl.BlockSpec((1, block_k, 1, hd),
                          lambda b, h, j, pos_v, pad_v:
-                         (b, live(j, pos_v), h, 0)),
+                         (b, live(b, j, pos_v), h, 0)),
             pl.BlockSpec((1, block_k, 1, hd),
                          lambda b, h, j, pos_v, pad_v:
-                         (b, live(j, pos_v), h, 0)),
+                         (b, live(b, j, pos_v), h, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, g_pad, hd),
                                lambda b, h, j, pos_v, pad_v: (b, h, 0, 0)),

@@ -95,3 +95,31 @@ def test_flash_decode_head_grouping_matrix():
             _xla_decode(q, ck, cv, 17, pad),
             atol=1e-5, err_msg=f"Hq={Hq} Hkv={Hkv}",
         )
+
+
+def test_flash_decode_per_row_positions():
+    """(B,) pos vector: each row's live prefix, DMA clamp and mask use its
+    own slot (the speculative-decoding layout where rows diverge)."""
+    B, S, Hq, Hkv, hd = 4, 96, 4, 2, 16
+    ks = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(ks[0], (B, Hq, hd))
+    ck = jax.random.normal(ks[1], (B, S, Hkv, hd))
+    cv = jax.random.normal(ks[2], (B, S, Hkv, hd))
+    pos = jnp.asarray([5, 50, 95, 17], jnp.int32)
+    pad = jnp.asarray([0, 3, 0, 2], jnp.int32)
+
+    got = flash_decode_attention(q, ck, cv, pos, pad)
+    # per-row oracle: full-cache einsum with a per-row visibility window
+    g = Hq // Hkv
+    qg = q.reshape(B, Hkv, g, hd)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, ck).astype(jnp.float32)
+    scores = scores * scale
+    valid = (jnp.arange(S)[None, :] <= pos[:, None]) & (
+        jnp.arange(S)[None, :] >= pad[:, None]
+    )
+    scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
+    att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    want = jnp.einsum("bkgs,bskd->bkgd", att, cv).reshape(B, Hq, hd)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)

@@ -199,3 +199,17 @@ def test_sampling_preserves_target_marginal(models):
     hist = np.bincount(tok2, minlength=V) / N
     tv = 0.5 * np.abs(hist - want).sum()
     assert tv < 0.10, f"total variation {tv:.3f} (want {want[:6]}...)"
+
+
+def test_speculative_with_flash_decode_impl(models):
+    """decode_impl='flash-decode' threads the per-row pos vector through
+    the Pallas kernel inside speculative decoding — output must still be
+    the target's exact greedy decode."""
+    tparams, dparams = models
+    fcfg = dataclasses.replace(TARGET, decode_impl="flash-decode")
+    fdcfg = dataclasses.replace(DRAFT, decode_impl="flash-decode")
+    prompt = jax.random.randint(jax.random.key(13), (2, 5), 1, 48)
+    want = generate(TARGET, tparams, prompt, 10)
+    got, _ = speculative_generate(fcfg, tparams, fdcfg, dparams,
+                                  prompt, 10, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

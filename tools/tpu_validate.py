@@ -233,6 +233,36 @@ def main():
 
         check(f"flash_decode Hq={Hq} Hkv={Hkv} ragged", dec_err, 1e-4)
 
+    # per-row pos vector (speculative-decoding layout): each row's DMA
+    # clamp and mask use its own slot
+    kk = jax.random.split(jax.random.fold_in(key, 4242), 3)
+    B, S, hd = 4, (256 if INTERPRET else 1024), 64
+    q = jax.random.normal(kk[0], (B, 8, hd)) * 0.5
+    ck = jax.random.normal(kk[1], (B, S, 4, hd)) * 0.5
+    cv = jax.random.normal(kk[2], (B, S, 4, hd)) * 0.5
+    pad = jnp.asarray([0, 3, 17, 0], jnp.int32)
+    pos_v = jnp.asarray([5, S // 2, S - 1, 63], jnp.int32)
+
+    def dec_rowpos_err(q=q, ck=ck, cv=cv, pad=pad, pos_v=pos_v):
+        got = jax.jit(
+            lambda *a: flash_decode_attention(*a, interpret=INTERPRET)
+        )(q, ck, cv, pos_v, pad)
+        # per-row oracle: full-cache einsum, per-row visibility window
+        g = 8 // 4
+        qg = q.reshape(B, 4, g, hd)
+        scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+        s = jnp.einsum("bkgd,bskd->bkgs", qg, ck).astype(jnp.float32)
+        s = s * scale
+        valid = (jnp.arange(S)[None, :] <= pos_v[:, None]) & (
+            jnp.arange(S)[None, :] >= pad[:, None]
+        )
+        s = jnp.where(valid[:, None, None], s, -jnp.inf)
+        att = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        want = jnp.einsum("bkgs,bskd->bkgd", att, cv).reshape(B, 8, hd)
+        return jnp.max(jnp.abs(got - want))
+
+    check("flash_decode per-row pos vector", dec_rowpos_err, 1e-4)
+
     # --- end-to-end: generation with flash-decode vs xla decode ----------
     # Scored as the FRACTION of generated tokens that differ: a wiring or
     # lowering bug gives near-random agreement (~1/vocab); ulp-level
