@@ -14,12 +14,14 @@ from .llama import (
     full_params_to_stage_params,
 )
 from .generate import generate
+from .distill import distill_draft
 from .speculative import speculative_generate
 from .quant import QuantDense, quantize_llama_params
 
 __all__ = [
     "generate",
     "speculative_generate",
+    "distill_draft",
     "QuantDense",
     "quantize_llama_params",
     "MnistCnn",

@@ -1,0 +1,110 @@
+"""Draft-model distillation for speculative decoding.
+
+Speculative decoding's speedup is ``~(a+1)`` committed tokens per target
+forward, so it lives or dies by the draft's acceptance rate — and a
+randomly initialised draft accepts ~1/vocab of proposals.  This utility
+closes the loop: distill a small draft to mimic the target's next-token
+distributions (standard soft-label distillation, Hinton et al. — public),
+then hand both to :func:`models.speculative.speculative_generate`.
+
+The loss is the per-position cross-entropy of the draft's logits against
+the target's softmax (== KL(target || draft) up to the target's constant
+entropy), averaged over a token stream.  One jitted update step; the
+target's logits come from a single forward with frozen params.
+
+tests/test_speculative.py pins the effect end-to-end: a distilled draft's
+acceptance rate must beat the random-init draft's on the same prompts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .llama import Llama, LlamaConfig
+
+
+def distill_draft(
+    target_config: LlamaConfig,
+    target_params,
+    draft_config: LlamaConfig,
+    *,
+    steps: int = 300,
+    batch_size: int = 8,
+    seq_l: int = 64,
+    lr: float = 1e-3,
+    key: jax.Array | None = None,
+    batches=None,
+    data: str = "target",
+):
+    """Train ``draft_config``-shaped params to mimic the target; returns
+    ``(draft_params, losses)``.
+
+    Training data, in descending order of precedence:
+
+    - ``batches``: an iterator of (batch_size, seq_l) int32 token arrays
+      (e.g. a real corpus stream);
+    - ``data="target"`` (default): sequences SAMPLED FROM THE TARGET
+      (temperature 1) from random single-token prompts — the same
+      distribution the draft will face inside speculative decoding, where
+      every accepted prefix is target-generated text.  Distilling on
+      uniform random tokens instead leaves the draft out-of-distribution
+      exactly where acceptance is measured (observed: 0.04 vs 0.4+);
+    - ``data="random"``: uniform random tokens (cheapest, weakest).
+    """
+    if target_config.vocab_size != draft_config.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    key = jax.random.key(0) if key is None else key
+    init_key, data_key = jax.random.split(key)
+
+    target = Llama(target_config)
+    draft = Llama(draft_config)
+    tparams = (target_params["params"] if "params" in target_params
+               else target_params)
+    dummy = jnp.zeros((1, seq_l), jnp.int32)
+    dparams = draft.init(init_key, dummy, positions=jnp.arange(seq_l))
+    opt = optax.adam(lr)
+    opt_state = opt.init(dparams)
+
+    @jax.jit
+    def step(dparams, opt_state, tokens):
+        soft = jax.nn.softmax(
+            target.apply({"params": tparams}, tokens), axis=-1
+        )
+
+        def loss_fn(dp):
+            logits = draft.apply(dp, tokens)
+            return jnp.mean(optax.softmax_cross_entropy(logits, soft))
+
+        loss, grads = jax.value_and_grad(loss_fn)(dparams)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(dparams, updates), opt_state, loss
+
+    if data not in ("target", "random"):
+        raise ValueError(f"data={data!r} not in ('target', 'random')")
+    if data == "target" and batches is None:
+        from .generate import generate
+
+        def draw(i):
+            ki = jax.random.fold_in(data_key, i)
+            kp, ks = jax.random.split(ki)
+            prompts = jax.random.randint(
+                kp, (batch_size, 1), 0, target_config.vocab_size
+            )
+            return generate(target_config, target_params, prompts,
+                            seq_l - 1, temperature=1.0, key=ks)
+    else:
+        def draw(i):
+            return jax.random.randint(
+                jax.random.fold_in(data_key, i),
+                (batch_size, seq_l), 0, target_config.vocab_size,
+            )
+
+    losses = []
+    for i in range(steps):
+        tokens = (jnp.asarray(next(batches)) if batches is not None
+                  else draw(i))
+        dparams, opt_state, loss = step(dparams, opt_state, tokens)
+        losses.append(float(loss))
+    return dparams, losses
