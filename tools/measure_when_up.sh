@@ -33,6 +33,10 @@ EOF
     timeout 1800 python bench.py --deadline-s 900 --cost-analysis \
       > results/bench_tpu_costs.json 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) cost analysis done (exit $rc)" >> "$LOG"
+    timeout 1800 python bench.py --deadline-s 900 --cost-analysis \
+      --norm-impl lean \
+      > results/bench_tpu_costs_lean.json 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) lean cost analysis done (exit $rc)" >> "$LOG"
     timeout 2400 python examples/bench_flash.py --check \
       > results/flash_tpu.txt 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) flash bench done (exit $rc)" >> "$LOG"
