@@ -389,3 +389,39 @@ def test_run_lm_ep_capacity_strategy():
         log_every=4,
     )
     assert losses[-1] < losses[0]
+
+
+def test_moe_serving_compositions():
+    """MoE composes with the whole serving stack: KV-cache generation
+    equals iterated full-forward argmax, the capacity-dispatch layer
+    decodes, and speculative decoding with an MoE target reproduces plain
+    greedy (self-draft rate 1.0)."""
+    import dataclasses
+
+    import numpy as np
+
+    from ddl25spring_tpu.models import generate, speculative_generate
+
+    cfg = LlamaConfig(vocab_size=48, dmodel=32, nr_heads=4, nr_layers=2,
+                      ctx_size=48, nr_experts=4, expert_topk=2)
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 1, 48)
+    params = Llama(cfg).init(jax.random.key(0), prompt,
+                             positions=jnp.arange(5))
+    out = generate(cfg, params, prompt, 8)
+
+    seq = prompt
+    for _ in range(8):
+        logits = Llama(cfg).apply(params, seq)
+        seq = jnp.concatenate([seq, jnp.argmax(logits[:, -1:], -1)], 1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+    ccfg = dataclasses.replace(cfg, moe_dispatch="capacity",
+                               moe_capacity_factor=4.0)
+    cparams = Llama(ccfg).init(jax.random.key(0), prompt,
+                               positions=jnp.arange(5))
+    assert generate(ccfg, cparams, prompt, 8).shape == (2, 13)
+
+    got, rate = speculative_generate(cfg, params, cfg, params, prompt, 8,
+                                     gamma=2)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(out))
+    assert float(rate) == 1.0
