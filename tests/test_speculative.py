@@ -270,3 +270,23 @@ def test_distilled_draft_beats_random_draft():
     assert float(rate_dist) > float(rate_rand) + 0.3, (
         f"distilled {float(rate_dist):.2f} vs random {float(rate_rand):.2f}"
     )
+
+
+def test_int8_serving_composes_with_speculative(models):
+    """int8 weight-only serving (models/quant.py) composes with
+    speculative decoding: an int8 target (self-draft and with an fp
+    draft) reproduces the int8 plain-greedy output exactly."""
+    from ddl25spring_tpu.models import quantize_llama_params
+
+    tparams, _ = models
+    qcfg = dataclasses.replace(TARGET, weights_int8=True)
+    qparams = quantize_llama_params(tparams)
+    prompt = jax.random.randint(jax.random.key(40), (2, 5), 1, 48)
+    want = generate(qcfg, qparams, prompt, 8)
+    got, rate = speculative_generate(qcfg, qparams, qcfg, qparams,
+                                     prompt, 8, gamma=2)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert float(rate) == 1.0
+    got2, _ = speculative_generate(qcfg, qparams, TARGET, tparams,
+                                   prompt, 8, gamma=2)
+    np.testing.assert_array_equal(np.asarray(got2), np.asarray(want))
