@@ -118,13 +118,19 @@ def _check_prompt_lengths(prompt_lengths, T0: int) -> None:
     if prompt_lengths is None:
         return
     pl = jnp.asarray(prompt_lengths)
-    if not isinstance(pl, jax.core.Tracer):
-        bad = (pl < 1) | (pl > T0)
-        if bool(jnp.any(bad)):
-            raise ValueError(
-                f"prompt_lengths must satisfy 1 <= length <= {T0} "
-                f"(prompt width); got {list(map(int, pl))}"
-            )
+    if isinstance(pl, jax.core.Tracer):
+        return
+    try:
+        bad = bool(jnp.any((pl < 1) | (pl > T0)))
+    except jax.errors.TracerBoolConversionError:
+        # under some traces (e.g. a shard_map body) even closed-over
+        # concrete arrays surface as tracers the isinstance above misses
+        return
+    if bad:
+        raise ValueError(
+            f"prompt_lengths must satisfy 1 <= length <= {T0} "
+            f"(prompt width); got {list(map(int, pl))}"
+        )
 
 
 def _left_align(prompt, T0: int, prompt_lengths):

@@ -61,6 +61,13 @@ class LlamaConfig:
     decode_impl: str = "xla"   # xla (einsum over the whole cache) |
     #                            flash-decode (Pallas, reads only live
     #                            cache blocks; ops/flash_decode.py)
+    decode_seq_shards: int = 1  # >1: KV cache sharded over `seq_axis`
+    #                             (parallel/sp.py make_sp_generate) — each
+    #                             device owns ctx_size/shards cache slots;
+    #                             attention merges partial results with an
+    #                             exact distributed log-sum-exp (pmax+psum).
+    #                             Serves contexts whose cache exceeds one
+    #                             chip's HBM.
 
     def __post_init__(self):
         if self.attn_impl not in ("dense", "ring", "flash", "ring-flash",
@@ -80,6 +87,18 @@ class LlamaConfig:
             raise ValueError(
                 f"decode_impl={self.decode_impl!r} not in ('xla', "
                 "'flash-decode')"
+            )
+        if self.decode_seq_shards > 1 and \
+                self.ctx_size % self.decode_seq_shards:
+            raise ValueError(
+                f"ctx_size={self.ctx_size} not divisible by "
+                f"decode_seq_shards={self.decode_seq_shards}"
+            )
+        if self.decode_seq_shards > 1 and self.decode_impl != "xla":
+            raise ValueError(
+                "decode_seq_shards > 1 uses its own distributed-merge "
+                "attention and would silently ignore "
+                f"decode_impl={self.decode_impl!r}; set decode_impl='xla'"
             )
         if self.moe_dispatch not in ("dense", "capacity"):
             raise ValueError(
@@ -224,6 +243,14 @@ class Attention(nn.Module):
         B, T = q.shape[:2]
         S = cfg.ctx_size
         Hkv = cfg.kv_heads
+        if cfg.decode_seq_shards > 1:
+            if positions.ndim == 2:
+                raise NotImplementedError(
+                    "sharded-cache decode supports lockstep (1-D) "
+                    "positions only; speculative decoding needs the "
+                    "single-device cache"
+                )
+            return self._sharded_decode_attention(q, k, v, positions, pad)
         zeros = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), q.dtype)
         ck = self.variable("cache", "k", zeros)
         cv = self.variable("cache", "v", zeros)
@@ -297,6 +324,69 @@ class Attention(nn.Module):
         scores = jnp.where(visible, scores, -jnp.inf)
         att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bkgts,bskd->btkgd", att, cv.value)
+        return out.reshape(B, T, cfg.nr_heads, cfg.head_dim)
+
+
+    def _sharded_decode_attention(self, q, k, v, positions, pad=None):
+        """Decode attention against a SEQ-SHARDED cache (inside shard_map
+        over ``cfg.seq_axis``; parallel/sp.py::make_sp_generate).
+
+        Each device's ``cache`` variable holds its ctx/shards slice of the
+        slots; queries and new K/V are replicated (every device computes
+        them — cheap next to the cache they'd otherwise all hold), writes
+        are masked to the owning device's window, and attention merges the
+        per-device partial results with the exact distributed
+        log-sum-exp: ``m = pmax(local max)``, then ONE fused ``psum`` of
+        the (numerator, denominator) pair.  Two collective launches per
+        layer per step, each O(B·H·T·hd) — the cache itself, the HBM
+        cost that motivates sharding, never moves.
+        """
+        cfg = self.config
+        B, T = q.shape[:2]
+        shards = cfg.decode_seq_shards
+        S_local = cfg.ctx_size // shards
+        Hkv = cfg.kv_heads
+        zeros = lambda: jnp.zeros((B, S_local, Hkv, cfg.head_dim), q.dtype)
+        ck = self.variable("cache", "k", zeros)
+        cv = self.variable("cache", "v", zeros)
+        idx = jax.lax.axis_index(cfg.seq_axis)
+        local_ids = idx * S_local + jnp.arange(S_local)  # global slot ids
+
+        if pad is not None:
+            real = (positions[None, :] >= pad[:, None])[..., None, None]
+            k = jnp.where(real, k, 0)
+            v = jnp.where(real, v, 0)
+        # owner-masked scatter-write: window slot t lands at local index
+        # positions[t] - idx*S_local; out-of-range indices (slots owned by
+        # other shards) are DROPPED, so each step touches at most T cache
+        # rows (the non-sharded path's O(1)-write property, kept)
+        local_idx = positions - idx * S_local          # (T,)
+        ck.value = ck.value.at[:, local_idx].set(k, mode="drop")
+        cv.value = cv.value.at[:, local_idx].set(v, mode="drop")
+
+        qg = q.reshape(B, T, Hkv, cfg.nr_heads // Hkv, cfg.head_dim)
+        scale = 1.0 / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck.value).astype(
+            jnp.float32
+        ) * scale                                      # (B,Hkv,g,T,S_local)
+        visible = local_ids[None, :] <= positions[:, None]  # (T, S_local)
+        visible = visible[None, None, None]
+        if pad is not None:
+            real = local_ids[None, :] >= pad[:, None]  # (B, S_local)
+            visible = visible & real[:, None, None, None, :]
+        scores = jnp.where(visible, scores, -jnp.inf)
+
+        # distributed log-sum-exp merge (exact): global max first, then
+        # one psum for the numerator and one for the denominator
+        m_loc = jnp.max(scores, axis=-1)               # (B,Hkv,g,T)
+        m = jax.lax.pmax(m_loc, cfg.seq_axis)
+        # a shard whose every slot is masked contributes exp(-inf - m)=0;
+        # m itself is finite (>= the diagonal slot on the owning shard)
+        p = jnp.exp(scores - m[..., None])
+        num = jnp.einsum("bkgts,bskd->btkgd", p.astype(q.dtype), cv.value)
+        den = jnp.sum(p, axis=-1)                      # (B,Hkv,g,T)
+        num, den = jax.lax.psum((num, den), cfg.seq_axis)
+        out = num / den.transpose(0, 3, 1, 2)[..., None].astype(q.dtype)
         return out.reshape(B, T, cfg.nr_heads, cfg.head_dim)
 
 

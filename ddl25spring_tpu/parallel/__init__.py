@@ -16,7 +16,12 @@ from .compress import (
 )
 from .multihost import initialize_multihost, make_multihost_mesh
 from .zero import make_zero_dp_train_step
-from .sp import make_sp_forward, make_sp_train_step, sp_data_sharding
+from .sp import (
+    make_sp_forward,
+    make_sp_generate,
+    make_sp_train_step,
+    sp_data_sharding,
+)
 from .pp_1f1b import make_1f1b_grad_fn, make_1f1b_train_step
 from .pp_interleaved import (
     bubble_fraction,
@@ -33,6 +38,7 @@ __all__ = [
     "make_interleaved_1f1b_grad_fn",
     "make_interleaved_1f1b_train_step",
     "make_sp_forward",
+    "make_sp_generate",
     "make_sp_train_step",
     "sp_data_sharding",
     "make_mesh",

@@ -16,7 +16,7 @@ over ``data``, sequence over ``seq``.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -137,3 +137,79 @@ def sp_data_sharding(mesh, seq_axis: str = "seq",
                      data_axis: str | None = None) -> NamedSharding:
     """Sharding for the (B, T) token batch consumed by the SP step."""
     return NamedSharding(mesh, P(data_axis, seq_axis))
+
+
+def make_sp_generate(config: LlamaConfig, mesh, seq_axis: str = "seq"):
+    """Sequence-sharded KV-cache generation: serve contexts whose cache
+    exceeds one chip's HBM.
+
+    Ring attention (above) scales TRAINING past one chip; this is its
+    decode-side counterpart: the fixed (B, ctx, Hkv, hd) cache is sharded
+    over ``seq_axis`` — each device holds ctx/n slots — and every decode
+    step merges per-device partial attention with an exact distributed
+    log-sum-exp (models/llama.py::_sharded_decode_attention; two O(B·H·hd)
+    collectives per layer, the cache bytes never move).  Queries, params
+    and emitted tokens are replicated, so the returned callable has
+    exactly :func:`models.generate.generate`'s contract (greedy and
+    sampling, ragged prompts, eos_id), just with 1/n of the cache per
+    device.
+
+    Returns ``generate_fn(params, prompt, max_new_tokens, *,
+    temperature=0, top_k=0, top_p=1.0, key=None, prompt_lengths=None,
+    eos_id=None)``.
+    """
+    n = mesh.shape[seq_axis]
+    gen_config = dataclasses.replace(
+        config, decode_seq_shards=n, seq_axis=seq_axis
+    )
+
+    def generate_fn(params, prompt, max_new_tokens, *, temperature=0.0,
+                    top_k=0, top_p=1.0, key=None, prompt_lengths=None,
+                    eos_id=None):
+        # host-side validation runs here, where lengths are concrete (in
+        # the shard_map body they trace)
+        from ..models.generate import _check_prompt_lengths
+
+        _check_prompt_lengths(prompt_lengths, prompt.shape[1])
+        run = _sp_generate_fn(
+            gen_config, mesh, seq_axis, max_new_tokens,
+            float(temperature), int(top_k), float(top_p), eos_id,
+            prompt_lengths is not None, key is not None,
+        )
+        lengths = (jnp.zeros((prompt.shape[0],), jnp.int32)
+                   if prompt_lengths is None
+                   else jnp.asarray(prompt_lengths, jnp.int32))
+        return run(params, prompt, lengths,
+                   jax.random.key(0) if key is None else key)
+
+    return generate_fn
+
+
+@lru_cache(maxsize=32)
+def _sp_generate_fn(gen_config, mesh, seq_axis, max_new_tokens,
+                    temperature, top_k, top_p, eos_id, has_lengths,
+                    has_key):
+    """One shard_map-wrapped decode program per geometry — a fresh closure
+    per call would miss jax's dispatch cache (keyed on callable identity)
+    and re-trace the whole prefill+scan every request, exactly what
+    generate._decode_fn's lru_cache exists to avoid."""
+    from ..models.generate import generate as _generate
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(), P()), out_specs=P(), check_vma=False,
+    )
+    def run(params, prompt, lengths, key):
+        kw = {}
+        if has_lengths:
+            kw["prompt_lengths"] = lengths
+        if has_key:
+            kw["key"] = key
+        return _generate(gen_config, params, prompt, max_new_tokens,
+                         temperature=temperature, top_k=top_k, top_p=top_p,
+                         eos_id=eos_id, **kw)
+
+    # jit the shard_map program: a bare shard_map call re-traces its body
+    # on every invocation; under jit the whole decode is one cached
+    # executable
+    return jax.jit(run)
