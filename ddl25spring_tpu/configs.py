@@ -58,6 +58,10 @@ class HflConfig:
     staleness_exp: float = 0.5  # fedbuff: delta weight (1+staleness)^-exp
     server_eta: float = 1.0    # fedbuff: server application rate
     dropout_rate: float = 0.0  # per-round client failure probability
+    compress: str = "none"     # fedavg/fedprox/fedsgd uplink compression:
+    #                            none | topk (sparsify client messages) |
+    #                            int8 (stochastic quantization); fl/engine.py
+    compress_ratio: float = 0.01  # topk: fraction of entries kept
     # robust aggregation (the missing course part 3; SURVEY.md §2.2)
     aggregator: str = "mean"   # mean | krum | multi-krum | bulyan | trimmed-mean | median | consensus (fedsgd only)
     attack: str = "none"       # none | label-flip | gaussian | sign-flip

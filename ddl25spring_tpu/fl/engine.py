@@ -176,6 +176,9 @@ def make_fl_round(
     dropout_rate: float = 0.0,
     dp_clip: float = 0.0,
     dp_noise_mult: float = 0.0,
+    compress: str = "none",
+    compress_ratio: float = 0.01,
+    compress_deltas: bool = True,
 ):
     """Build the jitted one-round function of a decentralized server.
 
@@ -247,6 +250,20 @@ def make_fl_round(
         raise ValueError(
             "dp_clip cannot combine with a custom aggregator: DP clips and "
             "noises the uniform delta mean, robust rules consume raw updates"
+        )
+    if compress not in ("none", "topk", "int8"):
+        raise ValueError(
+            f"compress={compress!r} not in ('none', 'topk', 'int8')"
+        )
+    if compress == "topk" and not 0.0 < compress_ratio <= 1.0:
+        raise ValueError(
+            f"compress_ratio={compress_ratio} outside (0, 1]"
+        )
+    if compress != "none" and dp_clip:
+        raise ValueError(
+            "compress cannot combine with dp_clip: lossy compression after "
+            "clipping changes the per-client sensitivity the noise is "
+            "calibrated to (no DP guarantee would hold)"
         )
     x = jnp.asarray(x)
     y = jnp.asarray(y)
@@ -341,6 +358,41 @@ def make_fl_round(
                 attacked,
                 updates,
             )
+
+        if compress != "none":
+            # communication-efficient uplink: each client's MESSAGE (its
+            # delta from round-start params for weight-returning servers,
+            # the raw gradient for gradient servers) is sparsified or
+            # stochastically int8-quantized before the server sees it —
+            # the standard FL uplink squeeze (per-client, stateless: a
+            # per-client error-feedback residual at N=256 x ResNet scale
+            # would dwarf the model in HBM).  Composes with robust
+            # aggregators: distances are computed on what the server
+            # actually receives.
+            from ..parallel.compress import quantize_int8, topk_sparsify
+
+            if compress_deltas:
+                space = jax.tree.map(lambda u, p: u - p, updates, params)
+            else:
+                space = updates
+            if compress == "topk":
+                # [0] = the sparse tree; the dropped remainder feeds error
+                # feedback in the DP training path, but per-client
+                # residuals are deliberately not kept here (see above)
+                space = jax.vmap(
+                    lambda t: topk_sparsify(t, compress_ratio)[0]
+                )(space)
+            else:
+                ckeys = jax.vmap(
+                    lambda kk: jax.random.fold_in(kk, 977)
+                )(keys)
+                space = jax.vmap(quantize_int8)(space, ckeys)
+            if compress_deltas:
+                updates = jax.tree.map(
+                    lambda s, p: s + p, space, params
+                )
+            else:
+                updates = space
 
         if dp_clip:
             # client-level DP: clip each client's delta from the round-start

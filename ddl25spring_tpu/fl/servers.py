@@ -162,7 +162,8 @@ class FedSgdGradientServer(DecentralizedServer):
 
     def __init__(self, task: Task, lr: float, client_data: ClientDatasets,
                  client_fraction: float, seed: int,
-                 aggregator=None, attack=None, malicious_mask=None, mesh=None):
+                 aggregator=None, attack=None, malicious_mask=None, mesh=None,
+                 compress: str = "none", compress_ratio: float = 0.01):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDGradient"
@@ -177,6 +178,10 @@ class FedSgdGradientServer(DecentralizedServer):
             ),
             attack=attack, malicious_mask=malicious_mask,
             mesh=mesh,
+            # gradient server: the client message IS the gradient, so
+            # compression acts on it directly, not on a params delta
+            compress=compress, compress_ratio=compress_ratio,
+            compress_deltas=False,
         )
 
 
@@ -220,7 +225,8 @@ class FedAvgServer(DecentralizedServer):
                  nr_local_epochs: int, seed: int,
                  aggregator=None, attack=None, malicious_mask=None, mesh=None,
                  prox_mu: float = 0.0, dropout_rate: float = 0.0,
-                 dp_clip: float = 0.0, dp_noise_mult: float = 0.0):
+                 dp_clip: float = 0.0, dp_noise_mult: float = 0.0,
+                 compress: str = "none", compress_ratio: float = 0.01):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         self.algorithm = "FedAvg" if prox_mu == 0.0 else "FedProx"
@@ -238,6 +244,9 @@ class FedAvgServer(DecentralizedServer):
             attack=attack, malicious_mask=malicious_mask,
             mesh=mesh, dropout_rate=dropout_rate,
             dp_clip=dp_clip, dp_noise_mult=dp_noise_mult,
+            # weight server: the client message is its params delta
+            compress=compress, compress_ratio=compress_ratio,
+            compress_deltas=True,
         )
 
 

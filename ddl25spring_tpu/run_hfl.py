@@ -153,7 +153,9 @@ def build_server(cfg: HflConfig):
               mesh=mesh)
     if cfg.algorithm == "fedsgd":
         return FedSgdGradientServer(task, cfg.lr, client_data,
-                                    cfg.client_fraction, cfg.seed, **kw)
+                                    cfg.client_fraction, cfg.seed,
+                                    compress=cfg.compress,
+                                    compress_ratio=cfg.compress_ratio, **kw)
     if cfg.algorithm == "fedsgd-weight":
         return FedSgdWeightServer(task, cfg.lr, client_data,
                                   cfg.client_fraction, cfg.seed, **kw)
@@ -166,7 +168,9 @@ def build_server(cfg: HflConfig):
                             cfg.seed, prox_mu=prox_mu,
                             dropout_rate=cfg.dropout_rate,
                             dp_clip=cfg.dp_clip,
-                            dp_noise_mult=cfg.dp_noise_mult, **kw)
+                            dp_noise_mult=cfg.dp_noise_mult,
+                            compress=cfg.compress,
+                            compress_ratio=cfg.compress_ratio, **kw)
     if cfg.algorithm == "fedopt":
         return FedOptServer(task, cfg.lr, cfg.batch_size, client_data,
                             cfg.client_fraction, cfg.nr_local_epochs,

@@ -13,7 +13,12 @@ import optax
 import pytest
 
 from ddl25spring_tpu.data import load_mnist, split_dataset
-from ddl25spring_tpu.fl import FedAvgServer, FedOptServer, mnist_task
+from ddl25spring_tpu.fl import (
+    FedAvgServer,
+    FedOptServer,
+    FedSgdGradientServer,
+    mnist_task,
+)
 from ddl25spring_tpu.parallel import (
     init_compression_state,
     make_compressed_dp_train_step,
@@ -467,3 +472,58 @@ def test_rdp_accountant_properties():
     # the reported budget is finite and positive for the bench-like config
     eps = dp_epsilon(1.1, 0.1, 100, 1e-5)
     assert 0 < eps < 50
+
+
+# --- communication-efficient uplink (compress=topk/int8) -------------------
+
+
+def test_fl_compress_topk_full_ratio_is_exact(small_fl):
+    """compress=topk with ratio 1.0 keeps every entry: FedAvg must equal
+    the uncompressed run bit-for-bit (the compression plumbing itself adds
+    nothing)."""
+    import numpy as np
+
+    data, task = small_fl
+    base = FedAvgServer(task, 0.05, 50, data, 0.5, 1, seed=10).run(2)
+    comp = FedAvgServer(task, 0.05, 50, data, 0.5, 1, seed=10,
+                        compress="topk", compress_ratio=1.0).run(2)
+    np.testing.assert_array_equal(
+        np.asarray(base.test_accuracy), np.asarray(comp.test_accuracy)
+    )
+
+
+def test_fl_compress_learns(small_fl):
+    """Sparsified (1% top-k) and int8-quantized uplinks still train: test
+    accuracy improves over the initial model for both FedAvg (delta space)
+    and FedSGD-gradient (raw-gradient space)."""
+    data, task = small_fl
+    for kwargs in (
+        dict(compress="topk", compress_ratio=0.05),
+        dict(compress="int8"),
+    ):
+        srv = FedAvgServer(task, 0.05, 50, data, 0.5, 2, seed=10, **kwargs)
+        acc0 = srv.test()
+        res = srv.run(3)
+        assert res.test_accuracy[-1] > acc0 + 5, (kwargs, acc0,
+                                                  res.test_accuracy)
+    sgd = FedSgdGradientServer(task, 0.1, data, 0.5, seed=10,
+                               compress="int8")
+    acc0 = sgd.test()
+    res = sgd.run(3)
+    assert res.test_accuracy[-1] > acc0
+
+
+def test_fl_compress_validation(small_fl):
+    """Invalid combinations fail at build time."""
+    import pytest
+
+    data, task = small_fl
+    with pytest.raises(ValueError, match="compress="):
+        FedAvgServer(task, 0.05, 50, data, 0.5, 1, seed=10,
+                     compress="gzip")
+    with pytest.raises(ValueError, match="compress_ratio"):
+        FedAvgServer(task, 0.05, 50, data, 0.5, 1, seed=10,
+                     compress="topk", compress_ratio=0.0)
+    with pytest.raises(ValueError, match="dp_clip"):
+        FedAvgServer(task, 0.05, 50, data, 0.5, 1, seed=10,
+                     compress="int8", dp_clip=1.0)
