@@ -64,7 +64,8 @@ class HflConfig:
     compress_ratio: float = 0.01  # topk: fraction of entries kept
     # robust aggregation (the missing course part 3; SURVEY.md §2.2)
     aggregator: str = "mean"   # mean | krum | multi-krum | bulyan | trimmed-mean | median | consensus (fedsgd only)
-    attack: str = "none"       # none | label-flip | gaussian | sign-flip
+    attack: str = "none"       # none | label-flip | gaussian | sign-flip |
+    #                            alie (collusive mu + z*sigma; robust/attacks)
     nr_malicious: int = 0
     # harness
     checkpoint_dir: str | None = None

@@ -348,16 +348,25 @@ def make_fl_round(
 
         if attack is not None:
             mal = jnp.take(mal_mask, sel, axis=0)
-            attacked = jax.vmap(attack, in_axes=(0, None, 0))(
-                updates, params, keys
-            )
-            updates = jax.tree.map(
-                lambda a, b: jnp.where(
-                    mal.reshape((-1,) + (1,) * (a.ndim - 1)), a, b
-                ),
-                attacked,
-                updates,
-            )
+            if getattr(attack, "collusive", False):
+                # collusive attacks (ALIE) need cross-attacker statistics:
+                # one call with the whole stack + mask, not a per-client
+                # vmap — the attack itself only rewrites masked rows
+                updates = attack(
+                    updates, mal, params,
+                    jax.random.fold_in(round_key, 0x5EED),
+                )
+            else:
+                attacked = jax.vmap(attack, in_axes=(0, None, 0))(
+                    updates, params, keys
+                )
+                updates = jax.tree.map(
+                    lambda a, b: jnp.where(
+                        mal.reshape((-1,) + (1,) * (a.ndim - 1)), a, b
+                    ),
+                    attacked,
+                    updates,
+                )
 
         if compress != "none":
             # communication-efficient uplink: each client's MESSAGE (its

@@ -7,6 +7,7 @@ from .aggregators import (
     make_bulyan,
 )
 from .attacks import (
+    make_alie_attack,
     make_gaussian_attack,
     make_sign_flip_attack,
     flip_labels,
@@ -19,6 +20,7 @@ __all__ = [
     "make_consensus",
     "make_krum",
     "make_bulyan",
+    "make_alie_attack",
     "make_gaussian_attack",
     "make_sign_flip_attack",
     "flip_labels",

@@ -59,3 +59,34 @@ def flip_labels(
     flipped = (nr_classes - 1) - y
     y[malicious] = flipped[malicious]
     return dataclasses.replace(data, y=y)
+
+
+def make_alie_attack(z: float = 1.5):
+    """ALIE — "A Little Is Enough" (Baruch et al. 2019, public): colluding
+    attackers estimate the coordinate-wise mean/std of their own honest
+    updates and all submit ``mu + z * sigma`` — a perturbation small
+    enough to sit inside the benign spread (defeating distance-based
+    defenses like Krum for suitable ``z``) yet consistently biased.
+
+    Collusive: the engine detects ``attack.collusive`` and calls
+    ``attack(stacked_updates, malicious_mask, params, key)`` ONCE with the
+    whole stack instead of vmapping per client — attackers need shared
+    statistics.  ``z`` trades stealth vs damage; the paper derives a
+    z_max from (n, f) via the normal quantile, left to the caller.
+    """
+
+    def attack(stacked, mal_mask, params, key):
+        w = mal_mask.astype(jnp.float32)
+        nm = jnp.maximum(jnp.sum(w), 1.0)
+
+        def per_leaf(leaf):
+            wm = w.reshape((-1,) + (1,) * (leaf.ndim - 1))
+            mu = jnp.sum(leaf * wm, axis=0) / nm
+            var = jnp.sum(jnp.square(leaf - mu) * wm, axis=0) / nm
+            adv = (mu + z * jnp.sqrt(var + 1e-12)).astype(leaf.dtype)
+            return jnp.where(wm > 0, adv[None], leaf)
+
+        return jax.tree.map(per_leaf, stacked)
+
+    attack.collusive = True
+    return attack

@@ -133,6 +133,10 @@ def build_server(cfg: HflConfig):
         attack = make_gaussian_attack()
     elif cfg.attack == "sign-flip":
         attack = make_sign_flip_attack()
+    elif cfg.attack == "alie":
+        from .robust import make_alie_attack
+
+        attack = make_alie_attack()
     elif cfg.attack == "label-flip":
         client_data = flip_labels(client_data, malicious, nr_classes=10)
     elif cfg.attack != "none":

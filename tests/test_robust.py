@@ -182,3 +182,50 @@ def test_bulyan_resists_large_outliers():
 
     with pytest.raises(ValueError, match="4f"):
         make_bulyan(3)({"w": jnp.ones((8, 4))}, None, None)
+
+
+def test_alie_attack_properties():
+    """ALIE (collusive mu + z*sigma): malicious rows all carry the SAME
+    adversarial update built from the attackers' own statistics; benign
+    rows pass through untouched."""
+    from ddl25spring_tpu.robust import make_alie_attack
+
+    stacked = {"w": jax.random.normal(jax.random.key(0), (6, 4))}
+    mal = jnp.asarray([True, True, True, False, False, False])
+    out = make_alie_attack(z=1.5)(stacked, mal, None, jax.random.key(1))
+    w = np.asarray(out["w"])
+    orig = np.asarray(stacked["w"])
+    np.testing.assert_array_equal(w[3:], orig[3:])     # benign untouched
+    np.testing.assert_array_equal(w[0], w[1])          # collusion
+    np.testing.assert_array_equal(w[0], w[2])
+    mu = orig[:3].mean(0)
+    sigma = orig[:3].std(0)
+    np.testing.assert_allclose(w[0], mu + 1.5 * sigma, atol=1e-5)
+
+
+def test_end_to_end_alie_collusive_path():
+    """The engine's collusive-attack branch end-to-end: ALIE at 2/8
+    malicious trains through FedSGD with and without Krum; the defended
+    run must not trail the plain mean by more than noise (ALIE is built
+    to be stealthy — the sharp Gaussian-vs-Krum separation test above
+    covers defense power; this pins the collusive hook's wiring)."""
+    from ddl25spring_tpu.robust import make_alie_attack
+
+    ds = load_mnist(n_train=1024, n_test=256)
+    task = mnist_task(ds.test_x, ds.test_y)
+    clients = split_dataset(ds.train_x, ds.train_y, nr_clients=8, iid=True,
+                            seed=10)
+    mal = np.zeros(8, bool)
+    mal[:2] = True
+
+    def build(aggregator):
+        return FedSgdGradientServer(
+            task, lr=0.05, client_data=clients, client_fraction=1.0,
+            seed=10, aggregator=aggregator,
+            attack=make_alie_attack(z=1.5), malicious_mask=mal,
+        )
+
+    defended = build(make_krum(nr_byzantine=2, nr_selected=4)).run(3)
+    plain = build(None).run(3)
+    assert defended.test_accuracy[-1] > 11  # above the 10% random baseline
+    assert defended.test_accuracy[-1] >= plain.test_accuracy[-1] - 3.0
