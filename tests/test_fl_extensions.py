@@ -503,13 +503,13 @@ def test_fl_compress_learns(small_fl):
     ):
         srv = FedAvgServer(task, 0.05, 50, data, 0.5, 2, seed=10, **kwargs)
         acc0 = srv.test()
-        res = srv.run(3)
+        res = srv.run(2)
         assert res.test_accuracy[-1] > acc0 + 5, (kwargs, acc0,
                                                   res.test_accuracy)
     sgd = FedSgdGradientServer(task, 0.1, data, 0.5, seed=10,
                                compress="int8")
     acc0 = sgd.test()
-    res = sgd.run(3)
+    res = sgd.run(2)
     assert res.test_accuracy[-1] > acc0
 
 

@@ -1,0 +1,100 @@
+"""Consolidate the sentinel's captured TPU artifacts into report rows.
+
+When tools/measure_when_up.sh lands its battery in results/, run this to
+get every number in one place — the BENCHMARKS.md ledger rows, the
+headline north-star line, and the validation verdicts that gate default
+flips (decode_impl, norm_impl).  Prints markdown-ready text; it does NOT
+edit docs (numbers should land in BENCHMARKS.md together with the
+measured-when note and a human-checked interpretation).
+
+Run:  python tools/refresh_benchmarks.py [--results results/]
+Exit: 0 if at least the north-star JSON was captured, 2 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def read_json_line(path: Path):
+    if not path.exists():
+        return None
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--results", default="results")
+    args = ap.parse_args()
+    root = Path(args.results)
+
+    print("# TPU capture report (paste-ready rows for docs/BENCHMARKS.md)")
+    captured_north_star = False
+
+    flax = read_json_line(root / "bench_tpu.json")
+    lean = read_json_line(root / "bench_tpu_lean.json")
+    for label, d in (("flax", flax), ("lean", lean)):
+        if d is None:
+            print(f"- north star ({label}): NOT CAPTURED")
+            continue
+        if d.get("value", 0) > 0:
+            captured_north_star |= label == "flax"
+            print(f"- north star ({label} norm): {d['value']} rounds/sec "
+                  f"(vs_baseline {d.get('vs_baseline')}, "
+                  f"acc {d.get('final_test_accuracy_pct')}%)")
+        else:
+            print(f"- north star ({label}): FAILED — {d.get('error')}")
+    if flax and lean and flax.get("value", 0) > 0 and lean.get("value", 0) > 0:
+        ratio = lean["value"] / flax["value"]
+        print(f"  -> lean/flax = {ratio:.3f} "
+              f"({'FLIP norm_impl default' if ratio > 1.02 else 'keep flax'})")
+
+    costs = read_json_line(root / "bench_tpu_costs.json")
+    costs_lean = read_json_line(root / "bench_tpu_costs_lean.json")
+    for label, d in (("flax", costs), ("lean", costs_lean)):
+        if d:
+            fl = d.get("flops", 0)
+            by = d.get("bytes_accessed", 0)
+            print(f"- cost analysis ({label}): {fl / 1e12:.2f} TFLOP, "
+                  f"{by / 2**30:.1f} GiB accessed per round")
+
+    val = read_json_line(root / "tpu_validate.txt")
+    if val:
+        ok = val.get("passed"), val.get("total")
+        print(f"- kernel validation: {ok[0]}/{ok[1]} passed"
+              + (f"; FAILED: {val['failed']}" if val.get("failed") else
+                 " -> flash/decode kernels Mosaic-green: consider flipping "
+                 "decode_impl default after the generate A/B"))
+    else:
+        print("- kernel validation: NOT CAPTURED")
+
+    for name in ("flash_tpu.txt", "flash_tpu_hd128.txt",
+                 "generate_tpu.txt", "generate_spec_tpu.txt"):
+        p = root / name
+        if p.exists() and p.stat().st_size > 0:
+            lines = [ln for ln in p.read_text().splitlines() if ln.strip()]
+            print(f"\n## {name} ({len(lines)} lines)")
+            for ln in lines:
+                print(f"    {ln}")
+        else:
+            print(f"- {name}: NOT CAPTURED")
+
+    if not captured_north_star:
+        print("\nNORTH STAR NOT CAPTURED — the round's #1 gate is still "
+              "open; keep tools/measure_when_up.sh running.")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
