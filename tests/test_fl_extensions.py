@@ -527,3 +527,17 @@ def test_fl_compress_validation(small_fl):
     with pytest.raises(ValueError, match="dp_clip"):
         FedAvgServer(task, 0.05, 50, data, 0.5, 1, seed=10,
                      compress="int8", dp_clip=1.0)
+
+
+def test_fl_compress_composes_with_robust_aggregator(small_fl):
+    """compress + Krum: distances are computed on the compressed messages
+    the server actually receives — the combination must build and train."""
+    from ddl25spring_tpu.robust import make_krum
+
+    data, task = small_fl
+    srv = FedAvgServer(task, 0.05, 50, data, 0.5, 1, seed=10,
+                       compress="int8",
+                       aggregator=make_krum(nr_byzantine=1, nr_selected=2))
+    acc0 = srv.test()
+    res = srv.run(2)
+    assert res.test_accuracy[-1] > acc0
