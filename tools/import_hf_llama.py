@@ -1,0 +1,135 @@
+"""HuggingFace Llama -> ddl25spring_tpu weight bridge.
+
+Real-weights interop: convert a ``transformers`` ``LlamaForCausalLM``
+checkpoint (the de-facto publishing format for Llama-family models) into
+this framework's param tree, so the whole serving stack — generation,
+GQA, int8, flash-decode, speculative decoding, TP/sequence-sharded
+serving — runs canonical weights unchanged.
+
+This doubles as an ARCHITECTURE PARITY ORACLE: tests/test_hf_import.py
+builds a random-initialised HF model on torch/CPU, converts it, and pins
+our JAX forward's logits to the HF forward's within fp tolerance — an
+external-reference check that our RMSNorm/rotary/GQA/SwiGLU math matches
+the canonical implementation, not just our own tests.
+
+Layout mapping (HF -> here):
+  model.embed_tokens.weight                  -> embed.embedding
+  layers.{i}.self_attn.{q,k,v,o}_proj.T     -> block{i}.attn.w{q,k,v,o}.kernel
+  layers.{i}.mlp.{gate,up,down}_proj.T      -> block{i}.mlp.{w1,w3,w2}.kernel
+  layers.{i}.input_layernorm.weight         -> block{i}.attn_norm.scale
+  layers.{i}.post_attention_layernorm.weight-> block{i}.mlp_norm.scale
+  model.norm.weight                         -> final_norm.scale
+  lm_head.weight.T                          -> lm_head.kernel
+
+Both sides use head-major projection layouts and the half-split
+(rotate-half) rotary convention, so kernels transpose 1:1 — no
+permutation needed (the parity test would catch a drift).
+
+Run:  python tools/import_hf_llama.py CHECKPOINT_DIR OUT.msgpack
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from ddl25spring_tpu.models.llama import LlamaConfig  # noqa: E402
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` onto :class:`LlamaConfig`."""
+    inter = hf_config.intermediate_size
+    dmodel = hf_config.hidden_size
+    cfg = LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        dmodel=dmodel,
+        nr_heads=hf_config.num_attention_heads,
+        nr_kv_heads=(
+            0
+            if hf_config.num_key_value_heads
+            == hf_config.num_attention_heads
+            else hf_config.num_key_value_heads
+        ),
+        nr_layers=hf_config.num_hidden_layers,
+        ctx_size=hf_config.max_position_embeddings,
+        hidden_mult=inter / dmodel,
+        norm_eps=hf_config.rms_norm_eps,
+    )
+    if cfg.hidden_dim != inter:
+        raise ValueError(
+            f"intermediate_size {inter} is not reachable (hidden_dim "
+            f"rounds to {cfg.hidden_dim}); this framework rounds hidden "
+            f"widths up to the 128-lane multiple"
+        )
+    if getattr(hf_config, "rope_theta", 10000.0) != 10000.0:
+        raise ValueError(
+            f"rope_theta={hf_config.rope_theta} != 10000: thread it "
+            "through models.llama.rope_angles before importing"
+        )
+    return cfg
+
+
+def _np(t):
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach")
+                      else t)
+
+
+def params_from_hf_state_dict(state_dict, config: LlamaConfig):
+    """HF ``LlamaForCausalLM`` state_dict -> ``{"params": ...}`` tree."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def kernel(name):
+        return sd.pop(name).T.copy()
+
+    params = {
+        "embed": {"embedding": sd.pop("model.embed_tokens.weight")},
+        "final_norm": {"scale": sd.pop("model.norm.weight")},
+        "lm_head": {"kernel": kernel("lm_head.weight")},
+    }
+    for i in range(config.nr_layers):
+        p = f"model.layers.{i}."
+        params[f"block{i}"] = {
+            "attn": {
+                "wq": {"kernel": kernel(p + "self_attn.q_proj.weight")},
+                "wk": {"kernel": kernel(p + "self_attn.k_proj.weight")},
+                "wv": {"kernel": kernel(p + "self_attn.v_proj.weight")},
+                "wo": {"kernel": kernel(p + "self_attn.o_proj.weight")},
+            },
+            "mlp": {
+                "w1": {"kernel": kernel(p + "mlp.gate_proj.weight")},
+                "w3": {"kernel": kernel(p + "mlp.up_proj.weight")},
+                "w2": {"kernel": kernel(p + "mlp.down_proj.weight")},
+            },
+            "attn_norm": {"scale": sd.pop(p + "input_layernorm.weight")},
+            "mlp_norm": {
+                "scale": sd.pop(p + "post_attention_layernorm.weight")
+            },
+        }
+    leftovers = [k for k in sd if "rotary" not in k and "inv_freq" not in k]
+    if leftovers:
+        raise ValueError(f"unmapped HF weights: {leftovers[:8]}")
+    return {"params": params}
+
+
+def main() -> int:
+    if len(sys.argv) != 3:
+        print(__doc__.splitlines()[-2])
+        return 2
+    src, out = sys.argv[1], sys.argv[2]
+    from flax import serialization
+    from transformers import LlamaForCausalLM
+
+    model = LlamaForCausalLM.from_pretrained(src)
+    cfg = config_from_hf(model.config)
+    params = params_from_hf_state_dict(model.state_dict(), cfg)
+    Path(out).write_bytes(serialization.to_bytes(params))
+    print(f"wrote {out}; config: {cfg}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
