@@ -61,6 +61,7 @@ class LlamaConfig:
     decode_impl: str = "xla"   # xla (einsum over the whole cache) |
     #                            flash-decode (Pallas, reads only live
     #                            cache blocks; ops/flash_decode.py)
+    rope_theta: float = 10000.0  # rotary base (Llama-2: 1e4, Llama-3: 5e5)
     decode_seq_shards: int = 1  # >1: KV cache sharded over `seq_axis`
     #                             (parallel/sp.py make_sp_generate) — each
     #                             device owns ctx_size/shards cache slots;
@@ -189,7 +190,7 @@ class Attention(nn.Module):
         else:
             pos2d = positions if positions.ndim == 2 else positions[None, :]
             rope_pos = jnp.maximum(pos2d - pad[:, None], 0)
-        cos, sin = rope_angles(cfg.head_dim, rope_pos)
+        cos, sin = rope_angles(cfg.head_dim, rope_pos, cfg.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if cfg.decode:

@@ -83,3 +83,25 @@ def test_unmapped_weights_rejected():
     sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(64)
     with pytest.raises(ValueError, match="unmapped"):
         params_from_hf_state_dict(sd, config_from_hf(hf.config))
+
+
+def test_rope_theta_and_tied_embeddings():
+    """Llama-3-style rope_theta (500000) and tie_word_embeddings
+    checkpoints convert and still match HF's logits exactly."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rms_norm_eps=1e-6, rope_theta=500000.0,
+        tie_word_embeddings=True, attn_implementation="eager",
+    )
+    torch.manual_seed(1)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf.config)
+    assert cfg.rope_theta == 500000.0
+    params = params_from_hf_state_dict(hf.state_dict(), cfg)
+    tokens_np = np.array([[3, 17, 99, 4, 56, 2]])
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens_np)).logits.numpy()
+    got = np.asarray(Llama(cfg).apply(params, jnp.asarray(tokens_np)))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-3)

@@ -58,6 +58,7 @@ def config_from_hf(hf_config) -> LlamaConfig:
         ctx_size=hf_config.max_position_embeddings,
         hidden_mult=inter / dmodel,
         norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
     )
     if cfg.hidden_dim != inter:
         raise ValueError(
@@ -65,10 +66,10 @@ def config_from_hf(hf_config) -> LlamaConfig:
             f"rounds to {cfg.hidden_dim}); this framework rounds hidden "
             f"widths up to the 128-lane multiple"
         )
-    if getattr(hf_config, "rope_theta", 10000.0) != 10000.0:
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
         raise ValueError(
-            f"rope_theta={hf_config.rope_theta} != 10000: thread it "
-            "through models.llama.rope_angles before importing"
+            f"rope_scaling={scaling} is not supported (plain rotary only)"
         )
     return cfg
 
@@ -85,10 +86,15 @@ def params_from_hf_state_dict(state_dict, config: LlamaConfig):
     def kernel(name):
         return sd.pop(name).T.copy()
 
+    embedding = sd.pop("model.embed_tokens.weight")
     params = {
-        "embed": {"embedding": sd.pop("model.embed_tokens.weight")},
+        "embed": {"embedding": embedding},
         "final_norm": {"scale": sd.pop("model.norm.weight")},
-        "lm_head": {"kernel": kernel("lm_head.weight")},
+        # tie_word_embeddings checkpoints omit lm_head: it IS the embedding
+        "lm_head": {
+            "kernel": (kernel("lm_head.weight")
+                       if "lm_head.weight" in sd else embedding.T.copy())
+        },
     }
     for i in range(config.nr_layers):
         p = f"model.layers.{i}."
