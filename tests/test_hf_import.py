@@ -105,3 +105,26 @@ def test_rope_theta_and_tied_embeddings():
         want = hf(torch.tensor(tokens_np)).logits.numpy()
     got = np.asarray(Llama(cfg).apply(params, jnp.asarray(tokens_np)))
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-3)
+
+
+def test_imported_weights_compose_into_pipeline_stages():
+    """HF checkpoint -> full tree -> pipeline-stage split: the [First,
+    Mid, Last] composition must reproduce HF's logits — imported weights
+    serve the PP path too, not just the single-model one."""
+    from ddl25spring_tpu.models import (
+        full_params_to_stage_params,
+        make_stages,
+    )
+
+    hf = _tiny_hf(2)
+    cfg = config_from_hf(hf.config)
+    params = params_from_hf_state_dict(hf.state_dict(), cfg)
+    tokens_np = np.array([[3, 17, 99, 4, 56, 2]])
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens_np)).logits.numpy()
+
+    stages = make_stages(cfg, 2)
+    stage_params = full_params_to_stage_params(params, cfg, 2)
+    h = stages[0].apply(stage_params[0], jnp.asarray(tokens_np))
+    h = stages[1].apply(stage_params[1], h)
+    np.testing.assert_allclose(np.asarray(h), want, atol=2e-4, rtol=1e-3)
