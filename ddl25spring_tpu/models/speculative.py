@@ -51,7 +51,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .generate import _check_prompt_lengths, _left_align
+from .generate import _check_prompt_lengths, _filter_logits, _left_align
 from .llama import Llama, LlamaConfig
 
 
@@ -113,6 +113,8 @@ def speculative_generate(
     prompt_lengths: jax.Array | None = None,
     eos_id: int | None = None,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     key: jax.Array | None = None,
 ):
     """Decode ``max_new_tokens`` continuations via draft+verify — greedy
@@ -142,8 +144,11 @@ def speculative_generate(
     distribution, whatever the draft (the token-level randomness stream
     differs from ``generate``'s, so sequences are distribution-equal, not
     bit-equal).  Needs ``key``; RNG is keyed per (row, slot, purpose) so
-    results are independent of round boundaries.  top-k/top-p filters are
-    not supported in this mode (plain temperature sampling only).
+    results are independent of round boundaries.  ``top_k``/``top_p``
+    compose exactly as in :func:`generate` (temperature first, then the
+    filters): the target distribution is the FILTERED one, and the draft
+    filters its own proposals the same way — a proposal outside the
+    target's candidate set simply has ``qt = 0`` and is always rejected.
     """
     if target_config.vocab_size != draft_config.vocab_size:
         raise ValueError("draft and target must share a vocabulary")
@@ -160,11 +165,19 @@ def speculative_generate(
     _check_prompt_lengths(prompt_lengths, T0)
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"need top_k >= 0 and 0 < top_p <= 1 (got {top_k}, {top_p})"
+        )
     sampling = temperature > 0
     if sampling and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if key is None:
         key = jax.random.key(0)  # unused on the greedy path
+    if not sampling:
+        # filters are dead under greedy decode — normalise them out of the
+        # cached-program key (same discipline as generate())
+        top_k, top_p = 0, 1.0
     if max_new_tokens == 0:
         if prompt_lengths is None:
             return prompt, jnp.float32(0)
@@ -185,13 +198,13 @@ def speculative_generate(
     tokens0 = jax.lax.dynamic_update_slice(tokens0, prompt_left, (0, gamma))
 
     run = _spec_fn(target_config, draft_config, gamma, float(temperature),
-                   B, T0, max_new_tokens, eos_id)
+                   int(top_k), float(top_p), B, T0, max_new_tokens, eos_id)
     return run(tparams, dparams, tokens0, pad, key)
 
 
 @functools.lru_cache(maxsize=32)
-def _spec_fn(target_config, draft_config, gamma, temperature, B, T0,
-             max_new_tokens, eos_id):
+def _spec_fn(target_config, draft_config, gamma, temperature, top_k, top_p,
+             B, T0, max_new_tokens, eos_id):
     """Build (once per geometry/config) the jitted draft+verify program.
 
     lru_cached for the same reason as generate._decode_fn: a fresh
@@ -227,11 +240,15 @@ def _spec_fn(target_config, draft_config, gamma, temperature, B, T0,
                 lambda r, ss: jax.vmap(lambda s: one(r, s))(ss)
             )(rows, slots)
 
+        def dist_logits(logits):
+            """generate()'s exact sampling transform: temperature first,
+            then the top-k/top-p filters."""
+            return _filter_logits(logits / temperature, top_k, top_p)
+
         def sample_rows(ks, logits):
-            """One categorical draw per row from temperature-scaled
-            logits; ks (B,) keys, logits (B, V)."""
+            """One categorical draw per row; ks (B,) keys, logits (B, V)."""
             return jax.vmap(
-                lambda k, l: jax.random.categorical(k, l / temperature)
+                lambda k, l: jax.random.categorical(k, dist_logits(l))
             )(ks, logits).astype(tokens.dtype)
 
         prefill_pos = jnp.arange(window)
@@ -277,7 +294,7 @@ def _spec_fn(target_config, draft_config, gamma, temperature, B, T0,
             dcache = dv["cache"]
             if sampling:
                 p1 = sample_rows(keys_for(L, 0), clog[:, -1])
-                qd1 = jax.nn.softmax(clog[:, -1] / temperature, axis=-1)
+                qd1 = jax.nn.softmax(dist_logits(clog[:, -1]), axis=-1)
             else:
                 p1 = jnp.argmax(clog[:, -1], axis=-1).astype(tokens.dtype)
                 qd1 = jnp.zeros((B, 1))  # unused
@@ -292,7 +309,7 @@ def _spec_fn(target_config, draft_config, gamma, temperature, B, T0,
                 if sampling:
                     nxt = sample_rows(keys_for(cur_pos + 1, 0),
                                       logits[:, 0])
-                    qd_row = jax.nn.softmax(logits[:, 0] / temperature,
+                    qd_row = jax.nn.softmax(dist_logits(logits[:, 0]),
                                             axis=-1)
                 else:
                     nxt = jnp.argmax(logits[:, 0], axis=-1).astype(
@@ -325,7 +342,7 @@ def _spec_fn(target_config, draft_config, gamma, temperature, B, T0,
             tcache = tv["cache"]
             if sampling:
                 # --- rejection-sampling acceptance ---------------------
-                qt = jax.nn.softmax(t_logits / temperature, axis=-1)
+                qt = jax.nn.softmax(dist_logits(t_logits), axis=-1)
                 qtp = jnp.take_along_axis(
                     qt[:, :gamma], props[..., None], axis=-1
                 )[..., 0]

@@ -290,3 +290,50 @@ def test_int8_serving_composes_with_speculative(models):
     got2, _ = speculative_generate(qcfg, qparams, TARGET, tparams,
                                    prompt, 8, gamma=2)
     np.testing.assert_array_equal(np.asarray(got2), np.asarray(want))
+
+
+def test_sampling_filters_match_generate_distribution(models):
+    """top_k composes with spec sampling exactly as in generate(): the
+    first spec-round token's marginal matches the analytic FILTERED
+    target marginal (temperature-then-filter order, generate's)."""
+    tparams, dparams = models
+    N, V, temp, k = 1200, 48, 1.0, 6
+    prompt1 = jax.random.randint(jax.random.key(16), (1, 5), 1, V)
+    prompt = jnp.tile(prompt1, (N, 1))
+    out, rate = speculative_generate(
+        TARGET, tparams, DRAFT, dparams, prompt, 3, gamma=2,
+        temperature=temp, top_k=k, key=jax.random.key(17),
+    )
+    tok2 = np.asarray(out[:, 6])
+
+    from ddl25spring_tpu.models.generate import _filter_logits
+
+    def fsm(logits):
+        return np.asarray(
+            jax.nn.softmax(_filter_logits(logits / temp, k, 1.0), axis=-1)
+        )
+
+    model = Llama(TARGET)
+    p1 = fsm(model.apply(tparams, prompt1, positions=jnp.arange(5))[0, -1])
+    seqs = jnp.concatenate(
+        [jnp.tile(prompt1, (V, 1)), jnp.arange(V)[:, None]], axis=1
+    )
+    p2 = fsm(model.apply(tparams, seqs, positions=jnp.arange(6))[:, -1])
+    want = p1 @ p2
+    hist = np.bincount(tok2, minlength=V) / N
+    tv = 0.5 * np.abs(hist - want).sum()
+    assert tv < 0.11, f"total variation {tv:.3f}"
+    # every sampled token must sit inside SOME top-k candidate set
+    assert 0.0 <= float(rate) <= 1.0
+
+
+def test_sampling_self_draft_with_filters_accepts_everything(models):
+    """Self-draft with identical filters: ratio exactly 1 on the shared
+    candidate set -> rate 1.0 (filters can't desynchronize qd from qt)."""
+    tparams, _ = models
+    prompt = jax.random.randint(jax.random.key(18), (2, 5), 1, 48)
+    _, rate = speculative_generate(
+        TARGET, tparams, TARGET, tparams, prompt, 10, gamma=3,
+        temperature=0.7, top_k=5, top_p=0.9, key=jax.random.key(19),
+    )
+    assert float(rate) == 1.0
