@@ -19,10 +19,19 @@ _ROW = {"wo", "w2"}                        # shard input dim
 
 def llama_tp_shardings(mesh, params, model_axis: str = "model"):
     """Sharding pytree for full ``Llama`` params on a mesh with a
-    ``model`` axis; all non-matmul params replicated."""
+    ``model`` axis; all non-matmul params replicated.
+
+    Also covers int8-serving trees (models/quant.py): ``kernel_q`` shards
+    like ``kernel``, and the per-output-channel ``scale`` vector shards
+    over the model axis for column-parallel layers (its length IS the
+    sharded output dim) while row-parallel layers keep it replicated
+    (their output dim is unsharded) — int8 and TP compose, quartering the
+    per-chip weight bytes of an already-sharded model.
+    """
 
     col = NamedSharding(mesh, P(None, model_axis))
     row = NamedSharding(mesh, P(model_axis, None))
+    vec = NamedSharding(mesh, P(model_axis))
     repl = NamedSharding(mesh, P())
     axis_size = mesh.shape[model_axis]
 
@@ -31,12 +40,18 @@ def llama_tp_shardings(mesh, params, model_axis: str = "model"):
 
     def spec_for(path, leaf):
         names = [getattr(k, "key", getattr(k, "name", "")) for k in path]
-        if "kernel" in names:
-            parent = names[-2] if len(names) >= 2 else ""
-            if (parent in _COLUMN or parent == "lm_head") and divisible(leaf, 1):
+        leaf_name = names[-1] if names else ""
+        parent = names[-2] if len(names) >= 2 else ""
+        if leaf_name in ("kernel", "kernel_q"):
+            if (parent in _COLUMN or parent == "lm_head") \
+                    and divisible(leaf, 1):
                 return col
             if parent in _ROW and divisible(leaf, 0):
                 return row
+        if leaf_name == "scale" and (
+            parent in _COLUMN or parent == "lm_head"
+        ) and leaf.ndim == 1 and divisible(leaf, 0):
+            return vec
         if "embedding" in names and divisible(leaf, 1):
             return NamedSharding(mesh, P(None, model_axis))
         return repl

@@ -301,3 +301,52 @@ def test_tensor_parallel_generate_matches_replicated():
         functools.partial(generate, cfg, max_new_tokens=10)
     ).lower(params_tp, prompt).compile()
     assert "all-reduce" in compiled.as_text()
+
+
+def test_int8_tensor_parallel_generate_matches_replicated():
+    """int8 x TP compose: Megatron shardings cover the quantized tree
+    (kernel_q like kernel; per-channel scale sharded where the output dim
+    is) and generation equals replicated int8 serving exactly, with real
+    collectives in the compiled program."""
+    import dataclasses
+    import functools
+
+    import numpy as np
+
+    from ddl25spring_tpu.models import generate, quantize_llama_params
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.parallel import (
+        apply_shardings,
+        llama_tp_shardings,
+        make_mesh,
+    )
+
+    cfg = LlamaConfig(vocab_size=64, dmodel=64, nr_heads=8, nr_layers=2,
+                      ctx_size=48)
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 1, 64)
+    params = Llama(cfg).init(jax.random.key(0), prompt,
+                             positions=jnp.arange(5))
+    qcfg = dataclasses.replace(cfg, weights_int8=True)
+    qparams = quantize_llama_params(params)
+    want = generate(qcfg, qparams, prompt, 10)
+
+    mesh = make_mesh({"model": 8})
+    shardings = llama_tp_shardings(mesh, qparams)
+    # the quantized kernels and their scales must actually be sharded
+    flat = dict(jax.tree_util.tree_flatten_with_path(shardings)[0])
+    specs = {"/".join(getattr(k, "key", "?") for k in path): s.spec
+             for path, s in flat.items()}
+    assert any("kernel_q" in k and s != () and s is not None
+               for k, s in ((k, tuple(v)) for k, v in specs.items()))
+    wq_scale = [v for k, v in specs.items()
+                if "wq" in k and k.endswith("scale")]
+    assert wq_scale and tuple(wq_scale[0]) == ("model",)
+
+    qparams_tp = apply_shardings(qparams, shardings)
+    got = generate(qcfg, qparams_tp, prompt, 10)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    compiled = jax.jit(
+        functools.partial(generate, qcfg, max_new_tokens=10)
+    ).lower(qparams_tp, prompt).compile()
+    assert "all-reduce" in compiled.as_text()
