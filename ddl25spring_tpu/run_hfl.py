@@ -41,6 +41,27 @@ from .robust import (
 from .utils import Checkpointer, MetricsLogger
 
 
+def build_attack(cfg: HflConfig):
+    """Update-attack factory for ``--attack``.
+
+    ``label-flip`` is a DATA attack (poisons the stacked datasets before
+    training) and ``none`` is no attack — both return None here; the
+    update attacks return the callable ``make_fl_round`` dispatches on
+    (collusive ones, like ALIE, carry ``.collusive`` for the engine's
+    whole-stack hook)."""
+    if cfg.attack == "gaussian":
+        return make_gaussian_attack()
+    if cfg.attack == "sign-flip":
+        return make_sign_flip_attack()
+    if cfg.attack == "alie":
+        from .robust import make_alie_attack
+
+        return make_alie_attack()
+    if cfg.attack in ("none", "label-flip"):
+        return None
+    raise ValueError(f"unknown attack {cfg.attack!r}")
+
+
 def build_aggregator(cfg: HflConfig):
     sampled = max(1, round(cfg.client_fraction * cfg.nr_clients))
     if cfg.aggregator == "mean":
@@ -128,19 +149,9 @@ def build_server(cfg: HflConfig):
         malicious[np.random.default_rng(cfg.seed).choice(
             cfg.nr_clients, cfg.nr_malicious, replace=False)] = True
 
-    attack = None
-    if cfg.attack == "gaussian":
-        attack = make_gaussian_attack()
-    elif cfg.attack == "sign-flip":
-        attack = make_sign_flip_attack()
-    elif cfg.attack == "alie":
-        from .robust import make_alie_attack
-
-        attack = make_alie_attack()
-    elif cfg.attack == "label-flip":
+    attack = build_attack(cfg)
+    if cfg.attack == "label-flip":  # data attack: poisons the datasets
         client_data = flip_labels(client_data, malicious, nr_classes=10)
-    elif cfg.attack != "none":
-        raise ValueError(f"unknown attack {cfg.attack!r}")
 
     import jax
 

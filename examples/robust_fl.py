@@ -30,7 +30,7 @@ def main(quick=False, plot_dir=None):
     nr_clients = 20 if quick else 50
     nr_malicious = 4 if quick else 10
     attacks = ["none", "label-flip"] if quick else \
-        ["none", "label-flip", "gaussian", "sign-flip"]
+        ["none", "label-flip", "gaussian", "sign-flip", "alie"]
     aggs = ["mean", "krum", "median", "consensus"] if quick else \
         ["mean", "krum", "multi-krum", "trimmed-mean", "median", "consensus"]
     print(f"{'attack':12s} {'aggregator':14s} final acc")

@@ -229,3 +229,17 @@ def test_end_to_end_alie_collusive_path():
     plain = build(None).run(3)
     assert defended.test_accuracy[-1] > 11  # above the 10% random baseline
     assert defended.test_accuracy[-1] >= plain.test_accuracy[-1] - 3.0
+
+
+def test_build_attack_alie_cli_path():
+    """run_hfl's --attack alie branch yields the collusive attack the
+    engine dispatches on (CLI plumbing, no dataset needed)."""
+    from ddl25spring_tpu.configs import HflConfig
+    from ddl25spring_tpu.run_hfl import build_attack
+
+    attack = build_attack(HflConfig(attack="alie"))
+    assert attack is not None and getattr(attack, "collusive", False)
+    assert build_attack(HflConfig(attack="none")) is None
+    assert not getattr(
+        build_attack(HflConfig(attack="gaussian")), "collusive", False
+    )
