@@ -1,7 +1,9 @@
 #!/bin/bash
 # Wait for the remote TPU tunnel, then capture the round's measurement
-# battery exactly once, must-have first (the tunnel can wedge mid-battery —
-# round 2 lost its whole window that way):
+# battery, must-have first (the tunnel can wedge mid-battery — round 2
+# lost its whole window that way).  If the north-star JSON comes back
+# value-0 (tunnel wedged right after the probe), the sentinel goes back
+# to waiting instead of exiting with nothing:
 #   1. north-star bench (flax GroupNorm)      -> results/bench_tpu.json
 #   2. north-star bench (lean GroupNorm A/B)  -> results/bench_tpu_lean.json
 #   3. Pallas kernel validation (Mosaic)      -> results/tpu_validate.txt
@@ -24,6 +26,15 @@ EOF
     timeout 1800 python bench.py --deadline-s 900 \
       > results/bench_tpu.json 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) bench flax done (exit $rc)" >> "$LOG"
+    if ! grep -q '"value": [1-9]' results/bench_tpu.json 2>/dev/null && \
+       ! grep -q '"value": 0\.[0-9]*[1-9]' results/bench_tpu.json \
+         2>/dev/null; then
+      echo "$(date +%H:%M:%S) north star NOT captured — back to waiting" \
+        >> "$LOG"
+      nohup /root/repo/tools/tpu_watch.sh >/dev/null 2>&1 &
+      sleep 300
+      continue
+    fi
     timeout 1800 python bench.py --deadline-s 900 --norm-impl lean \
       > results/bench_tpu_lean.json 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) bench lean done (exit $rc)" >> "$LOG"
