@@ -245,12 +245,6 @@ class Attention(nn.Module):
         S = cfg.ctx_size
         Hkv = cfg.kv_heads
         if cfg.decode_seq_shards > 1:
-            if positions.ndim == 2:
-                raise NotImplementedError(
-                    "sharded-cache decode supports lockstep (1-D) "
-                    "positions only; speculative decoding needs the "
-                    "single-device cache"
-                )
             return self._sharded_decode_attention(q, k, v, positions, pad)
         zeros = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), q.dtype)
         ck = self.variable("cache", "k", zeros)
@@ -352,26 +346,41 @@ class Attention(nn.Module):
         cv = self.variable("cache", "v", zeros)
         idx = jax.lax.axis_index(cfg.seq_axis)
         local_ids = idx * S_local + jnp.arange(S_local)  # global slot ids
+        per_row = positions.ndim == 2  # (B, T) row slots (speculative)
 
         if pad is not None:
-            real = (positions[None, :] >= pad[:, None])[..., None, None]
+            pos2d = positions if per_row else positions[None, :]
+            real = (pos2d >= pad[:, None])[..., None, None]
             k = jnp.where(real, k, 0)
             v = jnp.where(real, v, 0)
         # owner-masked scatter-write: window slot t lands at local index
         # positions[t] - idx*S_local; out-of-range indices (slots owned by
         # other shards) are DROPPED, so each step touches at most T cache
         # rows (the non-sharded path's O(1)-write property, kept)
-        local_idx = positions - idx * S_local          # (T,)
-        ck.value = ck.value.at[:, local_idx].set(k, mode="drop")
-        cv.value = cv.value.at[:, local_idx].set(v, mode="drop")
+        local_idx = positions - idx * S_local          # (T,) or (B, T)
+        if per_row:
+            row_scatter = jax.vmap(
+                lambda c, blk, ii: c.at[ii].set(blk, mode="drop")
+            )
+            ck.value = row_scatter(ck.value, k, local_idx)
+            cv.value = row_scatter(cv.value, v, local_idx)
+        else:
+            ck.value = ck.value.at[:, local_idx].set(k, mode="drop")
+            cv.value = cv.value.at[:, local_idx].set(v, mode="drop")
 
         qg = q.reshape(B, T, Hkv, cfg.nr_heads // Hkv, cfg.head_dim)
         scale = 1.0 / jnp.sqrt(cfg.head_dim).astype(jnp.float32)
         scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck.value).astype(
             jnp.float32
         ) * scale                                      # (B,Hkv,g,T,S_local)
-        visible = local_ids[None, :] <= positions[:, None]  # (T, S_local)
-        visible = visible[None, None, None]
+        if per_row:
+            visible = (
+                local_ids[None, None, :] <= positions[:, :, None]
+            )  # (B, T, S_local)
+            visible = visible[:, None, None]
+        else:
+            visible = local_ids[None, :] <= positions[:, None]
+            visible = visible[None, None, None]        # (1,1,1,T,S_local)
         if pad is not None:
             real = local_ids[None, :] >= pad[:, None]  # (B, S_local)
             visible = visible & real[:, None, None, None, :]

@@ -194,7 +194,12 @@ def speculative_generate(
     else:
         prompt_left, pad0 = _left_align(prompt, T0, prompt_lengths)
     pad = pad0 + gamma  # the gamma spec slots are permanent left pads
-    tokens0 = jnp.zeros((B, total + gamma), prompt.dtype)
+    shards = max(target_config.decode_seq_shards,
+                 draft_config.decode_seq_shards, 1)
+    total_buf = total + gamma  # must match _spec_fn's buffer geometry
+    if shards > 1:
+        total_buf = -(-total_buf // shards) * shards
+    tokens0 = jnp.zeros((B, total_buf), prompt.dtype)
     tokens0 = jax.lax.dynamic_update_slice(tokens0, prompt_left, (0, gamma))
 
     run = _spec_fn(target_config, draft_config, gamma, float(temperature),
@@ -213,6 +218,13 @@ def _spec_fn(target_config, draft_config, gamma, temperature, top_k, top_p,
     sampling = temperature > 0
     total = gamma + T0 + max_new_tokens
     total_buf = total + gamma  # + trailing scratch: windows never clamp
+    shards = max(target_config.decode_seq_shards,
+                 draft_config.decode_seq_shards, 1)
+    if shards > 1:
+        # sharded-cache decode (parallel/sp.py::make_sp_speculative): the
+        # cache length must divide over the seq axis — extra trailing
+        # scratch is harmless
+        total_buf = -(-total_buf // shards) * shards
     window = gamma + T0  # prefill width
     tcfg = dataclasses.replace(target_config, decode=True,
                                ctx_size=total_buf)

@@ -213,3 +213,69 @@ def _sp_generate_fn(gen_config, mesh, seq_axis, max_new_tokens,
     # on every invocation; under jit the whole decode is one cached
     # executable
     return jax.jit(run)
+
+
+def make_sp_speculative(target_config: LlamaConfig,
+                        draft_config: LlamaConfig, mesh,
+                        seq_axis: str = "seq"):
+    """Speculative decoding over a sequence-sharded KV cache — the two
+    serving accelerators compose: contexts whose cache exceeds one chip's
+    HBM (sharded cache, distributed log-sum-exp merge) decoded at
+    draft+verify speed.  Both models' caches shard over ``seq_axis``; the
+    per-row positions speculative decoding needs flow through the sharded
+    path's row-wise scatter writes and visibility.
+
+    Returns ``spec_fn(target_params, draft_params, prompt,
+    max_new_tokens, *, gamma=4, temperature=0, top_k=0, top_p=1.0,
+    key=None, prompt_lengths=None, eos_id=None) -> (tokens, rate)`` with
+    :func:`models.speculative.speculative_generate`'s exact contract.
+    """
+    n = mesh.shape[seq_axis]
+    tcfg = dataclasses.replace(target_config, decode_seq_shards=n,
+                               seq_axis=seq_axis)
+    dcfg = dataclasses.replace(draft_config, decode_seq_shards=n,
+                               seq_axis=seq_axis)
+
+    def spec_fn(target_params, draft_params, prompt, max_new_tokens, *,
+                gamma=4, temperature=0.0, top_k=0, top_p=1.0, key=None,
+                prompt_lengths=None, eos_id=None):
+        from ..models.generate import _check_prompt_lengths
+        from ..models.speculative import speculative_generate
+
+        _check_prompt_lengths(prompt_lengths, prompt.shape[1])
+        run = _sp_spec_fn(tcfg, dcfg, mesh, seq_axis, max_new_tokens,
+                          gamma, float(temperature), int(top_k),
+                          float(top_p), eos_id,
+                          prompt_lengths is not None, key is not None)
+        lengths = (jnp.zeros((prompt.shape[0],), jnp.int32)
+                   if prompt_lengths is None
+                   else jnp.asarray(prompt_lengths, jnp.int32))
+        return run(target_params, draft_params, prompt, lengths,
+                   jax.random.key(0) if key is None else key)
+
+    return spec_fn
+
+
+@lru_cache(maxsize=16)
+def _sp_spec_fn(tcfg, dcfg, mesh, seq_axis, max_new_tokens, gamma,
+                temperature, top_k, top_p, eos_id, has_lengths, has_key):
+    from ..models.speculative import speculative_generate
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P()), out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def run(tparams, dparams, prompt, lengths, key):
+        kw = {}
+        if has_lengths:
+            kw["prompt_lengths"] = lengths
+        if has_key:
+            kw["key"] = key
+        return speculative_generate(
+            tcfg, tparams, dcfg, dparams, prompt, max_new_tokens,
+            gamma=gamma, temperature=temperature, top_k=top_k,
+            top_p=top_p, eos_id=eos_id, **kw,
+        )
+
+    return jax.jit(run)

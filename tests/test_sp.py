@@ -158,3 +158,41 @@ def test_sharded_cache_generate_matches_single_device():
     want_r = generate(cfg, params, prompt, 10, prompt_lengths=lengths)
     got_r = sp_gen(params, prompt, 10, prompt_lengths=lengths)
     np.testing.assert_array_equal(np.asarray(got_r), np.asarray(want_r))
+
+
+def test_sharded_cache_speculative_matches_single_device():
+    """Speculative decoding OVER the sequence-sharded cache
+    (make_sp_speculative): the two serving accelerators compose — per-row
+    positions flow through the sharded scatter writes and per-row
+    visibility, and the output still equals plain single-device greedy
+    decode exactly (the spec invariant), for an unrelated draft."""
+    import numpy as np
+
+    from ddl25spring_tpu.models import generate
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.parallel import make_mesh
+    from ddl25spring_tpu.parallel.sp import make_sp_speculative
+
+    tcfg = LlamaConfig(vocab_size=48, dmodel=32, nr_heads=4,
+                       nr_kv_heads=2, nr_layers=2, ctx_size=64)
+    dcfg = LlamaConfig(vocab_size=48, dmodel=16, nr_heads=2, nr_layers=1,
+                       ctx_size=64)
+    mesh = make_mesh({"seq": 8})
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 1, 48)
+    tparams = Llama(tcfg).init(jax.random.key(0), prompt,
+                               positions=jnp.arange(5))
+    dparams = Llama(dcfg).init(jax.random.key(2), prompt,
+                               positions=jnp.arange(5))
+    want = generate(tcfg, tparams, prompt, 11)
+
+    spec = make_sp_speculative(tcfg, dcfg, mesh)
+    got, rate = spec(tparams, dparams, prompt, 11, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert 0.0 <= float(rate) <= 1.0
+
+    # ragged prompts through the same path
+    lengths = jnp.asarray([2, 5])
+    want_r = generate(tcfg, tparams, prompt, 8, prompt_lengths=lengths)
+    got_r, _ = spec(tparams, dparams, prompt, 8, gamma=3,
+                    prompt_lengths=lengths)
+    np.testing.assert_array_equal(np.asarray(got_r), np.asarray(want_r))
