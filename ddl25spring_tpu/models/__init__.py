@@ -15,6 +15,12 @@ from .llama import (
 )
 from .generate import generate
 from .distill import distill_draft
+from .lora import (
+    LoRADense,
+    lora_trainable_mask,
+    make_lora_optimizer,
+    merge_lora,
+)
 from .speculative import speculative_generate
 from .quant import QuantDense, quantize_llama_params
 
@@ -22,6 +28,10 @@ __all__ = [
     "generate",
     "speculative_generate",
     "distill_draft",
+    "LoRADense",
+    "lora_trainable_mask",
+    "make_lora_optimizer",
+    "merge_lora",
     "QuantDense",
     "quantize_llama_params",
     "MnistCnn",

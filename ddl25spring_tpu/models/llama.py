@@ -62,6 +62,11 @@ class LlamaConfig:
     #                            flash-decode (Pallas, reads only live
     #                            cache blocks; ops/flash_decode.py)
     rope_theta: float = 10000.0  # rotary base (Llama-2: 1e4, Llama-3: 5e5)
+    lora_rank: int = 0         # >0: every matmul gains a LoRA adapter
+    #                            (models/lora.py) — base kernels frozen by
+    #                            the masked optimizer, B zero-init so the
+    #                            adapted model starts as the base model
+    lora_alpha: float = 16.0   # adapter scale alpha/r
     decode_seq_shards: int = 1  # >1: KV cache sharded over `seq_axis`
     #                             (parallel/sp.py make_sp_generate) — each
     #                             device owns ctx_size/shards cache slots;
@@ -105,6 +110,12 @@ class LlamaConfig:
             raise ValueError(
                 f"moe_dispatch={self.moe_dispatch!r} not in ('dense', "
                 "'capacity')"
+            )
+        if self.weights_int8 and self.lora_rank:
+            raise ValueError(
+                "weights_int8 and lora_rank are mutually exclusive: train "
+                "adapters in fp, then merge_lora -> quantize_llama_params "
+                "for serving"
             )
         if self.weights_int8 and self.nr_experts:
             raise ValueError(
@@ -442,11 +453,19 @@ def _positions(T: int):
 
 
 def _dense_cls(cfg: LlamaConfig):
-    """Matmul-layer factory: fp ``nn.Dense`` or, for int8-serving configs,
-    ``QuantDense`` over quantize_llama_params output (models/quant.py)."""
+    """Matmul-layer factory: fp ``nn.Dense``; ``QuantDense`` for
+    int8-serving configs (models/quant.py); ``LoRADense`` for adapter
+    fine-tuning configs (models/lora.py)."""
     if cfg.weights_int8:
         return lambda features, name: QuantDense(
             features, dtype=cfg.dtype, name=name
+        )
+    if cfg.lora_rank:
+        from .lora import LoRADense  # local import avoids a module cycle
+
+        return lambda features, name: LoRADense(
+            features, rank=cfg.lora_rank, alpha=cfg.lora_alpha,
+            dtype=cfg.dtype, name=name,
         )
     return lambda features, name: nn.Dense(
         features, use_bias=False, dtype=cfg.dtype, name=name

@@ -1,0 +1,113 @@
+"""LoRA — low-rank adaptation of the Llama matmuls (Hu et al., public).
+
+The reference never fine-tunes anything; with the HF weight bridge
+(tools/import_hf_llama.py) this framework serves published checkpoints,
+and LoRA is the canonical way to ADAPT one without touching its weights:
+every matmul ``x @ W`` becomes ``x @ W + (alpha/r) * (x @ A) @ B`` with
+``A`` (in, r) small-random and ``B`` (r, out) ZERO — so an adapted model
+is exactly the base model at init, and training only moves the ~r·(in+out)
+adapter params per layer (optimizer state shrinks by the same factor).
+
+Three pieces, all config-driven:
+
+- ``LlamaConfig(lora_rank=r)`` swaps every matmul for :class:`LoRADense`
+  (models/llama.py ``_dense_cls``) — base kernels stay in the tree, so an
+  imported checkpoint loads unchanged and a frozen-base optimizer mask
+  keeps it bit-identical;
+- :func:`lora_trainable_mask` marks exactly the adapter leaves for
+  ``optax.masked`` (the standard freeze);
+- :func:`merge_lora` folds ``(alpha/r)·A@B`` into the kernels and returns
+  a plain (lora_rank=0) tree for serving — zero inference overhead, and
+  the merged model then composes with int8 quantization, TP shardings,
+  speculative decoding, everything.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class LoRADense(nn.Module):
+    """``x @ kernel + (alpha/rank) * (x @ lora_A) @ lora_B`` (no bias)."""
+
+    features: int
+    rank: int
+    alpha: float
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (in_dim, self.features),
+        ).astype(self.dtype)
+        a = self.param(
+            "lora_A", nn.initializers.normal(0.01), (in_dim, self.rank)
+        ).astype(self.dtype)
+        b = self.param(
+            "lora_B", nn.initializers.zeros, (self.rank, self.features)
+        ).astype(self.dtype)
+        x = x.astype(self.dtype)
+        return x @ kernel + (self.alpha / self.rank) * ((x @ a) @ b)
+
+
+def lora_trainable_mask(params):
+    """Boolean pytree: True exactly on ``lora_A``/``lora_B`` leaves — feed
+    ``optax.masked(opt, mask)`` to freeze the base model."""
+
+    def mark(path, leaf):
+        names = [getattr(k, "key", getattr(k, "name", "")) for k in path]
+        return names[-1] in ("lora_A", "lora_B")
+
+    return jax.tree_util.tree_map_with_path(mark, params)
+
+
+def make_lora_optimizer(base_optimizer):
+    """Wrap an optax optimizer so ONLY adapter params receive updates.
+
+    ``optax.masked`` alone would pass the base params' raw gradients
+    through untouched (its contract is pass-through, not freeze);
+    ``multi_transform`` routes adapters to the real optimizer and
+    everything else to ``set_to_zero`` — the base model stays
+    bit-identical through training (tests pin this) and optimizer state
+    is sized for the adapters only.
+    """
+
+    def labels(tree):
+        return jax.tree.map(
+            lambda m: "train" if m else "freeze", lora_trainable_mask(tree)
+        )
+
+    return optax.multi_transform(
+        {"train": base_optimizer, "freeze": optax.set_to_zero()}, labels
+    )
+
+
+def merge_lora(params, config):
+    """Fold each adapter into its kernel; -> plain lora_rank=0 tree.
+
+    The merged tree loads into ``LlamaConfig(lora_rank=0)`` (or int8 via
+    quantize_llama_params, TP via llama_tp_shardings, ...) with the
+    adapted behaviour baked in and zero inference overhead.
+    """
+    scale = config.lora_alpha / config.lora_rank
+
+    def walk(tree):
+        out = {}
+        for name, sub in tree.items():
+            if isinstance(sub, dict) and "lora_A" in sub:
+                merged = sub["kernel"] + scale * (
+                    sub["lora_A"] @ sub["lora_B"]
+                )
+                out[name] = {"kernel": merged}
+            elif isinstance(sub, dict):
+                out[name] = walk(sub)
+            else:
+                out[name] = sub
+        return out
+
+    return {k: walk(v) for k, v in params.items()}

@@ -1,0 +1,155 @@
+"""LoRA adapter oracles (models/lora.py)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+from ddl25spring_tpu.models.lora import (
+    lora_trainable_mask,
+    make_lora_optimizer,
+    merge_lora,
+)
+from ddl25spring_tpu.ops import causal_lm_loss
+
+BASE = LlamaConfig(vocab_size=64, dmodel=32, nr_heads=4, nr_layers=2,
+                   ctx_size=32)
+LORA = dataclasses.replace(BASE, lora_rank=4)
+
+
+def _adapt(base_params, lora_params):
+    """Copy the base kernels into a freshly initialised LoRA tree."""
+
+    def graft(lp, bp):
+        out = {}
+        for k, v in lp.items():
+            if isinstance(v, dict) and "lora_A" in v:
+                out[k] = dict(v, kernel=bp[k]["kernel"])
+            elif isinstance(v, dict):
+                out[k] = graft(v, bp[k])
+            else:
+                out[k] = bp[k]
+        return out
+
+    return {"params": graft(lora_params["params"], base_params["params"])}
+
+
+@pytest.fixture(scope="module")
+def models():
+    tokens = jax.random.randint(jax.random.key(0), (2, 16), 0, 64)
+    base = Llama(BASE).init(jax.random.key(1), tokens)
+    lora = _adapt(base, Llama(LORA).init(jax.random.key(2), tokens))
+    return base, lora, tokens
+
+
+def test_zero_init_adapter_is_the_base_model(models):
+    """lora_B starts at zero, so the adapted model IS the base model."""
+    base, lora, tokens = models
+    np.testing.assert_array_equal(
+        np.asarray(Llama(LORA).apply(lora, tokens)),
+        np.asarray(Llama(BASE).apply(base, tokens)),
+    )
+
+
+def test_masked_training_moves_only_adapters(models):
+    """make_lora_optimizer freezes the base: after training steps the
+    kernels are bit-identical, the adapters moved, and the loss fell."""
+    base, lora, tokens = models
+    model = Llama(LORA)
+    opt = make_lora_optimizer(optax.adam(1e-2))
+    state = opt.init(lora)
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(
+            lambda p: causal_lm_loss(model.apply(p, tokens), tokens)
+        )(p)
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s, loss
+
+    params, losses = lora, []
+    for _ in range(8):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    mask = lora_trainable_mask(params)
+    for (path, new), (_, old), (_, m) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(lora),
+        jax.tree_util.tree_leaves_with_path(mask),
+    ):
+        if m:
+            assert not np.array_equal(np.asarray(new), np.asarray(old)), (
+                path
+            )
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(new), np.asarray(old), err_msg=str(path)
+            )
+
+
+def test_merge_lora_equals_adapter_forward(models):
+    """Folding alpha/r * A @ B into the kernels reproduces the adapted
+    forward in a plain lora_rank=0 model (serving: zero overhead)."""
+    base, lora, tokens = models
+    # give the adapters nonzero weights so the merge actually does work
+    k = jax.random.key(3)
+
+    def perturb(path, leaf):
+        names = [getattr(p, "key", "") for p in path]
+        if names[-1] == "lora_B":
+            return jax.random.normal(
+                jax.random.fold_in(k, len(str(path))), leaf.shape
+            ) * 0.02
+        return leaf
+
+    lora2 = jax.tree_util.tree_map_with_path(perturb, lora)
+    want = Llama(LORA).apply(lora2, tokens)
+    merged = merge_lora(lora2, LORA)
+    got = Llama(BASE).apply(merged, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+    assert float(np.abs(np.asarray(want)
+                        - np.asarray(Llama(BASE).apply(base, tokens))
+                        ).max()) > 1e-3  # the adapters changed behaviour
+
+
+def test_lora_on_imported_hf_weights():
+    """The intended pipeline: HF checkpoint -> adapters on top -> the
+    adapted model starts exactly at the imported model."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+    from import_hf_llama import config_from_hf, params_from_hf_state_dict
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=32,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf.config)
+    base = params_from_hf_state_dict(hf.state_dict(), cfg)
+    lcfg = dataclasses.replace(cfg, lora_rank=4)
+    tokens = jnp.asarray([[3, 9, 27, 1]])
+    lora = _adapt(base, Llama(lcfg).init(jax.random.key(0), tokens))
+    np.testing.assert_array_equal(
+        np.asarray(Llama(lcfg).apply(lora, tokens)),
+        np.asarray(Llama(cfg).apply(base, tokens)),
+    )
+
+
+def test_int8_lora_rejected():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        dataclasses.replace(BASE, lora_rank=4, weights_int8=True)
