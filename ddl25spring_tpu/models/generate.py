@@ -233,3 +233,34 @@ def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
         return jnp.concatenate([prompt, gen], axis=1)
 
     return decode
+
+
+def sequence_logprobs(config: LlamaConfig, params, tokens,
+                      prompt_lengths=None):
+    """Per-token log-probabilities of ``tokens`` under the model —
+    the scoring side of serving (reranking, likelihood eval,
+    distillation targets).
+
+    ``tokens`` (B, T) int32; returns (B, T-1) float32 where entry
+    ``[b, t]`` is ``log p(tokens[b, t+1] | tokens[b, :t+1])``.  With
+    ``prompt_lengths``, positions at or beyond a row's true length score
+    0 (log-prob of padding is meaningless); rows are expected
+    RIGHT-padded as in :func:`generate`.  One full forward, no cache.
+    """
+    B, T = tokens.shape
+    model = Llama(config)
+    logits = model.apply(
+        {"params": params["params"] if "params" in params else params},
+        tokens,
+    )
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.take_along_axis(
+        logp[:, :-1], tokens[:, 1:, None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    if prompt_lengths is not None:
+        _check_prompt_lengths(prompt_lengths, T)
+        valid = jnp.arange(1, T)[None, :] < jnp.asarray(
+            prompt_lengths
+        )[:, None]
+        out = jnp.where(valid, out, 0.0)
+    return out

@@ -128,3 +128,22 @@ def test_imported_weights_compose_into_pipeline_stages():
     h = stages[0].apply(stage_params[0], jnp.asarray(tokens_np))
     h = stages[1].apply(stage_params[1], h)
     np.testing.assert_allclose(np.asarray(h), want, atol=2e-4, rtol=1e-3)
+
+
+def test_sequence_logprobs_match_hf_loss():
+    """Scoring oracle: mean negative sequence_logprobs over a batch equals
+    transformers' own causal-LM loss on the same tokens."""
+    from ddl25spring_tpu.models.generate import sequence_logprobs
+
+    hf = _tiny_hf(2)
+    cfg = config_from_hf(hf.config)
+    params = params_from_hf_state_dict(hf.state_dict(), cfg)
+    tokens_np = np.array([[3, 17, 99, 4, 56, 2], [1, 2, 3, 4, 5, 6]])
+    with torch.no_grad():
+        want = float(
+            hf(torch.tensor(tokens_np), labels=torch.tensor(tokens_np))
+            .loss.numpy()
+        )
+    lp = np.asarray(sequence_logprobs(cfg, params, jnp.asarray(tokens_np)))
+    got = float(-lp.mean())
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
