@@ -369,15 +369,25 @@ class Attention(nn.Module):
         # other shards) are DROPPED, so each step touches at most T cache
         # rows (the non-sharded path's O(1)-write property, kept)
         local_idx = positions - idx * S_local          # (T,) or (B, T)
+        # mode="drop" alone is NOT enough: JAX wraps negative indices
+        # before dropping, so a slot owned by a *lower* shard would wrap
+        # into a valid local row and corrupt it (and when the write window
+        # is wider than S_local, a wrapped and a real position can collide
+        # on the same row with implementation-defined update order).
+        # Route every out-of-window index to the explicit OOB sentinel
+        # S_local first; only then is the drop well-defined.
+        safe_idx = jnp.where(
+            (local_idx >= 0) & (local_idx < S_local), local_idx, S_local
+        )
         if per_row:
             row_scatter = jax.vmap(
                 lambda c, blk, ii: c.at[ii].set(blk, mode="drop")
             )
-            ck.value = row_scatter(ck.value, k, local_idx)
-            cv.value = row_scatter(cv.value, v, local_idx)
+            ck.value = row_scatter(ck.value, k, safe_idx)
+            cv.value = row_scatter(cv.value, v, safe_idx)
         else:
-            ck.value = ck.value.at[:, local_idx].set(k, mode="drop")
-            cv.value = cv.value.at[:, local_idx].set(v, mode="drop")
+            ck.value = ck.value.at[:, safe_idx].set(k, mode="drop")
+            cv.value = cv.value.at[:, safe_idx].set(v, mode="drop")
 
         qg = q.reshape(B, T, Hkv, cfg.nr_heads // Hkv, cfg.head_dim)
         scale = 1.0 / jnp.sqrt(cfg.head_dim).astype(jnp.float32)

@@ -147,3 +147,26 @@ def test_sequence_logprobs_match_hf_loss():
     lp = np.asarray(sequence_logprobs(cfg, params, jnp.asarray(tokens_np)))
     got = float(-lp.mean())
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_ctx_size_capped_and_overridable(capsys):
+    """config_from_hf must not size KV caches to a 128k-position
+    checkpoint's full window (every decode cache is B x ctx x Hkv x hd
+    per layer): default caps at DEFAULT_CTX_CAP with a stderr hint,
+    explicit ctx_size= wins either way."""
+    from import_hf_llama import DEFAULT_CTX_CAP
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=131072,
+    )
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.ctx_size == DEFAULT_CTX_CAP
+    assert "capping ctx_size" in capsys.readouterr().err
+
+    assert config_from_hf(hf_cfg, ctx_size=2048).ctx_size == 2048
+    # small windows import verbatim, no cap, no noise
+    hf_cfg.max_position_embeddings = 64
+    assert config_from_hf(hf_cfg).ctx_size == 64
+    assert capsys.readouterr().err == ""

@@ -160,6 +160,39 @@ def test_sharded_cache_generate_matches_single_device():
     np.testing.assert_array_equal(np.asarray(got_r), np.asarray(want_r))
 
 
+def test_sharded_cache_generate_long_prompt_spans_shards():
+    """Prefill window WIDER than one shard's cache slice (prompt 12 >
+    S_local = ctx/8 = 8): every device sees local indices that are
+    negative, in-window, and past-the-end in the same scatter.  This is
+    the headline regime of sequence-sharded decode and the exact shape of
+    the r3 advisor finding — without the OOB-sentinel remap
+    (llama.py::_sharded_decode_attention), negative indices wrap and a
+    wrapped/real pair collide on one row with undefined order."""
+    import numpy as np
+
+    from ddl25spring_tpu.models import generate
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.parallel import make_mesh, make_sp_generate
+
+    cfg = LlamaConfig(vocab_size=48, dmodel=32, nr_heads=4, nr_kv_heads=2,
+                      nr_layers=2, ctx_size=64)
+    mesh = make_mesh({"seq": 8})
+    prompt = jax.random.randint(jax.random.key(5), (2, 12), 1, 48)
+    params = Llama(cfg).init(jax.random.key(0), prompt,
+                             positions=jnp.arange(12))
+    sp_gen = make_sp_generate(cfg, mesh)
+
+    want = generate(cfg, params, prompt, 10)
+    got = sp_gen(params, prompt, 10)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # ragged long prompts: pad region must stay invisible across shards
+    lengths = jnp.asarray([9, 12])
+    want_r = generate(cfg, params, prompt, 8, prompt_lengths=lengths)
+    got_r = sp_gen(params, prompt, 8, prompt_lengths=lengths)
+    np.testing.assert_array_equal(np.asarray(got_r), np.asarray(want_r))
+
+
 def test_sharded_cache_speculative_matches_single_device():
     """Speculative decoding OVER the sequence-sharded cache
     (make_sp_speculative): the two serving accelerators compose — per-row

@@ -40,10 +40,35 @@ sys.path.insert(0, str(Path(__file__).parent.parent))
 from ddl25spring_tpu.models.llama import LlamaConfig  # noqa: E402
 
 
-def config_from_hf(hf_config) -> LlamaConfig:
-    """Map a ``transformers.LlamaConfig`` onto :class:`LlamaConfig`."""
+#: decode-path caches are allocated at the FULL ``ctx_size`` per layer
+#: (B × ctx × Hkv × hd), so importing a 128k-position checkpoint verbatim
+#: would OOM generate/speculative long before any real serving limit.
+#: Cap by default; pass ``ctx_size=`` to override either way
+#: (``dataclasses.replace(cfg, ctx_size=...)`` works after the fact too).
+DEFAULT_CTX_CAP = 8192
+
+
+def config_from_hf(hf_config, ctx_size: int | None = None) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` onto :class:`LlamaConfig`.
+
+    ``ctx_size`` overrides the imported context window; by default the
+    checkpoint's ``max_position_embeddings`` is capped at
+    :data:`DEFAULT_CTX_CAP` (with a warning) because this framework sizes
+    every KV cache to the full window.
+    """
     inter = hf_config.intermediate_size
     dmodel = hf_config.hidden_size
+    ctx = hf_config.max_position_embeddings
+    if ctx_size is not None:
+        ctx = ctx_size
+    elif ctx > DEFAULT_CTX_CAP:
+        print(
+            f"[import_hf_llama] capping ctx_size {ctx} -> {DEFAULT_CTX_CAP}"
+            " (KV caches are allocated at full ctx_size; pass ctx_size= to"
+            " override)",
+            file=sys.stderr,
+        )
+        ctx = DEFAULT_CTX_CAP
     cfg = LlamaConfig(
         vocab_size=hf_config.vocab_size,
         dmodel=dmodel,
@@ -55,7 +80,7 @@ def config_from_hf(hf_config) -> LlamaConfig:
             else hf_config.num_key_value_heads
         ),
         nr_layers=hf_config.num_hidden_layers,
-        ctx_size=hf_config.max_position_embeddings,
+        ctx_size=ctx,
         hidden_mult=inter / dmodel,
         norm_eps=hf_config.rms_norm_eps,
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
@@ -122,15 +147,20 @@ def params_from_hf_state_dict(state_dict, config: LlamaConfig):
 
 
 def main() -> int:
-    if len(sys.argv) != 3:
+    args = [a for a in sys.argv[1:] if not a.startswith("--ctx-size")]
+    ctx = next((int(a.split("=", 1)[1]) for a in sys.argv[1:]
+                if a.startswith("--ctx-size=")), None)
+    if len(args) != 2:
         print(__doc__.splitlines()[-2])
+        print("  options: --ctx-size=N  serving context window "
+              f"(default: checkpoint's, capped at {DEFAULT_CTX_CAP})")
         return 2
-    src, out = sys.argv[1], sys.argv[2]
+    src, out = args
     from flax import serialization
     from transformers import LlamaForCausalLM
 
     model = LlamaForCausalLM.from_pretrained(src)
-    cfg = config_from_hf(model.config)
+    cfg = config_from_hf(model.config, ctx_size=ctx)
     params = params_from_hf_state_dict(model.state_dict(), cfg)
     Path(out).write_bytes(serialization.to_bytes(params))
     print(f"wrote {out}; config: {cfg}")
