@@ -147,15 +147,20 @@ def params_from_hf_state_dict(state_dict, config: LlamaConfig):
 
 
 def main() -> int:
-    args = [a for a in sys.argv[1:] if not a.startswith("--ctx-size")]
-    ctx = next((int(a.split("=", 1)[1]) for a in sys.argv[1:]
-                if a.startswith("--ctx-size=")), None)
-    if len(args) != 2:
-        print(__doc__.splitlines()[-2])
-        print("  options: --ctx-size=N  serving context window "
-              f"(default: checkpoint's, capped at {DEFAULT_CTX_CAP})")
-        return 2
-    src, out = args
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[-2] if __doc__ else None
+    )
+    parser.add_argument("src")
+    parser.add_argument("out")
+    parser.add_argument(
+        "--ctx-size", type=int, default=None,
+        help="serving context window "
+             f"(default: checkpoint's, capped at {DEFAULT_CTX_CAP})",
+    )
+    ns = parser.parse_args()
+    src, out, ctx = ns.src, ns.out, ns.ctx_size
     from flax import serialization
     from transformers import LlamaForCausalLM
 
