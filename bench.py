@@ -183,7 +183,46 @@ def cost_breakdown(server) -> dict:
     for k, v in ca.items():
         if k.startswith("bytes accessed"):
             keep[k.replace(" ", "_")] = float(v)
+    # XLA's own optimal_seconds is unreliable on this client (observed
+    # NEGATIVE on the round-4 capture) — derive the roofline ourselves
+    # from chip peaks instead.  One roofline second per bound:
+    #   flops / peak_flops   (MXU-bound floor)
+    #   bytes / peak_bw      (HBM-bound floor)
+    # measured_round_time / max(...) is then the fraction-of-roofline.
+    peaks = _chip_peaks()
+    if peaks and "flops" in keep:
+        f, b = keep["flops"], keep.get("bytes_accessed", 0.0)
+        keep["roofline_seconds_flops"] = f / peaks["flops_per_s"]
+        keep["roofline_seconds_bytes"] = b / peaks["hbm_bytes_per_s"]
+        keep["roofline_seconds"] = max(
+            keep["roofline_seconds_flops"], keep["roofline_seconds_bytes"]
+        )
+        keep["roofline_peaks"] = peaks
     return keep
+
+
+def _chip_peaks() -> dict | None:
+    """Datasheet peaks for the chip we're on (bf16 MXU FLOP/s, HBM B/s).
+
+    Public numbers: TPU v5e 197 TFLOP/s bf16, 819 GB/s HBM; v4 275/1228;
+    v5p 459/2765.  Returns None off-TPU or for unknown kinds (the roofline
+    fields are then simply omitted rather than wrong)."""
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    table = {
+        "v5 lite": (197e12, 819e9),  # v5e; device_kind 'TPU v5 lite*'
+        "v5e": (197e12, 819e9),
+        "v4": (275e12, 1228e9),
+        "v5p": (459e12, 2765e9),
+        "v6 lite": (918e12, 1640e9),  # v6e / Trillium
+        "v6e": (918e12, 1640e9),
+    }
+    for name, (fl, bw) in table.items():
+        if name in kind:
+            return {"kind": kind, "flops_per_s": fl, "hbm_bytes_per_s": bw}
+    return None
 
 
 def timed_rounds(server, nr_rounds: int, fused: bool = True) -> float:

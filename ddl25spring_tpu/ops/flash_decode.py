@@ -20,10 +20,15 @@ head's (group, hd) query tile — no head expansion anywhere.  Forward-only
 by design (generation never differentiates through decode), so no custom
 VJP is needed.
 
-Layout: the group dim is padded to the f32 sublane multiple (>= 8) so the
-q tile is (g_pad, hd) and the running max/denominator scratches are 2-D
-(g_pad, 1) — vreg-native shapes rather than odd sub-sublane tiles whose
-acceptance only a real Mosaic lowering can confirm (advisor r2).
+Layout: the group dim is padded to the f32 sublane multiple (>= 8) so each
+head's q tile is (g_pad, hd) and the running max/denominator scratches are
+(Hkv, g_pad, 1) — vreg-native trailing shapes rather than odd sub-sublane
+tiles whose acceptance only a real Mosaic lowering can confirm (advisor
+r2).  The K/V BlockSpec carries ALL Hkv heads per chunk — its trailing
+(Hkv, hd) dims equal the array dims, which Mosaic's tiling rule always
+accepts, where a per-head (1, hd) block is rejected for Hkv > 1 (first
+real-TPU run, results/tpu_validate.txt round 4); the head loop is a
+static unroll inside the kernel instead.
 
 Validated in interpret mode (oracle: tests/test_flash_decode.py pins it to
 the XLA decode path bit-for-bit-close, including ragged pads); OFF by
@@ -45,9 +50,9 @@ from .flash_attention import NEG_INF, _pick_block
 
 
 def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
-            *, block_k, scale, nr_k):
+            *, block_k, scale, nr_k, nr_kv_heads):
     b = pl.program_id(0)
-    j = pl.program_id(2)
+    j = pl.program_id(1)
     pos = pos_ref[b]  # per-row positions (speculative decode rows diverge)
 
     @pl.when(j == 0)
@@ -58,31 +63,38 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
 
     @pl.when(j * block_k <= pos)
     def _compute():
-        q = q_ref[0, 0]                    # (g_pad, hd)
-        k = k_ref[0, :, 0, :]              # (block_k, hd)
-        v = v_ref[0, :, 0, :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1
         )
         valid = (k_pos <= pos) & (k_pos >= pad_ref[b])
-        s = jnp.where(valid, s, NEG_INF)
-        # scratches are (g_pad, 1) 2-D — Mosaic-native sublane x lane
-        # layout; the zero-padded q rows just compute a uniform softmax
-        # over the valid keys (never NaN) and are sliced off by the caller
-        m_old = m_scr[...]
-        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_old - m_new)
-        m_scr[...] = m_new
-        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc[...] = acc[...] * corr + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32
-        )
+        # static Python loop over KV heads — unrolled at trace time
+        # (Hkv <= 8 in practice).  Blocking ALL heads per K/V chunk keeps
+        # the BlockSpec's trailing dims equal to the array dims, which the
+        # Mosaic tiling rule always accepts; a (1, hd) head-sliced block is
+        # rejected for Hkv > 1 (results/tpu_validate.txt, round 4).
+        for h in range(nr_kv_heads):
+            q = q_ref[0, h]                # (g_pad, hd)
+            k = k_ref[0, :, h, :]          # (block_k, hd)
+            v = v_ref[0, :, h, :]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            s = jnp.where(valid, s, NEG_INF)
+            # scratches are (Hkv, g_pad, 1) — Mosaic-native sublane x lane
+            # trailing layout; the zero-padded q rows just compute a uniform
+            # softmax over the valid keys (never NaN) and are sliced off by
+            # the caller
+            m_old = m_scr[h]
+            m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_old - m_new)
+            m_scr[h] = m_new
+            l_scr[h] = l_scr[h] * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc[h] = acc[h] * corr + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32
+            )
 
     @pl.when(j == nr_k - 1)
     def _final():
-        o_ref[0, 0] = (acc[...] / l_scr[...]).astype(o_ref.dtype)
+        o_ref[0] = (acc[...] / l_scr[...]).astype(o_ref.dtype)
 
 
 def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
@@ -103,6 +115,11 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     _, S, Hkv, _ = cache_k.shape
     g = Hq // Hkv
     block_k = _pick_block(S)
+    # all Hkv heads ride in one K/V block (Mosaic tiling, see _kernel);
+    # keep the chunk within a ~1 MiB VMEM budget so double-buffering fits
+    itemsize = jnp.dtype(cache_k.dtype).itemsize
+    while block_k > 128 and block_k * Hkv * hd * itemsize > (1 << 20):
+        block_k = _pick_block(S, target=block_k // 2)
     nr_k = S // block_k
     scale = 1.0 / (hd ** 0.5)
     if pad is None:
@@ -126,27 +143,28 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     # index maps receive (*grid_indices, *scalar_prefetch_refs)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, Hkv, nr_k),
+        grid=(B, nr_k),
         in_specs=[
-            pl.BlockSpec((1, 1, g_pad, hd),
-                         lambda b, h, j, pos_v, pad_v: (b, h, 0, 0)),
-            pl.BlockSpec((1, block_k, 1, hd),
-                         lambda b, h, j, pos_v, pad_v:
-                         (b, live(b, j, pos_v), h, 0)),
-            pl.BlockSpec((1, block_k, 1, hd),
-                         lambda b, h, j, pos_v, pad_v:
-                         (b, live(b, j, pos_v), h, 0)),
+            pl.BlockSpec((1, Hkv, g_pad, hd),
+                         lambda b, j, pos_v, pad_v: (b, 0, 0, 0)),
+            pl.BlockSpec((1, block_k, Hkv, hd),
+                         lambda b, j, pos_v, pad_v:
+                         (b, live(b, j, pos_v), 0, 0)),
+            pl.BlockSpec((1, block_k, Hkv, hd),
+                         lambda b, j, pos_v, pad_v:
+                         (b, live(b, j, pos_v), 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, g_pad, hd),
-                               lambda b, h, j, pos_v, pad_v: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, Hkv, g_pad, hd),
+                               lambda b, j, pos_v, pad_v: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((g_pad, 1), jnp.float32),
-            pltpu.VMEM((g_pad, 1), jnp.float32),
-            pltpu.VMEM((g_pad, hd), jnp.float32),
+            pltpu.VMEM((Hkv, g_pad, 1), jnp.float32),
+            pltpu.VMEM((Hkv, g_pad, 1), jnp.float32),
+            pltpu.VMEM((Hkv, g_pad, hd), jnp.float32),
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, block_k=block_k, scale=scale, nr_k=nr_k),
+        functools.partial(_kernel, block_k=block_k, scale=scale, nr_k=nr_k,
+                          nr_kv_heads=Hkv),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, g_pad, hd), q.dtype),
         interpret=interpret,

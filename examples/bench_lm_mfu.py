@@ -1,0 +1,133 @@
+"""LM training-step MFU at serious scale (VERDICT r3 #6).
+
+The primer-matched config (d=288, lab/tutorial_1b/primer/intro.py:8-12)
+cannot exercise the MXU — its matmuls are too small to tile.  This bench
+runs a REALISTIC single-chip LM training step — d>=1024, T>=2048, bf16,
+flash attention, Adam — and reports tokens/sec plus MFU:
+
+    MFU = (XLA-counted FLOPs per step / measured step time) / chip peak
+
+FLOPs come from the compiled program's own cost analysis (not an analytic
+formula), the peak from the datasheet table in bench._chip_peaks().  Steps
+are fused into one ``lax.fori_loop`` dispatch so per-dispatch tunnel RPC
+latency (~50 ms here, see results/flash_tpu.txt's flat small-T rows) does
+not pollute the measurement.
+
+Usage: python examples/bench_lm_mfu.py [--dmodel 1024] [--seq 2048]
+           [--batch 8] [--layers 8] [--steps 8] [--attn flash]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dmodel", type=int, default=1024)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--kv-heads", type=int, default=None)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--attn", default="flash", choices=["flash", "dense"])
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="smoke-test on CPU (env JAX_PLATFORMS is forced to "
+                         "axon by the image; only config.update sticks)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    import bench  # repo root: _chip_peaks datasheet table
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.ops import causal_lm_loss
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    cfg = LlamaConfig(
+        vocab_size=args.vocab, dmodel=args.dmodel, nr_heads=args.heads,
+        nr_kv_heads=args.kv_heads, nr_layers=args.layers,
+        ctx_size=args.seq, attn_impl=args.attn, remat=args.remat,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    model = Llama(cfg)
+    optimizer = optax.adam(3e-4)
+
+    def loss_fn(params, tokens):
+        return causal_lm_loss(model.apply(params, tokens), tokens)
+
+    @partial(jax.jit, static_argnames=("nr",))
+    def run_n(params, opt_state, tokens, nr):
+        def body(_, carry):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        return jax.lax.fori_loop(0, nr, body, (params, opt_state))
+
+    t0 = time.perf_counter()
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (args.batch, args.seq), 0, args.vocab)
+    params = jax.jit(model.init)(key, tokens)
+    opt_state = jax.jit(optimizer.init)(params)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    print(f"backend={backend} attn={args.attn} d={args.dmodel} "
+          f"L={args.layers} H={args.heads} T={args.seq} B={args.batch} "
+          f"vocab={args.vocab} params={n_params / 1e6:.1f}M",
+          flush=True)
+
+    lowered = run_n.lower(params, opt_state, tokens, nr=args.steps)
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    flops_total = float(ca.get("flops", 0.0))
+    flops_step = flops_total / args.steps
+
+    # warmup dispatch (buffers land on device), then the timed one
+    out = compiled(params, opt_state, tokens)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = compiled(params, opt_state, tokens)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    step_s = dt / args.steps
+    tok_s = args.batch * args.seq / step_s
+
+    peaks = bench._chip_peaks()
+    mfu = (flops_step / step_s / peaks["flops_per_s"]) if peaks else None
+    line = {
+        "metric": "lm_train_step",
+        "backend": backend,
+        "attn": args.attn,
+        "dmodel": args.dmodel, "layers": args.layers, "seq": args.seq,
+        "batch": args.batch, "params_m": round(n_params / 1e6, 1),
+        "compile_s": round(compile_s, 1),
+        "step_ms": round(step_s * 1e3, 2),
+        "tokens_per_sec": round(tok_s, 0),
+        "flops_per_step": flops_step,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+    }
+    print(json.dumps(line), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

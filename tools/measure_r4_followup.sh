@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-4 follow-up battery: runs what the main battery could not —
+# the fixed flash-decode kernel + precision-context validation, the
+# roofline-annotated cost analysis, and the flash-on decode benches.
+# Same tunnel discipline as measure_when_up.sh: wait for a probe,
+# must-have first, log to /tmp/measure_r4.log.
+cd /root/repo || exit 1
+LOG=/tmp/measure_r4.log
+echo "$(date +%H:%M:%S) r4 follow-up sentinel started" >> "$LOG"
+while true; do
+  if timeout 60 python - <<'EOF' >/dev/null 2>&1
+import numpy as np, jax.numpy as jnp
+np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+EOF
+  then
+    echo "$(date +%H:%M:%S) tunnel UP — r4 follow-up measuring" >> "$LOG"
+    sleep 2
+    timeout 2400 python tools/tpu_validate.py \
+      > results/tpu_validate.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) kernel validation done (exit $rc)" >> "$LOG"
+    if [ "$rc" -ne 0 ] && ! grep -q '"tpu_validate"' results/tpu_validate.txt \
+        2>/dev/null; then
+      echo "$(date +%H:%M:%S) validation produced nothing — back to waiting" \
+        >> "$LOG"
+      sleep 300
+      continue
+    fi
+    timeout 1800 python bench.py --deadline-s 900 --cost-analysis \
+      --norm-impl lean \
+      > results/bench_tpu_costs_lean.json 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) lean cost analysis (roofline) done (exit $rc)" >> "$LOG"
+    timeout 1800 python examples/bench_lm_mfu.py \
+      > results/lm_mfu_tpu.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) LM MFU bench done (exit $rc)" >> "$LOG"
+    timeout 1200 python examples/bench_generate.py --batches 1 \
+      --decode-impl flash-decode \
+      > results/generate_flash_tpu.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) flash-decode generate done (exit $rc)" >> "$LOG"
+    echo "$(date +%H:%M:%S) r4 follow-up sentinel finished" >> "$LOG"
+    exit 0
+  fi
+  sleep 90
+done

@@ -1,0 +1,117 @@
+"""Hardware-independent north-star tracking: scaled FedAvg on the CPU mesh.
+
+The real north star (bench.py: 256 clients, CIFAR-10, ResNet-18, one real
+TPU) needs the tunnel, which has been down for whole rounds (BENCH_r01-r03
+all "device unreachable").  This tool measures a SCALED-DOWN but
+architecturally identical round — 32 clients, C=0.25 (8 sampled = 1 per
+device of the 8-device virtual CPU mesh), ResNet-18, B=50, E=1, fused
+``lax.fori_loop`` rounds — on the always-available CPU backend, and appends
+the result to ``results/northstar_cpu_trend.jsonl``.
+
+Run it every round (VERDICT r3 #2): FL-engine perf regressions then show up
+as a dropped rounds/sec in the committed trend even when the TPU is dark.
+``tests/test_northstar_trend.py`` asserts the latest committed entry stays
+above an absolute floor.
+
+Usage: python tools/northstar_cpu.py [--rounds N] [--dry-run]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+NR_CLIENTS = 32
+CLIENT_FRACTION = 0.25  # 8 sampled clients = 1 per device
+N_TRAIN = 6400  # 200 images/client, 4 minibatches of 50 per local epoch
+TREND = Path(__file__).resolve().parent.parent / "results" / "northstar_cpu_trend.jsonl"
+
+
+def build_scaled_server(seed: int = 10):
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.data.cifar import cifar_input_transform
+    from ddl25spring_tpu.data.synth_device import device_synthetic_clients
+    from ddl25spring_tpu.fl import FedAvgServer
+    from ddl25spring_tpu.fl.task import classification_task
+    from ddl25spring_tpu.models import ResNet18
+    from ddl25spring_tpu.parallel import make_mesh
+
+    client_data, test_x, test_y = device_synthetic_clients(
+        nr_clients=NR_CLIENTS, n_train=N_TRAIN, n_test=1000, seed=seed,
+        pad_multiple=50,
+    )
+    task = classification_task(
+        ResNet18(dtype=jnp.bfloat16), (32, 32, 3), test_x, test_y,
+        input_transform=cifar_input_transform(jnp.bfloat16),
+    )
+    mesh = make_mesh({"clients": len(jax.devices())})
+    return FedAvgServer(
+        task, lr=0.05, batch_size=50, client_data=client_data,
+        client_fraction=CLIENT_FRACTION, nr_local_epochs=1, seed=seed,
+        mesh=mesh,
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="measure but do not append to the trend file")
+    args = ap.parse_args()
+
+    assert len(jax.devices()) == 8, jax.devices()
+    import bench  # repo-root module: fused-round AOT machinery
+
+    t0 = time.perf_counter()
+    server = build_scaled_server()
+    compiled, params = bench._aot_fused_rounds(server, args.rounds)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    params = compiled(params, server.run_key, *server.round_fn.data)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    rps = args.rounds / dt
+
+    rev = "unknown"
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, cwd=TREND.parent.parent,
+        ).stdout.strip() or "unknown"
+    except OSError:
+        pass
+    entry = {
+        "date": time.strftime("%Y-%m-%d"),
+        "git": rev,
+        "rounds_per_sec": round(rps, 4),
+        "rounds_timed": args.rounds,
+        "compile_s": round(compile_s, 1),
+        "nr_clients": NR_CLIENTS,
+        "client_fraction": CLIENT_FRACTION,
+        "devices": 8,
+        "backend": "cpu-mesh",
+    }
+    print(json.dumps(entry))
+    if not args.dry_run:
+        with TREND.open("a") as f:
+            f.write(json.dumps(entry) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
