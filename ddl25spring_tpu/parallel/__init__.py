@@ -19,6 +19,7 @@ from .zero import make_zero_dp_train_step
 from .sp import (
     make_sp_forward,
     make_sp_generate,
+    make_sp_speculative,
     make_sp_train_step,
     sp_data_sharding,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "make_interleaved_1f1b_train_step",
     "make_sp_forward",
     "make_sp_generate",
+    "make_sp_speculative",
     "make_sp_train_step",
     "sp_data_sharding",
     "make_mesh",

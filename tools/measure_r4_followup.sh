@@ -3,10 +3,27 @@
 # the fixed flash-decode kernel + precision-context validation, the
 # roofline-annotated cost analysis, and the flash-on decode benches.
 # Same tunnel discipline as measure_when_up.sh: wait for a probe,
-# must-have first, log to /tmp/measure_r4.log.
+# must-have first, log to /tmp/measure_r4.log.  Each artifact is
+# written to a temp file and mv-ed into results/ only on success, so
+# a mid-battery tunnel flake can't truncate committed evidence.
 cd /root/repo || exit 1
 LOG=/tmp/measure_r4.log
 echo "$(date +%H:%M:%S) r4 follow-up sentinel started" >> "$LOG"
+
+capture() {  # capture <timeout_s> <dest> <cmd...>
+  local t=$1 dest=$2; shift 2
+  local tmp
+  tmp=$(mktemp)
+  timeout "$t" "$@" > "$tmp" 2>> "$LOG"
+  local rc=$?
+  if [ -s "$tmp" ]; then
+    mv "$tmp" "$dest"
+  else
+    rm -f "$tmp"
+  fi
+  return $rc
+}
+
 while true; do
   if timeout 60 python - <<'EOF' >/dev/null 2>&1
 import numpy as np, jax.numpy as jnp
@@ -15,26 +32,22 @@ EOF
   then
     echo "$(date +%H:%M:%S) tunnel UP — r4 follow-up measuring" >> "$LOG"
     sleep 2
-    timeout 2400 python tools/tpu_validate.py \
-      > results/tpu_validate.txt 2>> "$LOG"; rc=$?
+    capture 2400 results/tpu_validate.txt python tools/tpu_validate.py; rc=$?
     echo "$(date +%H:%M:%S) kernel validation done (exit $rc)" >> "$LOG"
-    if [ "$rc" -ne 0 ] && ! grep -q '"tpu_validate"' results/tpu_validate.txt \
-        2>/dev/null; then
-      echo "$(date +%H:%M:%S) validation produced nothing — back to waiting" \
+    if ! grep -q '"tpu_validate"' results/tpu_validate.txt 2>/dev/null; then
+      echo "$(date +%H:%M:%S) validation produced no summary — waiting" \
         >> "$LOG"
       sleep 300
       continue
     fi
-    timeout 1800 python bench.py --deadline-s 900 --cost-analysis \
-      --norm-impl lean \
-      > results/bench_tpu_costs_lean.json 2>> "$LOG"; rc=$?
+    capture 1800 results/bench_tpu_costs_lean.json \
+      python bench.py --deadline-s 900 --cost-analysis --norm-impl lean; rc=$?
     echo "$(date +%H:%M:%S) lean cost analysis (roofline) done (exit $rc)" >> "$LOG"
-    timeout 1800 python examples/bench_lm_mfu.py \
-      > results/lm_mfu_tpu.txt 2>> "$LOG"; rc=$?
+    capture 1800 results/lm_mfu_tpu.txt \
+      python examples/bench_lm_mfu.py; rc=$?
     echo "$(date +%H:%M:%S) LM MFU bench done (exit $rc)" >> "$LOG"
-    timeout 1200 python examples/bench_generate.py --batches 1 \
-      --decode-impl flash-decode \
-      > results/generate_flash_tpu.txt 2>> "$LOG"; rc=$?
+    capture 1200 results/generate_flash_tpu.txt \
+      python examples/bench_generate.py --batches 1 --decode-impl flash-decode; rc=$?
     echo "$(date +%H:%M:%S) flash-decode generate done (exit $rc)" >> "$LOG"
     echo "$(date +%H:%M:%S) r4 follow-up sentinel finished" >> "$LOG"
     exit 0
