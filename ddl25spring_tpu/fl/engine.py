@@ -179,6 +179,7 @@ def make_fl_round(
     compress: str = "none",
     compress_ratio: float = 0.01,
     compress_deltas: bool = True,
+    device_put_data: bool = True,
 ):
     """Build the jitted one-round function of a decentralized server.
 
@@ -290,7 +291,10 @@ def make_fl_round(
         from jax.sharding import NamedSharding, PartitionSpec
 
         cshard = NamedSharding(mesh, PartitionSpec(clients_axis))
-        if nr_clients % mesh.shape[clients_axis] == 0:
+        # device_put_data=False: AOT topology compiles (tools/aot_validate)
+        # lower against non-addressable devices where a put would fail; the
+        # in-trace with_sharding_constraint still carries the layout
+        if device_put_data and nr_clients % mesh.shape[clients_axis] == 0:
             x = jax.device_put(x, cshard)
             y = jax.device_put(y, cshard)
             counts = jax.device_put(counts, cshard)

@@ -409,8 +409,17 @@ def _flash_block_bwd_rule(causal, interpret, res, g):
 _flash_block.defvjp(_flash_block_fwd_rule, _flash_block_bwd_rule)
 
 
+#: ``interpret=None`` auto-select override.  AOT TPU-topology compiles
+#: (tools/aot_validate.py) trace under a CPU *default* backend while
+#: compiling for a TPU *target*, so the backend sniff below would wrongly
+#: pick the interpreter; they set this to False for the trace.
+INTERPRET_OVERRIDE: bool | None = None
+
+
 def _resolve_interpret(interpret):
     if interpret is None:
+        if INTERPRET_OVERRIDE is not None:
+            return INTERPRET_OVERRIDE
         return jax.default_backend() != "tpu"
     return interpret
 

@@ -109,8 +109,9 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     rows ``<= pos`` are live.  ``pad``: (B,) left-pad widths for ragged
     batches (None = all zeros).  Returns (B, Hq, hd).
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    from .flash_attention import _resolve_interpret
+
+    interpret = _resolve_interpret(interpret)
     B, Hq, hd = q.shape
     _, S, Hkv, _ = cache_k.shape
     g = Hq // Hkv

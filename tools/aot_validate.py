@@ -1,0 +1,317 @@
+"""AOT Mosaic validation + cost analysis against a TPU *topology* — no chip.
+
+The remote-tunnel chip has been unreachable for whole rounds (BENCH_r01-r03),
+leaving every Pallas kernel and SPMD program unvalidated against the real
+TPU toolchain.  This tool removes the tunnel from the loop: JAX ships a
+compile-only TPU client (``jax.experimental.topologies``), so the REAL
+XLA:TPU + Mosaic compiler can run locally against a described topology:
+
+- ``v5e:2x2`` single-device section: every Pallas kernel the framework
+  ships (flash fwd/bwd f32+bf16, the ring/zigzag building block + lse
+  grad, flash-decode across the GQA matrix at hd 64/128) plus the
+  MFU-scale LM training step — Mosaic accepts or rejects each, and the
+  compiled programs yield XLA cost analyses (the roofline numerators).
+- ``v5e:4x2`` eight-device section: the dryrun strategies compiled as real
+  TPU SPMD programs — TP x DP, SP ring-flash (ppermute collectives), and
+  the client-sharded FedAvg round — which even the live tunnel (ONE chip)
+  could never validate.
+
+Output: one PASS/FAIL line per item + a JSON summary, captured into
+``results/aot_tpu_compile.json`` by the Makefile-less convention of
+``python tools/aot_validate.py > results/aot_tpu_compile.json``.
+
+This compiles but cannot EXECUTE — numerics stay the job of
+tools/tpu_validate.py on the live chip.  Mosaic acceptance + cost modeling
+is exactly the evidence VERDICT r3 #1 asks for when the tunnel is dark.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # never touch the tunnel
+
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+RESULTS = []
+
+
+def check(name, fn):
+    """fn() -> dict of extras (cost analysis etc.); records PASS/FAIL."""
+    t0 = time.monotonic()
+    try:
+        extra = fn() or {}
+        dt = time.monotonic() - t0
+        RESULTS.append({"name": name, "ok": True, "s": round(dt, 1), **extra})
+        print(f"PASS {name}  {dt:.1f}s", file=sys.stderr, flush=True)
+    except Exception as e:
+        dt = time.monotonic() - t0
+        RESULTS.append(
+            {"name": name, "ok": False, "error": repr(e)[:400],
+             "s": round(dt, 1)}
+        )
+        print(f"FAIL {name}  {dt:.1f}s: {repr(e)[:200]}", file=sys.stderr,
+              flush=True)
+
+
+def costs_of(compiled):
+    """Cost analysis of a compiled program, sentinel-filtered.
+
+    XLA cannot see inside Mosaic custom calls: pure-Pallas programs report
+    flops as -1/-2 sentinels and byte counts that exclude the kernel's own
+    traffic.  Negative values are dropped, and programs whose cost is
+    custom-call-opaque are marked so the artifact can't be misread as a
+    roofline measurement.
+    """
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    out = {}
+    for k in ("flops", "bytes accessed", "transcendentals"):
+        if k in ca:
+            v = float(ca[k])
+            if v < 0:
+                out["custom_call_opaque"] = True  # sentinel, not a count
+            else:
+                out[k.replace(" ", "_")] = v
+    return out
+
+
+def main() -> int:
+    from ddl25spring_tpu.ops import flash_attention as fa
+
+    fa.INTERPRET_OVERRIDE = False  # tracing under cpu, compiling for tpu
+
+    topo1 = topologies.get_topology_desc("v5e:2x2", "tpu")
+    dev = topo1.devices[0]
+    print(f"single-device topology: {dev.device_kind}", file=sys.stderr,
+          flush=True)
+
+    from ddl25spring_tpu.ops.flash_attention import (
+        flash_block_attention,
+        flash_causal_attention,
+    )
+    from ddl25spring_tpu.ops.flash_decode import flash_decode_attention
+
+    def sds(shape, dtype=jnp.float32):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    # --- Pallas kernels, single device ----------------------------------
+    for T, hd, dtype in [(2048, 64, jnp.bfloat16), (2048, 64, jnp.float32),
+                         (2048, 128, jnp.bfloat16), (8192, 64, jnp.bfloat16)]:
+        s = sds((2, T, 4, hd), dtype)
+
+        def fwd(s=s):
+            c = jax.jit(flash_causal_attention, device=dev).lower(
+                s, s, s).compile()
+            return costs_of(c)
+
+        check(f"aot flash_fwd T={T} hd={hd} {jnp.dtype(dtype).name}", fwd)
+
+    def fwd_bwd():
+        s = sds((2, 2048, 4, 64), jnp.bfloat16)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_causal_attention(q, k, v).astype(jnp.float32) ** 2)
+
+        c = jax.jit(jax.grad(loss, (0, 1, 2)), device=dev).lower(
+            s, s, s).compile()
+        return costs_of(c)
+
+    check("aot flash_bwd T=2048 hd=64 bf16", fwd_bwd)
+
+    def block():
+        q = sds((2, 1024, 4, 64), jnp.bfloat16)
+        k = sds((2, 2048, 4, 64), jnp.bfloat16)
+
+        def f(q_, k_, v_):
+            o, lse = flash_block_attention(q_, k_, v_, causal=False)
+            return o, lse
+
+        c = jax.jit(f, device=dev).lower(q, k, k).compile()
+        return costs_of(c)
+
+    check("aot flash_block Tq=1024 Tk=2048", block)
+
+    def block_grad():
+        q = sds((2, 1024, 4, 64), jnp.bfloat16)
+        k = sds((2, 2048, 4, 64), jnp.bfloat16)
+
+        def loss(q_, k_, v_):
+            o, lse = flash_block_attention(q_, k_, v_, causal=False)
+            return jnp.sum(o.astype(jnp.float32) ** 2) + 0.1 * jnp.sum(lse)
+
+        c = jax.jit(jax.grad(loss, (0, 1, 2)), device=dev).lower(
+            q, k, k).compile()
+        return costs_of(c)
+
+    check("aot flash_block lse-grad", block_grad)
+
+    for Hq, Hkv, hd in [(8, 8, 64), (8, 4, 64), (8, 1, 64), (6, 3, 64),
+                        (8, 4, 128), (32, 8, 128)]:
+        def dec(Hq=Hq, Hkv=Hkv, hd=hd):
+            B, S = 4, 2048
+            c = jax.jit(flash_decode_attention, device=dev).lower(
+                sds((B, Hq, hd), jnp.bfloat16),
+                sds((B, S, Hkv, hd), jnp.bfloat16),
+                sds((B, S, Hkv, hd), jnp.bfloat16),
+                sds((B,), jnp.int32), sds((B,), jnp.int32),
+            ).compile()
+            return costs_of(c)
+
+        check(f"aot flash_decode Hq={Hq} Hkv={Hkv} hd={hd}", dec)
+
+    # --- MFU-scale LM training step -------------------------------------
+    def lm_step():
+        import optax
+
+        from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+        from ddl25spring_tpu.ops import causal_lm_loss
+
+        cfg = LlamaConfig(
+            vocab_size=32768, dmodel=1024, nr_heads=16, nr_layers=8,
+            ctx_size=2048, attn_impl="flash", dtype=jnp.bfloat16,
+        )
+        model = Llama(cfg)
+        optimizer = optax.adam(3e-4)
+        tokens = jnp.zeros((8, 2048), jnp.int32)
+        params = jax.eval_shape(model.init, jax.random.key(0), tokens)
+        opt_state = jax.eval_shape(optimizer.init, params)
+
+        def step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p, t: causal_lm_loss(model.apply(p, t), t)
+            )(params, tokens)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            import optax as _o
+
+            return _o.apply_updates(params, updates), opt_state, loss
+
+        c = jax.jit(step, device=dev).lower(
+            params, opt_state, sds((8, 2048), jnp.int32)).compile()
+        out = costs_of(c)
+        # modeled MFU ceiling: flops / v5e peak = the step's compute floor
+        out["roofline_step_ms_flops"] = out.get("flops", 0) / 197e12 * 1e3
+        out["roofline_step_ms_bytes"] = (
+            out.get("bytes_accessed", 0) / 819e9 * 1e3
+        )
+        return out
+
+    check("aot LM train step d=1024 L=8 T=2048 B=8 flash bf16", lm_step)
+
+    # --- 8-device SPMD section ------------------------------------------
+    topo8 = topologies.get_topology_desc("v5e:4x2", "tpu")
+    devs8 = np.array(topo8.devices)
+    print(f"8-device topology: {len(topo8.devices)} x "
+          f"{topo8.devices[0].device_kind}", file=sys.stderr, flush=True)
+
+    import optax
+
+    from ddl25spring_tpu.models import Llama, LlamaConfig
+    from ddl25spring_tpu.ops import causal_lm_loss
+    from ddl25spring_tpu.parallel import (
+        llama_tp_shardings,
+        make_sp_train_step,
+    )
+
+    cfg = LlamaConfig(vocab_size=4096, dmodel=256, nr_heads=8, nr_layers=4,
+                      ctx_size=1024, dtype=jnp.bfloat16)
+    model = Llama(cfg)
+    optimizer = optax.sgd(1e-2)
+    tokens_s = sds((8, cfg.ctx_size), jnp.int32)
+
+    def tp_dp():
+        mesh = Mesh(devs8.reshape(4, 2), ("data", "model"))
+        tokens = jnp.zeros((8, cfg.ctx_size), jnp.int32)
+        params = jax.eval_shape(model.init, jax.random.key(0), tokens)
+        shardings = llama_tp_shardings(mesh, params)
+        opt_state = jax.eval_shape(optimizer.init, params)
+
+        def loss_fn(p, t):
+            return causal_lm_loss(model.apply(p, t), t)
+
+        def step(p, s, t):
+            loss, grads = jax.value_and_grad(loss_fn)(p, t)
+            updates, s = optimizer.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, loss
+
+        c = jax.jit(
+            step,
+            in_shardings=(shardings, None, NamedSharding(mesh, P("data"))),
+        ).lower(params, opt_state, tokens_s).compile()
+        return costs_of(c)
+
+    check("aot SPMD TPxDP (4x2) llama step", tp_dp)
+
+    def sp_ring():
+        mesh = Mesh(devs8.reshape(2, 4), ("data", "seq"))
+        import dataclasses
+
+        rf_cfg = dataclasses.replace(cfg, attn_impl="flash")
+        step = make_sp_train_step(rf_cfg, mesh, optimizer, seq_axis="seq",
+                                  data_axis="data")
+        tokens = jnp.zeros((4, cfg.ctx_size), jnp.int32)
+        params = jax.eval_shape(model.init, jax.random.key(0), tokens)
+        opt_state = jax.eval_shape(optimizer.init, params)
+        c = step.lower(
+            params, opt_state, sds((4, cfg.ctx_size), jnp.int32)
+        ).compile()
+        return costs_of(c)
+
+    check("aot SPMD SPxDP (2x4) ring-flash step", sp_ring)
+
+    def fl_round():
+        from ddl25spring_tpu.fl import (
+            make_fl_round,
+            make_local_sgd_update,
+            mnist_task,
+        )
+
+        mesh = Mesh(devs8.reshape(8), ("clients",))
+        nr_clients = 16
+        x = np.zeros((nr_clients, 64, 28, 28, 1), np.float32)
+        y = np.zeros((nr_clients, 64), np.int32)
+        counts = np.full((nr_clients,), 64, np.int32)
+        task = mnist_task(x[0], y[0])
+        params = jax.eval_shape(task.init, jax.random.key(0))
+        update = make_local_sgd_update(task.loss_fn, 0.05, 32, 1)
+        round_fn = make_fl_round(update, x, y, counts, nr_sampled=8,
+                                 mesh=mesh, device_put_data=False)
+        # abstract data avals: concrete arrays would need a device_put to
+        # the topology's non-addressable devices (INVALID_ARGUMENT)
+        data_avals = [
+            jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+            for a in round_fn.data
+        ]
+        key_aval = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+        c = jax.jit(round_fn.raw).lower(
+            params, key_aval, 0, *data_avals
+        ).compile()
+        return costs_of(c)
+
+    check("aot SPMD FL round (8 clients sharded)", fl_round)
+
+    n_ok = sum(r["ok"] for r in RESULTS)
+    print(json.dumps({
+        "aot_validate": True,
+        "passed": n_ok,
+        "total": len(RESULTS),
+        "failed": [r["name"] for r in RESULTS if not r["ok"]],
+        "results": RESULTS,
+    }))
+    return 0 if n_ok == len(RESULTS) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,19 +4,21 @@
 # roofline-annotated cost analysis, and the flash-on decode benches.
 # Same tunnel discipline as measure_when_up.sh: wait for a probe,
 # must-have first, log to /tmp/measure_r4.log.  Each artifact is
-# written to a temp file and mv-ed into results/ only on success, so
-# a mid-battery tunnel flake can't truncate committed evidence.
+# written to a temp file and mv-ed into results/ only when the command
+# exited with an EXPECTED code (validate legitimately exits 1 on FAIL
+# rows), so neither a flake nor a timeout can replace committed
+# evidence with a truncated file.
 cd /root/repo || exit 1
 LOG=/tmp/measure_r4.log
 echo "$(date +%H:%M:%S) r4 follow-up sentinel started" >> "$LOG"
 
-capture() {  # capture <timeout_s> <dest> <cmd...>
-  local t=$1 dest=$2; shift 2
-  local tmp
+capture() {  # capture <timeout_s> <dest> <ok_rcs (csv)> <cmd...>
+  local t=$1 dest=$2 ok_rcs=$3; shift 3
+  local tmp rc
   tmp=$(mktemp)
   timeout "$t" "$@" > "$tmp" 2>> "$LOG"
-  local rc=$?
-  if [ -s "$tmp" ]; then
+  rc=$?
+  if [ -s "$tmp" ] && [[ ",$ok_rcs," == *",$rc,"* ]]; then
     mv "$tmp" "$dest"
   else
     rm -f "$tmp"
@@ -32,21 +34,22 @@ EOF
   then
     echo "$(date +%H:%M:%S) tunnel UP — r4 follow-up measuring" >> "$LOG"
     sleep 2
-    capture 2400 results/tpu_validate.txt python tools/tpu_validate.py; rc=$?
+    capture 2400 results/tpu_validate.txt 0,1 \
+      python tools/tpu_validate.py; rc=$?
     echo "$(date +%H:%M:%S) kernel validation done (exit $rc)" >> "$LOG"
-    if ! grep -q '"tpu_validate"' results/tpu_validate.txt 2>/dev/null; then
-      echo "$(date +%H:%M:%S) validation produced no summary — waiting" \
-        >> "$LOG"
+    if [ "$rc" -ne 0 ] && [ "$rc" -ne 1 ]; then
+      # timeout/kill/not-a-tpu: THIS run produced nothing — wait, retry
+      echo "$(date +%H:%M:%S) validation rc=$rc — back to waiting" >> "$LOG"
       sleep 300
       continue
     fi
-    capture 1800 results/bench_tpu_costs_lean.json \
+    capture 1800 results/bench_tpu_costs_lean.json 0 \
       python bench.py --deadline-s 900 --cost-analysis --norm-impl lean; rc=$?
     echo "$(date +%H:%M:%S) lean cost analysis (roofline) done (exit $rc)" >> "$LOG"
-    capture 1800 results/lm_mfu_tpu.txt \
+    capture 1800 results/lm_mfu_tpu.txt 0 \
       python examples/bench_lm_mfu.py; rc=$?
     echo "$(date +%H:%M:%S) LM MFU bench done (exit $rc)" >> "$LOG"
-    capture 1200 results/generate_flash_tpu.txt \
+    capture 1200 results/generate_flash_tpu.txt 0 \
       python examples/bench_generate.py --batches 1 --decode-impl flash-decode; rc=$?
     echo "$(date +%H:%M:%S) flash-decode generate done (exit $rc)" >> "$LOG"
     echo "$(date +%H:%M:%S) r4 follow-up sentinel finished" >> "$LOG"
