@@ -14,8 +14,11 @@ and only the final fused-multiply-add touches the (N, H, W, C) tensor, in
 bf16.  Numerics: identical reductions; the elementwise rounding differs from
 flax by ~1 bf16 ulp (pinned in ``tests/test_models.py``).
 
-Selectable via ``ResNet(norm_impl="lean")``; default stays flax until the
-A/B lands a measured win (VERDICT round 1, item 2).
+Selectable via ``ResNet(norm_impl="lean")``.  The A/B landed on round-4
+hardware: 3.90 rounds/sec vs flax's 1.55 on the north star at
+equal-or-better final accuracy (results/bench_tpu_lean.json), so
+``bench.py`` now defaults to lean; the flax path remains for the A/B and
+for f32 teaching runs.
 """
 
 from __future__ import annotations

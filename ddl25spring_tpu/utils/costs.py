@@ -1,0 +1,57 @@
+"""Shared XLA cost-analysis helpers (AOT tools + bench roofline).
+
+One place for two things every cost consumer needs:
+
+- ``cost_summary(compiled)``: the flops / bytes-accessed / transcendentals
+  triple with Mosaic custom-call SENTINELS filtered — XLA reports flops as
+  -1/-2 for programs it cannot see inside (Pallas custom calls) and those
+  must never be presented as measurements (round-4 advisor finding);
+- ``v5e (and friends) datasheet peaks`` via :func:`chip_peaks`, shared by
+  ``bench.py`` and the AOT tools so a roofline denominator can never drift
+  between them.
+"""
+
+from __future__ import annotations
+
+#: Datasheet peaks: bf16 MXU FLOP/s and HBM bytes/s per chip kind substring.
+#: Public numbers: v5e 197 TFLOP/s / 819 GB/s; v4 275/1228; v5p 459/2765;
+#: v6e (Trillium) 918/1640.
+PEAKS_TABLE = {
+    "v5 lite": (197e12, 819e9),  # v5e; device_kind 'TPU v5 lite*'
+    "v5e": (197e12, 819e9),
+    "v4": (275e12, 1228e9),
+    "v5p": (459e12, 2765e9),
+    "v6 lite": (918e12, 1640e9),
+    "v6e": (918e12, 1640e9),
+}
+
+
+def chip_peaks(device=None) -> dict | None:
+    """Peaks for ``device`` (default: ``jax.devices()[0]``); None if unknown
+    so callers omit roofline fields rather than fabricate them."""
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    for name, (fl, bw) in PEAKS_TABLE.items():
+        if name in kind:
+            return {"kind": kind, "flops_per_s": fl, "hbm_bytes_per_s": bw}
+    return None
+
+
+def cost_summary(compiled) -> dict:
+    """flops / bytes_accessed / transcendentals of a compiled program,
+    sentinel-filtered: negative values (Mosaic custom-call opacity) become
+    ``custom_call_opaque: True`` instead of numbers."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    out = {}
+    for k in ("flops", "bytes accessed", "transcendentals"):
+        if k in ca:
+            v = float(ca[k])
+            if v < 0:
+                out["custom_call_opaque"] = True
+            else:
+                out[k.replace(" ", "_")] = v
+    return out

@@ -66,26 +66,10 @@ def check(name, fn):
 
 
 def costs_of(compiled):
-    """Cost analysis of a compiled program, sentinel-filtered.
+    """Sentinel-filtered cost triple (shared helper: utils/costs.py)."""
+    from ddl25spring_tpu.utils.costs import cost_summary
 
-    XLA cannot see inside Mosaic custom calls: pure-Pallas programs report
-    flops as -1/-2 sentinels and byte counts that exclude the kernel's own
-    traffic.  Negative values are dropped, and programs whose cost is
-    custom-call-opaque are marked so the artifact can't be misread as a
-    roofline measurement.
-    """
-    ca = compiled.cost_analysis()
-    if isinstance(ca, list):
-        ca = ca[0] if ca else {}
-    out = {}
-    for k in ("flops", "bytes accessed", "transcendentals"):
-        if k in ca:
-            v = float(ca[k])
-            if v < 0:
-                out["custom_call_opaque"] = True  # sentinel, not a count
-            else:
-                out[k.replace(" ", "_")] = v
-    return out
+    return cost_summary(compiled)
 
 
 def main() -> int:

@@ -23,7 +23,7 @@ EOF
     echo "$(date +%H:%M:%S) tunnel UP — measuring" >> "$LOG"
     pkill -f tpu_watch.sh 2>/dev/null
     sleep 2
-    timeout 1800 python bench.py --deadline-s 900 \
+    timeout 1800 python bench.py --deadline-s 900 --norm-impl flax \
       > results/bench_tpu.json 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) bench flax done (exit $rc)" >> "$LOG"
     if ! grep -q '"value": [1-9]' results/bench_tpu.json 2>/dev/null && \
@@ -42,6 +42,7 @@ EOF
       > results/tpu_validate.txt 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) kernel validation done (exit $rc)" >> "$LOG"
     timeout 1800 python bench.py --deadline-s 900 --cost-analysis \
+      --norm-impl flax \
       > results/bench_tpu_costs.json 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) cost analysis done (exit $rc)" >> "$LOG"
     timeout 1800 python bench.py --deadline-s 900 --cost-analysis \

@@ -1,0 +1,107 @@
+"""AOT cost/HLO analysis of the REAL north-star round for the TPU target.
+
+Compiles the exact bench.py program shape — 26 sampled of 256 clients,
+ResNet-18 bf16, B=50, one local epoch — with the local XLA:TPU compiler
+(v5e topology, no tunnel) and reports:
+
+- total flops / bytes accessed and the v5e roofline (the denominators the
+  measured 3.90 rounds/sec must be judged against);
+- every convolution in the optimized HLO (shapes prove whether the
+  client-vmap axis batch-merges into the conv or degrades to grouped
+  convs — the difference between feeding the MXU 1300-image batches and
+  starving it);
+- the same for the lean-norm variant, attributing the measured flax->lean
+  2.5x (results/bench_tpu*.json) to fusion shape changes.
+
+Writes JSON + a conv-shape listing to stdout; run via
+``python tools/northstar_aot_costs.py > results/northstar_aot_costs.txt``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+
+
+def main() -> int:
+    from ddl25spring_tpu.data.cifar import cifar_input_transform
+    from ddl25spring_tpu.fl import make_fl_round, make_local_sgd_update
+    from ddl25spring_tpu.fl.task import classification_task
+    from ddl25spring_tpu.models import ResNet18
+
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    dev = topo.devices[0]
+
+    nr_clients, per, bs = 256, 200, 50
+    x = np.zeros((nr_clients, per, 32, 32, 3), np.uint8)
+    y = np.zeros((nr_clients, per), np.int32)
+    counts = np.full((nr_clients,), per, np.int32)
+
+    out = {"metric": "northstar_aot_costs", "variants": {}}
+    for norm in ("flax", "lean"):
+        task = classification_task(
+            ResNet18(dtype=jnp.bfloat16, norm_impl=norm), (32, 32, 3),
+            np.zeros((100, 32, 32, 3), np.uint8), np.zeros((100,), np.int32),
+            input_transform=cifar_input_transform(jnp.bfloat16),
+        )
+        update = make_local_sgd_update(task.loss_fn, 0.05, bs, 1)
+        rf = make_fl_round(update, x, y, counts, nr_sampled=26,
+                           device_put_data=False)
+        params = jax.eval_shape(task.init, jax.random.key(0))
+        avals = [jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+                 for a in rf.data]
+        t0 = time.time()
+        c = jax.jit(rf.raw, device=dev).lower(
+            params, jax.ShapeDtypeStruct((), jax.random.key(0).dtype), 0,
+            *avals
+        ).compile()
+        compile_s = round(time.time() - t0, 1)
+        from ddl25spring_tpu.utils.costs import PEAKS_TABLE, cost_summary
+
+        cs = cost_summary(c)
+        fl = cs.get("flops", 0.0)
+        by = cs.get("bytes_accessed", 0.0)
+        peak_fl, peak_bw = PEAKS_TABLE["v5e"]
+        txt = c.as_text()
+        convs = sorted(
+            {m.group(0)[:140] for m in re.finditer(
+                r"convolution\([^)]*\)[^\n]*", txt)}
+        )
+        conv_shapes = sorted(
+            {m.group(1) for m in re.finditer(
+                r"(\S+) = \S+ convolution\(", txt)}
+        )
+        out["variants"][norm] = {
+            "compile_s": compile_s,
+            "flops_per_round": fl,
+            "bytes_per_round": by,
+            "roofline_ms_flops": round(fl / peak_fl * 1e3, 2),
+            "roofline_ms_bytes": round(by / peak_bw * 1e3, 2),
+            **({"custom_call_opaque": True}
+               if cs.get("custom_call_opaque") else {}),
+            "nr_conv_ops": len(conv_shapes),
+        }
+        print(f"--- {norm}: compile {compile_s}s  "
+              f"flops {fl:.3e}  bytes {by:.3e}", file=sys.stderr)
+        for l in convs[:20]:
+            print("  ", l[:140], file=sys.stderr)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,8 +37,10 @@ jax.config.update("jax_platforms", "cpu")
 
 from ddl25spring_tpu.utils.platform import select_platform  # noqa: E402
 
-select_platform()  # persistent compile cache: the ResNet mesh program's
-#                    XLA:CPU compile runs tens of minutes; pay it once
+select_platform("cpu")  # explicit arg: DDL25_PLATFORM must not override the
+#                         CPU pin; we want only the persistent compile cache
+#                         (the ResNet mesh program's XLA:CPU compile runs
+#                         tens of minutes; pay it once)
 
 NR_CLIENTS = 32
 CLIENT_FRACTION = 0.25  # 8 sampled clients = 1 per device
