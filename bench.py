@@ -170,19 +170,12 @@ def cost_breakdown(server) -> dict:
     count.  Pairing these with the measured round time gives achieved
     FLOP/s and bytes/s to place the program against the chip's peaks —
     the evidence VERDICT r2 'weak #2' asks for (17% MXU claim)."""
+    from ddl25spring_tpu.utils.costs import cost_summary
+
     compiled, _ = _aot_fused_rounds(server, 1, run_warmup=False)
-    ca = compiled.cost_analysis()
-    if isinstance(ca, list):  # older jax returns one dict per executable
-        ca = ca[0] if ca else {}
-    keep = {}
-    for key in ("flops", "bytes accessed", "transcendentals",
-                "utilization operand 0 {}", "optimal_seconds"):
-        if key in ca:
-            keep[key.replace(" ", "_")] = float(ca[key])
-    # every bytes-accessed sub-bucket XLA reports (output, operand k, ...)
-    for k, v in ca.items():
-        if k.startswith("bytes accessed"):
-            keep[k.replace(" ", "_")] = float(v)
+    # ONE sentinel-filtered analysis pass, sub-buckets included (Mosaic
+    # custom calls report flops=-1/-2, never emitted as measurements)
+    keep = cost_summary(compiled, sub_buckets=True)
     # XLA's own optimal_seconds is unreliable on this client (observed
     # NEGATIVE on the round-4 capture) — derive the roofline ourselves
     # from chip peaks instead.  One roofline second per bound:

@@ -1,0 +1,250 @@
+"""Continuous-batching decode: slot-based serving with prefill/decode split.
+
+The reference never serves its LMs at all (training loss is its only LM
+output); ``models/generate.py`` added fixed-batch decoding.  This module
+adds the remaining standard serving piece: **continuous batching** — new
+requests join a running batch the moment a slot frees up, instead of
+waiting for the whole batch to finish (the static-batch regime wastes
+(B-1)/B of the chip whenever lengths diverge).
+
+TPU-first shape discipline — the classic continuous-batching schedulers
+(Orca, vLLM) re-pack a dynamic batch every iteration, which would retrace
+under XLA.  Here every compiled program is static:
+
+- ``_prefill_fn``: ONE request's prompt, right-aligned in a fixed
+  ``prefill_width`` window (left pad masked out of attention, rotary
+  starting at 0 — exactly ``generate()``'s ragged layout), forward once
+  with a fresh single-row cache; returns that row's cache + first token.
+- ``_insert_fn``: ``dynamic_update_slice`` of the prefilled row into slot
+  ``s`` of the (max_batch, ctx) serving cache.
+- ``_decode_fn``: one token for ALL slots in lockstep with PER-ROW
+  positions (the same (B, T) row-local position support speculative
+  decoding uses) — each slot sits at its own depth.
+
+The scheduler (plain Python, ``ContinuousBatcher.run``) owns all
+data-dependent control flow — admissions, EOS, slot recycling — on the
+host, where serving loops live in real systems; the device only ever sees
+the three fixed-shape programs above.  Greedy outputs are BIT-IDENTICAL to
+per-request ``generate()`` (oracle: tests/test_serving.py) because each
+row's attention/rope math is independent of its neighbours.
+
+Composes with the rest of the serving stack: LoRA fine-tune -> merge ->
+serve (merged trees are plain params), int8 (quantized trees load the same
+way), and the sequence-sharded cache for long contexts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from .llama import Llama, LlamaConfig
+
+
+@dataclass
+class _Slot:
+    request_id: int = -1
+    emitted: list = field(default_factory=list)
+    budget: int = 0
+    total: int = 0
+    done_eos: bool = False
+
+    @property
+    def free(self) -> bool:
+        return self.request_id < 0
+
+
+@functools.lru_cache(maxsize=8)
+def _programs(config: LlamaConfig, max_batch: int, prefill_width: int):
+    # eos handling is entirely host-side (the scheduler), so it is NOT part
+    # of the compiled programs or their cache key
+    cfg = dataclasses.replace(config, decode=True)
+    model = Llama(cfg)
+    S = cfg.ctx_size
+    W = prefill_width
+
+    @jax.jit
+    def prefill(params, prompt_row, length):
+        """prompt_row (W,) right-padded; -> (cache_row_tree, first_token).
+
+        The row is right-ALIGNED into the window (shift by W - length) so
+        the last prompt token sits at slot W-1 and decode continues at W
+        for every request regardless of its length."""
+        shift = W - length
+        aligned = jnp.roll(prompt_row, shift)[None, :]  # (1, W)
+        pad = shift[None]
+        logits, state = model.apply(
+            params, aligned, positions=jnp.arange(W),
+            pad=pad, mutable=["cache"],
+        )
+        # the last real token sits at slot W-1 (right-aligned), so its
+        # logits row IS the next-token distribution
+        first = jnp.argmax(logits[0, -1], axis=-1).astype(prompt_row.dtype)
+        return state["cache"], first, pad[0]
+
+    @jax.jit
+    def insert(cache, row_cache, slot):
+        """Scatter a prefilled (1, S, ...) row cache into slot ``slot``."""
+        return jax.tree.map(
+            lambda big, row: jax.lax.dynamic_update_slice(
+                big, row.astype(big.dtype),
+                (slot,) + (0,) * (big.ndim - 1),
+            ),
+            cache, row_cache,
+        )
+
+    @jax.jit
+    def decode(params, cache, tokens, pos, pad):
+        """One lockstep token for every slot at its own depth.
+
+        tokens (B,), pos (B,) the slot each row writes this step, pad (B,)
+        left-pad widths.  Returns (new_cache, next_tokens (B,))."""
+        logits, state = model.apply(
+            {**params, "cache": cache}, tokens[:, None],
+            positions=pos[:, None], pad=pad, mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tokens.dtype)
+        return state["cache"], nxt
+
+    def empty_cache(params):
+        """Shape-only init of the (max_batch, S) serving cache."""
+        tok = jnp.zeros((max_batch, 1), jnp.int32)
+        vars_ = jax.eval_shape(
+            lambda p: model.apply(
+                p, tok, positions=jnp.zeros((max_batch, 1), jnp.int32),
+                mutable=["cache"],
+            )[1],
+            params,
+        )
+        return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype),
+                            vars_["cache"])
+
+    return prefill, insert, decode, empty_cache
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching over a fixed ``max_batch``.
+
+    ``prefill_width`` is the static prompt window: prompts longer than it
+    are rejected (pick the serving bucket for your traffic); shorter ones
+    are left-padded for free.  ``config.ctx_size`` bounds
+    ``prefill_width + max_new_tokens``.
+    """
+
+    def __init__(self, config: LlamaConfig, params, *, max_batch: int = 8,
+                 prefill_width: int = 64, eos_id: int | None = None):
+        # ``params`` is the full variables dict ({"params": ...}), the same
+        # contract as models.generate.generate / speculative_generate
+        if config.decode_seq_shards > 1:
+            raise NotImplementedError(
+                "continuous batching over the sequence-sharded cache: use "
+                "one batcher per replica today"
+            )
+        self.config = config
+        self.params = params
+        self.max_batch = max_batch
+        self.prefill_width = prefill_width
+        self.eos_id = -1 if eos_id is None else int(eos_id)
+        self._prefill, self._insert, self._decode, empty = _programs(
+            config, max_batch, prefill_width
+        )
+        self.cache = empty(params)
+        self.pos = jnp.zeros((max_batch,), jnp.int32)
+        self.pad = jnp.zeros((max_batch,), jnp.int32)
+        self.tokens = jnp.zeros((max_batch,), jnp.int32)
+        self.slots = [_Slot() for _ in range(max_batch)]
+        # serving telemetry: how full the batch ran, admissions, steps
+        self.stats = {"decode_steps": 0, "slot_steps": 0, "active_steps": 0,
+                      "admitted": 0}
+
+    # -- scheduling ------------------------------------------------------
+
+    def _admit(self, rid: int, prompt, max_new_tokens: int):
+        s = next(i for i, sl in enumerate(self.slots) if sl.free)
+        prompt = jnp.asarray(prompt, jnp.int32)
+        (L,) = prompt.shape
+        row = jnp.zeros((self.prefill_width,), jnp.int32).at[:L].set(prompt)
+        row_cache, first, pad = self._prefill(self.params, row, L)
+        self.cache = self._insert(self.cache, row_cache, s)
+        first_i = int(first)
+        sl = self.slots[s]
+        sl.request_id = rid
+        sl.emitted = [first_i]
+        sl.budget = max_new_tokens - 1
+        sl.total = max_new_tokens
+        sl.done_eos = first_i == self.eos_id
+        self.pos = self.pos.at[s].set(self.prefill_width)
+        self.pad = self.pad.at[s].set(int(pad))
+        self.tokens = self.tokens.at[s].set(first_i)
+        self.stats["admitted"] += 1
+        return s
+
+    def _harvest(self, finished: dict):
+        for s, sl in enumerate(self.slots):
+            if sl.free:
+                continue
+            if sl.done_eos or sl.budget <= 0:
+                out = sl.emitted
+                if sl.done_eos and self.eos_id >= 0:
+                    # generate()'s EOS semantics: keep EOS, pad the rest
+                    cut = out.index(self.eos_id) + 1
+                    out = out[:cut]
+                out = out + [0] * (sl.total - len(out))
+                finished[sl.request_id] = out
+                self.slots[s] = _Slot()
+
+    def run(self, requests, max_new_tokens: int):
+        """Serve ``requests`` (list of 1-D int token prompts); returns a
+        list of generated-token lists (length ``max_new_tokens`` each,
+        EOS-padded like ``generate``), in request order."""
+        # validate EVERYTHING before mutating any slot state: a mid-stream
+        # raise would otherwise leave earlier admissions decoding, and a
+        # reused batcher would hand their stale outputs to the next run's
+        # colliding request ids
+        if self.prefill_width + max_new_tokens > self.config.ctx_size:
+            raise ValueError(
+                f"prefill_width + max_new_tokens "
+                f"({self.prefill_width}+{max_new_tokens}) exceeds ctx_size "
+                f"({self.config.ctx_size})"
+            )
+        for i, r in enumerate(requests):
+            if len(r) > self.prefill_width:
+                raise ValueError(
+                    f"request {i}: prompt length {len(r)} exceeds "
+                    f"prefill_width {self.prefill_width}"
+                )
+        if max_new_tokens == 0:
+            return [[] for _ in requests]
+        pending = list(enumerate(requests))
+        finished: dict = {}
+        while len(finished) < len(requests):
+            while pending and any(sl.free for sl in self.slots):
+                rid, prompt = pending.pop(0)
+                self._admit(rid, prompt, max_new_tokens)
+            self._harvest(finished)
+            active = [s for s, sl in enumerate(self.slots) if not sl.free]
+            if not active:
+                continue
+            self.cache, nxt = self._decode(
+                self.params, self.cache, self.tokens, self.pos, self.pad
+            )
+            self.tokens = nxt
+            self.pos = self.pos + 1
+            self.stats["decode_steps"] += 1
+            self.stats["slot_steps"] += self.max_batch
+            self.stats["active_steps"] += len(active)
+            nxt_host = jax.device_get(nxt)
+            for s in active:
+                sl = self.slots[s]
+                if sl.budget > 0 and not sl.done_eos:
+                    tok = int(nxt_host[s])
+                    sl.emitted.append(tok)
+                    sl.budget -= 1
+                    if tok == self.eos_id:
+                        sl.done_eos = True
+            self._harvest(finished)
+        return [finished[i] for i in range(len(requests))]

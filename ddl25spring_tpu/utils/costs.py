@@ -39,10 +39,12 @@ def chip_peaks(device=None) -> dict | None:
     return None
 
 
-def cost_summary(compiled) -> dict:
+def cost_summary(compiled, sub_buckets: bool = False) -> dict:
     """flops / bytes_accessed / transcendentals of a compiled program,
     sentinel-filtered: negative values (Mosaic custom-call opacity) become
-    ``custom_call_opaque: True`` instead of numbers."""
+    ``custom_call_opaque: True`` instead of numbers.  ``sub_buckets`` also
+    keeps every non-negative ``bytes accessed...`` sub-bucket (output,
+    operand k, ...) XLA reports — one analysis pass either way."""
     ca = compiled.cost_analysis()
     if isinstance(ca, list):
         ca = ca[0] if ca else {}
@@ -54,4 +56,9 @@ def cost_summary(compiled) -> dict:
                 out["custom_call_opaque"] = True
             else:
                 out[k.replace(" ", "_")] = v
+    if sub_buckets:
+        for k, v in ca.items():
+            if (k.startswith("bytes accessed") and k != "bytes accessed"
+                    and float(v) >= 0):
+                out[k.replace(" ", "_")] = float(v)
     return out

@@ -186,9 +186,12 @@ def main() -> int:
             params, opt_state, sds((8, 2048), jnp.int32)).compile()
         out = costs_of(c)
         # modeled MFU ceiling: flops / v5e peak = the step's compute floor
-        out["roofline_step_ms_flops"] = out.get("flops", 0) / 197e12 * 1e3
+        from ddl25spring_tpu.utils.costs import PEAKS_TABLE
+
+        peak_fl, peak_bw = PEAKS_TABLE["v5e"]
+        out["roofline_step_ms_flops"] = out.get("flops", 0) / peak_fl * 1e3
         out["roofline_step_ms_bytes"] = (
-            out.get("bytes_accessed", 0) / 819e9 * 1e3
+            out.get("bytes_accessed", 0) / peak_bw * 1e3
         )
         return out
 

@@ -95,10 +95,12 @@ def main() -> int:
                if cs.get("custom_call_opaque") else {}),
             "nr_conv_ops": len(conv_shapes),
         }
+        # evidence to STDOUT: the documented `> results/...txt` capture
+        # must contain the conv shapes, not just the JSON line
         print(f"--- {norm}: compile {compile_s}s  "
-              f"flops {fl:.3e}  bytes {by:.3e}", file=sys.stderr)
+              f"flops {fl:.3e}  bytes {by:.3e}")
         for l in convs[:20]:
-            print("  ", l[:140], file=sys.stderr)
+            print("  ", l[:140])
     print(json.dumps(out))
     return 0
 
