@@ -32,7 +32,8 @@ import time
 CPU_BASELINE_ROUNDS_PER_SEC = 0.001441
 
 
-def build_server(seed: int = 10, norm_impl: str = "flax"):
+def build_server(seed: int = 10, norm_impl: str = "flax",
+                 conv_impl: str = "flax"):
     import jax
     import jax.numpy as jnp
 
@@ -92,7 +93,8 @@ def build_server(seed: int = 10, norm_impl: str = "flax"):
         _stamp("on-device dataset ready")
     _stamp("building task + jit round_fn ...")
     task = classification_task(
-        ResNet18(dtype=jnp.bfloat16, norm_impl=norm_impl), (32, 32, 3),
+        ResNet18(dtype=jnp.bfloat16, norm_impl=norm_impl,
+                 conv_impl=conv_impl), (32, 32, 3),
         test_x, test_y,
         input_transform=cifar_input_transform(jnp.bfloat16),
     )
@@ -405,6 +407,12 @@ def main():
                          "landed the win it was gated on: 3.90 rounds/sec "
                          "vs flax's 1.55 at equal-or-better accuracy "
                          "(results/bench_tpu_lean.json vs bench_tpu.json)")
+    ap.add_argument("--conv-impl", default="flax",
+                    choices=["flax", "im2col"],
+                    help="conv lowering A/B (ops/conv.py): im2col keeps "
+                         "client-vmapped weights MXU-native (the vmapped "
+                         "lax.conv form puts the client axis inside the "
+                         "conv window, round-4 AOT HLO)")
     ap.add_argument("--no-fused", action="store_true",
                     help="dispatch each timed round separately instead of "
                          "one fused fori_loop program (the gap measures "
@@ -445,13 +453,15 @@ def main():
     global _WATCHDOG
     _WATCHDOG = _Watchdog(args.deadline_s)
     _stamp("building server (data + mesh + jit round_fn) ...")
-    server = build_server(norm_impl=args.norm_impl)
+    server = build_server(norm_impl=args.norm_impl,
+                          conv_impl=args.conv_impl)
     if args.cost_analysis:
         costs = cost_breakdown(server)
         _WATCHDOG.cancel()
         print(json.dumps({
             "metric": METRIC + "_cost_analysis",
             "norm_impl": args.norm_impl,
+            "conv_impl": args.conv_impl,
             **costs,
         }))
         return

@@ -24,12 +24,31 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+def _conv(features: int, kernel, strides, dtype, name: str,
+          impl: str = "flax"):
+    """Conv selector: flax ``nn.Conv`` or the im2col+einsum form whose
+    client-vmapped weights stay MXU-native (ops/conv.py — round-4 AOT HLO
+    showed the vmapped lax.conv lowering puts the client axis inside the
+    convolution window).  Parameter trees are identical either way."""
+    if impl == "im2col":
+        from ..ops.conv import Im2ColConv
+
+        return Im2ColConv(features, kernel_size=kernel, strides=strides,
+                          dtype=dtype, name=name)
+    if impl != "flax":
+        raise ValueError(f"unknown conv_impl {impl!r} (flax | im2col)")
+    return nn.Conv(features, kernel, strides=strides, padding="SAME",
+                   use_bias=False, dtype=dtype, name=name)
+
+
 def _norm(channels: int, dtype, name: str, impl: str = "flax"):
     if impl == "lean":
         from ..ops.norm import LeanGroupNorm
 
         return LeanGroupNorm(num_groups=min(32, channels), dtype=dtype,
                              name=name)
+    if impl != "flax":
+        raise ValueError(f"unknown norm_impl {impl!r} (flax | lean)")
     return nn.GroupNorm(num_groups=min(32, channels), dtype=dtype, name=name)
 
 
@@ -38,21 +57,19 @@ class BasicBlock(nn.Module):
     stride: int = 1
     dtype: jnp.dtype = jnp.float32
     norm_impl: str = "flax"
+    conv_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x):
         c, s, dt = self.channels, self.stride, self.dtype
-        ni = self.norm_impl
-        y = nn.Conv(c, (3, 3), strides=(s, s), padding="SAME", use_bias=False,
-                    dtype=dt, name="conv1")(x)
+        ni, ci = self.norm_impl, self.conv_impl
+        y = _conv(c, (3, 3), (s, s), dt, "conv1", ci)(x)
         y = _norm(c, dt, "norm1", ni)(y)
         y = nn.relu(y)
-        y = nn.Conv(c, (3, 3), padding="SAME", use_bias=False,
-                    dtype=dt, name="conv2")(y)
+        y = _conv(c, (3, 3), (1, 1), dt, "conv2", ci)(y)
         y = _norm(c, dt, "norm2", ni)(y)
         if x.shape[-1] != c or s != 1:
-            x = nn.Conv(c, (1, 1), strides=(s, s), use_bias=False,
-                        dtype=dt, name="proj")(x)
+            x = _conv(c, (1, 1), (s, s), dt, "proj", ci)(x)
             x = _norm(c, dt, "proj_norm", ni)(x)
         return nn.relu(x + y)
 
@@ -65,18 +82,20 @@ class ResNet(nn.Module):
     widths: Sequence[int] = (64, 128, 256, 512)
     dtype: jnp.dtype = jnp.float32
     norm_impl: str = "flax"  # flax | lean (ops.norm.LeanGroupNorm, same params)
+    conv_impl: str = "flax"  # flax | im2col (ops.conv.Im2ColConv, same params)
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         dt = self.dtype
         x = x.astype(dt)
-        x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False,
-                    dtype=dt, name="stem")(x)
+        x = _conv(self.widths[0], (3, 3), (1, 1), dt, "stem",
+                  self.conv_impl)(x)
         x = nn.relu(_norm(self.widths[0], dt, "stem_norm", self.norm_impl)(x))
         for g, (blocks, width) in enumerate(zip(self.blocks_per_group, self.widths)):
             for b in range(blocks):
                 stride = 2 if (b == 0 and g > 0) else 1
                 x = BasicBlock(width, stride, dt, norm_impl=self.norm_impl,
+                               conv_impl=self.conv_impl,
                                name=f"group{g}_block{b}")(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.nr_classes, dtype=jnp.float32, name="head")(
@@ -86,5 +105,6 @@ class ResNet(nn.Module):
 
 
 def ResNet18(nr_classes: int = 10, dtype=jnp.float32,
-             norm_impl: str = "flax") -> ResNet:
-    return ResNet(nr_classes=nr_classes, dtype=dtype, norm_impl=norm_impl)
+             norm_impl: str = "flax", conv_impl: str = "flax") -> ResNet:
+    return ResNet(nr_classes=nr_classes, dtype=dtype, norm_impl=norm_impl,
+                  conv_impl=conv_impl)

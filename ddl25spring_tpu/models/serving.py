@@ -212,6 +212,12 @@ class ContinuousBatcher:
                 f"({self.config.ctx_size})"
             )
         for i, r in enumerate(requests):
+            if len(r) < 1:
+                raise ValueError(
+                    f"request {i}: empty prompt (generate()'s contract "
+                    "requires length >= 1; an all-pad attention row would "
+                    "softmax over nothing and emit NaN-argmax garbage)"
+                )
             if len(r) > self.prefill_width:
                 raise ValueError(
                     f"request {i}: prompt length {len(r)} exceeds "

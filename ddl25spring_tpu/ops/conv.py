@@ -1,0 +1,74 @@
+"""im2col + einsum convolution — MXU-native under per-client vmapped weights.
+
+Why this exists (round-4 AOT HLO evidence, tools/northstar_aot_costs.py):
+the FL engine vmaps each client's LOCAL SGD over the sampled-client axis.
+After the first local minibatch every client's weights have diverged, so
+the ResNet convs are vmapped over inputs AND weights — and XLA's batching
+rule for ``conv_general_dilated`` with a batched *filter* lowers to a
+grouped convolution built from spatial dilation tricks::
+
+    window={size=3x3x26 stride=1x1x25 pad=1_1x1_1x0_0 lhs_dilate=1x1x26}
+
+The client axis (26) lands INSIDE the convolution window.  Mosaic/XLA
+cannot tile that shape onto the MXU; the compiled north-star round both
+inflates its flop count 4x (1.52e13 vs the honest 3.8e12) and starves the
+systolic array (~7.5% utilisation measured in round 4).
+
+The fix is algebraic, not a kernel: convolution == patch extraction
+(``lax.conv_general_dilated_patches`` — weight-FREE, so the client vmap
+stays a clean leading batch axis) followed by a patches x weights matmul.
+Under vmap the matmul becomes a *client-batched einsum* — exactly the
+shape the MXU is built for.  Cost: the patch tensor materialises k*k
+copies of the activations (9x for 3x3), trading HBM bytes for MXU
+utilisation; on a 7.5%-utilised MXU that trade is strongly favourable.
+
+``Im2ColConv`` is parameter-compatible with ``flax.linen.Conv`` (same
+``kernel`` shape (kh, kw, Cin, Cout), same init), value-equal to it
+(oracle: tests/test_models.py), and selected per-model via
+``ResNet(conv_impl="im2col")`` / ``bench.py --conv-impl``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Im2ColConv(nn.Module):
+    """Drop-in ``nn.Conv(features, (kh, kw), strides, "SAME")`` replacement
+    (NHWC, no bias) computing patches-then-einsum instead of lax.conv."""
+
+    features: int
+    kernel_size: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (kh, kw, cin, self.features),
+            jnp.float32,
+        )
+        kernel = kernel.astype(self.dtype)
+        x = x.astype(self.dtype)
+        # (B, H', W', kh*kw*Cin) patches; weight-free -> vmap-clean.
+        # conv_general_dilated_patches returns channels as the
+        # SLOWEST-varying patch axis: feature order is (Cin, kh, kw).
+        patches = lax.conv_general_dilated_patches(
+            x, filter_shape=(kh, kw), window_strides=self.strides,
+            padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        # match that (Cin, kh, kw) feature order on the weight side
+        w = kernel.transpose(2, 0, 1, 3).reshape(kh * kw * cin,
+                                                 self.features)
+        return jax.lax.dot_general(
+            patches, w,
+            (((patches.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=self.dtype,
+        )

@@ -117,3 +117,50 @@ def test_resnet_norm_impls_share_params():
     assert jax.tree.structure(a) == jax.tree.structure(b)
     out = ResNet18(dtype=jnp.bfloat16, norm_impl="lean").apply(b, x)
     assert out.shape == (2, 10)
+
+
+def test_im2col_conv_matches_flax_conv():
+    """ops/conv.py oracle: the im2col+einsum ResNet is value- AND
+    gradient-equal to the nn.Conv one on the IDENTICAL param tree (the
+    module is init-compatible by construction).  The im2col form exists
+    because client-vmapped conv WEIGHTS lower to an MXU-hostile dilated
+    grouped conv (round-4 AOT HLO, tools/northstar_aot_costs.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models import ResNet18
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    m_flax = ResNet18()
+    m_i2c = ResNet18(conv_impl="im2col")
+    p = m_flax.init(jax.random.PRNGKey(1), x)
+    assert (jax.tree.structure(p)
+            == jax.tree.structure(m_i2c.init(jax.random.PRNGKey(1), x)))
+    a = m_flax.apply(p, x)
+    b = m_i2c.apply(p, x)
+    assert float(jnp.max(jnp.abs(a - b))) < 1e-5
+    ga = jax.grad(lambda q: jnp.sum(m_flax.apply(q, x) ** 2))(p)
+    gb = jax.grad(lambda q: jnp.sum(m_i2c.apply(q, x) ** 2))(p)
+    for u, v in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        assert float(jnp.max(jnp.abs(u - v))) < 5e-4
+
+
+def test_im2col_conv_under_client_vmap():
+    """The motivating regime: per-client DIVERGED weights (vmap over params
+    and inputs together) must stay value-equal to the flax path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models import ResNet18
+
+    C = 3  # simulated clients
+    x = jax.random.normal(jax.random.PRNGKey(0), (C, 2, 32, 32, 3))
+    m_flax = ResNet18()
+    m_i2c = ResNet18(conv_impl="im2col")
+    p1 = m_flax.init(jax.random.PRNGKey(1), x[0])
+    stacked = jax.tree.map(
+        lambda l: jnp.stack([l + 0.01 * i for i in range(C)]), p1
+    )
+    a = jax.vmap(m_flax.apply)(stacked, x)
+    b = jax.vmap(m_i2c.apply)(stacked, x)
+    assert float(jnp.max(jnp.abs(a - b))) < 1e-5

@@ -52,9 +52,11 @@ def main() -> int:
     counts = np.full((nr_clients,), per, np.int32)
 
     out = {"metric": "northstar_aot_costs", "variants": {}}
-    for norm in ("flax", "lean"):
+    for norm, conv in (("flax", "flax"), ("lean", "flax"),
+                       ("lean", "im2col")):
         task = classification_task(
-            ResNet18(dtype=jnp.bfloat16, norm_impl=norm), (32, 32, 3),
+            ResNet18(dtype=jnp.bfloat16, norm_impl=norm, conv_impl=conv),
+            (32, 32, 3),
             np.zeros((100, 32, 32, 3), np.uint8), np.zeros((100,), np.int32),
             input_transform=cifar_input_transform(jnp.bfloat16),
         )
@@ -85,7 +87,7 @@ def main() -> int:
             {m.group(1) for m in re.finditer(
                 r"(\S+) = \S+ convolution\(", txt)}
         )
-        out["variants"][norm] = {
+        out["variants"][f"{norm}+{conv}"] = {
             "compile_s": compile_s,
             "flops_per_round": fl,
             "bytes_per_round": by,
@@ -97,7 +99,7 @@ def main() -> int:
         }
         # evidence to STDOUT: the documented `> results/...txt` capture
         # must contain the conv shapes, not just the JSON line
-        print(f"--- {norm}: compile {compile_s}s  "
+        print(f"--- {norm}+{conv}: compile {compile_s}s  "
               f"flops {fl:.3e}  bytes {by:.3e}")
         for l in convs[:20]:
             print("  ", l[:140])

@@ -1,19 +1,29 @@
-"""Hardware-independent north-star tracking: scaled FedAvg on the CPU mesh.
+"""Hardware-independent north-star tracking on the CPU backend.
 
 The real north star (bench.py: 256 clients, CIFAR-10, ResNet-18, one real
 TPU) needs the tunnel, which has been down for whole rounds (BENCH_r01-r03
-all "device unreachable").  This tool measures a SCALED-DOWN but
-architecturally identical round — 32 clients, C=0.25 (8 sampled = 1 per
-device of the 8-device virtual CPU mesh), ResNet-18, B=50, E=1, fused
-``lax.fori_loop`` rounds — on the always-available CPU backend, and appends
-the result to ``results/northstar_cpu_trend.jsonl``.
+all "device unreachable").  This tool measures two SCALED but
+architecturally faithful variants of the same engine every round and
+appends them to ``results/northstar_cpu_trend.jsonl``:
 
-Run it every round (VERDICT r3 #2): FL-engine perf regressions then show up
-as a dropped rounds/sec in the committed trend even when the TPU is dark.
-``tests/test_northstar_trend.py`` asserts the latest committed entry stays
-above an absolute floor.
+- ``resnet-1dev``: 32 clients, C=0.25 (8 sampled), ResNet-18 f32, B=50,
+  E=1, single CPU device.  Tracks the model+engine compute path.  Its
+  XLA:CPU compile is minutes-long the FIRST time (the conv program — the
+  8-device-mesh variant of this config never finished compiling in 36
+  minutes, which is why the mesh leg uses the CNN below); the persistent
+  compile cache makes later rounds take seconds.
+- ``cnn-mesh8``: the same FL round machinery (vmap over sampled clients +
+  weighted-mean aggregation + with_sharding_constraint) with the MNIST CNN
+  over the 8-device virtual CPU mesh.  Compiles in seconds and tracks the
+  SHARDED engine path — the part of the north star the ResNet leg can't
+  afford to cover on CPU.
+
+FL-engine perf regressions then show up as a dropped rounds/sec in the
+committed trend even when the TPU is dark
+(``tests/test_northstar_trend.py`` gates on it).
 
 Usage: python tools/northstar_cpu.py [--rounds N] [--dry-run]
+           [--variant resnet-1dev|cnn-mesh8|all]
 """
 
 from __future__ import annotations
@@ -39,16 +49,29 @@ from ddl25spring_tpu.utils.platform import select_platform  # noqa: E402
 
 select_platform("cpu")  # explicit arg: DDL25_PLATFORM must not override the
 #                         CPU pin; we want only the persistent compile cache
-#                         (the ResNet mesh program's XLA:CPU compile runs
-#                         tens of minutes; pay it once)
 
-NR_CLIENTS = 32
-CLIENT_FRACTION = 0.25  # 8 sampled clients = 1 per device
-N_TRAIN = 6400  # 200 images/client, 4 minibatches of 50 per local epoch
 TREND = Path(__file__).resolve().parent.parent / "results" / "northstar_cpu_trend.jsonl"
 
 
-def build_scaled_server(seed: int = 10):
+def _measure_rounds(server, nr_rounds: int):
+    """Compile (warmup round) + time ``nr_rounds`` unfused dispatches.
+
+    Unfused on purpose: CPU dispatch overhead is negligible, and the fused
+    fori_loop program would force a SECOND multi-minute XLA:CPU compile of
+    the same round body."""
+    t0 = time.perf_counter()
+    params = server.round_fn(server.params, server.run_key, 0)
+    jax.block_until_ready(params)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for r in range(1, nr_rounds + 1):
+        params = server.round_fn(params, server.run_key, r)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    return nr_rounds / dt, compile_s
+
+
+def _resnet_1dev(seed: int = 10):
     import jax.numpy as jnp
 
     from ddl25spring_tpu.data.cifar import cifar_input_transform
@@ -56,48 +79,47 @@ def build_scaled_server(seed: int = 10):
     from ddl25spring_tpu.fl import FedAvgServer
     from ddl25spring_tpu.fl.task import classification_task
     from ddl25spring_tpu.models import ResNet18
-    from ddl25spring_tpu.parallel import make_mesh
 
     client_data, test_x, test_y = device_synthetic_clients(
-        nr_clients=NR_CLIENTS, n_train=N_TRAIN, n_test=1000, seed=seed,
-        pad_multiple=50,
+        nr_clients=32, n_train=6400, n_test=1000, seed=seed, pad_multiple=50,
     )
-    # f32 on purpose: CPU bf16 is software-emulated (a warmup round that
-    # finishes in seconds in f32 ran >45 min in bf16 when this tool first
-    # ran).  The tracked quantity is round-over-round RELATIVE regression
-    # of the FL engine, which dtype does not disturb.
+    # f32: CPU bf16 is software-emulated (a bf16 warmup round ran >45 min)
     task = classification_task(
         ResNet18(dtype=jnp.float32), (32, 32, 3), test_x, test_y,
         input_transform=cifar_input_transform(jnp.float32),
     )
+    return FedAvgServer(task, lr=0.05, batch_size=50, client_data=client_data,
+                        client_fraction=0.25, nr_local_epochs=1, seed=seed)
+
+
+def _cnn_mesh8(seed: int = 10):
+    import numpy as np
+
+    from ddl25spring_tpu.data import load_mnist, split_dataset
+    from ddl25spring_tpu.fl import FedAvgServer
+    from ddl25spring_tpu.fl.task import mnist_task
+    from ddl25spring_tpu.parallel import make_mesh
+
+    ds = load_mnist(n_train=4096, n_test=512)
+    task = mnist_task(ds.test_x, ds.test_y)
+    data = split_dataset(ds.train_x, ds.train_y, 32, True, seed=seed,
+                         pad_multiple=32)
     mesh = make_mesh({"clients": len(jax.devices())})
-    return FedAvgServer(
-        task, lr=0.05, batch_size=50, client_data=client_data,
-        client_fraction=CLIENT_FRACTION, nr_local_epochs=1, seed=seed,
-        mesh=mesh,
-    )
+    return FedAvgServer(task, lr=0.05, batch_size=32, client_data=data,
+                        client_fraction=0.25, nr_local_epochs=1, seed=seed,
+                        mesh=mesh)
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--variant", default="all",
+                    choices=["resnet-1dev", "cnn-mesh8", "all"])
     ap.add_argument("--dry-run", action="store_true",
                     help="measure but do not append to the trend file")
     args = ap.parse_args()
 
     assert len(jax.devices()) == 8, jax.devices()
-    import bench  # repo-root module: fused-round AOT machinery
-
-    t0 = time.perf_counter()
-    server = build_scaled_server()
-    compiled, params = bench._aot_fused_rounds(server, args.rounds)
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    params = compiled(params, server.run_key, *server.round_fn.data)
-    jax.block_until_ready(params)
-    dt = time.perf_counter() - t0
-    rps = args.rounds / dt
-
     rev = "unknown"
     try:
         rev = subprocess.run(
@@ -106,21 +128,26 @@ def main() -> int:
         ).stdout.strip() or "unknown"
     except OSError:
         pass
-    entry = {
-        "date": time.strftime("%Y-%m-%d"),
-        "git": rev,
-        "rounds_per_sec": round(rps, 4),
-        "rounds_timed": args.rounds,
-        "compile_s": round(compile_s, 1),
-        "nr_clients": NR_CLIENTS,
-        "client_fraction": CLIENT_FRACTION,
-        "devices": 8,
-        "backend": "cpu-mesh",
-    }
-    print(json.dumps(entry))
-    if not args.dry_run:
-        with TREND.open("a") as f:
-            f.write(json.dumps(entry) + "\n")
+
+    backends = {"resnet-1dev": "cpu-1dev", "cnn-mesh8": "cpu-mesh8"}
+    builders = {"resnet-1dev": _resnet_1dev, "cnn-mesh8": _cnn_mesh8}
+    names = list(builders) if args.variant == "all" else [args.variant]
+    for name in names:
+        server = builders[name]()
+        rps, compile_s = _measure_rounds(server, args.rounds)
+        entry = {
+            "date": time.strftime("%Y-%m-%d"),
+            "git": rev,
+            "variant": name,
+            "rounds_per_sec": round(rps, 4),
+            "rounds_timed": args.rounds,
+            "compile_s": round(compile_s, 1),
+            "backend": backends[name],
+        }
+        print(json.dumps(entry), flush=True)
+        if not args.dry_run:
+            with TREND.open("a") as f:
+                f.write(json.dumps(entry) + "\n")
     return 0
 
 
