@@ -1,0 +1,70 @@
+"""Threshold test over the committed CPU-mesh north-star trend (VERDICT r3 #2).
+
+``tools/northstar_cpu.py`` appends per-variant rounds/sec entries each
+round to ``results/northstar_cpu_trend.jsonl`` (``resnet-1dev``: the
+model+engine compute path; ``cnn-mesh8``: the sharded engine path on the
+8-device virtual mesh).  This test keeps two invariants default-on:
+
+- the trend file exists and parses (the tool ran this round);
+- per variant, the LATEST entry has not collapsed: above an absolute
+  floor, and >= 40% of that variant's best entry (an FL-engine regression
+  shows up as a dropped ratio even as machines vary).
+
+The floors are intentionally loose — CPU containers differ — while the
+relative check is the real regression tripwire.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+TREND = Path(__file__).resolve().parent.parent / "results" / "northstar_cpu_trend.jsonl"
+# Absolute sanity floors, calibrated on the round-4 quiet-machine run
+# (resnet-1dev 0.0085 r/s -- ~118 s/round of ResNet-18 f32 through
+# XLA:CPU, which checks out against ~2.7 TFLOP/round at CPU conv
+# throughput; cnn-mesh8 0.0484 r/s -- the 8-virtual-device GSPMD
+# simulation carries heavy per-op host overhead).  The 40%-of-best
+# relative check below is the real regression tripwire.
+FLOORS = {"resnet-1dev": 0.002, "cnn-mesh8": 0.01}
+BACKENDS = {"resnet-1dev": "cpu-1dev", "cnn-mesh8": "cpu-mesh8"}
+
+
+def _entries():
+    if not TREND.exists():
+        pytest.fail(
+            "results/northstar_cpu_trend.jsonl missing — run "
+            "tools/northstar_cpu.py (VERDICT r3 #2: the scaled north star "
+            "must be recorded every round)"
+        )
+    return [json.loads(l) for l in TREND.read_text().splitlines() if l.strip()]
+
+
+def test_trend_exists_and_parses():
+    entries = _entries()
+    assert entries, "trend file is empty"
+    for e in entries:
+        assert e["rounds_per_sec"] > 0
+        assert e["variant"] in FLOORS
+        assert e["backend"] == BACKENDS[e["variant"]]
+
+
+def test_latest_has_not_collapsed():
+    entries = _entries()
+    for variant, floor in FLOORS.items():
+        ours = [e["rounds_per_sec"] for e in entries
+                if e["variant"] == variant]
+        if not ours:
+            pytest.fail(f"no {variant} entries recorded")
+        latest, best = ours[-1], max(ours)
+        assert latest >= floor, (
+            f"{variant}: latest {latest} r/s below the absolute floor "
+            f"{floor} — FL engine collapsed or the tool mismeasured"
+        )
+        assert latest >= 0.4 * best, (
+            f"{variant}: latest {latest} r/s is <40% of the best recorded "
+            f"{best} r/s — FL-engine perf regression (or a uniquely loaded "
+            "container: re-run tools/northstar_cpu.py to confirm)"
+        )

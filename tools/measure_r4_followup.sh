@@ -46,6 +46,9 @@ EOF
     capture 1800 results/bench_tpu_costs_lean.json 0 \
       python bench.py --deadline-s 900 --cost-analysis --norm-impl lean; rc=$?
     echo "$(date +%H:%M:%S) lean cost analysis (roofline) done (exit $rc)" >> "$LOG"
+    capture 1800 results/bench_tpu_im2col.json 0 \
+      python bench.py --deadline-s 900 --norm-impl lean --conv-impl im2col; rc=$?
+    echo "$(date +%H:%M:%S) bench lean+im2col done (exit $rc)" >> "$LOG"
     capture 1800 results/lm_mfu_tpu.txt 0 \
       python examples/bench_lm_mfu.py; rc=$?
     echo "$(date +%H:%M:%S) LM MFU bench done (exit $rc)" >> "$LOG"
