@@ -29,10 +29,10 @@ def setup():
     )
 
 
-def _oracle(params, prompt, max_new):
+def _oracle(params, prompt, max_new, cfg=CFG):
     """Solo generate() continuation tokens for one prompt."""
     p = jnp.asarray(prompt, jnp.int32)[None, :]
-    out = generate(CFG, params, p, max_new)
+    out = generate(cfg, params, p, max_new)
     return [int(t) for t in np.asarray(out[0, p.shape[1]:])]
 
 
@@ -97,3 +97,37 @@ def test_ctx_budget_enforced(setup):
     batcher = ContinuousBatcher(CFG, params, max_batch=2, prefill_width=16)
     with pytest.raises(ValueError, match="exceeds ctx_size"):
         batcher.run([[1, 2]], 40)  # 16 + 40 > 48
+
+
+def test_composes_with_int8_and_merged_lora(setup):
+    """Serving-stack composition: the batcher takes quantized trees and
+    LoRA-merged trees the same way generate() does — int8 output must
+    match int8 generate() exactly (same tree, same math), and a merged
+    LoRA tree must serve without error and match its own generate()."""
+    import dataclasses
+
+    from ddl25spring_tpu.models.lora import merge_lora
+    from ddl25spring_tpu.models.quant import quantize_llama_params
+
+    params = setup
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (4, 6)]
+    max_new = 5
+
+    qcfg = dataclasses.replace(CFG, weights_int8=True)
+    qparams = quantize_llama_params(params)
+    batcher = ContinuousBatcher(qcfg, qparams, max_batch=2, prefill_width=8)
+    served = batcher.run(prompts, max_new)
+    for i, prompt in enumerate(prompts):
+        assert served[i] == _oracle(qparams, prompt, max_new, cfg=qcfg)
+
+    lcfg = dataclasses.replace(CFG, lora_rank=2)
+    lparams = Llama(lcfg).init(
+        jax.random.PRNGKey(9), jnp.ones((1, 4), jnp.int32),
+        positions=jnp.arange(4),
+    )
+    merged = merge_lora(lparams, lcfg)
+    batcher = ContinuousBatcher(CFG, merged, max_batch=2, prefill_width=8)
+    served = batcher.run(prompts, max_new)
+    for i, prompt in enumerate(prompts):
+        assert served[i] == _oracle(merged, prompt, max_new)
