@@ -97,18 +97,31 @@ def _programs(config: LlamaConfig, max_batch: int, prefill_width: int):
             cache, row_cache,
         )
 
-    @jax.jit
-    def decode(params, cache, tokens, pos, pad):
-        """One lockstep token for every slot at its own depth.
+    @functools.partial(jax.jit, static_argnames=("nr",))
+    def decode(params, cache, tokens, pos, pad, nr=1):
+        """``nr`` lockstep tokens for every slot at its own depth.
 
-        tokens (B,), pos (B,) the slot each row writes this step, pad (B,)
-        left-pad widths.  Returns (new_cache, next_tokens (B,))."""
-        logits, state = model.apply(
-            {**params, "cache": cache}, tokens[:, None],
-            positions=pos[:, None], pad=pad, mutable=["cache"],
+        tokens (B,), pos (B,) the slot each row writes first, pad (B,)
+        left-pad widths.  Returns (new_cache, emitted (B, nr)) — a
+        ``lax.scan`` of single-token steps, so one DISPATCH yields ``nr``
+        tokens (the scheduler intervenes only at chunk boundaries; over a
+        remote tunnel per-dispatch RTT would otherwise dominate).  Each
+        step feeds its argmax forward exactly like generate()'s scan, so
+        per-row streams are bit-identical at any chunking."""
+
+        def step(carry, _):
+            cache, tok, pos = carry
+            logits, state = model.apply(
+                {**params, "cache": cache}, tok[:, None],
+                positions=pos[:, None], pad=pad, mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
+            return (state["cache"], nxt, pos + 1), nxt
+
+        (cache, _, _), toks = jax.lax.scan(
+            step, (cache, tokens, pos), None, length=nr
         )
-        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tokens.dtype)
-        return state["cache"], nxt
+        return cache, toks.T  # (B, nr)
 
     def empty_cache(params):
         """Shape-only init of the (max_batch, S) serving cache."""
@@ -136,9 +149,13 @@ class ContinuousBatcher:
     """
 
     def __init__(self, config: LlamaConfig, params, *, max_batch: int = 8,
-                 prefill_width: int = 64, eos_id: int | None = None):
+                 prefill_width: int = 64, eos_id: int | None = None,
+                 decode_chunk: int = 1):
         # ``params`` is the full variables dict ({"params": ...}), the same
-        # contract as models.generate.generate / speculative_generate
+        # contract as models.generate.generate / speculative_generate.
+        # ``decode_chunk``: tokens per decode dispatch — admissions happen
+        # at chunk boundaries, so larger chunks trade slot-refill latency
+        # for nr-fold less dispatch overhead (vital over a remote tunnel)
         if config.decode_seq_shards > 1:
             raise NotImplementedError(
                 "continuous batching over the sequence-sharded cache: use "
@@ -149,6 +166,9 @@ class ContinuousBatcher:
         self.max_batch = max_batch
         self.prefill_width = prefill_width
         self.eos_id = -1 if eos_id is None else int(eos_id)
+        if decode_chunk < 1:
+            raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
+        self.decode_chunk = decode_chunk
         self._prefill, self._insert, self._decode, empty = _programs(
             config, max_batch, prefill_width
         )
@@ -197,18 +217,43 @@ class ContinuousBatcher:
                 finished[sl.request_id] = out
                 self.slots[s] = _Slot()
 
-    def run(self, requests, max_new_tokens: int):
+    def run(self, requests, max_new_tokens):
         """Serve ``requests`` (list of 1-D int token prompts); returns a
-        list of generated-token lists (length ``max_new_tokens`` each,
-        EOS-padded like ``generate``), in request order."""
+        list of generated-token lists, in request order.
+
+        ``max_new_tokens`` is an int (same budget for every request) or a
+        per-request list — heterogeneous budgets are continuous batching's
+        home turf: a slot whose request finishes early is refilled
+        immediately.  Each output has its request's budget length,
+        EOS-padded like ``generate``."""
+        import numpy as _np
+
+        if isinstance(max_new_tokens, (int, _np.integer)):
+            budgets = [int(max_new_tokens)] * len(requests)
+        else:
+            budgets = [int(b) for b in max_new_tokens]
+        if len(budgets) != len(requests):
+            raise ValueError(
+                f"{len(budgets)} budgets for {len(requests)} requests"
+            )
+        if any(b < 0 for b in budgets):
+            raise ValueError(
+                f"negative budget in {budgets}: a request cannot owe "
+                "tokens (and the scheduler would wait on it forever)"
+            )
         # validate EVERYTHING before mutating any slot state: a mid-stream
         # raise would otherwise leave earlier admissions decoding, and a
         # reused batcher would hand their stale outputs to the next run's
         # colliding request ids
-        if self.prefill_width + max_new_tokens > self.config.ctx_size:
+        worst = max(budgets, default=0)
+        # chunked decode can overrun a finished row's budget by up to
+        # chunk-1 scratch steps before the slot is recycled; those writes
+        # must stay inside the cache
+        overrun = self.decode_chunk - 1
+        if self.prefill_width + worst + overrun > self.config.ctx_size:
             raise ValueError(
-                f"prefill_width + max_new_tokens "
-                f"({self.prefill_width}+{max_new_tokens}) exceeds ctx_size "
+                f"prefill_width + max_new_tokens + (decode_chunk - 1) "
+                f"({self.prefill_width}+{worst}+{overrun}) exceeds ctx_size "
                 f"({self.config.ctx_size})"
             )
         for i, r in enumerate(requests):
@@ -223,31 +268,40 @@ class ContinuousBatcher:
                     f"request {i}: prompt length {len(r)} exceeds "
                     f"prefill_width {self.prefill_width}"
                 )
-        if max_new_tokens == 0:
-            return [[] for _ in requests]
-        pending = list(enumerate(requests))
-        finished: dict = {}
+        finished: dict = {i: [] for i, b in enumerate(budgets) if b == 0}
+        # longest-budget-first admission: the classic makespan heuristic —
+        # big jobs start early, the tail is filled with small ones.  Output
+        # order is by request id regardless.
+        pending = sorted(
+            ((i, r) for i, (r, b) in enumerate(zip(requests, budgets))
+             if b > 0),
+            key=lambda ir: -budgets[ir[0]],
+        )
         while len(finished) < len(requests):
             while pending and any(sl.free for sl in self.slots):
                 rid, prompt = pending.pop(0)
-                self._admit(rid, prompt, max_new_tokens)
+                self._admit(rid, prompt, budgets[rid])
             self._harvest(finished)
             active = [s for s, sl in enumerate(self.slots) if not sl.free]
             if not active:
                 continue
-            self.cache, nxt = self._decode(
-                self.params, self.cache, self.tokens, self.pos, self.pad
+            K = self.decode_chunk
+            self.cache, toks = self._decode(
+                self.params, self.cache, self.tokens, self.pos, self.pad,
+                nr=K,
             )
-            self.tokens = nxt
-            self.pos = self.pos + 1
-            self.stats["decode_steps"] += 1
-            self.stats["slot_steps"] += self.max_batch
-            self.stats["active_steps"] += len(active)
-            nxt_host = jax.device_get(nxt)
+            self.tokens = toks[:, -1]
+            self.pos = self.pos + K
+            self.stats["decode_steps"] += K
+            self.stats["slot_steps"] += self.max_batch * K
+            toks_host = jax.device_get(toks)
             for s in active:
                 sl = self.slots[s]
-                if sl.budget > 0 and not sl.done_eos:
-                    tok = int(nxt_host[s])
+                for j in range(K):
+                    if sl.budget <= 0 or sl.done_eos:
+                        break
+                    self.stats["active_steps"] += 1
+                    tok = int(toks_host[s, j])
                     sl.emitted.append(tok)
                     sl.budget -= 1
                     if tok == self.eos_id:

@@ -131,3 +131,33 @@ def test_composes_with_int8_and_merged_lora(setup):
     served = batcher.run(prompts, max_new)
     for i, prompt in enumerate(prompts):
         assert served[i] == _oracle(merged, prompt, max_new)
+
+
+def test_per_request_budgets(setup):
+    """Heterogeneous budgets: each request's output has ITS budget length
+    and equals its solo generate() continuation; zero budgets return []."""
+    params = setup
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (3, 5, 4)]
+    budgets = [6, 0, 3]
+    batcher = ContinuousBatcher(CFG, params, max_batch=2, prefill_width=8)
+    served = batcher.run(prompts, budgets)
+    for i, (prompt, b) in enumerate(zip(prompts, budgets)):
+        assert len(served[i]) == b
+        if b:
+            assert served[i] == _oracle(params, prompt, b)
+
+
+def test_chunked_decode_bit_exact(setup):
+    """decode_chunk trades refill latency for dispatch count; per-row token
+    streams must be unchanged at ANY chunking (the in-chunk scan feeds
+    argmax forward exactly like generate's)."""
+    params = setup
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (3, 7, 5)]
+    budgets = [9, 4, 7]
+    base = ContinuousBatcher(CFG, params, max_batch=2,
+                             prefill_width=8).run(prompts, budgets)
+    chunked = ContinuousBatcher(CFG, params, max_batch=2, prefill_width=8,
+                                decode_chunk=4).run(prompts, budgets)
+    assert base == chunked
