@@ -67,6 +67,13 @@ class LlamaConfig:
     #                            the masked optimizer, B zero-init so the
     #                            adapted model starts as the base model
     lora_alpha: float = 16.0   # adapter scale alpha/r
+    kv_cache_int8: bool = False  # serving: decode KV cache stored int8
+    #                              with per-(token, head) absmax scales —
+    #                              halves the cache's HBM footprint and,
+    #                              on the bandwidth-bound decode step, its
+    #                              per-token read bill vs bf16 (4x vs f32).
+    #                              Values quantize at the write; the read
+    #                              dequant fuses into the attention einsum.
     decode_seq_shards: int = 1  # >1: KV cache sharded over `seq_axis`
     #                             (parallel/sp.py make_sp_generate) — each
     #                             device owns ctx_size/shards cache slots;
@@ -105,6 +112,16 @@ class LlamaConfig:
                 "decode_seq_shards > 1 uses its own distributed-merge "
                 "attention and would silently ignore "
                 f"decode_impl={self.decode_impl!r}; set decode_impl='xla'"
+            )
+        if self.kv_cache_int8 and self.decode_seq_shards > 1:
+            raise ValueError(
+                "kv_cache_int8 is not yet wired into the seq-sharded "
+                "decode path; shard a float cache or serve unsharded"
+            )
+        if self.kv_cache_int8 and self.decode_impl != "xla":
+            raise ValueError(
+                "kv_cache_int8 requires decode_impl='xla' (the Pallas "
+                "flash-decode kernel reads a float cache)"
             )
         if self.moe_dispatch not in ("dense", "capacity"):
             raise ValueError(
@@ -257,9 +274,6 @@ class Attention(nn.Module):
         Hkv = cfg.kv_heads
         if cfg.decode_seq_shards > 1:
             return self._sharded_decode_attention(q, k, v, positions, pad)
-        zeros = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), q.dtype)
-        ck = self.variable("cache", "k", zeros)
-        cv = self.variable("cache", "v", zeros)
         per_row = positions.ndim == 2  # (B, T) row-local slots (speculative)
         if pad is not None:
             # scrub pad-slot K/V before they enter the cache: pad-slot
@@ -271,22 +285,65 @@ class Attention(nn.Module):
             real = (pos2d >= pad[:, None])[..., None, None]
             k = jnp.where(real, k, 0)
             v = jnp.where(real, v, 0)
-        if per_row:
-            row_write = jax.vmap(
-                lambda c, blk, off: jax.lax.dynamic_update_slice(
-                    c, blk, (off, 0, 0)
+
+        def write(var, blk):
+            """Scatter a (B, T, Hkv, ...) block at the query positions —
+            shared by the value buffers and the int8 scale buffers (whose
+            trailing dims just shrink)."""
+            trail = (0,) * (blk.ndim - 2)
+            if per_row:
+                var.value = jax.vmap(
+                    lambda c, b, off: jax.lax.dynamic_update_slice(
+                        c, b, (off,) + trail
+                    )
+                )(var.value, blk, positions[:, 0])
+            else:
+                var.value = jax.lax.dynamic_update_slice(
+                    var.value, blk, (0, positions[0]) + trail
                 )
-            )
-            ck.value = row_write(ck.value, k, positions[:, 0])
-            cv.value = row_write(cv.value, v, positions[:, 0])
+
+        if cfg.kv_cache_int8:
+            # serving cache compression: per-(token, head) absmax over the
+            # head dim — worst-case per-element error is scale/2 (<=0.4% of
+            # the row's largest value), and the read-side dequant fuses
+            # into the attention einsum's operand load.  jnp.where keeps
+            # all-zero (scrubbed pad) rows exactly zero.
+            def quant(blk):
+                amax = jnp.max(jnp.abs(blk.astype(jnp.float32)), axis=-1)
+                scale = jnp.maximum(amax, 1e-8) / 127.0
+                qv = jnp.clip(
+                    jnp.round(blk.astype(jnp.float32) / scale[..., None]),
+                    -127, 127,
+                ).astype(jnp.int8)
+                return qv, scale.astype(jnp.float32)
+
+            z8 = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), jnp.int8)
+            zs = lambda: jnp.zeros((B, S, Hkv), jnp.float32)
+            ck_q = self.variable("cache", "k_q", z8)
+            ck_s = self.variable("cache", "k_s", zs)
+            cv_q = self.variable("cache", "v_q", z8)
+            cv_s = self.variable("cache", "v_s", zs)
+            kq, ks = quant(k)
+            vq, vs = quant(v)
+            write(ck_q, kq)
+            write(ck_s, ks)
+            write(cv_q, vq)
+            write(cv_s, vs)
+
+            class _Deq:  # minimal .value shim for the einsum below
+                def __init__(self, qv, sv):
+                    self.value = (
+                        qv.value.astype(q.dtype) * sv.value[..., None]
+                        .astype(q.dtype)
+                    )
+
+            ck, cv = _Deq(ck_q, ck_s), _Deq(cv_q, cv_s)
         else:
-            offset = positions[0]
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k, (0, offset, 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v, (0, offset, 0, 0)
-            )
+            zeros = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), q.dtype)
+            ck = self.variable("cache", "k", zeros)
+            cv = self.variable("cache", "v", zeros)
+            write(ck, k)
+            write(cv, v)
         if cfg.decode_impl == "flash-decode" and T == 1:
             # Pallas kernel streams only the LIVE cache prefix (scalar-
             # prefetch-clamped DMA); prefill (T > 1) keeps the einsum
@@ -296,7 +353,7 @@ class Attention(nn.Module):
 
             out = flash_decode_attention(
                 q[:, 0], ck.value, cv.value,
-                positions[:, 0] if per_row else offset, pad,
+                positions[:, 0] if per_row else positions[0], pad,
             )
             return out[:, None]  # (B, 1, H, hd)
         # (B, T, Hkv, group, hd): query heads grouped by the KV head they share

