@@ -1,0 +1,57 @@
+"""Privacy attacks on FL / split / generative training — and their defenses.
+
+The reference course plan names "Attacks & Defenses in Generative Models"
+(lab/README.md:13-16) but ships no code for it; the Byzantine side lives in
+:mod:`ddl25spring_tpu.robust`.  This package covers the *privacy* side — the
+attacks that read training data out of the very messages the FL/VFL protocols
+exchange:
+
+- :mod:`.inversion` — gradient inversion (DLG / iDLG): reconstruct a client's
+  training batch from the FedSGD gradient the server receives
+  (hfl_complete.py:291-299 is the observation point).  Defense: the engine's
+  DP clip+noise (``fl/engine.py`` ``dp_clip``/``dp_noise_mult``), quantified
+  here by reconstruction error vs noise multiplier.
+- :mod:`.mia` — membership inference: loss-threshold MIA on classifiers
+  (Yeom et al. 2018) and reconstruction-error MIA on the tabular VAE
+  (the generative-model attack; generative-modeling.py's Autoencoder is the
+  target class).  Reported as attack AUC.
+- :mod:`.label_leakage` — VFL label inference from the norm of the
+  server->client gradient at the split cut (Li et al. 2021), observed at the
+  concat boundary (vfl.py:36).  Defense: :class:`ProtectedVFLNetwork`'s
+  training step splits the backward at the cut explicitly (``jax.vjp``
+  through the bottoms) and noises the server->client gradient message
+  before the parties see it; ``cut_noise`` is the same noising operator
+  standalone, applied directly to an observed cut-gradient message.
+
+Everything is jit-compiled JAX; attacks run on the same mesh as training.
+"""
+
+from .inversion import (
+    infer_label_idlg,
+    invert_gradient,
+    make_classifier_loss,
+    noise_defense,
+)
+from .label_leakage import (
+    ProtectedVFLNetwork,
+    cut_gradient,
+    cut_gradient_norms,
+    cut_noise,
+    norm_leak_auc,
+)
+from .mia import attack_auc, loss_scores, vae_reconstruction_scores
+
+__all__ = [
+    "invert_gradient",
+    "infer_label_idlg",
+    "make_classifier_loss",
+    "noise_defense",
+    "attack_auc",
+    "loss_scores",
+    "vae_reconstruction_scores",
+    "cut_noise",
+    "cut_gradient",
+    "cut_gradient_norms",
+    "norm_leak_auc",
+    "ProtectedVFLNetwork",
+]

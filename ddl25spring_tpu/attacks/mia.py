@@ -1,0 +1,63 @@
+"""Membership inference attacks (MIA) — classifiers and generative models.
+
+The generative half is the course's Part-3 headline ("Attacks & Defenses in
+Generative Models", lab/README.md:13-16): a VAE trained on a small private
+table (the reference's Autoencoder on heart.csv,
+generative-modeling.py:133-165) memorizes — records it trained on
+reconstruct with lower error than records it never saw.  An attacker holding
+the model and a candidate record scores membership by reconstruction error.
+
+- :func:`loss_scores` — per-record loss of a classifier; Yeom et al. 2018's
+  threshold attack uses it directly (members have lower loss on an
+  overfitted model).
+- :func:`vae_reconstruction_scores` — per-record deterministic ELBO-style
+  score of a :class:`~ddl25spring_tpu.models.vae.TabularVAE`: mean-path
+  reconstruction MSE plus the KL term (both per record, no sampling noise).
+- :func:`attack_auc` — the Mann-Whitney AUC of "score separates members
+  from non-members"; 0.5 = no leak, 1.0 = total leak.  This is the number a
+  defense (DP noise, early stopping, more data) must push toward 0.5.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def loss_scores(log_probs, labels) -> jnp.ndarray:
+    """Per-record NLL (no reduction) — lower = more member-like."""
+    return -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+
+
+def vae_reconstruction_scores(
+    vae, variables, x, *, include_kl: bool = True
+) -> jnp.ndarray:
+    """Per-record deterministic VAE score: ``||x - dec(mu(x))||² +
+    KL(q(z|x) || N(0, I))``; lower = more member-like.
+
+    Eval-mode apply (running BatchNorm stats, mean-path latent) so the score
+    is a pure function of the record — the attacker needs no RNG luck.
+    """
+    recon, mu, logvar = vae.apply(variables, x, train=False)
+    mse = jnp.sum(jnp.square(recon - x), axis=-1)
+    if not include_kl:
+        return mse
+    kl = -0.5 * jnp.sum(
+        1 + logvar - jnp.square(mu) - jnp.exp(logvar), axis=-1
+    )
+    return mse + kl
+
+
+def attack_auc(member_scores, nonmember_scores) -> float:
+    """AUC of the rule "lower score ⇒ member" (Mann-Whitney U / (n·m)).
+
+    Ties count half, so a constant score gives exactly 0.5.
+    """
+    m = np.asarray(member_scores, np.float64).ravel()
+    n = np.asarray(nonmember_scores, np.float64).ravel()
+    if m.size == 0 or n.size == 0:
+        raise ValueError("both member and non-member scores required")
+    # P(member_score < nonmember_score) + 0.5 P(equal)
+    less = (m[:, None] < n[None, :]).sum()
+    ties = (m[:, None] == n[None, :]).sum()
+    return float((less + 0.5 * ties) / (m.size * n.size))
