@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
@@ -33,7 +34,7 @@ CPU_BASELINE_ROUNDS_PER_SEC = 0.001441
 
 
 def build_server(seed: int = 10, norm_impl: str = "flax",
-                 conv_impl: str = "flax"):
+                 conv_impl: str = "flax", remat: bool = False):
     import jax
     import jax.numpy as jnp
 
@@ -94,7 +95,7 @@ def build_server(seed: int = 10, norm_impl: str = "flax",
     _stamp("building task + jit round_fn ...")
     task = classification_task(
         ResNet18(dtype=jnp.bfloat16, norm_impl=norm_impl,
-                 conv_impl=conv_impl), (32, 32, 3),
+                 conv_impl=conv_impl, remat=remat), (32, 32, 3),
         test_x, test_y,
         input_transform=cifar_input_transform(jnp.bfloat16),
     )
@@ -178,6 +179,18 @@ def cost_breakdown(server) -> dict:
     # ONE sentinel-filtered analysis pass, sub-buckets included (Mosaic
     # custom calls report flops=-1/-2, never emitted as measurements)
     keep = cost_summary(compiled, sub_buckets=True)
+    # XLA's cost analysis counts a scan/fori_loop BODY once, independent of
+    # trip count (verified empirically, round 4) — each client's
+    # local-minibatch scan contributes ONE minibatch of flops, so `flops`
+    # is a LOWER bound on the round.  Record the per-client trip count so
+    # readers can bound the undercount: true scan flops = counted x steps.
+    try:
+        shard = server.client_data.x.shape[1]
+        keep["local_steps_counted_once"] = (
+            -(-shard // server.batch_size) * server.nr_local_epochs
+        )
+    except AttributeError:
+        pass
     # XLA's own optimal_seconds is unreliable on this client (observed
     # NEGATIVE on the round-4 capture) — derive the roofline ourselves
     # from chip peaks instead.  One roofline second per bound:
@@ -193,6 +206,19 @@ def cost_breakdown(server) -> dict:
             keep["roofline_seconds_flops"], keep["roofline_seconds_bytes"]
         )
         keep["roofline_peaks"] = peaks
+        # datasheet peaks are not what this tunneled chip delivers (72.5 of
+        # 197 bf16 TFLOP/s, 343 of 819 GB/s measured — tools/chip_peaks.py);
+        # when a measured-peaks artifact exists, emit that roofline too
+        measured = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "results", "chip_peaks_tpu.json")
+        if os.path.exists(measured):
+            with open(measured) as fh:
+                eff = json.load(fh).get("effective_peaks", {})
+            if eff.get("flops_per_s") and eff.get("hbm_bytes_per_s"):
+                keep["roofline_seconds_measured_peaks"] = max(
+                    f / eff["flops_per_s"], b / eff["hbm_bytes_per_s"]
+                )
+                keep["measured_peaks"] = eff
     return keep
 
 
@@ -413,6 +439,11 @@ def main():
                          "client-vmapped weights MXU-native (the vmapped "
                          "lax.conv form puts the client axis inside the "
                          "conv window, round-4 AOT HLO)")
+    ap.add_argument("--remat", action="store_true",
+                    help="checkpoint ResNet blocks (recompute activations "
+                         "in backward): im2col's 9x patch tensors OOM'd "
+                         "v5e HBM by 172 MB at bench scale without it "
+                         "(round-4 hardware capture)")
     ap.add_argument("--no-fused", action="store_true",
                     help="dispatch each timed round separately instead of "
                          "one fused fori_loop program (the gap measures "
@@ -454,7 +485,7 @@ def main():
     _WATCHDOG = _Watchdog(args.deadline_s)
     _stamp("building server (data + mesh + jit round_fn) ...")
     server = build_server(norm_impl=args.norm_impl,
-                          conv_impl=args.conv_impl)
+                          conv_impl=args.conv_impl, remat=args.remat)
     if args.cost_analysis:
         costs = cost_breakdown(server)
         _WATCHDOG.cancel()
@@ -462,6 +493,7 @@ def main():
             "metric": METRIC + "_cost_analysis",
             "norm_impl": args.norm_impl,
             "conv_impl": args.conv_impl,
+            "remat": args.remat,
             **costs,
         }))
         return
@@ -483,7 +515,8 @@ def main():
     _stamp("eval done")
     _WATCHDOG.cancel()
     _emit_json(rps, final_test_accuracy_pct=round(final_acc, 2),
-               rounds_timed=args.rounds)
+               rounds_timed=args.rounds, norm_impl=args.norm_impl,
+               conv_impl=args.conv_impl, remat=args.remat)
 
 
 if __name__ == "__main__":

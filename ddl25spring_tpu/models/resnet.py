@@ -83,6 +83,9 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
     norm_impl: str = "flax"  # flax | lean (ops.norm.LeanGroupNorm, same params)
     conv_impl: str = "flax"  # flax | im2col (ops.conv.Im2ColConv, same params)
+    remat: bool = False  # checkpoint each block: backward recomputes its
+    # activations instead of storing them — im2col's 9x patch tensors are
+    # what pushed the north-star bench 172 MB past v5e HBM (round-4 capture)
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -91,12 +94,13 @@ class ResNet(nn.Module):
         x = _conv(self.widths[0], (3, 3), (1, 1), dt, "stem",
                   self.conv_impl)(x)
         x = nn.relu(_norm(self.widths[0], dt, "stem_norm", self.norm_impl)(x))
+        block_cls = nn.remat(BasicBlock) if self.remat else BasicBlock
         for g, (blocks, width) in enumerate(zip(self.blocks_per_group, self.widths)):
             for b in range(blocks):
                 stride = 2 if (b == 0 and g > 0) else 1
-                x = BasicBlock(width, stride, dt, norm_impl=self.norm_impl,
-                               conv_impl=self.conv_impl,
-                               name=f"group{g}_block{b}")(x)
+                x = block_cls(width, stride, dt, norm_impl=self.norm_impl,
+                              conv_impl=self.conv_impl,
+                              name=f"group{g}_block{b}")(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.nr_classes, dtype=jnp.float32, name="head")(
             x.astype(jnp.float32)
@@ -105,6 +109,7 @@ class ResNet(nn.Module):
 
 
 def ResNet18(nr_classes: int = 10, dtype=jnp.float32,
-             norm_impl: str = "flax", conv_impl: str = "flax") -> ResNet:
+             norm_impl: str = "flax", conv_impl: str = "flax",
+             remat: bool = False) -> ResNet:
     return ResNet(nr_classes=nr_classes, dtype=dtype, norm_impl=norm_impl,
-                  conv_impl=conv_impl)
+                  conv_impl=conv_impl, remat=remat)

@@ -98,21 +98,44 @@ def main() -> int:
     ca = compiled.cost_analysis()
     if isinstance(ca, list):
         ca = ca[0] if ca else {}
-    flops_total = float(ca.get("flops", 0.0))
-    flops_step = flops_total / args.steps
+    # XLA's cost analysis counts a fori_loop BODY once, independent of trip
+    # count (verified empirically: flops identical for nr=1/4/8) — so the
+    # program's "flops" IS the per-step figure; do not divide by steps.
+    flops_step = float(ca.get("flops", 0.0))
 
-    # warmup dispatch (buffers land on device), then the timed one
+    # warmup dispatch (buffers land on device), then the timed one.
+    # Synchronize via a device->host scalar fetch: over the axon tunnel
+    # block_until_ready returns when the remote handle exists, NOT when the
+    # compute finishes (an earlier run "measured" 0.87 ms/step = 985% MFU),
+    # but a host readback cannot complete before the data does.
+    def sync(o):
+        import numpy as np
+        np.asarray(jax.device_get(jax.tree.leaves(o)[0].ravel()[:1]))
+
     out = compiled(params, opt_state, tokens)
-    jax.block_until_ready(out)
+    sync(out)
+    t0 = time.perf_counter()  # RTT of a fetch on already-synced data:
+    sync(out)                 # subtracted below so the timed window is
+    rtt = time.perf_counter() - t0  # compute, not tunnel round-trip
     t0 = time.perf_counter()
     out = compiled(params, opt_state, tokens)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
+    sync(out)
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
     step_s = dt / args.steps
     tok_s = args.batch * args.seq / step_s
 
     peaks = bench._chip_peaks()
     mfu = (flops_step / step_s / peaks["flops_per_s"]) if peaks else None
+    # this tunneled chip sustains well below datasheet (72.5 bf16 TFLOP/s
+    # measured vs 197 rated, tools/chip_peaks.py) — report both denominators
+    mfu_measured = None
+    peaks_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "results", "chip_peaks_tpu.json")
+    if os.path.exists(peaks_path):
+        with open(peaks_path) as f:
+            eff = json.load(f).get("effective_peaks", {})
+        if eff.get("flops_per_s"):
+            mfu_measured = flops_step / step_s / eff["flops_per_s"]
     line = {
         "metric": "lm_train_step",
         "backend": backend,
@@ -124,6 +147,9 @@ def main() -> int:
         "tokens_per_sec": round(tok_s, 0),
         "flops_per_step": flops_step,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_vs_measured_peak": (
+            round(mfu_measured, 4) if mfu_measured is not None else None
+        ),
     }
     print(json.dumps(line), flush=True)
     return 0

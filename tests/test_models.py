@@ -164,3 +164,25 @@ def test_im2col_conv_under_client_vmap():
     a = jax.vmap(m_flax.apply)(stacked, x)
     b = jax.vmap(m_i2c.apply)(stacked, x)
     assert float(jnp.max(jnp.abs(a - b))) < 1e-5
+
+
+def test_resnet_remat_matches_no_remat():
+    """``remat=True`` (checkpointed blocks, added when im2col's 9x patch
+    tensors pushed the north-star bench 172 MB past v5e HBM) must be a pure
+    memory/recompute trade: forward values and gradients identical."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models import ResNet18
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    m = ResNet18(conv_impl="im2col", remat=True)
+    m0 = ResNet18(conv_impl="im2col", remat=False)
+    p = m0.init(jax.random.PRNGKey(1), x)
+    assert (jax.tree.structure(p)
+            == jax.tree.structure(m.init(jax.random.PRNGKey(1), x)))
+    assert float(jnp.max(jnp.abs(m.apply(p, x) - m0.apply(p, x)))) < 1e-6
+    ga = jax.grad(lambda q: jnp.sum(m.apply(q, x) ** 2))(p)
+    gb = jax.grad(lambda q: jnp.sum(m0.apply(q, x) ** 2))(p)
+    for u, v in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        assert float(jnp.max(jnp.abs(u - v))) < 5e-4

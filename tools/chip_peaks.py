@@ -1,0 +1,97 @@
+"""Measure the chip's EFFECTIVE peaks — matmul TFLOP/s and HBM GB/s.
+
+Why: MFU and roofline numbers in this repo were initially computed against
+the v5e datasheet (197 bf16 TFLOP/s, 819 GB/s).  A round-4 probe showed a
+pure bf16 4096x4096x4096 matmul chain sustains only ~37 TFLOP/s on this
+tunneled "TPU v5 lite" — the datasheet denominator makes every MFU look
+5x worse than the fraction of *achievable* compute actually used.  This
+tool measures what the chip really delivers:
+
+- ``matmul``: fused fori_loop chains of square bf16 / f32 matmuls at
+  several sizes (the bf16 max is the effective MXU peak);
+- ``hbm``: a scaled-add (triad) over arrays far larger than VMEM, and a
+  reduction, giving effective bytes/s.
+
+Timing uses a device->host scalar readback for synchronization: over the
+axon tunnel ``block_until_ready`` returns before remote execution finishes
+(examples/bench_lm_mfu.py learned this the hard way: 985% "MFU").
+
+Output: one JSON line; save to results/chip_peaks_tpu.json so benches can
+report MFU against BOTH datasheet and measured peaks.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def sync(o):
+        np.asarray(jax.device_get(jax.tree.leaves(o)[0].ravel()[:1]))
+
+    def timeit(fn, *args, n=1):
+        out = fn(*args)
+        sync(out)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        return (time.perf_counter() - t0) / n
+
+    out = {"backend": jax.default_backend(),
+           "device": str(jax.devices()[0]), "matmul": {}, "hbm": {}}
+
+    @partial(jax.jit, static_argnames=("nr",))
+    def mm_chain(a, b, nr):
+        # a <- a @ b each step: serial dependence, no overlap tricks
+        def body(_, a):
+            return a @ b
+        return jax.lax.fori_loop(0, nr, body, a)
+
+    for size, dt, reps in [(2048, jnp.bfloat16, 64), (4096, jnp.bfloat16, 32),
+                           (8192, jnp.bfloat16, 8), (4096, jnp.float32, 8)]:
+        a = jnp.eye(size, dtype=dt) * 0.999  # eye^n stays finite
+        b = jnp.eye(size, dtype=dt)
+        dt_s = timeit(lambda a: mm_chain(a, b, reps), a, n=reps)
+        tflops = 2 * size**3 / dt_s / 1e12
+        out["matmul"][f"{size}_{jnp.dtype(dt).name}"] = {
+            "ms": round(dt_s * 1e3, 3), "tflops": round(tflops, 1)}
+
+    @partial(jax.jit, static_argnames=("nr",))
+    def triad(a, b, nr):
+        def body(_, a):
+            return a * 0.5 + b  # read 2 arrays, write 1 -> 3x bytes
+        return jax.lax.fori_loop(0, nr, body, a)
+
+    n = 256 * 1024 * 1024  # 1 GiB per f32 array, far beyond VMEM
+    a = jnp.ones((n,), jnp.float32)
+    b = jnp.ones((n,), jnp.float32)
+    dt_s = timeit(lambda a: triad(a, b, 16), a, n=16)
+    out["hbm"]["triad_gbps"] = round(3 * 4 * n / dt_s / 1e9, 1)
+
+    @partial(jax.jit, static_argnames=("nr",))
+    def reduce_chain(a, nr):
+        def body(_, acc):
+            return acc + jnp.sum(a)
+        return jax.lax.fori_loop(0, nr, body, jnp.float32(0.0))
+
+    dt_s = timeit(lambda a: reduce_chain(a, 16), a, n=16)
+    out["hbm"]["reduce_gbps"] = round(4 * n / dt_s / 1e9, 1)
+
+    best_mm = max(v["tflops"] for k, v in out["matmul"].items()
+                  if "bfloat16" in k)
+    best_bw = max(out["hbm"].values())
+    out["effective_peaks"] = {"flops_per_s": best_mm * 1e12,
+                              "hbm_bytes_per_s": best_bw * 1e9}
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

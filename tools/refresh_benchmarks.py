@@ -78,8 +78,31 @@ def main() -> int:
     else:
         print("- kernel validation: NOT CAPTURED")
 
+    peaks = read_json_line(root / "chip_peaks_tpu.json")
+    if peaks:
+        eff = peaks.get("effective_peaks", {})
+        print(f"- measured chip peaks: "
+              f"{eff.get('flops_per_s', 0) / 1e12:.1f} bf16 TFLOP/s, "
+              f"{eff.get('hbm_bytes_per_s', 0) / 1e9:.0f} GB/s "
+              "(MFU/roofline denominators)")
+
+    mfu = read_json_line(root / "lm_mfu_tpu.txt")
+    if mfu:
+        print(f"- LM MFU (d={mfu.get('dmodel')}, T={mfu.get('seq')}): "
+              f"{mfu.get('step_ms')} ms/step, "
+              f"{mfu.get('tokens_per_sec')} tok/s, "
+              f"mfu {mfu.get('mfu')} datasheet / "
+              f"{mfu.get('mfu_vs_measured_peak')} vs measured peak")
+
+    i2c = read_json_line(root / "bench_tpu_im2col_remat.json")
+    if i2c and lean and i2c.get("value", 0) > 0 and lean.get("value", 0) > 0:
+        print(f"- im2col+remat north star: {i2c['value']} rounds/sec "
+              f"({i2c['value'] / lean['value']:.2f}x the lean default -> "
+              f"{'FLIP conv_impl' if i2c['value'] > 1.02 * lean['value'] else 'keep flax conv'})")
+
     for name in ("flash_tpu.txt", "flash_tpu_hd128.txt",
-                 "generate_tpu.txt", "generate_spec_tpu.txt"):
+                 "generate_tpu.txt", "generate_flash_tpu.txt",
+                 "generate_spec_tpu.txt"):
         p = root / name
         if p.exists() and p.stat().st_size > 0:
             lines = [ln for ln in p.read_text().splitlines() if ln.strip()]
