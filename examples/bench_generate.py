@@ -37,10 +37,12 @@ def main():
     ap.add_argument("--int8", action="store_true",
                     help="also measure each config with int8 matmul weights "
                          "(models/quant.py) — the weight-bandwidth A/B")
-    ap.add_argument("--decode-impl", default="xla",
-                    choices=["xla", "flash-decode"],
+    ap.add_argument("--decode-impl", default="auto",
+                    choices=["auto", "xla", "flash-decode"],
                     help="flash-decode = Pallas kernel reading only live "
-                         "cache blocks (ops/flash_decode.py)")
+                         "cache blocks (ops/flash_decode.py); auto (the "
+                         "library default since the round-4 hardware "
+                         "validation) resolves to flash-decode on TPU")
     ap.add_argument("--speculative", type=int, default=0, metavar="GAMMA",
                     help="also measure speculative decoding at this "
                          "proposal depth: self-draft (acceptance 1.0 — the "

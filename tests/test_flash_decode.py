@@ -123,3 +123,34 @@ def test_flash_decode_per_row_positions():
     want = jnp.einsum("bkgs,bskd->bkgd", att, cv).reshape(B, Hq, hd)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-5)
+
+
+def test_decode_impl_auto_resolution():
+    """'auto' (the default since the round-4 hardware validation) resolves
+    by backend and eligibility; explicit impls pass through untouched."""
+    import dataclasses
+
+    import jax
+
+    from ddl25spring_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(decode=True)
+    assert cfg.decode_impl == "auto"
+    # CPU test backend -> xla
+    assert cfg.resolved_decode_impl() == (
+        "flash-decode" if jax.default_backend() == "tpu" else "xla"
+    )
+    # ineligible shapes resolve to xla even on TPU
+    assert dataclasses.replace(
+        cfg, ctx_size=256, decode_seq_shards=2
+    ).resolved_decode_impl() == "xla"
+    assert dataclasses.replace(
+        cfg, kv_cache_int8=True
+    ).resolved_decode_impl() == "xla"
+    # explicit settings are never overridden
+    assert dataclasses.replace(
+        cfg, decode_impl="flash-decode"
+    ).resolved_decode_impl() == "flash-decode"
+    assert dataclasses.replace(
+        cfg, decode_impl="xla"
+    ).resolved_decode_impl() == "xla"

@@ -291,9 +291,13 @@ def main():
         from ddl25spring_tpu.models.generate import generate
         from ddl25spring_tpu.models.llama import Llama, LlamaConfig
 
+        # decode_impl pinned EXPLICITLY on both sides: since the round-4
+        # default flip to "auto" (which resolves to flash-decode on the
+        # very chip this tool runs on), an unpinned baseline would make
+        # this oracle compare flash against itself
         cfg = LlamaConfig(
             vocab_size=128, dmodel=64, nr_heads=4, nr_kv_heads=2,
-            nr_layers=2, ctx_size=64,
+            nr_layers=2, ctx_size=64, decode_impl="xla",
         )
         fcfg = dataclasses.replace(cfg, decode_impl="flash-decode")
         prompt = jax.random.randint(
