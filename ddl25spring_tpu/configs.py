@@ -37,7 +37,7 @@ def _check_checkpoint_pair(checkpoint_dir, checkpoint_every):
 class HflConfig:
     """Horizontal-FL experiment (tutorial_1a / homework-1 family)."""
 
-    algorithm: str = "fedavg"  # centralized | fedsgd | fedsgd-weight | fedavg | fedprox | fedopt | fedbuff
+    algorithm: str = "fedavg"  # centralized | fedsgd | fedsgd-weight | fedavg | fedprox | fedopt | fedbuff | scaffold
     dataset: str = "mnist"     # mnist | cifar10
     nr_clients: int = 100      # N
     client_fraction: float = 0.1  # C
@@ -57,6 +57,9 @@ class HflConfig:
     staleness_window: int = 4  # fedbuff: versions a client can lag behind
     staleness_exp: float = 0.5  # fedbuff: delta weight (1+staleness)^-exp
     server_eta: float = 1.0    # fedbuff: server application rate
+    scaffold_server_lr: float = 1.0  # scaffold: global step eta_g (the
+    # paper's standard 1.0 — deliberately NOT fedopt's server_lr, whose
+    # 0.02 default would silently shrink scaffold's update 50x)
     dropout_rate: float = 0.0  # per-round client failure probability
     compress: str = "none"     # fedavg/fedprox/fedsgd uplink compression:
     #                            none | topk (sparsify client messages) |

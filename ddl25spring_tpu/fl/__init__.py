@@ -11,6 +11,7 @@ from .engine import (
     sample_clients,
 )
 from .fedbuff import FedBuffServer, init_history, make_fedbuff_round
+from .scaffold import ScaffoldServer, make_scaffold_round
 from .task import Task, classification_task, mnist_task
 from .servers import (
     Server,
@@ -42,6 +43,8 @@ __all__ = [
     "FedAvgServer",
     "FedOptServer",
     "FedBuffServer",
+    "ScaffoldServer",
+    "make_scaffold_round",
     "init_history",
     "make_fedbuff_round",
 ]

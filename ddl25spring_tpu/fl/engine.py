@@ -78,61 +78,79 @@ def make_local_sgd_update(
 
     def update(params, x, y, count, key):
         global_params = params  # round-start anchor for the proximal term
-        max_n = y.shape[0]
-        bsz = max_n if batch_size == -1 else batch_size
-        if max_n % bsz != 0:
-            raise ValueError(
-                f"padded client size {max_n} not a multiple of batch {bsz}"
+        if prox_mu:
+            grad_hook = lambda g, p: jax.tree.map(
+                lambda gl, pl, p0: gl + prox_mu * (pl - p0),
+                g, p, global_params,
             )
-        steps = max_n // bsz
-
-        def run_step(params, perm, step_idx, step_key):
-            idx = jax.lax.dynamic_slice_in_dim(perm, step_idx * bsz, bsz)
-            xb = jnp.take(x, idx, axis=0)
-            yb = jnp.take(y, idx, axis=0)
-            mask = idx < count
-            grads = jax.grad(loss_fn)(params, xb, yb, mask, step_key)
-            if prox_mu:
-                grads = jax.tree.map(
-                    lambda g, p, p0: g + prox_mu * (p - p0),
-                    grads, params, global_params,
-                )
-            return jax.tree.map(lambda p, g: p - lr * g, params, grads)
-
-        def epoch_perm_and_keys(epoch_key):
-            shuffle_key, steps_key = jax.random.split(epoch_key)
-            perm = (
-                jnp.arange(max_n)
-                if steps == 1
-                else jax.random.permutation(shuffle_key, max_n)
-            )
-            return perm, jax.random.split(steps_key, steps)
-
-        epoch_keys = jax.random.split(key, nr_epochs)
-
-        if nr_epochs * steps <= unroll_threshold:
-            for e in range(nr_epochs):
-                perm, step_keys = epoch_perm_and_keys(epoch_keys[e])
-                for s in range(steps):
-                    params = run_step(params, perm, s, step_keys[s])
-            return params
-
-        def epoch_body(params, epoch_key):
-            perm, step_keys = epoch_perm_and_keys(epoch_key)
-
-            def step_body(params, inp):
-                step_idx, step_key = inp
-                return run_step(params, perm, step_idx, step_key), None
-
-            params, _ = jax.lax.scan(
-                step_body, params, (jnp.arange(steps), step_keys)
-            )
-            return params, None
-
-        params, _ = jax.lax.scan(epoch_body, params, epoch_keys)
-        return params
+        else:
+            grad_hook = None
+        return run_local_sgd(
+            loss_fn, lr, batch_size, nr_epochs, unroll_threshold,
+            params, x, y, count, key, grad_hook,
+        )
 
     return update
+
+
+def run_local_sgd(loss_fn, lr, batch_size, nr_epochs, unroll_threshold,
+                  params, x, y, count, key, grad_hook=None):
+    """The shared E-epochs shuffled-minibatch SGD loop (see
+    :func:`make_local_sgd_update` for semantics and the key-derivation
+    chain).  ``grad_hook(grads, params) -> grads`` modifies each step's
+    gradient in place of plain SGD — FedProx's proximal term and SCAFFOLD's
+    control-variate correction (``fl/scaffold.py``) both plug in here, so
+    every variant shares ONE loop and stays shuffle/key-compatible."""
+    max_n = y.shape[0]
+    bsz = max_n if batch_size == -1 else batch_size
+    if max_n % bsz != 0:
+        raise ValueError(
+            f"padded client size {max_n} not a multiple of batch {bsz}"
+        )
+    steps = max_n // bsz
+
+    def run_step(params, perm, step_idx, step_key):
+        idx = jax.lax.dynamic_slice_in_dim(perm, step_idx * bsz, bsz)
+        xb = jnp.take(x, idx, axis=0)
+        yb = jnp.take(y, idx, axis=0)
+        mask = idx < count
+        grads = jax.grad(loss_fn)(params, xb, yb, mask, step_key)
+        if grad_hook is not None:
+            grads = grad_hook(grads, params)
+        return jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+    def epoch_perm_and_keys(epoch_key):
+        shuffle_key, steps_key = jax.random.split(epoch_key)
+        perm = (
+            jnp.arange(max_n)
+            if steps == 1
+            else jax.random.permutation(shuffle_key, max_n)
+        )
+        return perm, jax.random.split(steps_key, steps)
+
+    epoch_keys = jax.random.split(key, nr_epochs)
+
+    if nr_epochs * steps <= unroll_threshold:
+        for e in range(nr_epochs):
+            perm, step_keys = epoch_perm_and_keys(epoch_keys[e])
+            for s in range(steps):
+                params = run_step(params, perm, s, step_keys[s])
+        return params
+
+    def epoch_body(params, epoch_key):
+        perm, step_keys = epoch_perm_and_keys(epoch_key)
+
+        def step_body(params, inp):
+            step_idx, step_key = inp
+            return run_step(params, perm, step_idx, step_key), None
+
+        params, _ = jax.lax.scan(
+            step_body, params, (jnp.arange(steps), step_keys)
+        )
+        return params, None
+
+    params, _ = jax.lax.scan(epoch_body, params, epoch_keys)
+    return params
 
 
 def make_full_batch_grad(loss_fn: LossFn):

@@ -1,0 +1,222 @@
+"""SCAFFOLD — stochastic controlled averaging for federated learning.
+
+Karimireddy et al. 2020 (public): FedAvg's accuracy on non-IID splits
+degrades because each client's local SGD drifts toward its own optimum
+(the reference demonstrates exactly this degradation in homework-1 A3,
+lab/homework-1.ipynb; 2-shard split from hfl_complete.py:97-102).
+SCAFFOLD corrects the drift with control variates: a server control ``c``
+and one per-client control ``ci``, both parameter-shaped.  Each local step
+uses the corrected gradient ``g - ci + c``, steering every client's
+trajectory toward the *global* descent direction.
+
+Round (option II of the paper, the standard one):
+
+    for each sampled client i (vmapped, one SPMD program):
+        y_i <- params;  K steps of  y_i <- y_i - lr (g(y_i) - ci_i + c)
+        ci_i' = ci_i - c + (params - y_i) / (K lr)
+    params <- params + server_lr * mean_i (y_i - params)
+    c      <- c + (m / N) * mean_i (ci_i' - ci_i)
+    scatter ci_i' back into the stacked client controls
+
+TPU-native shape: the per-client state is ONE stacked pytree with a
+leading (N,) axis (gathered for the sampled m, scattered back after), the
+whole round is one jit, and the sampled axis shards over the mesh like
+every other server (engine.make_fl_round's layout).  With ``c = ci = 0``
+and a 0-length correction the local loop is exactly FedAvg's — the
+equivalence oracle in tests/test_fl_extensions.py pins a SCAFFOLD round
+with zeroed controls and K=1 full-batch to FedAvg's round.
+
+Cost note: the stacked ``ci`` is N x |params| — SCAFFOLD's price anywhere
+(each client must remember its control between rounds).  At the 256-client
+ResNet-18 north-star scale that is ~11 GB; intended for the smaller
+homework-scale experiments unless sharded over a mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .engine import run_local_sgd, sample_clients
+from .servers import DecentralizedServer
+
+
+def _tree_mean(stacked):
+    """Uniform mean over the leading (sampled-client) axis — SCAFFOLD
+    averages uniformly over participants (the paper's 1/|S|), unlike
+    FedAvg's n_k weighting."""
+    return jax.tree.map(lambda l: jnp.mean(l, axis=0), stacked)
+
+
+def make_scaffold_round(
+    loss_fn,
+    lr: float,
+    batch_size: int,
+    nr_epochs: int,
+    x,
+    y,
+    counts,
+    nr_sampled: int,
+    server_lr: float = 1.0,
+    mesh=None,
+    clients_axis: str = "clients",
+    unroll_threshold: int | None = None,
+):
+    """Build ``round(params, c, ci, base_key, round_idx) -> (params, c, ci)``.
+
+    ``loss_fn(params, xb, yb, mask, key) -> scalar`` is the engine's task
+    loss; ``x/y/counts`` the stacked padded client datasets
+    (``data.stack_client_datasets(..., pad_multiple=batch_size)``);
+    ``ci`` the stacked (N,)-leading client-control pytree.
+    """
+    if unroll_threshold is None:
+        unroll_threshold = 32 if jax.default_backend() == "cpu" else 0
+    # device-resident once, like engine.make_fl_round — raw numpy here
+    # would re-upload the whole stacked dataset every round
+    x, y, counts = jnp.asarray(x), jnp.asarray(y), jnp.asarray(counts)
+    nr_clients = y.shape[0]
+    max_n = y.shape[1]
+    bsz = max_n if batch_size == -1 else batch_size
+    if max_n % bsz != 0:
+        raise ValueError(
+            f"padded client size {max_n} not a multiple of batch {bsz}"
+        )
+    steps = max_n // bsz
+    nr_steps_total = nr_epochs * steps
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        cshard = NamedSharding(mesh, PartitionSpec(clients_axis))
+
+        def constrain(t):
+            return jax.tree.map(
+                lambda a: jax.lax.with_sharding_constraint(a, cshard), t
+            )
+    else:
+        constrain = lambda t: t
+
+    def local_update(params0, c, ci, x_i, y_i, count, key):
+        """K corrected-SGD steps — engine.run_local_sgd's loop (identical
+        shuffle/key chain to the FedAvg family) with the control-variate
+        correction as the gradient hook."""
+        correction = lambda g, p: jax.tree.map(
+            lambda gl, ci_l, c_l: gl - ci_l + c_l, g, ci, c
+        )
+        params = run_local_sgd(
+            loss_fn, lr, batch_size, nr_epochs, unroll_threshold,
+            params0, x_i, y_i, count, key, correction,
+        )
+
+        # option II control update: ci' = ci - c + (params0 - y_K)/(K lr)
+        ci_new = jax.tree.map(
+            lambda ci_l, c_l, p0, pk:
+                ci_l - c_l + (p0 - pk) / (nr_steps_total * lr),
+            ci, c, params0, params,
+        )
+        return params, ci_new
+
+    @jax.jit
+    def _round(params, c, ci, base_key, round_idx, x, y, counts):
+        # same key chain as engine.make_fl_round (sample_key = first of the
+        # 4-way split; per-client key = fold_in(round_key, client_id)), so a
+        # zero-control SCAFFOLD round sees the identical sample and dropout
+        # randomness as the FedAvg family — the equivalence oracle needs it
+        round_key = jax.random.fold_in(base_key, round_idx)
+        sample_key, _, _, _ = jax.random.split(round_key, 4)
+        idx = sample_clients(sample_key, nr_clients, nr_sampled)
+        keys = jax.vmap(
+            lambda i: jax.random.fold_in(round_key, i)
+        )(idx)
+
+        gather = lambda t: constrain(
+            jax.tree.map(lambda a: jnp.take(a, idx, axis=0), t)
+        )
+        x_s = constrain(jnp.take(x, idx, axis=0))
+        y_s = constrain(jnp.take(y, idx, axis=0))
+        counts_s = constrain(jnp.take(counts, idx, axis=0))
+        ci_s = gather(ci)
+
+        y_k, ci_new = jax.vmap(
+            local_update, in_axes=(None, None, 0, 0, 0, 0, 0)
+        )(params, c, ci_s, x_s, y_s, counts_s, keys)
+        y_k, ci_new = constrain(y_k), constrain(ci_new)
+
+        dx = _tree_mean(jax.tree.map(lambda yk, p: yk - p, y_k, params))
+        dc = _tree_mean(jax.tree.map(lambda n, o: n - o, ci_new, ci_s))
+        params = jax.tree.map(
+            lambda p, d: p + server_lr * d, params, dx
+        )
+        c = jax.tree.map(
+            lambda c_l, d: c_l + (nr_sampled / nr_clients) * d, c, dc
+        )
+        ci = jax.tree.map(
+            lambda full, new: full.at[idx].set(new), ci, ci_new
+        )
+        return params, c, ci
+
+    def round_fn(params, c, ci, base_key, round_idx):
+        return _round(params, c, ci, base_key, round_idx, x, y, counts)
+
+    round_fn.raw = _round
+    round_fn.data = (x, y, counts)
+    return round_fn
+
+
+class ScaffoldServer(DecentralizedServer):
+    """SCAFFOLD as a drop-in sibling of the FedAvg-family servers.
+
+    Subclasses :class:`~ddl25spring_tpu.fl.servers.DecentralizedServer`
+    (the FedBuff pattern) and overrides only what differs: the round
+    threads ``c``/``ci`` — cross-round state surfaced through
+    ``extra_state()`` for exact checkpoint-resume — and each selected
+    client exchanges 2 extra messages (its control) on top of FedAvg's 2.
+    """
+
+    def __init__(self, task, lr: float, batch_size: int, client_data,
+                 client_fraction: float, nr_local_epochs: int, seed: int,
+                 server_lr: float = 1.0, mesh=None):
+        super().__init__(task, lr, batch_size, client_data, client_fraction,
+                         seed, mesh=mesh)
+        self.algorithm = "SCAFFOLD"
+        self.nr_local_epochs = nr_local_epochs
+        self.c = jax.tree.map(jnp.zeros_like, self.params)
+        self.ci = jax.tree.map(
+            lambda l: jnp.zeros((self.nr_clients,) + l.shape, l.dtype),
+            self.params,
+        )
+        self.round_fn = make_scaffold_round(
+            task.loss_fn, lr, batch_size, nr_local_epochs,
+            client_data.x, client_data.y, client_data.counts,
+            self.nr_clients_per_round, server_lr=server_lr, mesh=mesh,
+        )
+
+    def extra_state(self):
+        return {"c": self.c, "ci": self.ci}
+
+    def restore_extra_state(self, state) -> None:
+        self.c, self.ci = state["c"], state["ci"]
+
+    def run(self, nr_rounds: int, start_round: int = 0, on_round=None):
+        from time import perf_counter
+
+        from ..utils.metrics import RunResult
+        from ..utils.platform import device_sync
+
+        result = RunResult(
+            self.algorithm, self.nr_clients, self.client_fraction,
+            self.batch_size, self.nr_local_epochs, self.lr, self.seed,
+        )
+        elapsed = 0.0
+        for r in range(start_round, start_round + nr_rounds):
+            t0 = perf_counter()
+            self.params, self.c, self.ci = device_sync(self.round_fn(
+                self.params, self.c, self.ci, self.run_key, r
+            ))
+            elapsed += perf_counter() - t0
+            result.record_round(
+                elapsed, 4 * (r + 1) * self.nr_clients_per_round, self.test()
+            )
+            if on_round is not None:
+                on_round(r, result)
+        return result

@@ -9,8 +9,9 @@ course part 3, SURVEY.md §2.2) plug in via --aggregator/--attack flags.
 
 Beyond the reference: ``--algorithm fedprox --prox-mu 0.1`` (proximal local
 SGD), ``--algorithm fedopt --server-optimizer adam|yogi|avgm`` (adaptive
-server optimizers over the round delta), and ``--dropout-rate`` (per-round
-client failure simulation with survivor renormalisation).
+server optimizers over the round delta), ``--algorithm scaffold``
+(control-variate drift correction, fl/scaffold.py), and ``--dropout-rate``
+(per-round client failure simulation with survivor renormalisation).
 """
 
 from __future__ import annotations
@@ -138,6 +139,24 @@ def build_server(cfg: HflConfig):
             cfg.nr_local_epochs, cfg.seed,
             staleness_window=cfg.staleness_window,
             staleness_exp=cfg.staleness_exp, server_eta=cfg.server_eta,
+        )
+
+    if cfg.algorithm == "scaffold":
+        if cfg.aggregator != "mean" or cfg.attack != "none" or cfg.dropout_rate:
+            raise ValueError(
+                "scaffold does not combine with robust aggregators, attacks, "
+                "or dropout_rate (the control-variate update assumes honest "
+                "full participation of the sampled set)"
+            )
+        from .fl import ScaffoldServer
+
+        client_data = split_dataset(ds.train_x, ds.train_y, cfg.nr_clients,
+                                    cfg.iid, cfg.seed,
+                                    pad_multiple=cfg.batch_size)
+        return ScaffoldServer(
+            task, cfg.lr, cfg.batch_size, client_data, cfg.client_fraction,
+            cfg.nr_local_epochs, cfg.seed,
+            server_lr=cfg.scaffold_server_lr,
         )
 
     pad = cfg.batch_size if cfg.algorithm in ("fedavg", "fedprox", "fedopt") else 1

@@ -541,3 +541,103 @@ def test_fl_compress_composes_with_robust_aggregator(small_fl):
     acc0 = srv.test()
     res = srv.run(2)
     assert res.test_accuracy[-1] > acc0
+
+
+# --- SCAFFOLD -------------------------------------------------------------
+
+def test_scaffold_zero_controls_k1_is_fedsgd_weight(small_fl):
+    """With c = ci = 0 and K = 1 full-batch step, the corrected gradient IS
+    the plain gradient, so one SCAFFOLD round equals one FedSgdWeight round
+    (uniform mean == n_k mean on this equal-count split).  Also checks the
+    option-II control update: with K=1 full batch, ci' = the client's
+    full-batch gradient."""
+    from ddl25spring_tpu.fl import FedSgdWeightServer, ScaffoldServer
+
+    cd, task = small_fl
+    kw = dict(task=task, lr=0.05, client_data=cd, client_fraction=1.0,
+              seed=10)
+    sc = ScaffoldServer(batch_size=-1, nr_local_epochs=1, **kw)
+    ref = FedSgdWeightServer(**kw)
+    sc.params, sc.c, sc.ci = sc.round_fn(
+        sc.params, sc.c, sc.ci, sc.run_key, 0
+    )
+    ref.params = ref.round_fn(ref.params, ref.run_key, 0)
+    for a, b in zip(jax.tree.leaves(sc.params), jax.tree.leaves(ref.params)):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-5
+    # c after full participation from zeros = mean of ci_new = mean grad;
+    # and each ci' is that client's gradient (nonzero)
+    norms = [float(jnp.linalg.norm(l.reshape(l.shape[0], -1), axis=1).min())
+             for l in jax.tree.leaves(sc.ci)]
+    assert all(n > 0 for n in norms)
+
+
+def test_scaffold_k1_control_update_closed_form(small_fl):
+    """Algebraic oracle with NONZERO controls: for K = 1 full-batch,
+    y = p - lr (g - ci + c)  and  ci' = ci - c + (p - y)/lr = g exactly —
+    the control update must return the raw gradient regardless of c/ci."""
+    from ddl25spring_tpu.fl import ScaffoldServer
+
+    cd, task = small_fl
+    sc = ScaffoldServer(task=task, lr=0.05, batch_size=-1,
+                        nr_local_epochs=1, client_data=cd,
+                        client_fraction=1.0, seed=10)
+    # seed nonzero controls
+    sc.c = jax.tree.map(
+        lambda l: 0.01 * jnp.ones_like(l), sc.c
+    )
+    sc.ci = jax.tree.map(
+        lambda l: 0.02 * jnp.ones_like(l), sc.ci
+    )
+    p0 = sc.params
+    ci0 = sc.ci
+    params, c, ci = sc.round_fn(p0, sc.c, sc.ci, sc.run_key, 0)
+    # ci' = g, independent of c/ci -> rerunning with zero controls must
+    # give the SAME ci' (gradient) even though params move differently
+    sc0 = ScaffoldServer(task=task, lr=0.05, batch_size=-1,
+                         nr_local_epochs=1, client_data=cd,
+                         client_fraction=1.0, seed=10)
+    _, _, ci_zero = sc0.round_fn(p0, sc0.c, sc0.ci, sc0.run_key, 0)
+    for a, b in zip(jax.tree.leaves(ci), jax.tree.leaves(ci_zero)):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-5
+    # c moved by (m/N) * mean(ci' - ci_old) with m = N
+    for c_l, ci_l, ci0_l in zip(jax.tree.leaves(c), jax.tree.leaves(ci),
+                                jax.tree.leaves(ci0)):
+        want = 0.01 + jnp.mean(ci_l - ci0_l, axis=0)
+        assert float(jnp.max(jnp.abs(c_l - want))) < 1e-6
+
+
+def test_scaffold_learns_and_fights_noniid_drift():
+    """SCAFFOLD on a pathological 2-shard non-IID split (the homework A3
+    regime): converges, and with multiple local epochs (where FedAvg's
+    client drift bites hardest) reaches at least FedAvg's accuracy at the
+    same budget.  Deterministic under the fixed seed."""
+    from ddl25spring_tpu.fl import ScaffoldServer
+
+    ds = load_mnist(n_train=2000, n_test=500)
+    cd = split_dataset(ds.train_x, ds.train_y, nr_clients=10, iid=False,
+                       seed=10, pad_multiple=50)
+    task = mnist_task(ds.test_x, ds.test_y)
+    kw = dict(task=task, lr=0.05, batch_size=50, client_data=cd,
+              client_fraction=0.5, nr_local_epochs=2, seed=10)
+    res_sc = ScaffoldServer(**kw).run(4)
+    res_avg = FedAvgServer(**kw).run(4)
+    assert res_sc.test_accuracy[-1] > 30.0  # learns on non-IID
+    assert res_sc.test_accuracy[-1] >= res_avg.test_accuracy[-1] - 2.0
+
+
+def test_scaffold_extra_state_roundtrip(small_fl):
+    from ddl25spring_tpu.fl import ScaffoldServer
+
+    cd, task = small_fl
+    kw = dict(task=task, lr=0.05, batch_size=50, client_data=cd,
+              client_fraction=0.5, nr_local_epochs=1, seed=10)
+    a = ScaffoldServer(**kw)
+    a.run(1)
+    b = ScaffoldServer(**kw)
+    b.params = a.params
+    b.restore_extra_state(a.extra_state())
+    # resumed server continues the exact trajectory
+    a.run(1, start_round=1)
+    b.run(1, start_round=1)
+    for u, v in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        assert float(jnp.max(jnp.abs(u - v))) == 0.0
