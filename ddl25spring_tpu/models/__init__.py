@@ -13,7 +13,7 @@ from .llama import (
     split_stage_layers,
     full_params_to_stage_params,
 )
-from .generate import generate, sequence_logprobs
+from .generate import generate, precompute_prefix, sequence_logprobs
 from .distill import distill_draft
 from .lora import (
     LoRADense,
@@ -26,6 +26,7 @@ from .quant import QuantDense, quantize_llama_params
 
 __all__ = [
     "generate",
+    "precompute_prefix",
     "sequence_logprobs",
     "speculative_generate",
     "distill_draft",

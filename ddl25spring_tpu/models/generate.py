@@ -39,6 +39,7 @@ def generate(
     key: jax.Array | None = None,
     prompt_lengths: jax.Array | None = None,
     eos_id: int | None = None,
+    prefix: tuple | None = None,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -69,8 +70,17 @@ def generate(
 
     The model's ``ctx_size`` bounds the total length; the rotary embedding is
     position-exact because every step passes its global position explicitly.
+
+    ``prefix`` — the result of :func:`precompute_prefix` — serves a batch
+    whose every row continues the SAME cached prompt prefix (system prompt,
+    few-shot header): the prefix KV is computed once, broadcast into cache
+    slots ``[0, P)``, and each row's prompt prefills after it.  Output rows
+    contain only ``prompt + continuation`` (the prefix tokens are not
+    repeated).  Oracle: identical tokens to generating from the
+    concatenated ``[prefix + prompt]`` (tests/test_llama.py).
     """
     B, T0 = prompt.shape
+    prefix_cache, prefix_len = prefix if prefix is not None else (None, 0)
     if max_new_tokens == 0:
         if prompt_lengths is None:
             return prompt
@@ -78,10 +88,10 @@ def generate(
         # to generate
         return _left_align(prompt, T0, prompt_lengths)[0]
     total = T0 + max_new_tokens
-    if total > config.ctx_size:
+    if prefix_len + total > config.ctx_size:
         raise ValueError(
-            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"ctx_size ({config.ctx_size})"
+            f"prefix ({prefix_len}) + prompt ({T0}) + max_new_tokens "
+            f"({max_new_tokens}) exceeds ctx_size ({config.ctx_size})"
         )
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
@@ -102,11 +112,12 @@ def generate(
         top_k, top_p = 0, 1.0
     decode = _decode_fn(config, T0, total, float(temperature), int(top_k),
                         float(top_p),
-                        -1 if eos_id is None else int(eos_id))
+                        -1 if eos_id is None else int(eos_id),
+                        int(prefix_len))
     if prompt_lengths is None:
-        return decode(params, prompt, key)
+        return decode(params, prompt, key, None, prefix_cache)
     prompt_left, pad = _left_align(prompt, T0, prompt_lengths)
-    return decode(params, prompt_left, key, pad)
+    return decode(params, prompt_left, key, pad, prefix_cache)
 
 
 def _check_prompt_lengths(prompt_lengths, T0: int) -> None:
@@ -172,7 +183,8 @@ def _filter_logits(logits, top_k: int, top_p: float):
 
 @functools.lru_cache(maxsize=16)
 def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
-               top_k: int, top_p: float, eos_id: int = -1):
+               top_k: int, top_p: float, eos_id: int = -1,
+               prefix_len: int = 0):
     """Compiled prefill+scan decoder, cached on (config, shape, sampling
     params) so repeated ``generate`` calls with the same geometry reuse the
     jitted program instead of rebuilding a fresh closure (and recompiling)
@@ -185,12 +197,23 @@ def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
     ))
 
     @jax.jit
-    def decode(params, prompt, key, pad=None):
+    def decode(params, prompt, key, pad=None, prefix_cache=None):
         # prefill: score the whole prompt in one forward, populating the
         # cache; ragged rows are already left-aligned, so every row's
-        # next-token logits sit at the shared last slot
+        # next-token logits sit at the shared last slot.  With a shared
+        # prefix, its KV (computed once, precompute_prefix) broadcasts to
+        # every row's cache slots [0, P) and the prompt prefills after it.
+        variables = params
+        if prefix_len:
+            B = prompt.shape[0]
+            cache0 = jax.tree.map(
+                lambda l: jnp.broadcast_to(l, (B,) + l.shape[1:]),
+                prefix_cache,
+            )
+            variables = {**params, "cache": cache0}
         logits, state = model.apply(
-            params, prompt, jnp.arange(T0), pad, mutable=["cache"]
+            variables, prompt, prefix_len + jnp.arange(T0), pad, prefix_len,
+            mutable=["cache"],
         )
         cache = state["cache"]
 
@@ -212,7 +235,7 @@ def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
             cache, tok, done = carry
             logits, state = model.apply(
                 {**params, "cache": cache}, tok[:, None], i[None], pad,
-                mutable=["cache"],
+                prefix_len, mutable=["cache"],
             )
             nxt = pick(logits[:, -1], jax.random.fold_in(key, i))
             # rows past their EOS decode into pad (0); the EOS itself is
@@ -221,9 +244,11 @@ def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
             return (state["cache"], nxt, done | (nxt == eos_id)), tok
 
         # prefill already produced the first generated token, so the scan
-        # runs the remaining max_new_tokens - 1 steps
+        # runs the remaining max_new_tokens - 1 steps (slots offset past
+        # any cached prefix)
         (_, last, _), toks = jax.lax.scan(
-            step, (cache, first, done), jnp.arange(T0, total - 1)
+            step, (cache, first, done),
+            jnp.arange(prefix_len + T0, prefix_len + total - 1),
         )
         # toks holds the input token of each step: generated[0..n-2]; append
         # the final step's output to complete the n generated tokens
@@ -233,6 +258,46 @@ def _decode_fn(config: LlamaConfig, T0: int, total: int, temperature: float,
         return jnp.concatenate([prompt, gen], axis=1)
 
     return decode
+
+
+def precompute_prefix(config: LlamaConfig, params, prefix_tokens):
+    """Prefill a SHARED prompt prefix once; returns the ``prefix`` argument
+    for :func:`generate` — standard serving prefix caching (system prompts,
+    few-shot headers amortized across every request that reuses them).
+
+    ``prefix_tokens`` (P,) int32.  Returns ``(cache, P)`` where ``cache``
+    is the model's KV-cache pytree with leading batch dim 1 and slots
+    ``[0, P)`` filled; ``generate`` broadcasts it across its batch.  The
+    full fixed-size cache (ctx_size slots) is allocated here, so P can be
+    any length up to ``ctx_size - 1``.
+    """
+    prefix_tokens = jnp.asarray(prefix_tokens)
+    if prefix_tokens.ndim != 1:
+        raise ValueError(
+            f"prefix_tokens must be 1-D (shared prefix), got shape "
+            f"{prefix_tokens.shape}"
+        )
+    P = prefix_tokens.shape[0]
+    if not 1 <= P <= config.ctx_size - 1:
+        raise ValueError(
+            f"prefix length {P} not in [1, ctx_size - 1 = "
+            f"{config.ctx_size - 1}]"
+        )
+    _, state = _prefix_prefill_fn(config, P)(params, prefix_tokens[None])
+    return state["cache"], P
+
+
+@functools.lru_cache(maxsize=16)
+def _prefix_prefill_fn(config: LlamaConfig, P: int):
+    """Jitted prefix prefill, cached per (config, P) — same discipline as
+    ``_decode_fn``: a server rotating between a few system prompts must not
+    recompile the prefill every call."""
+    model = Llama(dataclasses.replace(
+        config, decode=True, attn_impl="dense", remat=False
+    ))
+    return jax.jit(
+        lambda p, t: model.apply(p, t, jnp.arange(P), mutable=["cache"])
+    )
 
 
 def sequence_logprobs(config: LlamaConfig, params, tokens,

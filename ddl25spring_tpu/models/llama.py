@@ -224,7 +224,7 @@ class Attention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, pad=None):
+    def __call__(self, x, positions, pad=None, prefix_len: int = 0):
         cfg = self.config
         B, T, _ = x.shape
         mk = _dense_cls(cfg)
@@ -250,7 +250,7 @@ class Attention(nn.Module):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if cfg.decode:
-            out = self._decode_attention(q, k, v, positions, pad)
+            out = self._decode_attention(q, k, v, positions, pad, prefix_len)
             out = out.reshape(B, T, cfg.dmodel)
             return dense("wo", cfg.dmodel)(out)
         # single-device training paths: expand KV heads to the query heads
@@ -285,7 +285,8 @@ class Attention(nn.Module):
         out = out.reshape(B, T, cfg.dmodel)
         return dense("wo", cfg.dmodel)(out)
 
-    def _decode_attention(self, q, k, v, positions, pad=None):
+    def _decode_attention(self, q, k, v, positions, pad=None,
+                          prefix_len: int = 0):
         """Attention against a fixed-size KV cache (``cache`` collection).
 
         The cache keeps static shape (B, ctx_size, Hkv, hd) — TPU-friendly:
@@ -372,7 +373,13 @@ class Attention(nn.Module):
             cv = self.variable("cache", "v", zeros)
             write(ck, k)
             write(cv, v)
-        if cfg.resolved_decode_impl() == "flash-decode" and T == 1:
+        # ragged + shared-prefix decode must take the einsum path: the
+        # Pallas kernel's pad mask hides slots [0, pad) — with a prefix the
+        # garbage actually sits at [prefix_len, prefix_len + pad), and the
+        # prefix slots below it are REAL (models/generate.py prefix cache)
+        flash_ok = pad is None or prefix_len == 0
+        if (cfg.resolved_decode_impl() == "flash-decode" and T == 1
+                and flash_ok):
             # Pallas kernel streams only the LIVE cache prefix (scalar-
             # prefetch-clamped DMA); prefill (T > 1) keeps the einsum
             # below.  Per-row positions pass as a (B,) pos vector — each
@@ -410,7 +417,12 @@ class Attention(nn.Module):
             visible = jnp.arange(S)[None, :] <= positions[:, None]  # (T, S)
             visible = visible[None, None, None]  # (1, 1, 1, T, S)
         if pad is not None:
-            real = jnp.arange(S)[None, :] >= pad[:, None]  # (B, S)
+            # garbage slots: the left-pad window, which begins AFTER any
+            # shared prefix (slots [0, prefix_len) hold real prefix KV)
+            slot = jnp.arange(S)[None, :]
+            real = slot >= prefix_len + pad[:, None]  # (B, S)
+            if prefix_len:
+                real = real | (slot < prefix_len)
             visible = visible & real[:, None, None, None, :]
         scores = jnp.where(visible, scores, -jnp.inf)
         att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -522,10 +534,11 @@ class Block(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, pad=None):
+    def __call__(self, x, positions, pad=None, prefix_len: int = 0):
         cfg = self.config
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions, pad
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions, pad,
+            prefix_len,
         )
         h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
         if cfg.nr_experts:
@@ -643,7 +656,8 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, pad=None):
+    def __call__(self, tokens, positions=None, pad=None,
+                 prefix_len: int = 0):
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.dmodel,
@@ -652,11 +666,13 @@ class Llama(nn.Module):
         )(tokens)
         # explicit positions support sequence sharding, where a device's
         # local block starts at a nonzero global offset (parallel/sp.py);
-        # ``pad`` (B,) supports ragged left-padded decode (models/generate)
+        # ``pad`` (B,) supports ragged left-padded decode (models/generate);
+        # ``prefix_len`` marks shared prefix-cache slots (generate.py
+        # precompute_prefix) that stay visible below the pad window
         pos = _positions(tokens.shape[1]) if positions is None else positions
         block = _block_cls(cfg)
         for i in range(cfg.nr_layers):
-            x = block(cfg, name=f"block{i}")(x, pos, pad)
+            x = block(cfg, name=f"block{i}")(x, pos, pad, prefix_len)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = _dense_cls(cfg)(cfg.vocab_size, "lm_head")(x)
         return logits.astype(jnp.float32)
