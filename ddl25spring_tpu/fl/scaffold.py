@@ -34,6 +34,8 @@ homework-scale experiments unless sharded over a mesh.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -116,7 +118,13 @@ def make_scaffold_round(
         )
         return params, ci_new
 
-    @jax.jit
+    # donate the stacked ci (arg 2): it is N x |params| (the module
+    # docstring's 11 GB at north-star scale) and only the sampled m rows
+    # change — donation lets XLA scatter in place instead of holding
+    # input+output copies.  Callers must not retain a reference to the
+    # ci they pass in (on TPU the buffer is invalidated; the server's
+    # self.ci reassignment pattern is safe, CPU ignores donation).
+    @functools.partial(jax.jit, donate_argnums=(2,))
     def _round(params, c, ci, base_key, round_idx, x, y, counts):
         # same key chain as engine.make_fl_round (sample_key = first of the
         # 4-way split; per-client key = fold_in(round_key, client_id)), so a
@@ -180,6 +188,8 @@ class ScaffoldServer(DecentralizedServer):
                          seed, mesh=mesh)
         self.algorithm = "SCAFFOLD"
         self.nr_local_epochs = nr_local_epochs
+        # FedAvg's 2 messages (weights down/up) + 2 control variates
+        self.messages_per_client = 4
         self.c = jax.tree.map(jnp.zeros_like, self.params)
         self.ci = jax.tree.map(
             lambda l: jnp.zeros((self.nr_clients,) + l.shape, l.dtype),
@@ -195,28 +205,16 @@ class ScaffoldServer(DecentralizedServer):
         return {"c": self.c, "ci": self.ci}
 
     def restore_extra_state(self, state) -> None:
-        self.c, self.ci = state["c"], state["ci"]
+        self.c = state["c"]
+        # private copy: the round DONATES its ci input, so adopting the
+        # caller's buffer would let a later round on the source server
+        # invalidate ours (checkpoint-restore and the state-roundtrip test
+        # both hand over live buffers)
+        self.ci = jax.tree.map(jnp.array, state["ci"])
 
-    def run(self, nr_rounds: int, start_round: int = 0, on_round=None):
-        from time import perf_counter
-
-        from ..utils.metrics import RunResult
+    def _advance(self, r: int) -> None:
         from ..utils.platform import device_sync
 
-        result = RunResult(
-            self.algorithm, self.nr_clients, self.client_fraction,
-            self.batch_size, self.nr_local_epochs, self.lr, self.seed,
-        )
-        elapsed = 0.0
-        for r in range(start_round, start_round + nr_rounds):
-            t0 = perf_counter()
-            self.params, self.c, self.ci = device_sync(self.round_fn(
-                self.params, self.c, self.ci, self.run_key, r
-            ))
-            elapsed += perf_counter() - t0
-            result.record_round(
-                elapsed, 4 * (r + 1) * self.nr_clients_per_round, self.test()
-            )
-            if on_round is not None:
-                on_round(r, result)
-        return result
+        self.params, self.c, self.ci = device_sync(self.round_fn(
+            self.params, self.c, self.ci, self.run_key, r
+        ))

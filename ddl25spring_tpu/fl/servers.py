@@ -128,6 +128,18 @@ class DecentralizedServer(Server):
         self.round_fn = None  # set by subclass
         self.algorithm = "Decentralized"
         self.nr_local_epochs = 1
+        # messages each selected client exchanges per round (the reference's
+        # 2 = weights down + up, hfl_complete.py:309,387); stateful variants
+        # override (SCAFFOLD: +2 control variates)
+        self.messages_per_client = 2
+
+    def _advance(self, r: int) -> None:
+        """Execute round ``r`` and install its outputs — the ONE hook a
+        stateful server overrides (SCAFFOLD threads c/ci through here) so
+        every variant shares the timing/accounting loop below."""
+        self.params = device_sync(
+            self.round_fn(self.params, self.run_key, r)
+        )
 
     def run(self, nr_rounds: int, start_round: int = 0,
             on_round=None) -> RunResult:
@@ -143,12 +155,12 @@ class DecentralizedServer(Server):
         elapsed = 0.0
         for r in range(start_round, start_round + nr_rounds):
             t0 = perf_counter()
-            self.params = device_sync(
-                self.round_fn(self.params, self.run_key, r)
-            )
+            self._advance(r)
             elapsed += perf_counter() - t0
             result.record_round(
-                elapsed, 2 * (r + 1) * self.nr_clients_per_round, self.test()
+                elapsed,
+                self.messages_per_client * (r + 1) * self.nr_clients_per_round,
+                self.test(),
             )
             if on_round is not None:
                 on_round(r, result)

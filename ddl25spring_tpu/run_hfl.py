@@ -148,6 +148,13 @@ def build_server(cfg: HflConfig):
                 "or dropout_rate (the control-variate update assumes honest "
                 "full participation of the sampled set)"
             )
+        if cfg.dp_clip or cfg.dp_noise_mult or cfg.compress != "none":
+            raise ValueError(
+                "scaffold has no DP or compression path — rejecting rather "
+                "than silently dropping --dp-clip/--dp-noise-mult/--compress "
+                "(a run that LOOKS differentially private but isn't is "
+                "worse than an error)"
+            )
         from .fl import ScaffoldServer
 
         client_data = split_dataset(ds.train_x, ds.train_y, cfg.nr_clients,

@@ -589,7 +589,11 @@ def test_scaffold_k1_control_update_closed_form(small_fl):
         lambda l: 0.02 * jnp.ones_like(l), sc.ci
     )
     p0 = sc.params
-    ci0 = sc.ci
+    # host copy: the round DONATES the stacked ci buffer (in-place scatter
+    # on TPU), so a retained device reference would be invalidated there
+    import numpy as np
+
+    ci0 = jax.tree.map(np.asarray, sc.ci)
     params, c, ci = sc.round_fn(p0, sc.c, sc.ci, sc.run_key, 0)
     # ci' = g, independent of c/ci -> rerunning with zero controls must
     # give the SAME ci' (gradient) even though params move differently
