@@ -209,7 +209,10 @@ class ScaffoldServer(DecentralizedServer):
         # private copy: the round DONATES its ci input, so adopting the
         # caller's buffer would let a later round on the source server
         # invalidate ours (checkpoint-restore and the state-roundtrip test
-        # both hand over live buffers)
+        # both hand over live buffers).  Drop our own ci FIRST: at the
+        # 256-client ResNet scale it is ~11 GB, and holding old + restored
+        # + copy simultaneously would triple the transient footprint.
+        self.ci = None
         self.ci = jax.tree.map(jnp.array, state["ci"])
 
     def _advance(self, r: int) -> None:

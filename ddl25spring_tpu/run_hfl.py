@@ -96,6 +96,13 @@ def build_server(cfg: HflConfig):
             f"only; algorithm {cfg.algorithm!r} would silently train "
             "without privacy"
         )
+    if (cfg.compress != "none"
+            and cfg.algorithm not in ("fedsgd", "fedavg", "fedprox")):
+        raise ValueError(
+            "--compress is implemented for fedsgd/fedavg/fedprox only; "
+            f"algorithm {cfg.algorithm!r} would silently train with "
+            "uncompressed uplinks"
+        )
     # datasets ship as raw uint8 and are normalized on device inside the
     # jitted loss/score fns — 4x less host->device transfer, which matters
     # on the remote-tunnel TPU (data/mnist.py raw_dataset)
@@ -147,13 +154,6 @@ def build_server(cfg: HflConfig):
                 "scaffold does not combine with robust aggregators, attacks, "
                 "or dropout_rate (the control-variate update assumes honest "
                 "full participation of the sampled set)"
-            )
-        if cfg.dp_clip or cfg.dp_noise_mult or cfg.compress != "none":
-            raise ValueError(
-                "scaffold has no DP or compression path — rejecting rather "
-                "than silently dropping --dp-clip/--dp-noise-mult/--compress "
-                "(a run that LOOKS differentially private but isn't is "
-                "worse than an error)"
             )
         from .fl import ScaffoldServer
 

@@ -63,6 +63,33 @@ EOF
       --kv-heads 6 --speculative 4 \
       > results/generate_spec_tpu.txt 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) speculative bench done (exit $rc)" >> "$LOG"
+    # round-4 additions: measured chip peaks (the honest MFU/roofline
+    # denominators), the corrected LM MFU bench, and the im2col+remat A/B.
+    # tmp-then-install (the capture discipline of measure_r4_followup.sh):
+    # a wedged re-run must never truncate already-published evidence.
+    capture_r4() {  # capture_r4 <timeout_s> <dest> <cmd...>
+      local t=$1 dest=$2; shift 2
+      local tmp rc
+      tmp=$(mktemp)
+      timeout "$t" "$@" > "$tmp" 2>> "$LOG"
+      rc=$?
+      if [ -s "$tmp" ] && [ "$rc" -eq 0 ]; then
+        mv "$tmp" "$dest"
+      else
+        rm -f "$tmp"
+      fi
+      return $rc
+    }
+    capture_r4 1500 results/chip_peaks_tpu.json \
+      python tools/chip_peaks.py; rc=$?
+    echo "$(date +%H:%M:%S) chip peaks done (exit $rc)" >> "$LOG"
+    capture_r4 1200 results/lm_mfu_tpu.txt \
+      python examples/bench_lm_mfu.py; rc=$?
+    echo "$(date +%H:%M:%S) LM MFU done (exit $rc)" >> "$LOG"
+    capture_r4 1800 results/bench_tpu_im2col_remat.json \
+      python bench.py --deadline-s 900 --norm-impl lean \
+      --conv-impl im2col --remat; rc=$?
+    echo "$(date +%H:%M:%S) im2col+remat bench done (exit $rc)" >> "$LOG"
     nohup /root/repo/tools/tpu_watch.sh >/dev/null 2>&1 &
     echo "$(date +%H:%M:%S) sentinel finished" >> "$LOG"
     exit 0
