@@ -60,27 +60,34 @@ class _Slot:
 
 
 @functools.lru_cache(maxsize=8)
-def _programs(config: LlamaConfig, max_batch: int, prefill_width: int):
+def _programs(config: LlamaConfig, max_batch: int, prefill_width: int,
+              prefix_len: int = 0):
     # eos handling is entirely host-side (the scheduler), so it is NOT part
     # of the compiled programs or their cache key
     cfg = dataclasses.replace(config, decode=True)
     model = Llama(cfg)
     S = cfg.ctx_size
     W = prefill_width
+    P = prefix_len
 
     @jax.jit
-    def prefill(params, prompt_row, length):
+    def prefill(params, prompt_row, length, prefix_cache=None):
         """prompt_row (W,) right-padded; -> (cache_row_tree, first_token).
 
         The row is right-ALIGNED into the window (shift by W - length) so
         the last prompt token sits at slot W-1 and decode continues at W
-        for every request regardless of its length."""
+        for every request regardless of its length.  With a shared prefix
+        the window sits at cache slots [P, P+W) on top of the prefix row
+        cache (generate.precompute_prefix), and the returned row cache
+        carries BOTH — inserting it into the serving cache needs no
+        special prefix handling."""
         shift = W - length
         aligned = jnp.roll(prompt_row, shift)[None, :]  # (1, W)
         pad = shift[None]
+        variables = params if P == 0 else {**params, "cache": prefix_cache}
         logits, state = model.apply(
-            params, aligned, positions=jnp.arange(W),
-            pad=pad, mutable=["cache"],
+            variables, aligned, positions=P + jnp.arange(W),
+            pad=pad, prefix_len=P, mutable=["cache"],
         )
         # the last real token sits at slot W-1 (right-aligned), so its
         # logits row IS the next-token distribution
@@ -114,7 +121,8 @@ def _programs(config: LlamaConfig, max_batch: int, prefill_width: int):
             cache, tok, pos = carry
             logits, state = model.apply(
                 {**params, "cache": cache}, tok[:, None],
-                positions=pos[:, None], pad=pad, mutable=["cache"],
+                positions=pos[:, None], pad=pad, prefix_len=P,
+                mutable=["cache"],
             )
             nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
             return (state["cache"], nxt, pos + 1), nxt
@@ -146,14 +154,15 @@ class ContinuousBatcher:
     ``prefill_width`` is the static prompt window: prompts longer than it
     are rejected (pick the serving bucket for your traffic); shorter ones
     are left-padded for free.  ``config.ctx_size`` must cover
-    ``prefill_width + max_new_tokens + (decode_chunk - 1)`` — the chunk
-    tail are scratch writes a recycled slot overwrites, but they must land
-    inside the cache.
+    ``prefix_len + prefill_width + max_new_tokens + (decode_chunk - 1)``
+    (prefix_len = 0 without a shared prefix) — the chunk tail are scratch
+    writes a recycled slot overwrites, but they must land inside the
+    cache.
     """
 
     def __init__(self, config: LlamaConfig, params, *, max_batch: int = 8,
                  prefill_width: int = 64, eos_id: int | None = None,
-                 decode_chunk: int = 1):
+                 decode_chunk: int = 1, prefix: tuple | None = None):
         # ``params`` is the full variables dict ({"params": ...}), the same
         # contract as models.generate.generate / speculative_generate.
         # ``decode_chunk``: tokens per decode dispatch — admissions happen
@@ -172,8 +181,14 @@ class ContinuousBatcher:
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = decode_chunk
+        # shared-prefix serving (system prompt / few-shot header): the
+        # result of generate.precompute_prefix; every admission prefills
+        # on top of it and every slot decodes past it
+        self._prefix_cache, self.prefix_len = (
+            prefix if prefix is not None else (None, 0)
+        )
         self._prefill, self._insert, self._decode, empty = _programs(
-            config, max_batch, prefill_width
+            config, max_batch, prefill_width, self.prefix_len
         )
         self.cache = empty(params)
         self.pos = jnp.zeros((max_batch,), jnp.int32)
@@ -191,7 +206,9 @@ class ContinuousBatcher:
         prompt = jnp.asarray(prompt, jnp.int32)
         (L,) = prompt.shape
         row = jnp.zeros((self.prefill_width,), jnp.int32).at[:L].set(prompt)
-        row_cache, first, pad = self._prefill(self.params, row, L)
+        row_cache, first, pad = self._prefill(
+            self.params, row, L, self._prefix_cache
+        )
         self.cache = self._insert(self.cache, row_cache, s)
         first_i = int(first)
         sl = self.slots[s]
@@ -200,7 +217,7 @@ class ContinuousBatcher:
         sl.budget = max_new_tokens - 1
         sl.total = max_new_tokens
         sl.done_eos = first_i == self.eos_id
-        self.pos = self.pos.at[s].set(self.prefill_width)
+        self.pos = self.pos.at[s].set(self.prefix_len + self.prefill_width)
         self.pad = self.pad.at[s].set(int(pad))
         self.tokens = self.tokens.at[s].set(first_i)
         self.stats["admitted"] += 1
@@ -252,10 +269,12 @@ class ContinuousBatcher:
         # must stay inside the cache.  No decode dispatch runs at all when
         # every budget is zero, so nothing to charge then.
         overrun = (self.decode_chunk - 1) if worst > 0 else 0
-        if self.prefill_width + worst + overrun > self.config.ctx_size:
+        if (self.prefix_len + self.prefill_width + worst + overrun
+                > self.config.ctx_size):
             raise ValueError(
-                f"prefill_width + max_new_tokens + (decode_chunk - 1) "
-                f"({self.prefill_width}+{worst}+{overrun}) exceeds ctx_size "
+                f"prefix + prefill_width + max_new_tokens + "
+                f"(decode_chunk - 1) ({self.prefix_len}+{self.prefill_width}"
+                f"+{worst}+{overrun}) exceeds ctx_size "
                 f"({self.config.ctx_size})"
             )
         for i, r in enumerate(requests):

@@ -161,3 +161,31 @@ def test_chunked_decode_bit_exact(setup):
     chunked = ContinuousBatcher(CFG, params, max_batch=2, prefill_width=8,
                                 decode_chunk=4).run(prompts, budgets)
     assert base == chunked
+
+
+def test_prefix_cached_serving_matches_generate(setup):
+    """Shared-prefix continuous batching: every request continues the same
+    cached system prompt; outputs ≡ solo generate(prompt, prefix=...) per
+    request, through staggered admissions and slot recycling."""
+    from ddl25spring_tpu.models.generate import precompute_prefix
+
+    params = setup
+    rng = np.random.default_rng(11)
+    prefix = jnp.asarray(rng.integers(1, 97, size=10), jnp.int32)
+    pc = precompute_prefix(CFG, params, prefix)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (3, 6, 4, 7)]
+    max_new = 5
+    batcher = ContinuousBatcher(CFG, params, max_batch=2, prefill_width=8,
+                                prefix=pc)
+    served = batcher.run(prompts, max_new)
+    for i, prompt in enumerate(prompts):
+        p = jnp.asarray(prompt, jnp.int32)[None, :]
+        want = generate(CFG, params, p, max_new, prefix=pc)
+        want = [int(t) for t in np.asarray(want[0, p.shape[1]:])]
+        assert served[i] == want, f"request {i}"
+    assert batcher.stats["admitted"] == 4
+
+    # ctx accounting includes the prefix: 10 + 8 + 31 > 48 must reject
+    with pytest.raises(ValueError):
+        ContinuousBatcher(CFG, params, max_batch=2, prefill_width=8,
+                          prefix=pc).run([prompts[0]], 31)
