@@ -102,7 +102,8 @@ def main() -> int:
 
     for name in ("flash_tpu.txt", "flash_tpu_hd128.txt",
                  "generate_tpu.txt", "generate_flash_tpu.txt",
-                 "generate_spec_tpu.txt"):
+                 "generate_spec_tpu.txt", "serving_tpu.txt",
+                 "groupconv_formulations_tpu.txt", "prefix_cache_tpu.txt"):
         p = root / name
         if p.exists() and p.stat().st_size > 0:
             lines = [ln for ln in p.read_text().splitlines() if ln.strip()]
