@@ -186,8 +186,10 @@ def cost_breakdown(server) -> dict:
     # readers can bound the undercount: true scan flops = counted x steps.
     try:
         shard = server.client_data.x.shape[1]
+        # batch_size == -1 means full-batch (engine.run_local_sgd semantics)
+        bsz = shard if server.batch_size == -1 else server.batch_size
         keep["local_steps_counted_once"] = (
-            -(-shard // server.batch_size) * server.nr_local_epochs
+            -(-shard // bsz) * server.nr_local_epochs
         )
     except AttributeError:
         pass
@@ -229,36 +231,61 @@ def _chip_peaks() -> dict | None:
     return chip_peaks()
 
 
-def timed_rounds(server, nr_rounds: int, fused: bool = True) -> float:
-    """Rounds/sec over ``nr_rounds`` after a compile warmup round.
+def timed_rounds(server, nr_rounds: int, fused: bool = True,
+                 trials: int = 1) -> list[float]:
+    """Rounds/sec per trial over ``nr_rounds`` after a compile warmup round.
 
     ``fused`` runs all timed rounds as ONE jitted ``lax.fori_loop`` dispatch
     (engine round_fn.raw + .data keep the dataset as arguments, not HLO
     constants), so per-dispatch RPC latency over the remote tunnel doesn't
     pollute the measurement; ``fused=False`` keeps the one-dispatch-per-round
-    path for comparison (the gap IS the dispatch overhead)."""
+    path for comparison (the gap IS the dispatch overhead).
+
+    ``trials`` re-executes the same compiled program that many times (compile
+    once, time each execution) and returns all trial rates — single-shot
+    captures over the shared tunnel varied 25% between the builder's and the
+    driver's runs of the same config (round-4 ledger discrepancy); the median
+    of >=3 trials with the spread quoted is the driver-true number.
+
+    Later trials keep TRAINING the chained params (timing is param-value
+    independent), but ``server.params`` is left at the FIRST trial's output
+    so the post-bench accuracy eval means the same thing at any trial count:
+    accuracy after warmup + ``nr_rounds`` rounds, comparable across the
+    ledger and the CPU trend."""
     import jax
 
     rf = server.round_fn
     if fused and hasattr(rf, "raw"):
         compiled, params = _aot_fused_rounds(server, nr_rounds)
         _stamp("compile done; timing ...")
-        t0 = time.perf_counter()
-        params = compiled(params, server.run_key, *rf.data)
-        _sync(params)
-        server.params = params
-        return nr_rounds / (time.perf_counter() - t0)
+        rates, first_params = [], None
+        for t in range(trials):
+            t0 = time.perf_counter()
+            params = compiled(params, server.run_key, *rf.data)
+            _sync(params)
+            rates.append(nr_rounds / (time.perf_counter() - t0))
+            _stamp(f"trial {t + 1}/{trials}: {rates[-1]:.4f} rounds/sec")
+            if first_params is None:
+                first_params = params
+        server.params = first_params
+        return rates
 
     _stamp("warmup round (jit compile) ...")
     params = server.round_fn(server.params, server.run_key, 0)  # warmup/compile
     _sync(params)
     _stamp("warmup done; timing ...")
-    t0 = time.perf_counter()
-    for r in range(1, nr_rounds + 1):
-        params = server.round_fn(params, server.run_key, r)
-    _sync(params)
-    server.params = params
-    return nr_rounds / (time.perf_counter() - t0)
+    rates, first_params = [], None
+    for t in range(trials):
+        t0 = time.perf_counter()
+        for r in range(1, nr_rounds + 1):
+            params = server.round_fn(params, server.run_key, r)
+        _sync(params)
+        rates.append(nr_rounds / (time.perf_counter() - t0))
+        _stamp(f"trial {t + 1}/{trials}: {rates[-1]:.4f} rounds/sec")
+        if first_params is None:
+            first_params = params
+    server.params = first_params
+    return rates
 
 
 def measure_cpu_baseline():
@@ -427,6 +454,13 @@ def main():
     select_platform()
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--trials", type=int, default=3,
+                    help="re-execute the timed program this many times and "
+                         "report the MEDIAN rounds/sec with min/max spread; "
+                         "the compile dominates wall time so extra trials "
+                         "cost ~3.5 s each (round-4's 25%% ledger-vs-driver "
+                         "discrepancy came from comparing two single shots "
+                         "over the shared tunnel)")
     ap.add_argument("--norm-impl", default="lean", choices=["flax", "lean"],
                     help="GroupNorm implementation A/B (ops/norm.py). "
                          "Default lean since the round-4 hardware capture "
@@ -463,6 +497,10 @@ def main():
                          "exits 2 instead of hanging the driver; slow but "
                          "visibly progressing runs are unaffected")
     args = ap.parse_args()
+    if args.trials < 1:
+        # fail BEFORE any device work: a post-run crash would break the
+        # one-JSON-line driver contract after minutes of remote-TPU time
+        ap.error(f"--trials must be >= 1, got {args.trials}")
 
     if args.measure_cpu_baseline:
         measure_cpu_baseline()
@@ -501,12 +539,13 @@ def main():
         from ddl25spring_tpu.utils import profile_trace
 
         with profile_trace(args.profile):
-            rps = timed_rounds(server, args.rounds,
-                               fused=not args.no_fused)
+            rates = timed_rounds(server, args.rounds,
+                                 fused=not args.no_fused,
+                                 trials=args.trials)
         _stamp(f"profiler trace written to {args.profile}")
     else:
-        rps = timed_rounds(server, args.rounds,
-                           fused=not args.no_fused)
+        rates = timed_rounds(server, args.rounds,
+                             fused=not args.no_fused, trials=args.trials)
     _stamp("timed rounds done; evaluating ...")
     # the north star is rounds/sec AND final accuracy (BASELINE.md): report
     # test accuracy after the timed rounds (real CIFAR when available;
@@ -514,9 +553,19 @@ def main():
     final_acc = server.test()
     _stamp("eval done")
     _WATCHDOG.cancel()
+    import statistics
+
+    rps = statistics.median(rates)
+    spread_pct = (100.0 * (max(rates) - min(rates)) / rps) if rps else 0.0
+    # trial 1 of a freshly compiled program is consistently ~25% slower
+    # (one-time program-load / warm-path cost over the tunnel, ~0.9 s at
+    # bench scale) — the round-4 ledger-vs-driver discrepancy in one field
     _emit_json(rps, final_test_accuracy_pct=round(final_acc, 2),
                rounds_timed=args.rounds, norm_impl=args.norm_impl,
-               conv_impl=args.conv_impl, remat=args.remat)
+               conv_impl=args.conv_impl, remat=args.remat,
+               trials=[round(r, 4) for r in rates],
+               spread_pct=round(spread_pct, 2),
+               first_execution_rps=round(rates[0], 4))
 
 
 if __name__ == "__main__":

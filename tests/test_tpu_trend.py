@@ -1,0 +1,63 @@
+"""Regression gate over the committed TPU trend (VERDICT r4 #5).
+
+``tools/tpu_trend.py`` appends driver-true TPU measurements to
+``results/northstar_tpu_trend.jsonl``.  This test needs NO tunnel: it
+checks the committed file, so a build on a dark container still gates the
+last captured numbers.
+
+Per metric with >= 2 entries: the LATEST value must be >= 85% of the
+median of the prior entries (the >15%-regression tripwire the round-4
+3.90-vs-2.92 discrepancy showed was missing).  Median-of-priors, not
+best-of-priors: single captures over the shared tunnel legitimately vary
+10-25% (round-5 multi-trial finding), and gating on the best entry would
+flag that noise.  Metrics with a single entry are reported, not gated.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+TREND = (Path(__file__).resolve().parent.parent / "results"
+         / "northstar_tpu_trend.jsonl")
+# Higher-is-better metrics only; a new metric appears in the gate the
+# moment its second entry lands.
+REGRESSION_FRACTION = 0.85
+
+
+def _by_metric():
+    if not TREND.exists():
+        pytest.skip("no TPU trend recorded yet (tunnel never up?)")
+    groups: dict[str, list[float]] = {}
+    for line in TREND.read_text().splitlines():
+        if not line.strip():
+            continue
+        e = json.loads(line)
+        groups.setdefault(e["metric"], []).append(float(e["value"]))
+    if not groups:
+        pytest.skip("TPU trend file is empty")
+    return groups
+
+
+def test_trend_parses_and_positive():
+    for metric, values in _by_metric().items():
+        assert all(v > 0 for v in values), f"{metric}: non-positive entry"
+
+
+def test_latest_within_15pct_of_trend():
+    import statistics
+
+    failures = []
+    for metric, values in _by_metric().items():
+        if len(values) < 2:
+            continue  # first capture: nothing to gate against yet
+        latest, prior = values[-1], values[:-1]
+        baseline = statistics.median(prior)
+        if latest < REGRESSION_FRACTION * baseline:
+            failures.append(
+                f"{metric}: latest {latest:.4g} < {REGRESSION_FRACTION:.0%}"
+                f" of trend median {baseline:.4g} (prior: {prior})"
+            )
+    assert not failures, "TPU regression(s):\n" + "\n".join(failures)

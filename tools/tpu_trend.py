@@ -1,0 +1,149 @@
+"""Append TPU measurements to results/northstar_tpu_trend.jsonl (VERDICT r4 #5).
+
+Round 4's 3.90-vs-2.92 rounds/sec ledger/driver discrepancy survived
+because every TPU number was a one-shot capture that nothing re-checked.
+This tool turns capture artifacts into an append-only trend file, and
+``tests/test_tpu_trend.py`` gates the LATEST entry of each metric against
+the trend (>15% regression fails), so a silent slowdown — or a stale
+headline — can't recur.
+
+Usage (normally driven by tools/measure_when_up.sh after each capture):
+
+    python tools/tpu_trend.py --bench results/bench_tpu_lean_r5.json
+    python tools/tpu_trend.py --serving results/serving_tpu_r5.txt
+    python tools/tpu_trend.py --generate results/generate_tpu.txt
+    python tools/tpu_trend.py --spec-json results/spec_tpu_r5.json
+
+Each parser extracts the headline number(s) and appends
+``{date, git, metric, value, unit, ...}`` rows.  Rows are only appended
+when the source parses cleanly; a wedged capture appends nothing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+TREND = ROOT / "results" / "northstar_tpu_trend.jsonl"
+
+
+def _git_rev() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=ROOT,
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+def _stamp(rows: list[dict], *, git: str | None = None) -> None:
+    git = git or _git_rev()
+    date = datetime.date.today().isoformat()
+    with TREND.open("a") as fh:
+        for r in rows:
+            fh.write(json.dumps({"date": date, "git": git, **r}) + "\n")
+    for r in rows:
+        print(f"appended {r['metric']} = {r['value']}")
+
+
+def parse_bench(path: Path) -> list[dict]:
+    """bench.py JSON line -> north-star row (keyed by norm impl)."""
+    d = json.loads(path.read_text().strip().splitlines()[-1])
+    if not d.get("value"):
+        raise ValueError(f"{path}: value-0 capture (tunnel wedged)")
+    return [{
+        "metric": f"northstar_{d.get('norm_impl', 'flax')}_rounds_per_sec",
+        "value": d["value"],
+        "unit": "rounds/sec",
+        "spread_pct": d.get("spread_pct"),
+        "trials": len(d.get("trials", [])) or 1,
+    }]
+
+
+def parse_serving(path: Path) -> list[dict]:
+    """bench_serving JSON lines -> static + best fused/continuous rows."""
+    rows = []
+    best = None
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        d = json.loads(line)
+        if d.get("metric") != "serving_throughput":
+            continue
+        if best is None or d.get("fused_tok_s", 0) > best.get("fused_tok_s",
+                                                              0):
+            best = d
+    if best is None:
+        raise ValueError(f"{path}: no serving_throughput lines")
+    rows.append({"metric": "serving_static_tok_s",
+                 "value": best["static_tok_s"], "unit": "tok/s"})
+    if "fused_tok_s" in best:
+        rows.append({"metric": "serving_fused_tok_s",
+                     "value": best["fused_tok_s"], "unit": "tok/s",
+                     "decode_chunk": best.get("decode_chunk"),
+                     "vs_static": best.get("fused_speedup")})
+    return rows
+
+
+def parse_generate(path: Path) -> list[dict]:
+    """bench_generate table -> decode tok/s for the B=1 full-cache row."""
+    for line in path.read_text().splitlines():
+        parts = line.split()
+        # "  1   6   bflo   6.8   4.7   0.149   1713"
+        if len(parts) >= 7 and parts[0] == "1" and parts[2].startswith("bf"):
+            return [{"metric": "generate_b1_tok_s", "value": float(parts[6]),
+                     "unit": "tok/s"}]
+    raise ValueError(f"{path}: no B=1 bfloat row found")
+
+
+def parse_spec_json(path: Path) -> list[dict]:
+    """bench_speculative JSON line -> best speculative speedup row."""
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            d = json.loads(line)
+            if d.get("metric") == "speculative_decode":
+                return [{"metric": "speculative_best_speedup",
+                         "value": d["best_speedup"], "unit": "x",
+                         "gamma": d["best_gamma"],
+                         "plain_tok_s": d.get("plain_tok_s")}]
+    raise ValueError(f"{path}: no speculative_decode line")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench", type=Path)
+    ap.add_argument("--serving", type=Path)
+    ap.add_argument("--generate", type=Path)
+    ap.add_argument("--spec-json", type=Path)
+    ap.add_argument("--git", default=None,
+                    help="override the recorded revision (for ingesting "
+                         "historical captures)")
+    args = ap.parse_args()
+    rows: list[dict] = []
+    for path, parser in ((args.bench, parse_bench),
+                         (args.serving, parse_serving),
+                         (args.generate, parse_generate),
+                         (args.spec_json, parse_spec_json)):
+        if path is None:
+            continue
+        try:
+            rows += parser(path)
+        except (ValueError, OSError, json.JSONDecodeError, IndexError) as e:
+            print(f"SKIP {path}: {e}", file=sys.stderr)
+    if not rows:
+        print("nothing to append", file=sys.stderr)
+        return 1
+    _stamp(rows, git=args.git)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
