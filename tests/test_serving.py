@@ -15,7 +15,7 @@ import pytest
 
 from ddl25spring_tpu.models.generate import generate
 from ddl25spring_tpu.models.llama import Llama, LlamaConfig
-from ddl25spring_tpu.models.serving import ContinuousBatcher
+from ddl25spring_tpu.models.serving import ContinuousBatcher, serve_fused
 
 CFG = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
                   nr_layers=2, ctx_size=48)
@@ -161,6 +161,80 @@ def test_chunked_decode_bit_exact(setup):
     chunked = ContinuousBatcher(CFG, params, max_batch=2, prefill_width=8,
                                 decode_chunk=4).run(prompts, budgets)
     assert base == chunked
+
+
+def test_fused_matches_generate_staggered(setup):
+    """One-dispatch serving: the on-device while_loop scheduler must emit
+    the same bits as solo generate() through admissions + recycling (5
+    requests, 2 slots), including heterogeneous budgets and chunking."""
+    params = setup
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 97, size=n).tolist()
+               for n in (3, 7, 4, 8, 5)]
+    budgets = [6, 9, 2, 5, 7]
+    for chunk in (1, 4):
+        served = serve_fused(CFG, params, prompts, budgets, max_batch=2,
+                             prefill_width=8, decode_chunk=chunk)
+        for i, (prompt, b) in enumerate(zip(prompts, budgets)):
+            assert served[i] == _oracle(params, prompt, b), \
+                f"request {i} chunk {chunk}"
+
+
+def test_fused_eos_and_zero_budgets(setup):
+    """Fused EOS handling runs ON DEVICE (budget zeroed at the EOS step,
+    zeros after) — must equal generate(eos_id=...) trimmed to the EOS;
+    zero-budget requests return []."""
+    params = setup
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (4, 6, 3)]
+    max_new = 8
+    outs = [_oracle(params, p, max_new) for p in prompts]
+    eos_id = next((c for c in range(97)
+                   if any(c in o for o in outs)
+                   and not all(c in o for o in outs)), None)
+    if eos_id is None:
+        pytest.skip("no token splits the oracle outputs at this seed")
+    served = serve_fused(CFG, params, prompts, max_new, max_batch=2,
+                         prefill_width=8, eos_id=eos_id)
+    for i, prompt in enumerate(prompts):
+        assert served[i] == _oracle_eos(params, prompt, max_new, eos_id), \
+            f"request {i}"
+    assert serve_fused(CFG, params, [prompts[0]], [0], max_batch=2,
+                       prefill_width=8) == [[]]
+
+
+def test_fused_matches_host_batcher(setup):
+    """The two schedulers implement one spec: host-streamed and fused
+    outputs must be identical on the same workload."""
+    params = setup
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (3, 6, 4, 7)]
+    budgets = [5, 8, 3, 6]
+    host = ContinuousBatcher(CFG, params, max_batch=2, prefill_width=8,
+                             decode_chunk=2).run(prompts, budgets)
+    fused = serve_fused(CFG, params, prompts, budgets, max_batch=2,
+                        prefill_width=8, decode_chunk=2)
+    assert host == fused
+
+
+def test_fused_prefix_cached(setup):
+    """Fused serving on top of a shared cached prefix: outputs ≡ solo
+    generate(prompt, prefix=...)."""
+    from ddl25spring_tpu.models.generate import precompute_prefix
+
+    params = setup
+    rng = np.random.default_rng(11)
+    prefix = jnp.asarray(rng.integers(1, 97, size=10), jnp.int32)
+    pc = precompute_prefix(CFG, params, prefix)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (3, 6, 4)]
+    max_new = 5
+    served = serve_fused(CFG, params, prompts, max_new, max_batch=2,
+                         prefill_width=8, prefix=pc)
+    for i, prompt in enumerate(prompts):
+        p = jnp.asarray(prompt, jnp.int32)[None, :]
+        want = generate(CFG, params, p, max_new, prefix=pc)
+        want = [int(t) for t in np.asarray(want[0, p.shape[1]:])]
+        assert served[i] == want, f"request {i}"
 
 
 def test_prefix_cached_serving_matches_generate(setup):

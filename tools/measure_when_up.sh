@@ -56,13 +56,37 @@ EOF
       --seq-lens 2048,8192 \
       > results/flash_tpu_hd128.txt 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) flash hd128 done (exit $rc)" >> "$LOG"
-    timeout 1200 python examples/bench_generate.py --int8 \
+    timeout 1200 python examples/bench_generate.py --int8 --kv-int8 \
       > results/generate_tpu.txt 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) generate bench done (exit $rc)" >> "$LOG"
     timeout 1200 python examples/bench_generate.py --batches 1 \
       --kv-heads 6 --speculative 4 \
       > results/generate_spec_tpu.txt 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) speculative bench done (exit $rc)" >> "$LOG"
+    # round-5 additions: the serving three-way (static / host-streamed /
+    # fused one-dispatch), the distilled-draft speculative grid, the int8
+    # KV long-context A/B, and the TPU trend gate rows (VERDICT r4 #5)
+    rc=0
+    ( for K in 8 16 32; do
+        timeout 1200 python examples/bench_serving.py --decode-chunk $K \
+          2>> "$LOG" || echo "SERVING-RUN-FAILED chunk=$K rc=$?" >> "$LOG"
+      done ) > results/serving_tpu.txt
+    grep -q SERVING-RUN-FAILED "$LOG" && rc=1
+    echo "$(date +%H:%M:%S) serving battery done (exit $rc)" >> "$LOG"
+    timeout 2400 python examples/bench_speculative.py \
+      > results/spec_distilled_tpu.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) distilled spec bench done (exit $rc)" >> "$LOG"
+    timeout 1800 python examples/bench_generate.py --batches 1 \
+      --kv-heads 6,1 --ctx 8192 --prompt 2048 --new-tokens 512 --kv-int8 \
+      > results/generate_kv8_long_tpu.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) int8-KV long-ctx bench done (exit $rc)" >> "$LOG"
+    python tools/tpu_trend.py \
+      --bench results/bench_tpu_lean.json \
+      --serving results/serving_tpu.txt \
+      --generate results/generate_tpu.txt \
+      --spec-json results/spec_distilled_tpu.txt >> "$LOG" 2>&1
+    python tools/tpu_trend.py --bench results/bench_tpu.json >> "$LOG" 2>&1
+    echo "$(date +%H:%M:%S) trend rows appended" >> "$LOG"
     # round-4 additions: measured chip peaks (the honest MFU/roofline
     # denominators), the corrected LM MFU bench, and the im2col+remat A/B.
     # tmp-then-install (the capture discipline of measure_r4_followup.sh):
