@@ -81,18 +81,20 @@ def generate(
     """
     B, T0 = prompt.shape
     prefix_cache, prefix_len = prefix if prefix is not None else (None, 0)
+    total = T0 + max_new_tokens
+    # ctx validation FIRST: an over-long prefix+prompt must stay loud even
+    # when there is nothing to generate (ADVICE r4)
+    if prefix_len + total > config.ctx_size:
+        raise ValueError(
+            f"prefix ({prefix_len}) + prompt ({T0}) + max_new_tokens "
+            f"({max_new_tokens}) exceeds ctx_size ({config.ctx_size})"
+        )
     if max_new_tokens == 0:
         if prompt_lengths is None:
             return prompt
         # honour the documented left-padded output layout even with nothing
         # to generate
         return _left_align(prompt, T0, prompt_lengths)[0]
-    total = T0 + max_new_tokens
-    if prefix_len + total > config.ctx_size:
-        raise ValueError(
-            f"prefix ({prefix_len}) + prompt ({T0}) + max_new_tokens "
-            f"({max_new_tokens}) exceeds ctx_size ({config.ctx_size})"
-        )
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0 and key is None:
@@ -110,6 +112,9 @@ def generate(
         # the cache key so greedy calls with different top_k/top_p settings
         # share one compiled program instead of fragmenting the LRU
         top_k, top_p = 0, 1.0
+    # pin 'auto' decode_impl from the params' actual device (not the
+    # process default) BEFORE the config becomes a jit cache key
+    config = config.with_resolved_decode_impl(params)
     decode = _decode_fn(config, T0, total, float(temperature), int(top_k),
                         float(top_p),
                         -1 if eos_id is None else int(eos_id),

@@ -30,6 +30,21 @@ from ..ops.attention import causal_attention, ring_causal_attention
 from .quant import QuantDense
 
 
+def params_backend(params) -> str | None:
+    """Platform of the first concrete array leaf in ``params`` (None when
+    every leaf is abstract — tracers under an outer jit, ShapeDtypeStructs
+    during AOT lowering — or on an empty tree)."""
+    for leaf in jax.tree.leaves(params):
+        devices = getattr(leaf, "devices", None)
+        if devices is None:
+            continue
+        try:
+            return next(iter(devices())).platform
+        except Exception:  # tracer .devices() raises ConcretizationTypeError
+            continue
+    return None
+
+
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 4096
@@ -126,12 +141,6 @@ class LlamaConfig:
                 "kv_cache_int8 is not yet wired into the seq-sharded "
                 "decode path; shard a float cache or serve unsharded"
             )
-        if self.kv_cache_int8 and self.decode_impl == "flash-decode":
-            raise ValueError(
-                "kv_cache_int8 requires decode_impl='xla' (the Pallas "
-                "flash-decode kernel reads a float cache); 'auto' "
-                "resolves to xla here"
-            )
         if self.moe_dispatch not in ("dense", "capacity"):
             raise ValueError(
                 f"moe_dispatch={self.moe_dispatch!r} not in ('dense', "
@@ -169,20 +178,32 @@ class LlamaConfig:
         """'auto' → flash-decode on TPU when eligible, xla otherwise.
 
         Eligibility mirrors the __post_init__ conflicts: the Pallas kernel
-        serves neither the seq-sharded distributed-merge path nor an int8
-        cache.  Resolution reads ``jax.default_backend()`` — the PROCESS
-        default, not whatever a computation happens to be staged for — so
-        two caveats: when AOT-lowering a decode program for a TPU topology
-        from a chip-less host, or jitting with a per-call ``backend=``
-        override, pass ``backend=`` here (or pin ``decode_impl``
-        explicitly) or 'auto' will resolve for the wrong device."""
+        does not serve the seq-sharded distributed-merge path.  Without a
+        ``backend`` this falls back to ``jax.default_backend()`` — the
+        PROCESS default, not whatever a computation happens to be staged
+        for; the decode entry points (generate / serving / speculative)
+        therefore resolve from their params' actual device via
+        :func:`params_backend` before building the model, so AOT-lowering
+        a TPU decode program from a CPU-backed host picks the right
+        kernel.  Only code that constructs models directly should need to
+        pass ``backend=`` (or pin ``decode_impl``) itself."""
         if self.decode_impl != "auto":
             return self.decode_impl
         backend = backend or jax.default_backend()
-        if (backend == "tpu" and self.decode_seq_shards == 1
-                and not self.kv_cache_int8):
+        if backend == "tpu" and self.decode_seq_shards == 1:
             return "flash-decode"
         return "xla"
+
+    def with_resolved_decode_impl(self, params) -> "LlamaConfig":
+        """Pin ``decode_impl`` from the device ``params`` actually live on
+        (falling back to the process default when the leaves are abstract
+        — e.g. under an outer trace).  Decode entry points call this once
+        so 'auto' can never resolve against the wrong backend deep inside
+        a traced model (ADVICE r4)."""
+        return dataclasses.replace(
+            self,
+            decode_impl=self.resolved_decode_impl(params_backend(params)),
+        )
 
 
 class RMSNorm(nn.Module):
@@ -358,15 +379,6 @@ class Attention(nn.Module):
             write(ck_s, ks)
             write(cv_q, vq)
             write(cv_s, vs)
-
-            class _Deq:  # minimal .value shim for the einsum below
-                def __init__(self, qv, sv):
-                    self.value = (
-                        qv.value.astype(q.dtype) * sv.value[..., None]
-                        .astype(q.dtype)
-                    )
-
-            ck, cv = _Deq(ck_q, ck_s), _Deq(cv_q, cv_s)
         else:
             zeros = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), q.dtype)
             ck = self.variable("cache", "k", zeros)
@@ -383,14 +395,33 @@ class Attention(nn.Module):
             # Pallas kernel streams only the LIVE cache prefix (scalar-
             # prefetch-clamped DMA); prefill (T > 1) keeps the einsum
             # below.  Per-row positions pass as a (B,) pos vector — each
-            # row's DMA clamp and masks use its own slot.
+            # row's DMA clamp and masks use its own slot.  An int8 cache
+            # streams quantized (4x less HBM traffic — the bandwidth win
+            # that motivates it) and dequantizes inside the kernel.
             from ..ops.flash_decode import flash_decode_attention
 
-            out = flash_decode_attention(
-                q[:, 0], ck.value, cv.value,
-                positions[:, 0] if per_row else positions[0], pad,
-            )
+            pos_arg = positions[:, 0] if per_row else positions[0]
+            if cfg.kv_cache_int8:
+                out = flash_decode_attention(
+                    q[:, 0], ck_q.value, cv_q.value, pos_arg, pad,
+                    cache_k_scale=ck_s.value, cache_v_scale=cv_s.value,
+                )
+            else:
+                out = flash_decode_attention(
+                    q[:, 0], ck.value, cv.value, pos_arg, pad,
+                )
             return out[:, None]  # (B, 1, H, hd)
+        if cfg.kv_cache_int8:
+            # einsum path: dequantize the whole cache up front (XLA fuses
+            # the multiply into the operand load)
+            class _Deq:  # minimal .value shim for the einsum below
+                def __init__(self, qv, sv):
+                    self.value = (
+                        qv.value.astype(q.dtype) * sv.value[..., None]
+                        .astype(q.dtype)
+                    )
+
+            ck, cv = _Deq(ck_q, ck_s), _Deq(cv_q, cv_s)
         # (B, T, Hkv, group, hd): query heads grouped by the KV head they share
         qg = q.reshape(B, T, Hkv, cfg.nr_heads // Hkv, cfg.head_dim)
         # scores in float32 BEFORE scaling, matching ops.attention's dense

@@ -187,6 +187,10 @@ def speculative_generate(
                else target_params)
     dparams = (draft_params["params"] if "params" in draft_params
                else draft_params)
+    # pin 'auto' decode_impl from the params' actual device before the
+    # configs become _spec_fn's lru_cache key (ADVICE r4)
+    target_config = target_config.with_resolved_decode_impl(tparams)
+    draft_config = draft_config.with_resolved_decode_impl(dparams)
 
     if prompt_lengths is None:
         prompt_left = prompt

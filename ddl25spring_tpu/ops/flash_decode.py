@@ -51,6 +51,26 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import NEG_INF, _pick_block
 
 
+def _head_update(h, q, k, v, valid, scale, m_scr, l_scr, acc):
+    """Online-softmax update for one KV head's (block_k) chunk — shared by
+    the float and int8 kernels so their attention math cannot drift."""
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    s = jnp.where(valid, s, NEG_INF)
+    # scratches are (Hkv, g_pad, 1) — Mosaic-native sublane x lane
+    # trailing layout; the zero-padded q rows just compute a uniform
+    # softmax over the valid keys (never NaN) and are sliced off by
+    # the caller
+    m_old = m_scr[h]
+    m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_old - m_new)
+    m_scr[h] = m_new
+    l_scr[h] = l_scr[h] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc[h] = acc[h] * corr + jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+
+
 def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
             *, block_k, scale, nr_k, nr_kv_heads):
     b = pl.program_id(0)
@@ -75,24 +95,45 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
         # Mosaic tiling rule always accepts; a (1, hd) head-sliced block is
         # rejected for Hkv > 1 (results/tpu_validate.txt, round 4).
         for h in range(nr_kv_heads):
-            q = q_ref[0, h]                # (g_pad, hd)
-            k = k_ref[0, :, h, :]          # (block_k, hd)
-            v = v_ref[0, :, h, :]
-            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-            s = jnp.where(valid, s, NEG_INF)
-            # scratches are (Hkv, g_pad, 1) — Mosaic-native sublane x lane
-            # trailing layout; the zero-padded q rows just compute a uniform
-            # softmax over the valid keys (never NaN) and are sliced off by
-            # the caller
-            m_old = m_scr[h]
-            m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            corr = jnp.exp(m_old - m_new)
-            m_scr[h] = m_new
-            l_scr[h] = l_scr[h] * corr + jnp.sum(p, axis=-1, keepdims=True)
-            acc[h] = acc[h] * corr + jnp.dot(
-                p.astype(v.dtype), v, preferred_element_type=jnp.float32
-            )
+            _head_update(h, q_ref[0, h], k_ref[0, :, h, :], v_ref[0, :, h, :],
+                         valid, scale, m_scr, l_scr, acc)
+
+    @pl.when(j == nr_k - 1)
+    def _final():
+        o_ref[0] = (acc[...] / l_scr[...]).astype(o_ref.dtype)
+
+
+def _kernel_int8(pos_ref, pad_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                 o_ref, m_scr, l_scr, acc, *, block_k, scale, nr_k,
+                 nr_kv_heads):
+    """int8-cache variant: K/V blocks arrive as int8 with per-(token, head)
+    scales (models/llama.py ``quant``) and dequantize IN VMEM — the HBM
+    stream, where decode's time actually goes, stays 4x smaller."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    pos = pos_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc[...] = jnp.zeros_like(acc)
+
+    @pl.when(j * block_k <= pos)
+    def _compute():
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        valid = (k_pos <= pos) & (k_pos >= pad_ref[b])
+        for h in range(nr_kv_heads):
+            q = q_ref[0, h]
+            # dequant exactly as the XLA path's _Deq: value * scale, in the
+            # compute dtype — bit-for-bit the same operand to the dot
+            k = (k_ref[0, :, h, :].astype(q.dtype)
+                 * ks_ref[0, :, h][:, None].astype(q.dtype))
+            v = (v_ref[0, :, h, :].astype(q.dtype)
+                 * vs_ref[0, :, h][:, None].astype(q.dtype))
+            _head_update(h, q, k, v, valid, scale, m_scr, l_scr, acc)
 
     @pl.when(j == nr_k - 1)
     def _final():
@@ -100,6 +141,7 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
 
 
 def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
+                           cache_k_scale=None, cache_v_scale=None,
                            interpret: bool | None = None):
     """One decode step against the cache, reading only live blocks.
 
@@ -110,10 +152,18 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     different rates; each row's DMA clamp and mask use its own value);
     rows ``<= pos`` are live.  ``pad``: (B,) left-pad widths for ragged
     batches (None = all zeros).  Returns (B, Hq, hd).
+
+    ``cache_k_scale``/``cache_v_scale`` (both or neither): (B, S, Hkv)
+    per-(token, head) scales for an int8 cache (models/llama.py
+    ``kv_cache_int8``) — blocks stream from HBM as int8 (4x less traffic)
+    and dequantize in VMEM right before the dot.
     """
     from .flash_attention import _resolve_interpret
 
     interpret = _resolve_interpret(interpret)
+    int8 = cache_k_scale is not None
+    if int8 != (cache_v_scale is not None):
+        raise ValueError("pass both cache scales or neither")
     B, Hq, hd = q.shape
     _, S, Hkv, _ = cache_k.shape
     g = Hq // Hkv
@@ -143,20 +193,30 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
         # index -> the pipeline skips the DMA
         return jnp.minimum(j, pos_v[b] // block_k)
 
+    kv_spec = pl.BlockSpec((1, block_k, Hkv, hd),
+                           lambda b, j, pos_v, pad_v:
+                           (b, live(b, j, pos_v), 0, 0))
+    scale_spec = pl.BlockSpec((1, block_k, Hkv),
+                              lambda b, j, pos_v, pad_v:
+                              (b, live(b, j, pos_v), 0))
+    in_specs = [
+        pl.BlockSpec((1, Hkv, g_pad, hd),
+                     lambda b, j, pos_v, pad_v: (b, 0, 0, 0)),
+    ]
+    operands = [qg]
+    if int8:
+        in_specs += [kv_spec, scale_spec, kv_spec, scale_spec]
+        operands += [cache_k, cache_k_scale, cache_v, cache_v_scale]
+        kernel = _kernel_int8
+    else:
+        in_specs += [kv_spec, kv_spec]
+        operands += [cache_k, cache_v]
+        kernel = _kernel
     # index maps receive (*grid_indices, *scalar_prefetch_refs)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, nr_k),
-        in_specs=[
-            pl.BlockSpec((1, Hkv, g_pad, hd),
-                         lambda b, j, pos_v, pad_v: (b, 0, 0, 0)),
-            pl.BlockSpec((1, block_k, Hkv, hd),
-                         lambda b, j, pos_v, pad_v:
-                         (b, live(b, j, pos_v), 0, 0)),
-            pl.BlockSpec((1, block_k, Hkv, hd),
-                         lambda b, j, pos_v, pad_v:
-                         (b, live(b, j, pos_v), 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Hkv, g_pad, hd),
                                lambda b, j, pos_v, pad_v: (b, 0, 0, 0)),
         scratch_shapes=[
@@ -166,10 +226,10 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, block_k=block_k, scale=scale, nr_k=nr_k,
+        functools.partial(kernel, block_k=block_k, scale=scale, nr_k=nr_k,
                           nr_kv_heads=Hkv),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, g_pad, hd), q.dtype),
         interpret=interpret,
-    )(pos, jnp.asarray(pad, jnp.int32), qg, cache_k, cache_v)
+    )(pos, jnp.asarray(pad, jnp.int32), *operands)
     return out[:, :, :g].reshape(B, Hq, hd)

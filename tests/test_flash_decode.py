@@ -125,6 +125,64 @@ def test_flash_decode_per_row_positions():
                                atol=1e-5)
 
 
+def _quant_ref(x):
+    """models/llama.py's per-(token, head) absmax int8 quantization."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    qv = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                  -127, 127).astype(jnp.int8)
+    return qv, scale.astype(jnp.float32)
+
+
+def test_flash_decode_int8_matches_dequantized_einsum():
+    """int8-cache kernel: streaming quantized blocks + in-VMEM dequant must
+    equal the XLA path's dequantize-then-einsum on the same quantized
+    cache (same _Deq math — value * scale in the compute dtype), across
+    GQA groupings and ragged pads."""
+    B, S, hd = 2, 64, 8
+    ks = jax.random.split(jax.random.key(11), 3)
+    for Hq, Hkv in ((4, 4), (4, 2), (4, 1)):
+        q = jax.random.normal(ks[0], (B, Hq, hd))
+        ck = jax.random.normal(ks[1], (B, S, Hkv, hd))
+        cv = jax.random.normal(ks[2], (B, S, Hkv, hd))
+        kq, kscale = _quant_ref(ck)
+        vq, vscale = _quant_ref(cv)
+        pad = jnp.asarray([0, 4])
+        for pos in (9, S - 1):
+            got = flash_decode_attention(
+                q, kq, vq, pos, pad,
+                cache_k_scale=kscale, cache_v_scale=vscale,
+            )
+            want = _xla_decode(
+                q, kq.astype(q.dtype) * kscale[..., None].astype(q.dtype),
+                vq.astype(q.dtype) * vscale[..., None].astype(q.dtype),
+                pos, pad,
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-5,
+                err_msg=f"Hq={Hq} Hkv={Hkv} pos={pos}",
+            )
+
+
+def test_generation_int8_flash_matches_int8_xla():
+    """End-to-end: kv_cache_int8 generation through the flash-decode
+    kernel must emit the same tokens as kv_cache_int8 through the XLA
+    einsum path (same quantized cache, same dequant math — the impl is
+    not allowed to change the numbers)."""
+    cfg = LlamaConfig(vocab_size=32, dmodel=32, nr_heads=4, nr_kv_heads=2,
+                      nr_layers=2, ctx_size=24, kv_cache_int8=True)
+    fcfg = dataclasses.replace(cfg, decode_impl="flash-decode")
+    xcfg = dataclasses.replace(cfg, decode_impl="xla")
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 1, 32)
+    params = Llama(dataclasses.replace(cfg, kv_cache_int8=False)).init(
+        jax.random.key(2), prompt, positions=jnp.arange(5)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(generate(xcfg, params, prompt, 8)),
+        np.asarray(generate(fcfg, params, prompt, 8)),
+    )
+
+
 def test_decode_impl_auto_resolution():
     """'auto' (the default since the round-4 hardware validation) resolves
     by backend and eligibility; explicit impls pass through untouched."""
@@ -144,9 +202,11 @@ def test_decode_impl_auto_resolution():
     assert dataclasses.replace(
         cfg, ctx_size=256, decode_seq_shards=2
     ).resolved_decode_impl() == "xla"
+    # int8 caches are ELIGIBLE since round 5 (the kernel dequantizes
+    # in-stream): auto treats them like any other cache
     assert dataclasses.replace(
         cfg, kv_cache_int8=True
-    ).resolved_decode_impl() == "xla"
+    ).resolved_decode_impl(backend="tpu") == "flash-decode"
     # explicit settings are never overridden
     assert dataclasses.replace(
         cfg, decode_impl="flash-decode"
