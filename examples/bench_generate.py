@@ -37,6 +37,12 @@ def main():
     ap.add_argument("--int8", action="store_true",
                     help="also measure each config with int8 matmul weights "
                          "(models/quant.py) — the weight-bandwidth A/B")
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="also measure each config with an int8 KV cache "
+                         "(llama.py kv_cache_int8; the flash-decode kernel "
+                         "streams quantized blocks, 4x less cache traffic) "
+                         "— the cache-bandwidth A/B; most visible at long "
+                         "--ctx/--new-tokens where the cache dominates")
     ap.add_argument("--decode-impl", default="auto",
                     choices=["auto", "xla", "flash-decode"],
                     help="flash-decode = Pallas kernel reading only live "
@@ -77,9 +83,10 @@ def main():
 
     def measure(cfg, params, B):
         prompt = jnp.ones((B, args.prompt), jnp.int32)
+        kv_itemsize = 1 if cfg.kv_cache_int8 else dt.dtype.itemsize
         cache_mb = (
             2 * B * args.ctx * cfg.kv_heads * cfg.head_dim
-            * args.layers * dt.dtype.itemsize / 2**20
+            * args.layers * kv_itemsize / 2**20
         )
         t0 = time.perf_counter()
         out = generate(cfg, params, prompt, args.new_tokens)
@@ -93,6 +100,8 @@ def main():
             best = min(best, time.perf_counter() - t0)
         toks = B * args.new_tokens / best
         wlabel = "int8" if cfg.weights_int8 else dt.__name__[:4]
+        if cfg.kv_cache_int8:
+            wlabel = "kv8"
         print(f"{B:>3} {cfg.kv_heads:>8} {wlabel:>7} {cache_mb:>8.1f} "
               f"{compile_s:>9.1f} {best:>8.3f} {toks:>8.0f}", flush=True)
 
@@ -112,6 +121,9 @@ def main():
             if args.int8:
                 measure(dataclasses.replace(cfg, weights_int8=True),
                         quantize_llama_params(params), B)
+            if args.kv_int8:
+                measure(dataclasses.replace(cfg, kv_cache_int8=True),
+                        params, B)
             if args.speculative:
                 from ddl25spring_tpu.models import speculative_generate
 

@@ -1,0 +1,152 @@
+"""Speculative decoding with a DISTILLED draft: the >1x demonstration.
+
+Round-4 measured speculative decoding only at its two degenerate corners —
+self-draft (acceptance 1.0 but draft == target, so no win by construction)
+and a random small draft (acceptance ~0) — and concluded "correct but never
+fast".  This bench closes the loop the way the capability is meant to be
+used (models/distill.py): distill a genuinely smaller draft from the
+target, then measure plain vs speculative decode across gamma with the
+measured acceptance rate.
+
+Speculation is a LATENCY play: it wins when a single-row decode step is
+dominated by the target's weight streaming, so the draft's gamma cheap
+steps + one target verify of gamma+1 positions beat gamma+1 target steps.
+The default target here (dmodel=1024, 12 layers) is weight-bound at B=1;
+`--small` runs the primer-size target (d=288) where fixed per-step
+overheads dominate and speculation SHOULD show ~no win — both regimes are
+recorded.
+
+Run: python examples/bench_speculative.py [--gammas 2,4,8] [--small]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dmodel", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=4096)
+    ap.add_argument("--draft-dmodel", type=int, default=256)
+    ap.add_argument("--draft-layers", type=int, default=3)
+    ap.add_argument("--small", action="store_true",
+                    help="primer-size target (d=288, 6 layers): the regime "
+                         "where per-step overhead dominates and speculation "
+                         "is expected NOT to win")
+    ap.add_argument("--prompt", type=int, default=32)
+    ap.add_argument("--new-tokens", type=int, default=256)
+    ap.add_argument("--gammas", default="2,4,8")
+    ap.add_argument("--distill-steps", type=int, default=300)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    from ddl25spring_tpu.utils.platform import select_platform
+
+    select_platform()
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models import Llama, LlamaConfig, generate
+    from ddl25spring_tpu.models.distill import distill_draft
+    from ddl25spring_tpu.models.speculative import speculative_generate
+    from ddl25spring_tpu.utils.platform import device_sync
+
+    if args.small:
+        args.dmodel, args.layers, args.heads = 288, 6, 6
+        args.draft_dmodel, args.draft_layers = 96, 2
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    gammas = [int(g) for g in args.gammas.split(",")]
+    ctx = args.prompt + args.new_tokens + max(gammas) + 8
+    tcfg = LlamaConfig(vocab_size=args.vocab, dmodel=args.dmodel,
+                       nr_heads=args.heads, nr_layers=args.layers,
+                       ctx_size=ctx, dtype=dt)
+    dcfg = LlamaConfig(vocab_size=args.vocab, dmodel=args.draft_dmodel,
+                       nr_heads=max(2, args.heads // 2),
+                       nr_layers=args.draft_layers, ctx_size=ctx, dtype=dt)
+    print(f"backend={jax.default_backend()} target d={args.dmodel} "
+          f"L={args.layers} | draft d={args.draft_dmodel} "
+          f"L={args.draft_layers} | new={args.new_tokens}", flush=True)
+
+    prompt = jnp.ones((1, args.prompt), jnp.int32)
+    params = Llama(tcfg).init(jax.random.key(0), prompt,
+                              positions=jnp.arange(args.prompt))
+
+    t0 = time.perf_counter()
+    dparams, losses = distill_draft(
+        tcfg, params, dcfg, steps=args.distill_steps, seq_l=64,
+        key=jax.random.key(7),
+    )
+    distill_s = time.perf_counter() - t0
+    print(f"distilled draft in {distill_s:.0f}s "
+          f"(loss {losses[0]:.3f} -> {losses[-1]:.3f})", flush=True)
+
+    def timed(fn):
+        out = fn()
+        device_sync(out)
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            out = fn()
+            device_sync(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    plain_s = timed(lambda: generate(tcfg, params, prompt, args.new_tokens))
+    plain_tok_s = args.new_tokens / plain_s
+    print(f"{'mode':>10} {'total s':>8} {'tok/s':>8} {'accept':>7} "
+          f"{'speedup':>8}")
+    print(f"{'plain':>10} {plain_s:>8.3f} {plain_tok_s:>8.0f} {'—':>7} "
+          f"{'1.00':>8}", flush=True)
+
+    rows = []
+    for g in gammas:
+        rate_box = {}
+
+        def spec():
+            out, rate = speculative_generate(
+                tcfg, params, dcfg, dparams, prompt, args.new_tokens,
+                gamma=g,
+            )
+            rate_box["rate"] = float(rate)
+            return out
+
+        spec_s = timed(spec)
+        tok_s = args.new_tokens / spec_s
+        speedup = plain_s / spec_s
+        rows.append({"gamma": g, "tok_s": round(tok_s, 1),
+                     "acceptance": round(rate_box["rate"], 3),
+                     "speedup": round(speedup, 3)})
+        print(f"{'spec g=' + str(g):>10} {spec_s:>8.3f} {tok_s:>8.0f} "
+              f"{rate_box['rate']:>7.2f} {speedup:>8.2f}", flush=True)
+
+    best = max(rows, key=lambda r: r["speedup"])
+    print(json.dumps({
+        "metric": "speculative_decode",
+        "backend": jax.default_backend(),
+        "target_dmodel": args.dmodel, "target_layers": args.layers,
+        "draft_dmodel": args.draft_dmodel, "draft_layers": args.draft_layers,
+        "distill_steps": args.distill_steps,
+        "plain_tok_s": round(plain_tok_s, 1),
+        "gammas": rows,
+        "best_speedup": best["speedup"],
+        "best_gamma": best["gamma"],
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
