@@ -38,6 +38,7 @@ def small_fl():
     return cd, task
 
 
+@pytest.mark.slow
 def test_fedprox_mu_zero_is_exactly_fedavg(small_fl):
     cd, task = small_fl
     kw = dict(task=task, lr=0.05, batch_size=50, client_data=cd,
@@ -61,6 +62,7 @@ def test_fedprox_converges_and_damps_drift(small_fl):
     assert res.test_accuracy != res0.test_accuracy
 
 
+@pytest.mark.slow
 def test_fedopt_sgd_lr1_equals_fedavg(small_fl):
     """FedOpt with a plain SGD(1.0) server optimizer applies
     w - 1.0 * (w - w_avg) = w_avg — exactly FedAvg's overwrite."""
@@ -147,6 +149,7 @@ def test_fedopt_extra_state_roundtrip(small_fl):
                      ).restore_extra_state(saved_extra)
 
 
+@pytest.mark.slow
 def test_all_clients_dropped_falls_back_to_keeping_all(small_fl):
     cd, task = small_fl
     kw = dict(task=task, lr=0.05, batch_size=50, client_data=cd,
@@ -273,6 +276,7 @@ def test_compressed_dp_rejects_unknown_method():
                                       method="fp4")
 
 
+@pytest.mark.slow
 def test_fedbuff_window1_equals_fedavg_round():
     """With staleness_window=1 and server_eta=1, a FedBuff tick IS a
     synchronous FedAvg round: same sampled clients, same client keys, same
@@ -492,6 +496,7 @@ def test_fl_compress_topk_full_ratio_is_exact(small_fl):
     )
 
 
+@pytest.mark.slow
 def test_fl_compress_learns(small_fl):
     """Sparsified (1% top-k) and int8-quantized uplinks still train: test
     accuracy improves over the initial model for both FedAvg (delta space)
@@ -610,6 +615,7 @@ def test_scaffold_k1_control_update_closed_form(small_fl):
         assert float(jnp.max(jnp.abs(c_l - want))) < 1e-6
 
 
+@pytest.mark.slow
 def test_scaffold_learns_and_fights_noniid_drift():
     """SCAFFOLD on a pathological 2-shard non-IID split (the homework A3
     regime): converges, and with multiple local epochs (where FedAvg's

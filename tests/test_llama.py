@@ -11,6 +11,7 @@ import chex
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ddl25spring_tpu.data import ByteTokenizer, TokenStream
 from ddl25spring_tpu.models import (
@@ -282,6 +283,7 @@ def test_gqa_param_shapes_and_training():
         LlamaConfig(vocab_size=64, dmodel=48, nr_heads=6, nr_kv_heads=4)
 
 
+@pytest.mark.slow
 def test_gqa_generate_matches_full_forward():
     """The grouped-einsum KV cache decodes exactly like iterated full
     forwards under GQA (same oracle as the MHA decode test)."""

@@ -1,3 +1,4 @@
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -119,6 +120,7 @@ def test_resnet_norm_impls_share_params():
     assert out.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_im2col_conv_matches_flax_conv():
     """ops/conv.py oracle: the im2col+einsum ResNet is value- AND
     gradient-equal to the nn.Conv one on the IDENTICAL param tree (the

@@ -340,6 +340,7 @@ def test_llama_capacity_dispatch_end_to_end():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_moe_all_to_all_gradients_match_replicated():
     """The a2a path must be TRAINABLE: grads through two all_to_alls +
     capacity routing (w.r.t. x, router, and expert weights) equal the

@@ -159,6 +159,7 @@ def test_zigzag_permutation_roundtrip():
         zigzag_permutation(12, 4)
 
 
+@pytest.mark.slow
 def test_zigzag_ring_matches_dense():
     """Zigzag ring output, un-permuted, equals dense causal attention in
     true order — forward and grads."""
@@ -229,6 +230,7 @@ def test_sp_zigzag_train_step_matches_single_device():
         np.testing.assert_allclose(a, b, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_sp_rings_with_gqa_match_single_device():
     """GQA through both Pallas rings: KV blocks ride the ring at kv_heads
     size (expanded per block inside the op), and the step still equals the

@@ -203,6 +203,7 @@ def test_alie_attack_properties():
     np.testing.assert_allclose(w[0], mu + 1.5 * sigma, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_end_to_end_alie_collusive_path():
     """The engine's collusive-attack branch end-to-end: ALIE at 2/8
     malicious trains through FedSGD with and without Krum; the defended

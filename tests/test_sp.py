@@ -53,6 +53,7 @@ def test_ring_attention_matches_dense():
     assert jnp.allclose(out_ring, out_dense, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grads_match_dense():
     mesh = make_mesh({"seq": 4})
     B, T, H, D = 1, 16, 2, 8
