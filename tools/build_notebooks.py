@@ -1,0 +1,418 @@
+"""Generate the teaching notebooks (notebooks/*.ipynb).
+
+The reference delivers its course content as notebooks
+(lab/tutorial_1a/horizontal-federated-learning.ipynb, lab/homework-1.ipynb,
+lab/homework-2.ipynb, lab/tutorial_2b/lab-vfl.ipynb,
+lab/tutorial_1b/DP/gradient_aggr/intro_DP_GA_notebook.ipynb) — simultaneous
+documentation, scaffold, and driver.  This repo's executable surface is
+scripts + tests (examples/, run_*.py), and these notebooks are generated
+TWINS of the teaching arc: every cell runs against the public API with
+small CPU-sized configs, and the heavyweight batteries are linked rather
+than inlined.
+
+Regenerate with  python tools/build_notebooks.py  (deterministic output:
+notebooks are emitted clean — no outputs, no execution counts — which is
+also what tools/clean_notebooks.py enforces).  The execution oracle is
+tests/test_notebooks.py: structure in the default tier, full in-process
+cell execution under DDL25_NB_SMOKE=1 in the slow tier.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import nbformat
+
+ROOT = Path(__file__).resolve().parent.parent
+# DDL25_NB_OUT overrides the output dir (tests regenerate into a scratch
+# dir and compare bytes against the committed notebooks)
+OUT = Path(os.environ.get("DDL25_NB_OUT", ROOT / "notebooks"))
+
+SETUP = '''\
+# Environment: run everything on a virtual 8-device CPU mesh (the repo's
+# test harness layout) so the parallelism cells work on any machine; on a
+# real TPU host, drop the overrides.  DDL25_NB_SMOKE=1 shrinks workloads
+# to seconds (the notebook execution test uses it).
+import os, sys
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.getcwd()))  # repo root when run from notebooks/
+import jax
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass  # backend already initialised (re-run of this cell)
+SMOKE = os.environ.get("DDL25_NB_SMOKE") == "1"
+print("devices:", jax.devices())'''
+
+
+def nb(title_md: str, cells: list[tuple[str, str]]):
+    """cells: list of ("md"|"code", source)."""
+    book = nbformat.v4.new_notebook()
+    book.metadata = {"kernelspec": {"display_name": "Python 3",
+                                    "language": "python",
+                                    "name": "python3"},
+                     "language_info": {"name": "python"}}
+    book.cells = [nbformat.v4.new_markdown_cell(title_md)]
+    for kind, src in cells:
+        book.cells.append(
+            nbformat.v4.new_markdown_cell(src) if kind == "md"
+            else nbformat.v4.new_code_cell(src)
+        )
+    for i, cell in enumerate(book.cells):
+        cell["id"] = f"cell-{i}"  # deterministic across regenerations
+    return book
+
+
+def hfl():
+    return nb(
+        "# Horizontal federated learning\n\n"
+        "Twin of the reference's `tutorial_1a/horizontal-federated-"
+        "learning.ipynb` + `homework-1.ipynb` teaching arc, on this "
+        "framework's TPU-first engine: one jitted SPMD program runs a "
+        "whole FedAvg round (client sampling, E local epochs, weighted "
+        "aggregation) instead of a sequential Python loop over clients.\n\n"
+        "The full homework battery with reference-shaped outputs lives in "
+        "`examples/homework1.py`; the engine oracles in "
+        "`tests/test_fl.py`.",
+        [
+            ("code", SETUP),
+            ("md",
+             "## Data: IID and 2-shard non-IID client splits\n\n"
+             "`split_dataset` reproduces the reference's exact shard "
+             "construction (sort-by-label → 2 shards per client) — the "
+             "non-IID degradation in A3 depends on it.  MNIST falls back "
+             "to a deterministic synthetic set in zero-egress "
+             "environments (a loud banner says so)."),
+            ("code",
+             "import numpy as np\n"
+             "from ddl25spring_tpu.data import load_mnist, split_dataset\n"
+             "ds = load_mnist()\n"
+             "iid = split_dataset(ds.train_x, ds.train_y, nr_clients=20,\n"
+             "                    iid=True, seed=10)\n"
+             "noniid = split_dataset(ds.train_x, ds.train_y, nr_clients=20,\n"
+             "                       iid=False, seed=10)\n"
+             "def labels_held(split, c):\n"
+             "    y = np.asarray(split.y[c][:split.counts[c]])\n"
+             "    return sorted(set(int(v) for v in y))\n"
+             "print('client 0 labels, IID    :', labels_held(iid, 0))\n"
+             "print('client 0 labels, non-IID:', labels_held(noniid, 0))"),
+            ("md",
+             "## Centralized vs FedSGD vs FedAvg\n\n"
+             "The three reference algorithms through one engine "
+             "(`fl/servers.py`).  FedSGD's gradient and weight forms are "
+             "EXACTLY equal at E=1 full-batch (the A1 oracle); FedAvg "
+             "trades rounds for local epochs."),
+            ("code",
+             "from ddl25spring_tpu.configs import HflConfig\n"
+             "from ddl25spring_tpu.run_hfl import run\n"
+             "# SMOKE: 2 sampled clients x 2 rounds so the execution test\n"
+             "# stays in seconds; the real walkthrough uses 20 x 10\n"
+             "rounds = 2 if SMOKE else 10\n"
+             "N, C = (50, 0.04) if SMOKE else (20, 0.25)\n"
+             "algos = (['fedsgd', 'fedavg'] if SMOKE else\n"
+             "         ['centralized', 'fedsgd', 'fedavg'])\n"
+             "results = {}\n"
+             "for algo in algos:\n"
+             "    r = run(HflConfig(algorithm=algo, nr_clients=N,\n"
+             "                      client_fraction=C, nr_rounds=rounds,\n"
+             "                      batch_size=50, lr=0.05, seed=10))\n"
+             "    results[algo] = r.test_accuracy\n"
+             "    print(f'{algo:12s} final acc {r.test_accuracy[-1]:.4f}')"),
+            ("code",
+             "import matplotlib\n"
+             "matplotlib.use('Agg')\n"
+             "import matplotlib.pyplot as plt\n"
+             "for algo, accs in results.items():\n"
+             "    plt.plot(range(1, len(accs) + 1), accs, label=algo)\n"
+             "plt.xlabel('round'); plt.ylabel('test accuracy')\n"
+             "plt.legend(); plt.title('HFL algorithms')\n"
+             "plt.savefig('hfl_algorithms.png', dpi=80)\n"
+             "print('saved hfl_algorithms.png')"),
+            ("md",
+             "## Non-IID degradation (homework A3)\n\n"
+             "The 2-shard split starves each client of 8 of 10 classes; "
+             "FedAvg still learns, slower — the ordering the reference's "
+             "table pins."),
+            ("code",
+             "# IID was measured above; only the non-IID run is new work\n"
+             "non_r = run(HflConfig(algorithm='fedavg', nr_clients=N,\n"
+             "                      client_fraction=C, nr_rounds=rounds,\n"
+             "                      batch_size=50, lr=0.05, iid=False))\n"
+             "print('IID     final acc', round(results['fedavg'][-1], 4))\n"
+             "print('non-IID final acc', round(non_r.test_accuracy[-1], 4))"),
+            ("md",
+             "## Beyond the reference\n\n"
+             "The same config surface reaches FedProx, FedOpt (server "
+             "Adam/Yogi), FedBuff (async staleness), SCAFFOLD (control "
+             "variates), DP-FedAvg (clip+noise with an (ε, δ) report), "
+             "uplink compression, client dropout, and Byzantine-robust "
+             "aggregation — see `HflConfig` and `examples/homework1.py "
+             "--help`."),
+        ],
+    )
+
+
+def vfl():
+    return nb(
+        "# Vertical federated learning\n\n"
+        "Twin of `tutorial_2b/lab-vfl.ipynb` + `homework-2.ipynb`: "
+        "split-NN over feature-partitioned parties on the real heart "
+        "dataset, the exercise-1 feature permutations, the exercise-2 "
+        "party sweep, and the split VFL-VAE.  Full battery: "
+        "`examples/homework2.py`; oracles: `tests/test_vfl*.py`.",
+        [
+            ("code", SETUP),
+            ("md",
+             "## Split-NN classification (exercise structure)\n\n"
+             "Each party embeds its feature slice; the server "
+             "concatenates embeddings and classifies.  `sharded=True` "
+             "runs parties SPMD over a `party` mesh axis — the cut "
+             "crossing becomes an all-gather on the mesh, the TPU-native "
+             "answer to the reference's process-per-party layout."),
+            ("code",
+             "from ddl25spring_tpu.configs import VflConfig\n"
+             "from ddl25spring_tpu.run_vfl import run\n"
+             "epochs = 15 if SMOKE else 120\n"
+             "acc = run(VflConfig(mode='classify', nr_clients=4,\n"
+             "                    epochs=epochs))\n"
+             "print(f'4-party split-NN held-out accuracy: {acc:.3f}')"),
+            ("md",
+             "## Exercise 1-2: permuted features, 2-8 parties\n\n"
+             "`permutation_seed` shuffles which features land on which "
+             "party (exercise 1); `nr_clients` sweeps the partition "
+             "arity with balanced remainders (exercise 2)."),
+            ("code",
+             "for parties in ([2] if SMOKE else [2, 4, 6, 8]):\n"
+             "    acc = run(VflConfig(mode='classify', nr_clients=parties,\n"
+             "                        epochs=epochs, permutation_seed=1))\n"
+             "    print(f'{parties} parties, permuted features -> "
+             "acc {acc:.3f}')"),
+            ("md",
+             "## Split VFL-VAE (exercise 3)\n\n"
+             "Two cuts (encoder and decoder sides), combined "
+             "reconstruction+KL loss across the parties."),
+            ("code",
+             "loss = run(VflConfig(mode='vae', nr_clients=4,\n"
+             "                     epochs=25 if SMOKE else 200))\n"
+             "print(f'VFL-VAE final combined loss: {loss:.1f}')"),
+        ],
+    )
+
+
+def generative():
+    return nb(
+        "# Generative modeling: tabular VAE + TSTR\n\n"
+        "Twin of the reference's `generative-modeling` teaching arc: "
+        "train a tabular VAE on heart data, sample synthetic patients "
+        "from the aggregated posterior, and score them with "
+        "Train-on-Synthetic-Test-on-Real.  Oracles: "
+        "`tests/test_vfl_gen.py`.",
+        [
+            ("code", SETUP),
+            ("code",
+             "import numpy as np\n"
+             "from ddl25spring_tpu.data.heart import load_heart_classification\n"
+             "from ddl25spring_tpu.gen.vae_trainer import (\n"
+             "    encode_posterior, sample_synthetic, train_vae, tstr)\n"
+             "heart = load_heart_classification()\n"
+             "# the VAE models features AND label as one table (reference\n"
+             "# generative-modeling.py:156-159)\n"
+             "table = np.concatenate(\n"
+             "    [heart.x, heart.y[:, None].astype(np.float32)], axis=1)\n"
+             "split = int(0.8 * len(table))\n"
+             "epochs = 30 if SMOKE else 200\n"
+             "model, variables, losses = train_vae(table[:split],\n"
+             "                                     epochs=epochs, seed=42)\n"
+             "print(f'VAE loss {losses[0]:.1f} -> {losses[-1]:.1f}')"),
+            ("md",
+             "## Aggregated-posterior sampling\n\n"
+             "Instead of decoding N(0, I) draws, sampling fits the "
+             "aggregated posterior of the training set — the reference's "
+             "trick for tabular fidelity (its ``Autoencoder.sample``)."),
+            ("code",
+             "mu, logvar = encode_posterior(model, variables, table[:split])\n"
+             "synth = sample_synthetic(model, variables, mu, logvar,\n"
+             "                         split, seed=1)\n"
+             "print('synthetic table shape', synth.shape)\n"
+             "print('real mean[:4]  ', np.round(table[:split].mean(0)[:4], 3))\n"
+             "print('synth mean[:4] ', np.round(np.asarray(synth).mean(0)[:4], 3))"),
+            ("md",
+             "## TSTR: the honest generative metric\n\n"
+             "Train a classifier on synthetic, test on real; compare "
+             "with train-on-real."),
+            ("code",
+             "acc_real, acc_synth = tstr(\n"
+             "    real_x=table[:split, :-1], real_y=heart.y[:split],\n"
+             "    test_x=table[split:, :-1], test_y=heart.y[split:],\n"
+             "    synth_x=np.asarray(synth)[:, :-1],\n"
+             "    synth_y=np.asarray(synth)[:, -1].astype(np.int32),\n"
+             "    epochs=20 if SMOKE else 49,\n"
+             ")\n"
+             "print(f'train-on-real  test acc {acc_real:.3f}')\n"
+             "print(f'train-on-synth test acc {acc_synth:.3f}')"),
+        ],
+    )
+
+
+def distributed():
+    return nb(
+        "# Distributed LLM training: DP, PP, 1F1B, TP, SP on one mesh\n\n"
+        "Twin of the `tutorial_1b` family (DP gradient/weight "
+        "aggregation, naive + microbatched PP, 1F1B) plus the "
+        "parallelisms the reference lacks (TP, sequence-parallel ring "
+        "attention, MoE EP).  Every strategy is ONE jitted SPMD program "
+        "over a `jax.sharding.Mesh` — collectives are compiler-inserted, "
+        "not hand-written NCCL.  Equivalence oracles: "
+        "`tests/test_parallel.py`, `tests/test_pp_1f1b.py`, "
+        "`tests/test_sp.py`.",
+        [
+            ("code", SETUP),
+            ("md",
+             "## A strategy sweep on the 8-device mesh\n\n"
+             "Same tiny model and token stream per strategy; losses fall "
+             "comparably because the math is equivalent (the oracle "
+             "tests pin exact equality where it holds — e.g. GPipe "
+             "grads == full batch, 1F1B == GPipe)."),
+            ("code",
+             "from ddl25spring_tpu.configs import LmConfig\n"
+             "from ddl25spring_tpu.run_lm import run\n"
+             "iters = 3 if SMOKE else 12\n"
+             "base = dict(dmodel=32, nr_heads=2, nr_layers=4, seq_l=32,\n"
+             "            batch_size=8, nr_iters=iters, lr=3e-3,\n"
+             "            nr_microbatches=4)\n"
+             "for strategy in (['single', 'dp'] if SMOKE else\n"
+             "                 ['single', 'dp', 'pp', '1f1b', 'tp', 'sp']):\n"
+             "    losses = run(LmConfig(strategy=strategy, **base),\n"
+             "                 log_every=max(iters, 1))\n"
+             "    print(f'{strategy:7s} loss {losses[0]:.3f} -> '\n"
+             "          f'{losses[-1]:.3f}')"),
+            ("md",
+             "## What each strategy shards\n\n"
+             "- **dp**: batch over `data` axis; grads all-reduce "
+             "(`psum`).  `dp-zero` adds optimizer-state sharding; "
+             "`dp-topk` / `dp-int8` compress the uplink.\n"
+             "- **pp / 1f1b / 1f1b-int**: layer stages over a `stage` "
+             "axis; microbatches pipeline via `ppermute`; 1F1B bounds "
+             "live activations, interleaving adds virtual stages.\n"
+             "- **tp**: Megatron-style column/row sharding of attention "
+             "and MLP matmuls.\n"
+             "- **sp**: sequence-parallel ring attention "
+             "(`ops/ring_flash.py`: Pallas flash kernels inside the "
+             "ring; `sp_zigzag=True` load-balances the causal "
+             "triangle).\n"
+             "- **ep**: mixture-of-experts with capacity-based "
+             "all-to-all dispatch.\n\n"
+             "Mixes compose (`dp-pp`), and `__graft_entry__."
+             "dryrun_multichip` exercises all of them on a virtual "
+             "mesh."),
+            ("md",
+             "## DP privacy accounting (the DP notebook's arc)\n\n"
+             "The reference's DP teaching uses gradient aggregation; "
+             "here DP-FedAvg adds clipping + Gaussian noise with RDP "
+             "accounting (`fl/privacy.py`)."),
+            ("code",
+             "from ddl25spring_tpu.fl import dp_epsilon\n"
+             "eps = dp_epsilon(noise_mult=1.1, q=0.1, rounds=100,\n"
+             "                 delta=1e-5)\n"
+             "print(f'(eps, delta) = ({eps:.2f}, 1e-5) after 100 rounds')"),
+        ],
+    )
+
+
+def serving():
+    return nb(
+        "# Serving and inference: generation, prefix cache, speculative, "
+        "continuous batching\n\n"
+        "The reference never decodes its LMs; this framework treats "
+        "serving as a first-class surface.  Everything below is "
+        "bit-exactness-tested against plain `generate()` "
+        "(`tests/test_serving.py`, `tests/test_speculative.py`).",
+        [
+            ("code", SETUP),
+            ("code",
+             "import jax, jax.numpy as jnp, numpy as np\n"
+             "from ddl25spring_tpu.models import Llama, LlamaConfig, generate\n"
+             "cfg = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4,\n"
+             "                  nr_kv_heads=2, nr_layers=2, ctx_size=96)\n"
+             "params = Llama(cfg).init(jax.random.PRNGKey(0),\n"
+             "                         jnp.ones((1, 4), jnp.int32),\n"
+             "                         positions=jnp.arange(4))\n"
+             "prompt = jnp.asarray([[5, 9, 2, 7]], jnp.int32)\n"
+             "out = generate(cfg, params, prompt, 12)\n"
+             "print('greedy :', np.asarray(out)[0].tolist())\n"
+             "out = generate(cfg, params, prompt, 12, temperature=0.8,\n"
+             "               top_p=0.9, key=jax.random.key(1))\n"
+             "print('sampled:', np.asarray(out)[0].tolist())"),
+            ("md",
+             "## Prefix caching\n\n"
+             "A shared system prompt's KV is computed once "
+             "(`precompute_prefix`) and every request decodes on top of "
+             "it."),
+            ("code",
+             "from ddl25spring_tpu.models.generate import precompute_prefix\n"
+             "prefix = jnp.asarray([3, 1, 4, 1, 5, 9, 2, 6], jnp.int32)\n"
+             "pc = precompute_prefix(cfg, params, prefix)\n"
+             "out = generate(cfg, params, prompt, 8, prefix=pc)\n"
+             "print('with cached prefix:', np.asarray(out)[0].tolist())"),
+            ("md",
+             "## Speculative decoding\n\n"
+             "Draft proposes γ tokens, target verifies in one forward; "
+             "greedy output is bit-identical to plain decode for ANY "
+             "draft.  (Self-draft below demonstrates the harness; a "
+             "distilled smaller draft — `models/distill.py`, "
+             "`examples/bench_speculative.py` — is what makes it "
+             "fast.)"),
+            ("code",
+             "from ddl25spring_tpu.models import speculative_generate\n"
+             "sp, rate = speculative_generate(cfg, params, cfg, params,\n"
+             "                                prompt, 12, gamma=3)\n"
+             "plain = generate(cfg, params, prompt, 12)\n"
+             "assert np.array_equal(np.asarray(sp), np.asarray(plain))\n"
+             "print('speculative == plain, acceptance', float(rate))"),
+            ("md",
+             "## Continuous batching: streaming and fused\n\n"
+             "`ContinuousBatcher` streams requests through fixed slots "
+             "(host scheduler, static compiled programs); `serve_fused` "
+             "compiles the ENTIRE admit/decode/recycle schedule into one "
+             "device program — 4.0x static batching on the remote-TPU "
+             "benchmark (`docs/BENCHMARKS.md`, round 5)."),
+            ("code",
+             "from ddl25spring_tpu.models.serving import (\n"
+             "    ContinuousBatcher, serve_fused)\n"
+             "rng = np.random.default_rng(0)\n"
+             "prompts = [rng.integers(1, 97, size=int(n)).tolist()\n"
+             "           for n in rng.integers(2, 8, size=6)]\n"
+             "budgets = [int(b) for b in rng.integers(3, 10, size=6)]\n"
+             "host = ContinuousBatcher(cfg, params, max_batch=2,\n"
+             "                         prefill_width=8).run(prompts, budgets)\n"
+             "fused = serve_fused(cfg, params, prompts, budgets,\n"
+             "                    max_batch=2, prefill_width=8)\n"
+             "assert host == fused\n"
+             "print('host-streamed == fused for', len(prompts), 'requests')"),
+        ],
+    )
+
+
+BOOKS = {
+    "horizontal-federated-learning.ipynb": hfl,
+    "vertical-federated-learning.ipynb": vfl,
+    "generative-modeling.ipynb": generative,
+    "distributed-llm-training.ipynb": distributed,
+    "serving-and-inference.ipynb": serving,
+}
+
+
+def main() -> int:
+    OUT.mkdir(parents=True, exist_ok=True)
+    for name, build in BOOKS.items():
+        book = build()
+        nbformat.validate(book)
+        nbformat.write(book, OUT / name)
+        print(f"wrote notebooks/{name} ({len(book.cells)} cells)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

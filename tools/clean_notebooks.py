@@ -1,0 +1,70 @@
+"""Notebook hygiene: strip outputs, execution counts, and volatile metadata.
+
+Equivalent of the reference's ``lab/clear-metadata-notebooks.py`` (keep
+notebooks diffable and free of stale outputs), for the generated teaching
+notebooks in ``notebooks/``.  Also usable as a check (--check exits 1 if
+any notebook is dirty) — tests/test_notebooks.py keeps that invariant in
+the default test tier.
+
+Usage: python tools/clean_notebooks.py [--check] [paths...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import nbformat
+
+ROOT = Path(__file__).resolve().parent.parent
+KEEP_METADATA = {"kernelspec", "language_info"}
+
+
+def clean(book) -> bool:
+    """Scrub in place; returns True if anything changed."""
+    changed = False
+    for extra in set(book.metadata) - KEEP_METADATA:
+        del book.metadata[extra]
+        changed = True
+    for cell in book.cells:
+        if cell.get("cell_type") == "code":
+            if cell.get("outputs"):
+                cell["outputs"] = []
+                changed = True
+            if cell.get("execution_count") is not None:
+                cell["execution_count"] = None
+                changed = True
+        if cell.get("metadata"):
+            cell["metadata"] = {}
+            changed = True
+    return changed
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="*", type=Path,
+                    default=sorted((ROOT / "notebooks").glob("*.ipynb")))
+    ap.add_argument("--check", action="store_true",
+                    help="report dirty notebooks and exit 1 instead of "
+                         "rewriting them")
+    args = ap.parse_args()
+    dirty = []
+    for path in args.paths:
+        book = nbformat.read(path, as_version=4)
+        if clean(book):
+            dirty.append(path)
+            if not args.check:
+                nbformat.write(book, path)
+                print(f"cleaned {path}")
+    if args.check and dirty:
+        print("dirty notebooks (run tools/clean_notebooks.py):",
+              *map(str, dirty), sep="\n  ", file=sys.stderr)
+        return 1
+    if not dirty:
+        print("all notebooks clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
