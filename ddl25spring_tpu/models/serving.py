@@ -72,7 +72,9 @@ from .llama import Llama, LlamaConfig
 
 @dataclass
 class _Slot:
-    request_id: int = -1
+    # run() keys requests by position (int); the streaming interface by
+    # user-provided hashable rid — None is the only "free" sentinel
+    request_id: object = None
     # EOS mode: host ints, appended as chunks are fetched.  Budget mode:
     # (device_array, index, count) refs, resolved in ONE fetch at the end.
     emitted: list = field(default_factory=list)
@@ -82,7 +84,7 @@ class _Slot:
 
     @property
     def free(self) -> bool:
-        return self.request_id < 0
+        return self.request_id is None
 
 
 def _right_aligned_prefill(model, W: int, P: int, params, prompt_row,
@@ -300,6 +302,9 @@ class ContinuousBatcher:
         self.pad = jnp.zeros((max_batch,), jnp.int32)
         self.tokens = jnp.zeros((max_batch,), jnp.int32)
         self.slots = [_Slot() for _ in range(max_batch)]
+        # streaming interface state (submit/step/drain)
+        self._queue: list = []
+        self._instant: dict = {}  # zero-budget submissions, returned next step
         # serving telemetry: how full the batch ran, admissions, steps
         self.stats = {"decode_steps": 0, "slot_steps": 0, "active_steps": 0,
                       "admitted": 0}
@@ -385,6 +390,12 @@ class ContinuousBatcher:
         home turf: a slot whose request finishes early is refilled
         immediately.  Each output has its request's budget length,
         EOS-padded like ``generate``."""
+        if self.in_flight:
+            raise RuntimeError(
+                "run() on a batcher with streaming requests in flight: "
+                "drain() first (run() owns all slots and indexes requests "
+                "by position)"
+            )
         if isinstance(max_new_tokens, (int, np.integer)):
             budgets = [int(max_new_tokens)] * len(requests)
         else:
@@ -413,45 +424,21 @@ class ContinuousBatcher:
         # alone — stream every dispatch without ever blocking and resolve
         # the recorded refs in one fetch at the end.
         eos_mode = self.eos_id >= 0
+        pending = [(rid, prompt, budgets[rid]) for rid, prompt in pending]
         while len(finished) < len(requests):
-            free = [s for s, sl in enumerate(self.slots) if sl.free]
-            group = []
-            while pending and free:
-                rid, prompt = pending.pop(0)
-                group.append((free.pop(0), rid, prompt, budgets[rid]))
+            group = self._admit_from(pending)
             if group:
                 firsts = self._admit_group(group)
                 if eos_mode:
-                    firsts_h = np.asarray(firsts)  # one fetch per group
-                    for g, (s, _rid, _p, _b) in enumerate(group):
-                        sl = self.slots[s]
-                        first_i = int(firsts_h[g])
-                        sl.emitted = [first_i]
-                        sl.done_eos = first_i == self.eos_id
+                    self._sync_admit_bookkeep(group, firsts)
             self._harvest(finished, resolve=eos_mode)
             active = [s for s, sl in enumerate(self.slots) if not sl.free]
             if not active:
                 continue
             K = self.decode_chunk
-            self.cache, toks, self.pos, self.tokens = self._decode(
-                self.params, self.cache, self.tokens, self.pos, self.pad,
-                nr=K,
-            )
-            self.stats["decode_steps"] += K
-            self.stats["slot_steps"] += self.max_batch * K
+            toks = self._dispatch_chunk()
             if eos_mode:
-                toks_host = jax.device_get(toks)
-                for s in active:
-                    sl = self.slots[s]
-                    for j in range(K):
-                        if sl.budget <= 0 or sl.done_eos:
-                            break
-                        self.stats["active_steps"] += 1
-                        tok = int(toks_host[s, j])
-                        sl.emitted.append(tok)
-                        sl.budget -= 1
-                        if tok == self.eos_id:
-                            sl.done_eos = True
+                self._sync_chunk_bookkeep(active, toks)
             else:
                 for s in active:
                     sl = self.slots[s]
@@ -467,6 +454,113 @@ class ContinuousBatcher:
                 if refs:
                     finished[rid] = self._resolve(refs, fetched)
         return [finished[i] for i in range(len(requests))]
+
+    def _dispatch_chunk(self):
+        """One decode_chunk dispatch over all slots; updates cache/pos/
+        tokens and the step telemetry, returns the (B, K) token array.
+        Shared by run() and the streaming step()."""
+        K = self.decode_chunk
+        self.cache, toks, self.pos, self.tokens = self._decode(
+            self.params, self.cache, self.tokens, self.pos, self.pad,
+            nr=K,
+        )
+        self.stats["decode_steps"] += K
+        self.stats["slot_steps"] += self.max_batch * K
+        return toks
+
+    def _admit_from(self, pending: list) -> list:
+        """Pop requests off ``pending`` into free slots; returns the
+        admission group handed to _admit_group (empty if none)."""
+        free = [s for s, sl in enumerate(self.slots) if sl.free]
+        group = []
+        while pending and free:
+            rid, prompt, budget = pending.pop(0)
+            group.append((free.pop(0), rid, prompt, budget))
+        return group
+
+    def _sync_admit_bookkeep(self, group, firsts):
+        """Fetch an admission group's first tokens (one round trip per
+        group) and install host-int bookkeeping — the synchronous
+        discipline EOS mode and the streaming interface share."""
+        firsts_h = np.asarray(firsts)
+        for g, (s, _rid, _p, _b) in enumerate(group):
+            sl = self.slots[s]
+            first_i = int(firsts_h[g])
+            sl.emitted = [first_i]
+            sl.done_eos = self.eos_id >= 0 and first_i == self.eos_id
+
+    def _sync_chunk_bookkeep(self, active, toks):
+        """Fetch one decode chunk's tokens and append them to each active
+        slot up to its budget / EOS (host-int bookkeeping)."""
+        toks_host = jax.device_get(toks)
+        for s in active:
+            sl = self.slots[s]
+            for j in range(toks_host.shape[1]):
+                if sl.budget <= 0 or sl.done_eos:
+                    break
+                self.stats["active_steps"] += 1
+                tok = int(toks_host[s, j])
+                sl.emitted.append(tok)
+                sl.budget -= 1
+                if tok == self.eos_id:
+                    sl.done_eos = True
+
+    # -- streaming interface (requests arrive over time) ------------------
+
+    @property
+    def in_flight(self) -> int:
+        """Requests submitted but not yet returned by step()/drain()."""
+        active = sum(1 for sl in self.slots if not sl.free)
+        return len(self._queue) + len(self._instant) + active
+
+    def submit(self, rid, prompt, max_new_tokens: int) -> None:
+        """Enqueue one request under key ``rid`` (any hashable, unique
+        among in-flight requests); it joins the running batch at the next
+        ``step()`` with a free slot.  Zero budgets resolve to ``[]`` at
+        the next step."""
+        if (rid in self._instant
+                or any(q[0] == rid for q in self._queue)
+                or any(sl.request_id == rid for sl in self.slots
+                       if not sl.free)):
+            raise ValueError(f"request id {rid!r} already in flight")
+        budget = int(max_new_tokens)
+        _validate_workload(
+            [prompt], [budget], prefill_width=self.prefill_width,
+            prefix_len=self.prefix_len, decode_chunk=self.decode_chunk,
+            ctx_size=self.config.ctx_size,
+        )
+        if budget == 0:
+            self._instant[rid] = []
+            return
+        self._queue.append((rid, list(prompt), budget))
+
+    def step(self) -> dict:
+        """Admit queued requests into free slots, decode ONE chunk, and
+        return ``{rid: tokens}`` for every request that finished.
+
+        The streaming discipline is synchronous (one token fetch per
+        chunk — the minimum latency path); a workload known up front is
+        faster through ``run()`` (pipelined dispatch) or ``serve_fused``
+        (one program)."""
+        finished: dict = dict(self._instant)
+        self._instant.clear()
+        group = self._admit_from(self._queue)
+        if group:
+            self._sync_admit_bookkeep(group, self._admit_group(group))
+        self._harvest(finished, resolve=True)
+        active = [s for s, sl in enumerate(self.slots) if not sl.free]
+        if active:
+            self._sync_chunk_bookkeep(active, self._dispatch_chunk())
+            self._harvest(finished, resolve=True)
+        return finished
+
+    def drain(self) -> dict:
+        """step() until every in-flight request has finished; returns all
+        their outputs."""
+        out: dict = {}
+        while self.in_flight:
+            out.update(self.step())
+        return out
 
 
 # -- fully fused serving: the whole workload in ONE dispatch ---------------

@@ -237,6 +237,68 @@ def test_fused_prefix_cached(setup):
         assert served[i] == want, f"request {i}"
 
 
+def test_streaming_submit_step_matches_generate(setup):
+    """The streaming interface (submit/step/drain): requests submitted
+    MID-FLIGHT — while earlier ones are half-decoded — must still emit
+    solo-generate() bits; zero budgets resolve to []; duplicate in-flight
+    ids are rejected; run() refuses while streaming is active."""
+    params = setup
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(1, 97, size=n).tolist()
+               for n in (3, 7, 4, 6, 5)]
+    budgets = [6, 9, 4, 7, 5]
+    b = ContinuousBatcher(CFG, params, max_batch=2, prefill_width=8,
+                          decode_chunk=2)
+    b.submit("a", prompts[0], budgets[0])
+    b.submit("b", prompts[1], budgets[1])
+    b.submit("zero", prompts[2], 0)
+    with pytest.raises(ValueError, match="already in flight"):
+        b.submit("a", prompts[3], 3)
+    with pytest.raises(RuntimeError, match="drain"):
+        b.run([prompts[0]], 2)
+    got = b.step()  # returns the zero-budget instant; others mid-decode
+    assert got.pop("zero") == []
+    # submit two more while a/b are mid-decode, then drain everything
+    b.submit("c", prompts[2], budgets[2])
+    b.submit("d", prompts[3], budgets[3])
+    got.update(b.drain())
+    assert b.in_flight == 0
+    b.submit("e", prompts[4], budgets[4])  # reuse after drain works
+    got.update(b.drain())
+    for rid, (p, n) in zip("abcde", zip(prompts, budgets)):
+        assert got[rid] == _oracle(params, p, n), f"request {rid}"
+    # run() still works on the drained batcher
+    assert b.run([prompts[0]], 3)[0] == _oracle(params, prompts[0], 3)
+
+
+def test_streaming_eos_trickled_matches_generate(setup):
+    """Streaming + EOS: requests trickled in one per step() (new
+    submissions landing while earlier streams are mid-decode or ending on
+    EOS) must match generate(eos_id=...) — the EOS cut, padding, and
+    mid-drain slot recycling all happen through the streaming path."""
+    params = setup
+    rng = np.random.default_rng(29)
+    prompts = [rng.integers(1, 97, size=n).tolist()
+               for n in (3, 6, 4, 7, 5)]
+    max_new = 8
+    outs = [_oracle(params, p, max_new) for p in prompts]
+    eos_id = next((c for c in range(97)
+                   if any(c in o for o in outs)
+                   and not all(c in o for o in outs)), None)
+    if eos_id is None:
+        pytest.skip("no token splits the oracle outputs at this seed")
+    b = ContinuousBatcher(CFG, params, max_batch=2, prefill_width=8,
+                          eos_id=eos_id, decode_chunk=2)
+    got = {}
+    for i, p in enumerate(prompts):  # one new submission per step
+        b.submit(i, p, max_new)
+        got.update(b.step())
+    got.update(b.drain())
+    for i, p in enumerate(prompts):
+        assert got[i] == _oracle_eos(params, p, max_new, eos_id), \
+            f"request {i}"
+
+
 def test_prefix_cached_serving_matches_generate(setup):
     """Shared-prefix continuous batching: every request continues the same
     cached system prompt; outputs ≡ solo generate(prompt, prefix=...) per
