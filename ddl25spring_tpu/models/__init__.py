@@ -15,6 +15,7 @@ from .llama import (
 )
 from .generate import generate, precompute_prefix, sequence_logprobs
 from .distill import distill_draft
+from .serving import ContinuousBatcher, serve_fused
 from .lora import (
     LoRADense,
     lora_trainable_mask,
@@ -30,6 +31,8 @@ __all__ = [
     "sequence_logprobs",
     "speculative_generate",
     "distill_draft",
+    "ContinuousBatcher",
+    "serve_fused",
     "LoRADense",
     "lora_trainable_mask",
     "make_lora_optimizer",
