@@ -4,11 +4,16 @@
 # lost its whole window that way).  If the north-star JSON comes back
 # value-0 (tunnel wedged right after the probe), the sentinel goes back
 # to waiting instead of exiting with nothing:
-#   1. north-star bench (flax GroupNorm)      -> results/bench_tpu.json
-#   2. north-star bench (lean GroupNorm A/B)  -> results/bench_tpu_lean.json
-#   3. Pallas kernel validation (Mosaic)      -> results/tpu_validate.txt
-#   4. flash-attention microbench (+numerics) -> results/flash_tpu.txt (+hd128)
-#   5. generation tokens/sec grid             -> results/generate_tpu.txt
+# Phase 1 (round-5 priorities, highest value first):
+#   1. north-star bench, lean, multi-trial    -> results/bench_tpu_lean.json
+#   2. serving three-way battery              -> results/serving_tpu.txt
+#   3. distilled-draft speculative grid       -> results/spec_distilled_tpu.txt
+#   4. int8-KV long-context A/B               -> results/generate_kv8_long_tpu.txt
+#   5. north-star xprof trace + summary       -> results/northstar_trace_summary.*
+# Phase 2 (standing re-capture battery):
+#   flax bench, kernel validation, cost analyses, flash sweeps, generation
+#   grid, self-draft spec row, chip peaks, LM MFU, im2col+remat
+# Trend rows (tools/tpu_trend.py) append after each phase-1 capture.
 # Stops the tpu_watch prober first so nothing else talks to the single-tenant
 # chip mid-measurement.  Logs to /tmp/measure.log.
 cd /root/repo || exit 1
@@ -23,11 +28,15 @@ EOF
     echo "$(date +%H:%M:%S) tunnel UP — measuring" >> "$LOG"
     pkill -f tpu_watch.sh 2>/dev/null
     sleep 2
-    timeout 1800 python bench.py --deadline-s 900 --norm-impl flax \
-      > results/bench_tpu.json 2>> "$LOG"; rc=$?
-    echo "$(date +%H:%M:%S) bench flax done (exit $rc)" >> "$LOG"
-    if ! grep -q '"value": [1-9]' results/bench_tpu.json 2>/dev/null && \
-       ! grep -q '"value": 0\.[0-9]*[1-9]' results/bench_tpu.json \
+    # ---- phase 1: round-5 priorities, highest value first (the tunnel
+    # can wedge any minute — round 5 lost its serving K=32 row that way).
+    # The LEAN bench leads: it is the driver's metric and the trend gate's
+    # anchor, multi-trial by default since round 5.
+    timeout 1800 python bench.py --deadline-s 900 --norm-impl lean \
+      > results/bench_tpu_lean.json 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) bench lean done (exit $rc)" >> "$LOG"
+    if ! grep -q '"value": [1-9]' results/bench_tpu_lean.json 2>/dev/null \
+       && ! grep -q '"value": 0\.[0-9]*[1-9]' results/bench_tpu_lean.json \
          2>/dev/null; then
       echo "$(date +%H:%M:%S) north star NOT captured — back to waiting" \
         >> "$LOG"
@@ -35,9 +44,40 @@ EOF
       sleep 300
       continue
     fi
+    python tools/tpu_trend.py --bench results/bench_tpu_lean.json \
+      >> "$LOG" 2>&1
+    rc=0
+    ( for K in 8 16 32; do
+        timeout 1200 python examples/bench_serving.py --decode-chunk $K \
+          2>> "$LOG" || echo "SERVING-RUN-FAILED chunk=$K rc=$?" >> "$LOG"
+      done ) > results/serving_tpu.txt
+    grep -q SERVING-RUN-FAILED "$LOG" && rc=1
+    echo "$(date +%H:%M:%S) serving battery done (exit $rc)" >> "$LOG"
+    python tools/tpu_trend.py --serving results/serving_tpu.txt \
+      >> "$LOG" 2>&1
+    timeout 2400 python examples/bench_speculative.py \
+      > results/spec_distilled_tpu.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) distilled spec bench done (exit $rc)" >> "$LOG"
+    python tools/tpu_trend.py --spec-json results/spec_distilled_tpu.txt \
+      >> "$LOG" 2>&1
+    timeout 1800 python examples/bench_generate.py --batches 1 \
+      --kv-heads 6,1 --ctx 8192 --prompt 2048 --new-tokens 512 --kv-int8 \
+      > results/generate_kv8_long_tpu.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) int8-KV long-ctx bench done (exit $rc)" >> "$LOG"
+    rm -rf /tmp/trace_northstar
     timeout 1800 python bench.py --deadline-s 900 --norm-impl lean \
-      > results/bench_tpu_lean.json 2>> "$LOG"; rc=$?
-    echo "$(date +%H:%M:%S) bench lean done (exit $rc)" >> "$LOG"
+      --trials 2 --profile /tmp/trace_northstar \
+      > results/bench_tpu_lean_profiled.json 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) north-star profile done (exit $rc)" >> "$LOG"
+    timeout 300 python tools/trace_summary.py /tmp/trace_northstar \
+      --json results/northstar_trace_summary.json \
+      > results/northstar_trace_summary.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) trace summary done (exit $rc)" >> "$LOG"
+    # ---- phase 2: the standing re-capture battery (staleness discipline)
+    timeout 1800 python bench.py --deadline-s 900 --norm-impl flax \
+      > results/bench_tpu.json 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) bench flax done (exit $rc)" >> "$LOG"
+    python tools/tpu_trend.py --bench results/bench_tpu.json >> "$LOG" 2>&1
     timeout 2400 python tools/tpu_validate.py \
       > results/tpu_validate.txt 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) kernel validation done (exit $rc)" >> "$LOG"
@@ -63,29 +103,8 @@ EOF
       --kv-heads 6 --speculative 4 \
       > results/generate_spec_tpu.txt 2>> "$LOG"; rc=$?
     echo "$(date +%H:%M:%S) speculative bench done (exit $rc)" >> "$LOG"
-    # round-5 additions: the serving three-way (static / host-streamed /
-    # fused one-dispatch), the distilled-draft speculative grid, the int8
-    # KV long-context A/B, and the TPU trend gate rows (VERDICT r4 #5)
-    rc=0
-    ( for K in 8 16 32; do
-        timeout 1200 python examples/bench_serving.py --decode-chunk $K \
-          2>> "$LOG" || echo "SERVING-RUN-FAILED chunk=$K rc=$?" >> "$LOG"
-      done ) > results/serving_tpu.txt
-    grep -q SERVING-RUN-FAILED "$LOG" && rc=1
-    echo "$(date +%H:%M:%S) serving battery done (exit $rc)" >> "$LOG"
-    timeout 2400 python examples/bench_speculative.py \
-      > results/spec_distilled_tpu.txt 2>> "$LOG"; rc=$?
-    echo "$(date +%H:%M:%S) distilled spec bench done (exit $rc)" >> "$LOG"
-    timeout 1800 python examples/bench_generate.py --batches 1 \
-      --kv-heads 6,1 --ctx 8192 --prompt 2048 --new-tokens 512 --kv-int8 \
-      > results/generate_kv8_long_tpu.txt 2>> "$LOG"; rc=$?
-    echo "$(date +%H:%M:%S) int8-KV long-ctx bench done (exit $rc)" >> "$LOG"
-    python tools/tpu_trend.py \
-      --bench results/bench_tpu_lean.json \
-      --serving results/serving_tpu.txt \
-      --generate results/generate_tpu.txt \
-      --spec-json results/spec_distilled_tpu.txt >> "$LOG" 2>&1
-    python tools/tpu_trend.py --bench results/bench_tpu.json >> "$LOG" 2>&1
+    python tools/tpu_trend.py --generate results/generate_tpu.txt \
+      >> "$LOG" 2>&1
     echo "$(date +%H:%M:%S) trend rows appended" >> "$LOG"
     # round-4 additions: measured chip peaks (the honest MFU/roofline
     # denominators), the corrected LM MFU bench, and the im2col+remat A/B.
