@@ -4,9 +4,12 @@ Round-4 measured speculative decoding only at its two degenerate corners —
 self-draft (acceptance 1.0 but draft == target, so no win by construction)
 and a random small draft (acceptance ~0) — and concluded "correct but never
 fast".  This bench closes the loop the way the capability is meant to be
-used (models/distill.py): distill a genuinely smaller draft from the
-target, then measure plain vs speculative decode across gamma with the
-measured acceptance rate.
+used: PRE-TRAIN the target on the corpus (a random-init target's near-flat
+logits make greedy argmax-matching unwinnable for ANY draft — the regime
+note in tests/test_speculative.py::test_distilled_draft_beats_random_draft),
+distill a genuinely smaller draft from it (models/distill.py), then measure
+plain vs speculative decode across gamma with the measured acceptance rate
+on in-distribution prompts.
 
 Speculation is a LATENCY play: it wins when a single-row decode step is
 dominated by the target's weight streaming, so the draft's gamma cheap
@@ -35,7 +38,6 @@ def main() -> int:
     ap.add_argument("--dmodel", type=int, default=1024)
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--heads", type=int, default=8)
-    ap.add_argument("--vocab", type=int, default=4096)
     ap.add_argument("--draft-dmodel", type=int, default=256)
     ap.add_argument("--draft-layers", type=int, default=3)
     ap.add_argument("--small", action="store_true",
@@ -45,6 +47,11 @@ def main() -> int:
     ap.add_argument("--prompt", type=int, default=32)
     ap.add_argument("--new-tokens", type=int, default=256)
     ap.add_argument("--gammas", default="2,4,8")
+    ap.add_argument("--pretrain-steps", type=int, default=400,
+                    help="target pre-training steps on the (synthetic-"
+                         "fallback) corpus — speculation needs PEAKED "
+                         "target conditionals; a random-init target "
+                         "accepts ~nothing from any draft")
     ap.add_argument("--distill-steps", type=int, default=300)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--cpu", action="store_true")
@@ -65,12 +72,20 @@ def main() -> int:
     from ddl25spring_tpu.models.speculative import speculative_generate
     from ddl25spring_tpu.utils.platform import device_sync
 
+    import optax
+
+    from ddl25spring_tpu.data.bpe import BASE_VOCAB
+    from ddl25spring_tpu.data.text import token_stream
+    from ddl25spring_tpu.ops import causal_lm_loss
+
     if args.small:
         args.dmodel, args.layers, args.heads = 288, 6, 6
         args.draft_dmodel, args.draft_layers = 96, 2
+    # byte tokenizer: pre-training runs on the (synthetic-fallback) corpus
+    args.vocab = BASE_VOCAB
     dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
     gammas = [int(g) for g in args.gammas.split(",")]
-    ctx = args.prompt + args.new_tokens + max(gammas) + 8
+    ctx = max(args.prompt + args.new_tokens + max(gammas) + 8, 128)
     tcfg = LlamaConfig(vocab_size=args.vocab, dmodel=args.dmodel,
                        nr_heads=args.heads, nr_layers=args.layers,
                        ctx_size=ctx, dtype=dt)
@@ -81,9 +96,38 @@ def main() -> int:
           f"L={args.layers} | draft d={args.draft_dmodel} "
           f"L={args.draft_layers} | new={args.new_tokens}", flush=True)
 
-    prompt = jnp.ones((1, args.prompt), jnp.int32)
-    params = Llama(tcfg).init(jax.random.key(0), prompt,
-                              positions=jnp.arange(args.prompt))
+    # -- pre-train the target on the corpus (peaked conditionals) ---------
+    # the stream's seq_l must cover the measurement prompt sliced from it
+    T_train = max(128, args.prompt)
+    stream = iter(token_stream(8, T_train, seed=0))
+    target = Llama(tcfg)
+    params = target.init(jax.random.key(0),
+                         jnp.zeros((1, T_train), jnp.int32),
+                         positions=jnp.arange(T_train))
+    opt = optax.adam(3e-4 if args.dmodel >= 512 else 8e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(p, s, toks):
+        loss, g = jax.value_and_grad(
+            lambda p: causal_lm_loss(target.apply(p, toks), toks)
+        )(p)
+        up, s = opt.update(g, s)
+        return optax.apply_updates(p, up), s, loss
+
+    t0 = time.perf_counter()
+    first_loss = last_loss = float("nan")
+    for i in range(args.pretrain_steps):
+        params, opt_state, loss = train_step(params, opt_state,
+                                             jnp.asarray(next(stream)))
+        if i == 0:
+            first_loss = float(loss)
+        last_loss = float(loss)
+    print(f"pre-trained target in {time.perf_counter() - t0:.0f}s "
+          f"(loss {first_loss:.3f} -> {last_loss:.3f})", flush=True)
+
+    # in-distribution measurement prompts: a fresh corpus batch's prefix
+    prompt = jnp.asarray(next(stream))[:1, :args.prompt]
 
     t0 = time.perf_counter()
     dparams, losses = distill_draft(
@@ -139,6 +183,10 @@ def main() -> int:
         "backend": jax.default_backend(),
         "target_dmodel": args.dmodel, "target_layers": args.layers,
         "draft_dmodel": args.draft_dmodel, "draft_layers": args.draft_layers,
+        "vocab_size": args.vocab,
+        "pretrain_steps": args.pretrain_steps,
+        "pretrain_loss": round(last_loss, 3) if last_loss == last_loss
+        else None,
         "distill_steps": args.distill_steps,
         "plain_tok_s": round(plain_tok_s, 1),
         "gammas": rows,

@@ -46,12 +46,15 @@ EOF
     fi
     python tools/tpu_trend.py --bench results/bench_tpu_lean.json \
       >> "$LOG" 2>&1
-    rc=0
+    # per-run failure marker (grepping the append-only LOG would match
+    # stale failures from previous sentinel runs)
+    SERVING_FAIL=$(mktemp)
     ( for K in 8 16 32; do
         timeout 1200 python examples/bench_serving.py --decode-chunk $K \
-          2>> "$LOG" || echo "SERVING-RUN-FAILED chunk=$K rc=$?" >> "$LOG"
+          2>> "$LOG" || { echo "chunk=$K rc=$?" >> "$SERVING_FAIL";
+                          echo "SERVING-RUN-FAILED chunk=$K" >> "$LOG"; }
       done ) > results/serving_tpu.txt
-    grep -q SERVING-RUN-FAILED "$LOG" && rc=1
+    rc=0; [ -s "$SERVING_FAIL" ] && rc=1; rm -f "$SERVING_FAIL"
     echo "$(date +%H:%M:%S) serving battery done (exit $rc)" >> "$LOG"
     python tools/tpu_trend.py --serving results/serving_tpu.txt \
       >> "$LOG" 2>&1
