@@ -87,10 +87,16 @@ def distill_draft(
         opt_state = opt.init(dparams)
         start_step = 0
 
+    # the frozen target's params enter as an ARGUMENT, not a closure: a
+    # closure-captured pytree is baked into the HLO as constants, and a
+    # ~600 MB constant blob kills the tunnel's remote-compile upload with
+    # a broken pipe (the README's documented trap; observed twice
+    # 2026-08-02 before this fix — both "transport" failures were the
+    # compile of THIS step, not training)
     @jax.jit
-    def step(dparams, opt_state, tokens):
+    def step(dparams, opt_state, tokens, tp):
         soft = jax.nn.softmax(
-            target.apply({"params": tparams}, tokens), axis=-1
+            target.apply({"params": tp}, tokens), axis=-1
         )
 
         def loss_fn(dp):
@@ -125,7 +131,8 @@ def distill_draft(
     for i in range(start_step, steps):
         tokens = (jnp.asarray(next(batches)) if batches is not None
                   else draw(i))
-        dparams, opt_state, loss = step(dparams, opt_state, tokens)
+        dparams, opt_state, loss = step(dparams, opt_state, tokens,
+                                        tparams)
         losses.append(float(loss))
         if on_step is not None:
             on_step(i, dparams, opt_state, losses[-1])

@@ -58,8 +58,26 @@ EOF
     echo "$(date +%H:%M:%S) serving battery done (exit $rc)" >> "$LOG"
     python tools/tpu_trend.py --serving results/serving_tpu.txt \
       >> "$LOG" 2>&1
-    timeout 2400 python examples/bench_speculative.py \
-      > results/spec_distilled_tpu.txt 2>> "$LOG"; rc=$?
+    # two attempts: a transport drop (observed 2026-08-02) resumes from
+    # the bench's host-side param cache + 25-step snapshots on retry
+    # instead of restarting cold.  tmp-then-install per attempt so a
+    # worse retry never truncates the better partial capture.
+    for attempt in 1 2; do
+      SPEC_TMP=$(mktemp)
+      timeout 2400 python examples/bench_speculative.py \
+        > "$SPEC_TMP" 2>> "$LOG"; rc=$?
+      if [ -s "$SPEC_TMP" ] && { [ $rc -eq 0 ] || \
+           [ ! -s results/spec_distilled_tpu.txt ] || \
+           [ $(wc -l < "$SPEC_TMP") -gt \
+             $(wc -l < results/spec_distilled_tpu.txt) ]; }; then
+        mv "$SPEC_TMP" results/spec_distilled_tpu.txt
+      else
+        rm -f "$SPEC_TMP"
+      fi
+      [ $rc -eq 0 ] && break
+      echo "$(date +%H:%M:%S) spec bench attempt $attempt failed " \
+        "(exit $rc) — retrying from snapshot" >> "$LOG"
+    done
     echo "$(date +%H:%M:%S) distilled spec bench done (exit $rc)" >> "$LOG"
     python tools/tpu_trend.py --spec-json results/spec_distilled_tpu.txt \
       >> "$LOG" 2>&1
