@@ -18,6 +18,8 @@ acceptance rate must beat the random-init draft's on the same prompts.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -62,6 +64,14 @@ def distill_draft(
     ``resume=(dparams, opt_state, start_step)`` restarts the loop from a
     snapshot (the data stream is re-keyed per step index, so a resumed run
     sees the same batches it would have).
+
+    Buffer-donation contract: the update step donates ``dparams`` and
+    ``opt_state`` (halves the transient HBM footprint), so the arrays
+    ``on_step`` receives — and the ones passed via ``resume`` — are
+    INVALIDATED by the next iteration.  Snapshot host-side immediately
+    (``jax.device_get``, or ``np.asarray`` as bench_speculative does);
+    keeping a device reference across iterations raises
+    "Array has been deleted".
     """
     if target_config.vocab_size != draft_config.vocab_size:
         raise ValueError("draft and target must share a vocabulary")
@@ -93,7 +103,9 @@ def distill_draft(
     # a broken pipe (the README's documented trap; observed twice
     # 2026-08-02 before this fix — both "transport" failures were the
     # compile of THIS step, not training)
-    @jax.jit
+    # donate the draft's params + opt state (not tokens, not the frozen
+    # target params): halves the step's transient HBM footprint
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(dparams, opt_state, tokens, tp):
         soft = jax.nn.softmax(
             target.apply({"params": tp}, tokens), axis=-1

@@ -337,3 +337,36 @@ def test_sampling_self_draft_with_filters_accepts_everything(models):
         temperature=0.7, top_k=5, top_p=0.9, key=jax.random.key(19),
     )
     assert float(rate) == 1.0
+
+
+def test_distill_resume_is_bit_exact():
+    """distill_draft(resume=...) continues EXACTLY where an uninterrupted
+    run would be: per-step data re-keying + deterministic adam means a
+    crash/restart from an ``on_step`` snapshot (the bench_speculative
+    recovery path for tunnel transport drops, 2026-08-02) changes nothing.
+    """
+    from ddl25spring_tpu.models.distill import distill_draft
+
+    tparams = _init(TARGET, 0)
+    kw = dict(steps=8, seq_l=16, batch_size=2, key=jax.random.key(3),
+              data="random")
+
+    straight, losses_a = distill_draft(TARGET, tparams, DRAFT, **kw)
+
+    snap = {}
+
+    def on_step(i, dp, opt_state, loss):
+        if i + 1 == 4:
+            snap["s"] = (jax.device_get(dp), jax.device_get(opt_state))
+
+    distill_draft(TARGET, tparams, DRAFT, steps=4, seq_l=16, batch_size=2,
+                  key=jax.random.key(3), data="random", on_step=on_step)
+    resumed, losses_b = distill_draft(
+        TARGET, tparams, DRAFT, **kw,
+        resume=(jax.device_put(snap["s"][0]),
+                jax.device_put(snap["s"][1]), 4),
+    )
+    assert losses_b == losses_a[4:]
+    for a, b in zip(jax.tree_util.tree_leaves(straight),
+                    jax.tree_util.tree_leaves(resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -62,6 +62,7 @@ EOF
     # the bench's host-side param cache + 25-step snapshots on retry
     # instead of restarting cold.  tmp-then-install per attempt so a
     # worse retry never truncates the better partial capture.
+    SPEC_FRESH=0
     for attempt in 1 2; do
       SPEC_TMP=$(mktemp)
       timeout 2400 python examples/bench_speculative.py \
@@ -71,16 +72,27 @@ EOF
            [ $(wc -l < "$SPEC_TMP") -gt \
              $(wc -l < results/spec_distilled_tpu.txt) ]; }; then
         mv "$SPEC_TMP" results/spec_distilled_tpu.txt
+        SPEC_FRESH=1
       else
         rm -f "$SPEC_TMP"
       fi
       [ $rc -eq 0 ] && break
-      echo "$(date +%H:%M:%S) spec bench attempt $attempt failed " \
-        "(exit $rc) — retrying from snapshot" >> "$LOG"
+      if [ $attempt -lt 2 ]; then
+        echo "$(date +%H:%M:%S) spec bench attempt $attempt failed" \
+          "(exit $rc) — retrying from snapshot" >> "$LOG"
+      else
+        echo "$(date +%H:%M:%S) spec bench attempt $attempt failed" \
+          "(exit $rc) — giving up" >> "$LOG"
+      fi
     done
     echo "$(date +%H:%M:%S) distilled spec bench done (exit $rc)" >> "$LOG"
-    python tools/tpu_trend.py --spec-json results/spec_distilled_tpu.txt \
-      >> "$LOG" 2>&1
+    # only a capture refreshed THIS run may append a trend row: a stale
+    # file from a previous session parses cleanly and would stamp old
+    # data with today's date/rev
+    if [ "$SPEC_FRESH" -eq 1 ]; then
+      python tools/tpu_trend.py --spec-json results/spec_distilled_tpu.txt \
+        >> "$LOG" 2>&1
+    fi
     timeout 1800 python examples/bench_generate.py --batches 1 \
       --kv-heads 6,1 --ctx 8192 --prompt 2048 --new-tokens 512 --kv-int8 \
       > results/generate_kv8_long_tpu.txt 2>> "$LOG"; rc=$?
