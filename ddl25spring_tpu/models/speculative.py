@@ -116,6 +116,7 @@ def speculative_generate(
     top_k: int = 0,
     top_p: float = 1.0,
     key: jax.Array | None = None,
+    prefix: tuple | None = None,
 ):
     """Decode ``max_new_tokens`` continuations via draft+verify — greedy
     (``temperature=0``, bit-identical to plain greedy decode) or sampling
@@ -127,13 +128,31 @@ def speculative_generate(
     where ``tokens`` is (B, T0 + max_new_tokens) LEFT-padded and ``rate``
     is the mean acceptance (accepted proposals / proposed), the serving-
     side health metric.  ``gamma`` is the proposal depth; both models need
-    ``ctx_size >= gamma + T0 + max_new_tokens``.
+    ``ctx_size >= prefix_len + gamma + T0 + max_new_tokens`` (``prefix_len``
+    = 0 when no ``prefix`` is passed).
 
     ``eos_id`` reproduces generate()'s semantics exactly: the EOS is kept,
     every later generated slot becomes pad (0).  Here it is a post-pass —
     decoding past a row's EOS costs a few wasted slots but keeps every
     shape static, and the masked-out region is all zeros either way, so
     the output still matches ``generate(..., eos_id=...)`` bit-for-bit.
+
+    ``prefix`` composes speculative decoding with prefix caching
+    (:func:`models.generate.precompute_prefix`): pass a pair
+    ``(target_prefix, draft_prefix)`` — each the ``(cache, P)`` result of
+    ``precompute_prefix`` over the SAME prefix tokens with the respective
+    config/params (the draft needs its own prefix KV: it verifies nothing,
+    but its proposals must be conditioned on the prefix too or acceptance
+    collapses).  Every row continues the shared cached prefix exactly as in
+    :func:`generate`; output rows still contain only
+    ``prompt + continuation``.  Greedy output is bit-identical to
+    ``generate(..., prefix=target_prefix)`` whatever the draft.  Not
+    supported with ``decode_seq_shards > 1`` (the sharded cache path has no
+    prefix seam).  Perf note: a prefix currently forces the einsum decode
+    path — the flash-decode kernel's pad mask hides slots ``[0, pad)``,
+    which with a prefix would hide REAL prefix KV (models/llama.py
+    ``flash_ok``), so speculation over a cached prefix trades the Pallas
+    kernel for prefix reuse; profile both if the prefix is short.
 
     ``temperature > 0`` switches to SAMPLING speculative decoding (modified
     rejection sampling, the full Leviathan/Chen construction): the draft
@@ -156,11 +175,35 @@ def speculative_generate(
         raise ValueError(f"gamma must be >= 1, got {gamma}")
     B, T0 = prompt.shape
     total = gamma + T0 + max_new_tokens  # committed region (incl. left pads)
-    for name, cfg in (("target", target_config), ("draft", draft_config)):
-        if total > cfg.ctx_size:
+    if prefix is not None:
+        try:
+            (t_pref_cache, t_plen), (d_pref_cache, d_plen) = prefix
+        except (TypeError, ValueError):
             raise ValueError(
-                f"{name} ctx_size {cfg.ctx_size} < gamma + prompt + "
-                f"max_new_tokens = {total}"
+                "prefix must be (target_prefix, draft_prefix), each a "
+                "(cache, length) pair from precompute_prefix"
+            ) from None
+        if int(t_plen) != int(d_plen):
+            raise ValueError(
+                f"target and draft prefixes must cover the same tokens "
+                f"(lengths {int(t_plen)} vs {int(d_plen)})"
+            )
+        if max(target_config.decode_seq_shards,
+               draft_config.decode_seq_shards) > 1:
+            raise ValueError(
+                "prefix caching is not supported with decode_seq_shards > 1"
+            )
+        prefix_len = int(t_plen)
+    else:
+        t_pref_cache = d_pref_cache = None
+        prefix_len = 0
+    # ctx validation FIRST: an over-long prefix+prompt must stay loud even
+    # when there is nothing to generate (the generate() discipline)
+    for name, cfg in (("target", target_config), ("draft", draft_config)):
+        if prefix_len + total > cfg.ctx_size:
+            raise ValueError(
+                f"{name} ctx_size {cfg.ctx_size} < prefix + gamma + prompt "
+                f"+ max_new_tokens = {prefix_len + total}"
             )
     _check_prompt_lengths(prompt_lengths, T0)
     if temperature < 0:
@@ -207,13 +250,15 @@ def speculative_generate(
     tokens0 = jax.lax.dynamic_update_slice(tokens0, prompt_left, (0, gamma))
 
     run = _spec_fn(target_config, draft_config, gamma, float(temperature),
-                   int(top_k), float(top_p), B, T0, max_new_tokens, eos_id)
-    return run(tparams, dparams, tokens0, pad, key)
+                   int(top_k), float(top_p), B, T0, max_new_tokens, eos_id,
+                   prefix_len)
+    return run(tparams, dparams, tokens0, pad, key,
+               t_pref_cache, d_pref_cache)
 
 
 @functools.lru_cache(maxsize=32)
 def _spec_fn(target_config, draft_config, gamma, temperature, top_k, top_p,
-             B, T0, max_new_tokens, eos_id):
+             B, T0, max_new_tokens, eos_id, prefix_len=0):
     """Build (once per geometry/config) the jitted draft+verify program.
 
     lru_cached for the same reason as generate._decode_fn: a fresh
@@ -231,14 +276,32 @@ def _spec_fn(target_config, draft_config, gamma, temperature, top_k, top_p,
         total_buf = -(-total_buf // shards) * shards
     window = gamma + T0  # prefill width
     tcfg = dataclasses.replace(target_config, decode=True,
-                               ctx_size=total_buf)
+                               ctx_size=prefix_len + total_buf)
     dcfg = dataclasses.replace(draft_config, decode=True,
-                               ctx_size=total_buf)
+                               ctx_size=prefix_len + total_buf)
     target, draft = Llama(tcfg), Llama(dcfg)
 
     @jax.jit
-    def run(tparams, dparams, tokens, pad, key):
+    def run(tparams, dparams, tokens, pad, key,
+            t_prefix=None, d_prefix=None):
         rows = jnp.arange(B)
+
+        def seeded(pref_cache):
+            """Prefix KV (1, P_src, ...) -> this geometry's cache
+            (B, prefix_len + total_buf, ...): slots [0, prefix_len) carry
+            the shared prefix, the rest start zero (generate()'s broadcast,
+            re-laid-out because the spec buffer is sized to the decode
+            window, not the caller's ctx_size)."""
+
+            def seed(leaf):
+                blk = jnp.broadcast_to(
+                    leaf[:, :prefix_len],
+                    (B, prefix_len) + leaf.shape[2:],
+                )
+                z = jnp.zeros((B, total_buf) + leaf.shape[2:], leaf.dtype)
+                return jnp.concatenate([blk, z], axis=1)
+
+            return jax.tree.map(seed, pref_cache)
 
         def keys_for(slots, tag):
             """Per-(row, slot, purpose) keys — independent of how rounds
@@ -267,14 +330,21 @@ def _spec_fn(target_config, draft_config, gamma, temperature, top_k, top_p,
                 lambda k, l: jax.random.categorical(k, dist_logits(l))
             )(ks, logits).astype(tokens.dtype)
 
-        prefill_pos = jnp.arange(window)
+        prefill_pos = prefix_len + jnp.arange(window)
+        tvariables = {"params": tparams}
+        dvariables = {"params": dparams}
+        if prefix_len:
+            tvariables = {**tvariables, "cache": seeded(t_prefix)}
+            dvariables = {**dvariables, "cache": seeded(d_prefix)}
         t_logits, tvars = target.apply(
-            {"params": tparams}, tokens[:, :window],
-            positions=prefill_pos, pad=pad, mutable=["cache"],
+            tvariables, tokens[:, :window],
+            positions=prefill_pos, pad=pad, prefix_len=prefix_len,
+            mutable=["cache"],
         )
         _, dvars = draft.apply(
-            {"params": dparams}, tokens[:, :window],
-            positions=prefill_pos, pad=pad, mutable=["cache"],
+            dvariables, tokens[:, :window],
+            positions=prefill_pos, pad=pad, prefix_len=prefix_len,
+            mutable=["cache"],
         )
         if sampling:
             first = sample_rows(
@@ -302,10 +372,11 @@ def _spec_fn(target_config, draft_config, gamma, temperature, top_k, top_p,
             # its slot L'-2 has no K/V.  Both slots hold committed tokens,
             # so the rewrite is value-identical where already valid.
             catch = _row_read(tokens, L - 2, 2)
-            cpos = (L - 2)[:, None] + jnp.arange(2)[None, :]
+            cpos = prefix_len + (L - 2)[:, None] + jnp.arange(2)[None, :]
             clog, dv = draft.apply(
                 {"params": dparams, "cache": dcache},
-                catch, positions=cpos, pad=pad, mutable=["cache"],
+                catch, positions=cpos, pad=pad, prefix_len=prefix_len,
+                mutable=["cache"],
             )
             dcache = dv["cache"]
             if sampling:
@@ -319,8 +390,9 @@ def _spec_fn(target_config, draft_config, gamma, temperature, top_k, top_p,
                 dcache, cur_tok, cur_pos = c
                 logits, dv = draft.apply(
                     {"params": dparams, "cache": dcache},
-                    cur_tok[:, None], positions=cur_pos[:, None], pad=pad,
-                    mutable=["cache"],
+                    cur_tok[:, None],
+                    positions=prefix_len + cur_pos[:, None], pad=pad,
+                    prefix_len=prefix_len, mutable=["cache"],
                 )
                 if sampling:
                     nxt = sample_rows(keys_for(cur_pos + 1, 0),
@@ -350,10 +422,13 @@ def _spec_fn(target_config, draft_config, gamma, temperature, top_k, top_p,
                 tokens, L, props, jnp.full((B,), gamma, jnp.int32)
             )
             win = _row_read(tokens_p, L - 1, gamma + 1)
-            pos = (L - 1)[:, None] + jnp.arange(gamma + 1)[None, :]
+            pos = prefix_len + (L - 1)[:, None] + jnp.arange(
+                gamma + 1
+            )[None, :]
             t_logits, tv = target.apply(
                 {"params": tparams, "cache": tcache},
-                win, positions=pos, pad=pad, mutable=["cache"],
+                win, positions=pos, pad=pad, prefix_len=prefix_len,
+                mutable=["cache"],
             )
             tcache = tv["cache"]
             if sampling:

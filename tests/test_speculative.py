@@ -370,3 +370,85 @@ def test_distill_resume_is_bit_exact():
     for a, b in zip(jax.tree_util.tree_leaves(straight),
                     jax.tree_util.tree_leaves(resumed)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# prefix caching (speculative x precompute_prefix composition)
+# ---------------------------------------------------------------------------
+
+
+def _prefixes(tparams, dparams, pref_tokens):
+    from ddl25spring_tpu.models.generate import precompute_prefix
+
+    return (precompute_prefix(TARGET, tparams, pref_tokens),
+            precompute_prefix(DRAFT, dparams, pref_tokens))
+
+
+def test_prefix_greedy_matches_generate_prefix(models):
+    """THE composition oracle: speculative decoding continuing a cached
+    shared prefix is bit-identical to generate() continuing the same
+    prefix, whatever the draft — for full and ragged batches."""
+    tparams, dparams = models
+    pref = jax.random.randint(jax.random.key(20), (7,), 1, 48)
+    t_pref, d_pref = _prefixes(tparams, dparams, pref)
+
+    prompt = jax.random.randint(jax.random.key(21), (2, 5), 1, 48)
+    want = generate(TARGET, tparams, prompt, 11, prefix=t_pref)
+    got, rate = speculative_generate(
+        TARGET, tparams, DRAFT, dparams, prompt, 11, gamma=3,
+        prefix=(t_pref, d_pref),
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert 0.0 <= float(rate) <= 1.0
+
+    lengths = jnp.asarray([2, 5])
+    want = generate(TARGET, tparams, prompt, 9, prompt_lengths=lengths,
+                    prefix=t_pref)
+    got, _ = speculative_generate(
+        TARGET, tparams, DRAFT, dparams, prompt, 9, gamma=4,
+        prompt_lengths=lengths, prefix=(t_pref, d_pref),
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_prefix_self_draft_accepts_everything(models):
+    """Self-draft with a shared prefix still accepts every proposal (the
+    draft conditions on the same cached prefix the target verifies
+    against) — in greedy AND sampling mode."""
+    tparams, _ = models
+    pref = jax.random.randint(jax.random.key(22), (4,), 1, 48)
+    from ddl25spring_tpu.models.generate import precompute_prefix
+
+    t_pref = precompute_prefix(TARGET, tparams, pref)
+    prompt = jax.random.randint(jax.random.key(23), (2, 4), 1, 48)
+    for kw in (dict(), dict(temperature=0.8, key=jax.random.key(5))):
+        _, rate = speculative_generate(
+            TARGET, tparams, TARGET, tparams, prompt, 10, gamma=3,
+            prefix=(t_pref, t_pref), **kw,
+        )
+        assert float(rate) == 1.0, kw
+
+
+def test_prefix_validation(models):
+    tparams, dparams = models
+    prompt = jnp.ones((2, 4), jnp.int32)
+    pref = jnp.ones((5,), jnp.int32)
+    t_pref, d_pref = _prefixes(tparams, dparams, pref)
+
+    with pytest.raises(ValueError, match="same tokens"):
+        from ddl25spring_tpu.models.generate import precompute_prefix
+
+        short = precompute_prefix(DRAFT, dparams, pref[:3])
+        speculative_generate(TARGET, tparams, DRAFT, dparams, prompt, 4,
+                             prefix=(t_pref, short))
+    with pytest.raises(ValueError, match="pair"):
+        speculative_generate(TARGET, tparams, DRAFT, dparams, prompt, 4,
+                             prefix=t_pref)
+    with pytest.raises(ValueError, match="ctx_size"):
+        speculative_generate(TARGET, tparams, DRAFT, dparams, prompt, 60,
+                             prefix=(t_pref, d_pref))
+    with pytest.raises(ValueError, match="decode_seq_shards"):
+        speculative_generate(
+            dataclasses.replace(TARGET, decode_seq_shards=2), tparams,
+            DRAFT, dparams, prompt, 4, prefix=(t_pref, d_pref),
+        )
