@@ -385,19 +385,16 @@ class Attention(nn.Module):
             cv = self.variable("cache", "v", zeros)
             write(ck, k)
             write(cv, v)
-        # ragged + shared-prefix decode must take the einsum path: the
-        # Pallas kernel's pad mask hides slots [0, pad) — with a prefix the
-        # garbage actually sits at [prefix_len, prefix_len + pad), and the
-        # prefix slots below it are REAL (models/generate.py prefix cache)
-        flash_ok = pad is None or prefix_len == 0
-        if (cfg.resolved_decode_impl() == "flash-decode" and T == 1
-                and flash_ok):
+        if cfg.resolved_decode_impl() == "flash-decode" and T == 1:
             # Pallas kernel streams only the LIVE cache prefix (scalar-
             # prefetch-clamped DMA); prefill (T > 1) keeps the einsum
             # below.  Per-row positions pass as a (B,) pos vector — each
             # row's DMA clamp and masks use its own slot.  An int8 cache
             # streams quantized (4x less HBM traffic — the bandwidth win
-            # that motivates it) and dequantizes inside the kernel.
+            # that motivates it) and dequantizes inside the kernel.  A
+            # shared prefix passes as the STATIC prefix_len: the kernel's
+            # ragged mask shifts the garbage window to [prefix_len,
+            # prefix_len + pad) and keeps the real prefix KV below it.
             from ..ops.flash_decode import flash_decode_attention
 
             pos_arg = positions[:, 0] if per_row else positions[0]
@@ -405,10 +402,12 @@ class Attention(nn.Module):
                 out = flash_decode_attention(
                     q[:, 0], ck_q.value, cv_q.value, pos_arg, pad,
                     cache_k_scale=ck_s.value, cache_v_scale=cv_s.value,
+                    prefix_len=prefix_len,
                 )
             else:
                 out = flash_decode_attention(
                     q[:, 0], ck.value, cv.value, pos_arg, pad,
+                    prefix_len=prefix_len,
                 )
             return out[:, None]  # (B, 1, H, hd)
         if cfg.kv_cache_int8:

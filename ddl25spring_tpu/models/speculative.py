@@ -148,11 +148,10 @@ def speculative_generate(
     ``prompt + continuation``.  Greedy output is bit-identical to
     ``generate(..., prefix=target_prefix)`` whatever the draft.  Not
     supported with ``decode_seq_shards > 1`` (the sharded cache path has no
-    prefix seam).  Perf note: a prefix currently forces the einsum decode
-    path — the flash-decode kernel's pad mask hides slots ``[0, pad)``,
-    which with a prefix would hide REAL prefix KV (models/llama.py
-    ``flash_ok``), so speculation over a cached prefix trades the Pallas
-    kernel for prefix reuse; profile both if the prefix is short.
+    prefix seam).  The flash-decode kernel composes: its ragged mask takes
+    the prefix window as a static offset (ops/flash_decode.py
+    ``prefix_len``), so the draft's single-token steps keep the Pallas
+    path over a cached prefix.
 
     ``temperature > 0`` switches to SAMPLING speculative decoding (modified
     rejection sampling, the full Leviathan/Chen construction): the draft

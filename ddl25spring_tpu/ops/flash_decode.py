@@ -71,8 +71,21 @@ def _head_update(h, q, k, v, valid, scale, m_scr, l_scr, acc):
     )
 
 
+def _valid_mask(k_pos, pos, pad_b, prefix_len: int):
+    """Live-and-real mask shared by both kernels: keys at ``k_pos <= pos``,
+    minus the ragged-batch garbage window — which sits at ``[0, pad)``
+    without a prefix and at ``[prefix_len, prefix_len + pad)`` with one
+    (the prefix slots below it hold REAL shared KV, models/generate.py).
+    ``prefix_len`` is static, so the no-prefix program is unchanged."""
+    if prefix_len:
+        real = (k_pos < prefix_len) | (k_pos >= prefix_len + pad_b)
+    else:
+        real = k_pos >= pad_b
+    return (k_pos <= pos) & real
+
+
 def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
-            *, block_k, scale, nr_k, nr_kv_heads):
+            *, block_k, scale, nr_k, nr_kv_heads, prefix_len):
     b = pl.program_id(0)
     j = pl.program_id(1)
     pos = pos_ref[b]  # per-row positions (speculative decode rows diverge)
@@ -88,7 +101,7 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1
         )
-        valid = (k_pos <= pos) & (k_pos >= pad_ref[b])
+        valid = _valid_mask(k_pos, pos, pad_ref[b], prefix_len)
         # static Python loop over KV heads — unrolled at trace time
         # (Hkv <= 8 in practice).  Blocking ALL heads per K/V chunk keeps
         # the BlockSpec's trailing dims equal to the array dims, which the
@@ -105,7 +118,7 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
 
 def _kernel_int8(pos_ref, pad_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
                  o_ref, m_scr, l_scr, acc, *, block_k, scale, nr_k,
-                 nr_kv_heads):
+                 nr_kv_heads, prefix_len):
     """int8-cache variant: K/V blocks arrive as int8 with per-(token, head)
     scales (models/llama.py ``quant``) and dequantize IN VMEM — the HBM
     stream, where decode's time actually goes, stays 4x smaller."""
@@ -124,7 +137,7 @@ def _kernel_int8(pos_ref, pad_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1
         )
-        valid = (k_pos <= pos) & (k_pos >= pad_ref[b])
+        valid = _valid_mask(k_pos, pos, pad_ref[b], prefix_len)
         for h in range(nr_kv_heads):
             q = q_ref[0, h]
             # dequant exactly as the XLA path's _Deq: value * scale, in the
@@ -142,6 +155,7 @@ def _kernel_int8(pos_ref, pad_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
 
 def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
                            cache_k_scale=None, cache_v_scale=None,
+                           prefix_len: int = 0,
                            interpret: bool | None = None):
     """One decode step against the cache, reading only live blocks.
 
@@ -157,6 +171,12 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     per-(token, head) scales for an int8 cache (models/llama.py
     ``kv_cache_int8``) — blocks stream from HBM as int8 (4x less traffic)
     and dequantize in VMEM right before the dot.
+
+    ``prefix_len`` (static): with a shared cached prefix
+    (models/generate.py ``precompute_prefix``) slots ``[0, prefix_len)``
+    hold REAL KV and the ragged garbage window shifts to ``[prefix_len,
+    prefix_len + pad)`` — the mask follows; 0 (no prefix) compiles the
+    exact pre-existing program.
     """
     from .flash_attention import _resolve_interpret
 
@@ -227,7 +247,7 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     )
     out = pl.pallas_call(
         functools.partial(kernel, block_k=block_k, scale=scale, nr_k=nr_k,
-                          nr_kv_heads=Hkv),
+                          nr_kv_heads=Hkv, prefix_len=int(prefix_len)),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, g_pad, hd), q.dtype),
         interpret=interpret,

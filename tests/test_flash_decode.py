@@ -214,3 +214,108 @@ def test_decode_impl_auto_resolution():
     assert dataclasses.replace(
         cfg, decode_impl="xla"
     ).resolved_decode_impl() == "xla"
+
+
+def _xla_decode_prefix(q, ck, cv, pos, pad, prefix_len):
+    """Reference mask with a shared prefix: garbage window sits at
+    [prefix_len, prefix_len + pad); prefix slots below it are real."""
+    B, Hq, hd = q.shape
+    _, S, Hkv, _ = ck.shape
+    g = Hq // Hkv
+    qg = q.reshape(B, Hkv, g, hd)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, ck).astype(jnp.float32) * scale
+    slot = jnp.arange(S)[None, :]
+    live = slot <= pos  # scalar pos; per-row cases loop rows in the caller
+    real = (slot < prefix_len) | (slot >= prefix_len + pad[:, None])
+    scores = jnp.where((live & real)[:, None, None], scores, -jnp.inf)
+    att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgs,bskd->bkgd", att, cv)
+    return out.reshape(B, Hq, hd)
+
+
+def test_flash_decode_prefix_mask():
+    """prefix_len shifts the garbage window: slots [0, P) stay REAL,
+    [P, P + pad) are hidden — scalar and per-row positions, fp and int8
+    cache."""
+    B, S, Hq, Hkv, hd, P = 3, 64, 4, 2, 8, 9
+    ks = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(ks[0], (B, Hq, hd))
+    ck = jax.random.normal(ks[1], (B, S, Hkv, hd))
+    cv = jax.random.normal(ks[2], (B, S, Hkv, hd))
+    pad = jnp.asarray([0, 2, 5])
+    for pos in (P + 6, S - 1):
+        got = flash_decode_attention(q, ck, cv, pos, pad, prefix_len=P)
+        want = _xla_decode_prefix(q, ck, cv, pos, pad, P)
+        np.testing.assert_allclose(got, want, atol=1e-5, err_msg=f"pos={pos}")
+    # per-row positions (speculative rows diverge)
+    posv = jnp.asarray([P + 6, P + 11, S - 1])
+    got = flash_decode_attention(q, ck, cv, posv, pad, prefix_len=P)
+    want = np.stack([
+        np.asarray(_xla_decode_prefix(q[b:b + 1], ck[b:b + 1], cv[b:b + 1],
+                                      int(posv[b]), pad[b:b + 1], P))[0]
+        for b in range(B)
+    ])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # prefix_len=0 keeps the pre-existing no-prefix program exactly
+    np.testing.assert_allclose(
+        flash_decode_attention(q, ck, cv, 20, pad, prefix_len=0),
+        flash_decode_attention(q, ck, cv, 20, pad), atol=0,
+    )
+    # int8 cache: the quantized kernel shares _valid_mask — dequantized
+    # operands through the prefix-shifted mask must match the einsum
+    # reference on the same dequantized values
+    def quant(blk):
+        amax = jnp.max(jnp.abs(blk), axis=-1)
+        s = jnp.maximum(amax, 1e-8) / 127.0
+        qv = jnp.clip(jnp.round(blk / s[..., None]), -127, 127)
+        return qv.astype(jnp.int8), s.astype(jnp.float32)
+
+    kq, ks8 = quant(ck)
+    vq, vs8 = quant(cv)
+    got = flash_decode_attention(q, kq, vq, S - 1, pad,
+                                 cache_k_scale=ks8, cache_v_scale=vs8,
+                                 prefix_len=P)
+    want = _xla_decode_prefix(
+        q, kq.astype(q.dtype) * ks8[..., None],
+        vq.astype(q.dtype) * vs8[..., None], S - 1, pad, P,
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_generation_prefix_with_flash_decode_matches_xla():
+    """End-to-end: generate() over a cached prefix with
+    decode_impl='flash-decode' is bit-identical to the einsum path —
+    plain AND ragged (the composition the round-5 kernel mask unlocks) —
+    and speculative decoding over a prefix with a flash-decode draft
+    still reproduces the dense path's output."""
+    from ddl25spring_tpu.models.generate import precompute_prefix
+    from ddl25spring_tpu.models.speculative import speculative_generate
+
+    base = LlamaConfig(vocab_size=48, dmodel=32, nr_heads=4, nr_kv_heads=2,
+                       nr_layers=2, ctx_size=96, decode_impl="xla")
+    flash = dataclasses.replace(base, decode_impl="flash-decode")
+    toks = jnp.zeros((2, 5), jnp.int32)
+    params = Llama(base).init(jax.random.key(0), toks,
+                              positions=jnp.arange(5))
+    pref = jax.random.randint(jax.random.key(30), (11,), 1, 48)
+    t_pref = precompute_prefix(base, params, pref)
+
+    prompt = jax.random.randint(jax.random.key(31), (3, 6), 1, 48)
+    lengths = jnp.asarray([2, 6, 4])
+    for kw in (dict(), dict(prompt_lengths=lengths)):
+        want = generate(base, params, prompt, 12, prefix=t_pref, **kw)
+        got = generate(flash, params, prompt, 12, prefix=t_pref, **kw)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    dcfg = dataclasses.replace(base, dmodel=16, nr_heads=2, nr_kv_heads=2,
+                               nr_layers=1)
+    dflash = dataclasses.replace(dcfg, decode_impl="flash-decode")
+    dparams = Llama(dcfg).init(jax.random.key(1), toks,
+                               positions=jnp.arange(5))
+    d_pref = precompute_prefix(dcfg, dparams, pref)
+    want, _ = speculative_generate(base, params, dcfg, dparams, prompt, 10,
+                                   gamma=3, prefix=(t_pref, d_pref))
+    got, _ = speculative_generate(base, params, dflash, dparams, prompt, 10,
+                                  gamma=3, prefix=(t_pref, d_pref))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
