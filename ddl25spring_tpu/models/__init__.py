@@ -15,7 +15,8 @@ from .llama import (
 )
 from .generate import generate, precompute_prefix, sequence_logprobs
 from .distill import distill_draft
-from .serving import ContinuousBatcher, serve_fused
+from .serving import (ContinuousBatcher, serve_fused,
+                      serve_fused_speculative)
 from .lora import (
     LoRADense,
     lora_trainable_mask,
@@ -33,6 +34,7 @@ __all__ = [
     "distill_draft",
     "ContinuousBatcher",
     "serve_fused",
+    "serve_fused_speculative",
     "LoRADense",
     "lora_trainable_mask",
     "make_lora_optimizer",

@@ -566,6 +566,85 @@ class ContinuousBatcher:
 # -- fully fused serving: the whole workload in ONE dispatch ---------------
 
 
+def _lane_insert(cache, staged, mask, ix, B):
+    """Masked lane-aligned cache insert shared by every fused admitter:
+    lane b takes staged row ix[b] where mask[b], keeps its state
+    otherwise — jnp.where selects, no per-slot conds, no
+    dynamic_update_slice."""
+
+    def sel(big, st):
+        s = st[ix].astype(big.dtype)
+        m = mask.reshape((B,) + (1,) * (big.ndim - 1))
+        return jnp.where(m, s, big)
+
+    return jax.tree.map(sel, cache, staged)
+
+
+def _admit_bookkeeping(nxt, slot_req, slot_budget, out, out_n, budgets,
+                       firsts, eos_id: int, N: int):
+    """The slot bookkeeping every fused admitter shares (ONE copy — the
+    plain and speculative schedulers' admission semantics must not
+    drift): pack waiting requests into free lanes (free lane b takes
+    request nxt + #free lanes before b), write each admitted request's
+    prefill token to its output row, zero the budget of a request whose
+    FIRST token is already EOS.  Returns (mask, ix) for the caller's own
+    lane-state updates plus the advanced bookkeeping."""
+    free = slot_req < 0
+    offset = jnp.cumsum(free.astype(jnp.int32)) - free
+    req = nxt + offset
+    mask = free & (req < N)
+    ix = jnp.where(mask, req, 0)
+    out = out.at[jnp.where(mask, req, N), 0].set(
+        firsts[ix].astype(out.dtype)
+    )
+    done = (firsts[ix] == eos_id) if eos_id >= 0 \
+        else jnp.zeros_like(mask)
+    slot_budget = jnp.where(
+        mask, jnp.where(done, 0, budgets[ix] - 1), slot_budget
+    )
+    slot_req = jnp.where(mask, req, slot_req)
+    out_n = jnp.where(mask, 1, out_n)
+    nxt = nxt + jnp.minimum(free.sum(), N - nxt)
+    return mask, ix, slot_req, slot_budget, out, out_n, nxt
+
+
+def _pack_workload(requests, budgets, prefill_width: int):
+    """Host-side workload packing shared by the fused entry points (the
+    two fused servers must compile identical program variants for the
+    same workload): longest-budget-first (the host scheduler's makespan
+    heuristic), N padded to the next power of two with budget-1 dummy
+    requests (they briefly occupy tail slots — harmless), cap to a
+    multiple of 16.  Returns (live, N, cap, prompts, lengths, budg) or
+    None when nothing has a positive budget."""
+    live = [(i, r, b) for i, (r, b) in enumerate(zip(requests, budgets))
+            if b > 0]
+    if not live:
+        return None
+    live.sort(key=lambda irb: -irb[2])
+    N0 = len(live)
+    N = 1 << (N0 - 1).bit_length()
+    cap = -(-max(budgets) // 16) * 16
+    prompts = np.zeros((N, prefill_width), np.int32)
+    lengths = np.ones((N,), np.int32)
+    budg = np.ones((N,), np.int32)
+    for g, (_i, r, b) in enumerate(live):
+        prompts[g, :len(r)] = r
+        lengths[g] = len(r)
+        budg[g] = b
+    prompts[N0:, 0] = 1  # dummy one-token prompts, budget 1
+    return live, N, cap, prompts, lengths, budg
+
+
+def _gather_results(out, live, nr_requests: int):
+    """Per-request rows back from a fused (N, cap) output buffer: row g
+    belongs to live[g], trimmed to its budget (zeros past EOS ARE the
+    result — generate()'s pad semantics)."""
+    results: list = [[] for _ in range(nr_requests)]
+    for g, (i, _r, b) in enumerate(live):
+        results[i] = [int(t) for t in out[g, :b]]
+    return results
+
+
 @functools.lru_cache(maxsize=8)
 def _fused_program(config: LlamaConfig, max_batch: int, prefill_width: int,
                    prefix_len: int, decode_chunk: int, eos_id: int,
@@ -613,36 +692,17 @@ def _fused_program(config: LlamaConfig, max_batch: int, prefill_width: int,
         staged = jax.tree.map(lambda a: jnp.squeeze(a, axis=1), row_caches)
 
         def admit_all(state):
-            """Fill every free slot from the staging buffer: free lane b
-            takes request nxt + (#free lanes before b)."""
+            """Fill every free slot from the staging buffer
+            (:func:`_admit_bookkeeping` + this scheduler's lane state)."""
             (cache, tokens, pos, pad, slot_req, slot_budget, out, out_n,
              nxt) = state
-            free = slot_req < 0
-            offset = jnp.cumsum(free.astype(jnp.int32)) - free
-            req = nxt + offset
-            mask = free & (req < N)
-            ix = jnp.where(mask, req, 0)
-
-            def lane_select(big, st):
-                sel = st[ix].astype(big.dtype)  # (B, S, ...) staged rows
-                m = mask.reshape((B,) + (1,) * (big.ndim - 1))
-                return jnp.where(m, sel, big)
-
-            cache = jax.tree.map(lane_select, cache, staged)
+            mask, ix, slot_req, slot_budget, out, out_n, nxt = \
+                _admit_bookkeeping(nxt, slot_req, slot_budget, out, out_n,
+                                   budgets, firsts, eos_id, N)
+            cache = _lane_insert(cache, staged, mask, ix, B)
             tokens = jnp.where(mask, firsts[ix], tokens)
             pos = jnp.where(mask, P + W, pos)
             pad = jnp.where(mask, pads[ix], pad)
-            out = out.at[jnp.where(mask, req, N), 0].set(
-                firsts[ix].astype(out.dtype)
-            )
-            done = (firsts[ix] == eos_id) if eos_id >= 0 \
-                else jnp.zeros_like(mask)
-            slot_budget = jnp.where(
-                mask, jnp.where(done, 0, budgets[ix] - 1), slot_budget
-            )
-            slot_req = jnp.where(mask, req, slot_req)
-            out_n = jnp.where(mask, 1, out_n)
-            nxt = nxt + jnp.minimum(free.sum(), N - nxt)
             return (cache, tokens, pos, pad, slot_req, slot_budget, out,
                     out_n, nxt)
 
@@ -800,13 +860,7 @@ def _scheduled_program(config: LlamaConfig, max_batch: int,
                 cache, tokens, pos, pad = args
                 mask = areq >= 0
                 ix = jnp.maximum(areq, 0)
-
-                def lane_select(big, st):
-                    sel = st[ix].astype(big.dtype)  # (B, S, ...)
-                    m = mask.reshape((B,) + (1,) * (big.ndim - 1))
-                    return jnp.where(m, sel, big)
-
-                cache = jax.tree.map(lane_select, cache, staged)
+                cache = _lane_insert(cache, staged, mask, ix, B)
                 tokens = jnp.where(mask, firsts[ix], tokens)
                 pos = jnp.where(mask, P + W, pos)
                 pad = jnp.where(mask, pads[ix], pad)
@@ -869,26 +923,10 @@ def serve_fused(config: LlamaConfig, params, requests, max_new_tokens, *,
     _validate_workload(requests, budgets, prefill_width=prefill_width,
                        prefix_len=prefix_len, decode_chunk=decode_chunk,
                        ctx_size=config.ctx_size)
-    live = [(i, r, b) for i, (r, b) in enumerate(zip(requests, budgets))
-            if b > 0]
-    if not live:
+    packed = _pack_workload(requests, budgets, prefill_width)
+    if packed is None:
         return [[] for _ in requests]
-    # longest-budget-first (the host scheduler's makespan heuristic), then
-    # pad the table to coarse buckets so (N, cap) program variants stay
-    # bounded: N to the next power of two with budget-1 dummy requests
-    # (they briefly occupy tail slots — harmless), cap to a multiple of 16
-    live.sort(key=lambda irb: -irb[2])
-    N0 = len(live)
-    N = 1 << (N0 - 1).bit_length()
-    cap = -(-worst // 16) * 16
-    prompts = np.zeros((N, prefill_width), np.int32)
-    lengths = np.ones((N,), np.int32)
-    budg = np.ones((N,), np.int32)
-    for g, (_i, r, b) in enumerate(live):
-        prompts[g, :len(r)] = r
-        lengths[g] = len(r)
-        budg[g] = b
-    prompts[N0:, 0] = 1  # dummy one-token prompts, budget 1
+    live, N, cap, prompts, lengths, budg = packed
     if eos < 0:
         # budget mode: plan on host, execute one table-driven scan.  The
         # chunk count C is exact — a padded no-op chunk would cost K full
@@ -934,6 +972,266 @@ def serve_fused(config: LlamaConfig, params, requests, max_new_tokens, *,
     # EOS semantics need no host pass: each request owns its buffer row,
     # the device stops writing at the EOS, and the zeros past it are
     # exactly generate()'s pad
+    return _gather_results(out, live, len(requests))
+
+
+# -- fused speculative serving: continuous batching x draft+verify ---------
+
+
+@functools.lru_cache(maxsize=8)
+def _fused_spec_program(target_config: LlamaConfig,
+                        draft_config: LlamaConfig, max_batch: int,
+                        prefill_width: int, gamma: int, eos_id: int,
+                        cap: int, nr_requests: int):
+    """Compile continuous batching WITH speculative decoding into one
+    program: the :func:`_fused_program` while_loop scheduler whose body
+    unit is a draft+verify round (models/speculative.py) instead of a
+    plain decode chunk.
+
+    Per iteration, every lane runs the draft's 2-token catch-up +
+    ``gamma - 1`` single-token steps, ONE (gamma+1)-window target verify,
+    and commits its accepted prefix + correction — so a lane at
+    acceptance ``a`` emits ``a+1`` tokens per target pass, and the slot
+    machinery (admission into free lanes, budgets, EOS, recycling) rides
+    the same masked lane-select design.  Greedy only: every emitted token
+    is the target's own greedy continuation whatever the draft, so the
+    per-request outputs are BIT-IDENTICAL to solo ``generate()`` — the
+    oracle that pins the whole scheduler.
+
+    Lane state is O(1) per lane: no token ring buffer — the draft
+    catch-up needs only the last TWO committed tokens (a rolling pair),
+    and committed output goes straight to the (N, cap) output buffer.
+    """
+    tcfg = dataclasses.replace(target_config, decode=True)
+    dcfg = dataclasses.replace(draft_config, decode=True)
+    target, draft = Llama(tcfg), Llama(dcfg)
+    W, B, N, G = (prefill_width, max_batch, nr_requests, gamma)
+    _t_prefill = functools.partial(_right_aligned_prefill, target, W, 0)
+    _d_prefill = functools.partial(_right_aligned_prefill, draft, W, 0)
+
+    @jax.jit
+    def serve(tparams, dparams, prompts, lengths, budgets):
+        """prompts (N, W) right-padded; budgets (N,) >= 1.
+        -> out (N, cap): row i = request i's emitted tokens (col 0 = the
+        prefill token), zero-padded past its budget / EOS."""
+        tcache0 = _empty_cache_of(target, B, tparams)
+        dcache0 = _empty_cache_of(draft, B, dparams)
+        t_rows, firsts, pads = jax.vmap(
+            _t_prefill, in_axes=(None, 0, 0, None)
+        )(tparams, prompts, lengths, None)
+        d_rows, _, _ = jax.vmap(
+            _d_prefill, in_axes=(None, 0, 0, None)
+        )(dparams, prompts, lengths, None)
+        t_staged = jax.tree.map(lambda a: jnp.squeeze(a, axis=1), t_rows)
+        d_staged = jax.tree.map(lambda a: jnp.squeeze(a, axis=1), d_rows)
+        # the draft catch-up window [L-2, L) after admission covers the
+        # LAST PROMPT TOKEN (right-aligned: slot W-1) and the first
+        # generated token
+        lasts = jnp.take_along_axis(
+            prompts, (lengths - 1)[:, None], axis=1
+        )[:, 0]
+
+        def admit_all(state):
+            (tcache, dcache, pair, L, pad, slot_req, slot_budget, out,
+             out_n, nxt) = state
+            mask, ix, slot_req, slot_budget, out, out_n, nxt = \
+                _admit_bookkeeping(nxt, slot_req, slot_budget, out, out_n,
+                                   budgets, firsts, eos_id, N)
+            tcache = _lane_insert(tcache, t_staged, mask, ix, B)
+            dcache = _lane_insert(dcache, d_staged, mask, ix, B)
+            pair = jnp.where(
+                mask[:, None],
+                jnp.stack([lasts[ix], firsts[ix]], axis=1), pair,
+            )
+            L = jnp.where(mask, W + 1, L)
+            pad = jnp.where(mask, pads[ix], pad)
+            return (tcache, dcache, pair, L, pad, slot_req, slot_budget,
+                    out, out_n, nxt)
+
+        def spec_round(state):
+            (tcache, dcache, pair, L, pad, slot_req, slot_budget, out,
+             out_n, nxt) = state
+            # --- draft: catch-up + gamma-1 steps (speculative.py body,
+            # greedy, pair-fed) --------------------------------------
+            cpos = (L - 2)[:, None] + jnp.arange(2)[None, :]
+            clog, dv = draft.apply(
+                {**dparams, "cache": dcache},
+                pair, positions=cpos, pad=pad, mutable=["cache"],
+            )
+            dcache = dv["cache"]
+            p1 = jnp.argmax(clog[:, -1], axis=-1).astype(pair.dtype)
+            # gamma-1 plain draft steps: the ONE shared copy of the decode
+            # math (_decode_step) — bit-parity with every other serving
+            # path rests on it
+            (dcache, _, _), rest = jax.lax.scan(
+                functools.partial(_decode_step, draft, 0, dparams, pad),
+                (dcache, p1, L), None, length=G - 1,
+            )
+            props = jnp.concatenate([p1[:, None], rest.T], axis=1)  # (B,G)
+            # --- verify: one (gamma+1)-window target forward --------
+            win = jnp.concatenate([pair[:, 1:], props], axis=1)
+            pos = (L - 1)[:, None] + jnp.arange(G + 1)[None, :]
+            t_logits, tv = target.apply(
+                {**tparams, "cache": tcache},
+                win, positions=pos, pad=pad, mutable=["cache"],
+            )
+            tcache = tv["cache"]
+            tgt = jnp.argmax(t_logits, axis=-1).astype(pair.dtype)
+            match = (props == tgt[:, :G]).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)       # (B,)
+            corr = jnp.take_along_axis(tgt, a[:, None], axis=1)
+            cand = jnp.where(
+                jnp.arange(G + 1)[None, :] < a[:, None],
+                jnp.concatenate(
+                    [props, jnp.zeros((B, 1), props.dtype)], axis=1
+                ),
+                corr,
+            )  # (B, G+1)
+            # --- commit: budget clamp + EOS cut + output scatter ----
+            live = slot_req >= 0
+            commit = jnp.where(
+                live, jnp.minimum(a + 1, slot_budget), 0
+            )
+            if eos_id >= 0:
+                is_eos = (cand == eos_id).astype(jnp.int32)
+                # index of the first EOS in the candidate window (G+1 if
+                # none): EOS is kept, everything after it is cut
+                first_eos = jnp.sum(jnp.cumprod(1 - is_eos, axis=1),
+                                    axis=1)
+                hit = live & (first_eos < commit)
+                commit = jnp.minimum(commit, first_eos + 1)
+            else:
+                hit = jnp.zeros((B,), bool)
+            steps = jnp.arange(G + 1)[None, :]
+            rows = jnp.where(
+                live[:, None] & (steps < commit[:, None]),
+                slot_req[:, None], N,
+            )
+            cols = jnp.minimum(out_n[:, None] + steps, cap - 1)
+            out = out.at[rows, cols].set(cand.astype(out.dtype))
+            out_n = out_n + commit
+            slot_budget = jnp.where(hit, 0, slot_budget - commit)
+            # rolling pair -> tokens at [L'-2, L'-1]: index commit maps
+            # to L-2+commit in [pair | cand]
+            allt = jnp.concatenate([pair, cand], axis=1)  # (B, G+3)
+            pair = jnp.concatenate([
+                jnp.take_along_axis(allt, commit[:, None], axis=1),
+                jnp.take_along_axis(allt, commit[:, None] + 1, axis=1),
+            ], axis=1)
+            L = L + commit
+            slot_req = jnp.where(slot_budget > 0, slot_req, -1)
+            return (tcache, dcache, pair, L, pad, slot_req, slot_budget,
+                    out, out_n, nxt)
+
+        def body(state):
+            slot_req, nxt = state[5], state[9]
+            state = jax.lax.cond(
+                jnp.any(slot_req < 0) & (nxt < N), admit_all,
+                lambda s: s, state,
+            )
+            return spec_round(state)
+
+        def cond(state):
+            slot_budget, nxt = state[6], state[9]
+            return (nxt < N) | jnp.any(slot_budget > 0)
+
+        state = (
+            tcache0,
+            dcache0,
+            jnp.zeros((B, 2), jnp.int32),    # rolling last-two tokens
+            jnp.full((B,), 2, jnp.int32),    # L (>= 2: catch-up in bounds)
+            jnp.zeros((B,), jnp.int32),      # pad
+            jnp.full((B,), -1, jnp.int32),   # slot_req (-1 = free)
+            jnp.zeros((B,), jnp.int32),      # slot_budget
+            jnp.zeros((N + 1, cap), jnp.int32),  # out (+ dump row N)
+            jnp.zeros((B,), jnp.int32),      # out_n
+            jnp.int32(0),                    # next_req
+        )
+        state = jax.lax.while_loop(cond, body, state)
+        return state[7][:N]
+
+    return serve
+
+
+def serve_fused_speculative(target_config: LlamaConfig, target_params,
+                            draft_config: LlamaConfig, draft_params,
+                            requests, max_new_tokens, *, gamma: int = 4,
+                            max_batch: int = 8, prefill_width: int = 64,
+                            eos_id: int | None = None):
+    """One-dispatch continuous batching where every decode step is a
+    speculative draft+verify round: the target model runs one
+    (gamma+1)-window pass per ~(acceptance+1) committed tokens instead of
+    one bandwidth-bound single-token step per token, and requests still
+    join/leave the running batch at round boundaries.
+
+    Greedy semantics: per-request outputs are BIT-IDENTICAL to solo
+    ``generate()`` under the target (and so to ``serve_fused``) whatever
+    the draft proposes — the acceptance rate only changes the speed.
+    Same contract as :func:`serve_fused` otherwise (budgets per request
+    or one int; optional ``eos_id`` keeps the EOS and frees the slot).
+
+    The reference has no serving stack at all (SURVEY §2.2); this is the
+    framework's own composition of its continuous batching and
+    speculative decoding, fused for slow host<->device links.
+    """
+    if target_config.vocab_size != draft_config.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if max(target_config.decode_seq_shards,
+           draft_config.decode_seq_shards) > 1:
+        raise NotImplementedError(
+            "fused speculative serving over the sequence-sharded cache: "
+            "use one server per replica today"
+        )
+    target_config = target_config.with_resolved_decode_impl(target_params)
+    draft_config = draft_config.with_resolved_decode_impl(draft_params)
+    if isinstance(max_new_tokens, (int, np.integer)):
+        budgets = [int(max_new_tokens)] * len(requests)
+    else:
+        budgets = [int(b) for b in max_new_tokens]
+    eos = -1 if eos_id is None else int(eos_id)
+    worst = max(budgets, default=0)
+    # the verify window can scratch up to gamma slots past a lane's final
+    # committed length — both caches must absorb it
+    for name, cfg in (("target", target_config), ("draft", draft_config)):
+        if prefill_width + worst + gamma > cfg.ctx_size:
+            raise ValueError(
+                f"{name}: prefill_width + max_new_tokens + gamma "
+                f"({prefill_width}+{worst}+{gamma}) exceeds ctx_size "
+                f"({cfg.ctx_size})"
+            )
+    _validate_workload(requests, budgets, prefill_width=prefill_width,
+                       prefix_len=0, decode_chunk=1,
+                       ctx_size=target_config.ctx_size)
+    live = [(i, r, b) for i, (r, b) in enumerate(zip(requests, budgets))
+            if b > 0]
+    if not live:
+        return [[] for _ in requests]
+    live.sort(key=lambda irb: -irb[2])
+    N0 = len(live)
+    N = 1 << (N0 - 1).bit_length()
+    cap = -(-worst // 16) * 16
+    prompts = np.zeros((N, prefill_width), np.int32)
+    lengths = np.ones((N,), np.int32)
+    budg = np.ones((N,), np.int32)
+    for g, (_i, r, b) in enumerate(live):
+        prompts[g, :len(r)] = r
+        lengths[g] = len(r)
+        budg[g] = b
+    prompts[N0:, 0] = 1  # dummy one-token prompts, budget 1
+    serve = _fused_spec_program(
+        target_config, draft_config, max_batch, prefill_width, gamma, eos,
+        cap, N,
+    )
+    tparams = (target_params if "params" in target_params
+               else {"params": target_params})
+    dparams = (draft_params if "params" in draft_params
+               else {"params": draft_params})
+    out = np.asarray(serve(
+        tparams, dparams,
+        jnp.asarray(prompts), jnp.asarray(lengths), jnp.asarray(budg),
+    ))
     results: list = [[] for _ in requests]
     for g, (i, _r, b) in enumerate(live):
         results[i] = [int(t) for t in out[g, :b]]

@@ -55,6 +55,11 @@ def main() -> int:
                          "accepts ~nothing from any draft")
     ap.add_argument("--distill-steps", type=int, default=300)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--serve", action="store_true",
+                    help="after the gamma grid, A/B fused speculative "
+                         "serving (serve_fused_speculative at the best "
+                         "gamma) against plain fused serving on a "
+                         "staggered 16-request workload")
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--cache-dir", default="/tmp/spec_bench_cache",
                     help="host-side param cache so a tunnel transport drop "
@@ -284,6 +289,63 @@ def main() -> int:
               f"{rate_box['rate']:>7.2f} {speedup:>8.2f}", flush=True)
 
     best = max(rows, key=lambda r: r["speedup"])
+
+    serving = None
+    if args.serve:
+        # continuous batching x speculation: same staggered-workload shape
+        # as bench_serving (16 requests through 4 lanes), in-distribution
+        # prompts so acceptance matches the solo grid.  Both sides are
+        # one-dispatch programs; greedy outputs must agree exactly.
+        from ddl25spring_tpu.models.serving import (serve_fused,
+                                                    serve_fused_speculative)
+        rng = np.random.default_rng(11)
+        corpus = np.asarray(next(iter(token_stream(16, T_train, seed=2))))
+        n_req, lanes, w = 16, 4, 32
+        g = best["gamma"]
+        reqs = [[int(t) for t in corpus[i, :w]] for i in range(n_req)]
+        # staggered budgets, clamped so prefill + budget + gamma fits the
+        # ctx both models were built with (tiny smoke configs)
+        bmax = max(17, min(97, tcfg.ctx_size - w - g))
+        budgets = [int(b) for b in rng.integers(16, bmax, size=n_req)]
+
+        def run_plain():
+            return serve_fused(tcfg, params, reqs, budgets,
+                               max_batch=lanes, prefill_width=w,
+                               decode_chunk=8)
+
+        def run_spec():
+            return serve_fused_speculative(
+                tcfg, params, dcfg, dparams, reqs, budgets, gamma=g,
+                max_batch=lanes, prefill_width=w,
+            )
+
+        if run_plain() != run_spec():
+            raise AssertionError(
+                "fused speculative serving diverged from plain fused"
+            )
+
+        def timed_wall(fn):
+            best_s = float("inf")
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                fn()  # serve_* fetches host-side -> the call synchronizes
+                best_s = min(best_s, time.perf_counter() - t0)
+            return best_s
+
+        total = sum(budgets)
+        plain_sv = timed_wall(run_plain)
+        spec_sv = timed_wall(run_spec)
+        serving = {
+            "requests": n_req, "lanes": lanes,
+            "total_tokens": total, "gamma": g,
+            "plain_fused_tok_s": round(total / plain_sv, 1),
+            "spec_fused_tok_s": round(total / spec_sv, 1),
+            "speedup": round(plain_sv / spec_sv, 3),
+        }
+        print(f"fused serving: plain {total / plain_sv:.0f} tok/s | "
+              f"spec g={g} {total / spec_sv:.0f} tok/s | "
+              f"{plain_sv / spec_sv:.2f}x", flush=True)
+
     print(json.dumps({
         "metric": "speculative_decode",
         "backend": jax.default_backend(),
@@ -298,6 +360,7 @@ def main() -> int:
         "gammas": rows,
         "best_speedup": best["speedup"],
         "best_gamma": best["gamma"],
+        **({"serving": serving} if serving else {}),
     }), flush=True)
     return 0
 

@@ -1,0 +1,134 @@
+"""Fused speculative serving oracle (models/serving.py
+``serve_fused_speculative``).
+
+THE invariant, inherited from both parents: greedy speculative decoding
+emits exactly the target's greedy continuation whatever the draft
+(models/speculative.py), and slot-served greedy equals per-request
+``generate()`` (models/serving.py) — so continuous batching whose decode
+unit is a draft+verify round must STILL be bit-identical to solo
+``generate()`` under the target, through staggered admissions, slot
+recycling, per-request budgets and EOS.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.models.generate import generate
+from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+from ddl25spring_tpu.models.serving import (serve_fused,
+                                            serve_fused_speculative)
+
+TARGET = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                     nr_layers=2, ctx_size=48)
+DRAFT = LlamaConfig(vocab_size=97, dmodel=16, nr_heads=2, nr_layers=1,
+                    ctx_size=48)
+
+
+def _init(cfg, seed):
+    prompt = jnp.ones((1, 4), jnp.int32)
+    return Llama(cfg).init(jax.random.key(seed), prompt,
+                           positions=jnp.arange(4))
+
+
+@pytest.fixture(scope="module")
+def models():
+    return _init(TARGET, 0), _init(DRAFT, 1)
+
+
+def _oracle(params, prompt, max_new, eos_id=None):
+    p = jnp.asarray(prompt, jnp.int32)[None, :]
+    out = generate(TARGET, params, p, max_new, eos_id=eos_id)
+    return [int(t) for t in np.asarray(out[0, p.shape[1]:])]
+
+
+def test_matches_generate_staggered(models):
+    """5 requests through 2 lanes with an unrelated draft: admissions and
+    recycling happen while other lanes are mid-speculation, and every
+    request's output is still the target's exact greedy continuation."""
+    tparams, dparams = models
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 97, size=n).tolist()
+               for n in (3, 7, 4, 8, 5)]
+    max_new = 6
+    served = serve_fused_speculative(
+        TARGET, tparams, DRAFT, dparams, prompts, max_new, gamma=3,
+        max_batch=2, prefill_width=8,
+    )
+    for i, prompt in enumerate(prompts):
+        assert served[i] == _oracle(tparams, prompt, max_new), \
+            f"request {i}"
+
+
+def test_self_draft_matches_and_agrees_with_fused(models):
+    """draft == target accepts everything; outputs equal both the oracle
+    and plain serve_fused (the two fused schedulers may differ in rounds
+    but must agree token-for-token)."""
+    tparams, _ = models
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (4, 6, 3)]
+    max_new = 7
+    spec = serve_fused_speculative(
+        TARGET, tparams, TARGET, tparams, prompts, max_new, gamma=4,
+        max_batch=2, prefill_width=8,
+    )
+    plain = serve_fused(TARGET, tparams, prompts, max_new, max_batch=2,
+                        prefill_width=8)
+    assert spec == plain
+    for i, prompt in enumerate(prompts):
+        assert spec[i] == _oracle(tparams, prompt, max_new), f"request {i}"
+
+
+def test_per_request_budgets_and_zero(models):
+    tparams, dparams = models
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (5, 3, 6)]
+    budgets = [7, 0, 2]
+    served = serve_fused_speculative(
+        TARGET, tparams, DRAFT, dparams, prompts, budgets, gamma=3,
+        max_batch=2, prefill_width=8,
+    )
+    assert served[1] == []
+    for i in (0, 2):
+        assert served[i] == _oracle(tparams, prompts[i], budgets[i]), \
+            f"request {i}"
+
+
+def test_eos_matches_generate(models):
+    """EOS cuts INSIDE a committed speculative window: the EOS is kept,
+    later tokens of the same round are discarded, the slot frees."""
+    tparams, dparams = models
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (4, 6, 3)]
+    max_new = 8
+    outs = [_oracle(tparams, p, max_new) for p in prompts]
+    eos_id = next((c for c in range(97)
+                   if any(c in o for o in outs)
+                   and not all(c in o for o in outs)), None)
+    if eos_id is None:
+        pytest.skip("no token splits the oracle outputs at this seed")
+    served = serve_fused_speculative(
+        TARGET, tparams, DRAFT, dparams, prompts, max_new, gamma=3,
+        max_batch=2, prefill_width=8, eos_id=eos_id,
+    )
+    for i, prompt in enumerate(prompts):
+        want = _oracle(tparams, prompt, max_new, eos_id=eos_id)
+        assert served[i] == want, f"request {i}"
+
+
+def test_validation(models):
+    tparams, dparams = models
+    with pytest.raises(ValueError, match="vocabulary"):
+        serve_fused_speculative(
+            TARGET, tparams, dataclasses.replace(DRAFT, vocab_size=5),
+            dparams, [[1, 2]], 4,
+        )
+    with pytest.raises(ValueError, match="gamma"):
+        serve_fused_speculative(TARGET, tparams, DRAFT, dparams,
+                                [[1, 2]], 4, gamma=0)
+    with pytest.raises(ValueError, match="ctx_size"):
+        serve_fused_speculative(TARGET, tparams, DRAFT, dparams,
+                                [[1, 2]], 40, gamma=3, prefill_width=8)

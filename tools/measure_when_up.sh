@@ -72,7 +72,7 @@ EOF
     for attempt in 1 2; do
       SPEC_TMP=$(mktemp)
       timeout 2400 python examples/bench_speculative.py \
-        --dmodel 1536 --layers 16 \
+        --dmodel 1536 --layers 16 --serve \
         > "$SPEC_TMP" 2>> "$LOG"; rc=$?
       if [ -s "$SPEC_TMP" ] && { [ $rc -eq 0 ] || \
            [ ! -s results/spec_distilled_tpu.txt ] || \

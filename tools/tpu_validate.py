@@ -280,6 +280,36 @@ def main():
     check("flash_decode per-row pos vector", dec_rowpos_err, 1e-4,
           highest=True)
 
+    # prefix window (round-5 composition): the ragged garbage window
+    # shifts to [prefix_len, prefix_len + pad), real prefix KV below it.
+    # Mosaic must accept the shifted-mask comparisons the interpreter
+    # waves through.
+    P = 19
+    pos_pv = jnp.asarray([P + 6, S // 2, S - 1, P + 40], jnp.int32)
+
+    def dec_prefix_err(q=q, ck=ck, cv=cv, pad=pad, pos_v=pos_pv):
+        got = jax.jit(
+            lambda *a: flash_decode_attention(
+                *a, prefix_len=P, interpret=INTERPRET
+            )
+        )(q, ck, cv, pos_v, pad)
+        g = 8 // 4
+        qg = q.reshape(B, 4, g, hd)
+        scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+        s = jnp.einsum("bkgd,bskd->bkgs", qg, ck).astype(jnp.float32)
+        s = s * scale
+        slot = jnp.arange(S)[None, :]
+        valid = (slot <= pos_v[:, None]) & (
+            (slot < P) | (slot >= P + pad[:, None])
+        )
+        s = jnp.where(valid[:, None, None], s, -jnp.inf)
+        att = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        want = jnp.einsum("bkgs,bskd->bkgd", att, cv).reshape(B, 8, hd)
+        return jnp.max(jnp.abs(got - want))
+
+    check("flash_decode prefix window (per-row pos)", dec_prefix_err, 1e-4,
+          highest=True)
+
     # --- end-to-end: generation with flash-decode vs xla decode ----------
     # Scored as the FRACTION of generated tokens that differ: a wiring or
     # lowering bug gives near-random agreement (~1/vocab); ulp-level
