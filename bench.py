@@ -27,6 +27,8 @@ import sys
 import threading
 import time
 
+from ddl25spring_tpu import obs  # jax-free import; no-op until enabled
+
 # Measured on this container 2026-07-29 with --measure-cpu-baseline
 # (sequential reference architecture, jitted per-client updates, JAX CPU):
 # 693.8 s/round.
@@ -364,7 +366,16 @@ def _probe_device_with_retry(attempts: int = 6, timeout_s: float = 90.0,
     for i in range(attempts):
         _stamp(f"device probe attempt {i + 1}/{attempts} "
                f"(timeout {timeout_s:.0f}s) ...")
-        if _probe_device(timeout_s):
+        t0 = time.perf_counter()
+        up = _probe_device(timeout_s)
+        # structured probe trail (round 5 ran blind for ~10 min against an
+        # unreachable device with only log-tail evidence): one event per
+        # attempt, flushed line-by-line, survives the os._exit failure path
+        obs.event("bench.probe", attempt=i + 1, attempts=attempts,
+                  timeout_s=timeout_s,
+                  outcome="ok" if up else "timeout",
+                  elapsed_s=round(time.perf_counter() - t0, 3))
+        if up:
             _stamp("device reachable")
             return True
         if i < attempts - 1:
@@ -490,6 +501,13 @@ def main():
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the timed rounds "
                          "into DIR (view with xprof/tensorboard)")
+    ap.add_argument("--telemetry", metavar="PATH",
+                    default="results/bench_telemetry.jsonl",
+                    help="telemetry JSONL path (ddl25spring_tpu.obs): probe "
+                         "events, spans, and a final summary land here on "
+                         "EVERY run, --profile or not; render with "
+                         "tools/obs_report.py.  Pass an empty string to "
+                         "disable")
     ap.add_argument("--deadline-s", type=float, default=1500.0,
                     help="no-progress (idle) cap after the device probe: if "
                          "no milestone or transfer-chunk stamp lands for "
@@ -506,15 +524,22 @@ def main():
         measure_cpu_baseline()
         return
 
+    if args.telemetry:
+        # enabled AFTER select_platform (enable() pulls jax via the JSONL
+        # sink); MetricsLogger flushes per line, so probe events survive
+        # even the os._exit failure path below
+        os.makedirs(os.path.dirname(args.telemetry) or ".", exist_ok=True)
+        obs.enable(args.telemetry)
+        _stamp(f"telemetry -> {args.telemetry}")
+
     _stamp("probing device ...")
     if not _probe_device_with_retry():
+        obs.flush()
         # one well-formed JSON line either way: a hung tunnel must not hang
         # the driver, and value 0 is unambiguous about what happened
         _emit_json(0.0, error="device unreachable: trivial op never "
                               "completed across 6 probe attempts over "
                               "~10 min (remote TPU tunnel down?)")
-        import os
-
         # nonzero so scripts/CI keyed on exit status see the failure; daemon
         # probe threads may be wedged in the backend, so skip shutdown
         os._exit(1)
@@ -557,6 +582,12 @@ def main():
 
     rps = statistics.median(rates)
     spread_pct = (100.0 * (max(rates) - min(rates)) / rps) if rps else 0.0
+    if obs.enabled():
+        obs.set_gauge("bench_rounds_per_sec", rps)
+        obs.event("bench.result", rounds_per_sec=round(rps, 4),
+                  final_test_accuracy_pct=round(final_acc, 2),
+                  trials=[round(r, 4) for r in rates])
+        obs.flush()
     # trial 1 of a freshly compiled program is consistently ~25% slower
     # (one-time program-load / warm-path cost over the tunnel, ~0.9 s at
     # bench scale) — the round-4 ledger-vs-driver discrepancy in one field

@@ -31,7 +31,18 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..utils.trees import tree_weighted_mean
+
+
+def _tree_bytes(tree) -> int:
+    """Total payload bytes of a pytree of arrays (host-side, shape math
+    only — used to account aggregation traffic in telemetry)."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "size") and hasattr(leaf, "dtype")
+    )
 
 # A loss function of (params, x_batch, y_batch, mask, rng_key) -> scalar.
 LossFn = Callable[..., jax.Array]
@@ -472,7 +483,25 @@ def make_fl_round(
         return apply_aggregate(params, aggregate)
 
     def round_fn(params, base_key, round_idx):
-        return _round(params, base_key, round_idx, x, y, counts, mal_mask)
+        # telemetry wraps the DISPATCH boundary only; under an outer
+        # trace (or with obs disabled) this is the bare jitted call.
+        # bench.py's fused fori_loop path uses round_fn.raw directly and
+        # is untouched either way.
+        if not obs.enabled() or isinstance(round_idx, jax.core.Tracer):
+            return _round(params, base_key, round_idx, x, y, counts,
+                          mal_mask)
+        with obs.span("fl.round") as sp:
+            new_params = sp.fence(
+                _round(params, base_key, round_idx, x, y, counts, mal_mask)
+            )
+        obs.inc("fl_rounds_total")
+        obs.inc("fl_clients_sampled_total", nr_sampled)
+        obs.set_gauge("fl_clients_per_round", nr_sampled)
+        # traffic model: each sampled client downloads + uploads one full
+        # param tree per round (2 messages/client, servers.py's count)
+        obs.inc("fl_bytes_aggregated_total",
+                2 * nr_sampled * _tree_bytes(new_params))
+        return new_params
 
     # expose the raw jitted step + its device-resident data so callers can
     # compose rounds INSIDE one jit (e.g. bench.py fuses N timed rounds into

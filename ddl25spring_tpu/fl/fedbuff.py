@@ -29,8 +29,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..utils.trees import tree_weighted_mean
-from .engine import sample_clients
+from .engine import _tree_bytes, sample_clients
 from .servers import DecentralizedServer as _DecentralizedServer
 
 
@@ -99,7 +100,22 @@ def make_fedbuff_round(
         )
 
     def tick(history, base_key, tick_idx):
-        return _tick(history, base_key, tick_idx, x, y, counts)
+        # dispatch-boundary telemetry, same shape as engine.make_fl_round's
+        # round_fn (skipped under an outer trace / with obs disabled)
+        if not obs.enabled() or isinstance(tick_idx, jax.core.Tracer):
+            return _tick(history, base_key, tick_idx, x, y, counts)
+        with obs.span("fl.tick", staleness_window=W) as sp:
+            new_history = sp.fence(
+                _tick(history, base_key, tick_idx, x, y, counts)
+            )
+        obs.inc("fl_rounds_total")
+        obs.inc("fl_clients_sampled_total", nr_sampled)
+        obs.set_gauge("fl_clients_per_round", nr_sampled)
+        # per-client traffic is ONE model version each way, not the whole
+        # W-deep history
+        obs.inc("fl_bytes_aggregated_total",
+                2 * nr_sampled * (_tree_bytes(new_history) // W))
+        return new_history
 
     return tick
 

@@ -61,12 +61,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from .llama import Llama, LlamaConfig
 
 
@@ -308,6 +310,33 @@ class ContinuousBatcher:
         # serving telemetry: how full the batch ran, admissions, steps
         self.stats = {"decode_steps": 0, "slot_steps": 0, "active_steps": 0,
                       "admitted": 0}
+        # obs stamps: rid -> submit/run-entry perf_counter (only written
+        # while telemetry is enabled; queue-wait and request-latency
+        # histograms are derived from these host-side)
+        self._req_ts: dict = {}
+
+    # -- telemetry (all no-ops while ddl25spring_tpu.obs is disabled) ----
+
+    def _obs_admitted(self, admissions):
+        """Queue-wait per admitted request: admission is when a request
+        stops waiting and starts occupying a lane."""
+        if not self._req_ts:
+            return
+        now = time.perf_counter()
+        for _s, rid, _p, _b in admissions:
+            t0 = self._req_ts.get(rid)
+            if t0 is not None:
+                obs.observe("serving_queue_wait_seconds", now - t0)
+
+    def _obs_finish(self, rids):
+        """Request latency at the moment tokens became host-visible."""
+        if not self._req_ts:
+            return
+        now = time.perf_counter()
+        for rid in rids:
+            t0 = self._req_ts.pop(rid, None)
+            if t0 is not None:
+                obs.observe("serving_request_seconds", now - t0)
 
     # -- scheduling ------------------------------------------------------
 
@@ -316,6 +345,7 @@ class ContinuousBatcher:
         in ONE device dispatch.  Returns the (G,) first-token device array
         (lane g belongs to admissions[g]); nothing is fetched here."""
         G0 = len(admissions)
+        self._obs_admitted(admissions)
         G = 1 << (G0 - 1).bit_length()  # pad group to a power of two
         W = self.prefill_width
         rows = np.zeros((G, W), np.int32)
@@ -330,11 +360,15 @@ class ContinuousBatcher:
         rows[G0:] = rows[G0 - 1]
         lengths[G0:] = lengths[G0 - 1]
         slot_ix[G0:] = slot_ix[G0 - 1]
-        self.cache, self.tokens, self.pos, self.pad, firsts = self._admit_fn(
-            self.params, self.cache, jnp.asarray(rows), jnp.asarray(lengths),
-            jnp.asarray(slot_ix), self.tokens, self.pos, self.pad,
-            self._prefix_cache,
-        )
+        # span times DISPATCH only (no fence): budget mode's pipelining —
+        # never block on device results mid-run — is the whole design
+        with obs.span("serving.admit", group=G0):
+            (self.cache, self.tokens, self.pos, self.pad,
+             firsts) = self._admit_fn(
+                self.params, self.cache, jnp.asarray(rows),
+                jnp.asarray(lengths), jnp.asarray(slot_ix), self.tokens,
+                self.pos, self.pad, self._prefix_cache,
+            )
         for g, (s, rid, _prompt, budget) in enumerate(admissions):
             sl = self.slots[s]
             sl.request_id = rid
@@ -367,6 +401,7 @@ class ContinuousBatcher:
         ``resolve`` fetches refs now (EOS mode resolves eagerly as part of
         its per-chunk fetch; budget mode defers — run() resolves all
         requests in one pass at the end)."""
+        done_rids = []
         for s, sl in enumerate(self.slots):
             if sl.free:
                 continue
@@ -379,7 +414,13 @@ class ContinuousBatcher:
                         out = out[:cut]
                     out = out + [0] * (sl.total - len(out))
                 finished[sl.request_id] = out
+                done_rids.append(sl.request_id)
                 self.slots[s] = _Slot()
+        if resolve:
+            # tokens are host ints right here — this IS completion.  In
+            # budget mode (resolve=False) nothing has been fetched yet;
+            # run() observes completion after its single end-of-run fetch.
+            self._obs_finish(done_rids)
 
     def run(self, requests, max_new_tokens):
         """Serve ``requests`` (list of 1-D int token prompts); returns a
@@ -425,34 +466,54 @@ class ContinuousBatcher:
         # the recorded refs in one fetch at the end.
         eos_mode = self.eos_id >= 0
         pending = [(rid, prompt, budgets[rid]) for rid, prompt in pending]
-        while len(finished) < len(requests):
-            group = self._admit_from(pending)
-            if group:
-                firsts = self._admit_group(group)
+        telem = obs.enabled()
+        if telem:
+            t_run = time.perf_counter()
+            self._req_ts.update(
+                (rid, t_run) for rid, _p, _b in pending
+            )
+        with obs.span("serving.run", requests=len(requests),
+                      mode="eos" if eos_mode else "budget"):
+            while len(finished) < len(requests):
+                group = self._admit_from(pending)
+                if group:
+                    firsts = self._admit_group(group)
+                    if eos_mode:
+                        self._sync_admit_bookkeep(group, firsts)
+                self._harvest(finished, resolve=eos_mode)
+                active = [s for s, sl in enumerate(self.slots)
+                          if not sl.free]
+                if not active:
+                    continue
+                K = self.decode_chunk
+                toks = self._dispatch_chunk()
                 if eos_mode:
-                    self._sync_admit_bookkeep(group, firsts)
-            self._harvest(finished, resolve=eos_mode)
-            active = [s for s, sl in enumerate(self.slots) if not sl.free]
-            if not active:
-                continue
-            K = self.decode_chunk
-            toks = self._dispatch_chunk()
-            if eos_mode:
-                self._sync_chunk_bookkeep(active, toks)
-            else:
-                for s in active:
-                    sl = self.slots[s]
-                    use = min(K, sl.budget)
-                    if use > 0:
-                        sl.emitted.append((toks, s, use))
-                        sl.budget -= use
-                        self.stats["active_steps"] += use
-            self._harvest(finished, resolve=eos_mode)
-        if not eos_mode:
-            fetched: dict = {}  # shared across requests: chunk arrays
-            for rid, refs in finished.items():
-                if refs:
-                    finished[rid] = self._resolve(refs, fetched)
+                    self._sync_chunk_bookkeep(active, toks)
+                else:
+                    for s in active:
+                        sl = self.slots[s]
+                        use = min(K, sl.budget)
+                        if use > 0:
+                            sl.emitted.append((toks, s, use))
+                            sl.budget -= use
+                            self.stats["active_steps"] += use
+                self._harvest(finished, resolve=eos_mode)
+            if not eos_mode:
+                fetched: dict = {}  # shared across requests: chunk arrays
+                for rid, refs in finished.items():
+                    if refs:
+                        finished[rid] = self._resolve(refs, fetched)
+                # the resolve fetch above was the run's ONE block — every
+                # deferred request completed here
+                self._obs_finish(list(self._req_ts))
+        if telem:
+            elapsed = time.perf_counter() - t_run
+            nr_tokens = sum(len(v) for v in finished.values())
+            obs.inc("serving_requests_total", len(requests))
+            obs.inc("serving_tokens_total", nr_tokens)
+            if elapsed > 0:
+                obs.set_gauge("serving_tokens_per_sec",
+                              nr_tokens / elapsed)
         return [finished[i] for i in range(len(requests))]
 
     def _dispatch_chunk(self):
@@ -460,10 +521,13 @@ class ContinuousBatcher:
         tokens and the step telemetry, returns the (B, K) token array.
         Shared by run() and the streaming step()."""
         K = self.decode_chunk
-        self.cache, toks, self.pos, self.tokens = self._decode(
-            self.params, self.cache, self.tokens, self.pos, self.pad,
-            nr=K,
-        )
+        # dispatch-boundary span, unfenced: budget mode streams chunks
+        # back-to-back and a block here would serialise the pipeline
+        with obs.span("serving.decode", chunk=K):
+            self.cache, toks, self.pos, self.tokens = self._decode(
+                self.params, self.cache, self.tokens, self.pos, self.pad,
+                nr=K,
+            )
         self.stats["decode_steps"] += K
         self.stats["slot_steps"] += self.max_batch * K
         return toks
@@ -529,6 +593,8 @@ class ContinuousBatcher:
             prefix_len=self.prefix_len, decode_chunk=self.decode_chunk,
             ctx_size=self.config.ctx_size,
         )
+        if obs.enabled():
+            self._req_ts[rid] = time.perf_counter()
         if budget == 0:
             self._instant[rid] = []
             return
@@ -544,6 +610,7 @@ class ContinuousBatcher:
         (one program)."""
         finished: dict = dict(self._instant)
         self._instant.clear()
+        self._obs_finish(list(finished))  # zero-budget instants
         group = self._admit_from(self._queue)
         if group:
             self._sync_admit_bookkeep(group, self._admit_group(group))
@@ -552,6 +619,10 @@ class ContinuousBatcher:
         if active:
             self._sync_chunk_bookkeep(active, self._dispatch_chunk())
             self._harvest(finished, resolve=True)
+        if finished and obs.enabled():
+            obs.inc("serving_requests_total", len(finished))
+            obs.inc("serving_tokens_total",
+                    sum(len(v) for v in finished.values()))
         return finished
 
     def drain(self) -> dict:
@@ -643,6 +714,21 @@ def _gather_results(out, live, nr_requests: int):
     for g, (i, _r, b) in enumerate(live):
         results[i] = [int(t) for t in out[g, :b]]
     return results
+
+
+def _obs_fused_done(t0: float, results, live):
+    """Telemetry tail shared by the fused entry points (caller checks
+    ``obs.enabled()``): a fused run is one dispatch + one fetch, so every
+    live request completes AT the fetch — each observes the same
+    end-to-end latency, and tokens/sec is the workload total over it."""
+    elapsed = time.perf_counter() - t0
+    nr_tokens = sum(len(r) for r in results)
+    obs.inc("serving_requests_total", len(results))
+    obs.inc("serving_tokens_total", nr_tokens)
+    for _ in live:
+        obs.observe("serving_request_seconds", elapsed)
+    if elapsed > 0:
+        obs.set_gauge("serving_tokens_per_sec", nr_tokens / elapsed)
 
 
 @functools.lru_cache(maxsize=8)
@@ -904,7 +990,16 @@ def serve_fused(config: LlamaConfig, params, requests, max_new_tokens, *,
 
     Use this when the host<->device link is slow (remote tunnels, congested
     PCIe) or the workload is known up front; use ``ContinuousBatcher`` when
-    requests arrive over time or you need token streaming."""
+    requests arrive over time or you need token streaming.
+
+    Numerical caveat: bit-identity across serving paths assumes they run
+    the SAME attention implementation.  The flash-decode kernel
+    (``decode_impl='flash'``) and the einsum path reduce in different
+    orders — last-ulp logit differences can flip an argmax near a tie, so
+    parity ACROSS ``decode_impl`` settings is checked empirically (the
+    TPU A/B in ``examples/bench_speculative.py --serve``), not
+    guaranteed.  Within one ``decode_impl`` the oracle tests pin exact
+    equality."""
     if config.decode_seq_shards > 1:
         raise NotImplementedError(
             "fused serving over the sequence-sharded cache: use one "
@@ -927,6 +1022,8 @@ def serve_fused(config: LlamaConfig, params, requests, max_new_tokens, *,
     if packed is None:
         return [[] for _ in requests]
     live, N, cap, prompts, lengths, budg = packed
+    telem = obs.enabled()
+    t0 = time.perf_counter() if telem else 0.0
     if eos < 0:
         # budget mode: plan on host, execute one table-driven scan.  The
         # chunk count C is exact — a padded no-op chunk would cost K full
@@ -941,14 +1038,19 @@ def serve_fused(config: LlamaConfig, params, requests, max_new_tokens, *,
             config, max_batch, prefill_width, prefix_len, decode_chunk,
             N, C,
         )
-        firsts, toks = serve(
-            params, jnp.asarray(prompts), jnp.asarray(lengths),
-            jnp.asarray(admit_req), prefix_cache,
-        )
-        # host assembly from the planner's own tables: the device returned
-        # pure compute (firsts + the raw (C, B, K) token tensor); which
-        # (chunk, lane, step) belongs to which request is host knowledge
-        firsts, toks = np.asarray(firsts), np.asarray(toks)
+        # span covers dispatch AND the fetch below (np.asarray blocks), so
+        # wall time is the true end-to-end serve time — no extra fence
+        with obs.span("serving.fused", requests=len(live), mode="budget",
+                      chunks=int(C)):
+            firsts, toks = serve(
+                params, jnp.asarray(prompts), jnp.asarray(lengths),
+                jnp.asarray(admit_req), prefix_cache,
+            )
+            # host assembly from the planner's own tables: the device
+            # returned pure compute (firsts + the raw (C, B, K) token
+            # tensor); which (chunk, lane, step) belongs to which request
+            # is host knowledge
+            firsts, toks = np.asarray(firsts), np.asarray(toks)
         by_req: list = [[] for _ in range(N)]
         for g in range(N):
             by_req[g].append(int(firsts[g]))
@@ -960,19 +1062,25 @@ def serve_fused(config: LlamaConfig, params, requests, max_new_tokens, *,
         results: list = [[] for _ in requests]
         for g, (i, _r, b) in enumerate(live):
             results[i] = by_req[g]
+        if telem:
+            _obs_fused_done(t0, results, live)
         return results
     serve, _ = _fused_program(
         config, max_batch, prefill_width, prefix_len, decode_chunk, eos,
         cap, N,
     )
-    out = np.asarray(serve(
-        params, jnp.asarray(prompts), jnp.asarray(lengths),
-        jnp.asarray(budg), prefix_cache,
-    ))
+    with obs.span("serving.fused", requests=len(live), mode="eos"):
+        out = np.asarray(serve(
+            params, jnp.asarray(prompts), jnp.asarray(lengths),
+            jnp.asarray(budg), prefix_cache,
+        ))
     # EOS semantics need no host pass: each request owns its buffer row,
     # the device stops writing at the EOS, and the zeros past it are
     # exactly generate()'s pad
-    return _gather_results(out, live, len(requests))
+    results = _gather_results(out, live, len(requests))
+    if telem:
+        _obs_fused_done(t0, results, live)
+    return results
 
 
 # -- fused speculative serving: continuous batching x draft+verify ---------
@@ -1033,7 +1141,7 @@ def _fused_spec_program(target_config: LlamaConfig,
 
         def admit_all(state):
             (tcache, dcache, pair, L, pad, slot_req, slot_budget, out,
-             out_n, nxt) = state
+             out_n, nxt, n_prop, n_acc) = state
             mask, ix, slot_req, slot_budget, out, out_n, nxt = \
                 _admit_bookkeeping(nxt, slot_req, slot_budget, out, out_n,
                                    budgets, firsts, eos_id, N)
@@ -1046,11 +1154,11 @@ def _fused_spec_program(target_config: LlamaConfig,
             L = jnp.where(mask, W + 1, L)
             pad = jnp.where(mask, pads[ix], pad)
             return (tcache, dcache, pair, L, pad, slot_req, slot_budget,
-                    out, out_n, nxt)
+                    out, out_n, nxt, n_prop, n_acc)
 
         def spec_round(state):
             (tcache, dcache, pair, L, pad, slot_req, slot_budget, out,
-             out_n, nxt) = state
+             out_n, nxt, n_prop, n_acc) = state
             # --- draft: catch-up + gamma-1 steps (speculative.py body,
             # greedy, pair-fed) --------------------------------------
             cpos = (L - 2)[:, None] + jnp.arange(2)[None, :]
@@ -1089,6 +1197,12 @@ def _fused_spec_program(target_config: LlamaConfig,
             )  # (B, G+1)
             # --- commit: budget clamp + EOS cut + output scatter ----
             live = slot_req >= 0
+            # acceptance accumulators: IN-BUDGET proposals only, the same
+            # counting discipline as speculative.py's rate (a clamped
+            # final round must not deflate it; self-draft reports 1.0)
+            in_budget = jnp.where(live, jnp.minimum(G, slot_budget), 0)
+            n_prop = n_prop + jnp.sum(in_budget)
+            n_acc = n_acc + jnp.sum(jnp.minimum(a, in_budget))
             commit = jnp.where(
                 live, jnp.minimum(a + 1, slot_budget), 0
             )
@@ -1121,7 +1235,7 @@ def _fused_spec_program(target_config: LlamaConfig,
             L = L + commit
             slot_req = jnp.where(slot_budget > 0, slot_req, -1)
             return (tcache, dcache, pair, L, pad, slot_req, slot_budget,
-                    out, out_n, nxt)
+                    out, out_n, nxt, n_prop, n_acc)
 
         def body(state):
             slot_req, nxt = state[5], state[9]
@@ -1146,9 +1260,11 @@ def _fused_spec_program(target_config: LlamaConfig,
             jnp.zeros((N + 1, cap), jnp.int32),  # out (+ dump row N)
             jnp.zeros((B,), jnp.int32),      # out_n
             jnp.int32(0),                    # next_req
+            jnp.int32(0),                    # n_prop (in-budget proposals)
+            jnp.int32(0),                    # n_acc (accepted of those)
         )
         state = jax.lax.while_loop(cond, body, state)
-        return state[7][:N]
+        return state[7][:N], state[10], state[11]
 
     return serve
 
@@ -1204,22 +1320,12 @@ def serve_fused_speculative(target_config: LlamaConfig, target_params,
     _validate_workload(requests, budgets, prefill_width=prefill_width,
                        prefix_len=0, decode_chunk=1,
                        ctx_size=target_config.ctx_size)
-    live = [(i, r, b) for i, (r, b) in enumerate(zip(requests, budgets))
-            if b > 0]
-    if not live:
+    # the ONE host packer both fused servers share (_pack_workload): the
+    # two schedulers must see identical workload layouts or they drift
+    packed = _pack_workload(requests, budgets, prefill_width)
+    if packed is None:
         return [[] for _ in requests]
-    live.sort(key=lambda irb: -irb[2])
-    N0 = len(live)
-    N = 1 << (N0 - 1).bit_length()
-    cap = -(-worst // 16) * 16
-    prompts = np.zeros((N, prefill_width), np.int32)
-    lengths = np.ones((N,), np.int32)
-    budg = np.ones((N,), np.int32)
-    for g, (_i, r, b) in enumerate(live):
-        prompts[g, :len(r)] = r
-        lengths[g] = len(r)
-        budg[g] = b
-    prompts[N0:, 0] = 1  # dummy one-token prompts, budget 1
+    live, N, cap, prompts, lengths, budg = packed
     serve = _fused_spec_program(
         target_config, draft_config, max_batch, prefill_width, gamma, eos,
         cap, N,
@@ -1228,11 +1334,19 @@ def serve_fused_speculative(target_config: LlamaConfig, target_params,
                else {"params": target_params})
     dparams = (draft_params if "params" in draft_params
                else {"params": draft_params})
-    out = np.asarray(serve(
-        tparams, dparams,
-        jnp.asarray(prompts), jnp.asarray(lengths), jnp.asarray(budg),
-    ))
-    results: list = [[] for _ in requests]
-    for g, (i, _r, b) in enumerate(live):
-        results[i] = [int(t) for t in out[g, :b]]
+    telem = obs.enabled()
+    t0 = time.perf_counter() if telem else 0.0
+    with obs.span("serving.fused_spec", requests=len(live), gamma=gamma):
+        out, n_prop, n_acc = serve(
+            tparams, dparams,
+            jnp.asarray(prompts), jnp.asarray(lengths), jnp.asarray(budg),
+        )
+        out = np.asarray(out)  # the one blocking fetch
+    results = _gather_results(out, live, len(requests))
+    if telem:
+        # counters ride the scalars the program already returns — the
+        # extra fetch happens only with telemetry on
+        obs.inc("spec_proposed_total", int(n_prop))
+        obs.inc("spec_accepted_total", int(n_acc))
+        _obs_fused_done(t0, results, live)
     return results

@@ -51,6 +51,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from .generate import _check_prompt_lengths, _filter_logits, _left_align
 from .llama import Llama, LlamaConfig
 
@@ -167,6 +168,23 @@ def speculative_generate(
     filters): the target distribution is the FILTERED one, and the draft
     filters its own proposals the same way — a proposal outside the
     target's candidate set simply has ``qt = 0`` and is always rejected.
+
+    Numerical caveat: "bit-identical to plain greedy decode" holds when
+    both paths run the SAME attention implementation.  The flash-decode
+    kernel (``decode_impl='flash'``) and the einsum path reduce in
+    different orders, so their logits can differ in the last ulp and an
+    argmax near a tie may flip — greedy parity across ``decode_impl``
+    settings is an empirical claim, checked on TPU by the
+    ``examples/bench_speculative.py --serve`` A/B, not a theorem.  Within
+    one ``decode_impl`` the bit-identity oracle holds everywhere
+    (tests/test_speculative.py).
+
+    When telemetry is enabled (``ddl25spring_tpu.obs``), each call feeds
+    the round's in-budget proposed/accepted totals into the
+    ``spec_proposed_total`` / ``spec_accepted_total`` counters, so the
+    cumulative counter ratio equals the proposal-weighted mean of the
+    per-call ``rate``.  (Skipped under tracing — e.g. inside
+    ``parallel/sp.py``'s sharded jit — where the counts are abstract.)
     """
     if target_config.vocab_size != draft_config.vocab_size:
         raise ValueError("draft and target must share a vocabulary")
@@ -251,8 +269,17 @@ def speculative_generate(
     run = _spec_fn(target_config, draft_config, gamma, float(temperature),
                    int(top_k), float(top_p), B, T0, max_new_tokens, eos_id,
                    prefix_len)
-    return run(tparams, dparams, tokens0, pad, key,
-               t_pref_cache, d_pref_cache)
+    out, rate, n_prop, n_acc = run(tparams, dparams, tokens0, pad, key,
+                                   t_pref_cache, d_pref_cache)
+    # feed the acceptance counters host-side, from values the program
+    # already returns — never from inside the trace.  Under an outer jit /
+    # shard_map (parallel/sp.py) the counts are tracers: skip, the inner
+    # program still returns its rate.
+    if obs.enabled() and not isinstance(n_prop, jax.core.Tracer):
+        obs.inc("spec_proposed_total", int(n_prop))
+        obs.inc("spec_accepted_total", int(n_acc))
+        obs.inc("spec_calls_total")
+    return out, rate
 
 
 @functools.lru_cache(maxsize=32)
@@ -507,6 +534,8 @@ def _spec_fn(target_config, draft_config, gamma, temperature, top_k, top_p,
             # slots strictly AFTER a row's first generated EOS become 0
             hits = jnp.cumsum(hit.astype(jnp.int32), axis=1)
             out = jnp.where(hits - hit.astype(jnp.int32) >= 1, 0, out)
-        return out, rate
+        # raw counts ride along so the caller can feed telemetry counters
+        # host-side; the public contract stays (tokens, rate)
+        return out, rate, n_prop, n_acc
 
     return run

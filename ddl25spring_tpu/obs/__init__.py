@@ -1,0 +1,116 @@
+"""Process-global telemetry: no-op by default, one call to turn on.
+
+Importing this package never imports jax (guarded by
+``tests/test_obs.py``), so CPU-only CI and host tools can use it freely.
+Telemetry is OFF until :func:`enable` is called; every module-level helper
+(:func:`span`, :func:`inc`, :func:`observe`, :func:`set_gauge`,
+:func:`event`) short-circuits on a single ``is None`` check when disabled —
+no allocation, no locking, no event writes — so instrumented library code
+pays nothing in the default configuration.
+
+Typical use::
+
+    from ddl25spring_tpu import obs
+
+    obs.enable("results/telemetry.jsonl")       # JSONL sink via MetricsLogger
+    ...                                          # instrumented code runs
+    obs.flush()                                  # one telemetry_summary event
+    print(obs.render_prom())                     # Prometheus text exposition
+
+Library code instruments unconditionally::
+
+    with obs.span("serving.decode", chunk=k) as sp:
+        out = dispatch(...)          # sp.fence(out) to also time the device
+
+See ``docs/OBSERVABILITY.md`` for the event schema and
+``tools/obs_report.py`` for rendering the JSONL into a report.
+"""
+
+from __future__ import annotations
+
+from .core import (DEFAULT_BUCKETS, NULL_SPAN, Counter, Gauge, Histogram,
+                   Telemetry)
+
+__all__ = [
+    "Telemetry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
+    "enable", "disable", "enabled", "get",
+    "span", "inc", "observe", "set_gauge", "event", "flush", "render_prom",
+]
+
+_T: Telemetry | None = None
+
+
+def enable(jsonl_path=None, *, sink=None, echo: bool = False) -> Telemetry:
+    """Turn telemetry on process-wide and return the registry.
+
+    ``jsonl_path`` opens a ``MetricsLogger`` JSONL sink there (this is the
+    one place obs touches ``utils.logging``, lazily — that import pulls
+    jax, which any process calling ``enable`` has anyway); ``sink`` passes
+    an explicit ``log(event, **fields)`` object instead; neither means
+    instruments aggregate in-process only (no event stream).  Calling
+    ``enable`` again replaces the registry (fresh instruments)."""
+    global _T
+    if sink is None and jsonl_path is not None:
+        from ..utils.logging import MetricsLogger
+        sink = MetricsLogger(jsonl_path, echo=echo)
+    _T = Telemetry(sink=sink)
+    return _T
+
+
+def disable():
+    """Turn telemetry off: helpers return to their no-op fast path."""
+    global _T
+    _T = None
+
+
+def enabled() -> bool:
+    return _T is not None
+
+
+def get() -> Telemetry | None:
+    """The active registry, or None when disabled — for code that needs
+    direct instrument access (``obs.get().render_prom()``...)."""
+    return _T
+
+
+def span(name: str, **fields):
+    """Timing context manager (see :meth:`Telemetry.span`); a shared no-op
+    when disabled."""
+    t = _T
+    return NULL_SPAN if t is None else t.span(name, **fields)
+
+
+def inc(name: str, n=1, **labels):
+    t = _T
+    if t is not None:
+        t.counter(name, **labels).inc(n)
+
+
+def observe(name: str, value, **labels):
+    t = _T
+    if t is not None:
+        t.histogram(name, **labels).observe(value)
+
+
+def set_gauge(name: str, value, **labels):
+    t = _T
+    if t is not None:
+        t.gauge(name, **labels).set(value)
+
+
+def event(name: str, **fields):
+    t = _T
+    if t is not None:
+        t.event(name, **fields)
+
+
+def flush():
+    """Emit the aggregate snapshot as one ``telemetry_summary`` event."""
+    t = _T
+    if t is not None:
+        t.flush()
+
+
+def render_prom() -> str:
+    t = _T
+    return "" if t is None else t.render_prom()

@@ -1,0 +1,330 @@
+"""Telemetry primitives: counters, gauges, log-bucketed histograms, spans.
+
+This module is deliberately dependency-free (stdlib only — in particular it
+never imports jax; ``tests/test_obs.py`` guards that), so CPU-only CI and
+host-side tools can import it without pulling a backend.  The instruments are
+plain Python objects mutated host-side: instrumentation NEVER enters jitted
+code paths — spans wrap dispatch boundaries, counters are fed from values the
+program already returns.
+
+The registry (:class:`Telemetry`) streams span/probe *events* through any
+object with a ``log(event, **fields)`` method — in practice the existing
+``utils.logging.MetricsLogger`` JSONL sink — and renders the aggregate
+instrument state either as a JSON snapshot (one ``telemetry_summary`` JSONL
+event, see :meth:`Telemetry.flush`) or as Prometheus text exposition
+(:meth:`Telemetry.render_prom`).
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+import sys
+import threading
+import time
+
+# Fixed log-spaced latency buckets: four per decade over [1 µs, 1000 s] —
+# wide enough for a single decode dispatch and a whole FL round alike, and
+# FIXED so histograms from different runs/processes are always mergeable.
+DEFAULT_BUCKETS = tuple(10.0 ** (e / 4.0) for e in range(-24, 13))
+
+_PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _labels_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    """Monotonic counter.  ``inc`` only; negative increments raise."""
+
+    kind = "counter"
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = labels
+        self.value = 0
+
+    def inc(self, n: float | int = 1):
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative increment {n}")
+        self.value += n
+
+    def snapshot(self):
+        return {"value": self.value}
+
+
+class Gauge:
+    """Last-value-wins instrument (``set``); ``add`` for deltas."""
+
+    kind = "gauge"
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+
+    def set(self, v: float):
+        self.value = v
+
+    def add(self, v: float):
+        self.value += v
+
+    def snapshot(self):
+        return {"value": self.value}
+
+
+class Histogram:
+    """Fixed-bucket latency/size histogram (log-spaced by default).
+
+    Stores per-bucket counts plus count/sum/min/max; :meth:`quantile`
+    interpolates within the matched bucket (log-spaced buckets keep the
+    relative error of that interpolation bounded by the bucket ratio,
+    ~1.78x at the default four-per-decade spacing)."""
+
+    kind = "histogram"
+    __slots__ = ("name", "labels", "bounds", "counts", "count", "total",
+                 "min", "max")
+
+    def __init__(self, name: str, labels: dict, bounds=DEFAULT_BUCKETS):
+        self.name = name
+        self.labels = labels
+        self.bounds = tuple(bounds)  # upper bounds; +Inf bucket implicit
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v: float):
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Approximate q-quantile from the bucket counts (0 when empty)."""
+        if not self.count:
+            return 0.0
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank and c:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else self.max
+                frac = (rank - (seen - c)) / c
+                return lo + (hi - lo) * frac
+        return self.max
+
+    def snapshot(self):
+        return {
+            "count": self.count, "sum": self.total,
+            "min": self.min, "max": self.max,
+            "buckets": {
+                # sparse: only non-empty buckets, keyed by upper bound
+                ("+Inf" if i == len(self.bounds) else repr(self.bounds[i])): c
+                for i, c in enumerate(self.counts) if c
+            },
+        }
+
+
+class _Span:
+    """Handle yielded by :meth:`Telemetry.span` — call :meth:`fence` with a
+    device value to additionally record ``block_until_ready``-fenced device
+    time at span exit (wall time to dispatch return is always recorded)."""
+
+    __slots__ = ("fields", "_fence")
+
+    def __init__(self, fields):
+        self.fields = fields
+        self._fence = None
+
+    def fence(self, value):
+        """Mark ``value`` to be blocked on at span exit; returns it so the
+        call slots into an assignment (``out = sp.fence(f(x))``)."""
+        self._fence = value
+        return value
+
+
+class _NullSpan:
+    """Shared no-op stand-in for a span when telemetry is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def fence(self, value):
+        return value
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx:
+    """The actual span context manager (hand-rolled rather than
+    ``@contextmanager`` — it is entered on hot-ish host paths and a plain
+    class is both cheaper and re-entrant-safe)."""
+
+    __slots__ = ("_t", "_name", "_handle", "_t0")
+
+    def __init__(self, telemetry, name, fields):
+        self._t = telemetry
+        self._name = name
+        self._handle = _Span(fields)
+
+    def __enter__(self):
+        stack = self._t._stack()
+        stack.append(self._name)
+        self._t0 = time.perf_counter()
+        return self._handle
+
+    def __exit__(self, exc_type, exc, tb):
+        wall = time.perf_counter() - self._t0
+        t = self._t
+        stack = t._stack()
+        stack.pop()
+        h = self._handle
+        rec = dict(h.fields)
+        rec["name"] = self._name
+        rec["seconds"] = round(wall, 6)
+        rec["depth"] = len(stack)
+        if stack:
+            rec["parent"] = stack[-1]
+        dur = wall
+        if h._fence is not None:
+            # lazy fence: only meaningful (and only possible) when jax is
+            # already in the process — never import it from here
+            jax = sys.modules.get("jax")
+            if jax is not None:
+                jax.block_until_ready(h._fence)
+                dur = time.perf_counter() - self._t0
+                rec["device_seconds"] = round(dur, 6)
+        if exc_type is not None:
+            rec["ok"] = False
+            rec["error"] = exc_type.__name__
+        t.histogram("span_seconds", span=self._name).observe(dur)
+        t.event("span", **rec)
+        return False
+
+
+class Telemetry:
+    """Process-global registry of counters/gauges/histograms + span stack.
+
+    ``sink`` is any object with ``log(event, **fields)`` (the
+    ``MetricsLogger`` JSONL contract); events stream through it as they
+    happen, instrument state is aggregated in-process and exported via
+    :meth:`flush` (one ``telemetry_summary`` JSONL event) or
+    :meth:`render_prom`.  Instrument creation is locked; increments are
+    single bytecode-level mutations left unlocked (telemetry tolerates the
+    theoretical lost-update far better than a lock on every event)."""
+
+    def __init__(self, sink=None):
+        self.sink = sink
+        self._metrics: dict = {}
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    # -- instruments -----------------------------------------------------
+
+    def _get(self, cls, name, labels, **kw):
+        key = (name, _labels_key(labels))
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = self._metrics[key] = cls(name, labels, **kw)
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"{name}{labels or ''} already registered as {m.kind}"
+            )
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, bounds=DEFAULT_BUCKETS,
+                  **labels) -> Histogram:
+        return self._get(Histogram, name, labels, bounds=bounds)
+
+    # -- events & spans --------------------------------------------------
+
+    def event(self, event: str, **fields):
+        if self.sink is not None:
+            self.sink.log(event, **fields)
+
+    def _stack(self):
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
+
+    def span(self, name: str, **fields) -> _SpanCtx:
+        """Context manager timing the enclosed block: wall time always;
+        device time too when the caller fences a device value
+        (``sp.fence(out)``).  Span durations also feed the
+        ``span_seconds{span=name}`` histogram, and each exit streams one
+        ``span`` event (name, seconds, nesting depth, parent)."""
+        return _SpanCtx(self, name, fields)
+
+    # -- export ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """{kind: {name or name{labels}: state}} of every instrument."""
+        out: dict = {"counter": {}, "gauge": {}, "histogram": {}}
+        for (name, lk), m in sorted(self._metrics.items()):
+            disp = name + (
+                "{" + ",".join(f"{k}={v}" for k, v in lk) + "}" if lk else ""
+            )
+            out[m.kind][disp] = m.snapshot()
+        return out
+
+    def flush(self):
+        """Stream the aggregate instrument state as ONE
+        ``telemetry_summary`` event (the JSONL-side counterpart of
+        :meth:`render_prom`; ``tools/obs_report.py`` reads the last one)."""
+        self.event("telemetry_summary", summary=self.snapshot())
+
+    def render_prom(self) -> str:
+        """Prometheus text exposition of every instrument (text format
+        0.0.4: ``# TYPE`` headers, cumulative ``_bucket{le=...}`` series)."""
+        by_name: dict = {}
+        for (name, lk), m in sorted(self._metrics.items()):
+            by_name.setdefault(_PROM_NAME.sub("_", name), []).append((lk, m))
+        lines = []
+        for pname, series in by_name.items():
+            lines.append(f"# TYPE {pname} {series[0][1].kind}")
+            for lk, m in series:
+                lab = ",".join(f'{k}="{v}"' for k, v in lk)
+                if m.kind in ("counter", "gauge"):
+                    lines.append(
+                        f"{pname}{{{lab}}} {m.value}" if lab
+                        else f"{pname} {m.value}"
+                    )
+                    continue
+                cum = 0
+                for i, c in enumerate(m.counts):
+                    cum += c
+                    le = ("+Inf" if i == len(m.bounds)
+                          else repr(m.bounds[i]))
+                    ll = (lab + "," if lab else "") + f'le="{le}"'
+                    lines.append(f"{pname}_bucket{{{ll}}} {cum}")
+                suffix = f"{{{lab}}}" if lab else ""
+                lines.append(f"{pname}_sum{suffix} {m.total}")
+                lines.append(f"{pname}_count{suffix} {m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")

@@ -1,4 +1,5 @@
 from .mesh import make_mesh, replicated, sharded
+from .collectives import instrument_collectives, tree_payload_bytes
 from .dp import make_dp_train_step, dp_data_sharding
 from .pp import (
     pp_params_from_full,
@@ -64,4 +65,6 @@ __all__ = [
     "initialize_multihost",
     "make_multihost_mesh",
     "make_zero_dp_train_step",
+    "instrument_collectives",
+    "tree_payload_bytes",
 ]

@@ -1,0 +1,78 @@
+"""Host-side collective-traffic accounting for SPMD train steps.
+
+A jitted ``shard_map`` program's collectives are STATIC: which ``pmean``/
+``psum``/all-gather ops it contains, over which leaves, is fixed at trace
+time — only the dispatch count varies at runtime.  So collective telemetry
+never needs to enter the jitted code path (which would be impossible
+host-side anyway): :func:`instrument_collectives` wraps the compiled step,
+computes the program's collective signature ONCE from the first call's
+arguments (pure shape math), and bumps the counters
+
+- ``collective_calls_total{kind=..., op=...}`` — logical collective ops
+  per dispatch (one per pytree leaf reduced; XLA may fuse them on the
+  wire, this counts what the program asked for), and
+- ``collective_payload_bytes_total{kind=..., op=...}`` — bytes of array
+  payload entering those collectives per dispatch,
+
+on every host dispatch while telemetry is enabled.  Disabled, the wrapper
+is one predicate check around the underlying call.
+
+Note on compression (parallel/compress.py): the payload counted is the
+DENSE array entering the ``pmean`` — XLA has no sparse all-reduce, so
+that is what actually moves; the compression ratio lives in the update's
+information content, not the wire bytes (see the module docstring there).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from .. import obs
+
+
+def tree_payload_bytes(tree) -> int:
+    """Total bytes of the array leaves of ``tree`` (shape math only)."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "size") and hasattr(leaf, "dtype")
+    )
+
+
+def tree_nr_leaves(tree) -> int:
+    """Number of array leaves (= logical collective ops for a whole-tree
+    reduction)."""
+    return sum(
+        1 for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "size") and hasattr(leaf, "dtype")
+    )
+
+
+def instrument_collectives(fn, signature_fn, *, op: str):
+    """Wrap compiled step ``fn`` so each host dispatch accounts its
+    collective traffic.
+
+    ``signature_fn(*args, **kwargs)`` returns an iterable of
+    ``(kind, calls, payload_bytes)`` triples describing the collectives
+    ONE dispatch of the program performs (e.g. ``[("pmean", 5, 42000)]``);
+    it runs once, lazily, on the first dispatch with telemetry enabled —
+    argument shapes are static across dispatches of a compiled program, so
+    the result is cached for the wrapper's lifetime.  ``op`` labels the
+    counters (which step family the traffic belongs to)."""
+    sig_cache: list = []
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        if obs.enabled():
+            if not sig_cache:
+                sig_cache.append(tuple(signature_fn(*args, **kwargs)))
+            for kind, calls, nbytes in sig_cache[0]:
+                obs.inc("collective_calls_total", calls, kind=kind, op=op)
+                obs.inc("collective_payload_bytes_total", nbytes,
+                        kind=kind, op=op)
+        return out
+
+    return wrapped

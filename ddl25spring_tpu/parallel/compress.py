@@ -33,6 +33,9 @@ import optax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .collectives import (instrument_collectives, tree_nr_leaves,
+                          tree_payload_bytes)
+
 
 def topk_sparsify(tree, ratio: float):
     """Keep the largest-magnitude ``ratio`` fraction of entries per leaf
@@ -139,4 +142,13 @@ def make_compressed_dp_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, residual, jax.lax.pmean(loss, axis)
 
-    return jax.jit(spmd_step, donate_argnums=(0, 1, 2) if donate else ())
+    step = jax.jit(spmd_step, donate_argnums=(0, 1, 2) if donate else ())
+
+    def _collective_signature(params, opt_state, residual, batch, key):
+        # one pmean per (compressed-but-dense) grad leaf + the loss scalar
+        # — see the module docstring: the wire payload stays dense
+        return [("pmean", tree_nr_leaves(params) + 1,
+                 tree_payload_bytes(params) + 4)]
+
+    return instrument_collectives(step, _collective_signature,
+                                  op=f"dp_{method}")

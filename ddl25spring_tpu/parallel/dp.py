@@ -30,6 +30,9 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from .collectives import (instrument_collectives, tree_nr_leaves,
+                          tree_payload_bytes)
+
 
 def make_dp_train_step(loss_fn, optimizer, mesh, axis: str = "data",
                        mode: str = "grad", donate: bool = False):
@@ -75,7 +78,26 @@ def make_dp_train_step(loss_fn, optimizer, mesh, axis: str = "data",
             )
         return params, opt_state, jax.lax.pmean(loss, axis)
 
-    return jax.jit(spmd_step, donate_argnums=(0, 1) if donate else ())
+    step = jax.jit(spmd_step, donate_argnums=(0, 1) if donate else ())
+
+    def _collective_signature(params, opt_state, batch):
+        # mirrors spmd_step's pmeans exactly: grad mode reduces the grad
+        # tree (param-shaped) + the loss scalar; weight mode reduces
+        # params + the inexact opt-state leaves + the loss scalar
+        calls = tree_nr_leaves(params) + 1
+        nbytes = tree_payload_bytes(params) + 4
+        if mode == "weight":
+            inexact = [
+                leaf for leaf in jax.tree.leaves(opt_state)
+                if hasattr(leaf, "dtype")
+                and jax.numpy.issubdtype(leaf.dtype, jax.numpy.inexact)
+            ]
+            calls += len(inexact)
+            nbytes += tree_payload_bytes(inexact)
+        return [("pmean", calls, nbytes)]
+
+    return instrument_collectives(step, _collective_signature,
+                                  op=f"dp_{mode}")
 
 
 def dp_data_sharding(mesh, axis: str = "data") -> NamedSharding:

@@ -47,6 +47,13 @@ def main() -> int:
                          "reported (single shots over the shared tunnel "
                          "vary 10-25%%, round-5 bench.py finding)")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--telemetry", metavar="PATH", default=None,
+                    help="enable ddl25spring_tpu.obs telemetry and stream "
+                         "events (spans, request latency, tokens/sec, "
+                         "speculative acceptance) to this JSONL; adds a "
+                         "fused-speculative contender so acceptance "
+                         "counters are populated.  Render with "
+                         "tools/obs_report.py PATH")
     args = ap.parse_args()
 
     import jax
@@ -57,9 +64,16 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from ddl25spring_tpu import obs
     from ddl25spring_tpu.models.generate import generate
     from ddl25spring_tpu.models.llama import Llama, LlamaConfig
-    from ddl25spring_tpu.models.serving import ContinuousBatcher, serve_fused
+    from ddl25spring_tpu.models.serving import (ContinuousBatcher,
+                                                serve_fused,
+                                                serve_fused_speculative)
+
+    if args.telemetry:
+        os.makedirs(os.path.dirname(args.telemetry) or ".", exist_ok=True)
+        obs.enable(args.telemetry)
 
     cfg = LlamaConfig(
         vocab_size=args.vocab, dmodel=args.dmodel, nr_heads=args.heads,
@@ -149,8 +163,41 @@ def main() -> int:
     fused_s, _ = timed_median(run_fused)
     toks_f = toks
 
+    # --- fused speculative (telemetry runs only): a small random-init
+    # draft exercises the draft+verify scheduler end-to-end — acceptance
+    # will be near-chance, which is exactly what the acceptance-rate
+    # counters are for ------------------------------------------------
+    spec_s = None
+    gamma = 4
+    if (args.telemetry
+            and args.prefill_width + args.max_new + gamma <= cfg.ctx_size):
+        dcfg = LlamaConfig(
+            vocab_size=args.vocab, dmodel=64, nr_heads=2, nr_layers=2,
+            ctx_size=cfg.ctx_size, dtype=cfg.dtype,
+        )
+        dparams = Llama(dcfg).init(
+            jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32),
+            positions=jnp.arange(4),
+        )
+
+        def run_spec():
+            served = serve_fused_speculative(
+                cfg, params, dcfg, dparams, prompts,
+                [int(b) for b in budgets], gamma=gamma,
+                max_batch=args.batch, prefill_width=args.prefill_width,
+            )
+            assert all(len(o) == b for o, b in zip(served, budgets))
+
+        run_spec()  # warmup
+        spec_s, _ = timed_median(run_spec)
+
     occ = (batcher.stats["active_steps"]
            / max(batcher.stats["slot_steps"], 1))
+    if args.telemetry:
+        obs.flush()
+        print(f"telemetry written to {args.telemetry} "
+              f"(render: python tools/obs_report.py {args.telemetry})",
+              flush=True)
     print(json.dumps({
         "metric": "serving_throughput",
         "backend": jax.default_backend(),
@@ -165,6 +212,9 @@ def main() -> int:
         "fused_speedup": round(static_s / fused_s, 3),
         "decode_chunk": args.decode_chunk,
         "slot_occupancy": round(occ, 3),
+        **({"fused_spec_s": round(spec_s, 3),
+            "fused_spec_tok_s": round(toks / spec_s, 1)}
+           if spec_s is not None else {}),
     }), flush=True)
     return 0
 

@@ -302,49 +302,64 @@ def main() -> int:
         corpus = np.asarray(next(iter(token_stream(16, T_train, seed=2))))
         n_req, lanes, w = 16, 4, 32
         g = best["gamma"]
-        reqs = [[int(t) for t in corpus[i, :w]] for i in range(n_req)]
-        # staggered budgets, clamped so prefill + budget + gamma fits the
-        # ctx both models were built with (tiny smoke configs)
-        bmax = max(17, min(97, tcfg.ctx_size - w - g))
-        budgets = [int(b) for b in rng.integers(16, bmax, size=n_req)]
+        # prefill + budget + gamma must fit the ctx both models were
+        # built with (tiny smoke configs).  Shrink the prompt window
+        # before giving up, and skip the A/B with a notice when even a
+        # minimal window leaves no room for the smallest staggered
+        # budget — the old ``max(17, ...)`` floor handed out-of-ctx
+        # budgets to serve_fused_speculative and crashed there.
+        min_w, min_budget = 8, 16
+        if tcfg.ctx_size - w - g <= min_budget:
+            w = tcfg.ctx_size - g - min_budget - 1
+            if w >= min_w:
+                print(f"--serve: prefill window shrunk to w={w} to fit "
+                      f"ctx_size={tcfg.ctx_size} (gamma={g})", flush=True)
+        if w < min_w:
+            print(f"--serve: skipped — ctx_size={tcfg.ctx_size} too small "
+                  f"for prefill + budget + gamma={g} "
+                  f"(needs >= {min_w + min_budget + 1 + g})", flush=True)
+        else:
+            reqs = [[int(t) for t in corpus[i, :w]] for i in range(n_req)]
+            bmax = min(97, tcfg.ctx_size - w - g)
+            budgets = [int(b) for b in rng.integers(16, bmax, size=n_req)]
 
-        def run_plain():
-            return serve_fused(tcfg, params, reqs, budgets,
-                               max_batch=lanes, prefill_width=w,
-                               decode_chunk=8)
+            def run_plain():
+                return serve_fused(tcfg, params, reqs, budgets,
+                                   max_batch=lanes, prefill_width=w,
+                                   decode_chunk=8)
 
-        def run_spec():
-            return serve_fused_speculative(
-                tcfg, params, dcfg, dparams, reqs, budgets, gamma=g,
-                max_batch=lanes, prefill_width=w,
-            )
+            def run_spec():
+                return serve_fused_speculative(
+                    tcfg, params, dcfg, dparams, reqs, budgets, gamma=g,
+                    max_batch=lanes, prefill_width=w,
+                )
 
-        if run_plain() != run_spec():
-            raise AssertionError(
-                "fused speculative serving diverged from plain fused"
-            )
+            if run_plain() != run_spec():
+                raise AssertionError(
+                    "fused speculative serving diverged from plain fused"
+                )
 
-        def timed_wall(fn):
-            best_s = float("inf")
-            for _ in range(args.reps):
-                t0 = time.perf_counter()
-                fn()  # serve_* fetches host-side -> the call synchronizes
-                best_s = min(best_s, time.perf_counter() - t0)
-            return best_s
+            def timed_wall(fn):
+                best_s = float("inf")
+                for _ in range(args.reps):
+                    t0 = time.perf_counter()
+                    fn()  # serve_* fetches host-side -> call synchronizes
+                    best_s = min(best_s, time.perf_counter() - t0)
+                return best_s
 
-        total = sum(budgets)
-        plain_sv = timed_wall(run_plain)
-        spec_sv = timed_wall(run_spec)
-        serving = {
-            "requests": n_req, "lanes": lanes,
-            "total_tokens": total, "gamma": g,
-            "plain_fused_tok_s": round(total / plain_sv, 1),
-            "spec_fused_tok_s": round(total / spec_sv, 1),
-            "speedup": round(plain_sv / spec_sv, 3),
-        }
-        print(f"fused serving: plain {total / plain_sv:.0f} tok/s | "
-              f"spec g={g} {total / spec_sv:.0f} tok/s | "
-              f"{plain_sv / spec_sv:.2f}x", flush=True)
+            total = sum(budgets)
+            plain_sv = timed_wall(run_plain)
+            spec_sv = timed_wall(run_spec)
+            serving = {
+                "requests": n_req, "lanes": lanes,
+                "total_tokens": total, "gamma": g,
+                "plain_fused_tok_s": round(total / plain_sv, 1),
+                "spec_fused_tok_s": round(total / spec_sv, 1),
+                "speedup": round(plain_sv / spec_sv, 3),
+            }
+            print(f"fused serving: plain {total / plain_sv:.0f} tok/s | "
+                  f"spec g={g} {total / spec_sv:.0f} tok/s | "
+                  f"{plain_sv / spec_sv:.2f}x", flush=True)
 
     print(json.dumps({
         "metric": "speculative_decode",

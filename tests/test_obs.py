@@ -1,0 +1,490 @@
+"""Telemetry layer (ddl25spring_tpu.obs) tests.
+
+Covers the instrument semantics (counter/gauge/histogram), span nesting and
+the event stream, Prometheus rendering, the JSONL round-trip through
+``utils.logging``, the zero-overhead disabled default, and the actual
+instrumentation wired into serving / speculative decoding / FL rounds /
+collective wrappers — plus the import-hygiene guard that ``import
+ddl25spring_tpu.obs`` never pulls jax into the process.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ddl25spring_tpu import obs
+from ddl25spring_tpu.obs.core import (DEFAULT_BUCKETS, NULL_SPAN, Counter,
+                                      Gauge, Histogram, Telemetry)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts and ends with telemetry off (process-global)."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+class Sink:
+    """Minimal MetricsLogger-contract sink capturing events in memory."""
+
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **fields):
+        self.events.append({"event": event, **fields})
+
+    def of(self, name):
+        return [e for e in self.events if e["event"] == name]
+
+
+# --------------------------------------------------------------------------
+# instrument semantics
+# --------------------------------------------------------------------------
+
+def test_counter_monotonic_and_negative_raises():
+    c = Counter("x", {})
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value == 5
+
+
+def test_gauge_set_and_add():
+    g = Gauge("x", {})
+    g.set(2.5)
+    g.add(-1.0)
+    assert g.value == 1.5
+    g.set(7.0)
+    assert g.value == 7.0
+
+
+def test_histogram_stats_quantiles_and_snapshot():
+    h = Histogram("lat", {})
+    for v in (0.001, 0.002, 0.004, 0.1, 1.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.min == 0.001 and h.max == 1.0
+    assert h.mean == pytest.approx(sum((0.001, 0.002, 0.004, 0.1, 1.0)) / 5)
+    # quantiles are bucket-interpolated: bounded by the bucket ratio
+    assert 0.001 <= h.quantile(0.5) <= 0.01
+    assert h.quantile(1.0) == pytest.approx(1.0, rel=0.8)
+    assert h.quantile(0.0) >= 0.0
+    snap = h.snapshot()
+    assert snap["count"] == 5 and snap["sum"] == pytest.approx(h.total)
+    assert sum(snap["buckets"].values()) == 5  # sparse: only non-empty
+    # empty histogram quantile is 0, not an error
+    assert Histogram("e", {}).quantile(0.9) == 0.0
+
+
+def test_histogram_overflow_bucket():
+    h = Histogram("lat", {})
+    h.observe(10.0 ** 9)  # beyond the last bound -> +Inf bucket
+    assert h.snapshot()["buckets"] == {"+Inf": 1}
+
+
+def test_registry_kind_mismatch_and_labels():
+    t = Telemetry()
+    t.counter("n").inc()
+    with pytest.raises(TypeError):
+        t.gauge("n")
+    # labeled series are distinct instruments; same labels = same object
+    t.counter("c", op="a").inc(2)
+    t.counter("c", op="b").inc(3)
+    assert t.counter("c", op="a").value == 2
+    assert t.counter("c", op="b").value == 3
+    snap = t.snapshot()
+    assert snap["counter"]["c{op=a}"]["value"] == 2
+    assert snap["counter"]["c{op=b}"]["value"] == 3
+
+
+# --------------------------------------------------------------------------
+# spans + event stream
+# --------------------------------------------------------------------------
+
+def test_span_nesting_depth_and_parent():
+    sink = Sink()
+    t = Telemetry(sink=sink)
+    with t.span("outer", tag=1):
+        with t.span("inner"):
+            pass
+    inner, outer = sink.of("span")  # inner exits first
+    assert inner["name"] == "inner" and inner["depth"] == 1
+    assert inner["parent"] == "outer"
+    assert outer["name"] == "outer" and outer["depth"] == 0
+    assert "parent" not in outer
+    assert outer["tag"] == 1
+    assert outer["seconds"] >= inner["seconds"] >= 0
+    # durations feed the span_seconds histogram, per span name
+    assert t.histogram("span_seconds", span="outer").count == 1
+    assert t.histogram("span_seconds", span="inner").count == 1
+
+
+def test_span_exception_recorded_and_propagates():
+    sink = Sink()
+    t = Telemetry(sink=sink)
+    with pytest.raises(RuntimeError):
+        with t.span("boom"):
+            raise RuntimeError("x")
+    (rec,) = sink.of("span")
+    assert rec["ok"] is False and rec["error"] == "RuntimeError"
+    # the stack unwound: a following span is depth 0 again
+    with t.span("after"):
+        pass
+    assert sink.of("span")[-1]["depth"] == 0
+
+
+def test_span_fence_returns_value():
+    t = Telemetry()
+    with t.span("s") as sp:
+        assert sp.fence(42) == 42
+    assert NULL_SPAN.fence("v") == "v"
+
+
+def test_disabled_span_is_shared_noop():
+    assert obs.span("anything", k=1) is NULL_SPAN
+    with obs.span("x") as sp:
+        assert sp.fence(3) == 3
+
+
+def test_disabled_helpers_do_nothing():
+    obs.inc("c", 5)
+    obs.observe("h", 1.0)
+    obs.set_gauge("g", 2.0)
+    obs.event("e", a=1)
+    obs.flush()
+    assert not obs.enabled()
+    assert obs.get() is None
+    assert obs.render_prom() == ""
+    # enabling afterwards starts from a clean registry
+    t = obs.enable()
+    assert t.snapshot() == {"counter": {}, "gauge": {}, "histogram": {}}
+
+
+# --------------------------------------------------------------------------
+# export: prometheus + JSONL
+# --------------------------------------------------------------------------
+
+def test_render_prom_format():
+    t = obs.enable()
+    t.counter("req_total", op="serve").inc(3)
+    t.gauge("tok_per_sec").set(12.5)
+    t.histogram("lat_seconds").observe(0.5)
+    text = obs.render_prom()
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{op="serve"} 3' in text
+    assert "# TYPE tok_per_sec gauge" in text
+    assert "tok_per_sec 12.5" in text
+    assert "# TYPE lat_seconds histogram" in text
+    assert "lat_seconds_count 1" in text
+    assert "lat_seconds_sum 0.5" in text
+    # cumulative buckets end at the total count on the +Inf series
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    assert text.endswith("\n")
+
+
+def test_jsonl_roundtrip(tmp_path):
+    from ddl25spring_tpu.utils.logging import read_jsonl
+
+    path = tmp_path / "telemetry.jsonl"
+    obs.enable(str(path))
+    obs.inc("widgets_total", 2)
+    obs.observe("lat_seconds", 0.25)
+    obs.event("custom", a=1)
+    with obs.span("work"):
+        pass
+    obs.flush()
+    events = read_jsonl(path)
+    assert [e["event"] for e in events] == ["custom", "span",
+                                           "telemetry_summary"]
+    assert all("ts" in e for e in events)
+    summary = events[-1]["summary"]
+    assert summary["counter"]["widgets_total"]["value"] == 2
+    assert summary["histogram"]["lat_seconds"]["count"] == 1
+    assert summary["histogram"]["span_seconds{span=work}"]["count"] == 1
+
+
+def test_disabled_writes_nothing(tmp_path):
+    path = tmp_path / "none.jsonl"
+    obs.inc("c")
+    obs.flush()
+    assert not path.exists()
+    # enable with an explicit sink: events flow, nothing hits the fs
+    sink = Sink()
+    obs.enable(sink=sink)
+    obs.event("e")
+    assert len(sink.events) == 1 and not path.exists()
+
+
+# --------------------------------------------------------------------------
+# import hygiene: obs must stay importable without jax
+# --------------------------------------------------------------------------
+
+def test_obs_import_is_jax_free():
+    code = ("import sys; import ddl25spring_tpu.obs; "
+            "assert 'jax' not in sys.modules, 'obs import pulled jax'; "
+            "print('ok')")
+    out = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+# --------------------------------------------------------------------------
+# wired instrumentation: serving / speculative / FL / collectives
+# --------------------------------------------------------------------------
+
+def _tiny_llama():
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                      nr_layers=2, ctx_size=48)
+    params = Llama(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32),
+        positions=jnp.arange(4),
+    )
+    return cfg, params
+
+
+def test_serving_batcher_telemetry():
+    import numpy as np
+
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+
+    cfg, params = _tiny_llama()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 97, size=6).tolist() for _ in range(3)]
+    budgets = [7, 4, 5]
+
+    sink = Sink()
+    t = obs.enable(sink=sink)
+    b = ContinuousBatcher(cfg, params, max_batch=2, prefill_width=8)
+    served = b.run(prompts, budgets)
+    assert [len(o) for o in served] == budgets
+
+    assert t.counter("serving_requests_total").value == 3
+    assert t.counter("serving_tokens_total").value == sum(budgets)
+    assert t.histogram("serving_request_seconds").count == 3
+    assert t.histogram("serving_queue_wait_seconds").count == 3
+    assert t.gauge("serving_tokens_per_sec").value > 0
+    names = {e["name"] for e in sink.of("span")}
+    assert {"serving.run", "serving.admit", "serving.decode"} <= names
+
+
+def test_serving_disabled_records_nothing():
+    import numpy as np
+
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+
+    cfg, params = _tiny_llama()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 97, size=6).tolist() for _ in range(2)]
+    b = ContinuousBatcher(cfg, params, max_batch=2, prefill_width=8)
+    served = b.run(prompts, [5, 3])
+    assert [len(o) for o in served] == [5, 3]
+    assert b._req_ts == {}  # no timestamps kept when telemetry is off
+    assert obs.get() is None
+
+
+def test_serve_fused_telemetry():
+    import numpy as np
+
+    from ddl25spring_tpu.models.serving import serve_fused
+
+    cfg, params = _tiny_llama()
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 97, size=6).tolist() for _ in range(3)]
+    budgets = [6, 3, 4]
+    sink = Sink()
+    t = obs.enable(sink=sink)
+    served = serve_fused(cfg, params, prompts, budgets,
+                         max_batch=2, prefill_width=8, decode_chunk=4)
+    assert [len(o) for o in served] == budgets
+    assert t.counter("serving_requests_total").value == 3
+    assert t.counter("serving_tokens_total").value == sum(budgets)
+    assert t.histogram("serving_request_seconds").count == 3
+    assert [e["name"] for e in sink.of("span")] == ["serving.fused"]
+
+
+def test_speculative_counters_match_reported_rate():
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.speculative import speculative_generate
+
+    tcfg, tparams = _tiny_llama()
+    dcfg = LlamaConfig(vocab_size=97, dmodel=16, nr_heads=2, nr_layers=1,
+                       ctx_size=48)
+    dparams = Llama(dcfg).init(
+        jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32),
+        positions=jnp.arange(4),
+    )
+    prompt = jnp.asarray([[3, 5, 7, 11, 13, 17]], jnp.int32)
+
+    t = obs.enable()
+    out, rate = speculative_generate(tcfg, tparams, dcfg, dparams,
+                                     prompt, 12, gamma=3)
+    p = t.counter("spec_proposed_total").value
+    a = t.counter("spec_accepted_total").value
+    assert t.counter("spec_calls_total").value == 1
+    assert p > 0
+    assert a / p == pytest.approx(float(rate), abs=1e-5)
+    # self-draft: every proposal accepted, counters must agree
+    t2 = obs.enable()
+    _, rate2 = speculative_generate(tcfg, tparams, tcfg, tparams,
+                                    prompt, 8, gamma=3)
+    assert float(rate2) == pytest.approx(1.0)
+    assert (t2.counter("spec_accepted_total").value
+            == t2.counter("spec_proposed_total").value > 0)
+
+
+def test_serve_fused_speculative_telemetry():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu.models.serving import serve_fused_speculative
+
+    cfg, params = _tiny_llama()
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(1, 97, size=6).tolist() for _ in range(2)]
+    budgets = [8, 5]
+    sink = Sink()
+    t = obs.enable(sink=sink)
+    served = serve_fused_speculative(cfg, params, cfg, params, prompts,
+                                     budgets, gamma=3,
+                                     max_batch=2, prefill_width=8)
+    assert [len(o) for o in served] == budgets
+    p = t.counter("spec_proposed_total").value
+    a = t.counter("spec_accepted_total").value
+    assert p > 0 and a == p  # self-draft accepts everything
+    assert t.counter("serving_requests_total").value == 2
+    assert [e["name"] for e in sink.of("span")] == ["serving.fused_spec"]
+
+
+def test_fl_round_telemetry():
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.fl.engine import _tree_bytes, make_fl_round
+
+    nr_clients, n_i, d = 4, 2, 3
+    x = jnp.ones((nr_clients, n_i, d))
+    y = jnp.zeros((nr_clients, n_i), jnp.int32)
+    counts = jnp.full((nr_clients,), n_i, jnp.int32)
+
+    def client_update(params, x_i, y_i, count_i, key_i):
+        return jax.tree.map(lambda p: p + 1.0, params)
+
+    round_fn = make_fl_round(client_update, x, y, counts, nr_sampled=2)
+    params = {"w": jnp.zeros((d,))}
+
+    sink = Sink()
+    t = obs.enable(sink=sink)
+    new_params = round_fn(params, jax.random.PRNGKey(0), 0)
+    assert t.counter("fl_rounds_total").value == 1
+    assert t.counter("fl_clients_sampled_total").value == 2
+    assert t.gauge("fl_clients_per_round").value == 2
+    # traffic model: download + upload of the dense tree per sampled client
+    assert (t.counter("fl_bytes_aggregated_total").value
+            == 2 * 2 * _tree_bytes(new_params))
+    (rec,) = sink.of("span")
+    assert rec["name"] == "fl.round"
+    assert "device_seconds" in rec  # round is fenced
+
+    # disabled: the raw path, no counters
+    obs.disable()
+    round_fn(params, jax.random.PRNGKey(1), 1)
+
+
+def test_collectives_wrapper_accounting():
+    try:
+        from ddl25spring_tpu.parallel.collectives import (
+            instrument_collectives, tree_nr_leaves, tree_payload_bytes)
+    except ImportError:
+        pytest.skip("parallel package unavailable on this jax build")
+    import numpy as np
+
+    tree = {"a": np.zeros((2, 3), np.float32), "b": np.zeros((4,), np.int32),
+            "n": 7}
+    assert tree_payload_bytes(tree) == 2 * 3 * 4 + 4 * 4
+    assert tree_nr_leaves(tree) == 2
+
+    seen = []
+
+    def step(a, b):
+        return a + b
+
+    def signature(a, b):
+        seen.append(1)
+        return [("pmean", 3, 120), ("all_gather", 1, 16)]
+
+    wrapped = instrument_collectives(step, signature, op="dp_test")
+    assert wrapped(1, 2) == 3  # disabled: no signature evaluation
+    assert seen == []
+
+    t = obs.enable()
+    assert wrapped(2, 3) == 5
+    assert wrapped(3, 4) == 7
+    assert seen == [1]  # signature computed once, then cached
+    assert t.counter("collective_calls_total",
+                     kind="pmean", op="dp_test").value == 6
+    assert t.counter("collective_payload_bytes_total",
+                     kind="pmean", op="dp_test").value == 240
+    assert t.counter("collective_calls_total",
+                     kind="all_gather", op="dp_test").value == 2
+
+
+# --------------------------------------------------------------------------
+# the report tool renders a real run
+# --------------------------------------------------------------------------
+
+def test_obs_report_renders(tmp_path):
+    path = tmp_path / "run.jsonl"
+    obs.enable(str(path))
+    obs.event("bench.probe", attempt=1, attempts=3, timeout_s=60,
+              outcome="ok", elapsed_s=0.5)
+    for v in (0.01, 0.02, 0.2, 1.5):
+        obs.observe("serving_request_seconds", v)
+    obs.inc("serving_requests_total", 4)
+    obs.inc("serving_tokens_total", 128)
+    obs.set_gauge("serving_tokens_per_sec", 321.0)
+    obs.inc("spec_proposed_total", 100)
+    obs.inc("spec_accepted_total", 73)
+    obs.inc("fl_rounds_total", 2)
+    obs.inc("fl_clients_sampled_total", 8)
+    obs.inc("fl_bytes_aggregated_total", 4096)
+    obs.inc("collective_calls_total", 10, kind="pmean", op="dp_grad")
+    obs.inc("collective_payload_bytes_total", 2048, kind="pmean",
+            op="dp_grad")
+    with obs.span("serving.run"):
+        pass
+    obs.flush()
+
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "obs_report.py"), str(path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    text = out.stdout
+    assert "device probes" in text and "ok" in text
+    assert "serving.run" in text
+    assert "requests served: 4" in text and "321.0" in text
+    assert "p50=" in text and "p99=" in text
+    assert "acceptance rate: 0.730" in text
+    assert "rounds: 2" in text and "4.0KiB" in text
+    assert "pmean" in text and "dp_grad" in text

@@ -1,0 +1,363 @@
+"""Render a ddl25spring_tpu.obs telemetry JSONL as one human-readable report.
+
+The obs registry streams two kinds of lines into its JSONL sink: per-event
+records (``span``, ``bench.probe``, ``bench.result``, ...) and one aggregate
+``telemetry_summary`` record per ``obs.flush()`` holding every counter /
+gauge / histogram.  This tool joins both into the serving/FL/collective
+story a human wants after a run:
+
+- device-probe attempts (bench.py's retry loop) and their outcomes,
+- span aggregates (count / total / mean / max wall time, device time when
+  the span was fenced, error counts),
+- the serving section: request-latency histogram (ASCII, with interpolated
+  p50/p90/p99), queue wait, throughput counters and tokens/sec,
+- speculative decoding acceptance rate (accepted/proposed counters),
+- the FL section: rounds, client participation, bytes aggregated,
+- collective traffic (calls x payload bytes per kind/op label),
+- any remaining instruments, so nothing logged is invisible.
+
+``--trace DIR`` additionally aggregates an XProf trace directory through
+``tools/trace_summary.py`` (lazy jax import — the JSONL part of this tool
+is stdlib-only and runs anywhere).
+
+Usage:
+    python tools/obs_report.py results/bench_telemetry.jsonl
+    python tools/obs_report.py results/bench_telemetry.jsonl --trace /tmp/trace
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+_KEY = re.compile(r"^(?P<name>[^{]+)(\{(?P<labels>.*)\})?$")
+_BAR_WIDTH = 40
+
+
+def load_events(path: Path) -> list[dict]:
+    """Inline JSONL reader (mirrors utils.logging.read_jsonl without
+    importing the package — this tool must run with zero deps)."""
+    with path.open() as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def parse_key(disp: str) -> tuple[str, dict]:
+    """Split a snapshot display key ``name{k=v,...}`` into (name, labels)."""
+    m = _KEY.match(disp)
+    name = m.group("name")
+    labels = {}
+    if m.group("labels"):
+        for pair in m.group("labels").split(","):
+            k, _, v = pair.partition("=")
+            labels[k] = v
+    return name, labels
+
+
+def fmt_seconds(s: float) -> str:
+    if s < 1e-3:
+        return f"{s * 1e6:.1f}us"
+    if s < 1.0:
+        return f"{s * 1e3:.2f}ms"
+    return f"{s:.3f}s"
+
+
+def fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _buckets(hist: dict) -> list[tuple[float, int]]:
+    """Sparse snapshot buckets -> [(upper_bound, count)] sorted; +Inf last."""
+    out = []
+    for key, c in hist.get("buckets", {}).items():
+        bound = float("inf") if key == "+Inf" else float(key)
+        out.append((bound, c))
+    out.sort(key=lambda bc: bc[0])
+    return out
+
+
+def hist_quantile(hist: dict, q: float) -> float:
+    """Interpolated q-quantile from a sparse snapshot (same scheme as
+    obs.core.Histogram.quantile, reconstructed from the JSONL side)."""
+    count = hist.get("count", 0)
+    if not count:
+        return 0.0
+    rank = q * count
+    seen = 0
+    prev_bound = 0.0
+    for bound, c in _buckets(hist):
+        if seen + c >= rank:
+            hi = hist["max"] if bound == float("inf") else bound
+            lo = prev_bound
+            frac = (rank - seen) / c
+            v = lo + (hi - lo) * frac
+            return min(max(v, hist["min"]), hist["max"])
+        seen += c
+        prev_bound = bound
+    return hist["max"]
+
+
+def render_hist(hist: dict, indent: str = "  ") -> list[str]:
+    """ASCII histogram: one row per non-empty bucket, bar scaled to the
+    fullest bucket, with count/mean/min/max and p50/p90/p99 footer."""
+    lines = []
+    buckets = _buckets(hist)
+    if not buckets:
+        return [indent + "(empty)"]
+    peak = max(c for _, c in buckets)
+    prev = 0.0
+    for bound, c in buckets:
+        hi = "+Inf" if bound == float("inf") else fmt_seconds(bound)
+        bar = "#" * max(1, round(_BAR_WIDTH * c / peak))
+        lines.append(f"{indent}[{fmt_seconds(prev):>9} .. {hi:>9}) "
+                     f"{c:>6}  {bar}")
+        prev = 0.0 if bound == float("inf") else bound
+    lines.append(
+        f"{indent}count={hist['count']} mean="
+        f"{fmt_seconds(hist['sum'] / hist['count'])} "
+        f"min={fmt_seconds(hist['min'])} max={fmt_seconds(hist['max'])}")
+    lines.append(
+        f"{indent}p50={fmt_seconds(hist_quantile(hist, 0.50))} "
+        f"p90={fmt_seconds(hist_quantile(hist, 0.90))} "
+        f"p99={fmt_seconds(hist_quantile(hist, 0.99))}")
+    return lines
+
+
+def aggregate_spans(events: list[dict]) -> dict:
+    """Per-name span stats from the streamed ``span`` events."""
+    agg: dict = defaultdict(lambda: {
+        "count": 0, "total": 0.0, "max": 0.0,
+        "device_total": 0.0, "fenced": 0, "errors": 0})
+    for e in events:
+        if e.get("event") != "span":
+            continue
+        a = agg[e["name"]]
+        a["count"] += 1
+        a["total"] += e["seconds"]
+        a["max"] = max(a["max"], e["seconds"])
+        if "device_seconds" in e:
+            a["fenced"] += 1
+            a["device_total"] += e["device_seconds"]
+        if e.get("ok") is False:
+            a["errors"] += 1
+    return dict(agg)
+
+
+def section(title: str) -> None:
+    print(f"\n== {title} " + "=" * max(0, 60 - len(title)))
+
+
+def _pick(instruments: dict, name: str):
+    """All (labels, state) entries of ``name`` in one snapshot kind."""
+    out = []
+    for disp, state in instruments.items():
+        n, labels = parse_key(disp)
+        if n == name:
+            out.append((labels, state))
+    return out
+
+
+def _value(instruments: dict, name: str, default=None):
+    hits = _pick(instruments, name)
+    return hits[0][1]["value"] if hits else default
+
+
+def report(events: list[dict], top: int) -> None:
+    kinds = defaultdict(int)
+    for e in events:
+        kinds[e.get("event", "?")] += 1
+    span_total = sum(t for k, t in kinds.items())
+    ts = [e["ts"] for e in events if "ts" in e]
+    dur = f", {ts[-1] - ts[0]:.1f}s wall" if len(ts) > 1 else ""
+    print(f"{span_total} events ({', '.join(f'{k} x{v}' for k, v in sorted(kinds.items()))}){dur}")
+
+    summaries = [e for e in events if e.get("event") == "telemetry_summary"]
+    summary = summaries[-1]["summary"] if summaries else {
+        "counter": {}, "gauge": {}, "histogram": {}}
+    counters, gauges, hists = (summary["counter"], summary["gauge"],
+                               summary["histogram"])
+    used: set = set()
+
+    def take(kind: dict, name: str):
+        for disp in list(kind):
+            if parse_key(disp)[0] == name:
+                used.add(disp)
+        return _pick(kind, name)
+
+    # -- device probes ---------------------------------------------------
+    probes = [e for e in events if e.get("event") == "bench.probe"]
+    if probes:
+        section("device probes (bench.py)")
+        for e in probes:
+            print(f"  attempt {e['attempt']}/{e['attempts']}: "
+                  f"{e['outcome']:>7}  ({e['elapsed_s']:.1f}s of "
+                  f"{e['timeout_s']}s timeout)")
+
+    # -- spans -----------------------------------------------------------
+    spans = aggregate_spans(events)
+    if spans:
+        section("spans")
+        print(f"  {'name':<22} {'count':>6} {'total':>10} {'mean':>10} "
+              f"{'max':>10}  device(fenced)")
+        for name, a in sorted(spans.items(), key=lambda kv: -kv[1]["total"]):
+            dev = (fmt_seconds(a["device_total"]) + f" ({a['fenced']})"
+                   if a["fenced"] else "-")
+            err = f"  errors={a['errors']}" if a["errors"] else ""
+            print(f"  {name:<22} {a['count']:>6} "
+                  f"{fmt_seconds(a['total']):>10} "
+                  f"{fmt_seconds(a['total'] / a['count']):>10} "
+                  f"{fmt_seconds(a['max']):>10}  {dev}{err}")
+        for disp in list(hists):
+            if parse_key(disp)[0] == "span_seconds":
+                used.add(disp)
+
+    # -- serving ---------------------------------------------------------
+    nr_req = _value(counters, "serving_requests_total")
+    take(counters, "serving_requests_total")
+    nr_tok = _value(counters, "serving_tokens_total")
+    take(counters, "serving_tokens_total")
+    tok_s = _value(gauges, "serving_tokens_per_sec")
+    take(gauges, "serving_tokens_per_sec")
+    req_hist = take(hists, "serving_request_seconds")
+    wait_hist = take(hists, "serving_queue_wait_seconds")
+    if nr_req is not None or req_hist:
+        section("serving")
+        if nr_req is not None:
+            print(f"  requests served: {nr_req}   tokens: {nr_tok}"
+                  + (f"   tokens/sec (last run): {tok_s:.1f}"
+                     if tok_s is not None else ""))
+        if req_hist:
+            print("  request latency (submit -> final token):")
+            for line in render_hist(req_hist[0][1], indent="    "):
+                print(line)
+        if wait_hist:
+            h = wait_hist[0][1]
+            print(f"  queue wait: count={h['count']} "
+                  f"mean={fmt_seconds(h['sum'] / max(h['count'], 1))} "
+                  f"p90={fmt_seconds(hist_quantile(h, 0.90))} "
+                  f"max={fmt_seconds(h['max'] or 0)}")
+
+    # -- speculative decoding --------------------------------------------
+    proposed = _value(counters, "spec_proposed_total")
+    accepted = _value(counters, "spec_accepted_total")
+    calls = _value(counters, "spec_calls_total")
+    for n in ("spec_proposed_total", "spec_accepted_total",
+              "spec_calls_total"):
+        take(counters, n)
+    if proposed is not None or accepted is not None:
+        section("speculative decoding")
+        proposed = proposed or 0
+        accepted = accepted or 0
+        rate = f"{accepted / proposed:.3f}" if proposed else "-"
+        print(f"  proposed: {proposed}   accepted: {accepted}   "
+              f"acceptance rate: {rate}"
+              + (f"   calls: {calls}" if calls is not None else ""))
+
+    # -- federated learning ----------------------------------------------
+    fl_rounds = _value(counters, "fl_rounds_total")
+    fl_clients = _value(counters, "fl_clients_sampled_total")
+    fl_bytes = _value(counters, "fl_bytes_aggregated_total")
+    fl_cpr = _value(gauges, "fl_clients_per_round")
+    for n in ("fl_rounds_total", "fl_clients_sampled_total",
+              "fl_bytes_aggregated_total"):
+        take(counters, n)
+    take(gauges, "fl_clients_per_round")
+    if fl_rounds is not None:
+        section("federated learning")
+        print(f"  rounds: {fl_rounds}   clients sampled: {fl_clients}"
+              + (f"   ({fl_cpr:.0f}/round)" if fl_cpr else ""))
+        if fl_bytes is not None:
+            print(f"  bytes aggregated (down+up, dense model): "
+                  f"{fmt_bytes(fl_bytes)}")
+
+    # -- collectives -----------------------------------------------------
+    coll_calls = take(counters, "collective_calls_total")
+    coll_bytes = {tuple(sorted(lb.items())): st["value"]
+                  for lb, st in take(counters,
+                                     "collective_payload_bytes_total")}
+    if coll_calls:
+        section("collectives (host-side: signature x dispatch count)")
+        print(f"  {'kind':<12} {'op':<16} {'calls':>10} {'payload':>12}")
+        for labels, state in sorted(coll_calls,
+                                    key=lambda ls: -ls[1]["value"]):
+            nb = coll_bytes.get(tuple(sorted(labels.items())), 0)
+            print(f"  {labels.get('kind', '?'):<12} "
+                  f"{labels.get('op', '?'):<16} "
+                  f"{state['value']:>10} {fmt_bytes(nb):>12}")
+
+    # -- bench results ---------------------------------------------------
+    results = [e for e in events if e.get("event") == "bench.result"]
+    if results:
+        section("bench results")
+        for e in results:
+            row = {k: v for k, v in e.items() if k not in ("ts", "event")}
+            print("  " + json.dumps(row))
+
+    # -- everything not already shown ------------------------------------
+    rest_c = {d: s for d, s in counters.items() if d not in used}
+    rest_g = {d: s for d, s in gauges.items() if d not in used}
+    rest_h = {d: s for d, s in hists.items() if d not in used}
+    if rest_c or rest_g or rest_h:
+        section("other instruments")
+        for disp, state in sorted(rest_c.items()):
+            print(f"  counter   {disp} = {state['value']}")
+        for disp, state in sorted(rest_g.items()):
+            print(f"  gauge     {disp} = {state['value']}")
+        for disp, state in sorted(rest_h.items()):
+            h = state
+            print(f"  histogram {disp}: count={h['count']} "
+                  f"mean={fmt_seconds(h['sum'] / max(h['count'], 1))} "
+                  f"max={fmt_seconds(h['max'] or 0)}")
+    if not summaries:
+        print("\n(no telemetry_summary event — was obs.flush() called?)")
+
+
+def report_trace(trace_dir: Path, top: int) -> None:
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from trace_summary import find_xplanes, summarize  # lazy: pulls jax
+
+    xplanes = find_xplanes(trace_dir)
+    section(f"device trace ({trace_dir})")
+    if not xplanes:
+        print(f"  no *.xplane.pb under {trace_dir}")
+        return
+    s = summarize(xplanes[-1], top)
+    print(f"  steady-state window {s['window'][:50]} "
+          f"({s['window_span_ms']:.1f} ms, {s['nr_device_cores']} cores)")
+    print(f"  device busy {s['device_busy_ms']:.1f} ms -> "
+          f"{s['device_idle_pct']}% idle")
+    for r in s["by_opcode"][:top]:
+        print(f"  {r['ms']:>10.2f}ms {r['pct']:>6.2f}% {r['calls']:>7}  "
+              f"{r['opcode']}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="Render an obs telemetry JSONL as one report")
+    ap.add_argument("jsonl", type=Path)
+    ap.add_argument("--trace", type=Path, default=None,
+                    help="XProf trace dir to aggregate via trace_summary "
+                         "(needs jax; the JSONL part never does)")
+    ap.add_argument("--top", type=int, default=8,
+                    help="rows in the trace by-opcode table")
+    args = ap.parse_args()
+    if not args.jsonl.exists():
+        print(f"no such file: {args.jsonl}", file=sys.stderr)
+        return 1
+    events = load_events(args.jsonl)
+    print(f"telemetry report: {args.jsonl}")
+    report(events, args.top)
+    if args.trace is not None:
+        report_trace(args.trace, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
