@@ -36,7 +36,8 @@ CPU_BASELINE_ROUNDS_PER_SEC = 0.001441
 
 
 def build_server(seed: int = 10, norm_impl: str = "flax",
-                 conv_impl: str = "flax", remat: bool = False):
+                 conv_impl: str = "flax", remat: bool = False,
+                 fault_spec: str = ""):
     import jax
     import jax.numpy as jnp
 
@@ -105,9 +106,12 @@ def build_server(seed: int = 10, norm_impl: str = "flax",
     # one-core-per-simulated-client north star); single-chip runs unsharded
     nr_devices = len(jax.devices())
     mesh = make_mesh({"clients": nr_devices}) if nr_devices > 1 else None
+    from ddl25spring_tpu.resilience.faults import FaultPlan
+
     return FedAvgServer(
         task, lr=0.05, batch_size=50, client_data=client_data,
         client_fraction=0.1, nr_local_epochs=1, seed=seed, mesh=mesh,
+        fault_plan=FaultPlan.parse(fault_spec),
     )
 
 
@@ -149,11 +153,14 @@ def _aot_fused_rounds(server, nr_rounds: int, run_warmup: bool = True):
 
     @functools.partial(jax.jit, static_argnames=("nr",))
     def run_n(params, key, nr, x, y, counts, mal):
-        return jax.lax.fori_loop(
-            0, nr,
-            lambda i, p: rf.raw(p, key, 1 + i, x, y, counts, mal),
-            params,
-        )
+        def body(i, p):
+            out = rf.raw(p, key, 1 + i, x, y, counts, mal)
+            # with a fault plan, raw returns (params, fault-stats); the
+            # fused timing loop only threads params (stats are a per-round
+            # observability concern, not a bench output)
+            return out[0] if isinstance(out, tuple) else out
+
+        return jax.lax.fori_loop(0, nr, body, params)
 
     params = server.params
     if run_warmup:
@@ -508,6 +515,13 @@ def main():
                          "EVERY run, --profile or not; render with "
                          "tools/obs_report.py.  Pass an empty string to "
                          "disable")
+    ap.add_argument("--faults", default="",
+                    help="operational fault spec injected into the timed "
+                         "rounds (resilience/faults.py grammar, e.g. "
+                         "'drop=0.2,nan=0.05,seed=7') — measures the cost "
+                         "of fault screening and the rounds/sec under "
+                         "degraded participation; empty = the exact "
+                         "fault-free program")
     ap.add_argument("--deadline-s", type=float, default=1500.0,
                     help="no-progress (idle) cap after the device probe: if "
                          "no milestone or transfer-chunk stamp lands for "
@@ -548,7 +562,8 @@ def main():
     _WATCHDOG = _Watchdog(args.deadline_s)
     _stamp("building server (data + mesh + jit round_fn) ...")
     server = build_server(norm_impl=args.norm_impl,
-                          conv_impl=args.conv_impl, remat=args.remat)
+                          conv_impl=args.conv_impl, remat=args.remat,
+                          fault_spec=args.faults)
     if args.cost_analysis:
         costs = cost_breakdown(server)
         _WATCHDOG.cancel()
@@ -594,6 +609,7 @@ def main():
     _emit_json(rps, final_test_accuracy_pct=round(final_acc, 2),
                rounds_timed=args.rounds, norm_impl=args.norm_impl,
                conv_impl=args.conv_impl, remat=args.remat,
+               faults=args.faults,
                trials=[round(r, 4) for r in rates],
                spread_pct=round(spread_pct, 2),
                first_execution_rps=round(rates[0], 4))

@@ -70,6 +70,11 @@ class HflConfig:
     attack: str = "none"       # none | label-flip | gaussian | sign-flip |
     #                            alie (collusive mu + z*sigma; robust/attacks)
     nr_malicious: int = 0
+    # operational fault injection (resilience/faults.py spec grammar, e.g.
+    # "drop=0.2,nan=0.05,seed=7"; "" = no plan, exact fault-free program)
+    fault_spec: str = ""
+    round_deadline_s: float = 0.0  # simulated round deadline stragglers
+    #                                are measured against; 0 = unbounded
     # harness
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # rounds; 0 = off
@@ -84,6 +89,16 @@ class HflConfig:
             raise ValueError(
                 f"dp_delta must be in (0, 1), got {self.dp_delta}"
             )
+        if self.round_deadline_s < 0:
+            raise ValueError(
+                f"round_deadline_s must be >= 0, got {self.round_deadline_s}"
+            )
+        if self.fault_spec:
+            # parse eagerly so a typo'd spec fails at config time, not
+            # mid-run (parse is pure validation; the plan is rebuilt where
+            # it is used)
+            from .resilience.faults import FaultPlan
+            FaultPlan.parse(self.fault_spec)
 
 
 @dataclass(frozen=True)

@@ -44,6 +44,24 @@ def _tree_bytes(tree) -> int:
         if hasattr(leaf, "size") and hasattr(leaf, "dtype")
     )
 
+def _obs_round_faults(stats) -> None:
+    """Feed one round's fault-stats vector (int32 [dropped, late,
+    injected, nonfinite]) into the obs registry — shared by the engine and
+    fedbuff dispatch wrappers so the counter names cannot drift.  Called
+    only with obs enabled; the int() conversions are the blocking fetch."""
+    dropped, late, injected, nonfinite = (int(v) for v in stats)
+    if dropped:
+        obs.inc("resilience_faults_injected_total", dropped, kind="drop")
+    if late:
+        obs.inc("resilience_faults_injected_total", late, kind="straggle")
+    if injected:
+        obs.inc("resilience_faults_injected_total", injected, kind="corrupt")
+    if nonfinite:
+        obs.inc("resilience_nonfinite_excluded_total", nonfinite)
+    if dropped or late or nonfinite:
+        obs.inc("resilience_degraded_rounds_total")
+
+
 # A loss function of (params, x_batch, y_batch, mask, rng_key) -> scalar.
 LossFn = Callable[..., jax.Array]
 
@@ -209,6 +227,8 @@ def make_fl_round(
     compress_ratio: float = 0.01,
     compress_deltas: bool = True,
     device_put_data: bool = True,
+    fault_plan=None,
+    round_deadline_s: float | None = None,
 ):
     """Build the jitted one-round function of a decentralized server.
 
@@ -256,6 +276,30 @@ def make_fl_round(
     in device memory, every device runs its shard of the vmapped local
     updates, and the weighted-mean aggregation lowers to one all-reduce over
     ICI.  Without ``mesh`` the same program runs on one device.
+
+    ``fault_plan`` (a ``resilience.FaultPlan``) turns the round into a
+    degraded-mode round: per-client dropout / straggler / corruption masks
+    are derived INSIDE the jitted program from ``(plan.seed, round_idx)``
+    (so they trace under bench.py's fused fori_loop and replay eagerly in
+    tests), corrupted clients get all-NaN/Inf update messages, and the
+    aggregation screens every client's update for non-finite values
+    (``resilience.guard.screen_nonfinite``), zero-weights the faulted set,
+    and renormalises over the survivors.  ``round_deadline_s`` bounds the
+    simulated round: stragglers whose drawn delay exceeds it are excluded
+    the same way (a deadline-bounded degraded round).  If NO client
+    survives, the round keeps the previous params (shapes stay static; the
+    server would otherwise re-run the round).  With a fault plan the built
+    round function returns ``(params, stats)`` from its raw jitted form —
+    ``stats`` is an int32 ``[dropped, late, injected, nonfinite]`` vector
+    the telemetry wrapper feeds to ``obs`` — while the dispatch-level
+    ``round_fn(params, key, round_idx)`` still returns params only.  With
+    a custom ``aggregator`` (which deliberately ignores weights), faulted
+    clients are neutralised by SUBSTITUTION instead: their rows are
+    replaced with the round-start params (weight-space updates) or zeros
+    (gradient updates, ``compress_deltas=False``) so robust rules see a
+    no-op update rather than poison.  Without a plan, none of this traces:
+    the compiled program is bit-identical to the fault-free one (oracle:
+    tests/test_resilience.py).
     """
     if not 0.0 <= dropout_rate <= 1.0:
         raise ValueError(
@@ -295,6 +339,15 @@ def make_fl_round(
             "clipping changes the per-client sensitivity the noise is "
             "calibrated to (no DP guarantee would hold)"
         )
+    if round_deadline_s is not None and round_deadline_s <= 0:
+        raise ValueError(
+            f"round_deadline_s={round_deadline_s} must be > 0 (it is the "
+            "simulated round deadline stragglers are measured against)"
+        )
+    if fault_plan is not None and not fault_plan.affects_fl_round:
+        # a crash/serving-only plan has nothing to inject here; dropping it
+        # keeps the compiled round on the exact fault-free program
+        fault_plan = None
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     counts = jnp.asarray(counts)
@@ -335,6 +388,7 @@ def make_fl_round(
     else:
         constrain = lambda t: t
 
+    custom_agg = aggregator is not None
     if aggregator is None:
         aggregator = lambda updates, weights, key: tree_weighted_mean(
             updates, weights
@@ -366,6 +420,14 @@ def make_fl_round(
         # entries beyond nr_sampled are shard padding: real clients that run
         # a local update but contribute weight 0 to the aggregate
         live = jnp.arange(nr_shard) < nr_sampled
+
+        if fault_plan is not None:
+            # per-client fault draws, a pure function of (plan.seed,
+            # round_idx) — independent of the round_key streams so adding
+            # a plan never perturbs sampling/aggregation randomness
+            f_keep, f_nan, f_inf, f_late = fault_plan.round_masks(
+                round_idx, nr_shard, round_deadline_s
+            )
 
         xs = constrain(jnp.take(x, sel, axis=0))
         ys = constrain(jnp.take(y, sel, axis=0))
@@ -436,6 +498,44 @@ def make_fl_round(
             else:
                 updates = space
 
+        if fault_plan is not None and fault_plan.corrupts:
+            # corruption lands on the RECEIVED message (post-attack,
+            # post-compression): a broken client's uplink is garbage no
+            # matter what the honest pipeline did to it
+            def _poison(u):
+                if not jnp.issubdtype(u.dtype, jnp.inexact):
+                    return u
+                shape = (-1,) + (1,) * (u.ndim - 1)
+                u = jnp.where(f_nan.reshape(shape), jnp.nan, u)
+                return jnp.where(f_inf.reshape(shape), jnp.inf, u)
+
+            updates = jax.tree.map(_poison, updates)
+
+        if fault_plan is not None:
+            from ..resilience.guard import tree_client_isfinite
+
+            # detects injected corruption AND naturally-diverged clients
+            finite = tree_client_isfinite(updates)
+            faulted = ~f_keep | f_late | ~finite
+            stats = jnp.stack([
+                jnp.sum(~f_keep & live), jnp.sum(f_late & live),
+                jnp.sum((f_nan | f_inf) & live),
+                jnp.sum(~finite & live),
+            ]).astype(jnp.int32)
+            if custom_agg:
+                # robust aggregators ignore weights, so exclusion must be
+                # by substitution: faulted rows become a no-op update
+                # (round-start params for weight-space messages, zeros for
+                # gradients) the rule can safely rank/average
+                def _neutralise(u, p):
+                    if not jnp.issubdtype(u.dtype, jnp.inexact):
+                        return u
+                    shape = (-1,) + (1,) * (u.ndim - 1)
+                    neutral = p if compress_deltas else jnp.zeros_like(p)
+                    return jnp.where(faulted.reshape(shape), neutral, u)
+
+                updates = jax.tree.map(_neutralise, updates, params)
+
         if dp_clip:
             # client-level DP: clip each client's delta from the round-start
             # params to L2 <= dp_clip; uniform weights (n_k would leak)
@@ -465,8 +565,32 @@ def make_fl_round(
                 jnp.any(survived & live), survived, jnp.ones_like(survived)
             )
             weights = jnp.where(survived, weights, 0.0)
-        nr_contributing = jnp.sum(weights > 0)
-        weights = weights / jnp.sum(weights)
+        if fault_plan is not None and not custom_agg:
+            # zero-weight the faulted set (dropout + deadline stragglers +
+            # non-finite screen) and renormalise over the survivors — the
+            # ONE normalisation step below, so a fault-free draw (masks
+            # all-pass) is bit-identical to the plan-less program
+            weights = jnp.where(faulted, 0.0, weights)
+            wsum = jnp.sum(weights)
+            any_survivor = wsum > 0
+            nr_contributing = jnp.sum(weights > 0)
+            # all-faulted round: divide by 1 (weights stay all-zero, the
+            # aggregate is zeros) and keep the old params at the end
+            weights = weights / jnp.where(any_survivor, wsum, 1.0)
+            # zero weight is not enough for non-finite rows: the weighted
+            # mean multiplies BEFORE summing and NaN * 0 is still NaN, so
+            # hard-zero the faulted rows themselves
+            updates = jax.tree.map(
+                lambda u: jnp.where(
+                    faulted.reshape((-1,) + (1,) * (u.ndim - 1)), 0.0, u
+                ).astype(u.dtype) if jnp.issubdtype(u.dtype, jnp.inexact)
+                else u,
+                updates,
+            )
+        else:
+            any_survivor = jnp.bool_(True)
+            nr_contributing = jnp.sum(weights > 0)
+            weights = weights / jnp.sum(weights)
         aggregate = aggregator(updates, weights, agg_key)
         if dp_clip and dp_noise_mult:
             # Gaussian mechanism on the delta mean: per-coordinate std
@@ -480,7 +604,15 @@ def make_fl_round(
                 for i, l in enumerate(leaves)
             ]
             aggregate = jax.tree.unflatten(treedef, noisy)
-        return apply_aggregate(params, aggregate)
+        if fault_plan is None:
+            return apply_aggregate(params, aggregate)
+        from ..utils.trees import tree_select
+
+        new_params = apply_aggregate(params, aggregate)
+        # degraded-round floor: with zero survivors the aggregate above is
+        # zeros — installing it would zero the model, so keep the previous
+        # params (static shapes; the host sees it in stats and telemetry)
+        return tree_select(any_survivor, new_params, params), stats
 
     def round_fn(params, base_key, round_idx):
         # telemetry wraps the DISPATCH boundary only; under an outer
@@ -488,12 +620,18 @@ def make_fl_round(
         # bench.py's fused fori_loop path uses round_fn.raw directly and
         # is untouched either way.
         if not obs.enabled() or isinstance(round_idx, jax.core.Tracer):
-            return _round(params, base_key, round_idx, x, y, counts,
-                          mal_mask)
+            out = _round(params, base_key, round_idx, x, y, counts,
+                         mal_mask)
+            return out[0] if fault_plan is not None else out
         with obs.span("fl.round") as sp:
-            new_params = sp.fence(
+            out = sp.fence(
                 _round(params, base_key, round_idx, x, y, counts, mal_mask)
             )
+        if fault_plan is not None:
+            new_params, stats = out
+            _obs_round_faults(stats)
+        else:
+            new_params = out
         obs.inc("fl_rounds_total")
         obs.inc("fl_clients_sampled_total", nr_sampled)
         obs.set_gauge("fl_clients_per_round", nr_sampled)
@@ -510,7 +648,8 @@ def make_fl_round(
     # the data as explicit arguments keeps it out of the fused program's
     # HLO — calling the closure under an outer jit would embed the stacked
     # dataset as a compile-time constant (the exact failure the comment
-    # above _round documents).
+    # above _round documents).  With a fault_plan, raw returns
+    # (params, stats) — fused callers keep [0] as the loop carry.
     round_fn.raw = _round
     round_fn.data = (x, y, counts, mal_mask)
     return round_fn

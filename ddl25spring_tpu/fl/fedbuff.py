@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from .. import obs
 from ..utils.trees import tree_weighted_mean
-from .engine import _tree_bytes, sample_clients
+from .engine import _obs_round_faults, _tree_bytes, sample_clients
 from .servers import DecentralizedServer as _DecentralizedServer
 
 
@@ -44,14 +44,30 @@ def make_fedbuff_round(
     staleness_window: int = 4,
     staleness_exp: float = 0.5,
     server_eta: float = 1.0,
+    fault_plan=None,
+    round_deadline_s: float | None = None,
 ):
     """Build ``tick(history, base_key, tick_idx) -> history`` where
     ``history`` is the params pytree with a leading ``staleness_window``
     version axis (index 0 = current).  ``client_update`` has the engine
     contract ``(params, x_i, y_i, count_i, key_i) -> local_params``.
+
+    ``fault_plan``/``round_deadline_s`` have ``engine.make_fl_round``
+    semantics: in-trace per-client masks drop/corrupt/straggle the sampled
+    set, non-finite deltas are screened, and the staleness-weighted mean
+    renormalises over the survivors.  An all-faulted tick applies a zero
+    delta (params carry over unchanged — the async analogue of a degraded
+    round).  No plan -> the exact fault-free program (the W=1 FedAvg
+    oracle keeps pinning it).
     """
     if staleness_window < 1:
         raise ValueError(f"staleness_window must be >= 1, got {staleness_window}")
+    if round_deadline_s is not None and round_deadline_s <= 0:
+        raise ValueError(
+            f"round_deadline_s={round_deadline_s} must be > 0"
+        )
+    if fault_plan is not None and not fault_plan.affects_fl_round:
+        fault_plan = None
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     counts = jnp.asarray(counts)
@@ -88,26 +104,75 @@ def make_fedbuff_round(
 
         deltas = jax.vmap(one_client)(stale, xs, ys, cs, keys)
 
+        if fault_plan is not None and fault_plan.corrupts:
+            _, f_nan, f_inf, _ = fault_plan.round_masks(
+                tick_idx, nr_sampled, round_deadline_s
+            )
+
+            def _poison(d):
+                if not jnp.issubdtype(d.dtype, jnp.inexact):
+                    return d
+                shape = (-1,) + (1,) * (d.ndim - 1)
+                d = jnp.where(f_nan.reshape(shape), jnp.nan, d)
+                return jnp.where(f_inf.reshape(shape), jnp.inf, d)
+
+            deltas = jax.tree.map(_poison, deltas)
+
         weights = cs.astype(jnp.float32) / (1.0 + stale.astype(jnp.float32)) ** staleness_exp
-        weights = weights / jnp.sum(weights)
+        if fault_plan is not None:
+            from ..resilience.guard import tree_client_isfinite
+
+            f_keep, f_nan, f_inf, f_late = fault_plan.round_masks(
+                tick_idx, nr_sampled, round_deadline_s
+            )
+            finite = tree_client_isfinite(deltas)
+            faulted = ~f_keep | f_late | ~finite
+            stats = jnp.stack([
+                jnp.sum(~f_keep), jnp.sum(f_late),
+                jnp.sum(f_nan | f_inf), jnp.sum(~finite),
+            ]).astype(jnp.int32)
+            # zero-weight + renormalise over survivors; an all-faulted
+            # tick divides by 1 and applies a ZERO delta (params carry
+            # over — the buffer simply had nothing trustworthy in it)
+            weights = jnp.where(faulted, 0.0, weights)
+            wsum = jnp.sum(weights)
+            weights = weights / jnp.where(wsum > 0, wsum, 1.0)
+            # faulted rows may hold NaN/Inf; tree_weighted_mean multiplies
+            # before summing and NaN * 0 is still NaN, so hard-zero them
+            deltas = jax.tree.map(
+                lambda d: jnp.where(
+                    faulted.reshape((-1,) + (1,) * (d.ndim - 1)), 0.0, d
+                ).astype(d.dtype) if jnp.issubdtype(d.dtype, jnp.inexact)
+                else d,
+                deltas,
+            )
+        else:
+            weights = weights / jnp.sum(weights)
         delta = tree_weighted_mean(deltas, weights)
 
         current = jax.tree.map(lambda h: h[0], history)
         new = jax.tree.map(lambda p, d: p + server_eta * d, current, delta)
         # push the new version: roll the axis and overwrite slot 0
-        return jax.tree.map(
+        out = jax.tree.map(
             lambda h, n: jnp.roll(h, 1, axis=0).at[0].set(n), history, new
         )
+        return (out, stats) if fault_plan is not None else out
 
     def tick(history, base_key, tick_idx):
         # dispatch-boundary telemetry, same shape as engine.make_fl_round's
         # round_fn (skipped under an outer trace / with obs disabled)
         if not obs.enabled() or isinstance(tick_idx, jax.core.Tracer):
-            return _tick(history, base_key, tick_idx, x, y, counts)
+            out = _tick(history, base_key, tick_idx, x, y, counts)
+            return out[0] if fault_plan is not None else out
         with obs.span("fl.tick", staleness_window=W) as sp:
-            new_history = sp.fence(
+            out = sp.fence(
                 _tick(history, base_key, tick_idx, x, y, counts)
             )
+        if fault_plan is not None:
+            new_history, f_stats = out
+            _obs_round_faults(f_stats)
+        else:
+            new_history = out
         obs.inc("fl_rounds_total")
         obs.inc("fl_clients_sampled_total", nr_sampled)
         obs.set_gauge("fl_clients_per_round", nr_sampled)
@@ -149,7 +214,8 @@ class FedBuffServer(_DecentralizedServer):
     def __init__(self, task, lr: float, batch_size: int, client_data,
                  client_fraction: float, nr_local_epochs: int, seed: int,
                  staleness_window: int = 4, staleness_exp: float = 0.5,
-                 server_eta: float = 1.0):
+                 server_eta: float = 1.0, fault_plan=None,
+                 round_deadline_s: float | None = None):
         from .engine import make_local_sgd_update
 
         super().__init__(task, lr, batch_size, client_data, client_fraction,
@@ -164,6 +230,7 @@ class FedBuffServer(_DecentralizedServer):
             self.nr_clients_per_round,
             staleness_window=staleness_window,
             staleness_exp=staleness_exp, server_eta=server_eta,
+            fault_plan=fault_plan, round_deadline_s=round_deadline_s,
         )
         self.params = init_history(self.params, staleness_window)
         # evaluate the CURRENT version of the stacked history

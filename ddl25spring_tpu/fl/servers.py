@@ -175,7 +175,8 @@ class FedSgdGradientServer(DecentralizedServer):
     def __init__(self, task: Task, lr: float, client_data: ClientDatasets,
                  client_fraction: float, seed: int,
                  aggregator=None, attack=None, malicious_mask=None, mesh=None,
-                 compress: str = "none", compress_ratio: float = 0.01):
+                 compress: str = "none", compress_ratio: float = 0.01,
+                 fault_plan=None, round_deadline_s: float | None = None):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDGradient"
@@ -194,6 +195,7 @@ class FedSgdGradientServer(DecentralizedServer):
             # compression acts on it directly, not on a params delta
             compress=compress, compress_ratio=compress_ratio,
             compress_deltas=False,
+            fault_plan=fault_plan, round_deadline_s=round_deadline_s,
         )
 
 
@@ -205,7 +207,8 @@ class FedSgdWeightServer(DecentralizedServer):
 
     def __init__(self, task: Task, lr: float, client_data: ClientDatasets,
                  client_fraction: float, seed: int,
-                 aggregator=None, attack=None, malicious_mask=None, mesh=None):
+                 aggregator=None, attack=None, malicious_mask=None, mesh=None,
+                 fault_plan=None, round_deadline_s: float | None = None):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDWeight"
@@ -217,6 +220,7 @@ class FedSgdWeightServer(DecentralizedServer):
             aggregator=aggregator,
             attack=attack, malicious_mask=malicious_mask,
             mesh=mesh,
+            fault_plan=fault_plan, round_deadline_s=round_deadline_s,
         )
 
 
@@ -238,7 +242,8 @@ class FedAvgServer(DecentralizedServer):
                  aggregator=None, attack=None, malicious_mask=None, mesh=None,
                  prox_mu: float = 0.0, dropout_rate: float = 0.0,
                  dp_clip: float = 0.0, dp_noise_mult: float = 0.0,
-                 compress: str = "none", compress_ratio: float = 0.01):
+                 compress: str = "none", compress_ratio: float = 0.01,
+                 fault_plan=None, round_deadline_s: float | None = None):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         self.algorithm = "FedAvg" if prox_mu == 0.0 else "FedProx"
@@ -259,6 +264,7 @@ class FedAvgServer(DecentralizedServer):
             # weight server: the client message is its params delta
             compress=compress, compress_ratio=compress_ratio,
             compress_deltas=True,
+            fault_plan=fault_plan, round_deadline_s=round_deadline_s,
         )
 
 
@@ -282,7 +288,8 @@ class FedOptServer(DecentralizedServer):
                  nr_local_epochs: int, seed: int,
                  server_optimizer: str = "adam", server_lr: float = 1e-2,
                  aggregator=None, attack=None, malicious_mask=None, mesh=None,
-                 prox_mu: float = 0.0, dropout_rate: float = 0.0):
+                 prox_mu: float = 0.0, dropout_rate: float = 0.0,
+                 fault_plan=None, round_deadline_s: float | None = None):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         if server_optimizer not in self.OPTIMIZERS:
@@ -316,6 +323,7 @@ class FedOptServer(DecentralizedServer):
             apply_aggregate=lambda params, agg: agg,  # return w_avg itself
             attack=attack, malicious_mask=malicious_mask,
             mesh=mesh, dropout_rate=dropout_rate,
+            fault_plan=fault_plan, round_deadline_s=round_deadline_s,
         )
 
         @jax.jit

@@ -15,8 +15,8 @@ from .llama import (
 )
 from .generate import generate, precompute_prefix, sequence_logprobs
 from .distill import distill_draft
-from .serving import (ContinuousBatcher, serve_fused,
-                      serve_fused_speculative)
+from .serving import (AdmissionRejected, ContinuousBatcher, ServedTokens,
+                      serve_fused, serve_fused_speculative)
 from .lora import (
     LoRADense,
     lora_trainable_mask,
@@ -32,7 +32,9 @@ __all__ = [
     "sequence_logprobs",
     "speculative_generate",
     "distill_draft",
+    "AdmissionRejected",
     "ContinuousBatcher",
+    "ServedTokens",
     "serve_fused",
     "serve_fused_speculative",
     "LoRADense",

@@ -72,6 +72,32 @@ from .. import obs
 from .llama import Llama, LlamaConfig
 
 
+class AdmissionRejected(RuntimeError):
+    """Bounded-queue backpressure: the batcher's waiting queue is full.
+    ``retry_after_s`` is the scheduler's estimate of when a queue lane
+    frees up — clients back off (``resilience.retry.retry_call`` with
+    ``retry_on=(AdmissionRejected,)``) instead of piling on."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class ServedTokens(list):
+    """A served request's token list plus its resilience ``status``:
+    ``"ok"``, ``"timed_out"`` (deadline eviction — the tokens are the
+    PARTIAL stream emitted before the deadline) or ``"poisoned"``
+    (non-finite logits; tokens truncated before the first bad chunk).
+    Compares equal to a plain list of the same tokens, so oracle tests
+    against ``generate()`` need no unwrapping."""
+
+    __slots__ = ("status",)
+
+    def __init__(self, tokens=(), status: str = "ok"):
+        super().__init__(tokens)
+        self.status = status
+
+
 @dataclass
 class _Slot:
     # run() keys requests by position (int); the streaming interface by
@@ -83,6 +109,11 @@ class _Slot:
     budget: int = 0
     total: int = 0
     done_eos: bool = False
+    # resilience: absolute perf_counter deadline (None = unbounded) and
+    # deferred poison-guard chunk flags ((ok_array, row) refs, budget
+    # mode) — resolved with the tokens at end of run
+    deadline: float | None = None
+    ok_refs: list = field(default_factory=list)
 
     @property
     def free(self) -> bool:
@@ -141,11 +172,16 @@ def _make_empty_cache(model, max_batch: int):
     return jax.jit(functools.partial(_empty_cache_of, model, max_batch))
 
 
-def _decode_step(model, P: int, params, pad, carry, _=None):
+def _decode_step(model, P: int, params, pad, carry, _=None, *, check=False):
     """One lockstep greedy decode step for all slots at their own depths —
     the scan body every serving path shares (host batcher chunks, fused
     while_loop, scheduled scan), so the bit-identical-to-generate()
-    contract rests on exactly one copy of the math."""
+    contract rests on exactly one copy of the math.
+
+    ``check`` (keyword-only: the fused call sites pass positionally and
+    stay on the plain path) additionally emits a per-row all-finite flag
+    over the step's logits — the batcher's poison guard.  The token math
+    is untouched either way."""
     cache, tok, pos = carry
     logits, state = model.apply(
         {**params, "cache": cache}, tok[:, None],
@@ -153,6 +189,9 @@ def _decode_step(model, P: int, params, pad, carry, _=None):
         mutable=["cache"],
     )
     nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
+    if check:
+        ok = jnp.isfinite(logits[:, 0]).all(axis=-1)
+        return (state["cache"], nxt, pos + 1), (nxt, ok)
     return (state["cache"], nxt, pos + 1), nxt
 
 
@@ -231,8 +270,8 @@ def _programs(config: LlamaConfig, max_batch: int, prefill_width: int,
         pad = pad.at[slots].set(pads)
         return cache, tokens, pos, pad, firsts
 
-    @functools.partial(jax.jit, static_argnames=("nr",))
-    def decode(params, cache, tokens, pos, pad, nr=1):
+    @functools.partial(jax.jit, static_argnames=("nr", "check"))
+    def decode(params, cache, tokens, pos, pad, nr=1, check=False):
         """``nr`` lockstep tokens for every slot at its own depth.
 
         tokens (B,), pos (B,) the slot each row writes first, pad (B,)
@@ -241,15 +280,24 @@ def _programs(config: LlamaConfig, max_batch: int, prefill_width: int,
         ``nr`` tokens (the scheduler intervenes only at chunk boundaries;
         over a remote tunnel per-dispatch RTT would otherwise dominate).
         Each step feeds its argmax forward exactly like generate()'s
-        scan, so per-row streams are bit-identical at any chunking."""
-        (cache, last, final_pos), toks = jax.lax.scan(
-            functools.partial(_decode_step, model, P, params, pad),
+        scan, so per-row streams are bit-identical at any chunking.
+
+        ``check`` (the batcher's poison guard) appends a (B,) bool —
+        every step of this chunk produced all-finite logits for the row —
+        as a fifth output; the token math is identical, so guarded and
+        unguarded streams stay bit-equal."""
+        (cache, last, final_pos), ys = jax.lax.scan(
+            functools.partial(_decode_step, model, P, params, pad,
+                              check=check),
             (cache, tokens, pos), None, length=nr,
         )
         # ``last`` == toks[:, -1]; returning it saves the scheduler a
         # separate slice dispatch per chunk (each dispatch costs ~10 ms
         # over the remote tunnel, measured round 5)
-        return cache, toks.T, final_pos, last  # toks (B, nr)
+        if check:
+            toks, ok = ys
+            return cache, toks.T, final_pos, last, ok.all(axis=0)
+        return cache, ys.T, final_pos, last  # toks (B, nr)
 
     return admit, decode, _make_empty_cache(model, max_batch)
 
@@ -268,12 +316,23 @@ class ContinuousBatcher:
 
     def __init__(self, config: LlamaConfig, params, *, max_batch: int = 8,
                  prefill_width: int = 64, eos_id: int | None = None,
-                 decode_chunk: int = 1, prefix: tuple | None = None):
+                 decode_chunk: int = 1, prefix: tuple | None = None,
+                 max_queue: int | None = None, poison_guard: bool = False,
+                 fault_plan=None):
         # ``params`` is the full variables dict ({"params": ...}), the same
         # contract as models.generate.generate / speculative_generate.
         # ``decode_chunk``: tokens per decode dispatch — admissions happen
         # at chunk boundaries, so larger chunks trade slot-refill latency
-        # for nr-fold less dispatch overhead (vital over a remote tunnel)
+        # for nr-fold less dispatch overhead (vital over a remote tunnel).
+        #
+        # Resilience (docs/RESILIENCE.md):
+        # ``max_queue``     bounded streaming queue — ``submit`` raises
+        #                   AdmissionRejected(retry_after_s) when full;
+        # ``poison_guard``  screen decode logits for non-finite values and
+        #                   evict (+ quarantine) poisoned slots;
+        # ``fault_plan``    resilience.FaultPlan — its ``serve_timeout``
+        #                   rate injects deterministic request stalls
+        #                   (evicted as ``timed_out``).
         if config.decode_seq_shards > 1:
             raise NotImplementedError(
                 "continuous batching over the sequence-sharded cache: use "
@@ -304,6 +363,19 @@ class ContinuousBatcher:
         self.pad = jnp.zeros((max_batch,), jnp.int32)
         self.tokens = jnp.zeros((max_batch,), jnp.int32)
         self.slots = [_Slot() for _ in range(max_batch)]
+        # resilience state
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self.poison_guard = bool(poison_guard)
+        self.fault_plan = fault_plan
+        self._quarantined: set[int] = set()  # poisoned slots, out of rotation
+        self._status: dict = {}  # rid -> non-ok status for the current run
+        self._deadlines: dict = {}  # rid -> deadline_s; the clock starts
+        # at ADMISSION (decode-time bound; queue wait is the backpressure
+        # knob's job, not the deadline's)
+        self._okrefs: dict = {}  # rid -> deferred poison-guard chunk refs
+        self._chunk_s = 0.0  # EWMA of fenced chunk wall time (backpressure)
         # streaming interface state (submit/step/drain)
         self._queue: list = []
         self._instant: dict = {}  # zero-budget submissions, returned next step
@@ -369,6 +441,8 @@ class ContinuousBatcher:
                 jnp.asarray(lengths), jnp.asarray(slot_ix), self.tokens,
                 self.pos, self.pad, self._prefix_cache,
             )
+        now = (time.perf_counter()
+               if self._deadlines or self.fault_plan is not None else 0.0)
         for g, (s, rid, _prompt, budget) in enumerate(admissions):
             sl = self.slots[s]
             sl.request_id = rid
@@ -376,6 +450,15 @@ class ContinuousBatcher:
             sl.budget = budget - 1
             sl.total = budget
             sl.done_eos = False
+            sl.ok_refs = []
+            # injected stall (fault plan): the request's deadline is
+            # already behind it — evicted at the next chunk boundary
+            rel = self._deadlines.get(rid)
+            if (self.fault_plan is not None
+                    and self.fault_plan.serving_fault(rid)):
+                sl.deadline = now
+            else:
+                sl.deadline = None if rel is None else now + rel
         self.stats["admitted"] += G0
         return firsts
 
@@ -413,8 +496,13 @@ class ContinuousBatcher:
                         cut = out.index(self.eos_id) + 1
                         out = out[:cut]
                     out = out + [0] * (sl.total - len(out))
+                if sl.ok_refs:
+                    # deferred poison-guard flags ride along until the
+                    # end-of-run resolve (budget mode)
+                    self._okrefs[sl.request_id] = sl.ok_refs
                 finished[sl.request_id] = out
                 done_rids.append(sl.request_id)
+                self._deadlines.pop(sl.request_id, None)
                 self.slots[s] = _Slot()
         if resolve:
             # tokens are host ints right here — this IS completion.  In
@@ -422,7 +510,71 @@ class ContinuousBatcher:
             # run() observes completion after its single end-of-run fetch.
             self._obs_finish(done_rids)
 
-    def run(self, requests, max_new_tokens):
+    # -- resilience: deadline eviction, poison quarantine ----------------
+
+    def _evict_expired(self, finished: dict, now: float | None = None):
+        """Evict every active slot whose deadline has passed: its PARTIAL
+        stream (whatever was emitted before the deadline — host ints in
+        EOS/streaming mode, refs in budget mode) becomes the result,
+        status ``timed_out``.  Never raises: a deadline miss is data, not
+        an error."""
+        rids = []
+        for s, sl in enumerate(self.slots):
+            if sl.free or sl.deadline is None:
+                continue
+            if now is None:
+                now = time.perf_counter()
+            if now >= sl.deadline:
+                if sl.ok_refs:
+                    self._okrefs[sl.request_id] = sl.ok_refs
+                finished[sl.request_id] = sl.emitted
+                self._status[sl.request_id] = "timed_out"
+                rids.append(sl.request_id)
+                obs.inc("serving_timed_out_total")
+                obs.event("serving.timed_out", rid=repr(sl.request_id),
+                          emitted=len(sl.emitted))
+                self._deadlines.pop(sl.request_id, None)
+                self.slots[s] = _Slot()
+        if rids:
+            self._obs_finish(rids)
+
+    def _evict_poisoned(self, active, ok_host, finished: dict):
+        """Evict slots whose LAST decode chunk produced non-finite logits
+        (called BEFORE the chunk's tokens are booked, so the garbage
+        argmax stream never reaches the result): partial output, status
+        ``poisoned``, slot quarantined out of rotation — its cache rows
+        hold NaN/Inf a later occupant would read through attention."""
+        rids = []
+        for s in active:
+            sl = self.slots[s]
+            if sl.free or bool(ok_host[s]):
+                continue
+            finished[sl.request_id] = sl.emitted
+            self._status[sl.request_id] = "poisoned"
+            rids.append(sl.request_id)
+            self._quarantined.add(s)
+            obs.inc("serving_poisoned_total")
+            obs.event("serving.poisoned", rid=repr(sl.request_id), slot=s)
+            self._deadlines.pop(sl.request_id, None)
+            self.slots[s] = _Slot()
+        if rids:
+            self._obs_finish(rids)
+
+    def scrub(self):
+        """Zero the cache rows of quarantined slots and return them to
+        rotation (one dispatch).  The scheduler calls this itself when
+        admissions starve with every usable slot quarantined; callers can
+        also scrub eagerly between workloads."""
+        if not self._quarantined:
+            return
+        ix = jnp.asarray(sorted(self._quarantined), jnp.int32)
+        self.cache = jax.tree.map(
+            lambda big: big.at[ix].set(jnp.zeros((), big.dtype)), self.cache
+        )
+        obs.inc("serving_slots_scrubbed_total", len(self._quarantined))
+        self._quarantined.clear()
+
+    def run(self, requests, max_new_tokens, *, deadline_s=None):
         """Serve ``requests`` (list of 1-D int token prompts); returns a
         list of generated-token lists, in request order.
 
@@ -430,7 +582,18 @@ class ContinuousBatcher:
         per-request list — heterogeneous budgets are continuous batching's
         home turf: a slot whose request finishes early is refilled
         immediately.  Each output has its request's budget length,
-        EOS-padded like ``generate``."""
+        EOS-padded like ``generate``.
+
+        ``deadline_s`` (scalar or per-request list; None = unbounded)
+        bounds each request's DECODE time from its admission: a slot past
+        its deadline is evicted at the next chunk boundary and returns its
+        partial stream as :class:`ServedTokens` with status
+        ``timed_out``.  Deadlines force a device fence per chunk so wall
+        clock means something — budget mode loses its 1-fetch pipelining
+        (the documented cost of bounded latency).  With any resilience
+        feature active (deadlines, ``poison_guard``, a ``fault_plan``)
+        every result comes back as :class:`ServedTokens` (== its plain
+        list); otherwise the return is exactly the plain-list fast path."""
         if self.in_flight:
             raise RuntimeError(
                 "run() on a batcher with streaming requests in flight: "
@@ -450,6 +613,33 @@ class ContinuousBatcher:
             prefix_len=self.prefix_len, decode_chunk=self.decode_chunk,
             ctx_size=self.config.ctx_size,
         )
+        if deadline_s is None:
+            deadlines = {}
+        elif isinstance(deadline_s, (int, float, np.floating, np.integer)):
+            deadlines = {i: float(deadline_s) for i in range(len(requests))}
+        else:
+            if len(deadline_s) != len(requests):
+                raise ValueError(
+                    f"{len(deadline_s)} deadlines for {len(requests)} "
+                    "requests"
+                )
+            deadlines = {i: float(d) for i, d in enumerate(deadline_s)
+                         if d is not None}
+        if any(d <= 0 for d in deadlines.values()):
+            raise ValueError(
+                f"deadline_s must be > 0 (got {deadline_s!r}); a request "
+                "that cannot start has no business being submitted"
+            )
+        stalls = (self.fault_plan is not None
+                  and self.fault_plan.serve_timeout > 0)
+        resilient = bool(deadlines) or self.poison_guard or stalls
+        # deadline eviction needs a meaningful wall clock at chunk
+        # boundaries, so those runs FENCE each chunk (EOS mode already
+        # blocks per chunk for its token fetch — no extra fence there)
+        fenced = bool(deadlines) or stalls
+        self._deadlines = dict(deadlines)
+        self._status = {}
+        self._okrefs = {}
         finished: dict = {i: [] for i, b in enumerate(budgets) if b == 0}
         # longest-budget-first admission: the classic makespan heuristic —
         # big jobs start early, the tail is filled with small ones.  Output
@@ -481,12 +671,38 @@ class ContinuousBatcher:
                     if eos_mode:
                         self._sync_admit_bookkeep(group, firsts)
                 self._harvest(finished, resolve=eos_mode)
+                if fenced:
+                    self._evict_expired(finished)
                 active = [s for s, sl in enumerate(self.slots)
                           if not sl.free]
                 if not active:
+                    if pending and self._quarantined:
+                        # admission starved with every usable slot
+                        # quarantined: scrub the poisoned rows and retry
+                        self.scrub()
                     continue
                 K = self.decode_chunk
-                toks = self._dispatch_chunk()
+                t_chunk = time.perf_counter() if fenced else 0.0
+                out = self._dispatch_chunk(check=self.poison_guard)
+                if self.poison_guard:
+                    toks, ok_dev = out
+                else:
+                    toks, ok_dev = out, None
+                if fenced:
+                    # the fence deadlines pay for: wall clock at the
+                    # chunk boundary now reflects completed device work
+                    jax.block_until_ready(toks)
+                    dt = time.perf_counter() - t_chunk
+                    self._chunk_s = (0.8 * self._chunk_s + 0.2 * dt
+                                     if self._chunk_s else dt)
+                eager_guard = ok_dev is not None and (eos_mode or fenced)
+                if eager_guard:
+                    # eager containment (the per-chunk block is already
+                    # paid for): evict BEFORE booking the chunk, so the
+                    # garbage argmax stream never reaches the result
+                    self._evict_poisoned(active, np.asarray(ok_dev),
+                                         finished)
+                    active = [s for s in active if not self.slots[s].free]
                 if eos_mode:
                     self._sync_chunk_bookkeep(active, toks)
                 else:
@@ -495,14 +711,42 @@ class ContinuousBatcher:
                         use = min(K, sl.budget)
                         if use > 0:
                             sl.emitted.append((toks, s, use))
+                            if ok_dev is not None and not eager_guard:
+                                # deferred guard: flags resolved with the
+                                # tokens in the end-of-run fetch
+                                sl.ok_refs.append((ok_dev, s))
                             sl.budget -= use
                             self.stats["active_steps"] += use
+                if fenced:
+                    self._evict_expired(finished)
                 self._harvest(finished, resolve=eos_mode)
             if not eos_mode:
                 fetched: dict = {}  # shared across requests: chunk arrays
-                for rid, refs in finished.items():
-                    if refs:
-                        finished[rid] = self._resolve(refs, fetched)
+                for rid in list(finished):
+                    refs = finished[rid]
+                    if not refs:
+                        continue
+                    toks_l = self._resolve(refs, fetched)
+                    okr = self._okrefs.pop(rid, None)
+                    if okr:
+                        # deferred poison guard (unfenced budget mode —
+                        # the pipelining trade: detection is post-hoc, so
+                        # truncate at the first bad chunk here; eager
+                        # containment needs EOS mode or a deadline)
+                        bad = None
+                        for k, (arr, row) in enumerate(okr):
+                            buf = fetched.get(id(arr))
+                            if buf is None:
+                                buf = fetched[id(arr)] = np.asarray(arr)
+                            if not bool(buf[row]):
+                                bad = k
+                                break
+                        if bad is not None:
+                            cut = sum(c for _a, _i, c in refs[:bad + 1])
+                            toks_l = toks_l[:cut]
+                            self._status[rid] = "poisoned"
+                            obs.inc("serving_poisoned_total")
+                    finished[rid] = toks_l
                 # the resolve fetch above was the run's ONE block — every
                 # deferred request completed here
                 self._obs_finish(list(self._req_ts))
@@ -514,28 +758,44 @@ class ContinuousBatcher:
             if elapsed > 0:
                 obs.set_gauge("serving_tokens_per_sec",
                               nr_tokens / elapsed)
+        self._deadlines = {}
+        if resilient:
+            return [ServedTokens(finished[i], self._status.get(i, "ok"))
+                    for i in range(len(requests))]
         return [finished[i] for i in range(len(requests))]
 
-    def _dispatch_chunk(self):
+    def _dispatch_chunk(self, check: bool = False):
         """One decode_chunk dispatch over all slots; updates cache/pos/
-        tokens and the step telemetry, returns the (B, K) token array.
-        Shared by run() and the streaming step()."""
+        tokens and the step telemetry, returns the (B, K) token array —
+        or ``(tokens, ok)`` with the per-row all-finite chunk flags when
+        ``check`` (the poison guard) is on.  Shared by run() and the
+        streaming step()."""
         K = self.decode_chunk
         # dispatch-boundary span, unfenced: budget mode streams chunks
         # back-to-back and a block here would serialise the pipeline
         with obs.span("serving.decode", chunk=K):
-            self.cache, toks, self.pos, self.tokens = self._decode(
-                self.params, self.cache, self.tokens, self.pos, self.pad,
-                nr=K,
-            )
+            if check:
+                self.cache, toks, self.pos, self.tokens, ok = self._decode(
+                    self.params, self.cache, self.tokens, self.pos,
+                    self.pad, nr=K, check=True,
+                )
+            else:
+                self.cache, toks, self.pos, self.tokens = self._decode(
+                    self.params, self.cache, self.tokens, self.pos,
+                    self.pad, nr=K,
+                )
         self.stats["decode_steps"] += K
         self.stats["slot_steps"] += self.max_batch * K
-        return toks
+        return (toks, ok) if check else toks
 
     def _admit_from(self, pending: list) -> list:
         """Pop requests off ``pending`` into free slots; returns the
-        admission group handed to _admit_group (empty if none)."""
-        free = [s for s, sl in enumerate(self.slots) if sl.free]
+        admission group handed to _admit_group (empty if none).
+        Quarantined slots (poison guard) stay out of rotation — their
+        cache rows hold non-finite state a new request's decode would
+        read through attention."""
+        free = [s for s, sl in enumerate(self.slots)
+                if sl.free and s not in self._quarantined]
         group = []
         while pending and free:
             rid, prompt, budget = pending.pop(0)
@@ -577,16 +837,39 @@ class ContinuousBatcher:
         active = sum(1 for sl in self.slots if not sl.free)
         return len(self._queue) + len(self._instant) + active
 
-    def submit(self, rid, prompt, max_new_tokens: int) -> None:
+    def submit(self, rid, prompt, max_new_tokens: int,
+               deadline_s: float | None = None) -> None:
         """Enqueue one request under key ``rid`` (any hashable, unique
         among in-flight requests); it joins the running batch at the next
         ``step()`` with a free slot.  Zero budgets resolve to ``[]`` at
-        the next step."""
+        the next step.
+
+        With ``max_queue`` set, a full waiting queue raises
+        :class:`AdmissionRejected` (with a ``retry_after_s`` backoff
+        estimate from recent chunk times) instead of growing without
+        bound — load the caller can see beats latency it can't.
+        ``deadline_s`` bounds the request's decode time from admission;
+        past it the slot is evicted and the partial stream comes back as
+        :class:`ServedTokens` with status ``timed_out``."""
         if (rid in self._instant
                 or any(q[0] == rid for q in self._queue)
                 or any(sl.request_id == rid for sl in self.slots
                        if not sl.free)):
             raise ValueError(f"request id {rid!r} already in flight")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s={deadline_s} must be > 0")
+        if (self.max_queue is not None
+                and len(self._queue) >= self.max_queue):
+            # backoff estimate: one queue lane frees up roughly every
+            # (chunk time x queue depth / batch width) at steady state
+            est = self._chunk_s if self._chunk_s > 0 else 0.05
+            retry_after = max(0.01, est * (1 + len(self._queue)
+                                           / self.max_batch))
+            obs.inc("serving_rejected_total")
+            raise AdmissionRejected(
+                f"queue full ({len(self._queue)}/{self.max_queue}); "
+                f"retry in ~{retry_after:.3f}s", retry_after,
+            )
         budget = int(max_new_tokens)
         _validate_workload(
             [prompt], [budget], prefill_width=self.prefill_width,
@@ -595,6 +878,8 @@ class ContinuousBatcher:
         )
         if obs.enabled():
             self._req_ts[rid] = time.perf_counter()
+        if deadline_s is not None:
+            self._deadlines[rid] = float(deadline_s)
         if budget == 0:
             self._instant[rid] = []
             return
@@ -615,14 +900,38 @@ class ContinuousBatcher:
         if group:
             self._sync_admit_bookkeep(group, self._admit_group(group))
         self._harvest(finished, resolve=True)
+        self._evict_expired(finished)
         active = [s for s, sl in enumerate(self.slots) if not sl.free]
+        if not active and self._queue and self._quarantined:
+            # every usable slot quarantined while requests wait: scrub
+            # the poisoned rows so the next step can admit
+            self.scrub()
         if active:
-            self._sync_chunk_bookkeep(active, self._dispatch_chunk())
+            t_chunk = time.perf_counter()
+            out = self._dispatch_chunk(check=self.poison_guard)
+            if self.poison_guard:
+                toks, ok_dev = out
+                # the streaming path blocks on toks right below anyway
+                self._evict_poisoned(active, np.asarray(ok_dev), finished)
+                active = [s for s in active if not self.slots[s].free]
+            else:
+                toks = out
+            self._sync_chunk_bookkeep(active, toks)
+            dt = time.perf_counter() - t_chunk
+            self._chunk_s = (0.8 * self._chunk_s + 0.2 * dt
+                             if self._chunk_s else dt)
             self._harvest(finished, resolve=True)
+            self._evict_expired(finished)
         if finished and obs.enabled():
             obs.inc("serving_requests_total", len(finished))
             obs.inc("serving_tokens_total",
                     sum(len(v) for v in finished.values()))
+        # tag evicted requests (their partial streams still compare equal
+        # to the same plain list); clean completions stay plain lists
+        for rid in list(finished):
+            status = self._status.pop(rid, None)
+            if status is not None:
+                finished[rid] = ServedTokens(finished[rid], status)
         return finished
 
     def drain(self) -> dict:

@@ -1,0 +1,40 @@
+"""Fault injection, failure containment, and recovery.
+
+- :mod:`.faults` — seeded deterministic :class:`FaultPlan` (dropout,
+  stragglers, corrupted updates, serving stalls, crash points) parsed
+  from a compact spec string;
+- :mod:`.guard` — jit-side non-finite screening of stacked client
+  updates and a host-side :class:`DivergenceGuard` for training loops;
+- :mod:`.retry` — bounded retry with exponential backoff + jitter and a
+  :class:`Deadline` helper;
+- :mod:`.autoresume` — checkpoint-every-round training wrapper that
+  resumes bit-exactly after a crash.
+
+See ``docs/RESILIENCE.md`` for the failure model and recipes.
+"""
+
+from .faults import FaultPlan, InjectedCrash
+from .guard import DivergenceGuard, screen_nonfinite, tree_client_isfinite
+from .retry import Deadline, RetryError, backoff_delays, retry_call
+
+__all__ = [
+    "FaultPlan",
+    "InjectedCrash",
+    "DivergenceGuard",
+    "screen_nonfinite",
+    "tree_client_isfinite",
+    "Deadline",
+    "RetryError",
+    "backoff_delays",
+    "retry_call",
+    "run_with_autoresume",
+]
+
+
+def __getattr__(name):
+    # autoresume pulls in utils.checkpoint (orbax) — keep that import out
+    # of the package's import path so fault/guard users never pay for it
+    if name == "run_with_autoresume":
+        from .autoresume import run_with_autoresume
+        return run_with_autoresume
+    raise AttributeError(name)

@@ -1,0 +1,103 @@
+"""Crash-tolerant training: checkpoint every round, resume bit-exactly.
+
+The server loop already derives round keys from the GLOBAL round index
+(``DecentralizedServer.run``), so a resumed run continues the exact
+key/accounting sequence of an uninterrupted one — all this wrapper adds
+is the persistence discipline around it:
+
+- every ``every`` rounds, save ``{"params", "round"}`` (plus the
+  server's :meth:`~..fl.servers.Server.extra_state`, e.g. FedOpt's
+  optimizer moments) through :class:`..utils.checkpoint.Checkpointer`
+  with ``wait=True`` — a *committed* checkpoint, so the set of rounds a
+  crash can lose is deterministic;
+- on entry, restore the latest committed step if one exists and continue
+  from the next round (``resilience_resumes_total`` counts it);
+- optionally thread every round through a
+  :class:`..resilience.guard.DivergenceGuard` (non-finite / exploded
+  params never get installed OR checkpointed);
+- optionally fire a :class:`..resilience.faults.FaultPlan` crash point
+  (``crash=N`` raises, ``kill=N`` hard-exits) at the START of round N's
+  post-round hook — i.e. *before* round N is saved — so the last
+  committed step after a crash at round N is exactly the newest multiple
+  of ``every`` below N.  Crash-recovery tests rely on that determinism.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .. import obs
+from ..utils.checkpoint import Checkpointer
+
+
+def run_with_autoresume(server, nr_rounds: int, directory: str | os.PathLike,
+                        *, every: int = 1, max_to_keep: int = 3,
+                        guard=None, fault_plan=None, on_round=None):
+    """Run ``server`` for global rounds ``0 .. nr_rounds-1``, checkpointing
+    to ``directory`` and resuming from the latest committed step if the
+    directory already holds one.  Returns the ``RunResult`` of the rounds
+    actually executed this call (``None`` if everything was already done).
+
+    ``server`` is any :class:`..fl.servers.Server` subclass — ``params``
+    is the full round-carried state by construction (FedBuff's stacked
+    history included), and ``extra_state()`` covers the rest (FedOpt
+    moments, SCAFFOLD variates)."""
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+    ckpt = Checkpointer(directory, max_to_keep=max_to_keep)
+    try:
+        start = 0
+        latest = ckpt.latest_step()
+        if latest is not None:
+            template = {"params": server.params, "round": 0}
+            extra = server.extra_state()
+            if extra:
+                template["extra"] = extra
+            state = ckpt.restore(template)
+            server.params = state["params"]
+            if extra:
+                server.restore_extra_state(state["extra"])
+            start = int(state["round"]) + 1
+            obs.inc("resilience_resumes_total")
+            obs.event("resilience.resume", step=latest, next_round=start)
+        if start >= nr_rounds:
+            return None
+
+        def _save(r: int) -> None:
+            state = {"params": server.params, "round": r}
+            extra = server.extra_state()
+            if extra:
+                state["extra"] = extra
+            # wait=True: only COMMITTED checkpoints exist, so what a crash
+            # loses is deterministic (the crash-recovery tests pin it)
+            ckpt.save(r, state, wait=True)
+            obs.inc("checkpoint_saves_total")
+
+        def _on_round(r: int, result) -> None:
+            # crash point fires BEFORE round r is persisted: a crash at
+            # round N leaves the newest multiple of `every` below N as
+            # the last committed step
+            if fault_plan is not None:
+                fault_plan.maybe_crash(r)
+            if (r + 1) % every == 0 or r == nr_rounds - 1:
+                _save(r)
+            if on_round is not None:
+                on_round(r, result)
+
+        if guard is not None:
+            raw_advance = server._advance
+
+            def _guarded(r: int) -> None:
+                old = server.params
+                raw_advance(r)
+                server.params, _ = guard.admit(r, old, server.params)
+
+            server._advance = _guarded
+        try:
+            return server.run(nr_rounds - start, start_round=start,
+                              on_round=_on_round)
+        finally:
+            if guard is not None:
+                server._advance = raw_advance
+    finally:
+        ckpt.close()

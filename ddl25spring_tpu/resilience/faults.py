@@ -1,0 +1,224 @@
+"""Seeded, deterministic fault injection from a compact spec string.
+
+The reference course never simulates failure at all (SURVEY.md §5); the
+byzantine benches inject *adversarial* updates but every round, request,
+and process still completes.  A :class:`FaultPlan` is the missing piece:
+one object that injects the *operational* failure modes — client dropout,
+straggler delay, corrupted (non-finite) updates, serving-request stalls,
+and host crash points — **reproducibly**, so every fault a test or bench
+observes can be replayed bit-for-bit.
+
+Spec grammar (comma-separated ``key=value`` tokens)::
+
+    drop=0.2              per-round client dropout probability
+    nan=0.05              per-client probability of an all-NaN update
+    inf=0.05              per-client probability of an all-Inf update
+    straggle=0.3:2.0      straggler probability : mean delay seconds
+                          (per-client delay ~ U[0, 2*mean])
+    serve_timeout=0.1     per-request probability a serving request stalls
+                          past its deadline
+    crash=5               raise InjectedCrash at training round 5
+    kill=5                hard-exit the process at round 5 (os._exit —
+                          simulates SIGKILL/OOM for crash-recovery tests)
+    seed=42               fault randomness seed (default 0)
+
+e.g. ``FaultPlan.parse("drop=0.2,nan=0.05,seed=7")``.
+
+Determinism contract: FL-round masks are derived inside the jitted round
+from ``fold_in(PRNGKey(seed), round_idx)`` — a pure function of
+``(seed, round)`` that works identically under a tracer (bench.py's
+fused ``fori_loop``) and eagerly (tests replicating a draw).  Host-side
+faults (serving, crash points) hash stable request/round identifiers
+with crc32, so they reproduce across processes (unlike ``hash()``,
+which is salted per interpreter).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import obs
+
+
+class InjectedCrash(RuntimeError):
+    """Raised by ``FaultPlan.maybe_crash`` at a ``crash=N`` point — an
+    exception-shaped process death (stack unwinds; ``kill=N`` is the
+    no-cleanup variant)."""
+
+
+_FLOAT_KEYS = ("drop", "nan", "inf", "serve_timeout")
+# domain-separation tags for the per-kind fault key streams (arbitrary
+# distinct constants; folded on top of the round key)
+_TAG_DROP, _TAG_NAN, _TAG_INF, _TAG_STRAGGLE = 0xD0, 0xA1, 0x1F, 0x57
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    seed: int = 0
+    drop: float = 0.0           # client dropout probability per round
+    nan: float = 0.0            # per-client all-NaN update probability
+    inf: float = 0.0            # per-client all-Inf update probability
+    straggle: float = 0.0       # straggler probability per client
+    straggle_s: float = 0.0     # mean injected delay (delay ~ U[0, 2*mean])
+    serve_timeout: float = 0.0  # serving-request stall probability
+    crash: int | None = None    # raise InjectedCrash at this round
+    kill: int | None = None     # os._exit at this round (SIGKILL-like)
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def parse(cls, spec: str | None) -> "FaultPlan | None":
+        """``None``/empty spec -> ``None`` (no plan; callers keep the
+        exact fault-free code path)."""
+        if not spec:
+            return None
+        kw: dict = {}
+        for token in spec.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            key, sep, value = token.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if not sep or not value:
+                raise ValueError(
+                    f"fault spec token {token!r} is not key=value "
+                    f"(full spec: {spec!r})"
+                )
+            try:
+                if key in _FLOAT_KEYS:
+                    kw[key] = float(value)
+                elif key == "straggle":
+                    prob, _, delay = value.partition(":")
+                    kw["straggle"] = float(prob)
+                    kw["straggle_s"] = float(delay) if delay else 1.0
+                elif key in ("crash", "kill", "seed"):
+                    kw[key] = int(value)
+                else:
+                    raise KeyError(key)
+            except KeyError:
+                raise ValueError(
+                    f"unknown fault kind {key!r} in spec {spec!r}; known: "
+                    f"{', '.join(_FLOAT_KEYS)}, straggle, crash, kill, seed"
+                ) from None
+            except ValueError as e:
+                raise ValueError(
+                    f"bad value for {key!r} in fault spec {spec!r}: {e}"
+                ) from None
+        plan = cls(**kw)
+        plan.validate()
+        return plan
+
+    def validate(self) -> None:
+        for key in _FLOAT_KEYS + ("straggle",):
+            v = getattr(self, key)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(
+                    f"{key}={v} outside [0, 1] — fault rates are "
+                    "probabilities"
+                )
+        if self.straggle_s < 0:
+            raise ValueError(f"straggle_s={self.straggle_s} must be >= 0")
+
+    def describe(self) -> str:
+        """Round-trippable compact spec of the non-default fields."""
+        parts = []
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v == f.default or f.name == "straggle_s":
+                continue
+            if f.name == "straggle":
+                parts.append(f"straggle={v}:{self.straggle_s}")
+            else:
+                parts.append(f"{f.name}={v}")
+        return ",".join(parts)
+
+    # -- what the plan can do --------------------------------------------
+
+    @property
+    def corrupts(self) -> bool:
+        return self.nan > 0 or self.inf > 0
+
+    @property
+    def drops(self) -> bool:
+        return self.drop > 0
+
+    @property
+    def straggles(self) -> bool:
+        return self.straggle > 0 and self.straggle_s > 0
+
+    @property
+    def affects_fl_round(self) -> bool:
+        return self.corrupts or self.drops or self.straggles
+
+    # -- FL-round masks (jit-traceable) ----------------------------------
+
+    def round_masks(self, round_idx, nr: int, deadline_s: float | None = None):
+        """Per-client fault draws for one round: ``(keep, nan_mask,
+        inf_mask, late)``, each a ``(nr,)`` bool array.
+
+        Pure function of ``(seed, round_idx)`` via fold_in, so it traces
+        under jit (``round_idx`` may be a tracer) AND replays eagerly —
+        the engine derives the masks inside the compiled round while
+        tests re-derive the identical masks host-side.  ``late`` marks
+        stragglers whose drawn delay exceeds ``deadline_s`` (all-False
+        without a deadline: a synchronous round just waits)."""
+        import jax
+        import jax.numpy as jnp
+
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed), round_idx
+        )
+
+        def draw(tag, prob):
+            if prob <= 0.0:
+                return jnp.zeros((nr,), bool)
+            u = jax.random.uniform(jax.random.fold_in(key, tag), (nr,))
+            return u < prob
+
+        keep = ~draw(_TAG_DROP, self.drop)
+        nan_mask = draw(_TAG_NAN, self.nan)
+        inf_mask = draw(_TAG_INF, self.inf)
+        late = jnp.zeros((nr,), bool)
+        if self.straggles and deadline_s is not None:
+            straggler = draw(_TAG_STRAGGLE, self.straggle)
+            delay = (2.0 * self.straggle_s) * jax.random.uniform(
+                jax.random.fold_in(key, _TAG_STRAGGLE + 1), (nr,)
+            )
+            late = straggler & (delay > deadline_s)
+        return keep, nan_mask, inf_mask, late
+
+    # -- host-side faults -------------------------------------------------
+
+    def serving_fault(self, rid) -> bool:
+        """Deterministic per-request stall draw (keyed on a stable crc32
+        of the request id, so it reproduces across processes)."""
+        if self.serve_timeout <= 0:
+            return False
+        h = zlib.crc32(repr(rid).encode()) ^ (self.seed * 0x9E3779B1)
+        u = (h & 0xFFFFFFFF) / 2.0 ** 32
+        hit = u < self.serve_timeout
+        if hit:
+            obs.inc("resilience_faults_injected_total", kind="serve_timeout")
+        return hit
+
+    def maybe_crash(self, step: int) -> None:
+        """Fire the configured crash point for ``step`` (no-op
+        otherwise).  ``crash``: raise :class:`InjectedCrash` (stack
+        unwinds, finally-blocks run).  ``kill``: ``os._exit(23)`` — the
+        SIGKILL/OOM simulation crash-recovery tests need, since nothing
+        (not even orbax's atomic-commit finalizers) runs after it."""
+        if self.kill is not None and step == self.kill:
+            obs.inc("resilience_faults_injected_total", kind="kill")
+            os._exit(23)
+        if self.crash is not None and step == self.crash:
+            obs.inc("resilience_faults_injected_total", kind="crash")
+            raise InjectedCrash(
+                f"injected crash at step {step} (fault plan "
+                f"{self.describe() or 'crash'!r})"
+            )

@@ -1,0 +1,141 @@
+"""Non-finite screening and divergence guarding.
+
+Two layers of defence against updates that would poison training:
+
+1. **jit-side screening** of stacked client updates
+   (:func:`tree_client_isfinite` / :func:`screen_nonfinite`): a
+   per-client ``isfinite`` reduction over every leaf — one bool per
+   client, static shapes — lets the engine zero-weight any client whose
+   update contains NaN/Inf and renormalise over the finite survivors
+   *inside* the compiled round.  A single NaN client otherwise destroys
+   the weighted mean (NaN * 0-weight is still NaN through a plain sum,
+   which is why exclusion must happen in the WEIGHTS, before the mean).
+
+2. **host-side divergence guard** (:class:`DivergenceGuard`): wraps a
+   training loop's step boundary and refuses to install parameters that
+   are non-finite (or whose update step exploded past
+   ``max_update_norm``), with three policies:
+
+   - ``skip``     drop the bad step, keep the previous params;
+   - ``clip``     scale the step's delta down to ``max_update_norm``
+                  (non-finite steps are skipped — there is nothing
+                  finite to scale);
+   - ``restore``  roll back to the last known-good snapshot (taken every
+                  ``snapshot_every`` healthy steps).
+
+Every intervention counts through ``obs``
+(``resilience_divergence_total{policy=...}``), so a run that silently
+skipped half its steps is visible in ``tools/obs_report.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import obs
+
+
+def tree_client_isfinite(stacked):
+    """Per-client all-finite flag over a stacked pytree: ``(N, ...)``
+    leaves -> ``(N,)`` bool.  Static shapes — usable inside jit."""
+    flags = None
+    for leaf in jax.tree.leaves(stacked):
+        f = jnp.isfinite(leaf).reshape(leaf.shape[0], -1).all(axis=1)
+        flags = f if flags is None else flags & f
+    if flags is None:
+        raise ValueError("tree_client_isfinite: empty pytree")
+    return flags
+
+
+def screen_nonfinite(stacked, weights):
+    """Zero the aggregation weight of every client whose stacked update
+    contains a non-finite value.  Returns ``(weights, finite_mask)``;
+    the caller renormalises (the engine does it in its one existing
+    normalisation step, so a fully-finite stack is bit-identical)."""
+    finite = tree_client_isfinite(stacked)
+    return jnp.where(finite, weights, 0.0), finite
+
+
+@jax.jit
+def _step_health(new_params, old_params):
+    """(all_finite, l2 norm of new - old) — ONE tiny jitted program per
+    params shape, shared by every DivergenceGuard instance."""
+    finite = jnp.array(True)
+    sq = jnp.float32(0.0)
+    for n, o in zip(jax.tree.leaves(new_params),
+                    jax.tree.leaves(old_params)):
+        finite &= jnp.isfinite(n).all()
+        d = (n - o).astype(jnp.float32)
+        sq += jnp.sum(d * d)
+    return finite, jnp.sqrt(sq)
+
+
+@jax.jit
+def _clip_delta(new_params, old_params, scale):
+    return jax.tree.map(
+        lambda n, o: o + (n - o) * scale.astype(n.dtype),
+        new_params, old_params,
+    )
+
+
+class DivergenceGuard:
+    """Training-loop guard: ``admit(step, old, new)`` returns the params
+    the loop should actually install.
+
+    The health check is a blocking device fetch of two scalars — cheap
+    next to a training step, but it IS a sync point; callers pipelining
+    dispatches should admit at checkpoint boundaries, not every step.
+    """
+
+    POLICIES = ("skip", "clip", "restore")
+
+    def __init__(self, policy: str = "skip",
+                 max_update_norm: float | None = None,
+                 snapshot_every: int = 10):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"policy={policy!r} not in {self.POLICIES}"
+            )
+        if policy == "clip" and not max_update_norm:
+            raise ValueError(
+                "policy='clip' needs max_update_norm > 0 (the bound to "
+                "scale exploded steps down to)"
+            )
+        if snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1, got {snapshot_every}"
+            )
+        self.policy = policy
+        self.max_update_norm = max_update_norm
+        self.snapshot_every = snapshot_every
+        self._snapshot = None  # last known-good params (restore policy)
+        self._good_steps = 0
+        self.events = 0  # interventions so far (tests/report)
+
+    def admit(self, step: int, old_params, new_params):
+        """-> (params_to_install, ok).  ``ok`` False means the guard
+        intervened (skipped/clipped/restored)."""
+        if self._snapshot is None:
+            self._snapshot = old_params
+        finite, norm = _step_health(new_params, old_params)
+        finite = bool(finite)
+        exploded = (self.max_update_norm is not None
+                    and float(norm) > self.max_update_norm)
+        if finite and not exploded:
+            self._good_steps += 1
+            if self._good_steps % self.snapshot_every == 0:
+                self._snapshot = new_params
+            return new_params, True
+
+        self.events += 1
+        obs.inc("resilience_divergence_total", policy=self.policy)
+        obs.event("resilience.divergence", step=step, policy=self.policy,
+                  finite=finite, update_norm=float(norm))
+        if self.policy == "clip" and finite:
+            scale = jnp.float32(self.max_update_norm / float(norm))
+            return _clip_delta(new_params, old_params, scale), False
+        if self.policy == "restore":
+            return self._snapshot, False
+        # skip (and clip-of-nonfinite: nothing finite to scale)
+        return old_params, False

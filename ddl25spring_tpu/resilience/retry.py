@@ -1,0 +1,128 @@
+"""Generic retry/backoff and deadline helpers (stdlib-only, no jax).
+
+The course reference has no retry story at all (SURVEY.md §5: the first
+transient error anywhere — a flaky mount during ingest, a dropped tunnel
+RPC — kills the run).  This module is the ONE place bounded-retry policy
+lives so every caller (tools/fetch_data.py ingest, future RPC paths)
+shares the same backoff math and telemetry:
+
+- exponential backoff with decorrelating jitter (capped doubling; the
+  jitter fraction spreads simultaneous retriers so they do not stampede);
+- an optional overall :class:`Deadline` that bounds the WHOLE attempt
+  sequence, not just the count;
+- a deterministic mode (``seed=``) so tests can pin the exact sleep
+  schedule.
+
+Every retry increments ``resilience_retries_total`` and the final
+failure raises :class:`RetryError` carrying the attempt count and the
+last underlying exception (``raise ... from last``), so the operator
+sees one clear error instead of the first transient one.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from .. import obs
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted (or the deadline expired); ``__cause__`` is
+    the last underlying exception."""
+
+    def __init__(self, msg: str, attempts: int):
+        super().__init__(msg)
+        self.attempts = attempts
+
+
+class Deadline:
+    """Wall-clock budget shared across a sequence of operations.
+
+    ``Deadline(None)`` never expires, so callers can thread an optional
+    deadline without branching.
+    """
+
+    def __init__(self, seconds: float | None,
+                 clock=time.monotonic):
+        self._clock = clock
+        self.seconds = seconds
+        self._t0 = clock()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def remaining(self) -> float:
+        if self.seconds is None:
+            return float("inf")
+        return self.seconds - (self._clock() - self._t0)
+
+    def clamp(self, delay: float) -> float:
+        """Cap a planned sleep so it never overshoots the deadline."""
+        return max(0.0, min(delay, self.remaining()))
+
+    def raise_if_expired(self, what: str = "operation") -> None:
+        if self.expired:
+            raise TimeoutError(
+                f"{what} exceeded its {self.seconds}s deadline"
+            )
+
+
+def backoff_delays(retries: int, base_delay_s: float, max_delay_s: float,
+                   jitter: float, rng: random.Random):
+    """The planned sleep before each RETRY (length ``retries``): capped
+    exponential ``base * 2**k`` scaled by a uniform jitter factor in
+    ``[1 - jitter, 1 + jitter]``.  Exposed for tests to pin the
+    schedule."""
+    for k in range(retries):
+        delay = min(max_delay_s, base_delay_s * (2.0 ** k))
+        yield delay * (1.0 + jitter * (2.0 * rng.random() - 1.0))
+
+
+def retry_call(fn, *args, retries: int = 4, base_delay_s: float = 0.5,
+               max_delay_s: float = 8.0, jitter: float = 0.5,
+               retry_on=(OSError,), deadline_s: float | None = None,
+               seed: int | None = None, on_retry=None, sleep=time.sleep,
+               label: str | None = None, **kwargs):
+    """Call ``fn(*args, **kwargs)``; on an exception in ``retry_on``,
+    retry up to ``retries`` more times with exponential backoff + jitter.
+
+    ``deadline_s`` bounds the whole sequence (sleeps are clamped to it and
+    a retry never starts past it).  ``seed`` makes the jitter — and thus
+    the full sleep schedule — deterministic.  ``on_retry(attempt, exc,
+    delay)`` observes each scheduled retry; ``sleep`` is injectable so
+    tests run instantly.  Exceptions outside ``retry_on`` propagate
+    immediately (a malformed input should fail loud, not burn retries).
+    """
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0, got {retries}")
+    name = label or getattr(fn, "__name__", "call")
+    deadline = Deadline(deadline_s)
+    rng = random.Random(seed)
+    delays = backoff_delays(retries, base_delay_s, max_delay_s, jitter, rng)
+    last: BaseException | None = None
+    for attempt in range(retries + 1):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:  # noqa: PERF203 — retry loop by design
+            last = e
+            if attempt == retries:
+                break
+            if deadline.expired:
+                raise RetryError(
+                    f"{name}: deadline ({deadline.seconds}s) expired after "
+                    f"{attempt + 1} attempt(s); last error: {e}",
+                    attempts=attempt + 1,
+                ) from e
+            delay = deadline.clamp(next(delays))
+            obs.inc("resilience_retries_total", op=name)
+            if on_retry is not None:
+                on_retry(attempt + 1, e, delay)
+            if delay > 0:
+                sleep(delay)
+    raise RetryError(
+        f"{name}: failed after {retries + 1} attempt(s); "
+        f"last error: {last}",
+        attempts=retries + 1,
+    ) from last

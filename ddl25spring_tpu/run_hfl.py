@@ -89,6 +89,16 @@ def build_aggregator(cfg: HflConfig):
 
 
 def build_server(cfg: HflConfig):
+    from .resilience.faults import FaultPlan
+
+    fault_plan = FaultPlan.parse(cfg.fault_spec)
+    round_deadline_s = cfg.round_deadline_s or None
+    if fault_plan is not None and cfg.algorithm in ("centralized", "scaffold"):
+        raise ValueError(
+            f"--fault-spec is not wired into {cfg.algorithm!r} "
+            "(centralized has no clients to fail; scaffold's "
+            "control-variate update assumes honest full participation)"
+        )
     if ((cfg.dp_clip or cfg.dp_noise_mult)
             and cfg.algorithm not in ("fedavg", "fedprox")):
         raise ValueError(
@@ -146,6 +156,7 @@ def build_server(cfg: HflConfig):
             cfg.nr_local_epochs, cfg.seed,
             staleness_window=cfg.staleness_window,
             staleness_exp=cfg.staleness_exp, server_eta=cfg.server_eta,
+            fault_plan=fault_plan, round_deadline_s=round_deadline_s,
         )
 
     if cfg.algorithm == "scaffold":
@@ -191,7 +202,8 @@ def build_server(cfg: HflConfig):
             if nr_devices > 1 and clients_per_round >= nr_devices else None)
     kw = dict(aggregator=build_aggregator(cfg), attack=attack,
               malicious_mask=malicious if attack is not None else None,
-              mesh=mesh)
+              mesh=mesh, fault_plan=fault_plan,
+              round_deadline_s=round_deadline_s)
     if cfg.algorithm == "fedsgd":
         return FedSgdGradientServer(task, cfg.lr, client_data,
                                     cfg.client_fraction, cfg.seed,

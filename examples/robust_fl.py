@@ -6,7 +6,15 @@ multi-krum, trimmed-mean, median, consensus} on FedSGD over MNIST,
 reporting final accuracy — robust aggregators should hold accuracy under
 attack where the plain mean collapses.
 
-Run:  python examples/robust_fl.py [--quick]
+Operational faults (resilience/faults.py) compose with the byzantine
+grid: ``--dropout 0.2`` drops clients per round, ``--straggler 0.3``
+marks stragglers late against ``--round-deadline`` seconds, and
+``--faults "nan=0.05,seed=7"`` passes a raw spec (raw spec wins on
+conflicting keys).  Robust aggregators should additionally survive the
+crossed regime — e.g. median under sign-flip AND 20% dropout.
+
+Run:  python examples/robust_fl.py [--quick] [--dropout P] [--straggler P]
+                                   [--faults SPEC]
 """
 
 from __future__ import annotations
@@ -25,7 +33,21 @@ from ddl25spring_tpu.run_hfl import build_server  # noqa: E402
 from ddl25spring_tpu.configs import HflConfig  # noqa: E402
 
 
-def main(quick=False, plot_dir=None):
+def compose_fault_spec(dropout=0.0, straggler=0.0, faults=""):
+    """Flag sugar -> one spec string (raw --faults last, so it wins on
+    duplicate keys; FaultPlan.parse keeps the last occurrence)."""
+    parts = []
+    if dropout:
+        parts.append(f"drop={dropout}")
+    if straggler:
+        parts.append(f"straggle={straggler}:1.0")
+    if faults:
+        parts.append(faults)
+    return ",".join(parts)
+
+
+def main(quick=False, plot_dir=None, dropout=0.0, straggler=0.0,
+         faults="", round_deadline=0.0):
     rounds = 3 if quick else 10
     nr_clients = 20 if quick else 50
     nr_malicious = 4 if quick else 10
@@ -33,6 +55,14 @@ def main(quick=False, plot_dir=None):
         ["none", "label-flip", "gaussian", "sign-flip", "alie"]
     aggs = ["mean", "krum", "median", "consensus"] if quick else \
         ["mean", "krum", "multi-krum", "trimmed-mean", "median", "consensus"]
+    fault_spec = compose_fault_spec(dropout, straggler, faults)
+    if straggler and not round_deadline:
+        # stragglers only become faults when measured against a deadline
+        round_deadline = 1.0
+    if fault_spec:
+        print(f"fault plan: {fault_spec}"
+              + (f" (round deadline {round_deadline}s)"
+                 if round_deadline else ""))
     print(f"{'attack':12s} {'aggregator':14s} final acc")
     for attack in attacks:
         curves = {}
@@ -43,6 +73,8 @@ def main(quick=False, plot_dir=None):
                 aggregator=agg, attack=attack,
                 nr_malicious=0 if attack == "none" else nr_malicious,
                 nr_rounds=rounds,
+                fault_spec=fault_spec,
+                round_deadline_s=round_deadline,
             )
             server = build_server(cfg)
             result = server.run(rounds)
@@ -62,5 +94,18 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--plot-dir", default=None)
+    ap.add_argument("--dropout", type=float, default=0.0,
+                    help="per-round client dropout probability")
+    ap.add_argument("--straggler", type=float, default=0.0,
+                    help="per-client straggler probability (late against "
+                         "--round-deadline)")
+    ap.add_argument("--faults", default="",
+                    help="raw fault spec, e.g. 'nan=0.05,seed=7' "
+                         "(resilience/faults.py grammar)")
+    ap.add_argument("--round-deadline", type=float, default=0.0,
+                    help="simulated round deadline seconds (defaults to "
+                         "1.0 when --straggler is set)")
     args = ap.parse_args()
-    main(args.quick, args.plot_dir)
+    main(args.quick, args.plot_dir, dropout=args.dropout,
+         straggler=args.straggler, faults=args.faults,
+         round_deadline=args.round_deadline)

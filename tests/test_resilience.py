@@ -1,0 +1,525 @@
+"""Resilience layer: deterministic faults, graceful degradation, recovery.
+
+Core oracles (docs/RESILIENCE.md):
+- ``fault_plan=None`` and zero-rate plans are BIT-IDENTICAL to the
+  fault-free program (engine, fedbuff, serving);
+- fault stats reported by the jitted round equal the eagerly re-derived
+  mask draws (the determinism contract: masks are a pure function of
+  (seed, round));
+- corrupted clients never leak non-finite values into installed params;
+- serving deadlines degrade to partial results with ``timed_out`` status,
+  never an exception; full queues reject with a retry hint;
+- a crashed training run (exception-shaped OR SIGKILL-shaped, in a
+  subprocess) resumes from the last committed checkpoint bit-exactly.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu import obs
+from ddl25spring_tpu.fl.engine import make_fl_round
+from ddl25spring_tpu.fl.fedbuff import init_history, make_fedbuff_round
+from ddl25spring_tpu.resilience import (
+    Deadline,
+    DivergenceGuard,
+    FaultPlan,
+    InjectedCrash,
+    RetryError,
+    backoff_delays,
+    retry_call,
+    screen_nonfinite,
+    tree_client_isfinite,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def tree_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def tree_finite(t):
+    return all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(t))
+
+
+# --- fault-spec grammar -----------------------------------------------------
+
+@pytest.mark.parametrize("spec", [
+    "drop=0.2",
+    "nan=0.05,seed=7",
+    "drop=0.2,nan=0.05,inf=0.01,straggle=0.3:2.0,seed=7",
+    "serve_timeout=0.1,crash=5",
+    "kill=3,seed=1",
+])
+def test_parse_describe_roundtrip(spec):
+    plan = FaultPlan.parse(spec)
+    assert FaultPlan.parse(plan.describe()) == plan
+
+
+def test_parse_empty_is_none():
+    assert FaultPlan.parse("") is None
+    assert FaultPlan.parse(None) is None
+
+
+@pytest.mark.parametrize("spec", [
+    "drop",                 # not key=value
+    "banana=0.5",           # unknown kind
+    "drop=1.5",             # probability outside [0, 1]
+    "drop=abc",             # not a float
+    "straggle=0.5:-1.0",    # negative delay
+])
+def test_parse_rejects_bad_specs(spec):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(spec)
+
+
+def test_duplicate_keys_last_wins():
+    assert FaultPlan.parse("drop=0.1,drop=0.4").drop == 0.4
+
+
+# --- degraded FL rounds (tiny synthetic task: jit-cheap) --------------------
+
+N, S, NR_SAMPLED = 8, 4, 4
+_rng = np.random.default_rng(0)
+X = _rng.normal(size=(N, S, 3)).astype(np.float32)
+Y = np.zeros((N, S), np.int32)
+COUNTS = np.full((N,), S, np.int64)
+
+
+def client_update(params, x_i, y_i, c_i, k_i):
+    return {"w": params["w"] + x_i.mean(axis=0)}
+
+
+P0 = {"w": jnp.zeros((3,), jnp.float32)}
+KEY = jax.random.PRNGKey(0)
+
+
+def round_with(plan, deadline=None, **kw):
+    return make_fl_round(client_update, X, Y, COUNTS, NR_SAMPLED,
+                         fault_plan=plan, round_deadline_s=deadline, **kw)
+
+
+@pytest.fixture(scope="module")
+def clean_params():
+    return round_with(None)(P0, KEY, 0)
+
+
+@pytest.mark.parametrize("spec", ["drop=0.0,nan=0.0", "drop=1e-12,seed=3"])
+def test_zero_fault_plan_bitidentical(spec, clean_params):
+    # rate-0 plans short-circuit to the fault-free program; an epsilon-rate
+    # plan runs the masked program with all-pass draws — both must be
+    # BIT-identical to no plan at all
+    p = round_with(FaultPlan.parse(spec))(P0, KEY, 0)
+    assert tree_equal(p, clean_params)
+
+
+@pytest.mark.parametrize("spec,deadline,stat_ix,mask_of", [
+    ("drop=0.6,seed=11", None, 0, "drop"),
+    ("straggle=1.0:5.0,seed=4", 0.001, 1, "late"),
+    ("nan=0.5,seed=2", None, 2, "corrupt"),
+    ("inf=0.5,seed=9", None, 2, "corrupt"),
+])
+def test_fault_stats_match_eager_masks(spec, deadline, stat_ix, mask_of):
+    # determinism contract: the stats the jitted round reports equal the
+    # host-side eager re-derivation of the same (seed, round) draw
+    plan = FaultPlan.parse(spec)
+    rf = round_with(plan, deadline)
+    for r in range(3):
+        params, stats = rf.raw(P0, KEY, r, *rf.data)
+        keep, nan_m, inf_m, late = plan.round_masks(r, NR_SAMPLED, deadline)
+        expected = {
+            "drop": int(np.sum(~np.asarray(keep))),
+            "late": int(np.sum(np.asarray(late))),
+            "corrupt": int(np.sum(np.asarray(nan_m) | np.asarray(inf_m))),
+        }[mask_of]
+        assert int(np.asarray(stats)[stat_ix]) == expected
+        assert tree_finite(params)
+
+
+def test_corrupted_clients_never_leak(clean_params):
+    rf = round_with(FaultPlan.parse("nan=0.5,inf=0.3,seed=2"))
+    p = P0
+    for r in range(5):
+        p = rf(p, KEY, r)
+        assert tree_finite(p), f"non-finite params after round {r}"
+
+
+def test_all_faulted_round_keeps_params():
+    p = round_with(FaultPlan.parse("drop=1.0"))(P0, KEY, 0)
+    assert tree_equal(p, P0)
+
+
+def test_straggle_without_deadline_is_clean(clean_params):
+    # a synchronous round just waits for stragglers: without a deadline the
+    # result is the fault-free one
+    plan = FaultPlan.parse("straggle=1.0:5.0,seed=4")
+    assert tree_equal(round_with(plan)(P0, KEY, 0), clean_params)
+
+
+def test_custom_aggregator_neutralises_faulted_rows():
+    def median_agg(updates, weights, key):
+        return jax.tree.map(lambda u: jnp.median(u, axis=0), updates)
+
+    rf = round_with(FaultPlan.parse("nan=0.5,seed=2"), aggregator=median_agg)
+    for r in range(3):
+        assert tree_finite(rf(P0, KEY, r))
+
+
+def test_fedbuff_zero_fault_bitidentical_and_corrupt_finite():
+    hist = init_history(P0, 2)
+    clean = make_fedbuff_round(client_update, X, Y, COUNTS, NR_SAMPLED,
+                               staleness_window=2)(hist, KEY, 0)
+    eps = make_fedbuff_round(client_update, X, Y, COUNTS, NR_SAMPLED,
+                             staleness_window=2,
+                             fault_plan=FaultPlan.parse("drop=1e-12,seed=3"))
+    assert tree_equal(eps(hist, KEY, 0), clean)
+    nan = make_fedbuff_round(client_update, X, Y, COUNTS, NR_SAMPLED,
+                             staleness_window=2,
+                             fault_plan=FaultPlan.parse("nan=0.5,seed=2"))
+    assert tree_finite(nan(hist, KEY, 0))
+
+
+def test_obs_report_shows_resilience_section(tmp_path, capsys):
+    # inject a NaN client with telemetry on, then render the JSONL through
+    # tools/obs_report.py: the counters must surface in the report
+    jsonl = tmp_path / "t.jsonl"
+    obs.enable(str(jsonl))
+    try:
+        rf = round_with(FaultPlan.parse("nan=0.5,seed=2"))
+        p = rf(P0, KEY, 0)
+        assert tree_finite(p)
+        obs.flush()
+    finally:
+        obs.disable()
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from obs_report import load_events, report
+
+        report(load_events(jsonl), top=8)
+    finally:
+        sys.path.remove(str(REPO / "tools"))
+    out = capsys.readouterr().out
+    assert "== resilience" in out
+    assert "corrupt" in out
+    assert "non-finite client updates excluded" in out
+
+
+# --- guard ------------------------------------------------------------------
+
+GOOD = {"w": jnp.array([0.1, 0.2, 0.3], jnp.float32)}
+BAD = {"w": jnp.array([np.nan, 1.0, 2.0], jnp.float32)}
+
+
+def test_screen_nonfinite_marks_bad_clients():
+    stacked = {"w": jnp.stack([GOOD["w"], BAD["w"], GOOD["w"]])}
+    ok = np.asarray(tree_client_isfinite(stacked))
+    assert ok.tolist() == [True, False, True]
+    w, kept = screen_nonfinite(stacked, jnp.ones((3,)))
+    assert np.asarray(kept).tolist() == [True, False, True]
+    assert np.asarray(w).tolist() == [1.0, 0.0, 1.0]
+
+
+def test_guard_skip_rejects_nonfinite():
+    g = DivergenceGuard(policy="skip")
+    p, ok = g.admit(0, P0, BAD)
+    assert not ok and tree_equal(p, P0)
+    p, ok = g.admit(1, P0, GOOD)
+    assert ok and tree_equal(p, GOOD)
+
+
+def test_guard_clip_bounds_update_norm():
+    g = DivergenceGuard(policy="clip", max_update_norm=0.1)
+    big = {"w": jnp.full((3,), 100.0, jnp.float32)}
+    p, ok = g.admit(0, P0, big)
+    assert not ok
+    assert abs(float(jnp.linalg.norm(p["w"])) - 0.1) < 1e-5
+
+
+def test_guard_restore_falls_back_to_snapshot():
+    g = DivergenceGuard(policy="restore", snapshot_every=1)
+    p, ok = g.admit(0, P0, GOOD)   # admitted + snapshotted
+    assert ok
+    p, ok = g.admit(1, GOOD, BAD)
+    assert not ok and tree_equal(p, GOOD)
+
+
+# --- retry ------------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return 42
+
+    assert retry_call(flaky, retries=5, base_delay_s=0.0, jitter=0.0) == 42
+    assert calls["n"] == 3
+
+
+def test_retry_exhausts_with_clear_error():
+    def always():
+        raise OSError("mount gone")
+
+    with pytest.raises(RetryError) as ei:
+        retry_call(always, retries=2, base_delay_s=0.0, jitter=0.0,
+                   label="read:test")
+    assert ei.value.attempts == 3  # initial call + 2 retries
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_retry_does_not_swallow_unlisted_exceptions():
+    with pytest.raises(KeyError):
+        retry_call(lambda: (_ for _ in ()).throw(KeyError("x")),
+                   retries=3, base_delay_s=0.0)
+
+
+def test_backoff_delays_exponential_and_capped():
+    import random
+
+    d = list(backoff_delays(6, 0.5, 4.0, 0.0, random.Random(0)))
+    assert d == [0.5, 1.0, 2.0, 4.0, 4.0, 4.0]
+    # seeded jitter is deterministic and stays within the jitter band
+    j1 = list(backoff_delays(4, 1.0, 8.0, 0.5, random.Random(7)))
+    j2 = list(backoff_delays(4, 1.0, 8.0, 0.5, random.Random(7)))
+    assert j1 == j2
+    for base, j in zip([1.0, 2.0, 4.0, 8.0], j1):
+        assert base * 0.5 <= j <= base * 1.5
+
+
+def test_deadline():
+    d = Deadline(60.0)
+    assert not d.expired
+    assert 0 < d.remaining() <= 60.0
+    assert Deadline(0.0).expired
+    assert not Deadline(None).expired  # optional deadline never expires
+
+
+# --- serving degradation ----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def llama_serving():
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                      nr_layers=2, ctx_size=48)
+    params = Llama(cfg).init(jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32),
+                             positions=jnp.arange(4))
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (3, 7, 4, 8, 5)]
+    return cfg, params, prompts
+
+
+def _batcher(cfg, params, **kw):
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+
+    return ContinuousBatcher(cfg, params, max_batch=2, prefill_width=8, **kw)
+
+
+def test_serving_clean_oracle_bitidentical(llama_serving):
+    from ddl25spring_tpu.models import ServedTokens
+
+    cfg, params, prompts = llama_serving
+    base = _batcher(cfg, params).run(prompts, 6)
+    # no resilience args -> the pre-existing code path, plain lists
+    assert all(type(r) is list for r in base)
+    guarded = _batcher(cfg, params, poison_guard=True).run(prompts, 6)
+    assert all(isinstance(r, ServedTokens) and r.status == "ok"
+               for r in guarded)
+    assert guarded == base
+    generous = _batcher(cfg, params).run(prompts, 6, deadline_s=60.0)
+    assert generous == base and all(r.status == "ok" for r in generous)
+
+
+def test_serving_deadline_partial_no_raise(llama_serving):
+    cfg, params, prompts = llama_serving
+    out = _batcher(cfg, params).run(prompts, 6, deadline_s=1e-9)
+    assert all(r.status == "timed_out" for r in out)
+    assert all(len(r) < 6 for r in out)
+
+
+def test_serving_fault_plan_stalls_deterministic(llama_serving):
+    cfg, params, prompts = llama_serving
+    plan = FaultPlan(seed=5, serve_timeout=0.5)
+    hits = [plan.serving_fault(i) for i in range(len(prompts))]
+    assert any(hits) and not all(hits)  # crc32 draw, stable across runs
+    base = _batcher(cfg, params).run(prompts, 6)
+    out = _batcher(cfg, params, fault_plan=plan).run(prompts, 6)
+    for i, r in enumerate(out):
+        if hits[i]:
+            assert r.status == "timed_out" and len(r) < 6
+        else:
+            assert r.status == "ok" and r == base[i]
+
+
+def test_serving_backpressure_rejects_then_recovers(llama_serving):
+    from ddl25spring_tpu.models import AdmissionRejected
+
+    cfg, params, prompts = llama_serving
+    base = _batcher(cfg, params).run(prompts, 6)
+    b = _batcher(cfg, params, max_queue=2)
+    b.submit("a", prompts[0], 6)
+    b.submit("b", prompts[1], 6)
+    with pytest.raises(AdmissionRejected) as ei:
+        b.submit("c", prompts[2], 6)
+    assert ei.value.retry_after_s > 0
+    b.step()  # frees queue lanes (admits into decode slots)
+    b.submit("c", prompts[2], 6)
+    res = b.drain()
+    assert set(res) == {"a", "b", "c"}
+    assert res["a"] == base[0] and res["c"] == base[2]
+
+
+def test_serving_poison_guard_quarantines(llama_serving):
+    import jax.tree_util as jtu
+
+    cfg, params, prompts = llama_serving
+
+    def poison(path, leaf):
+        return (leaf.at[0, 0].set(jnp.nan) if "lm_head" in jtu.keystr(path)
+                else leaf)
+
+    bad = jtu.tree_map_with_path(poison, params)
+    b = _batcher(cfg, bad, poison_guard=True)
+    out = b.run(prompts[:2], 6)
+    assert all(r.status == "poisoned" for r in out)
+
+
+# --- autoresume + crash recovery --------------------------------------------
+
+@pytest.fixture(scope="module")
+def fl_server_factory():
+    from ddl25spring_tpu.data import load_mnist, split_dataset
+    from ddl25spring_tpu.fl import FedSgdGradientServer, mnist_task
+
+    ds = load_mnist(n_train=512, n_test=128)
+    task = mnist_task(ds.test_x, ds.test_y)
+    clients = split_dataset(ds.train_x, ds.train_y, nr_clients=8, iid=True,
+                            seed=10)
+    return lambda: FedSgdGradientServer(task, lr=0.05, client_data=clients,
+                                        client_fraction=0.5, seed=10)
+
+
+def test_autoresume_crash_then_resume_bitexact(fl_server_factory, tmp_path):
+    from ddl25spring_tpu.resilience.autoresume import run_with_autoresume
+    from ddl25spring_tpu.utils.checkpoint import Checkpointer
+
+    base = fl_server_factory()
+    base.run(4)
+
+    d = tmp_path / "ckpt"
+    crashed = fl_server_factory()
+    with pytest.raises(InjectedCrash):
+        run_with_autoresume(crashed, 4, d, fault_plan=FaultPlan(crash=2))
+    # the crash fires BEFORE round 2 is saved: last committed step is 1
+    ck = Checkpointer(d)
+    assert ck.latest_step() == 1
+    ck.close()
+
+    resumed = fl_server_factory()
+    assert run_with_autoresume(resumed, 4, d) is not None
+    assert tree_equal(resumed.params, base.params)
+    # fully done -> a further call is a no-op that restores final params
+    again = fl_server_factory()
+    assert run_with_autoresume(again, 4, d) is None
+    assert tree_equal(again.params, base.params)
+
+
+_SUBPROC_PRELUDE = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+_f = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _f:
+    os.environ["XLA_FLAGS"] = (
+        _f + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+import sys
+sys.path.insert(0, {repo!r})
+"""
+
+
+def test_autoresume_subprocess_kill_resumes_bitexact(fl_server_factory,
+                                                     tmp_path):
+    # SIGKILL-shaped crash: kill=2 hard-exits (os._exit(23)) before round 2
+    # is committed; the parent then resumes bit-exactly.  The child
+    # replicates conftest's jax config so params match bit-for-bit.
+    from ddl25spring_tpu.resilience.autoresume import run_with_autoresume
+    from ddl25spring_tpu.utils.checkpoint import Checkpointer
+
+    script = _SUBPROC_PRELUDE.format(repo=str(REPO)) + textwrap.dedent("""
+    from ddl25spring_tpu.data import load_mnist, split_dataset
+    from ddl25spring_tpu.fl import FedSgdGradientServer, mnist_task
+    from ddl25spring_tpu.resilience import FaultPlan
+    from ddl25spring_tpu.resilience.autoresume import run_with_autoresume
+    ds = load_mnist(n_train=512, n_test=128)
+    task = mnist_task(ds.test_x, ds.test_y)
+    clients = split_dataset(ds.train_x, ds.train_y, nr_clients=8, iid=True,
+                            seed=10)
+    server = FedSgdGradientServer(task, lr=0.05, client_data=clients,
+                                  client_fraction=0.5, seed=10)
+    run_with_autoresume(server, 4, sys.argv[1],
+                        fault_plan=FaultPlan(kill=2))
+    raise SystemExit("unreachable: kill=2 must have fired")
+    """)
+    d = tmp_path / "ckpt"
+    proc = subprocess.run([sys.executable, "-c", script, str(d)],
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 23, proc.stderr[-2000:]
+
+    ck = Checkpointer(d)
+    assert ck.latest_step() == 1
+    ck.close()
+
+    base = fl_server_factory()
+    base.run(4)
+    resumed = fl_server_factory()
+    run_with_autoresume(resumed, 4, d)
+    assert tree_equal(resumed.params, base.params)
+
+
+def test_checkpointer_kill_during_async_save(tmp_path):
+    # kill the process while an async (wait=False) save may be in flight:
+    # orbax's atomic commit means the directory holds EITHER the committed
+    # earlier step or the fully-committed newer one — never a torn state.
+    from ddl25spring_tpu.utils.checkpoint import Checkpointer
+
+    script = _SUBPROC_PRELUDE.format(repo=str(REPO)) + textwrap.dedent("""
+    import numpy as np
+    from ddl25spring_tpu.utils.checkpoint import Checkpointer
+    ck = Checkpointer(sys.argv[1], max_to_keep=5)
+    def state(r):
+        return {"params": np.full((1 << 22,), float(r), np.float32),
+                "round": r}
+    ck.save(0, state(0), wait=True)   # committed baseline
+    ck.save(1, state(1), wait=False)  # async write races the kill below
+    os._exit(9)                       # SIGKILL/OOM: no finalizers run
+    """)
+    d = tmp_path / "ckpt"
+    proc = subprocess.run([sys.executable, "-c", script, str(d)],
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 9, proc.stderr[-2000:]
+
+    ck = Checkpointer(d)
+    latest = ck.latest_step()
+    # whichever step won the race, it must restore as a CONSISTENT pair
+    assert latest in (0, 1)
+    template = {"params": np.zeros((1 << 22,), np.float32), "round": 0}
+    state = ck.restore(template)
+    ck.close()
+    assert int(state["round"]) == latest
+    assert np.all(np.asarray(state["params"]) == float(latest))

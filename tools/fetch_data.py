@@ -41,6 +41,7 @@ from ddl25spring_tpu.data.mnist import (  # noqa: E402
     _read_idx_images,
     _read_idx_labels,
 )
+from ddl25spring_tpu.resilience.retry import RetryError, retry_call  # noqa: E402
 
 MNIST_STEMS = {
     "train_x": "train-images-idx3-ubyte",
@@ -190,7 +191,15 @@ def main() -> int:
             return
         for src in [target] + sources:
             try:
-                found = finder(src)
+                # data often arrives over network mounts (NFS/FUSE), where
+                # reads fail transiently — bounded retries with backoff +
+                # jitter (resilience/retry.py) instead of one brittle shot
+                found = retry_call(finder, src, retries=3, base_delay_s=0.2,
+                                   max_delay_s=2.0, label=f"read:{name}")
+            except RetryError as e:
+                print(f"[fetch_data] {name}: {src} unreadable after "
+                      f"{e.attempts} attempts: {e.__cause__}")
+                continue
             except Exception as e:  # malformed candidate: keep scanning
                 print(f"[fetch_data] {name}: skipping {src}: {e}")
                 continue
@@ -201,7 +210,14 @@ def main() -> int:
             except ValueError as e:
                 print(f"[fetch_data] {e}")
                 continue
-            write(out, found)
+            try:
+                retry_call(write, out, found, retries=3, base_delay_s=0.2,
+                           max_delay_s=2.0, label=f"write:{name}")
+            except RetryError as e:
+                print(f"[fetch_data] {name}: writing {out} failed after "
+                      f"{e.attempts} attempts: {e.__cause__}")
+                landed[name] = None
+                return
             landed[name] = f"ingested from {src} -> {out}"
             return
         landed[name] = None

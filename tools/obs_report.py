@@ -292,6 +292,53 @@ def report(events: list[dict], top: int) -> None:
                   f"{labels.get('op', '?'):<16} "
                   f"{state['value']:>10} {fmt_bytes(nb):>12}")
 
+    # -- resilience ------------------------------------------------------
+    injected = take(counters, "resilience_faults_injected_total")
+    excluded = _value(counters, "resilience_nonfinite_excluded_total")
+    take(counters, "resilience_nonfinite_excluded_total")
+    degraded = _value(counters, "resilience_degraded_rounds_total")
+    take(counters, "resilience_degraded_rounds_total")
+    diverged = take(counters, "resilience_divergence_total")
+    retries = take(counters, "resilience_retries_total")
+    resumes = _value(counters, "resilience_resumes_total")
+    take(counters, "resilience_resumes_total")
+    saves = _value(counters, "checkpoint_saves_total")
+    take(counters, "checkpoint_saves_total")
+    serv_res = {}
+    for n in ("serving_timed_out_total", "serving_rejected_total",
+              "serving_poisoned_total", "serving_slots_scrubbed_total"):
+        v = _value(counters, n)
+        take(counters, n)
+        if v is not None:
+            serv_res[n.removeprefix("serving_").removesuffix("_total")] = v
+    if (injected or diverged or retries or serv_res
+            or excluded is not None or degraded is not None
+            or resumes is not None or saves is not None):
+        section("resilience")
+        if injected:
+            kinds_s = ", ".join(
+                f"{lb.get('kind', '?')} x{st['value']}"
+                for lb, st in sorted(injected,
+                                     key=lambda ls: -ls[1]["value"]))
+            print(f"  faults injected: {kinds_s}")
+        if excluded is not None or degraded is not None:
+            print(f"  non-finite client updates excluded: {excluded or 0}"
+                  f"   degraded rounds (any fault seen): {degraded or 0}")
+        if diverged:
+            pol = ", ".join(f"{lb.get('policy', '?')} x{st['value']}"
+                            for lb, st in diverged)
+            print(f"  divergence-guard interventions: {pol}")
+        if retries:
+            ops = ", ".join(f"{lb.get('op', '?')} x{st['value']}"
+                            for lb, st in retries)
+            print(f"  retried operations: {ops}")
+        if resumes is not None or saves is not None:
+            print(f"  checkpoint saves: {saves or 0}   resumes from "
+                  f"checkpoint: {resumes or 0}")
+        if serv_res:
+            print("  serving: " + "   ".join(
+                f"{k.replace('_', ' ')}: {v}" for k, v in serv_res.items()))
+
     # -- bench results ---------------------------------------------------
     results = [e for e in events if e.get("event") == "bench.result"]
     if results:
