@@ -265,14 +265,21 @@ def timed_rounds(server, nr_rounds: int, fused: bool = True,
 
     rf = server.round_fn
     if fused and hasattr(rf, "raw"):
-        compiled, params = _aot_fused_rounds(server, nr_rounds)
+        with obs.span("bench.compile", rounds=nr_rounds):
+            compiled, params = _aot_fused_rounds(server, nr_rounds)
+        # the fused program is in hand anyway — publish its cost analysis
+        # as per-phase MFU gauges (XLA counts the fori body ONCE, so the
+        # flops are ~one round: exactly the per-round numerator)
+        from ddl25spring_tpu.utils.costs import record_cost_gauges
+        record_cost_gauges(compiled, phase="fl.round")
         _stamp("compile done; timing ...")
         rates, first_params = [], None
         for t in range(trials):
-            t0 = time.perf_counter()
-            params = compiled(params, server.run_key, *rf.data)
-            _sync(params)
-            rates.append(nr_rounds / (time.perf_counter() - t0))
+            with obs.span("bench.trial", trial=t, rounds=nr_rounds):
+                t0 = time.perf_counter()
+                params = compiled(params, server.run_key, *rf.data)
+                _sync(params)
+                rates.append(nr_rounds / (time.perf_counter() - t0))
             _stamp(f"trial {t + 1}/{trials}: {rates[-1]:.4f} rounds/sec")
             if first_params is None:
                 first_params = params
@@ -285,11 +292,12 @@ def timed_rounds(server, nr_rounds: int, fused: bool = True,
     _stamp("warmup done; timing ...")
     rates, first_params = [], None
     for t in range(trials):
-        t0 = time.perf_counter()
-        for r in range(1, nr_rounds + 1):
-            params = server.round_fn(params, server.run_key, r)
-        _sync(params)
-        rates.append(nr_rounds / (time.perf_counter() - t0))
+        with obs.span("bench.trial", trial=t, rounds=nr_rounds):
+            t0 = time.perf_counter()
+            for r in range(1, nr_rounds + 1):
+                params = server.round_fn(params, server.run_key, r)
+            _sync(params)
+            rates.append(nr_rounds / (time.perf_counter() - t0))
         _stamp(f"trial {t + 1}/{trials}: {rates[-1]:.4f} rounds/sec")
         if first_params is None:
             first_params = params
@@ -539,12 +547,17 @@ def main():
         return
 
     if args.telemetry:
-        # enabled AFTER select_platform (enable() pulls jax via the JSONL
-        # sink); MetricsLogger flushes per line, so probe events survive
-        # even the os._exit failure path below
+        # per-line JSONL flushes, so probe events survive even the
+        # os._exit failure path below; --profile also mirrors spans into
+        # the XProf trace (TraceAnnotation / StepTraceAnnotation)
         os.makedirs(os.path.dirname(args.telemetry) or ".", exist_ok=True)
-        obs.enable(args.telemetry)
-        _stamp(f"telemetry -> {args.telemetry}")
+        obs.enable(args.telemetry,
+                   device_annotations=args.profile is not None)
+        obs.trace.ensure()  # adopt DDL25_TRACEPARENT or start a new trace
+        from ddl25spring_tpu.obs import watchdog as obs_watchdog
+        obs_watchdog.install()
+        _stamp(f"telemetry -> {args.telemetry} "
+               f"(trace {obs.trace.trace_id()})")
 
     _stamp("probing device ...")
     if not _probe_device_with_retry():

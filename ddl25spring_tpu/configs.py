@@ -79,6 +79,10 @@ class HflConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # rounds; 0 = off
     metrics_path: str | None = None
+    # telemetry JSONL path (ddl25spring_tpu.obs): round spans with trace
+    # ids, compile/memory watchdogs, final telemetry_summary; render with
+    # tools/obs_report.py, export with tools/trace_export.py.  None = off
+    telemetry: str | None = None
     plot_dir: str | None = None  # write the accuracy-vs-round figure here
 
     def __post_init__(self):

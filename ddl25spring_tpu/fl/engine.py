@@ -623,10 +623,13 @@ def make_fl_round(
             out = _round(params, base_key, round_idx, x, y, counts,
                          mal_mask)
             return out[0] if fault_plan is not None else out
-        with obs.span("fl.round") as sp:
-            out = sp.fence(
-                _round(params, base_key, round_idx, x, y, counts, mal_mask)
-            )
+        step = int(round_idx)
+        with obs.span("fl.round", round=step) as sp:
+            with obs.step_annotation("fl.round", step):
+                out = sp.fence(
+                    _round(params, base_key, round_idx, x, y, counts,
+                           mal_mask)
+                )
         if fault_plan is not None:
             new_params, stats = out
             _obs_round_faults(stats)

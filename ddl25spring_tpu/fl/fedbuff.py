@@ -164,10 +164,12 @@ def make_fedbuff_round(
         if not obs.enabled() or isinstance(tick_idx, jax.core.Tracer):
             out = _tick(history, base_key, tick_idx, x, y, counts)
             return out[0] if fault_plan is not None else out
-        with obs.span("fl.tick", staleness_window=W) as sp:
-            out = sp.fence(
-                _tick(history, base_key, tick_idx, x, y, counts)
-            )
+        step = int(tick_idx)
+        with obs.span("fl.tick", tick=step, staleness_window=W) as sp:
+            with obs.step_annotation("fl.tick", step):
+                out = sp.fence(
+                    _tick(history, base_key, tick_idx, x, y, counts)
+                )
         if fault_plan is not None:
             new_history, f_stats = out
             _obs_round_faults(f_stats)

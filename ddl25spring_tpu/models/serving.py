@@ -774,16 +774,19 @@ class ContinuousBatcher:
         # dispatch-boundary span, unfenced: budget mode streams chunks
         # back-to-back and a block here would serialise the pipeline
         with obs.span("serving.decode", chunk=K):
-            if check:
-                self.cache, toks, self.pos, self.tokens, ok = self._decode(
-                    self.params, self.cache, self.tokens, self.pos,
-                    self.pad, nr=K, check=True,
-                )
-            else:
-                self.cache, toks, self.pos, self.tokens = self._decode(
-                    self.params, self.cache, self.tokens, self.pos,
-                    self.pad, nr=K,
-                )
+            with obs.step_annotation("serving.decode",
+                                     self.stats["decode_steps"] // K):
+                if check:
+                    (self.cache, toks, self.pos, self.tokens,
+                     ok) = self._decode(
+                        self.params, self.cache, self.tokens, self.pos,
+                        self.pad, nr=K, check=True,
+                    )
+                else:
+                    self.cache, toks, self.pos, self.tokens = self._decode(
+                        self.params, self.cache, self.tokens, self.pos,
+                        self.pad, nr=K,
+                    )
         self.stats["decode_steps"] += K
         self.stats["slot_steps"] += self.max_batch * K
         return (toks, ok) if check else toks

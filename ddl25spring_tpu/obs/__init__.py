@@ -12,10 +12,15 @@ Typical use::
 
     from ddl25spring_tpu import obs
 
-    obs.enable("results/telemetry.jsonl")       # JSONL sink via MetricsLogger
+    obs.enable("results/telemetry.jsonl")       # append-only JSONL sink
     ...                                          # instrumented code runs
     obs.flush()                                  # one telemetry_summary event
     print(obs.render_prom())                     # Prometheus text exposition
+
+Every span carries a deterministic ``trace_id``/``span_id``/``parent_id``
+(:mod:`ddl25spring_tpu.obs.trace`) that joins across processes via the
+``DDL25_TRACEPARENT`` env var; ``obs/export.py`` merges span JSONL files
+into one Chrome-trace/Perfetto timeline.
 
 Library code instruments unconditionally::
 
@@ -28,32 +33,67 @@ See ``docs/OBSERVABILITY.md`` for the event schema and
 
 from __future__ import annotations
 
+import json
+import sys
+import time
+from pathlib import Path
+
+from . import trace
 from .core import (DEFAULT_BUCKETS, NULL_SPAN, Counter, Gauge, Histogram,
                    Telemetry)
 
 __all__ = [
     "Telemetry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
+    "trace",
     "enable", "disable", "enabled", "get",
     "span", "inc", "observe", "set_gauge", "event", "flush", "render_prom",
+    "step_annotation",
 ]
 
 _T: Telemetry | None = None
 
 
-def enable(jsonl_path=None, *, sink=None, echo: bool = False) -> Telemetry:
+class _JsonlSink:
+    """Append-only JSONL sink with the ``MetricsLogger`` line format
+    (``ts`` + ``event`` + fields, flushed per line) but zero imports
+    outside the stdlib — so ``obs.enable(path)`` works in processes that
+    never load jax (trace-export self-checks, spawned eval children)."""
+
+    def __init__(self, path, echo: bool = False):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._echo = echo
+        self._fh = self.path.open("a")
+
+    def log(self, event: str, **fields):
+        rec = {"ts": round(time.time(), 3), "event": event, **fields}
+        line = json.dumps(rec)
+        self._fh.write(line + "\n")
+        self._fh.flush()
+        if self._echo:
+            print(line)
+
+    def close(self):
+        self._fh.close()
+
+
+def enable(jsonl_path=None, *, sink=None, echo: bool = False,
+           device_annotations: bool = False) -> Telemetry:
     """Turn telemetry on process-wide and return the registry.
 
-    ``jsonl_path`` opens a ``MetricsLogger`` JSONL sink there (this is the
-    one place obs touches ``utils.logging``, lazily — that import pulls
-    jax, which any process calling ``enable`` has anyway); ``sink`` passes
-    an explicit ``log(event, **fields)`` object instead; neither means
-    instruments aggregate in-process only (no event stream).  Calling
-    ``enable`` again replaces the registry (fresh instruments)."""
+    ``jsonl_path`` opens an append-only JSONL sink there (same line format
+    as ``utils.logging.MetricsLogger``, but stdlib-only so enabling never
+    imports jax); ``sink`` passes an explicit ``log(event, **fields)``
+    object instead; neither means instruments aggregate in-process only
+    (no event stream).  ``device_annotations=True`` mirrors every span as
+    a ``jax.profiler.TraceAnnotation`` (and arms :func:`step_annotation`)
+    when jax is already loaded, so XProf traces carry the same span names
+    as the JSONL.  Calling ``enable`` again replaces the registry (fresh
+    instruments)."""
     global _T
     if sink is None and jsonl_path is not None:
-        from ..utils.logging import MetricsLogger
-        sink = MetricsLogger(jsonl_path, echo=echo)
-    _T = Telemetry(sink=sink)
+        sink = _JsonlSink(jsonl_path, echo=echo)
+    _T = Telemetry(sink=sink, device_annotations=device_annotations)
     return _T
 
 
@@ -114,3 +154,18 @@ def flush():
 def render_prom() -> str:
     t = _T
     return "" if t is None else t.render_prom()
+
+
+def step_annotation(name: str, step: int):
+    """``jax.profiler.StepTraceAnnotation`` context for an FL round or a
+    serving decode chunk — XProf then segments device activity by step.
+    A shared no-op unless telemetry is enabled with
+    ``device_annotations=True`` AND jax is already loaded (never imported
+    from here)."""
+    t = _T
+    if t is None or not t.device_annotations:
+        return NULL_SPAN
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return NULL_SPAN
+    return jax.profiler.StepTraceAnnotation(name, step_num=int(step))

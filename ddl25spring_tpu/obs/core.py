@@ -23,6 +23,26 @@ import sys
 import threading
 import time
 
+from . import trace as _trace
+
+# Hooks invoked at every span exit (watchdogs sampling device memory etc.):
+# ``fn(telemetry, record)`` — guarded by a truthiness check so the empty
+# default costs one bytecode on the hot path.  Exceptions are swallowed;
+# telemetry never takes down the instrumented program.
+_SPAN_EXIT_HOOKS: list = []
+
+
+def add_span_exit_hook(fn):
+    _SPAN_EXIT_HOOKS.append(fn)
+
+
+def remove_span_exit_hook(fn):
+    try:
+        _SPAN_EXIT_HOOKS.remove(fn)
+    except ValueError:
+        pass
+
+
 # Fixed log-spaced latency buckets: four per decade over [1 µs, 1000 s] —
 # wide enough for a single decode dispatch and a whole FL round alike, and
 # FIXED so histograms from different runs/processes are always mergeable.
@@ -177,44 +197,71 @@ class _SpanCtx:
     ``@contextmanager`` — it is entered on hot-ish host paths and a plain
     class is both cheaper and re-entrant-safe)."""
 
-    __slots__ = ("_t", "_name", "_handle", "_t0")
+    __slots__ = ("_t", "_name", "_handle", "_t0", "_ids", "_ann")
 
     def __init__(self, telemetry, name, fields):
         self._t = telemetry
         self._name = name
         self._handle = _Span(fields)
+        self._ann = None
 
     def __enter__(self):
-        stack = self._t._stack()
-        stack.append(self._name)
+        self._ids = _trace.begin_span(self._name)
+        if self._t.device_annotations:
+            # mirror the span into the device profile (XProf host track)
+            # when jax is already in the process — never import it here
+            jax = sys.modules.get("jax")
+            if jax is not None:
+                self._ann = jax.profiler.TraceAnnotation(self._name)
+                self._ann.__enter__()
         self._t0 = time.perf_counter()
         return self._handle
 
     def __exit__(self, exc_type, exc, tb):
         wall = time.perf_counter() - self._t0
         t = self._t
-        stack = t._stack()
-        stack.pop()
         h = self._handle
+        trace_id, span_id, parent_id, parent_name = self._ids
         rec = dict(h.fields)
         rec["name"] = self._name
         rec["seconds"] = round(wall, 6)
-        rec["depth"] = len(stack)
-        if stack:
-            rec["parent"] = stack[-1]
-        dur = wall
+        device = None
         if h._fence is not None:
             # lazy fence: only meaningful (and only possible) when jax is
             # already in the process — never import it from here
             jax = sys.modules.get("jax")
             if jax is not None:
                 jax.block_until_ready(h._fence)
-                dur = time.perf_counter() - self._t0
-                rec["device_seconds"] = round(dur, 6)
+                device = time.perf_counter() - self._t0
+                rec["device_seconds"] = round(device, 6)
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        rec["depth"] = _trace.end_span()
+        if parent_name is not None:
+            rec["parent"] = parent_name
+        rec["trace_id"] = trace_id
+        rec["span_id"] = span_id
+        if parent_id is not None:
+            rec["parent_id"] = parent_id
+        rec["process"] = _trace.process_index()
+        rec["start_ts"] = round(_trace.EPOCH0 + self._t0, 6)
+        thread = threading.current_thread().name
+        if thread != "MainThread":
+            rec["thread"] = thread
         if exc_type is not None:
             rec["ok"] = False
             rec["error"] = exc_type.__name__
-        t.histogram("span_seconds", span=self._name).observe(dur)
+        # wall time ALWAYS lands in span_seconds; fenced device time gets
+        # its own histogram (mixing the two made quantiles meaningless)
+        t.histogram("span_seconds", span=self._name).observe(wall)
+        if device is not None:
+            t.histogram("span_device_seconds", span=self._name).observe(device)
+        if _SPAN_EXIT_HOOKS:
+            for fn in list(_SPAN_EXIT_HOOKS):
+                try:
+                    fn(t, rec)
+                except Exception:
+                    pass
         t.event("span", **rec)
         return False
 
@@ -230,11 +277,11 @@ class Telemetry:
     single bytecode-level mutations left unlocked (telemetry tolerates the
     theoretical lost-update far better than a lock on every event)."""
 
-    def __init__(self, sink=None):
+    def __init__(self, sink=None, device_annotations: bool = False):
         self.sink = sink
+        self.device_annotations = device_annotations
         self._metrics: dict = {}
         self._lock = threading.Lock()
-        self._tls = threading.local()
 
     # -- instruments -----------------------------------------------------
 
@@ -268,18 +315,13 @@ class Telemetry:
         if self.sink is not None:
             self.sink.log(event, **fields)
 
-    def _stack(self):
-        s = getattr(self._tls, "stack", None)
-        if s is None:
-            s = self._tls.stack = []
-        return s
-
     def span(self, name: str, **fields) -> _SpanCtx:
-        """Context manager timing the enclosed block: wall time always;
-        device time too when the caller fences a device value
-        (``sp.fence(out)``).  Span durations also feed the
-        ``span_seconds{span=name}`` histogram, and each exit streams one
-        ``span`` event (name, seconds, nesting depth, parent)."""
+        """Context manager timing the enclosed block: wall time always
+        (``span_seconds{span=name}`` histogram); device time too when the
+        caller fences a device value (``sp.fence(out)``,
+        ``span_device_seconds``).  Each exit streams one ``span`` event
+        carrying name, seconds, nesting depth/parent and the trace ids
+        from :mod:`ddl25spring_tpu.obs.trace`."""
         return _SpanCtx(self, name, fields)
 
     # -- export ----------------------------------------------------------

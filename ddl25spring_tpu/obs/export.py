@@ -1,0 +1,225 @@
+"""Chrome-trace / Perfetto export of span JSONL files.
+
+Stdlib-only (covered by the jax-import-free guard).  Takes one or many
+telemetry JSONL files — FL server, spawned client/eval subprocesses,
+multihost ranks — and merges their ``span`` events into a single
+Chrome-trace JSON (the ``{"traceEvents": [...]}`` dialect that both
+``chrome://tracing`` and https://ui.perfetto.dev load):
+
+* one *process track* (pid) per distinct ``(file, process_index)`` pair,
+  named after the rank and source file, so multi-rank merges keep events
+  on distinct tracks even when every rank reports ``process == 0``;
+* one *thread track* (tid) per recording thread within a file;
+* ``X`` complete events (start + duration in µs) — duration is the fenced
+  ``device_seconds`` when present (it encloses the dispatch wall time),
+  else wall ``seconds``;
+* ``s``/``f`` flow events stitching cross-process parent links
+  (``parent_id`` recorded in another file) so the UI draws the arrow from
+  the server's round span into the child's root span.
+
+Span start comes from the ``start_ts`` field (perf_counter anchored to
+the wall clock once per process — see ``obs/trace.py``), falling back to
+``ts - seconds`` for pre-tracing JSONL.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+__all__ = ["load_span_events", "chrome_trace", "write_chrome_trace",
+           "validate"]
+
+
+def load_span_events(paths) -> list[dict]:
+    """``span`` events from one or many JSONL files, each tagged with the
+    0-based ``_file`` index and ``_src`` stem of its origin."""
+    events = []
+    for i, path in enumerate(paths):
+        p = Path(path)
+        with p.open() as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") != "span":
+                    continue
+                rec["_file"] = i
+                rec["_src"] = p.stem
+                events.append(rec)
+    return events
+
+
+def _start_of(e) -> float | None:
+    if "start_ts" in e:
+        return float(e["start_ts"])
+    if "ts" in e and "seconds" in e:
+        return float(e["ts"]) - float(e["seconds"])
+    return None
+
+
+def _duration_of(e) -> float:
+    return float(e.get("device_seconds", e.get("seconds", 0.0)))
+
+
+_ID_KEYS = ("trace_id", "span_id", "parent_id", "parent", "process")
+_SKIP_KEYS = set(_ID_KEYS) | {
+    "name", "seconds", "device_seconds", "depth", "start_ts", "ts",
+    "event", "_file", "_src",
+}
+
+
+def chrome_trace(events_or_paths) -> dict:
+    """Merge span events (or JSONL paths) into a Chrome-trace dict."""
+    if events_or_paths and not isinstance(events_or_paths[0], dict):
+        events = load_span_events(events_or_paths)
+    else:
+        events = list(events_or_paths)
+
+    starts = [s for e in events if (s := _start_of(e)) is not None]
+    t0 = min(starts) if starts else 0.0
+
+    pids: dict = {}      # (file, process) -> pid
+    tids: dict = {}      # (pid, thread-name) -> tid
+    trace_events = []
+    span_pid = {}        # span_id -> (pid, start_us, end_us) for flows
+
+    def _pid(e) -> int:
+        key = (e.get("_file", 0), e.get("process", 0))
+        if key not in pids:
+            pid = len(pids)
+            pids[key] = pid
+            label = f"rank{key[1]}"
+            if e.get("_src"):
+                label += f" · {e['_src']}"
+            trace_events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": label},
+            })
+            trace_events.append({
+                "name": "process_sort_index", "ph": "M", "pid": pid,
+                "tid": 0, "args": {"sort_index": pid},
+            })
+        return pids[key]
+
+    def _tid(pid: int, e) -> int:
+        thread = e.get("thread", "MainThread")
+        key = (pid, thread)
+        if key not in tids:
+            tid = sum(1 for (p, _n) in tids if p == pid)
+            tids[key] = tid
+            trace_events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": thread},
+            })
+        return tids[key]
+
+    for e in events:
+        start = _start_of(e)
+        if start is None or "name" not in e:
+            continue
+        pid = _pid(e)
+        tid = _tid(pid, e)
+        ts_us = (start - t0) * 1e6
+        dur_us = max(_duration_of(e), 0.0) * 1e6
+        args = {k: e[k] for k in _ID_KEYS if k in e}
+        args.update({k: v for k, v in e.items() if k not in _SKIP_KEYS})
+        trace_events.append({
+            "name": e["name"], "ph": "X", "cat": "span",
+            "pid": pid, "tid": tid,
+            "ts": round(ts_us, 3), "dur": round(dur_us, 3),
+            "args": args,
+        })
+        if e.get("span_id"):
+            span_pid[e["span_id"]] = (pid, tid, ts_us, ts_us + dur_us)
+
+    # flow arrows for parent links that cross a process/file boundary
+    flow = 0
+    for e in events:
+        parent = e.get("parent_id")
+        child = e.get("span_id")
+        if not parent or not child:
+            continue
+        src = span_pid.get(parent)
+        dst = span_pid.get(child)
+        if src is None or dst is None or src[0] == dst[0]:
+            continue
+        flow += 1
+        bind = min(max(dst[2], src[2]), src[3])  # inside the source slice
+        trace_events.append({
+            "name": "trace", "cat": "flow", "ph": "s", "id": flow,
+            "pid": src[0], "tid": src[1], "ts": round(bind, 3)})
+        trace_events.append({
+            "name": "trace", "cat": "flow", "ph": "f", "bp": "e",
+            "id": flow, "pid": dst[0], "tid": dst[1],
+            "ts": round(dst[2], 3)})
+
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "generator": "ddl25spring_tpu.obs.export",
+            "epoch_offset_s": t0,
+            "files": len({e.get("_file", 0) for e in events}),
+        },
+    }
+
+
+def write_chrome_trace(paths, out_path) -> dict:
+    """Export JSONL files to a Chrome-trace JSON on disk; returns the
+    trace dict."""
+    trace = chrome_trace(list(paths))
+    out = Path(out_path)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(trace))
+    return trace
+
+
+def validate(trace: dict, eps_us: float = 50.0) -> list[str]:
+    """Structural checks on an exported trace; returns problems (empty ==
+    valid).  Checks the Chrome-trace shape, that ``X`` events on each
+    (pid, tid) track nest properly (stack discipline), and that
+    parent/child id links stay within one trace_id."""
+    problems = []
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    if not xs:
+        problems.append("no X events")
+    by_track: dict = {}
+    span_trace = {}
+    for e in xs:
+        for key in ("name", "pid", "tid", "ts", "dur"):
+            if key not in e:
+                problems.append(f"X event missing {key}: {e}")
+                break
+        else:
+            by_track.setdefault((e["pid"], e["tid"]), []).append(e)
+            sid = e.get("args", {}).get("span_id")
+            if sid:
+                span_trace[sid] = e.get("args", {}).get("trace_id")
+    for (pid, tid), track in by_track.items():
+        track.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []  # end timestamps
+        for e in track:
+            start, end = e["ts"], e["ts"] + e["dur"]
+            while stack and stack[-1] <= start + eps_us:
+                stack.pop()
+            if stack and end > stack[-1] + eps_us:
+                problems.append(
+                    f"overlap on track ({pid},{tid}): {e['name']} ends "
+                    f"{end - stack[-1]:.1f}us after its enclosing span")
+            stack.append(end)
+    for e in xs:
+        args = e.get("args", {})
+        parent = args.get("parent_id")
+        if parent and parent in span_trace:
+            if span_trace[parent] != args.get("trace_id"):
+                problems.append(
+                    f"{e['name']}: parent {parent} in different trace")
+    return problems

@@ -1,0 +1,250 @@
+"""Trace context: deterministic trace/span ids + cross-process propagation.
+
+Stdlib-only (the ``tests/test_obs.py`` jax-import-free guard covers this
+module).  Every span recorded by :mod:`ddl25spring_tpu.obs.core` carries a
+``trace_id`` / ``span_id`` / ``parent_id`` triple threaded through a
+process-wide thread-local span stack kept here, so span JSONL from the FL
+server, its spawned client/eval subprocesses, multihost ranks and
+autoresume restarts can be joined into ONE timeline by
+``obs/export.py``.
+
+Id scheme (all lowercase hex, W3C trace-context sized):
+
+* ``trace_id``  — 32 hex chars.  ``start(seed=...)`` derives it
+  deterministically from the seed via blake2b; unseeded traces mix wall
+  time, pid and entropy.
+* ``span_id``   — 16 hex chars,
+  ``blake2b(f"{trace_id}:{lineage}:{process}:{seq}")`` with a per-process
+  monotonic ``seq`` and a spawn-lineage tag inherited from the parent
+  process (``DDL25_TRACE_CHILD``) — deterministic given the trace id, the
+  spawn topology and the span order, yet collision-free across processes
+  that share a rank.
+
+Propagation uses a ``traceparent``-style string
+``00-<trace_id>-<span_id>-01`` carried in the ``DDL25_TRACEPARENT``
+environment variable: a parent process calls :func:`child_env` when
+spawning (the innermost active span on the calling thread becomes the
+remote parent), and the child adopts it lazily the first time a span is
+opened — nothing to configure on the child side.  Multihost ranks tag
+every span with their ``process_index`` (:func:`set_process_index`, wired
+from ``parallel/multihost.py``); autoresume persists the root traceparent
+next to its checkpoints so a resumed run continues the same trace.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import os
+import threading
+import time
+
+TRACEPARENT_ENV = "DDL25_TRACEPARENT"
+# Spawn lineage tag ("<parent span id>/<spawn #>", chained): hashed into
+# every span id so two processes that share a trace_id, a process_index
+# and a span sequence number (e.g. rank-0 server and the client subprocess
+# it spawns) can never mint colliding ids.
+CHILD_TAG_ENV = "DDL25_TRACE_CHILD"
+
+# Anchor mapping perf_counter readings onto the wall clock, taken ONCE per
+# process: span start/end timestamps derived from it are mutually
+# consistent to perf_counter precision (time.time() per span would not be),
+# which is what keeps exported timelines properly nested.
+EPOCH0 = time.time() - time.perf_counter()
+
+_lock = threading.Lock()
+_tls = threading.local()
+_seq = itertools.count()
+
+_trace_id: str | None = None
+_root_parent: str | None = None  # remote parent span for this process's roots
+_process: int | None = None
+_spawn_seq = itertools.count()
+
+
+def _child_tag() -> str:
+    return os.environ.get(CHILD_TAG_ENV, "")
+
+
+def _hash_hex(material: str, nbytes: int) -> str:
+    return hashlib.blake2b(material.encode(), digest_size=nbytes).hexdigest()
+
+
+def _is_hex(s: str, n: int) -> bool:
+    if len(s) != n:
+        return False
+    try:
+        int(s, 16)
+        return True
+    except ValueError:
+        return False
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(value: str) -> tuple[str, str] | None:
+    """``(trace_id, span_id)`` from a traceparent string, or None."""
+    parts = value.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _ver, tid, sid, _flags = parts
+    if not (_is_hex(tid, 32) and _is_hex(sid, 16)):
+        return None
+    if set(tid) == {"0"} or set(sid) == {"0"}:
+        return None
+    return tid, sid
+
+
+# -- process identity ----------------------------------------------------
+
+
+def set_process_index(index: int):
+    """Tag every subsequent span with this rank (multihost wires it from
+    ``jax.process_index()`` at distributed init)."""
+    global _process
+    _process = int(index)
+
+
+def process_index() -> int:
+    if _process is not None:
+        return _process
+    env = os.environ.get("JAX_PROCESS_ID", "")
+    try:
+        return int(env)
+    except ValueError:
+        return 0
+
+
+# -- trace lifecycle -----------------------------------------------------
+
+
+def start(seed=None) -> str:
+    """Start a NEW trace (ignoring any inherited traceparent) and return
+    its trace_id.  ``seed`` makes the id — and through it every span id —
+    deterministic across runs."""
+    global _trace_id, _root_parent
+    if seed is None:
+        material = f"{time.time_ns()}:{os.getpid()}:{os.urandom(8).hex()}"
+    else:
+        material = f"ddl25spring:{seed}"
+    with _lock:
+        _trace_id = _hash_hex("trace:" + material, 16)
+        _root_parent = None
+    return _trace_id
+
+
+def adopt(traceparent: str) -> bool:
+    """Join the trace described by ``traceparent``: subsequent root spans
+    in this process parent under its span_id.  Returns False (and changes
+    nothing) when the string does not parse."""
+    global _trace_id, _root_parent
+    parsed = parse_traceparent(traceparent)
+    if parsed is None:
+        return False
+    with _lock:
+        _trace_id, _root_parent = parsed
+    return True
+
+
+def ensure() -> str:
+    """The current trace_id, lazily initialised: adopt ``DDL25_TRACEPARENT``
+    from the environment if present, else start a fresh trace."""
+    if _trace_id is not None:
+        return _trace_id
+    with _lock:
+        if _trace_id is not None:
+            return _trace_id
+    env = os.environ.get(TRACEPARENT_ENV)
+    if env and adopt(env):
+        return _trace_id
+    return start()
+
+
+def trace_id() -> str | None:
+    """The active trace_id WITHOUT forcing one to exist."""
+    return _trace_id
+
+
+def reset():
+    """Forget all trace state (fresh trace on next span) — tests and
+    deliberate run boundaries only."""
+    global _trace_id, _root_parent, _process, _seq, _spawn_seq
+    with _lock:
+        _trace_id = None
+        _root_parent = None
+        _process = None
+        _seq = itertools.count()
+        _spawn_seq = itertools.count()
+    os.environ.pop(TRACEPARENT_ENV, None)
+    os.environ.pop(CHILD_TAG_ENV, None)
+
+
+# -- span stack ----------------------------------------------------------
+
+
+def _stack() -> list:
+    s = getattr(_tls, "spans", None)
+    if s is None:
+        s = _tls.spans = []
+    return s
+
+
+def new_span_id() -> str:
+    material = (f"{ensure()}:{_child_tag()}:{process_index()}"
+                f":{next(_seq)}")
+    return _hash_hex(material, 8)
+
+
+def begin_span(name: str):
+    """Push a span; returns ``(trace_id, span_id, parent_id, parent_name)``
+    — parent ids come from the innermost open span on this thread, else
+    from the adopted remote parent (None for a true root)."""
+    tid = ensure()
+    sid = new_span_id()
+    stack = _stack()
+    if stack:
+        parent_name, parent_id = stack[-1]
+    else:
+        parent_name, parent_id = None, _root_parent
+    stack.append((name, sid))
+    return tid, sid, parent_id, parent_name
+
+
+def end_span() -> int:
+    """Pop the innermost span; returns the remaining depth."""
+    stack = _stack()
+    if stack:
+        stack.pop()
+    return len(stack)
+
+
+def current_span_id() -> str | None:
+    stack = _stack()
+    return stack[-1][1] if stack else None
+
+
+# -- propagation ---------------------------------------------------------
+
+
+def traceparent() -> str:
+    """Traceparent for handing to a child process: the innermost active
+    span on this thread, else the adopted remote parent, else a synthetic
+    process-root id (deterministic from the trace id)."""
+    tid = ensure()
+    sid = current_span_id() or _root_parent
+    if sid is None:
+        sid = _hash_hex(f"{tid}:root", 8)
+    return format_traceparent(tid, sid)
+
+
+def child_env(base=None) -> dict:
+    """A copy of ``base`` (default ``os.environ``) with the current
+    traceparent and a unique spawn-lineage tag injected — pass as
+    ``env=`` when spawning subprocesses."""
+    env = dict(os.environ if base is None else base)
+    tp = traceparent()
+    env[TRACEPARENT_ENV] = tp
+    env[CHILD_TAG_ENV] = f"{tp.split('-')[2]}/{next(_spawn_seq)}"
+    return env

@@ -1,0 +1,185 @@
+"""Runtime watchdogs: compile/retrace counters and device-memory gauges.
+
+Importing this module never imports jax (the ``tests/test_obs.py`` guard
+covers it); :func:`install` is what touches ``jax.monitoring`` and must
+only be called from a process that already runs jax.
+
+Three feeds, all landing in the active obs registry:
+
+* **compilation counters** — a ``jax.monitoring`` event-duration listener
+  maps ``/jax/core/compile/{jaxpr_trace,jaxpr_to_mlir_module,
+  backend_compile}_duration`` onto ``jax_compilations_total{kind=...}``
+  counters plus a ``jax_compile_seconds{kind=...}`` histogram;
+* **retrace detection** — jax's monitoring events carry no function
+  names, so a ``logging.Handler`` on the ``jax._src.dispatch`` logger
+  parses the per-function "Finished XLA compilation of <fun> in ..."
+  debug lines into ``jax_function_compiles_total{fun=...}``; a function
+  crossing ``retrace_threshold`` compiles emits a ``watchdog.retrace``
+  event and bumps ``watchdog_retrace_warnings_total{fun=...}`` (the
+  classic silent-retrace-per-step failure made loud);
+* **memory gauges** — a span-exit hook samples
+  ``device.memory_stats()`` (rate-limited, skipped gracefully on
+  backends like CPU that return None) into
+  ``device_memory_bytes_in_use{device=...}`` /
+  ``device_memory_peak_bytes{device=...}``.
+
+``tools/obs_report.py`` renders all three in its "runtime watchdogs"
+section; ``utils/costs.py:record_cost_gauges`` adds the per-phase FLOPs
+gauges that turn span timings into MFU.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import sys
+import time
+
+from . import core as _core
+
+__all__ = ["install", "uninstall", "installed"]
+
+_EVENT_KINDS = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "compile",
+}
+
+# "Finished XLA compilation of jit(train_step) in 0.42 sec"
+_COMPILE_MSG = re.compile(r"Finished XLA compilation of (.+?) in ")
+_DISPATCH_LOGGER = "jax._src.dispatch"
+
+_state: dict | None = None
+_duration_registered = False
+
+
+class _CompileLogHandler(logging.Handler):
+    """Counts per-function XLA compilations from jax's debug log lines."""
+
+    def __init__(self, threshold: int):
+        super().__init__(level=logging.DEBUG)
+        self.threshold = threshold
+        self.counts: dict = {}
+
+    def emit(self, record):
+        try:
+            m = _COMPILE_MSG.match(record.getMessage())
+        except Exception:
+            return
+        if m is None:
+            return
+        fun = m.group(1)
+        n = self.counts[fun] = self.counts.get(fun, 0) + 1
+        from ddl25spring_tpu import obs
+        if not obs.enabled():
+            return
+        obs.inc("jax_function_compiles_total", fun=fun)
+        if n >= self.threshold:
+            obs.inc("watchdog_retrace_warnings_total", fun=fun)
+            obs.event("watchdog.retrace", fun=fun, compiles=n,
+                      threshold=self.threshold)
+
+
+def _on_duration(event, duration, **_kw):
+    kind = _EVENT_KINDS.get(event)
+    if kind is None:
+        return
+    from ddl25spring_tpu import obs
+    if not obs.enabled():
+        return
+    obs.inc("jax_compilations_total", kind=kind)
+    obs.observe("jax_compile_seconds", duration, kind=kind)
+
+
+def _make_memory_hook(min_interval_s: float):
+    last = [0.0]
+    unavailable = [False]
+
+    def _hook(telemetry, _rec):
+        if unavailable[0]:
+            return
+        now = time.monotonic()
+        if now - last[0] < min_interval_s:
+            return
+        last[0] = now
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return
+        try:
+            for d in jax.local_devices():
+                stats = d.memory_stats()
+                if not stats:  # CPU and some backends expose nothing
+                    unavailable[0] = True
+                    return
+                telemetry.gauge(
+                    "device_memory_bytes_in_use", device=d.id
+                ).set(stats.get("bytes_in_use", 0))
+                peak = stats.get("peak_bytes_in_use")
+                if peak is not None:
+                    telemetry.gauge(
+                        "device_memory_peak_bytes", device=d.id).set(peak)
+        except Exception:
+            unavailable[0] = True
+
+    return _hook
+
+
+def install(retrace_threshold: int = 2, *, memory: bool = True,
+            memory_interval_s: float = 0.5):
+    """Arm the watchdogs (idempotent).  Requires jax importable — call
+    after backend selection, next to ``obs.enable``."""
+    global _state, _duration_registered
+    if _state is not None:
+        return
+    import jax  # noqa: F401  deliberate: install() is the jax boundary
+    from jax import monitoring
+
+    # jax offers no deregistration — register once per process even
+    # across install/uninstall cycles to avoid double counting
+    if not _duration_registered:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _duration_registered = True
+
+    handler = _CompileLogHandler(retrace_threshold)
+    dispatch_logger = logging.getLogger(_DISPATCH_LOGGER)
+    prev_level = dispatch_logger.level
+    prev_propagate = dispatch_logger.propagate
+    # the per-function compile lines are emitted at DEBUG and gated by
+    # isEnabledFor — the logger must be opened for them to exist at all;
+    # propagation is cut so opening it doesn't spam the root handlers
+    dispatch_logger.setLevel(logging.DEBUG)
+    dispatch_logger.propagate = False
+    dispatch_logger.addHandler(handler)
+
+    hook = None
+    if memory:
+        hook = _make_memory_hook(memory_interval_s)
+        _core.add_span_exit_hook(hook)
+
+    _state = {"handler": handler, "prev_level": prev_level,
+              "prev_propagate": prev_propagate, "hook": hook}
+
+
+def uninstall():
+    """Disarm the logging handler and memory hook (tests).  jax offers no
+    listener deregistration; the duration listener stays registered but
+    is inert while telemetry is disabled."""
+    global _state
+    if _state is None:
+        return
+    dispatch_logger = logging.getLogger(_DISPATCH_LOGGER)
+    dispatch_logger.removeHandler(_state["handler"])
+    dispatch_logger.setLevel(_state["prev_level"])
+    dispatch_logger.propagate = _state["prev_propagate"]
+    if _state["hook"] is not None:
+        _core.remove_span_exit_hook(_state["hook"])
+    _state = None
+
+
+def installed() -> bool:
+    return _state is not None
+
+
+def compile_counts() -> dict:
+    """Per-function compile counts seen since install (empty when off)."""
+    return dict(_state["handler"].counts) if _state else {}

@@ -1,0 +1,29 @@
+"""jax API compatibility: ``shard_map`` across the experimental->stable move.
+
+jax >= 0.4.35 exports :func:`jax.shard_map` (keyword ``check_vma``);
+older releases only have ``jax.experimental.shard_map.shard_map``
+(keyword ``check_rep``).  Every call site in this package writes the
+stable spelling — ``from .compat import shard_map`` with ``check_vma=``
+— and this module translates when running on the older API.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f=None, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:  # used as partial(shard_map, ...) / decorator factory
+            return functools.partial(shard_map, **kwargs)
+        return _experimental_shard_map(f, **kwargs)
+
+
+__all__ = ["shard_map"]

@@ -28,7 +28,7 @@ from functools import partial
 import jax
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from .compat import shard_map
 
 from .collectives import (instrument_collectives, tree_nr_leaves,
                           tree_payload_bytes)

@@ -28,6 +28,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .compat import shard_map as _shard_map
+
 
 def llama_moe_ep_shardings(mesh, params, expert_axis: str = "expert"):
     """Sharding tree for a params pytree containing MoEMLP experts: stacked
@@ -147,7 +149,7 @@ def apply_moe_all_to_all(mesh, params, x, *, topk: int = 2,
         )
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(expert_axis), P(), P(expert_axis), P(expert_axis),
                   P(expert_axis)),

@@ -74,6 +74,12 @@ def initialize_multihost(
         num_processes=num_processes,
         process_id=process_id,
     )
+    # tag this rank into the trace context: every span this process emits
+    # now carries process=<rank>, which is what keeps per-rank JSONL on
+    # distinct tracks when obs/export.py merges them into one timeline
+    from ..obs import trace as obs_trace
+
+    obs_trace.set_process_index(jax.process_index())
     return True
 
 

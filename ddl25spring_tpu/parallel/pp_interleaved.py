@@ -41,7 +41,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from .compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..models.llama import LlamaConfig

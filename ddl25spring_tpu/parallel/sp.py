@@ -21,7 +21,7 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from .compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.llama import Llama, LlamaConfig

@@ -31,7 +31,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from .compat import shard_map
 from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 

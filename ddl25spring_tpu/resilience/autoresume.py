@@ -25,9 +25,28 @@ is the persistence discipline around it:
 from __future__ import annotations
 
 import os
+from pathlib import Path
 
 from .. import obs
+from ..obs import trace as obs_trace
 from ..utils.checkpoint import Checkpointer
+
+
+def _continue_trace(directory) -> None:
+    """Keep one trace across restarts: the first run persists its root
+    traceparent next to the checkpoints; any restart that has not yet
+    started a trace of its own adopts it, so spans from every incarnation
+    of the run join into a single timeline."""
+    tp_path = Path(directory) / "traceparent"
+    try:
+        if tp_path.exists():
+            if obs_trace.trace_id() is None:
+                obs_trace.adopt(tp_path.read_text())
+        else:
+            tp_path.parent.mkdir(parents=True, exist_ok=True)
+            tp_path.write_text(obs_trace.traceparent() + "\n")
+    except OSError:
+        pass  # tracing must never block training
 
 
 def run_with_autoresume(server, nr_rounds: int, directory: str | os.PathLike,
@@ -44,6 +63,7 @@ def run_with_autoresume(server, nr_rounds: int, directory: str | os.PathLike,
     moments, SCAFFOLD variates)."""
     if every < 1:
         raise ValueError(f"every must be >= 1, got {every}")
+    _continue_trace(directory)
     ckpt = Checkpointer(directory, max_to_keep=max_to_keep)
     try:
         start = 0
@@ -94,8 +114,10 @@ def run_with_autoresume(server, nr_rounds: int, directory: str | os.PathLike,
 
             server._advance = _guarded
         try:
-            return server.run(nr_rounds - start, start_round=start,
-                              on_round=_on_round)
+            with obs.span("autoresume.run", start_round=start,
+                          nr_rounds=nr_rounds):
+                return server.run(nr_rounds - start, start_round=start,
+                                  on_round=_on_round)
         finally:
             if guard is not None:
                 server._advance = raw_advance

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import obs
 from .configs import HflConfig, parse_config
 from .data import load_cifar10, load_mnist, split_dataset
 from .fl import (
@@ -234,6 +235,12 @@ def build_server(cfg: HflConfig):
 
 
 def run(cfg: HflConfig):
+    if cfg.telemetry:
+        from .obs import watchdog as obs_watchdog
+
+        obs.enable(cfg.telemetry)
+        obs.trace.ensure()  # adopt DDL25_TRACEPARENT or start a new trace
+        obs_watchdog.install()
     server = build_server(cfg)
     logger = MetricsLogger(cfg.metrics_path) if cfg.metrics_path else None
     ckpt = (Checkpointer(cfg.checkpoint_dir)
@@ -272,8 +279,10 @@ def run(cfg: HflConfig):
 
     nr_remaining = max(0, cfg.nr_rounds - start_round)
     try:
-        result = server.run(nr_remaining, start_round=start_round,
-                            on_round=on_round)
+        with obs.span("hfl.run", algorithm=cfg.algorithm,
+                      rounds=nr_remaining):
+            result = server.run(nr_remaining, start_round=start_round,
+                                on_round=on_round)
     finally:
         # saves are async (on_round): drain + close even on a mid-run crash,
         # or the newest checkpoint dies uncommitted with the process — the
@@ -300,6 +309,7 @@ def run(cfg: HflConfig):
 
     if logger is not None:
         logger.close()
+    obs.flush()  # one telemetry_summary event; no-op when disabled
     if cfg.plot_dir and result.test_accuracy:
         from pathlib import Path
 

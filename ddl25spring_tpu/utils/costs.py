@@ -62,3 +62,33 @@ def cost_summary(compiled, sub_buckets: bool = False) -> dict:
                     and float(v) >= 0):
                 out[k.replace(" ", "_")] = float(v)
     return out
+
+
+def record_cost_gauges(compiled, phase: str) -> dict:
+    """Publish a compiled program's cost analysis as obs gauges so
+    ``tools/obs_report.py`` can turn span timings into per-phase MFU:
+    ``xla_cost_flops{phase=...}`` / ``xla_cost_bytes{phase=...}`` plus the
+    datasheet ``chip_peak_flops_per_s`` / ``chip_peak_hbm_bytes_per_s``
+    roofline denominators when the chip is known.  Returns the cost
+    summary; a no-op (empty dict) when telemetry is disabled, and never
+    raises — cost accounting must not take down the run."""
+    from ddl25spring_tpu import obs
+
+    if not obs.enabled():
+        return {}
+    try:
+        cs = cost_summary(compiled)
+    except Exception:
+        return {}
+    if "flops" in cs:
+        obs.set_gauge("xla_cost_flops", cs["flops"], phase=phase)
+    if "bytes_accessed" in cs:
+        obs.set_gauge("xla_cost_bytes", cs["bytes_accessed"], phase=phase)
+    try:
+        peaks = chip_peaks()
+    except Exception:
+        peaks = None
+    if peaks is not None:
+        obs.set_gauge("chip_peak_flops_per_s", peaks["flops_per_s"])
+        obs.set_gauge("chip_peak_hbm_bytes_per_s", peaks["hbm_bytes_per_s"])
+    return cs

@@ -25,10 +25,13 @@ REPO = Path(__file__).resolve().parent.parent
 
 @pytest.fixture(autouse=True)
 def _clean_obs():
-    """Every test starts and ends with telemetry off (process-global)."""
+    """Every test starts and ends with telemetry off (process-global),
+    with no live trace context."""
     obs.disable()
+    obs.trace.reset()
     yield
     obs.disable()
+    obs.trace.reset()
 
 
 class Sink:
@@ -229,7 +232,17 @@ def test_disabled_writes_nothing(tmp_path):
 # --------------------------------------------------------------------------
 
 def test_obs_import_is_jax_free():
-    code = ("import sys; import ddl25spring_tpu.obs; "
+    # the WHOLE obs surface, including an enabled sink and the tracing /
+    # export / watchdog modules — only watchdog.install() may touch jax
+    code = ("import sys, tempfile, os; "
+            "import ddl25spring_tpu.obs as obs; "
+            "import ddl25spring_tpu.obs.trace; "
+            "import ddl25spring_tpu.obs.export; "
+            "import ddl25spring_tpu.obs.watchdog; "
+            "p = os.path.join(tempfile.mkdtemp(), 't.jsonl'); "
+            "obs.enable(p); obs.trace.ensure(); "
+            "obs.span('x').__enter__(); "
+            "obs.flush(); obs.disable(); "
             "assert 'jax' not in sys.modules, 'obs import pulled jax'; "
             "print('ok')")
     out = subprocess.run(

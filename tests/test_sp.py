@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import optax
 import pytest
-from jax import shard_map
+from ddl25spring_tpu.parallel.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ddl25spring_tpu.models import Llama, LlamaConfig

@@ -1,0 +1,308 @@
+"""Distributed tracing tests: obs.trace id scheme, cross-process
+propagation, Chrome-trace export, the watchdogs, and the reporting tools.
+
+Everything here except the explicitly-jax tests runs without jax in the
+process — the tracing layer is stdlib-only by design (the
+``tests/test_obs.py`` import guard pins that).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ddl25spring_tpu import obs
+from ddl25spring_tpu.obs import export as obs_export
+from ddl25spring_tpu.obs import trace as obs_trace
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    obs.disable()
+    obs_trace.reset()
+    yield
+    obs.disable()
+    obs_trace.reset()
+
+
+class Sink:
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **fields):
+        self.events.append({"event": event, **fields})
+
+    def of(self, name):
+        return [e for e in self.events if e["event"] == name]
+
+
+# --------------------------------------------------------------------------
+# id scheme and propagation
+# --------------------------------------------------------------------------
+
+def test_traceparent_format_roundtrip():
+    tid = obs_trace.start(seed=7)
+    assert len(tid) == 32 and int(tid, 16)
+    tp = obs_trace.traceparent()
+    parsed = obs_trace.parse_traceparent(tp)
+    assert parsed is not None
+    assert parsed[0] == tid
+    assert obs_trace.parse_traceparent("garbage") is None
+    assert obs_trace.parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16
+                                       + "-01") is None
+
+
+def test_seeded_trace_id_is_deterministic():
+    a = obs_trace.start(seed=13)
+    obs_trace.reset()
+    b = obs_trace.start(seed=13)
+    obs_trace.reset()
+    c = obs_trace.start(seed=14)
+    assert a == b and a != c
+
+
+def test_span_records_carry_linked_ids():
+    sink = Sink()
+    obs.enable(sink=sink)
+    with obs.span("outer"):
+        with obs.span("inner"):
+            pass
+    inner, outer = sink.of("span")  # inner exits first
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["trace_id"] == outer["trace_id"]
+    assert inner["parent_id"] == outer["span_id"]
+    assert inner["depth"] == 1 and outer["depth"] == 0
+    assert inner["span_id"] != outer["span_id"]
+    assert {len(outer["trace_id"]), len(outer["span_id"])} == {32, 16}
+
+
+def test_traceparent_survives_subprocess_roundtrip():
+    obs_trace.start(seed=3)
+    sink = Sink()
+    obs.enable(sink=sink)
+    with obs.span("parent.work"):
+        env = obs_trace.child_env()
+        code = ("import sys; sys.path.insert(0, %r); "
+                "from ddl25spring_tpu.obs import trace; "
+                "print(trace.ensure()); print(trace.new_span_id())"
+                % str(REPO))
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=120,
+                             env=env)
+        parent_span = obs_trace.current_span_id()
+    assert out.returncode == 0, out.stderr
+    child_tid, child_span = out.stdout.split()
+    assert child_tid == obs_trace.trace_id()
+    assert child_span != parent_span
+    # the lineage tag in the env pins the child under THIS span
+    assert env[obs_trace.CHILD_TAG_ENV].startswith(parent_span + "/")
+
+
+def test_disabled_paths_are_noops():
+    # no telemetry -> spans are NULL_SPAN and no trace is ever started
+    with obs.span("x") as sp:
+        sp.fence(1)
+    assert obs_trace.trace_id() is None
+
+
+# --------------------------------------------------------------------------
+# Chrome-trace export
+# --------------------------------------------------------------------------
+
+def _run_spans(path, seed, names=("fl.round", "client.update")):
+    obs_trace.reset()
+    obs_trace.start(seed=seed)
+    obs.enable(str(path))
+    with obs.span(names[0], round=0):
+        with obs.span(names[1], client=1):
+            pass
+    obs.flush()
+    obs.disable()
+
+
+def test_chrome_trace_export_parses_and_nests(tmp_path):
+    a = tmp_path / "a.jsonl"
+    _run_spans(a, seed=1)
+    out = tmp_path / "trace.json"
+    obs_export.write_chrome_trace([a], out)
+    trace = json.loads(out.read_text())
+    assert obs_export.validate(trace) == []
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"fl.round", "client.update"}
+    for e in xs:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    # the child slice sits inside the parent slice
+    by = {e["name"]: e for e in xs}
+    par, kid = by["fl.round"], by["client.update"]
+    assert par["ts"] <= kid["ts"]
+    assert kid["ts"] + kid["dur"] <= par["ts"] + par["dur"] + 1e-3
+
+
+def test_multi_file_merge_keeps_distinct_tracks(tmp_path):
+    a, b = tmp_path / "rank0.jsonl", tmp_path / "rank1.jsonl"
+    _run_spans(a, seed=1)
+    _run_spans(b, seed=2)
+    trace = obs_export.chrome_trace([a, b])
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len({e["pid"] for e in xs}) == 2
+    names = [e for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    labels = {m["args"]["name"] for m in names}
+    assert any("rank0" in l for l in labels)
+    assert any("rank1" in l for l in labels)
+
+
+def test_trace_export_self_check():
+    """tools/trace_export.py --self-check spawns a child process, joins the
+    two span files on one trace id and validates the merged timeline."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_export.py"),
+         "--self-check"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "self-check ok" in out.stdout
+
+
+# --------------------------------------------------------------------------
+# histogram split + prom round-trip
+# --------------------------------------------------------------------------
+
+def test_wall_and_device_time_split_into_separate_histograms():
+    jax = pytest.importorskip("jax")
+    sink = Sink()
+    obs.enable(sink=sink)
+    with obs.span("step") as sp:
+        sp.fence(jax.numpy.ones(4) * 2)
+    snap = obs.get().snapshot()
+    hists = snap["histogram"]
+    assert 'span_seconds{span=step}' in hists
+    assert 'span_device_seconds{span=step}' in hists
+    rec = sink.of("span")[0]
+    assert rec["device_seconds"] >= 0
+
+
+def test_prom_snapshot_roundtrip(tmp_path):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from obs_report import render_prom_snapshot
+    finally:
+        sys.path.pop(0)
+    obs.enable(sink=Sink())
+    obs.inc("fl_rounds_total", 3)
+    obs.set_gauge("bench_rounds_per_sec", 12.5)
+    for v in (0.001, 0.02, 0.3, 4.0):
+        obs.observe("span_seconds", v, span="fl.round")
+    live = obs.render_prom()
+    rendered = render_prom_snapshot(obs.get().snapshot())
+    live_lines = set(live.splitlines())
+    # counters/gauges/sum/count must match the live renderer exactly;
+    # bucket lines are the sparse subset of its full-bounds rendering
+    for line in rendered.splitlines():
+        if line.startswith("#"):
+            continue
+        assert line in live_lines, (line, live)
+
+
+# --------------------------------------------------------------------------
+# watchdogs (jax required)
+# --------------------------------------------------------------------------
+
+def test_watchdog_counts_compiles_and_flags_retraces():
+    jax = pytest.importorskip("jax")
+    from ddl25spring_tpu.obs import watchdog
+
+    sink = Sink()
+    obs.enable(sink=sink)
+    watchdog.install(retrace_threshold=2)
+    try:
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        import numpy as np
+        for n in (2, 3, 4):  # three shapes -> three compiles of jit(f)
+            f(np.ones((n,), np.float32))
+        snap = obs.get().snapshot()
+        counters = snap["counter"]
+        compiles = {k: v["value"] for k, v in counters.items()
+                    if k.startswith("jax_compilations_total")}
+        assert sum(compiles.values()) > 0, counters
+        fn_key = 'jax_function_compiles_total{fun=jit(f)}'
+        assert counters[fn_key]["value"] == 3
+        warn_key = 'watchdog_retrace_warnings_total{fun=jit(f)}'
+        assert counters[warn_key]["value"] == 2  # fired at compiles 2 and 3
+        assert len([e for e in sink.of("watchdog.retrace")
+                    if e["fun"] == "jit(f)"]) == 2
+    finally:
+        watchdog.uninstall()
+    assert not watchdog.installed()
+
+
+# --------------------------------------------------------------------------
+# autoresume trace continuity
+# --------------------------------------------------------------------------
+
+def test_autoresume_persists_and_adopts_traceparent(tmp_path):
+    from ddl25spring_tpu.resilience.autoresume import _continue_trace
+
+    d = tmp_path / "ck"
+    obs_trace.start(seed=11)
+    first = obs_trace.trace_id()
+    _continue_trace(d)
+    tp_file = d / "traceparent"
+    assert tp_file.exists()
+    # a fresh process (no trace yet) adopts the persisted root
+    obs_trace.reset()
+    _continue_trace(d)
+    assert obs_trace.trace_id() == first
+    # spans in the restarted process continue the same trace
+    sink = Sink()
+    obs.enable(sink=sink)
+    with obs.span("after.restart"):
+        pass
+    assert sink.of("span")[0]["trace_id"] == first
+
+
+# --------------------------------------------------------------------------
+# report tool sections
+# --------------------------------------------------------------------------
+
+def test_obs_report_renders_timeline_and_critical_path(tmp_path):
+    a = tmp_path / "run.jsonl"
+    _run_spans(a, seed=5)
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "obs_report.py"), str(a)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "timeline" in out.stdout
+    assert "critical path" in out.stdout
+    assert "fl.round" in out.stdout
+
+
+def test_obs_report_renders_mfu_section(tmp_path):
+    a = tmp_path / "run.jsonl"
+    obs_trace.start(seed=6)
+    obs.enable(str(a))
+    with obs.span("fl.round", round=0):
+        pass
+    obs.set_gauge("xla_cost_flops", 1.0e9, phase="fl.round")
+    obs.set_gauge("xla_cost_bytes", 2.0e6, phase="fl.round")
+    obs.set_gauge("chip_peak_flops_per_s", 1.0e12)
+    obs.set_gauge("bench_rounds_per_sec", 10.0)
+    obs.flush()
+    obs.disable()
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "obs_report.py"), str(a)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "MFU" in out.stdout, out.stdout
+    assert "fl.round" in out.stdout

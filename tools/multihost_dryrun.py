@@ -45,6 +45,7 @@ def worker(port: str, pid: int) -> None:
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    from ddl25spring_tpu.parallel.compat import shard_map
     from ddl25spring_tpu.parallel.multihost import (
         initialize_multihost,
         make_multihost_mesh,
@@ -75,8 +76,9 @@ def worker(port: str, pid: int) -> None:
     w = jnp.float32(1.0)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P(("dcn", "data"))), out_specs=(P(), P()),
+        check_vma=False,
     )
     def global_grad(w, x_local):
         # d/dw sum(w * x) = sum(x): once via an EXPLICIT psum over both

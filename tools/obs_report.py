@@ -14,7 +14,20 @@ story a human wants after a run:
 - speculative decoding acceptance rate (accepted/proposed counters),
 - the FL section: rounds, client participation, bytes aggregated,
 - collective traffic (calls x payload bytes per kind/op label),
+- the timeline/critical-path section: per-(file, rank) tracks of root
+  spans joined on their obs.trace ids, plus the longest parent->child
+  chain through the merged span tree,
+- compute accounting: per-phase MFU from the ``xla_cost_flops`` gauges
+  (utils/costs.py:record_cost_gauges) against measured phase seconds and
+  the chip's datasheet peaks,
+- runtime watchdogs: compilation counters, per-function retrace warnings,
+  device-memory gauges (obs/watchdog.py),
 - any remaining instruments, so nothing logged is invisible.
+
+Accepts MANY JSONL files (one per process/rank) and merges them; pair
+with ``tools/trace_export.py`` for the interactive Perfetto view of the
+same files.  ``--prom`` renders the last ``telemetry_summary`` back out
+as Prometheus text exposition instead of the report.
 
 ``--trace DIR`` additionally aggregates an XProf trace directory through
 ``tools/trace_summary.py`` (lazy jax import — the JSONL part of this tool
@@ -22,6 +35,8 @@ is stdlib-only and runs anywhere).
 
 Usage:
     python tools/obs_report.py results/bench_telemetry.jsonl
+    python tools/obs_report.py results/rank0.jsonl results/rank1.jsonl
+    python tools/obs_report.py results/bench_telemetry.jsonl --prom
     python tools/obs_report.py results/bench_telemetry.jsonl --trace /tmp/trace
 """
 
@@ -43,6 +58,19 @@ def load_events(path: Path) -> list[dict]:
     importing the package — this tool must run with zero deps)."""
     with path.open() as fh:
         return [json.loads(line) for line in fh if line.strip()]
+
+
+def load_merged(paths) -> list[dict]:
+    """Events from many JSONL files, tagged with their source file and
+    sorted by wall timestamp so cross-process sequences read in order."""
+    events = []
+    for i, path in enumerate(paths):
+        for e in load_events(Path(path)):
+            e["_file"] = i
+            e["_src"] = Path(path).stem
+            events.append(e)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events
 
 
 def parse_key(disp: str) -> tuple[str, dict]:
@@ -167,6 +195,133 @@ def _pick(instruments: dict, name: str):
 def _value(instruments: dict, name: str, default=None):
     hits = _pick(instruments, name)
     return hits[0][1]["value"] if hits else default
+
+
+def _span_start(e) -> float | None:
+    if "start_ts" in e:
+        return float(e["start_ts"])
+    if "ts" in e and "seconds" in e:
+        return float(e["ts"]) - float(e["seconds"])
+    return None
+
+
+def _span_dur(e) -> float:
+    return float(e.get("device_seconds", e.get("seconds", 0.0)))
+
+
+def _phase_seconds(hists: dict, phase: str, rps) -> tuple:
+    """Measured seconds for one phase + the source of the number.  The
+    bench's timed-trial gauge beats the span histograms for ``fl.round``
+    (the warmup round's span includes compile time); otherwise prefer
+    fenced device time over dispatch wall time."""
+    if phase == "fl.round" and rps:
+        return 1.0 / rps, "timed trials"
+    for hname, src in (("span_device_seconds", "device mean"),
+                       ("span_seconds", "wall mean")):
+        for disp, st in hists.items():
+            n, lb = parse_key(disp)
+            if n == hname and lb.get("span") == phase and st["count"]:
+                return st["sum"] / st["count"], src
+    return None, None
+
+
+def report_timeline(events: list[dict], top: int) -> None:
+    """Per-(file, rank) tracks of root spans joined on trace ids, plus the
+    critical path — the ASCII counterpart of tools/trace_export.py."""
+    spans = [e for e in events if e.get("event") == "span"
+             and e.get("span_id") and _span_start(e) is not None]
+    if not spans:
+        return
+    t0 = min(_span_start(e) for e in spans)
+    tracks = defaultdict(list)
+    for e in spans:
+        tracks[(e.get("_src") or "", e.get("process", 0))].append(e)
+    traces = sorted({e.get("trace_id", "?") for e in spans})
+    by_id = {e["span_id"]: e for e in spans}
+    section(f"timeline ({len(tracks)} track(s), {len(traces)} trace(s))")
+    print("  trace " + ", ".join(traces))
+    for key in sorted(tracks):
+        evs = tracks[key]
+        roots = sorted((e for e in evs if e.get("depth", 0) == 0),
+                       key=_span_start)
+        label = f"rank{key[1]}" + (f" · {key[0]}" if key[0] else "")
+        print(f"  {label}: {len(evs)} spans, {len(roots)} roots")
+        for e in roots[:top]:
+            off = _span_start(e) - t0
+            join = ""
+            p = e.get("parent_id")
+            if p and p in by_id and by_id[p].get("_file") != e.get("_file"):
+                parent = by_id[p]
+                join = (f"  <- {parent['name']}"
+                        f"@rank{parent.get('process', 0)}")
+            print(f"    +{off:9.3f}s {fmt_seconds(_span_dur(e)):>10} "
+                  f"{e['name']}{join}")
+        if len(roots) > top:
+            print(f"    ... {len(roots) - top} more roots")
+    children = defaultdict(list)
+    for e in spans:
+        p = e.get("parent_id")
+        if p:
+            children[p].append(e)
+    top_roots = [e for e in spans
+                 if not e.get("parent_id") or e["parent_id"] not in by_id]
+    if not top_roots:
+        return
+    node = max(top_roots, key=_span_dur)
+    total = _span_dur(node) or 1.0
+    section("critical path (longest child at each level)")
+    depth = 0
+    while node is not None and depth < 20:
+        dur = _span_dur(node)
+        kids = children.get(node["span_id"], [])
+        kid = max(kids, key=_span_dur) if kids else None
+        self_s = max(dur - (_span_dur(kid) if kid else 0.0), 0.0)
+        print(f"  {'  ' * depth}{node['name']} "
+              f"[rank{node.get('process', 0)}] {fmt_seconds(dur)} "
+              f"({100.0 * dur / total:5.1f}% of root, "
+              f"self {fmt_seconds(self_s)})")
+        node = kid
+        depth += 1
+
+
+def render_prom_snapshot(summary: dict) -> str:
+    """The last ``telemetry_summary`` back out as Prometheus text
+    exposition — the JSONL-side inverse of obs.core.Telemetry.render_prom
+    (sparse histograms: only recorded bucket bounds are emitted, each with
+    the same cumulative count the live renderer produces; ``+Inf``, sum
+    and count always match exactly)."""
+    prom_name = re.compile(r"[^a-zA-Z0-9_:]")
+    by_name: dict = {}
+    for kind in ("counter", "gauge", "histogram"):
+        for disp, state in summary.get(kind, {}).items():
+            name, labels = parse_key(disp)
+            lab = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            by_name.setdefault(prom_name.sub("_", name), []).append(
+                (lab, kind, state))
+    lines = []
+    for pname, entries in by_name.items():
+        lines.append(f"# TYPE {pname} {entries[0][1]}")
+        for lab, kind, st in entries:
+            if kind in ("counter", "gauge"):
+                lines.append(f"{pname}{{{lab}}} {st['value']}" if lab
+                             else f"{pname} {st['value']}")
+                continue
+            buckets = sorted(
+                st.get("buckets", {}).items(),
+                key=lambda kv: (float("inf") if kv[0] == "+Inf"
+                                else float(kv[0])))
+            cum = 0
+            for le, c in buckets:
+                cum += c
+                ll = (lab + "," if lab else "") + f'le="{le}"'
+                lines.append(f"{pname}_bucket{{{ll}}} {cum}")
+            if not any(le == "+Inf" for le, _c in buckets):
+                ll = (lab + "," if lab else "") + 'le="+Inf"'
+                lines.append(f"{pname}_bucket{{{ll}}} {st['count']}")
+            suffix = f"{{{lab}}}" if lab else ""
+            lines.append(f"{pname}_sum{suffix} {st['sum']}")
+            lines.append(f"{pname}_count{suffix} {st['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def report(events: list[dict], top: int) -> None:
@@ -339,12 +494,98 @@ def report(events: list[dict], top: int) -> None:
             print("  serving: " + "   ".join(
                 f"{k.replace('_', ' ')}: {v}" for k, v in serv_res.items()))
 
+    # -- timeline / critical path ----------------------------------------
+    report_timeline(events, top)
+
+    # -- compute accounting (per-phase MFU) ------------------------------
+    flops_g = take(gauges, "xla_cost_flops")
+    bytes_g = {lb.get("phase"): st["value"]
+               for lb, st in take(gauges, "xla_cost_bytes")}
+    peak_f = _value(gauges, "chip_peak_flops_per_s")
+    take(gauges, "chip_peak_flops_per_s")
+    peak_b = _value(gauges, "chip_peak_hbm_bytes_per_s")
+    take(gauges, "chip_peak_hbm_bytes_per_s")
+    rps = _value(gauges, "bench_rounds_per_sec")
+    take(gauges, "bench_rounds_per_sec")
+    for disp in list(hists):
+        if parse_key(disp)[0] == "span_device_seconds":
+            used.add(disp)
+    if flops_g:
+        section("compute accounting (per-phase MFU)")
+        for labels, st in sorted(flops_g, key=lambda ls: -ls[1]["value"]):
+            phase = labels.get("phase", "?")
+            flops = st["value"]
+            secs, src = _phase_seconds(hists, phase, rps)
+            line = f"  {phase}: {flops:.3e} FLOP"
+            nbytes = bytes_g.get(phase)
+            if nbytes is not None:
+                line += f", {fmt_bytes(nbytes)} accessed"
+            if secs:
+                ach = flops / secs
+                line += f"  @ {fmt_seconds(secs)}/{src} -> {ach:.3e} FLOP/s"
+                if peak_f:
+                    line += f" = {100.0 * ach / peak_f:.1f}% MFU"
+                if nbytes is not None and peak_b:
+                    line += (f", {100.0 * (nbytes / secs) / peak_b:.1f}% "
+                             f"of peak HBM BW")
+            else:
+                line += "  (no measured phase seconds)"
+            print(line)
+        if peak_f:
+            print(f"  chip peaks: {peak_f:.3e} FLOP/s, "
+                  f"{fmt_bytes(peak_b or 0)}/s HBM"
+                  + ("" if peak_b else " (bw unknown)"))
+        else:
+            print("  (chip peaks unknown — achieved FLOP/s only)")
+        print("  note: XLA counts scan/fori bodies once; FLOPs are a "
+              "lower bound (bench.py cost_breakdown)")
+
+    # -- runtime watchdogs -----------------------------------------------
+    comp = take(counters, "jax_compilations_total")
+    fun_comp = take(counters, "jax_function_compiles_total")
+    retr = take(counters, "watchdog_retrace_warnings_total")
+    comp_h = {lb.get("kind"): st
+              for lb, st in take(hists, "jax_compile_seconds")}
+    mem = take(gauges, "device_memory_bytes_in_use")
+    mem_peak = {lb.get("device"): st["value"]
+                for lb, st in take(gauges, "device_memory_peak_bytes")}
+    retrace_evs = [e for e in events if e.get("event") == "watchdog.retrace"]
+    if comp or fun_comp or mem:
+        section("runtime watchdogs")
+        if comp:
+            parts = []
+            for lb, st in sorted(comp, key=lambda ls: ls[0].get("kind", "")):
+                kind = lb.get("kind", "?")
+                h = comp_h.get(kind)
+                tot = f" ({fmt_seconds(h['sum'])})" if h else ""
+                parts.append(f"{kind} x{st['value']}{tot}")
+            print("  compilations: " + "   ".join(parts))
+        if fun_comp:
+            worst = sorted(fun_comp, key=lambda ls: -ls[1]["value"])[:top]
+            print("  per-function compiles: " + ", ".join(
+                f"{lb.get('fun', '?')} x{st['value']}"
+                for lb, st in worst))
+        if retr or retrace_evs:
+            funs = {lb.get("fun", "?"): st["value"] for lb, st in retr}
+            print(f"  RETRACE WARNINGS ({len(retrace_evs)} events): "
+                  + ", ".join(f"{f} recompiled x{n}"
+                              for f, n in sorted(funs.items(),
+                                                 key=lambda fv: -fv[1]))
+                  + "  — check for varying shapes/static args")
+        if mem:
+            for lb, st in sorted(mem, key=lambda ls: ls[0].get("device", "")):
+                d = lb.get("device", "?")
+                pk = mem_peak.get(d)
+                print(f"  device {d} memory: {fmt_bytes(st['value'])} in "
+                      f"use" + (f", peak {fmt_bytes(pk)}" if pk else ""))
+
     # -- bench results ---------------------------------------------------
     results = [e for e in events if e.get("event") == "bench.result"]
     if results:
         section("bench results")
         for e in results:
-            row = {k: v for k, v in e.items() if k not in ("ts", "event")}
+            row = {k: v for k, v in e.items()
+                   if k not in ("ts", "event", "_file", "_src")}
             print("  " + json.dumps(row))
 
     # -- everything not already shown ------------------------------------
@@ -388,18 +629,32 @@ def report_trace(trace_dir: Path, top: int) -> None:
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="Render an obs telemetry JSONL as one report")
-    ap.add_argument("jsonl", type=Path)
+    ap.add_argument("jsonl", type=Path, nargs="+",
+                    help="one or more telemetry JSONL files (multi-rank / "
+                         "subprocess files merge into one timeline)")
     ap.add_argument("--trace", type=Path, default=None,
                     help="XProf trace dir to aggregate via trace_summary "
                          "(needs jax; the JSONL part never does)")
     ap.add_argument("--top", type=int, default=8,
                     help="rows in the trace by-opcode table")
+    ap.add_argument("--prom", action="store_true",
+                    help="print the last telemetry_summary as Prometheus "
+                         "text exposition instead of the report")
     args = ap.parse_args()
-    if not args.jsonl.exists():
-        print(f"no such file: {args.jsonl}", file=sys.stderr)
-        return 1
-    events = load_events(args.jsonl)
-    print(f"telemetry report: {args.jsonl}")
+    for p in args.jsonl:
+        if not p.exists():
+            print(f"no such file: {p}", file=sys.stderr)
+            return 1
+    events = load_merged(args.jsonl)
+    if args.prom:
+        summaries = [e for e in events
+                     if e.get("event") == "telemetry_summary"]
+        if not summaries:
+            print("no telemetry_summary event found", file=sys.stderr)
+            return 1
+        sys.stdout.write(render_prom_snapshot(summaries[-1]["summary"]))
+        return 0
+    print("telemetry report: " + ", ".join(str(p) for p in args.jsonl))
     report(events, args.top)
     if args.trace is not None:
         report_trace(args.trace, args.top)

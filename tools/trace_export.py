@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Export telemetry span JSONL files to one Chrome-trace/Perfetto JSON.
+
+Usage::
+
+    python tools/trace_export.py results/rank0.jsonl results/rank1.jsonl \
+        -o results/trace.json
+    python tools/trace_export.py --self-check
+
+Load the output at chrome://tracing or https://ui.perfetto.dev — one
+process track per (file, rank), spans nested per thread, flow arrows on
+cross-process parent links.  ``--self-check`` synthesizes a two-process
+JSONL pair (parent span → spawned child adopting the traceparent env
+var), exports it, and validates the result — a fast tier-1 smoke so the
+exporter can't silently rot.  Stdlib-only; never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from ddl25spring_tpu.obs import export  # noqa: E402
+
+_CHILD_CODE = """
+import sys
+from ddl25spring_tpu import obs
+
+obs.enable(sys.argv[1])
+with obs.span("client.update", client=1):
+    with obs.span("client.sgd_step"):
+        pass
+obs.flush()
+"""
+
+
+def self_check() -> int:
+    from ddl25spring_tpu import obs
+    from ddl25spring_tpu.obs import trace as obs_trace
+
+    with tempfile.TemporaryDirectory() as td:
+        parent_jsonl = os.path.join(td, "parent.jsonl")
+        child_jsonl = os.path.join(td, "child.jsonl")
+        out_json = os.path.join(td, "trace.json")
+
+        obs_trace.reset()
+        obs.enable(parent_jsonl)
+        with obs.span("fl.round", round=0):
+            env = obs_trace.child_env()
+            env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep + env.get(
+                "PYTHONPATH", "")
+            subprocess.run(
+                [sys.executable, "-c", _CHILD_CODE, child_jsonl],
+                env=env, check=True)
+        obs.flush()
+        obs.disable()
+
+        trace = export.write_chrome_trace(
+            [parent_jsonl, child_jsonl], out_json)
+        problems = export.validate(json.loads(Path(out_json).read_text()))
+
+        xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        by_name = {e["name"]: e for e in xs}
+        trace_ids = {e["args"].get("trace_id") for e in xs}
+        pids = {e["pid"] for e in xs}
+        if len(trace_ids) != 1 or None in trace_ids:
+            problems.append(f"expected one trace_id, got {trace_ids}")
+        if len(pids) != 2:
+            problems.append(f"expected 2 process tracks, got {pids}")
+        round_span = by_name.get("fl.round")
+        client_root = by_name.get("client.update")
+        if not round_span or not client_root:
+            problems.append(f"missing expected spans: {sorted(by_name)}")
+        elif client_root["args"].get("parent_id") != \
+                round_span["args"].get("span_id"):
+            problems.append("child root does not parent under fl.round")
+        if not any(e.get("ph") == "s" for e in trace["traceEvents"]):
+            problems.append("no cross-process flow event emitted")
+
+        if problems:
+            for p in problems:
+                print(f"self-check FAIL: {p}", file=sys.stderr)
+            return 1
+        print(f"self-check ok: {len(xs)} spans, {len(pids)} process "
+              f"tracks, 1 trace ({trace_ids.pop()})")
+        return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", nargs="*",
+                    help="telemetry JSONL files (one per process/rank)")
+    ap.add_argument("-o", "--out", default="results/trace.json",
+                    help="output Chrome-trace JSON path")
+    ap.add_argument("--self-check", action="store_true",
+                    help="synthesize a two-process trace, export, validate")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if not args.jsonl:
+        ap.error("at least one JSONL file (or --self-check) required")
+
+    trace = export.write_chrome_trace(args.jsonl, args.out)
+    problems = export.validate(trace)
+    xs = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    pids = len({e["pid"] for e in trace["traceEvents"] if e.get("ph") == "X"})
+    print(f"wrote {args.out}: {xs} spans on {pids} process track(s) "
+          f"from {len(args.jsonl)} file(s)")
+    for p in problems:
+        print(f"warning: {p}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
