@@ -37,7 +37,7 @@ CPU_BASELINE_ROUNDS_PER_SEC = 0.001441
 
 def build_server(seed: int = 10, norm_impl: str = "flax",
                  conv_impl: str = "flax", remat: bool = False,
-                 fault_spec: str = ""):
+                 fault_spec: str = "", client_chunk: int = 0):
     import jax
     import jax.numpy as jnp
 
@@ -112,6 +112,9 @@ def build_server(seed: int = 10, norm_impl: str = "flax",
         task, lr=0.05, batch_size=50, client_data=client_data,
         client_fraction=0.1, nr_local_epochs=1, seed=seed, mesh=mesh,
         fault_plan=FaultPlan.parse(fault_spec),
+        # bench holds no extra reference to params between rounds (no
+        # checkpointer), so the streaming accumulator can be donated
+        client_chunk=client_chunk, donate=client_chunk > 0,
     )
 
 
@@ -369,15 +372,56 @@ def _probe_device(timeout_s: float = 120.0) -> bool:
     return ok.is_set()
 
 
+def _registered_platforms(timeout_s: float):
+    """Set of registered device platform names, or None if even device
+    ENUMERATION wedged (remote-tunnel backends can hang there too, so the
+    listing runs under the same daemon-thread timeout as the op probe)."""
+    out: dict = {}
+
+    def attempt():
+        import jax
+
+        out["platforms"] = {d.platform for d in jax.devices()}
+
+    t = threading.Thread(target=attempt, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return out.get("platforms")
+
+
+def _cpu_only_error(timeout_s: float) -> str | None:
+    """Fail-fast reason when this process can only ever see CPU, else None.
+
+    BENCH_r05 burned ~10 minutes in 6 fixed 90 s probes against a process
+    that had JAX_PLATFORMS=cpu exported — no amount of retrying conjures a
+    TPU a pinned process can't load.  Both conditions here are decidable in
+    seconds; genuine tunnel flakiness (enumeration wedged) falls through to
+    the retry loop, which exists for exactly that."""
+    pinned = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if pinned == "cpu":
+        return ("JAX_PLATFORMS=cpu pins this process to CPU: no probe "
+                "retry can reach an accelerator (unset it, or pass "
+                "--allow-cpu for a deliberate CPU run)")
+    platforms = _registered_platforms(timeout_s)
+    if platforms is not None and not (platforms - {"cpu"}):
+        return ("no non-CPU device registered (platforms="
+                f"{sorted(platforms)}): accelerator plugin missing or "
+                "backend fell back to CPU — retrying cannot fix this "
+                "(pass --allow-cpu for a deliberate CPU run)")
+    return None
+
+
 def _probe_device_with_retry(attempts: int = 6, timeout_s: float = 90.0,
                              pause_s: float = 20.0) -> bool:
     """Probe the device repeatedly over a multi-minute window.
 
     A transient tunnel outage must not cost the whole round's perf evidence
     (it did in round 1: BENCH_r01.json recorded 0.0 off a single 120 s shot).
-    Worst case this burns ~11 min, well inside what the driver allows.  Each
-    attempt leaves at most one wedged daemon thread behind; the process exits
-    via os._exit on the failure path so they can't keep it alive."""
+    Worst case this burns ~attempts*(timeout+pause), tunable via
+    --probe-attempts/--probe-timeout-s/--probe-pause-s or the DDL25_PROBE_*
+    env vars.  Each attempt leaves at most one wedged daemon thread behind;
+    the process exits via os._exit on the failure path so they can't keep it
+    alive."""
     for i in range(attempts):
         _stamp(f"device probe attempt {i + 1}/{attempts} "
                f"(timeout {timeout_s:.0f}s) ...")
@@ -530,6 +574,32 @@ def main():
                          "of fault screening and the rounds/sec under "
                          "degraded participation; empty = the exact "
                          "fault-free program")
+    ap.add_argument("--client-chunk", type=int, default=0,
+                    help="stream the FL round in chunks of this many "
+                         "sampled clients (lax.scan over chunks, "
+                         "O(chunk*P) update memory instead of the full "
+                         "26-row stack; docs/PERFORMANCE.md); 0 = stacked "
+                         "full cohort")
+    ap.add_argument("--probe-attempts", type=int,
+                    default=int(os.environ.get("DDL25_PROBE_ATTEMPTS", 6)),
+                    help="device-probe attempts before declaring the "
+                         "device unreachable (env DDL25_PROBE_ATTEMPTS)")
+    ap.add_argument("--probe-timeout-s", type=float,
+                    default=float(os.environ.get("DDL25_PROBE_TIMEOUT_S",
+                                                 90.0)),
+                    help="per-attempt probe timeout in seconds "
+                         "(env DDL25_PROBE_TIMEOUT_S)")
+    ap.add_argument("--probe-pause-s", type=float,
+                    default=float(os.environ.get("DDL25_PROBE_PAUSE_S",
+                                                 20.0)),
+                    help="pause between probe attempts in seconds "
+                         "(env DDL25_PROBE_PAUSE_S)")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="run the bench on CPU instead of failing fast "
+                         "when no accelerator can ever be reached "
+                         "(JAX_PLATFORMS=cpu or no non-CPU device "
+                         "registered) — for deliberate CPU measurements "
+                         "only; the headline metric assumes a TPU")
     ap.add_argument("--deadline-s", type=float, default=1500.0,
                     help="no-progress (idle) cap after the device probe: if "
                          "no milestone or transfer-chunk stamp lands for "
@@ -541,6 +611,9 @@ def main():
         # fail BEFORE any device work: a post-run crash would break the
         # one-JSON-line driver contract after minutes of remote-TPU time
         ap.error(f"--trials must be >= 1, got {args.trials}")
+    if args.probe_attempts < 1 or args.probe_timeout_s <= 0:
+        ap.error("--probe-attempts must be >= 1 and --probe-timeout-s > 0 "
+                 f"(got {args.probe_attempts}, {args.probe_timeout_s})")
 
     if args.measure_cpu_baseline:
         measure_cpu_baseline()
@@ -559,14 +632,29 @@ def main():
         _stamp(f"telemetry -> {args.telemetry} "
                f"(trace {obs.trace.trace_id()})")
 
+    if not args.allow_cpu:
+        # decidable-in-seconds failure first: a CPU-pinned process can never
+        # reach an accelerator, so don't burn the probe-retry window on it
+        reason = _cpu_only_error(args.probe_timeout_s)
+        if reason is not None:
+            _stamp(f"fail-fast: {reason}")
+            obs.event("bench.probe", attempt=0, outcome="cpu_only",
+                      reason=reason)
+            obs.flush()
+            _emit_json(0.0, error=reason)
+            os._exit(1)
+
     _stamp("probing device ...")
-    if not _probe_device_with_retry():
+    if not _probe_device_with_retry(attempts=args.probe_attempts,
+                                    timeout_s=args.probe_timeout_s,
+                                    pause_s=args.probe_pause_s):
         obs.flush()
         # one well-formed JSON line either way: a hung tunnel must not hang
         # the driver, and value 0 is unambiguous about what happened
         _emit_json(0.0, error="device unreachable: trivial op never "
-                              "completed across 6 probe attempts over "
-                              "~10 min (remote TPU tunnel down?)")
+                              f"completed across {args.probe_attempts} "
+                              f"probe attempts of {args.probe_timeout_s:.0f}s "
+                              "(remote TPU tunnel down?)")
         # nonzero so scripts/CI keyed on exit status see the failure; daemon
         # probe threads may be wedged in the backend, so skip shutdown
         os._exit(1)
@@ -576,7 +664,28 @@ def main():
     _stamp("building server (data + mesh + jit round_fn) ...")
     server = build_server(norm_impl=args.norm_impl,
                           conv_impl=args.conv_impl, remat=args.remat,
-                          fault_spec=args.faults)
+                          fault_spec=args.faults,
+                          client_chunk=args.client_chunk)
+    # the cost gauge the chunking exists to move: bytes of the per-round
+    # update stack with the full cohort vs with the resolved chunk (the
+    # resolved size can exceed the request — divisor rounding, engine
+    # _resolve_chunk); "effective" is what THIS run materializes
+    from ddl25spring_tpu.fl.engine import _tree_bytes
+
+    cohort = server.nr_clients_per_round
+    eff_chunk = getattr(server.round_fn, "client_chunk", None) or cohort
+    param_bytes = _tree_bytes(server.params)
+    stack_bytes = {
+        "update_stack_bytes_stacked": cohort * param_bytes,
+        "update_stack_bytes_effective": eff_chunk * param_bytes,
+        "client_chunk_requested": args.client_chunk,
+        "client_chunk_effective": eff_chunk if eff_chunk != cohort else 0,
+    }
+    if obs.enabled():
+        obs.set_gauge("fl_update_stack_bytes_stacked",
+                      stack_bytes["update_stack_bytes_stacked"])
+        obs.set_gauge("fl_update_stack_bytes_effective",
+                      stack_bytes["update_stack_bytes_effective"])
     if args.cost_analysis:
         costs = cost_breakdown(server)
         _WATCHDOG.cancel()
@@ -585,6 +694,7 @@ def main():
             "norm_impl": args.norm_impl,
             "conv_impl": args.conv_impl,
             "remat": args.remat,
+            **stack_bytes,
             **costs,
         }))
         return
@@ -625,7 +735,8 @@ def main():
                faults=args.faults,
                trials=[round(r, 4) for r in rates],
                spread_pct=round(spread_pct, 2),
-               first_execution_rps=round(rates[0], 4))
+               first_execution_rps=round(rates[0], 4),
+               **stack_bytes)
 
 
 if __name__ == "__main__":

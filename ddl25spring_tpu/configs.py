@@ -61,6 +61,15 @@ class HflConfig:
     # paper's standard 1.0 — deliberately NOT fedopt's server_lr, whose
     # 0.02 default would silently shrink scaffold's update 50x)
     dropout_rate: float = 0.0  # per-round client failure probability
+    client_chunk: int = 0      # stream the round in chunks of this many
+    #                            clients (lax.scan over chunks, O(chunk·P)
+    #                            update memory); 0 = stacked full cohort.
+    #                            Rounded up to a divisor of the sample size;
+    #                            see docs/PERFORMANCE.md
+    robust_stack: str = "float32"  # chunked robust aggregation keeps a full
+    #                            update stack; store it reduced-precision:
+    #                            float32 | bfloat16 | int8 (needs
+    #                            client_chunk > 0 and a robust aggregator)
     compress: str = "none"     # fedavg/fedprox/fedsgd uplink compression:
     #                            none | topk (sparsify client messages) |
     #                            int8 (stochastic quantization); fl/engine.py
@@ -96,6 +105,16 @@ class HflConfig:
         if self.round_deadline_s < 0:
             raise ValueError(
                 f"round_deadline_s must be >= 0, got {self.round_deadline_s}"
+            )
+        if self.client_chunk < 0:
+            raise ValueError(
+                f"client_chunk must be >= 0 (0 = stacked), got "
+                f"{self.client_chunk}"
+            )
+        if self.robust_stack not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"robust_stack must be float32 | bfloat16 | int8, got "
+                f"{self.robust_stack!r}"
             )
         if self.fault_spec:
             # parse eagerly so a typo'd spec fails at config time, not

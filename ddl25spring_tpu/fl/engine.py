@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import obs
-from ..utils.trees import tree_weighted_mean
+from ..utils.trees import tree_select, tree_weighted_mean
 
 
 def _tree_bytes(tree) -> int:
@@ -208,6 +208,44 @@ def sample_clients(key, nr_clients: int, nr_sampled: int):
     return jax.random.permutation(key, nr_clients)[:nr_sampled]
 
 
+def _resolve_chunk(requested: int, group: int, axis_size: int = 1):
+    """Resolve a requested client-chunk size against ``group`` sampled
+    clients: the smallest divisor of ``group`` that is >= ``requested`` and
+    a multiple of ``axis_size`` (the mesh client-axis extent), or ``None``
+    when only the whole group qualifies (chunking off).
+
+    Divisors only, and ``group`` itself is never changed: sampling and
+    fault-mask draws are shaped by ``group``, and ``jax.random`` draws are
+    NOT prefix-stable across shapes — padding the cohort to fit a chunk
+    would silently change which clients drop or get corrupted, breaking
+    the streaming-vs-stacked equivalence this mode guarantees."""
+    if requested <= 0 or requested >= group:
+        return None
+    for cand in range(requested, group):
+        if group % cand == 0 and cand % axis_size == 0:
+            return cand
+    return None
+
+
+def donation_safe(argnums: tuple) -> tuple:
+    """Gate buffer donation on the persistent compilation cache being OFF.
+
+    Empirically (jax 0.4.37, CPU backend): an executable DESERIALIZED from
+    the persistent compilation cache can lose the read-before-write
+    ordering on a donated buffer that the program both gathers from and
+    scatters into — the gather reads post-scatter rows.  Bisected via the
+    SCAFFOLD K=1 closed form (tests/test_fl_extensions.py): the identical
+    program is exact (max err 6e-8) when freshly compiled, wrong by ~0.5
+    when loaded from a cache hit, and exact again with donation removed.
+    Fresh compiles are always correct, so only the cache+donation
+    combination is unsafe; whenever ``jax_compilation_cache_dir`` is set
+    we trade the in-place-update memory saving for correctness.
+    """
+    if argnums and jax.config.jax_compilation_cache_dir:
+        return ()
+    return argnums
+
+
 def make_fl_round(
     client_update,
     x,
@@ -229,6 +267,9 @@ def make_fl_round(
     device_put_data: bool = True,
     fault_plan=None,
     round_deadline_s: float | None = None,
+    client_chunk: int = 0,
+    donate: bool = False,
+    robust_stack: str = "float32",
 ):
     """Build the jitted one-round function of a decentralized server.
 
@@ -300,6 +341,42 @@ def make_fl_round(
     no-op update rather than poison.  Without a plan, none of this traces:
     the compiled program is bit-identical to the fault-free one (oracle:
     tests/test_resilience.py).
+
+    ``client_chunk > 0`` turns the round into a STREAMING round: instead of
+    vmapping ``client_update`` over all sampled clients at once (an
+    ``[m, P]`` update stack — ~11.5 GB at the 256-client ResNet-18
+    north-star scale), the round ``lax.scan``-s over chunks of clients
+    (vmap within a chunk) and folds each chunk into a running weighted-sum
+    accumulator, so peak update memory is O(chunk·P) and the backward-pass
+    temporaries scale with the chunk too.  The requested size is rounded up
+    to the nearest divisor of the (padded) cohort that the mesh client axis
+    divides (:func:`_resolve_chunk`) so that NO random draw changes:
+    sampling, dropout, DP noise and fault masks are all drawn exactly as on
+    the stacked path, int32 fault stats are order-exact partial sums, and
+    the single survivor renormalisation still happens once at the end.  The
+    only difference from the stacked path is float summation order
+    (sum of w_i·u_i then one divide, vs. sum of u_i·(w_i/Σw)), which is why
+    ``client_chunk = 0`` (or >= the cohort) IS the stacked code path —
+    bit-identical by construction.  Collusive attacks (which need the whole
+    stack) force the stacked path.
+
+    With a custom ``aggregator`` the rule genuinely needs the full ``[m, D]``
+    matrix, so chunking instead streams the stack CONSTRUCTION (per-chunk
+    training temporaries, rows written into a preallocated buffer) and
+    ``robust_stack`` picks the buffer precision: ``"float32"`` (default),
+    ``"bfloat16"`` (half the stack bytes), or ``"int8"``
+    (``parallel.compress`` stochastic per-tensor quantization — ~1/4 the
+    stack bytes, decoded before aggregation).
+
+    ``donate = True`` donates the params argument of the jitted round so
+    XLA may write the new params into the input buffer (the scan-carry
+    accumulator is aliased in place by XLA either way).  The caller must
+    not reuse the params it passed in — the server ``self.params``
+    reassignment pattern is safe, but FedOpt-style consumers that reuse
+    the round input, and checkpointers holding an async reference to it,
+    must keep ``donate = False``.  Donation is enforced on CPU too (the
+    donated buffer is deleted), so tests comparing two rounds from the
+    same params must copy first.
     """
     if not 0.0 <= dropout_rate <= 1.0:
         raise ValueError(
@@ -344,6 +421,27 @@ def make_fl_round(
             f"round_deadline_s={round_deadline_s} must be > 0 (it is the "
             "simulated round deadline stragglers are measured against)"
         )
+    if client_chunk < 0:
+        raise ValueError(
+            f"client_chunk={client_chunk} must be >= 0 (0 = stacked round)"
+        )
+    if robust_stack not in ("float32", "bfloat16", "int8"):
+        raise ValueError(
+            f"robust_stack={robust_stack!r} not in "
+            "('float32', 'bfloat16', 'int8')"
+        )
+    if robust_stack != "float32" and aggregator is None:
+        raise ValueError(
+            "robust_stack only applies to a custom (robust) aggregator's "
+            "stacked build; linear aggregation streams through an "
+            "accumulator and never materialises a stack to compress"
+        )
+    if robust_stack != "float32" and client_chunk <= 0:
+        raise ValueError(
+            "robust_stack needs client_chunk > 0: without chunking the "
+            "full-precision stack is materialised first, so a reduced-"
+            "precision copy would only ADD memory"
+        )
     if fault_plan is not None and not fault_plan.affects_fl_round:
         # a crash/serving-only plan has nothing to inject here; dropping it
         # keeps the compiled round on the exact fault-free program
@@ -368,6 +466,15 @@ def make_fl_round(
             mesh = None
         else:
             nr_shard = padded
+
+    # resolve the streaming chunk AFTER padding so it divides the cohort
+    # the program actually runs; collusive attacks need the whole stack
+    chunk = _resolve_chunk(
+        client_chunk, nr_shard,
+        mesh.shape[clients_axis] if mesh is not None else 1,
+    )
+    if attack is not None and getattr(attack, "collusive", False):
+        chunk = None
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -406,7 +513,7 @@ def make_fl_round(
     # (256 CIFAR clients ≈ 150 MB) — slow to compile anywhere and an outright
     # compile-upload failure on remote-compile TPU frontends.  As arguments
     # they stay resident device buffers reused every round.
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donation_safe((0,) if donate else ()))
     def _round(params, base_key, round_idx, x, y, counts, mal_mask):
         round_key = jax.random.fold_in(base_key, round_idx)
         # noise_key is dedicated to the DP Gaussian mechanism: the aggregator
@@ -424,104 +531,211 @@ def make_fl_round(
         if fault_plan is not None:
             # per-client fault draws, a pure function of (plan.seed,
             # round_idx) — independent of the round_key streams so adding
-            # a plan never perturbs sampling/aggregation randomness
+            # a plan never perturbs sampling/aggregation randomness; drawn
+            # for the FULL cohort regardless of chunking (the chunked paths
+            # slice these, so the draws are identical to the stacked path's)
             f_keep, f_nan, f_inf, f_late = fault_plan.round_masks(
                 round_idx, nr_shard, round_deadline_s
             )
+        else:
+            f_keep = f_nan = f_inf = f_late = None
 
-        xs = constrain(jnp.take(x, sel, axis=0))
-        ys = constrain(jnp.take(y, sel, axis=0))
-        cs = constrain(jnp.take(counts, sel, axis=0))
         # per-(round, client-id) keys: same discipline as the reference's
         # client_round_seed (hfl_complete.py:368), JAX-native derivation
         keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(sel)
-
-        updates = jax.vmap(client_update, in_axes=(None, 0, 0, 0, 0))(
-            params, xs, ys, cs, keys
+        mal = (
+            jnp.take(mal_mask, sel, axis=0) if attack is not None else None
         )
-        updates = constrain(updates)
 
-        if attack is not None:
-            mal = jnp.take(mal_mask, sel, axis=0)
-            if getattr(attack, "collusive", False):
-                # collusive attacks (ALIE) need cross-attacker statistics:
-                # one call with the whole stack + mask, not a per-client
-                # vmap — the attack itself only rewrites masked rows
-                updates = attack(
-                    updates, mal, params,
-                    jax.random.fold_in(round_key, 0x5EED),
-                )
-            else:
-                attacked = jax.vmap(attack, in_axes=(0, None, 0))(
-                    updates, params, keys
-                )
-                updates = jax.tree.map(
-                    lambda a, b: jnp.where(
-                        mal.reshape((-1,) + (1,) * (a.ndim - 1)), a, b
-                    ),
-                    attacked,
-                    updates,
-                )
+        def client_messages(sel_g, keys_g, mal_g, f_nan_g, f_inf_g):
+            """Local updates + uplink pipeline (attack, compression, fault
+            corruption) for one GROUP of sampled clients — the whole cohort
+            on the stacked path, one chunk on the streaming paths.  One
+            shared function so the two paths cannot drift semantically."""
+            xs = constrain(jnp.take(x, sel_g, axis=0))
+            ys = constrain(jnp.take(y, sel_g, axis=0))
+            cs = constrain(jnp.take(counts, sel_g, axis=0))
+            updates = jax.vmap(client_update, in_axes=(None, 0, 0, 0, 0))(
+                params, xs, ys, cs, keys_g
+            )
+            updates = constrain(updates)
 
-        if compress != "none":
-            # communication-efficient uplink: each client's MESSAGE (its
-            # delta from round-start params for weight-returning servers,
-            # the raw gradient for gradient servers) is sparsified or
-            # stochastically int8-quantized before the server sees it —
-            # the standard FL uplink squeeze (per-client, stateless: a
-            # per-client error-feedback residual at N=256 x ResNet scale
-            # would dwarf the model in HBM).  Composes with robust
-            # aggregators: distances are computed on what the server
-            # actually receives.
-            from ..parallel.compress import quantize_int8, topk_sparsify
+            if attack is not None:
+                if getattr(attack, "collusive", False):
+                    # collusive attacks (ALIE) need cross-attacker
+                    # statistics: one call with the whole stack + mask, not
+                    # a per-client vmap — the attack itself only rewrites
+                    # masked rows.  Chunking is disabled for these (above),
+                    # so this group IS the whole cohort.
+                    updates = attack(
+                        updates, mal_g, params,
+                        jax.random.fold_in(round_key, 0x5EED),
+                    )
+                else:
+                    attacked = jax.vmap(attack, in_axes=(0, None, 0))(
+                        updates, params, keys_g
+                    )
+                    updates = jax.tree.map(
+                        lambda a, b: jnp.where(
+                            mal_g.reshape((-1,) + (1,) * (a.ndim - 1)), a, b
+                        ),
+                        attacked,
+                        updates,
+                    )
 
-            if compress_deltas:
-                space = jax.tree.map(lambda u, p: u - p, updates, params)
-            else:
-                space = updates
-            if compress == "topk":
-                # [0] = the sparse tree; the dropped remainder feeds error
-                # feedback in the DP training path, but per-client
-                # residuals are deliberately not kept here (see above)
-                space = jax.vmap(
-                    lambda t: topk_sparsify(t, compress_ratio)[0]
-                )(space)
-            else:
-                ckeys = jax.vmap(
-                    lambda kk: jax.random.fold_in(kk, 977)
-                )(keys)
-                space = jax.vmap(quantize_int8)(space, ckeys)
-            if compress_deltas:
-                updates = jax.tree.map(
-                    lambda s, p: s + p, space, params
-                )
-            else:
-                updates = space
+            if compress != "none":
+                # communication-efficient uplink: each client's MESSAGE (its
+                # delta from round-start params for weight-returning servers,
+                # the raw gradient for gradient servers) is sparsified or
+                # stochastically int8-quantized before the server sees it —
+                # the standard FL uplink squeeze (per-client, stateless: a
+                # per-client error-feedback residual at N=256 x ResNet scale
+                # would dwarf the model in HBM).  Composes with robust
+                # aggregators: distances are computed on what the server
+                # actually receives.
+                from ..parallel.compress import quantize_int8, topk_sparsify
 
-        if fault_plan is not None and fault_plan.corrupts:
-            # corruption lands on the RECEIVED message (post-attack,
-            # post-compression): a broken client's uplink is garbage no
-            # matter what the honest pipeline did to it
-            def _poison(u):
-                if not jnp.issubdtype(u.dtype, jnp.inexact):
-                    return u
-                shape = (-1,) + (1,) * (u.ndim - 1)
-                u = jnp.where(f_nan.reshape(shape), jnp.nan, u)
-                return jnp.where(f_inf.reshape(shape), jnp.inf, u)
+                if compress_deltas:
+                    space = jax.tree.map(lambda u, p: u - p, updates, params)
+                else:
+                    space = updates
+                if compress == "topk":
+                    # [0] = the sparse tree; the dropped remainder feeds
+                    # error feedback in the DP training path, but per-client
+                    # residuals are deliberately not kept here (see above)
+                    space = jax.vmap(
+                        lambda t: topk_sparsify(t, compress_ratio)[0]
+                    )(space)
+                else:
+                    ckeys = jax.vmap(
+                        lambda kk: jax.random.fold_in(kk, 977)
+                    )(keys_g)
+                    space = jax.vmap(quantize_int8)(space, ckeys)
+                if compress_deltas:
+                    updates = jax.tree.map(
+                        lambda s, p: s + p, space, params
+                    )
+                else:
+                    updates = space
 
-            updates = jax.tree.map(_poison, updates)
+            if fault_plan is not None and fault_plan.corrupts:
+                # corruption lands on the RECEIVED message (post-attack,
+                # post-compression): a broken client's uplink is garbage no
+                # matter what the honest pipeline did to it
+                def _poison(u):
+                    if not jnp.issubdtype(u.dtype, jnp.inexact):
+                        return u
+                    shape = (-1,) + (1,) * (u.ndim - 1)
+                    u = jnp.where(f_nan_g.reshape(shape), jnp.nan, u)
+                    return jnp.where(f_inf_g.reshape(shape), jnp.inf, u)
 
-        if fault_plan is not None:
+                updates = jax.tree.map(_poison, updates)
+            return updates, cs
+
+        def screen_and_stats(updates, f_keep_g, f_nan_g, f_inf_g, f_late_g,
+                             live_g):
+            """Non-finite screen + faulted mask + int32 stats for one group
+            (detects injected corruption AND naturally-diverged clients).
+            Int sums are order-exact, so per-chunk partial stats sum to
+            exactly the stacked round's stats."""
             from ..resilience.guard import tree_client_isfinite
 
-            # detects injected corruption AND naturally-diverged clients
             finite = tree_client_isfinite(updates)
-            faulted = ~f_keep | f_late | ~finite
+            faulted = ~f_keep_g | f_late_g | ~finite
             stats = jnp.stack([
-                jnp.sum(~f_keep & live), jnp.sum(f_late & live),
-                jnp.sum((f_nan | f_inf) & live),
-                jnp.sum(~finite & live),
+                jnp.sum(~f_keep_g & live_g), jnp.sum(f_late_g & live_g),
+                jnp.sum((f_nan_g | f_inf_g) & live_g),
+                jnp.sum(~finite & live_g),
             ]).astype(jnp.int32)
+            return faulted, stats
+
+        def clip_updates(updates):
+            # client-level DP: clip each client's delta from the round-start
+            # params to L2 <= dp_clip; uniform weights (n_k would leak)
+            deltas = jax.tree.map(lambda u, p: u - p, updates, params)
+            sq = sum(
+                jnp.sum(jnp.square(l).reshape(l.shape[0], -1), axis=1)
+                for l in jax.tree.leaves(deltas)
+            )
+            scale = jnp.minimum(
+                1.0, dp_clip / jnp.maximum(jnp.sqrt(sq), 1e-12)
+            )
+            return jax.tree.map(
+                lambda d, p: p + d * scale.reshape(
+                    (-1,) + (1,) * (d.ndim - 1)
+                ),
+                deltas, params,
+            )
+
+        def base_weights(cs_all):
+            """Pre-fault aggregation weights for the full cohort (n_k, or
+            uniform under DP), with the dropout draw + all-dropped
+            fallback.  A cohort-global computation: the streaming path
+            needs the fallback's any()-over-everyone BEFORE the scan."""
+            if dp_clip:
+                w = jnp.where(live, 1.0, 0.0)
+            else:
+                w = jnp.where(live, cs_all.astype(jnp.float32), 0.0)
+            if dropout_rate:
+                survived = (
+                    jax.random.uniform(drop_key, (nr_shard,)) >= dropout_rate
+                )
+                # all-dropped fallback: keep everyone, don't divide by zero
+                survived = jnp.where(
+                    jnp.any(survived & live), survived,
+                    jnp.ones_like(survived),
+                )
+                w = jnp.where(survived, w, 0.0)
+            return w
+
+        def hard_zero(updates, faulted):
+            # zero weight is not enough for non-finite rows: the weighted
+            # mean multiplies BEFORE summing and NaN * 0 is still NaN, so
+            # hard-zero the faulted rows themselves
+            return jax.tree.map(
+                lambda u: jnp.where(
+                    faulted.reshape((-1,) + (1,) * (u.ndim - 1)), 0.0, u
+                ).astype(u.dtype) if jnp.issubdtype(u.dtype, jnp.inexact)
+                else u,
+                updates,
+            )
+
+        def add_dp_noise(aggregate, nr_contributing):
+            if not (dp_clip and dp_noise_mult):
+                return aggregate
+            # Gaussian mechanism on the delta mean: per-coordinate std
+            # noise_mult * sensitivity, sensitivity = clip / #contributors
+            std = dp_noise_mult * dp_clip / nr_contributing
+            leaves, treedef = jax.tree.flatten(aggregate)
+            noisy = [
+                l + std * jax.random.normal(
+                    jax.random.fold_in(noise_key, i), l.shape, l.dtype
+                )
+                for i, l in enumerate(leaves)
+            ]
+            return jax.tree.unflatten(treedef, noisy)
+
+        if chunk is not None and not custom_agg:
+            return _streaming_linear_round(
+                params, sel, keys, mal, live,
+                (f_keep, f_nan, f_inf, f_late), counts, agg_key,
+                client_messages, screen_and_stats, clip_updates,
+                base_weights, hard_zero, add_dp_noise,
+            )
+        if chunk is not None and custom_agg:
+            return _chunked_stack_round(
+                params, sel, keys, mal, live,
+                (f_keep, f_nan, f_inf, f_late), counts, agg_key,
+                client_messages, screen_and_stats,
+            )
+
+        # ---- stacked path (client_chunk = 0, the legacy program) ----
+        updates, cs = client_messages(sel, keys, mal, f_nan, f_inf)
+
+        if fault_plan is not None:
+            faulted, stats = screen_and_stats(
+                updates, f_keep, f_nan, f_inf, f_late, live
+            )
             if custom_agg:
                 # robust aggregators ignore weights, so exclusion must be
                 # by substitution: faulted rows become a no-op update
@@ -537,34 +751,8 @@ def make_fl_round(
                 updates = jax.tree.map(_neutralise, updates, params)
 
         if dp_clip:
-            # client-level DP: clip each client's delta from the round-start
-            # params to L2 <= dp_clip; uniform weights (n_k would leak)
-            deltas = jax.tree.map(lambda u, p: u - p, updates, params)
-            sq = sum(
-                jnp.sum(jnp.square(l).reshape(l.shape[0], -1), axis=1)
-                for l in jax.tree.leaves(deltas)
-            )
-            scale = jnp.minimum(
-                1.0, dp_clip / jnp.maximum(jnp.sqrt(sq), 1e-12)
-            )
-            updates = jax.tree.map(
-                lambda d, p: p + d * scale.reshape(
-                    (-1,) + (1,) * (d.ndim - 1)
-                ),
-                deltas, params,
-            )
-            weights = jnp.where(live, 1.0, 0.0)
-        else:
-            weights = jnp.where(live, cs.astype(jnp.float32), 0.0)
-        if dropout_rate:
-            survived = (
-                jax.random.uniform(drop_key, (nr_shard,)) >= dropout_rate
-            )
-            # all-dropped fallback: keep everyone rather than divide by zero
-            survived = jnp.where(
-                jnp.any(survived & live), survived, jnp.ones_like(survived)
-            )
-            weights = jnp.where(survived, weights, 0.0)
+            updates = clip_updates(updates)
+        weights = base_weights(cs)
         if fault_plan is not None and not custom_agg:
             # zero-weight the faulted set (dropout + deadline stragglers +
             # non-finite screen) and renormalise over the survivors — the
@@ -577,42 +765,232 @@ def make_fl_round(
             # all-faulted round: divide by 1 (weights stay all-zero, the
             # aggregate is zeros) and keep the old params at the end
             weights = weights / jnp.where(any_survivor, wsum, 1.0)
-            # zero weight is not enough for non-finite rows: the weighted
-            # mean multiplies BEFORE summing and NaN * 0 is still NaN, so
-            # hard-zero the faulted rows themselves
-            updates = jax.tree.map(
-                lambda u: jnp.where(
-                    faulted.reshape((-1,) + (1,) * (u.ndim - 1)), 0.0, u
-                ).astype(u.dtype) if jnp.issubdtype(u.dtype, jnp.inexact)
-                else u,
-                updates,
-            )
+            updates = hard_zero(updates, faulted)
         else:
             any_survivor = jnp.bool_(True)
             nr_contributing = jnp.sum(weights > 0)
             weights = weights / jnp.sum(weights)
         aggregate = aggregator(updates, weights, agg_key)
-        if dp_clip and dp_noise_mult:
-            # Gaussian mechanism on the delta mean: per-coordinate std
-            # noise_mult * sensitivity, sensitivity = clip / #contributors
-            std = dp_noise_mult * dp_clip / nr_contributing
-            leaves, treedef = jax.tree.flatten(aggregate)
-            noisy = [
-                l + std * jax.random.normal(
-                    jax.random.fold_in(noise_key, i), l.shape, l.dtype
-                )
-                for i, l in enumerate(leaves)
-            ]
-            aggregate = jax.tree.unflatten(treedef, noisy)
+        aggregate = add_dp_noise(aggregate, nr_contributing)
         if fault_plan is None:
             return apply_aggregate(params, aggregate)
-        from ..utils.trees import tree_select
-
         new_params = apply_aggregate(params, aggregate)
         # degraded-round floor: with zero survivors the aggregate above is
         # zeros — installing it would zero the model, so keep the previous
         # params (static shapes; the host sees it in stats and telemetry)
         return tree_select(any_survivor, new_params, params), stats
+
+    def _streaming_linear_round(params, sel, keys, mal, live, fmasks,
+                                counts, agg_key, client_messages,
+                                screen_and_stats, clip_updates,
+                                base_weights, hard_zero, add_dp_noise):
+        """lax.scan over client chunks with a running weighted-sum
+        accumulator: peak update memory is O(chunk·P) instead of O(m·P).
+        All randomness (sampling, dropout, fault masks, per-client keys) is
+        drawn cohort-globally above and only SLICED here, so the streamed
+        round sees draw-for-draw the stacked round's world; the one change
+        is float summation order (Σ wᵢuᵢ then a single divide, vs the
+        stacked Σ uᵢ·(wᵢ/Σw)) — see tests/test_fl_chunked.py for the
+        tolerance this implies.  Fault stats are int partial sums, exact."""
+        f_keep, f_nan, f_inf, f_late = fmasks
+        nr_chunks = nr_shard // chunk
+
+        def rs(a):
+            return a.reshape((nr_chunks, chunk) + a.shape[1:])
+
+        weights0 = base_weights(jnp.take(counts, sel, axis=0))
+        zb = jnp.zeros((nr_shard,), jnp.bool_)
+        xs_scan = (
+            rs(sel), rs(keys), rs(weights0), rs(live),
+            rs(mal if mal is not None else zb),
+            rs(f_keep if f_keep is not None else zb),
+            rs(f_nan if f_nan is not None else zb),
+            rs(f_inf if f_inf is not None else zb),
+            rs(f_late if f_late is not None else zb),
+        )
+        carry0 = (
+            jax.tree.map(jnp.zeros_like, params),  # Σ wᵢ·uᵢ accumulator
+            jnp.float32(0.0),                      # Σ wᵢ
+            jnp.int32(0),                          # nr_contributing
+            jnp.zeros((4,), jnp.int32),            # fault stats
+        )
+
+        def chunk_body(carry, inp):
+            acc, wsum, nct, stats = carry
+            (sel_c, keys_c, w_c, live_c,
+             mal_c, fk_c, fn_c, fi_c, fl_c) = inp
+            updates, _ = client_messages(sel_c, keys_c, mal_c, fn_c, fi_c)
+            if fault_plan is not None:
+                faulted, stats_c = screen_and_stats(
+                    updates, fk_c, fn_c, fi_c, fl_c, live_c
+                )
+                stats = stats + stats_c
+            if dp_clip:
+                updates = clip_updates(updates)
+            if fault_plan is not None:
+                w_c = jnp.where(faulted, 0.0, w_c)
+                updates = hard_zero(updates, faulted)
+            # tree_weighted_mean with UNNORMALIZED weights is exactly the
+            # chunk's weighted partial sum Σᵢ wᵢ·uᵢ
+            acc = jax.tree.map(
+                jnp.add, acc, tree_weighted_mean(updates, w_c)
+            )
+            return (
+                acc, wsum + jnp.sum(w_c), nct + jnp.sum(w_c > 0), stats
+            ), None
+
+        (acc, wsum, nct, stats), _ = jax.lax.scan(
+            chunk_body, carry0, xs_scan
+        )
+
+        if fault_plan is not None:
+            # all-faulted round: divide by 1 (the accumulator is zeros —
+            # faulted rows were hard-zeroed and zero-weighted) and keep the
+            # old params below, exactly the stacked path's floor
+            any_survivor = wsum > 0
+            denom = jnp.where(any_survivor, wsum, 1.0)
+        else:
+            any_survivor = jnp.bool_(True)
+            denom = wsum
+        aggregate = jax.tree.map(
+            lambda a: (a / denom).astype(a.dtype), acc
+        )
+        aggregate = add_dp_noise(aggregate, nct)
+        if fault_plan is None:
+            return apply_aggregate(params, aggregate)
+        new_params = apply_aggregate(params, aggregate)
+        return tree_select(any_survivor, new_params, params), stats
+
+    def _chunked_stack_round(params, sel, keys, mal, live, fmasks, counts,
+                             agg_key, client_messages, screen_and_stats):
+        """Robust aggregators genuinely need the full [m, D] matrix, so
+        chunking streams the stack CONSTRUCTION instead: per-chunk local
+        training (bounding the backward-pass temporaries to chunk·P) writes
+        rows into a preallocated buffer held in ``robust_stack`` precision —
+        float32, bfloat16 (stack/2), or stochastic int8 (~stack/4, the
+        ``parallel.compress`` scheme, decoded to param dtype right before
+        the aggregator, where XLA fuses the upcast into the distance math
+        where it can).  Faulted rows are neutralised by substitution per
+        chunk, identical to the stacked path."""
+        f_keep, f_nan, f_inf, f_late = fmasks
+        nr_chunks = nr_shard // chunk
+
+        def rs(a):
+            return a.reshape((nr_chunks, chunk) + a.shape[1:])
+
+        # the stacked path's custom-agg weight pipeline (dropout/DP are
+        # rejected with custom aggregators at build time)
+        cs_all = jnp.take(counts, sel, axis=0)
+        weights = jnp.where(live, cs_all.astype(jnp.float32), 0.0)
+        weights = weights / jnp.sum(weights)
+
+        def leaf_buf(p):
+            if robust_stack == "int8" and jnp.issubdtype(
+                    p.dtype, jnp.inexact):
+                return jnp.zeros((nr_shard,) + p.shape, jnp.int8)
+            if robust_stack == "bfloat16" and jnp.issubdtype(
+                    p.dtype, jnp.inexact):
+                return jnp.zeros((nr_shard,) + p.shape, jnp.bfloat16)
+            return jnp.zeros((nr_shard,) + p.shape, p.dtype)
+
+        bufs0 = jax.tree.map(leaf_buf, params)
+        # per-(client, leaf) dequantization scales; dummy zeros when unused
+        scales0 = jax.tree.map(
+            lambda p: jnp.zeros((nr_shard,), jnp.float32), params
+        )
+        zb = jnp.zeros((nr_shard,), jnp.bool_)
+        xs_scan = (
+            jnp.arange(nr_chunks), rs(sel), rs(keys),
+            rs(mal if mal is not None else zb),
+            rs(f_keep if f_keep is not None else zb),
+            rs(f_nan if f_nan is not None else zb),
+            rs(f_inf if f_inf is not None else zb),
+            rs(f_late if f_late is not None else zb),
+            rs(live),
+        )
+
+        def chunk_body(carry, inp):
+            bufs, scales, stats = carry
+            ci, sel_c, keys_c, mal_c, fk_c, fn_c, fi_c, fl_c, live_c = inp
+            updates, _ = client_messages(sel_c, keys_c, mal_c, fn_c, fi_c)
+            if fault_plan is not None:
+                faulted, stats_c = screen_and_stats(
+                    updates, fk_c, fn_c, fi_c, fl_c, live_c
+                )
+                stats = stats + stats_c
+
+                # substitution-neutralisation, as on the stacked path
+                def _neutralise(u, p):
+                    if not jnp.issubdtype(u.dtype, jnp.inexact):
+                        return u
+                    shape = (-1,) + (1,) * (u.ndim - 1)
+                    neutral = p if compress_deltas else jnp.zeros_like(p)
+                    return jnp.where(faulted.reshape(shape), neutral, u)
+
+                updates = jax.tree.map(_neutralise, updates, params)
+            start = ci * chunk
+            if robust_stack == "int8":
+                from ..parallel.compress import int8_encode
+
+                enc_keys = jax.vmap(
+                    lambda kk: jax.random.fold_in(kk, 1031)
+                )(keys_c)
+                q_c, s_c = jax.vmap(int8_encode)(updates, enc_keys)
+                bufs = jax.tree.map(
+                    lambda b, q: jax.lax.dynamic_update_slice_in_dim(
+                        b, q.astype(b.dtype), start, 0
+                    ), bufs, q_c,
+                )
+                scales = jax.tree.map(
+                    lambda b, s: jax.lax.dynamic_update_slice_in_dim(
+                        b, s.astype(jnp.float32), start, 0
+                    ), scales, s_c,
+                )
+            else:
+                bufs = jax.tree.map(
+                    lambda b, u: jax.lax.dynamic_update_slice_in_dim(
+                        b, u.astype(b.dtype), start, 0
+                    ), bufs, updates,
+                )
+            return (bufs, scales, stats), None
+
+        (bufs, scales, stats), _ = jax.lax.scan(
+            chunk_body,
+            (bufs0, scales0, jnp.zeros((4,), jnp.int32)),
+            xs_scan,
+        )
+
+        if robust_stack == "int8":
+            stacked = jax.tree.map(
+                lambda q, s, p: (
+                    q.astype(p.dtype)
+                    * s.reshape((-1,) + (1,) * (q.ndim - 1)).astype(p.dtype)
+                    if q.dtype == jnp.int8 else q
+                ),
+                bufs, scales, params,
+            )
+        else:
+            stacked = bufs
+        aggregate = aggregator(stacked, weights, agg_key)
+        # a reduced-precision stack yields a reduced/mixed-precision
+        # aggregate; install it in param dtype
+        aggregate = jax.tree.map(
+            lambda a, p: a.astype(p.dtype), aggregate, params
+        )
+        new_params = apply_aggregate(params, aggregate)
+        if fault_plan is None:
+            return new_params
+        return new_params, stats
+
+    # stack geometry for the peak-update-bytes gauge: the streaming linear
+    # path holds chunk rows (accumulator is 1 extra row); the chunked
+    # robust build holds the full cohort at robust_stack precision; the
+    # stacked path holds the full cohort at param precision
+    stack_rows = chunk if (chunk is not None and not custom_agg) else nr_shard
+    stack_shrink = (
+        {"float32": 1, "bfloat16": 2, "int8": 4}[robust_stack]
+        if (chunk is not None and custom_agg) else 1
+    )
 
     def round_fn(params, base_key, round_idx):
         # telemetry wraps the DISPATCH boundary only; under an outer
@@ -635,6 +1013,13 @@ def make_fl_round(
             _obs_round_faults(stats)
         else:
             new_params = out
+        # round memory model (docs/PERFORMANCE.md): the update stack is
+        # rows x |params| at the stack precision — the term client_chunk
+        # converts from O(cohort) to O(chunk)
+        obs.set_gauge(
+            "fl_update_stack_bytes",
+            stack_rows * (_tree_bytes(new_params) // stack_shrink),
+        )
         obs.inc("fl_rounds_total")
         obs.inc("fl_clients_sampled_total", nr_sampled)
         obs.set_gauge("fl_clients_per_round", nr_sampled)
@@ -655,6 +1040,12 @@ def make_fl_round(
     # (params, stats) — fused callers keep [0] as the loop carry.
     round_fn.raw = _round
     round_fn.data = (x, y, counts, mal_mask)
+    # the RESOLVED chunk (None = stacked): tests and bench read this to see
+    # what _resolve_chunk actually picked after divisor/mesh rounding;
+    # nr_sampled is the (mesh-padded) per-round cohort the stacked path
+    # would materialize — tools/mem_estimate.py's stack-rows denominator
+    round_fn.client_chunk = chunk
+    round_fn.nr_sampled = nr_shard
     return round_fn
 
 

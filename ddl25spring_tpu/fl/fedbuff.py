@@ -26,12 +26,16 @@ the tick reduces EXACTLY to a synchronous FedAvg round (the oracle
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from .. import obs
 from ..utils.trees import tree_weighted_mean
-from .engine import _obs_round_faults, _tree_bytes, sample_clients
+from .engine import (_obs_round_faults, _resolve_chunk, _tree_bytes,
+                     donation_safe,
+                     sample_clients)
 from .servers import DecentralizedServer as _DecentralizedServer
 
 
@@ -46,6 +50,8 @@ def make_fedbuff_round(
     server_eta: float = 1.0,
     fault_plan=None,
     round_deadline_s: float | None = None,
+    client_chunk: int = 0,
+    donate: bool = False,
 ):
     """Build ``tick(history, base_key, tick_idx) -> history`` where
     ``history`` is the params pytree with a leading ``staleness_window``
@@ -59,7 +65,16 @@ def make_fedbuff_round(
     delta (params carry over unchanged — the async analogue of a degraded
     round).  No plan -> the exact fault-free program (the W=1 FedAvg
     oracle keeps pinning it).
-    """
+
+    ``client_chunk > 0`` streams the tick the same way as
+    ``engine.make_fl_round``: a ``lax.scan`` over client chunks folds each
+    chunk's staleness-weighted delta sum into a fixed-size accumulator
+    (O(chunk·P) peak update memory).  Sampling, staleness draws and fault
+    masks stay cohort-global, fault stats are exact int partial sums, and
+    ``client_chunk = 0`` IS the stacked program.  ``donate = True``
+    donates the history argument of the jitted tick (the caller must not
+    reuse the history it passed in; the server reassignment pattern is
+    safe, async checkpointers are not)."""
     if staleness_window < 1:
         raise ValueError(f"staleness_window must be >= 1, got {staleness_window}")
     if round_deadline_s is not None and round_deadline_s <= 0:
@@ -73,12 +88,15 @@ def make_fedbuff_round(
     counts = jnp.asarray(counts)
     nr_clients = x.shape[0]
     W = staleness_window
+    chunk = _resolve_chunk(client_chunk, nr_sampled)
 
     # client data enters as ARGUMENTS, not closure captures (see
     # engine.make_fl_round: captured arrays are baked into the HLO as
     # constants — slow compiles, and a compile-upload failure on
     # remote-compile TPU frontends for CIFAR-sized client stacks)
-    @jax.jit
+    @functools.partial(
+        jax.jit, donate_argnums=donation_safe((0,) if donate else ())
+    )
     def _tick(history, base_key, tick_idx, x, y, counts):
         round_key = jax.random.fold_in(base_key, tick_idx)
         # same split arity as engine.make_fl_round so the W=1 oracle samples
@@ -91,53 +109,50 @@ def make_fedbuff_round(
             if W == 1
             else jax.random.randint(stale_key, (nr_sampled,), 0, W)
         )
-
-        xs = jnp.take(x, sel, axis=0)
-        ys = jnp.take(y, sel, axis=0)
-        cs = jnp.take(counts, sel, axis=0)
         keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(sel)
-
-        def one_client(d, x_i, y_i, c_i, k_i):
-            base = jax.tree.map(lambda h: h[d], history)
-            local = client_update(base, x_i, y_i, c_i, k_i)
-            return jax.tree.map(jnp.subtract, local, base)
-
-        deltas = jax.vmap(one_client)(stale, xs, ys, cs, keys)
-
-        if fault_plan is not None and fault_plan.corrupts:
-            _, f_nan, f_inf, _ = fault_plan.round_masks(
-                tick_idx, nr_sampled, round_deadline_s
-            )
-
-            def _poison(d):
-                if not jnp.issubdtype(d.dtype, jnp.inexact):
-                    return d
-                shape = (-1,) + (1,) * (d.ndim - 1)
-                d = jnp.where(f_nan.reshape(shape), jnp.nan, d)
-                return jnp.where(f_inf.reshape(shape), jnp.inf, d)
-
-            deltas = jax.tree.map(_poison, deltas)
-
-        weights = cs.astype(jnp.float32) / (1.0 + stale.astype(jnp.float32)) ** staleness_exp
         if fault_plan is not None:
-            from ..resilience.guard import tree_client_isfinite
-
             f_keep, f_nan, f_inf, f_late = fault_plan.round_masks(
                 tick_idx, nr_sampled, round_deadline_s
             )
+        else:
+            f_keep = f_nan = f_inf = f_late = None
+
+        def chunk_deltas(stale_g, sel_g, keys_g, f_nan_g, f_inf_g):
+            """Deltas + fault corruption for one group of sampled clients
+            (the whole sample on the stacked path, one chunk when
+            streaming) — shared so the two paths cannot drift."""
+            xs = jnp.take(x, sel_g, axis=0)
+            ys = jnp.take(y, sel_g, axis=0)
+            cs = jnp.take(counts, sel_g, axis=0)
+
+            def one_client(d, x_i, y_i, c_i, k_i):
+                base = jax.tree.map(lambda h: h[d], history)
+                local = client_update(base, x_i, y_i, c_i, k_i)
+                return jax.tree.map(jnp.subtract, local, base)
+
+            deltas = jax.vmap(one_client)(stale_g, xs, ys, cs, keys_g)
+
+            if fault_plan is not None and fault_plan.corrupts:
+                def _poison(d):
+                    if not jnp.issubdtype(d.dtype, jnp.inexact):
+                        return d
+                    shape = (-1,) + (1,) * (d.ndim - 1)
+                    d = jnp.where(f_nan_g.reshape(shape), jnp.nan, d)
+                    return jnp.where(f_inf_g.reshape(shape), jnp.inf, d)
+
+                deltas = jax.tree.map(_poison, deltas)
+            return deltas
+
+        def screen(deltas, f_keep_g, f_nan_g, f_inf_g, f_late_g):
+            from ..resilience.guard import tree_client_isfinite
+
             finite = tree_client_isfinite(deltas)
-            faulted = ~f_keep | f_late | ~finite
+            faulted = ~f_keep_g | f_late_g | ~finite
             stats = jnp.stack([
-                jnp.sum(~f_keep), jnp.sum(f_late),
-                jnp.sum(f_nan | f_inf), jnp.sum(~finite),
+                jnp.sum(~f_keep_g), jnp.sum(f_late_g),
+                jnp.sum(f_nan_g | f_inf_g), jnp.sum(~finite),
             ]).astype(jnp.int32)
-            # zero-weight + renormalise over survivors; an all-faulted
-            # tick divides by 1 and applies a ZERO delta (params carry
-            # over — the buffer simply had nothing trustworthy in it)
-            weights = jnp.where(faulted, 0.0, weights)
-            wsum = jnp.sum(weights)
-            weights = weights / jnp.where(wsum > 0, wsum, 1.0)
-            # faulted rows may hold NaN/Inf; tree_weighted_mean multiplies
+            # faulted rows may hold NaN/Inf; the weighted sum multiplies
             # before summing and NaN * 0 is still NaN, so hard-zero them
             deltas = jax.tree.map(
                 lambda d: jnp.where(
@@ -146,9 +161,73 @@ def make_fedbuff_round(
                 else d,
                 deltas,
             )
+            return deltas, faulted, stats
+
+        # staleness-decayed base weights, cohort-global either way
+        cs_all = jnp.take(counts, sel, axis=0)
+        weights = (
+            cs_all.astype(jnp.float32)
+            / (1.0 + stale.astype(jnp.float32)) ** staleness_exp
+        )
+
+        if chunk is not None:
+            # streaming tick: scan over chunks, folding each chunk's
+            # weighted delta sum into a fixed-size accumulator (the
+            # engine's O(chunk·P) recipe; single renormalisation below)
+            nr_chunks = nr_sampled // chunk
+
+            def rs(a):
+                return a.reshape((nr_chunks, chunk) + a.shape[1:])
+
+            zb = jnp.zeros((nr_sampled,), jnp.bool_)
+            xs_scan = (
+                rs(stale), rs(sel), rs(keys), rs(weights),
+                rs(f_keep if f_keep is not None else zb),
+                rs(f_nan if f_nan is not None else zb),
+                rs(f_inf if f_inf is not None else zb),
+                rs(f_late if f_late is not None else zb),
+            )
+            current = jax.tree.map(lambda h: h[0], history)
+            carry0 = (
+                jax.tree.map(jnp.zeros_like, current),
+                jnp.float32(0.0),
+                jnp.zeros((4,), jnp.int32),
+            )
+
+            def body(carry, inp):
+                acc, wsum, stats = carry
+                stale_c, sel_c, keys_c, w_c, fk_c, fn_c, fi_c, fl_c = inp
+                deltas = chunk_deltas(stale_c, sel_c, keys_c, fn_c, fi_c)
+                if fault_plan is not None:
+                    deltas, faulted, stats_c = screen(
+                        deltas, fk_c, fn_c, fi_c, fl_c
+                    )
+                    stats = stats + stats_c
+                    w_c = jnp.where(faulted, 0.0, w_c)
+                acc = jax.tree.map(
+                    jnp.add, acc, tree_weighted_mean(deltas, w_c)
+                )
+                return (acc, wsum + jnp.sum(w_c), stats), None
+
+            (acc, wsum, stats), _ = jax.lax.scan(body, carry0, xs_scan)
+            denom = jnp.where(wsum > 0, wsum, 1.0) \
+                if fault_plan is not None else wsum
+            delta = jax.tree.map(lambda a: (a / denom).astype(a.dtype), acc)
         else:
-            weights = weights / jnp.sum(weights)
-        delta = tree_weighted_mean(deltas, weights)
+            deltas = chunk_deltas(stale, sel, keys, f_nan, f_inf)
+            if fault_plan is not None:
+                # zero-weight + renormalise over survivors; an all-faulted
+                # tick divides by 1 and applies a ZERO delta (params carry
+                # over — the buffer simply had nothing trustworthy in it)
+                deltas, faulted, stats = screen(
+                    deltas, f_keep, f_nan, f_inf, f_late
+                )
+                weights = jnp.where(faulted, 0.0, weights)
+                wsum = jnp.sum(weights)
+                weights = weights / jnp.where(wsum > 0, wsum, 1.0)
+            else:
+                weights = weights / jnp.sum(weights)
+            delta = tree_weighted_mean(deltas, weights)
 
         current = jax.tree.map(lambda h: h[0], history)
         new = jax.tree.map(lambda p, d: p + server_eta * d, current, delta)
@@ -217,7 +296,8 @@ class FedBuffServer(_DecentralizedServer):
                  client_fraction: float, nr_local_epochs: int, seed: int,
                  staleness_window: int = 4, staleness_exp: float = 0.5,
                  server_eta: float = 1.0, fault_plan=None,
-                 round_deadline_s: float | None = None):
+                 round_deadline_s: float | None = None,
+                 client_chunk: int = 0, donate: bool = False):
         from .engine import make_local_sgd_update
 
         super().__init__(task, lr, batch_size, client_data, client_fraction,
@@ -233,6 +313,7 @@ class FedBuffServer(_DecentralizedServer):
             staleness_window=staleness_window,
             staleness_exp=staleness_exp, server_eta=server_eta,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
+            client_chunk=client_chunk, donate=donate,
         )
         self.params = init_history(self.params, staleness_window)
         # evaluate the CURRENT version of the stacked history

@@ -39,7 +39,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .engine import run_local_sgd, sample_clients
+from .engine import (_resolve_chunk, donation_safe, run_local_sgd,
+                     sample_clients)
 from .servers import DecentralizedServer
 
 
@@ -63,6 +64,7 @@ def make_scaffold_round(
     mesh=None,
     clients_axis: str = "clients",
     unroll_threshold: int | None = None,
+    client_chunk: int = 0,
 ):
     """Build ``round(params, c, ci, base_key, round_idx) -> (params, c, ci)``.
 
@@ -70,6 +72,14 @@ def make_scaffold_round(
     loss; ``x/y/counts`` the stacked padded client datasets
     (``data.stack_client_datasets(..., pad_multiple=batch_size)``);
     ``ci`` the stacked (N,)-leading client-control pytree.
+
+    ``client_chunk > 0`` streams the round (engine.make_fl_round's recipe):
+    a ``lax.scan`` over client chunks accumulates the Σ(y_k − params) and
+    Σ(ci' − ci) control-variate sums in fixed-size accumulators and
+    scatters each chunk's ``ci'`` rows in place, so peak per-round update
+    memory is O(chunk·P) on top of the (unavoidable, donated) stacked
+    ``ci``.  Sampling and per-client keys stay cohort-global; the only
+    deviation from the stacked round is float summation order.
     """
     if unroll_threshold is None:
         unroll_threshold = 32 if jax.default_backend() == "cpu" else 0
@@ -122,9 +132,17 @@ def make_scaffold_round(
     # docstring's 11 GB at north-star scale) and only the sampled m rows
     # change — donation lets XLA scatter in place instead of holding
     # input+output copies.  Callers must not retain a reference to the
-    # ci they pass in (on TPU the buffer is invalidated; the server's
-    # self.ci reassignment pattern is safe, CPU ignores donation).
-    @functools.partial(jax.jit, donate_argnums=(2,))
+    # ci they pass in (the buffer is invalidated; the server's self.ci
+    # reassignment pattern is safe).  donation_safe drops the donation
+    # when a persistent compilation cache is configured: a cache-hit
+    # executable can reorder the in-place ci scatter before the gather
+    # of the old rows (see engine.donation_safe for the bisection).
+    chunk = _resolve_chunk(
+        client_chunk, nr_sampled,
+        mesh.shape[clients_axis] if mesh is not None else 1,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=donation_safe((2,)))
     def _round(params, c, ci, base_key, round_idx, x, y, counts):
         # same key chain as engine.make_fl_round (sample_key = first of the
         # 4-way split; per-client key = fold_in(round_key, client_id)), so a
@@ -137,29 +155,72 @@ def make_scaffold_round(
             lambda i: jax.random.fold_in(round_key, i)
         )(idx)
 
-        gather = lambda t: constrain(
-            jax.tree.map(lambda a: jnp.take(a, idx, axis=0), t)
-        )
-        x_s = constrain(jnp.take(x, idx, axis=0))
-        y_s = constrain(jnp.take(y, idx, axis=0))
-        counts_s = constrain(jnp.take(counts, idx, axis=0))
-        ci_s = gather(ci)
+        def chunk_updates(idx_g, keys_g, ci_src):
+            """Vmapped corrected local SGD + control update for one group
+            of sampled clients (whole sample, or one chunk)."""
+            x_g = constrain(jnp.take(x, idx_g, axis=0))
+            y_g = constrain(jnp.take(y, idx_g, axis=0))
+            counts_g = constrain(jnp.take(counts, idx_g, axis=0))
+            ci_g = constrain(
+                jax.tree.map(lambda a: jnp.take(a, idx_g, axis=0), ci_src)
+            )
+            y_k, ci_new = jax.vmap(
+                local_update, in_axes=(None, None, 0, 0, 0, 0, 0)
+            )(params, c, ci_g, x_g, y_g, counts_g, keys_g)
+            return constrain(y_k), constrain(ci_new), ci_g
 
-        y_k, ci_new = jax.vmap(
-            local_update, in_axes=(None, None, 0, 0, 0, 0, 0)
-        )(params, c, ci_s, x_s, y_s, counts_s, keys)
-        y_k, ci_new = constrain(y_k), constrain(ci_new)
+        if chunk is not None:
+            # streaming round: accumulate the two control-variate sums in
+            # fixed-size accumulators, scatter each chunk's ci' in place
+            nr_chunks = nr_sampled // chunk
 
-        dx = _tree_mean(jax.tree.map(lambda yk, p: yk - p, y_k, params))
-        dc = _tree_mean(jax.tree.map(lambda n, o: n - o, ci_new, ci_s))
+            def rs(a):
+                return a.reshape((nr_chunks, chunk) + a.shape[1:])
+
+            carry0 = (
+                jax.tree.map(jnp.zeros_like, params),  # Σ (y_k − params)
+                jax.tree.map(jnp.zeros_like, params),  # Σ (ci' − ci)
+                ci,
+            )
+
+            def body(carry, inp):
+                dx_acc, dc_acc, ci_full = carry
+                idx_c, keys_c = inp
+                # sampling is without replacement, so gathering each
+                # chunk's controls from the progressively-scattered carry
+                # (not a second captured copy of ci) reads pristine rows
+                y_k, ci_new, ci_g = chunk_updates(idx_c, keys_c, ci_full)
+                dx_acc = jax.tree.map(
+                    lambda a, yk, p: a + jnp.sum(yk - p[None], axis=0),
+                    dx_acc, y_k, params,
+                )
+                dc_acc = jax.tree.map(
+                    lambda a, n, o: a + jnp.sum(n - o, axis=0),
+                    dc_acc, ci_new, ci_g,
+                )
+                ci_full = jax.tree.map(
+                    lambda full, new: full.at[idx_c].set(new),
+                    ci_full, ci_new,
+                )
+                return (dx_acc, dc_acc, ci_full), None
+
+            (dx_acc, dc_acc, ci), _ = jax.lax.scan(
+                body, carry0, (rs(idx), rs(keys))
+            )
+            dx = jax.tree.map(lambda a: a / nr_sampled, dx_acc)
+            dc = jax.tree.map(lambda a: a / nr_sampled, dc_acc)
+        else:
+            y_k, ci_new, ci_s = chunk_updates(idx, keys, ci)
+            dx = _tree_mean(jax.tree.map(lambda yk, p: yk - p, y_k, params))
+            dc = _tree_mean(jax.tree.map(lambda n, o: n - o, ci_new, ci_s))
+            ci = jax.tree.map(
+                lambda full, new: full.at[idx].set(new), ci, ci_new
+            )
         params = jax.tree.map(
             lambda p, d: p + server_lr * d, params, dx
         )
         c = jax.tree.map(
             lambda c_l, d: c_l + (nr_sampled / nr_clients) * d, c, dc
-        )
-        ci = jax.tree.map(
-            lambda full, new: full.at[idx].set(new), ci, ci_new
         )
         return params, c, ci
 
@@ -183,7 +244,7 @@ class ScaffoldServer(DecentralizedServer):
 
     def __init__(self, task, lr: float, batch_size: int, client_data,
                  client_fraction: float, nr_local_epochs: int, seed: int,
-                 server_lr: float = 1.0, mesh=None):
+                 server_lr: float = 1.0, mesh=None, client_chunk: int = 0):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         self.algorithm = "SCAFFOLD"
@@ -199,6 +260,7 @@ class ScaffoldServer(DecentralizedServer):
             task.loss_fn, lr, batch_size, nr_local_epochs,
             client_data.x, client_data.y, client_data.counts,
             self.nr_clients_per_round, server_lr=server_lr, mesh=mesh,
+            client_chunk=client_chunk,
         )
 
     def extra_state(self):

@@ -176,7 +176,9 @@ class FedSgdGradientServer(DecentralizedServer):
                  client_fraction: float, seed: int,
                  aggregator=None, attack=None, malicious_mask=None, mesh=None,
                  compress: str = "none", compress_ratio: float = 0.01,
-                 fault_plan=None, round_deadline_s: float | None = None):
+                 fault_plan=None, round_deadline_s: float | None = None,
+                 client_chunk: int = 0, donate: bool = False,
+                 robust_stack: str = "float32"):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDGradient"
@@ -196,6 +198,8 @@ class FedSgdGradientServer(DecentralizedServer):
             compress=compress, compress_ratio=compress_ratio,
             compress_deltas=False,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
+            client_chunk=client_chunk, donate=donate,
+            robust_stack=robust_stack,
         )
 
 
@@ -208,7 +212,9 @@ class FedSgdWeightServer(DecentralizedServer):
     def __init__(self, task: Task, lr: float, client_data: ClientDatasets,
                  client_fraction: float, seed: int,
                  aggregator=None, attack=None, malicious_mask=None, mesh=None,
-                 fault_plan=None, round_deadline_s: float | None = None):
+                 fault_plan=None, round_deadline_s: float | None = None,
+                 client_chunk: int = 0, donate: bool = False,
+                 robust_stack: str = "float32"):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDWeight"
@@ -221,6 +227,8 @@ class FedSgdWeightServer(DecentralizedServer):
             attack=attack, malicious_mask=malicious_mask,
             mesh=mesh,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
+            client_chunk=client_chunk, donate=donate,
+            robust_stack=robust_stack,
         )
 
 
@@ -243,7 +251,9 @@ class FedAvgServer(DecentralizedServer):
                  prox_mu: float = 0.0, dropout_rate: float = 0.0,
                  dp_clip: float = 0.0, dp_noise_mult: float = 0.0,
                  compress: str = "none", compress_ratio: float = 0.01,
-                 fault_plan=None, round_deadline_s: float | None = None):
+                 fault_plan=None, round_deadline_s: float | None = None,
+                 client_chunk: int = 0, donate: bool = False,
+                 robust_stack: str = "float32"):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         self.algorithm = "FedAvg" if prox_mu == 0.0 else "FedProx"
@@ -265,6 +275,8 @@ class FedAvgServer(DecentralizedServer):
             compress=compress, compress_ratio=compress_ratio,
             compress_deltas=True,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
+            client_chunk=client_chunk, donate=donate,
+            robust_stack=robust_stack,
         )
 
 
@@ -289,7 +301,8 @@ class FedOptServer(DecentralizedServer):
                  server_optimizer: str = "adam", server_lr: float = 1e-2,
                  aggregator=None, attack=None, malicious_mask=None, mesh=None,
                  prox_mu: float = 0.0, dropout_rate: float = 0.0,
-                 fault_plan=None, round_deadline_s: float | None = None):
+                 fault_plan=None, round_deadline_s: float | None = None,
+                 client_chunk: int = 0, robust_stack: str = "float32"):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         if server_optimizer not in self.OPTIMIZERS:
@@ -324,6 +337,10 @@ class FedOptServer(DecentralizedServer):
             attack=attack, malicious_mask=malicious_mask,
             mesh=mesh, dropout_rate=dropout_rate,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
+            # no donate here: round_fn below reuses params after the
+            # aggregate (server_step takes the same buffer) — donating it
+            # would hand XLA a buffer the next line still reads
+            client_chunk=client_chunk, robust_stack=robust_stack,
         )
 
         @jax.jit

@@ -17,6 +17,16 @@ Three feeds, all landing in the active obs registry:
   crossing ``retrace_threshold`` compiles emits a ``watchdog.retrace``
   event and bumps ``watchdog_retrace_warnings_total{fun=...}`` (the
   classic silent-retrace-per-step failure made loud);
+* **persistent-cache effectiveness** — ``utils/platform.py`` points
+  ``jax_compilation_cache_dir`` at a persistent cache, but whether it
+  actually HITS was invisible; event listeners on
+  ``/jax/compilation_cache/compile_requests_use_cache`` /
+  ``cache_hits`` / ``compile_time_saved_sec`` feed
+  ``jax_compile_cache_requests_total`` / ``jax_compile_cache_hits_total``
+  / the ``jax_compile_cache_saved_seconds`` histogram (misses =
+  requests − hits;
+  jax emits no miss event), so obs_report can show cold-vs-warm compile
+  cost per run;
 * **memory gauges** — a span-exit hook samples
   ``device.memory_stats()`` (rate-limited, skipped gracefully on
   backends like CPU that return None) into
@@ -44,6 +54,12 @@ _EVENT_KINDS = {
     "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
     "/jax/core/compile/backend_compile_duration": "compile",
 }
+
+# persistent-compilation-cache events (jax emits no explicit miss — a miss
+# is a use_cache request without a matching hit)
+_CACHE_REQUEST_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_SAVED_EVENT = "/jax/compilation_cache/compile_time_saved_sec"
 
 # "Finished XLA compilation of jit(train_step) in 0.42 sec"
 _COMPILE_MSG = re.compile(r"Finished XLA compilation of (.+?) in ")
@@ -81,14 +97,32 @@ class _CompileLogHandler(logging.Handler):
 
 
 def _on_duration(event, duration, **_kw):
+    from ddl25spring_tpu import obs
+    if event == _CACHE_SAVED_EVENT:
+        if obs.enabled():
+            # histogram, not counter: jax reports NEGATIVE savings when
+            # retrieving a tiny program from the cache cost more than
+            # recompiling it would have — the report sums the histogram
+            obs.observe("jax_compile_cache_saved_seconds", duration)
+        return
     kind = _EVENT_KINDS.get(event)
     if kind is None:
         return
-    from ddl25spring_tpu import obs
     if not obs.enabled():
         return
     obs.inc("jax_compilations_total", kind=kind)
     obs.observe("jax_compile_seconds", duration, kind=kind)
+
+
+def _on_event(event, **_kw):
+    if event not in (_CACHE_REQUEST_EVENT, _CACHE_HIT_EVENT):
+        return
+    from ddl25spring_tpu import obs
+    if not obs.enabled():
+        return
+    obs.inc("jax_compile_cache_requests_total"
+            if event == _CACHE_REQUEST_EVENT
+            else "jax_compile_cache_hits_total")
 
 
 def _make_memory_hook(min_interval_s: float):
@@ -138,6 +172,7 @@ def install(retrace_threshold: int = 2, *, memory: bool = True,
     # across install/uninstall cycles to avoid double counting
     if not _duration_registered:
         monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
         _duration_registered = True
 
     handler = _CompileLogHandler(retrace_threshold)

@@ -56,24 +56,61 @@ def topk_sparsify(tree, ratio: float):
             jax.tree.map(lambda p: p[1], pairs, is_leaf=lambda x: isinstance(x, tuple)))
 
 
-def quantize_int8(tree, key):
-    """Stochastically round each leaf to int8 on a per-tensor symmetric
-    scale; returns the dequantized tree (unbiased: E[q(x)] == x)."""
+def int8_encode(tree, key):
+    """Stochastically round each inexact leaf to int8 on a per-tensor
+    symmetric scale (QSGD-style, unbiased).  Returns ``(q_tree, scale_tree)``
+    where ``q_tree`` holds int8 leaves and ``scale_tree`` the matching f32
+    scalar scales — the STORED form, 1/4 the bytes of an f32 leaf, which is
+    what lets the FL engine hold a whole robust-aggregation update stack in
+    int8 (``make_fl_round(robust_stack='int8')``).  Non-inexact leaves pass
+    through unchanged with a unit scale."""
 
     def one(leaf, k):
+        if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+            return leaf, jnp.float32(1.0)
         scale = jnp.maximum(jnp.max(jnp.abs(leaf)), 1e-12) / 127.0
         scaled = leaf / scale
         low = jnp.floor(scaled)
         p_up = scaled - low
         up = jax.random.uniform(k, leaf.shape) < p_up
         q = jnp.clip(low + up, -127, 127).astype(jnp.int8)
-        return q.astype(leaf.dtype) * scale
+        return q, scale.astype(jnp.float32)
 
     leaves, treedef = jax.tree.flatten(tree)
     keys = jax.random.split(key, len(leaves))
-    return jax.tree.unflatten(
-        treedef, [one(l, k) for l, k in zip(leaves, keys)]
+    enc = [one(l, k) for l, k in zip(leaves, keys)]
+    return (
+        jax.tree.unflatten(treedef, [q for q, _ in enc]),
+        jax.tree.unflatten(treedef, [s for _, s in enc]),
     )
+
+
+def int8_decode(q_tree, scale_tree, like=None):
+    """Inverse of :func:`int8_encode`: dequantize int8 leaves (pass-through
+    leaves come back untouched).  ``like`` is a template pytree supplying
+    the output dtype per leaf (e.g. the params the updates were computed
+    from); without it, int8 leaves dequantize as ``scale.dtype * q``
+    (f32)."""
+    if like is None:
+        like = scale_tree
+
+    def one(q, s, l):
+        if q.dtype != jnp.int8:
+            return q
+        return q.astype(l.dtype) * s.astype(l.dtype)
+
+    return jax.tree.map(one, q_tree, scale_tree, like)
+
+
+def quantize_int8(tree, key):
+    """Stochastically round each leaf to int8 on a per-tensor symmetric
+    scale; returns the dequantized tree (unbiased: E[q(x)] == x).  The
+    immediate encode/decode round-trip models the WIRE effect of int8
+    uplink compression; callers that want to *store* the compressed form
+    (the FL engine's robust-aggregation stack) use :func:`int8_encode` /
+    :func:`int8_decode` directly."""
+    q, s = int8_encode(tree, key)
+    return int8_decode(q, s, like=tree)
 
 
 def init_compression_state(params, mesh, axis: str = "data"):

@@ -39,6 +39,11 @@ def _stack_to_matrix(stacked):
     leaves = jax.tree.leaves(stacked)
     m = leaves[0].shape[0]
     mat = jnp.concatenate([leaf.reshape(m, -1) for leaf in leaves], axis=1)
+    if mat.dtype in (jnp.bfloat16, jnp.float16):
+        # reduced-precision update stacks (make_fl_round robust_stack=
+        # 'bfloat16') are a storage format only — pairwise distances and
+        # sorted means accumulate in f32 or selection becomes tie-unstable
+        mat = mat.astype(jnp.float32)
 
     treedef = jax.tree.structure(stacked)
     shapes = [leaf.shape[1:] for leaf in leaves]

@@ -158,6 +158,7 @@ def build_server(cfg: HflConfig):
             staleness_window=cfg.staleness_window,
             staleness_exp=cfg.staleness_exp, server_eta=cfg.server_eta,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
+            client_chunk=cfg.client_chunk,
         )
 
     if cfg.algorithm == "scaffold":
@@ -176,6 +177,7 @@ def build_server(cfg: HflConfig):
             task, cfg.lr, cfg.batch_size, client_data, cfg.client_fraction,
             cfg.nr_local_epochs, cfg.seed,
             server_lr=cfg.scaffold_server_lr,
+            client_chunk=cfg.client_chunk,
         )
 
     pad = cfg.batch_size if cfg.algorithm in ("fedavg", "fedprox", "fedopt") else 1
@@ -201,10 +203,14 @@ def build_server(cfg: HflConfig):
     # sampled clients as devices — below that, padding wastes compute
     mesh = (make_mesh({"clients": nr_devices})
             if nr_devices > 1 and clients_per_round >= nr_devices else None)
+    # donate stays off here: the async checkpointer (on_round) holds a live
+    # reference to server.params across the next round's dispatch — donating
+    # it would let XLA overwrite a buffer the save is still serializing
     kw = dict(aggregator=build_aggregator(cfg), attack=attack,
               malicious_mask=malicious if attack is not None else None,
               mesh=mesh, fault_plan=fault_plan,
-              round_deadline_s=round_deadline_s)
+              round_deadline_s=round_deadline_s,
+              client_chunk=cfg.client_chunk, robust_stack=cfg.robust_stack)
     if cfg.algorithm == "fedsgd":
         return FedSgdGradientServer(task, cfg.lr, client_data,
                                     cfg.client_fraction, cfg.seed,

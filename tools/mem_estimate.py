@@ -1,0 +1,176 @@
+"""AOT peak-memory estimate of the FL round across client-chunk sizes.
+
+The streaming round (``make_fl_round(client_chunk=...)``,
+docs/PERFORMANCE.md) exists to convert per-round update memory from
+O(cohort·P) to O(chunk·P).  This tool makes that win CHECKABLE without a
+live TPU: it AOT-compiles the same jitted round at several chunk sizes and
+reports XLA's ``memory_analysis()`` — peak temp bytes, argument/output
+bytes — next to the analytic update-stack bytes (rows × |params|).
+
+Two compile targets:
+
+- ``--target cpu`` (default): compile with the host XLA:CPU compiler.
+  Fast, runs anywhere (tier-1 smoke uses it); temp bytes are CPU-layout
+  numbers but the chunk-size SCALING is what matters.
+- ``--target v5e:2x2`` (any ``topologies.get_topology_desc`` name):
+  compile for the real TPU target with no device attached — the HBM
+  numbers chunk-size guidance should be read from.
+
+Usage:
+    python tools/mem_estimate.py                        # tiny MLP, CPU
+    python tools/mem_estimate.py --chunks 0,2,4,8,13,26
+    python tools/mem_estimate.py --target v5e:2x2 --northstar
+
+``--northstar`` swaps the tiny MLP for the bench.py shape (256-client
+CIFAR-10 ResNet-18, 26 sampled, B=50) — minutes of compile per chunk
+size; the default model compiles in seconds.
+
+Prints one human table to stderr and one JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def _tiny_mlp_round(nr_clients: int, nr_sampled: int, chunk: int):
+    """A deliberately small FL round (logistic regression, synthetic data)
+    whose compile time is seconds — enough to show the stack-vs-chunk
+    scaling because the update-stack bytes dominate the tiny params."""
+    from ddl25spring_tpu.fl import make_fl_round
+    from ddl25spring_tpu.fl.engine import make_local_sgd_update
+
+    per, d, k, bs = 32, 64, 10, 16
+    x = np.zeros((nr_clients, per, d), np.float32)
+    y = np.zeros((nr_clients, per), np.int32)
+    counts = np.full((nr_clients,), per, np.int32)
+
+    def loss_fn(params, xb, yb, mask, key):
+        logits = xb @ params["w"] + params["b"]
+        ls = -jax.nn.log_softmax(logits)[jnp.arange(yb.shape[0]), yb]
+        return jnp.sum(ls * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+    update = make_local_sgd_update(loss_fn, 0.05, bs, 1)
+    rf = make_fl_round(update, x, y, counts, nr_sampled=nr_sampled,
+                       device_put_data=False, client_chunk=chunk,
+                       donate=True)
+    params = {"w": jax.ShapeDtypeStruct((d, k), jnp.float32),
+              "b": jax.ShapeDtypeStruct((k,), jnp.float32)}
+    return rf, params
+
+
+def _northstar_round(chunk: int):
+    """The bench.py program shape (northstar_aot_costs.py's construction)."""
+    from ddl25spring_tpu.data.cifar import cifar_input_transform
+    from ddl25spring_tpu.fl import make_fl_round
+    from ddl25spring_tpu.fl.engine import make_local_sgd_update
+    from ddl25spring_tpu.fl.task import classification_task
+    from ddl25spring_tpu.models import ResNet18
+
+    nr_clients, per, bs = 256, 200, 50
+    x = np.zeros((nr_clients, per, 32, 32, 3), np.uint8)
+    y = np.zeros((nr_clients, per), np.int32)
+    counts = np.full((nr_clients,), per, np.int32)
+    task = classification_task(
+        ResNet18(dtype=jnp.bfloat16, norm_impl="lean"), (32, 32, 3),
+        np.zeros((100, 32, 32, 3), np.uint8), np.zeros((100,), np.int32),
+        input_transform=cifar_input_transform(jnp.bfloat16),
+    )
+    update = make_local_sgd_update(task.loss_fn, 0.05, bs, 1)
+    rf = make_fl_round(update, x, y, counts, nr_sampled=26,
+                       device_put_data=False, client_chunk=chunk,
+                       donate=True)
+    params = jax.eval_shape(task.init, jax.random.key(0))
+    return rf, params
+
+
+def estimate(build, chunk: int, device=None) -> dict:
+    """Compile the round at ``chunk`` and read XLA's memory analysis."""
+    from ddl25spring_tpu.fl.engine import _tree_bytes
+
+    rf, params = build(chunk)
+    avals = [jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+             for a in rf.data]
+    key_aval = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+    jit_kw = {"device": device} if device is not None else {}
+    t0 = time.time()
+    compiled = jax.jit(rf.raw, **jit_kw).lower(
+        params, key_aval, 0, *avals
+    ).compile()
+    mem = compiled.memory_analysis()
+    param_bytes = _tree_bytes(params)
+    eff = rf.client_chunk  # resolved chunk; None = stacked path
+    rows = eff if eff is not None else rf.nr_sampled
+    return {
+        "client_chunk_requested": chunk,
+        "client_chunk_effective": eff or 0,
+        "update_stack_bytes": rows * param_bytes,
+        "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+        "argument_bytes": int(getattr(mem, "argument_size_in_bytes", 0)),
+        "output_bytes": int(getattr(mem, "output_size_in_bytes", 0)),
+        "compile_s": round(time.time() - t0, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--target", default="cpu",
+                    help="'cpu' (host compiler) or an AOT topology name "
+                         "like 'v5e:2x2' (no device needed)")
+    ap.add_argument("--chunks", default="0,2,4,8",
+                    help="comma-separated client_chunk values; 0 = stacked")
+    ap.add_argument("--clients", type=int, default=64,
+                    help="tiny-MLP population size")
+    ap.add_argument("--sampled", type=int, default=16,
+                    help="tiny-MLP sampled cohort per round")
+    ap.add_argument("--northstar", action="store_true",
+                    help="use the bench.py ResNet-18 shape instead of the "
+                         "tiny MLP (minutes of compile per chunk size)")
+    args = ap.parse_args(argv)
+
+    device = None
+    if args.target != "cpu":
+        from jax.experimental import topologies
+
+        device = topologies.get_topology_desc(args.target, "tpu").devices[0]
+
+    chunks = [int(c) for c in args.chunks.split(",") if c.strip()]
+    if args.northstar:
+        build = _northstar_round
+    else:
+        build = lambda ch: _tiny_mlp_round(args.clients, args.sampled, ch)
+
+    rows = []
+    for ch in chunks:
+        r = estimate(build, ch, device=device)
+        rows.append(r)
+        print(f"  chunk={r['client_chunk_requested']:>3} "
+              f"(effective {r['client_chunk_effective'] or 'stacked'}): "
+              f"update stack {r['update_stack_bytes']:>12,} B   "
+              f"temp {r['temp_bytes']:>14,} B   "
+              f"compile {r['compile_s']}s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "fl_round_memory_estimate",
+        "target": args.target,
+        "model": "resnet18_northstar" if args.northstar else "tiny_mlp",
+        "chunks": rows,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

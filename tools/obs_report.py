@@ -544,13 +544,16 @@ def report(events: list[dict], top: int) -> None:
     comp = take(counters, "jax_compilations_total")
     fun_comp = take(counters, "jax_function_compiles_total")
     retr = take(counters, "watchdog_retrace_warnings_total")
+    cache_req = take(counters, "jax_compile_cache_requests_total")
+    cache_hit = take(counters, "jax_compile_cache_hits_total")
+    cache_saved = take(hists, "jax_compile_cache_saved_seconds")
     comp_h = {lb.get("kind"): st
               for lb, st in take(hists, "jax_compile_seconds")}
     mem = take(gauges, "device_memory_bytes_in_use")
     mem_peak = {lb.get("device"): st["value"]
                 for lb, st in take(gauges, "device_memory_peak_bytes")}
     retrace_evs = [e for e in events if e.get("event") == "watchdog.retrace"]
-    if comp or fun_comp or mem:
+    if comp or fun_comp or mem or cache_req:
         section("runtime watchdogs")
         if comp:
             parts = []
@@ -565,6 +568,19 @@ def report(events: list[dict], top: int) -> None:
             print("  per-function compiles: " + ", ".join(
                 f"{lb.get('fun', '?')} x{st['value']}"
                 for lb, st in worst))
+        if cache_req:
+            req = sum(st["value"] for _, st in cache_req)
+            hits = sum(st["value"] for _, st in cache_hit)
+            saved = sum(st.get("sum", 0.0) for _, st in cache_saved)
+            # jax emits no miss event — a miss is a cacheable compile
+            # request that never produced a hit
+            pct = 100.0 * hits / req if req else 0.0
+            line = (f"  persistent compile cache: {hits}/{req} hits "
+                    f"({pct:.0f}%), {req - hits} misses")
+            if saved > 0:
+                line += f", ~{fmt_seconds(saved)} compile time saved"
+            print(line + ("  — cold cache (first run on this "
+                          "program/jaxlib?)" if req and not hits else ""))
         if retr or retrace_evs:
             funs = {lb.get("fun", "?"): st["value"] for lb, st in retr}
             print(f"  RETRACE WARNINGS ({len(retrace_evs)} events): "
