@@ -37,7 +37,8 @@ CPU_BASELINE_ROUNDS_PER_SEC = 0.001441
 
 def build_server(seed: int = 10, norm_impl: str = "flax",
                  conv_impl: str = "flax", remat: bool = False,
-                 fault_spec: str = "", client_chunk: int = 0):
+                 fault_spec: str = "", client_chunk: int = 0,
+                 secagg: bool = False):
     import jax
     import jax.numpy as jnp
 
@@ -108,6 +109,19 @@ def build_server(seed: int = 10, norm_impl: str = "flax",
     mesh = make_mesh({"clients": nr_devices}) if nr_devices > 1 else None
     from ddl25spring_tpu.resilience.faults import FaultPlan
 
+    secagg_session = None
+    if secagg:
+        import numpy as np
+
+        from ddl25spring_tpu.secagg.protocol import SecAgg
+
+        # same cohort geometry as the server below: 256 clients, C=0.1
+        secagg_session = SecAgg(
+            256, max(1, round(0.1 * 256)),
+            counts=np.asarray(client_data.counts),
+            clip=4.0, threshold_frac=0.5, seed=seed,
+        )
+        _stamp(f"secagg on: {secagg_session.describe()}")
     return FedAvgServer(
         task, lr=0.05, batch_size=50, client_data=client_data,
         client_fraction=0.1, nr_local_epochs=1, seed=seed, mesh=mesh,
@@ -115,6 +129,7 @@ def build_server(seed: int = 10, norm_impl: str = "flax",
         # bench holds no extra reference to params between rounds (no
         # checkpointer), so the streaming accumulator can be donated
         client_chunk=client_chunk, donate=client_chunk > 0,
+        secagg=secagg_session,
     )
 
 
@@ -580,6 +595,12 @@ def main():
                          "O(chunk*P) update memory instead of the full "
                          "26-row stack; docs/PERFORMANCE.md); 0 = stacked "
                          "full cohort")
+    ap.add_argument("--secagg", action="store_true",
+                    help="aggregate over the masked fixed-point field "
+                         "(ddl25spring_tpu.secagg): measures the overhead "
+                         "of per-client mask expansion + modular summing "
+                         "vs the plaintext weighted mean; adds the "
+                         "secagg_bytes_per_round uplink gauge to the JSON")
     ap.add_argument("--probe-attempts", type=int,
                     default=int(os.environ.get("DDL25_PROBE_ATTEMPTS", 6)),
                     help="device-probe attempts before declaring the "
@@ -665,7 +686,8 @@ def main():
     server = build_server(norm_impl=args.norm_impl,
                           conv_impl=args.conv_impl, remat=args.remat,
                           fault_spec=args.faults,
-                          client_chunk=args.client_chunk)
+                          client_chunk=args.client_chunk,
+                          secagg=args.secagg)
     # the cost gauge the chunking exists to move: bytes of the per-round
     # update stack with the full cohort vs with the resolved chunk (the
     # resolved size can exceed the request — divisor rounding, engine
@@ -681,6 +703,19 @@ def main():
         "client_chunk_requested": args.client_chunk,
         "client_chunk_effective": eff_chunk if eff_chunk != cohort else 0,
     }
+    if args.secagg:
+        import jax as _jax
+
+        # uplink model: one uint32-encoded coordinate per param coordinate
+        # per sampled client (see engine.make_fl_round's secagg counters)
+        secagg_bytes = cohort * 4 * sum(
+            l.size for l in _jax.tree.leaves(server.params)
+            if hasattr(l, "size")
+        )
+        stack_bytes["secagg"] = True
+        stack_bytes["secagg_bytes_per_round"] = secagg_bytes
+        if obs.enabled():
+            obs.set_gauge("secagg_bytes_per_round", secagg_bytes)
     if obs.enabled():
         obs.set_gauge("fl_update_stack_bytes_stacked",
                       stack_bytes["update_stack_bytes_stacked"])

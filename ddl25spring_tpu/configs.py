@@ -84,6 +84,14 @@ class HflConfig:
     fault_spec: str = ""
     round_deadline_s: float = 0.0  # simulated round deadline stragglers
     #                                are measured against; 0 = unbounded
+    # secure aggregation (ddl25spring_tpu.secagg): the server only ever
+    # sees the masked fixed-point sum; docs/SECURITY.md has the threat
+    # model and the overflow-budget formula behind secagg_clip
+    secagg: bool = False
+    secagg_clip: float = 4.0   # per-coordinate clamp before fixed-point
+    #                            encoding (the field's value bound)
+    secagg_threshold: float = 0.5  # fraction of the cohort whose Shamir
+    #                            shares must survive to unmask a round
     # harness
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # rounds; 0 = off
@@ -122,6 +130,15 @@ class HflConfig:
             # it is used)
             from .resilience.faults import FaultPlan
             FaultPlan.parse(self.fault_spec)
+        if self.secagg_clip <= 0:
+            raise ValueError(
+                f"secagg_clip must be > 0, got {self.secagg_clip}"
+            )
+        if not 0.0 < self.secagg_threshold <= 1.0:
+            raise ValueError(
+                f"secagg_threshold must be in (0, 1], got "
+                f"{self.secagg_threshold}"
+            )
 
 
 @dataclass(frozen=True)

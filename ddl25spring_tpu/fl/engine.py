@@ -270,6 +270,7 @@ def make_fl_round(
     client_chunk: int = 0,
     donate: bool = False,
     robust_stack: str = "float32",
+    secagg=None,
 ):
     """Build the jitted one-round function of a decentralized server.
 
@@ -368,6 +369,26 @@ def make_fl_round(
     (``parallel.compress`` stochastic per-tensor quantization — ~1/4 the
     stack bytes, decoded before aggregation).
 
+    ``secagg`` (a ``secagg.SecAgg`` session) replaces the plaintext
+    weighted sum with MASKED fixed-point aggregation: each client's message
+    is clipped, encoded into the uint32 ring (``secagg/field.py``),
+    multiplied by its INTEGER weight (n_k, or 1 under ``dp_clip`` — integer
+    weights keep the modular sum exact), and hidden under self + pairwise
+    cancelling masks (``secagg/masks.py``) before the server sums it.  The
+    server subtracts the survivors' mask residue (dropped clients' pair
+    terms recovered via Shamir shares — ``protocol.SecAgg.recover`` runs
+    the host-side recovery each faulty round) and decodes ONE field sum; it
+    never sees an individual update.  Consequences wired in here: fault
+    corruption cannot be screened (the server cannot inspect messages, so
+    ``encode`` degrades non-finite uplinks to zero contributions instead),
+    rounds with fewer than the Shamir threshold of survivors keep the
+    previous params (the same in-trace floor as an all-faulted round), the
+    round is forced onto the stacked path, and robust aggregators /
+    ``dropout_rate`` / ``compress`` are rejected at build time
+    (docs/SECURITY.md).  DP composes as clip → encode → mask → sum →
+    decode → noise: the Gaussian mechanism lands on the decoded aggregate
+    server-side.
+
     ``donate = True`` donates the params argument of the jitted round so
     XLA may write the new params into the input buffer (the scan-carry
     accumulator is aliased in place by XLA either way).  The caller must
@@ -442,6 +463,28 @@ def make_fl_round(
             "full-precision stack is materialised first, so a reduced-"
             "precision copy would only ADD memory"
         )
+    if secagg is not None:
+        if aggregator is not None:
+            raise ValueError(
+                "secagg cannot combine with a custom (robust) aggregator: "
+                "robust rules need per-client updates in the clear, and the "
+                "whole point of secure aggregation is that the server only "
+                "ever sees the masked sum"
+            )
+        if dropout_rate:
+            raise ValueError(
+                "secagg does not combine with dropout_rate (zero-weight "
+                "dropout assumes the server can re-weight individual "
+                "clients it can no longer see); use a fault plan "
+                "(fault_spec drop=...) — dropped clients are excluded via "
+                "Shamir mask recovery instead"
+            )
+        if compress != "none":
+            raise ValueError(
+                "secagg replaces uplink compression: the fixed-point field "
+                "encoding IS the quantized uplink, composing another lossy "
+                "codec underneath it would double-quantize the messages"
+            )
     if fault_plan is not None and not fault_plan.affects_fl_round:
         # a crash/serving-only plan has nothing to inject here; dropping it
         # keeps the compiled round on the exact fault-free program
@@ -474,6 +517,11 @@ def make_fl_round(
         mesh.shape[clients_axis] if mesh is not None else 1,
     )
     if attack is not None and getattr(attack, "collusive", False):
+        chunk = None
+    if secagg is not None:
+        # masked aggregation needs the whole cohort's messages and masks in
+        # one place (the pairwise cancellation spans every live pair), so —
+        # like collusive attacks — it forces the stacked path
         chunk = None
 
     if mesh is not None:
@@ -513,8 +561,10 @@ def make_fl_round(
     # (256 CIFAR clients ≈ 150 MB) — slow to compile anywhere and an outright
     # compile-upload failure on remote-compile TPU frontends.  As arguments
     # they stay resident device buffers reused every round.
-    @partial(jax.jit, donate_argnums=donation_safe((0,) if donate else ()))
-    def _round(params, base_key, round_idx, x, y, counts, mal_mask):
+    @partial(jax.jit, donate_argnums=donation_safe((0,) if donate else ()),
+             static_argnames=("oracle",))
+    def _round(params, base_key, round_idx, x, y, counts, mal_mask,
+               oracle=False):
         round_key = jax.random.fold_in(base_key, round_idx)
         # noise_key is dedicated to the DP Gaussian mechanism: the aggregator
         # also receives agg_key, so deriving noise from agg_key would
@@ -732,6 +782,13 @@ def make_fl_round(
         # ---- stacked path (client_chunk = 0, the legacy program) ----
         updates, cs = client_messages(sel, keys, mal, f_nan, f_inf)
 
+        if secagg is not None:
+            return _secagg_aggregate(
+                params, sel, live, round_idx, updates, cs,
+                (f_keep, f_nan, f_inf, f_late), add_dp_noise, clip_updates,
+                oracle,
+            )
+
         if fault_plan is not None:
             faulted, stats = screen_and_stats(
                 updates, f_keep, f_nan, f_inf, f_late, live
@@ -779,6 +836,114 @@ def make_fl_round(
         # zeros — installing it would zero the model, so keep the previous
         # params (static shapes; the host sees it in stats and telemetry)
         return tree_select(any_survivor, new_params, params), stats
+
+    def _secagg_aggregate(params, sel, live, round_idx, updates, cs, fmasks,
+                          add_dp_noise, clip_updates, oracle):
+        """Masked fixed-point aggregation replacing the plaintext weighted
+        sum: encode each client's message into the shared uint32 field, add
+        its pairwise-cancelling + self masks, modular-sum the SURVIVORS'
+        rows, subtract the server-side mask residue (``masks.unmask_total``
+        — the residue the host's Shamir recovery makes legitimate) and
+        decode.  Aggregation weights are INTEGERS (n_k, or 1 under dp_clip)
+        multiplied into the encoded message inside the field, so the
+        modular sum equals the true integer sum while the FieldSpec budget
+        holds.  ``oracle=True`` short-circuits to ``(field_sum, plaintext
+        field sum, nr_survivors)`` for the tests' bit-exactness check."""
+        from ..secagg import field as sa_field
+        from ..secagg import masks as sa_masks
+
+        f_keep, f_nan, f_inf, f_late = fmasks
+        if fault_plan is not None:
+            surv = live & f_keep & ~f_late
+            # the screened-non-finite column is structurally zero: under
+            # secagg the server never sees per-client messages, so corrupt
+            # uplinks are sanitised to zero contributions at encode time
+            # instead of screened (the injected-corruption column still
+            # counts what the plan did)
+            stats = jnp.stack([
+                jnp.sum(~f_keep & live), jnp.sum(f_late & live),
+                jnp.sum((f_nan | f_inf) & live),
+                jnp.zeros((), jnp.int32),
+            ]).astype(jnp.int32)
+        else:
+            surv = live
+            stats = None
+
+        if dp_clip:
+            updates = clip_updates(updates)
+        if compress_deltas:
+            msgs = jax.tree.map(lambda u, p: u - p, updates, params)
+        else:
+            msgs = updates
+
+        spec = secagg.spec
+        enc = sa_field.encode(msgs, spec)
+        if dp_clip:
+            omega_f = jnp.where(live, 1.0, 0.0)
+            omega_u = live.astype(jnp.uint32)
+        else:
+            omega_f = jnp.where(live, cs.astype(jnp.float32), 0.0)
+            omega_u = jnp.where(live, cs, 0).astype(jnp.uint32)
+
+        def wrow(t, m):
+            return m.reshape((-1,) + (1,) * (t.ndim - 1))
+
+        cohort = sa_masks.cohort_masks(
+            secagg.seed, sel, live, round_idx, params
+        )
+        masked = jax.tree.map(
+            lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
+        )
+        total = jax.tree.map(
+            lambda ml: jnp.sum(
+                jnp.where(wrow(ml, surv), ml, jnp.uint32(0)),
+                axis=0, dtype=jnp.uint32,
+            ),
+            masked,
+        )
+        residue = sa_masks.unmask_total(
+            secagg.seed, sel, live, surv, round_idx, params
+        )
+        field_sum = jax.tree.map(jnp.subtract, total, residue)
+
+        nr_surv = jnp.sum(surv.astype(jnp.int32))
+        if oracle:
+            # the plaintext integer-field sum over the same survivors —
+            # computed WITHOUT any mask code so the masked==plain assertion
+            # in tests/test_secagg.py checks the cancellation algebra
+            plain = jax.tree.map(
+                lambda e: jnp.sum(
+                    jnp.where(wrow(e, surv), e * wrow(e, omega_u),
+                              jnp.uint32(0)),
+                    axis=0, dtype=jnp.uint32,
+                ),
+                enc,
+            )
+            return field_sum, plain, nr_surv
+
+        denom = jnp.sum(jnp.where(surv, omega_f, 0.0))
+        # in-trace Shamir-threshold floor: below t survivors the host
+        # cannot reconstruct the mask seeds, so the round is unrecoverable
+        # — keep the previous params (mirrors protocol.SecAgg.recover's
+        # predicate, see its docstring)
+        ok = (nr_surv >= secagg.threshold) & (denom > 0)
+        dec = sa_field.decode_sum(field_sum, spec)
+        mean = jax.tree.map(
+            lambda d: d / jnp.where(ok, denom, jnp.float32(1.0)), dec
+        )
+        if compress_deltas:
+            aggregate = jax.tree.map(
+                lambda p, m: (p.astype(jnp.float32) + m).astype(p.dtype),
+                params, mean,
+            )
+        else:
+            aggregate = jax.tree.map(
+                lambda p, m: m.astype(p.dtype), params, mean
+            )
+        aggregate = add_dp_noise(aggregate, jnp.maximum(nr_surv, 1))
+        new_params = apply_aggregate(params, aggregate)
+        out = tree_select(ok, new_params, params)
+        return (out, stats) if fault_plan is not None else out
 
     def _streaming_linear_round(params, sel, keys, mal, live, fmasks,
                                 counts, agg_key, client_messages,
@@ -992,12 +1157,38 @@ def make_fl_round(
         if (chunk is not None and custom_agg) else 1
     )
 
+    def _secagg_host_round(base_key, step):
+        """Eager replay of the jitted round's sampling + fault draws so
+        the host-side Shamir bookkeeping (protocol.SecAgg.recover) sees
+        exactly the survivor set the compiled program unmasked against —
+        every input is a pure function of (key/seed, round), the property
+        resilience/faults.py establishes for its masks."""
+        round_key = jax.random.fold_in(base_key, step)
+        sample_key = jax.random.split(round_key, 4)[0]
+        sel = sample_clients(sample_key, nr_clients, nr_shard)
+        live = jnp.arange(nr_shard) < nr_sampled
+        if fault_plan is not None:
+            f_keep, _, _, f_late = fault_plan.round_masks(
+                step, nr_shard, round_deadline_s
+            )
+            surv = live & f_keep & ~f_late
+        else:
+            surv = live
+        sel_h, live_h, surv_h = jax.device_get((sel, live, surv))
+        secagg.recover(sel_h[surv_h], sel_h[live_h & ~surv_h], step)
+
     def round_fn(params, base_key, round_idx):
         # telemetry wraps the DISPATCH boundary only; under an outer
         # trace (or with obs disabled) this is the bare jitted call.
         # bench.py's fused fori_loop path uses round_fn.raw directly and
         # is untouched either way.
-        if not obs.enabled() or isinstance(round_idx, jax.core.Tracer):
+        tracer = isinstance(round_idx, jax.core.Tracer)
+        if secagg is not None and not tracer:
+            # host bookkeeping BEFORE the dispatch: a below-threshold round
+            # must be counted as an unmask failure even though the jitted
+            # floor silently keeps the old params
+            _secagg_host_round(base_key, int(round_idx))
+        if not obs.enabled() or tracer:
             out = _round(params, base_key, round_idx, x, y, counts,
                          mal_mask)
             return out[0] if fault_plan is not None else out
@@ -1027,6 +1218,18 @@ def make_fl_round(
         # param tree per round (2 messages/client, servers.py's count)
         obs.inc("fl_bytes_aggregated_total",
                 2 * nr_sampled * _tree_bytes(new_params))
+        if secagg is not None:
+            # secagg uplink model: every sampled client ships one full
+            # uint32-encoded tree (4 bytes/coordinate regardless of param
+            # dtype; masks add nothing — they land in the same field
+            # elements)
+            u32 = 4 * sum(
+                l.size for l in jax.tree.leaves(new_params)
+                if hasattr(l, "size")
+            )
+            obs.inc("secagg_rounds_total")
+            obs.inc("secagg_bytes_total", nr_sampled * u32)
+            obs.set_gauge("secagg_bytes_per_round", nr_sampled * u32)
         return new_params
 
     # expose the raw jitted step + its device-resident data so callers can
@@ -1046,6 +1249,16 @@ def make_fl_round(
     # would materialize — tools/mem_estimate.py's stack-rows denominator
     round_fn.client_chunk = chunk
     round_fn.nr_sampled = nr_shard
+    # the session object (None when off) + a bit-exactness probe for the
+    # tests: (masked field sum, independently-computed plaintext field sum,
+    # nr_survivors) for one round, no params update
+    round_fn.secagg = secagg
+    if secagg is not None:
+        def _secagg_oracle(params, base_key, round_idx):
+            return _round(params, base_key, round_idx, x, y, counts,
+                          mal_mask, oracle=True)
+
+        round_fn.secagg_oracle = _secagg_oracle
     return round_fn
 
 

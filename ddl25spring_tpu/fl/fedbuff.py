@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import obs
-from ..utils.trees import tree_weighted_mean
+from ..utils.trees import tree_select, tree_weighted_mean
 from .engine import (_obs_round_faults, _resolve_chunk, _tree_bytes,
                      donation_safe,
                      sample_clients)
@@ -52,6 +52,7 @@ def make_fedbuff_round(
     round_deadline_s: float | None = None,
     client_chunk: int = 0,
     donate: bool = False,
+    secagg=None,
 ):
     """Build ``tick(history, base_key, tick_idx) -> history`` where
     ``history`` is the params pytree with a leading ``staleness_window``
@@ -89,15 +90,24 @@ def make_fedbuff_round(
     nr_clients = x.shape[0]
     W = staleness_window
     chunk = _resolve_chunk(client_chunk, nr_sampled)
+    if secagg is not None:
+        # masked aggregation spans every live pair (engine.make_fl_round's
+        # reasoning), so secagg forces the stacked tick.  The staleness
+        # discount CANNOT ride as a float weight — the field sum needs
+        # integer weights to stay exact — so it is folded into the ENCODED
+        # message instead: encode(disc_i·Δ_i) with weight n_i, and the
+        # denominator is the float Σ n_i·disc_i over survivors.
+        chunk = None
 
     # client data enters as ARGUMENTS, not closure captures (see
     # engine.make_fl_round: captured arrays are baked into the HLO as
     # constants — slow compiles, and a compile-upload failure on
     # remote-compile TPU frontends for CIFAR-sized client stacks)
     @functools.partial(
-        jax.jit, donate_argnums=donation_safe((0,) if donate else ())
+        jax.jit, donate_argnums=donation_safe((0,) if donate else ()),
+        static_argnames=("oracle",),
     )
-    def _tick(history, base_key, tick_idx, x, y, counts):
+    def _tick(history, base_key, tick_idx, x, y, counts, oracle=False):
         round_key = jax.random.fold_in(base_key, tick_idx)
         # same split arity as engine.make_fl_round so the W=1 oracle samples
         # the exact same clients as a synchronous FedAvg round
@@ -213,6 +223,93 @@ def make_fedbuff_round(
             denom = jnp.where(wsum > 0, wsum, 1.0) \
                 if fault_plan is not None else wsum
             delta = jax.tree.map(lambda a: (a / denom).astype(a.dtype), acc)
+        elif secagg is not None:
+            from ..secagg import field as sa_field
+            from ..secagg import masks as sa_masks
+
+            deltas = chunk_deltas(stale, sel, keys, f_nan, f_inf)
+            live = jnp.ones((nr_sampled,), jnp.bool_)
+            if fault_plan is not None:
+                surv = f_keep & ~f_late
+                # screened-non-finite column structurally zero: the server
+                # never sees per-client deltas under secagg, corruption is
+                # sanitised to a zero contribution at encode time
+                stats = jnp.stack([
+                    jnp.sum(~f_keep), jnp.sum(f_late),
+                    jnp.sum(f_nan | f_inf), jnp.zeros((), jnp.int32),
+                ]).astype(jnp.int32)
+            else:
+                surv = live
+                stats = None
+
+            current = jax.tree.map(lambda h: h[0], history)
+            # fold the fractional staleness discount into the MESSAGE so
+            # the field weight stays the integer n_i (see the chunk=None
+            # comment above); disc ≤ 1 keeps the clip bound valid
+            disc = (
+                1.0 / (1.0 + stale.astype(jnp.float32)) ** staleness_exp
+            )
+            msgs = jax.tree.map(
+                lambda d: d * disc.reshape((-1,) + (1,) * (d.ndim - 1)),
+                deltas,
+            )
+            enc = sa_field.encode(msgs, secagg.spec)
+            omega_u = cs_all.astype(jnp.uint32)
+
+            def wrow(t, m):
+                return m.reshape((-1,) + (1,) * (t.ndim - 1))
+
+            cohort = sa_masks.cohort_masks(
+                secagg.seed, sel, live, tick_idx, current
+            )
+            masked = jax.tree.map(
+                lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
+            )
+            total = jax.tree.map(
+                lambda ml: jnp.sum(
+                    jnp.where(wrow(ml, surv), ml, jnp.uint32(0)),
+                    axis=0, dtype=jnp.uint32,
+                ),
+                masked,
+            )
+            residue = sa_masks.unmask_total(
+                secagg.seed, sel, live, surv, tick_idx, current
+            )
+            field_sum = jax.tree.map(jnp.subtract, total, residue)
+            nr_surv = jnp.sum(surv.astype(jnp.int32))
+            if oracle:
+                plain = jax.tree.map(
+                    lambda e: jnp.sum(
+                        jnp.where(wrow(e, surv), e * wrow(e, omega_u),
+                                  jnp.uint32(0)),
+                        axis=0, dtype=jnp.uint32,
+                    ),
+                    enc,
+                )
+                return field_sum, plain, nr_surv
+            # decoded field sum ≈ Σ_surv n_i·disc_i·Δ_i, so the matching
+            # denominator is the float staleness-decayed weight sum (the
+            # SAME `weights` the plaintext tick normalises by)
+            denom = jnp.sum(jnp.where(surv, weights, 0.0))
+            ok = (nr_surv >= secagg.threshold) & (denom > 0)
+            dec = sa_field.decode_sum(field_sum, secagg.spec)
+            delta = jax.tree.map(
+                lambda d, c: (
+                    d / jnp.where(ok, denom, jnp.float32(1.0))
+                ).astype(c.dtype),
+                dec, current,
+            )
+            new = jax.tree.map(
+                lambda p, d: p + server_eta * d, current, delta
+            )
+            rolled = jax.tree.map(
+                lambda h, n: jnp.roll(h, 1, axis=0).at[0].set(n),
+                history, new,
+            )
+            # below the Shamir threshold the tick is unrecoverable: keep
+            # the whole history (protocol.SecAgg.recover's predicate)
+            out = tree_select(ok, rolled, history)
+            return (out, stats) if fault_plan is not None else out
         else:
             deltas = chunk_deltas(stale, sel, keys, f_nan, f_inf)
             if fault_plan is not None:
@@ -237,10 +334,30 @@ def make_fedbuff_round(
         )
         return (out, stats) if fault_plan is not None else out
 
+    def _secagg_host_tick(base_key, step):
+        """Eager replay of the tick's sampling + fault draws for the
+        host-side Shamir bookkeeping (engine._secagg_host_round's twin,
+        with the fedbuff key-split arity)."""
+        round_key = jax.random.fold_in(base_key, step)
+        sample_key = jax.random.split(round_key, 3)[0]
+        sel = sample_clients(sample_key, nr_clients, nr_sampled)
+        if fault_plan is not None:
+            f_keep, _, _, f_late = fault_plan.round_masks(
+                step, nr_sampled, round_deadline_s
+            )
+            surv = f_keep & ~f_late
+        else:
+            surv = jnp.ones((nr_sampled,), jnp.bool_)
+        sel_h, surv_h = jax.device_get((sel, surv))
+        secagg.recover(sel_h[surv_h], sel_h[~surv_h], step)
+
     def tick(history, base_key, tick_idx):
         # dispatch-boundary telemetry, same shape as engine.make_fl_round's
         # round_fn (skipped under an outer trace / with obs disabled)
-        if not obs.enabled() or isinstance(tick_idx, jax.core.Tracer):
+        tracer = isinstance(tick_idx, jax.core.Tracer)
+        if secagg is not None and not tracer:
+            _secagg_host_tick(base_key, int(tick_idx))
+        if not obs.enabled() or tracer:
             out = _tick(history, base_key, tick_idx, x, y, counts)
             return out[0] if fault_plan is not None else out
         step = int(tick_idx)
@@ -261,8 +378,24 @@ def make_fedbuff_round(
         # W-deep history
         obs.inc("fl_bytes_aggregated_total",
                 2 * nr_sampled * (_tree_bytes(new_history) // W))
+        if secagg is not None:
+            # one uint32-encoded model version up per sampled client
+            u32 = 4 * sum(
+                l.size // W for l in jax.tree.leaves(new_history)
+                if hasattr(l, "size")
+            )
+            obs.inc("secagg_rounds_total")
+            obs.inc("secagg_bytes_total", nr_sampled * u32)
+            obs.set_gauge("secagg_bytes_per_round", nr_sampled * u32)
         return new_history
 
+    tick.secagg = secagg
+    if secagg is not None:
+        def _secagg_oracle(history, base_key, tick_idx):
+            return _tick(history, base_key, tick_idx, x, y, counts,
+                         oracle=True)
+
+        tick.secagg_oracle = _secagg_oracle
     return tick
 
 
@@ -297,7 +430,8 @@ class FedBuffServer(_DecentralizedServer):
                  staleness_window: int = 4, staleness_exp: float = 0.5,
                  server_eta: float = 1.0, fault_plan=None,
                  round_deadline_s: float | None = None,
-                 client_chunk: int = 0, donate: bool = False):
+                 client_chunk: int = 0, donate: bool = False,
+                 secagg=None):
         from .engine import make_local_sgd_update
 
         super().__init__(task, lr, batch_size, client_data, client_fraction,
@@ -313,7 +447,7 @@ class FedBuffServer(_DecentralizedServer):
             staleness_window=staleness_window,
             staleness_exp=staleness_exp, server_eta=server_eta,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
-            client_chunk=client_chunk, donate=donate,
+            client_chunk=client_chunk, donate=donate, secagg=secagg,
         )
         self.params = init_history(self.params, staleness_window)
         # evaluate the CURRENT version of the stacked history

@@ -178,7 +178,7 @@ class FedSgdGradientServer(DecentralizedServer):
                  compress: str = "none", compress_ratio: float = 0.01,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
-                 robust_stack: str = "float32"):
+                 robust_stack: str = "float32", secagg=None):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDGradient"
@@ -199,7 +199,7 @@ class FedSgdGradientServer(DecentralizedServer):
             compress_deltas=False,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate,
-            robust_stack=robust_stack,
+            robust_stack=robust_stack, secagg=secagg,
         )
 
 
@@ -214,7 +214,7 @@ class FedSgdWeightServer(DecentralizedServer):
                  aggregator=None, attack=None, malicious_mask=None, mesh=None,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
-                 robust_stack: str = "float32"):
+                 robust_stack: str = "float32", secagg=None):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDWeight"
@@ -228,7 +228,7 @@ class FedSgdWeightServer(DecentralizedServer):
             mesh=mesh,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate,
-            robust_stack=robust_stack,
+            robust_stack=robust_stack, secagg=secagg,
         )
 
 
@@ -253,7 +253,7 @@ class FedAvgServer(DecentralizedServer):
                  compress: str = "none", compress_ratio: float = 0.01,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
-                 robust_stack: str = "float32"):
+                 robust_stack: str = "float32", secagg=None):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         self.algorithm = "FedAvg" if prox_mu == 0.0 else "FedProx"
@@ -276,7 +276,7 @@ class FedAvgServer(DecentralizedServer):
             compress_deltas=True,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate,
-            robust_stack=robust_stack,
+            robust_stack=robust_stack, secagg=secagg,
         )
 
 
@@ -302,7 +302,8 @@ class FedOptServer(DecentralizedServer):
                  aggregator=None, attack=None, malicious_mask=None, mesh=None,
                  prox_mu: float = 0.0, dropout_rate: float = 0.0,
                  fault_plan=None, round_deadline_s: float | None = None,
-                 client_chunk: int = 0, robust_stack: str = "float32"):
+                 client_chunk: int = 0, robust_stack: str = "float32",
+                 secagg=None):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         if server_optimizer not in self.OPTIMIZERS:
@@ -341,6 +342,7 @@ class FedOptServer(DecentralizedServer):
             # aggregate (server_step takes the same buffer) — donating it
             # would hand XLA a buffer the next line still reads
             client_chunk=client_chunk, robust_stack=robust_stack,
+            secagg=secagg,
         )
 
         @jax.jit
@@ -356,6 +358,10 @@ class FedOptServer(DecentralizedServer):
             )
             return params
 
+        # surface the inner round's secagg session + oracle so tests and
+        # run_hfl reporting see FedOpt like the direct servers
+        round_fn.secagg = getattr(aggregate_fn, "secagg", None)
+        round_fn.secagg_oracle = getattr(aggregate_fn, "secagg_oracle", None)
         self.round_fn = round_fn
 
     def extra_state(self):

@@ -12,6 +12,13 @@ SGD), ``--algorithm fedopt --server-optimizer adam|yogi|avgm`` (adaptive
 server optimizers over the round delta), ``--algorithm scaffold``
 (control-variate drift correction, fl/scaffold.py), and ``--dropout-rate``
 (per-round client failure simulation with survivor renormalisation).
+
+``--secagg`` runs the linear servers (fedsgd/fedsgd-weight/fedavg/fedprox/
+fedopt/fedbuff) over masked fixed-point sums (ddl25spring_tpu.secagg): the
+server only ever sees the cohort's modular sum, dropped clients are
+excluded via Shamir mask recovery (combine with --fault-spec drop=...),
+and --secagg-clip/--secagg-threshold size the field's overflow budget and
+the recovery threshold.  Threat model and caveats: docs/SECURITY.md.
 """
 
 from __future__ import annotations
@@ -89,6 +96,24 @@ def build_aggregator(cfg: HflConfig):
     raise ValueError(f"unknown aggregator {cfg.aggregator!r}")
 
 
+def build_secagg(cfg: HflConfig, client_data):
+    """Per-run secure-aggregation session (None when --secagg is off).
+
+    Under --dp-clip the aggregation weights are uniform (n_k weighting
+    would leak client data sizes), so the overflow budget is sized for
+    cohort_size; otherwise it is sized against the cohort_size largest
+    client counts — see secagg/field.py for the formula."""
+    if not cfg.secagg:
+        return None
+    from .secagg.protocol import SecAgg
+
+    clients_per_round = max(1, round(cfg.client_fraction * cfg.nr_clients))
+    counts = None if cfg.dp_clip else np.asarray(client_data.counts)
+    return SecAgg(cfg.nr_clients, clients_per_round, counts=counts,
+                  clip=cfg.secagg_clip,
+                  threshold_frac=cfg.secagg_threshold, seed=cfg.seed)
+
+
 def build_server(cfg: HflConfig):
     from .resilience.faults import FaultPlan
 
@@ -114,6 +139,35 @@ def build_server(cfg: HflConfig):
             f"algorithm {cfg.algorithm!r} would silently train with "
             "uncompressed uplinks"
         )
+    if cfg.secagg:
+        # reject every incompatible combination BEFORE the dataset loads;
+        # docs/SECURITY.md explains each one
+        if cfg.algorithm in ("centralized", "scaffold"):
+            raise ValueError(
+                f"--secagg is not wired into {cfg.algorithm!r} "
+                "(centralized has no client uplinks to mask; scaffold's "
+                "control variates are a second per-client message the "
+                "masked-sum protocol does not cover)"
+            )
+        if cfg.aggregator != "mean":
+            raise ValueError(
+                "--secagg cannot combine with a robust aggregator "
+                f"({cfg.aggregator!r}): robust rules need per-client "
+                "updates in the clear, and under secure aggregation the "
+                "server only ever sees the masked sum"
+            )
+        if cfg.dropout_rate:
+            raise ValueError(
+                "--secagg does not combine with --dropout-rate; simulate "
+                "client failures with --fault-spec drop=... instead, where "
+                "dropped clients are excluded via Shamir mask recovery"
+            )
+        if cfg.compress != "none":
+            raise ValueError(
+                "--secagg replaces uplink compression: the fixed-point "
+                "field encoding IS the quantized uplink (--compress "
+                f"{cfg.compress!r} would double-quantize the messages)"
+            )
     # datasets ship as raw uint8 and are normalized on device inside the
     # jitted loss/score fns — 4x less host->device transfer, which matters
     # on the remote-tunnel TPU (data/mnist.py raw_dataset)
@@ -159,6 +213,7 @@ def build_server(cfg: HflConfig):
             staleness_exp=cfg.staleness_exp, server_eta=cfg.server_eta,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=cfg.client_chunk,
+            secagg=build_secagg(cfg, client_data),
         )
 
     if cfg.algorithm == "scaffold":
@@ -210,7 +265,8 @@ def build_server(cfg: HflConfig):
               malicious_mask=malicious if attack is not None else None,
               mesh=mesh, fault_plan=fault_plan,
               round_deadline_s=round_deadline_s,
-              client_chunk=cfg.client_chunk, robust_stack=cfg.robust_stack)
+              client_chunk=cfg.client_chunk, robust_stack=cfg.robust_stack,
+              secagg=build_secagg(cfg, client_data))
     if cfg.algorithm == "fedsgd":
         return FedSgdGradientServer(task, cfg.lr, client_data,
                                     cfg.client_fraction, cfg.seed,
@@ -306,12 +362,30 @@ def run(cfg: HflConfig):
         # server so the report can never drift from what the mechanism did.
         q = server.nr_clients_per_round / cfg.nr_clients
         eps = dp_epsilon(cfg.dp_noise_mult, q, cfg.nr_rounds, cfg.dp_delta)
+        secagg_note = (
+            "; composition ordering: clip -> fixed-point encode -> mask -> "
+            "masked sum -> decode -> server-side Gaussian noise, i.e. DP "
+            "noise is added AFTER secure aggregation on the decoded "
+            "aggregate (docs/SECURITY.md)"
+            if cfg.secagg else ""
+        )
         print(f"[dp] client-level privacy spent: ε = {eps:.3f} at "
               f"δ = {cfg.dp_delta:g} (σ = {cfg.dp_noise_mult}, "
               f"q = {q:.4g}, {cfg.nr_rounds} rounds; "
               f"RDP accountant, fl/privacy.py — Poisson-subsampling "
               f"approximation: the engine samples a FIXED-SIZE subset, so "
-              f"ε can be optimistic under replace-one adjacency)")
+              f"ε can be optimistic under replace-one adjacency"
+              f"{secagg_note})")
+
+    secagg = getattr(server.round_fn, "secagg", None)
+    if secagg is not None:
+        s = secagg.stats
+        print(f"[secagg] {secagg.describe()}; rounds={s['rounds']} "
+              f"faulty={s['faulty_rounds']} "
+              f"recovered pair_keys={s['recovered_pair_keys']} "
+              f"self_seeds={s['recovered_self_seeds']} "
+              f"unmask_failures={s['unmask_failures']} "
+              f"(simulated key agreement — see docs/SECURITY.md)")
 
     if logger is not None:
         logger.close()

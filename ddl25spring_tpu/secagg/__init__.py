@@ -1,0 +1,41 @@
+"""Secure aggregation for the horizontal-FL servers (Bonawitz et al.,
+CCS 2017 — the public recipe), jit-traceable end to end.
+
+The plaintext engine lets the server read every client's update; with
+``secagg`` the server only ever sums MASKED fixed-point messages
+
+    y_i = ω_i · encode(v_i) + PRG(b_i, r) + Σ_{j≠i} ±PRG(s_ij, r)   (mod 2³²)
+
+where the pairwise masks cancel between surviving clients and the server
+reconstructs the leftover mask terms of dropped clients from Shamir
+shares.  Module map:
+
+- :mod:`.field`   — fixed-point pytree encode/decode into the uint32 ring,
+  with the explicit overflow budget (host-side accounting is jax-free);
+- :mod:`.masks`   — self + pairwise cancelling masks from the counter-based
+  PRNG ``fold_in(seed, round)`` (jit-traceable);
+- :mod:`.shamir`  — share/reconstruct over GF(2⁶¹−1) (pure Python);
+- :mod:`.protocol` — the per-run session object: key setup, share dealing,
+  per-round dropout recovery, obs counters.
+
+This ``__init__`` is import-light on purpose: ``shamir`` and ``field`` are
+the host-side accounting modules and must stay importable without pulling
+jax into the process (tests/test_secagg.py guards it, same contract as
+``ddl25spring_tpu.obs``), so the jax-using surface loads lazily.
+"""
+
+from __future__ import annotations
+
+_LAZY = {"SecAgg": ".protocol", "FieldSpec": ".field"}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["SecAgg", "FieldSpec"]

@@ -1,0 +1,126 @@
+"""Fixed-point encoding of update pytrees into the uint32 modular ring.
+
+Secure aggregation sums MASKED integers mod 2³² — the float update must
+first become an integer whose weighted cohort sum provably fits the ring.
+The scheme (the same per-tensor symmetric-scale idea as
+``parallel/compress.py``'s int8 path, with a round-to-nearest quantizer and
+a GLOBAL scale so that client messages live in one shared field):
+
+    q_i = round(clip(v_i, ±clip) · scale)          int32, |q_i| ≤ clip·scale + ½
+    encode(v_i) = q_i  reinterpreted as uint32      (two's complement)
+    decode(Σ ω_i·encode(v_i) mod 2³²) = (Σ ω_i·q_i as int32) / scale
+
+The decode is EXACT (the modular sum equals the true integer sum) iff the
+overflow budget holds:
+
+    total_weight · (clip · scale + ½)  ≤  2³¹ − 1
+
+where ``total_weight = Σ ω_i`` over the worst-case cohort — so
+:meth:`FieldSpec.for_budget` picks the largest integer scale satisfying
+it.  Per coordinate of the weighted MEAN the quantization error is then
+bounded by ``½ / scale`` (each |v_i·scale − q_i| ≤ ½ after clipping, and
+the mean of per-client errors cannot exceed their max) — the formula
+``docs/SECURITY.md`` documents and ``tests/test_secagg.py`` asserts.
+
+Module-level import of this file must stay jax-free (it is the host-side
+budget accounting ``tools/obs_report.py``-style tooling and the import
+guard rely on); the tensor encode/decode below import jax lazily inside
+the functions, which is free by the time anything traces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+_INT32_MAX = (1 << 31) - 1
+
+
+@dataclass(frozen=True)
+class FieldSpec:
+    """The shared fixed-point field of one secure-aggregation session."""
+
+    clip: float          # per-coordinate value clamp applied before encoding
+    total_weight: int    # Σ ω_i over the worst-case cohort (the budget's m·ω)
+    scale: int           # fixed-point multiplier (integer: keeps q exact)
+
+    @classmethod
+    def for_budget(cls, clip: float, total_weight: int) -> "FieldSpec":
+        """Largest integer scale with ``total_weight·(clip·scale + ½)``
+        inside int32 — the overflow budget = cohort × clip bound."""
+        if clip <= 0:
+            raise ValueError(f"clip={clip} must be > 0")
+        if total_weight < 1:
+            raise ValueError(
+                f"total_weight={total_weight} must be >= 1 (it is the "
+                "worst-case sum of integer aggregation weights)"
+            )
+        scale = int((_INT32_MAX / total_weight - 0.5) / clip)
+        if scale < 1:
+            raise ValueError(
+                f"overflow budget exhausted: total_weight={total_weight} x "
+                f"clip={clip} leaves no integer scale with "
+                f"total_weight*(clip*scale + 0.5) <= 2^31 - 1; lower the "
+                "clip bound or the cohort weight (e.g. --dp-clip switches "
+                "to uniform weights)"
+            )
+        return cls(clip=float(clip), total_weight=int(total_weight),
+                   scale=scale)
+
+    @property
+    def quantization_error(self) -> float:
+        """Per-coordinate bound on |decoded weighted mean − true weighted
+        mean of the CLIPPED messages|: ½ / scale."""
+        return 0.5 / self.scale
+
+    def check_budget(self) -> None:
+        """Re-assert the exactness condition (tests call this after
+        hand-constructing specs)."""
+        if self.total_weight * (self.clip * self.scale + 0.5) > _INT32_MAX:
+            raise ValueError(
+                f"FieldSpec violates its overflow budget: {self.total_weight}"
+                f" * ({self.clip} * {self.scale} + 0.5) > 2^31 - 1"
+            )
+
+
+def encode(tree, spec: FieldSpec):
+    """Fixed-point encode every leaf into uint32 (jit-traceable).
+
+    Non-finite entries are sanitised to 0 first: under secure aggregation
+    the server cannot screen a corrupt client's message (it never sees it
+    in the clear), so a NaN/Inf uplink degrades to a zero contribution
+    instead of poisoning the modular sum.  Raises at trace time on
+    non-float leaves — a secagg message tree must be all-inexact, there is
+    no meaningful fixed-point embedding of integer state."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(leaf):
+        if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+            raise TypeError(
+                f"secagg encode needs float leaves, got {leaf.dtype}; "
+                "integer/bool state cannot ride the fixed-point field"
+            )
+        v = jnp.clip(jnp.nan_to_num(leaf, nan=0.0, posinf=0.0, neginf=0.0),
+                     -spec.clip, spec.clip)
+        q = jnp.round(v.astype(jnp.float32) * spec.scale).astype(jnp.int32)
+        return q.astype(jnp.uint32)
+
+    return jax.tree.map(one, tree)
+
+
+def decode_sum(tree, spec: FieldSpec, like=None):
+    """Decode a MODULAR SUM of encoded-and-weighted messages back to float:
+    reinterpret uint32 as int32 (two's complement — exact while the budget
+    holds) and divide by the scale.  ``like`` supplies output dtypes (e.g.
+    the params tree); float32 without it."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(leaf, template):
+        dtype = template.dtype if template is not None else jnp.float32
+        return (leaf.astype(jnp.int32).astype(jnp.float32)
+                / jnp.float32(spec.scale)).astype(dtype)
+
+    if like is None:
+        return jax.tree.map(lambda l: one(l, None), tree)
+    return jax.tree.map(one, tree, like)

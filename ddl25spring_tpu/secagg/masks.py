@@ -1,0 +1,162 @@
+"""Pairwise-cancelling and self masks from a counter-based PRNG.
+
+Every mask in the protocol expands from a 32-bit seed through the same
+deterministic chain the rest of the repo uses for replayable randomness
+(``resilience/faults.py``): ``fold_in(PRNGKey(seed), round)`` then one
+``fold_in`` per tree leaf.  Pure functions of ``(seed, ids, round)`` — they
+trace inside the jitted round AND replay eagerly on the host, which is what
+lets ``protocol.SecAgg`` deal Shamir shares of exactly the seeds the
+compiled program expands.
+
+Key material (SIMULATED key agreement — the threat model caveat):
+
+- ``key_material(seed, gid)``  → sk_i, the per-client "DH secret";
+- ``pair_seed(seed, gid_a, gid_b)`` → s_ab, symmetric in (a, b), derived
+  from BOTH parties' sk via an order-independent fold — standing in for
+  ``KA(sk_a, pk_b) = KA(sk_b, pk_a)``.  In this single-process simulation
+  the "public keys" carry full key information (there is no discrete-log
+  hardness behind ``fold_in``), so a real deployment must replace this
+  function with an X25519 agreement; everything downstream (PRG expansion,
+  Shamir recovery, unmasking algebra) is unchanged.  docs/SECURITY.md
+  spells out the consequences.
+- ``self_seed(seed, gid)`` → b_i, the self-mask seed that hides a client's
+  message even from the pairwise-mask peers.
+
+Masking algebra (all arithmetic mod 2³², i.e. native uint32 wraparound):
+client a at round r adds ``PRG(b_a, r) + Σ_{b live, b≠a} sign(a,b)·PRG(s_ab, r)``
+with ``sign(a,b) = +1 if gid_a < gid_b else −1``, so each pair term appears
+once with + and once with − in the cohort sum and cancels.  For a set A of
+survivors the residue the server must subtract is
+
+    Σ_{i∈A} PRG(b_i, r)  +  Σ_{i∈A} Σ_{j live∖A} sign(i,j)·PRG(s_ij, r)
+
+— :func:`unmask_total` computes exactly that, with a bookkeeping path
+INDEPENDENT of :func:`cohort_masks` (client-side per-client loop vs
+server-side survivor×dropped double loop), which is what makes the
+bit-exact masked-sum == plaintext-field-sum oracle in tests/test_secagg.py
+a real check of the sign conventions rather than a tautology.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# domain-separation tags (arbitrary distinct constants, same discipline as
+# resilience/faults.py's fault-kind tags)
+_TAG_SELF = 0x5E1F
+_TAG_KA = 0xCA11
+_TAG_PAIR = 0x9A12
+
+
+def _u32(key):
+    return jax.random.bits(key, dtype=jnp.uint32)
+
+
+def key_material(seed: int, gid):
+    """sk_i — the per-client key-agreement secret (Shamir-shared so the
+    server can rebuild a DROPPED client's pair seeds)."""
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), _TAG_KA)
+    return _u32(jax.random.fold_in(base, gid))
+
+
+def self_seed(seed: int, gid):
+    """b_i — the per-client self-mask seed (Shamir-shared so the server can
+    unmask a SURVIVING client's contribution)."""
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), _TAG_SELF)
+    return _u32(jax.random.fold_in(base, gid))
+
+
+def pair_seed(seed: int, gid_a, gid_b):
+    """s_ab = s_ba — simulated key agreement over both parties' sk (see
+    module docstring for what this does and does not guarantee)."""
+    sk_a = key_material(seed, gid_a)
+    sk_b = key_material(seed, gid_b)
+    lo = jnp.minimum(sk_a, sk_b)
+    hi = jnp.maximum(sk_a, sk_b)
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), _TAG_PAIR)
+    return _u32(jax.random.fold_in(jax.random.fold_in(base, lo), hi))
+
+
+def _prg_leaves(seed_u32, round_idx, leaves):
+    """Expand one 32-bit seed into per-leaf uint32 tensors for one round —
+    the counter-based PRG: fold the round index, then one fold per leaf."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed_u32), round_idx)
+    return [
+        jax.random.bits(jax.random.fold_in(key, i), l.shape, jnp.uint32)
+        for i, l in enumerate(leaves)
+    ]
+
+
+def _signed(gid_a, gid_b, leaf):
+    """sign(a, b)·leaf in uint32: +leaf when gid_a < gid_b, the additive
+    inverse mod 2³² otherwise."""
+    return jnp.where(gid_a < gid_b, leaf, (jnp.uint32(0) - leaf))
+
+
+def cohort_masks(seed: int, gids, live, round_idx, template):
+    """The CLIENT-side masks: a stacked pytree (leading cohort axis) where
+    row a is what client ``gids[a]`` adds to its encoded message this
+    round.  Rows of non-``live`` (shard padding) positions are zero, and
+    pair terms are gated on the PARTNER being live — a client only runs
+    key agreement with cohort members that actually exist this round."""
+    m = gids.shape[0]
+    leaves, treedef = jax.tree.flatten(template)
+
+    def one_client(a):
+        ga = gids[a]
+        own = _prg_leaves(self_seed(seed, ga), round_idx, leaves)
+
+        def partner(c, acc):
+            gb = gids[c]
+            pair = _prg_leaves(pair_seed(seed, ga, gb), round_idx, leaves)
+            use = live[c] & (c != a)
+            return [
+                al + jnp.where(use, _signed(ga, gb, pl), jnp.uint32(0))
+                for al, pl in zip(acc, pair)
+            ]
+
+        zeros = [jnp.zeros(l.shape, jnp.uint32) for l in leaves]
+        pairs = jax.lax.fori_loop(0, m, partner, zeros)
+        total = [
+            jnp.where(live[a], o + p, jnp.uint32(0))
+            for o, p in zip(own, pairs)
+        ]
+        return jax.tree.unflatten(treedef, total)
+
+    return jax.vmap(one_client)(jnp.arange(m))
+
+
+def unmask_total(seed: int, gids, live, survivors, round_idx, template):
+    """The SERVER-side mask residue to subtract from the modular sum of the
+    survivors' masked messages: survivors' self masks plus the
+    survivor×dropped crossing pair terms (pairs internal to the survivor
+    set cancel and are deliberately NOT regenerated here).  ``survivors``
+    must be a subset of ``live``; the seeds this expands are the ones
+    ``protocol.SecAgg.recover`` reconstructs from Shamir shares."""
+    m = gids.shape[0]
+    leaves, treedef = jax.tree.flatten(template)
+    dropped = live & ~survivors
+
+    def outer(i, acc):
+        gi = gids[i]
+        own = _prg_leaves(self_seed(seed, gi), round_idx, leaves)
+        acc = [
+            al + jnp.where(survivors[i], ol, jnp.uint32(0))
+            for al, ol in zip(acc, own)
+        ]
+
+        def crossing(j, acc):
+            gj = gids[j]
+            pair = _prg_leaves(pair_seed(seed, gi, gj), round_idx, leaves)
+            use = survivors[i] & dropped[j]
+            return [
+                al + jnp.where(use, _signed(gi, gj, pl), jnp.uint32(0))
+                for al, pl in zip(acc, pair)
+            ]
+
+        return jax.lax.fori_loop(0, m, crossing, acc)
+
+    zeros = [jnp.zeros(l.shape, jnp.uint32) for l in leaves]
+    total = jax.lax.fori_loop(0, m, outer, zeros)
+    return jax.tree.unflatten(treedef, total)

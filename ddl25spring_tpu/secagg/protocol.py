@@ -1,0 +1,171 @@
+"""The per-run secure-aggregation session: keys, shares, dropout recovery.
+
+One :class:`SecAgg` object configures a server's masked rounds:
+
+- it fixes the shared :class:`~.field.FieldSpec` from the overflow budget
+  (worst-case cohort weight × clip bound — field.py's formula);
+- at setup it derives every client's mask seeds (``masks.self_seed`` /
+  ``masks.key_material`` — the SAME pure functions the jitted round
+  expands, so host and device can never disagree about key material) and
+  deals Shamir shares of each to the whole client population;
+- per faulty round, :meth:`recover` replays the resilience layer's
+  drop/straggle outcome and reconstructs from survivor-held shares exactly
+  the seeds the in-trace ``masks.unmask_total`` expands — the dropped
+  clients' pair-key secrets and the survivors' self-mask seeds — then
+  verifies them against the directly-derived truth.  In this single-process
+  simulation the verification can be exact (the process knows the truth);
+  its real-deployment meaning is "the share set held by this survivor
+  subset determines the correct seeds", i.e. the recovery path is
+  exercised and counted (``secagg_mask_recovery_total``), not mocked.
+
+Below the Shamir threshold ``t`` the round is unrecoverable: ``recover``
+reports failure (``secagg_unmask_failures_total``) and the engine's
+in-trace floor — which applies the SAME ``nr_survivors >= t`` predicate —
+keeps the previous params, so host accounting and compiled behavior agree
+round for round.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+import numpy as np
+
+from .. import obs
+from . import shamir
+from .field import FieldSpec
+
+# rng stream tag for share dealing (so the dealer's randomness cannot
+# collide with anything else seeded from the same experiment seed)
+_DEAL_TAG = 0x5A6A
+
+
+class SecAgg:
+    """Session state + host-side recovery for masked aggregation.
+
+    ``counts=None`` means uniform integer weights (ω_i = 1 — the DP-clip
+    configuration, where n_k weighting would leak client data sizes);
+    otherwise ω_i = n_k and the budget is sized against the ``cohort_size``
+    LARGEST counts, the worst cohort sampling can produce.
+    """
+
+    def __init__(self, nr_clients: int, cohort_size: int, counts=None,
+                 clip: float = 4.0, threshold_frac: float = 0.5,
+                 seed: int = 0):
+        if not 0.0 < threshold_frac <= 1.0:
+            raise ValueError(
+                f"threshold_frac={threshold_frac} outside (0, 1] — it is "
+                "the fraction of the cohort whose shares must survive"
+            )
+        if not 1 <= cohort_size <= nr_clients:
+            raise ValueError(
+                f"cohort_size={cohort_size} outside [1, nr_clients="
+                f"{nr_clients}]"
+            )
+        self.nr_clients = int(nr_clients)
+        self.cohort_size = int(cohort_size)
+        self.seed = int(seed)
+        if counts is None:
+            self.counts = None
+            total_weight = self.cohort_size
+        else:
+            self.counts = np.asarray(counts, dtype=np.int64)
+            if self.counts.shape != (self.nr_clients,):
+                raise ValueError(
+                    f"counts shape {self.counts.shape} != ({nr_clients},)"
+                )
+            if (self.counts < 0).any():
+                raise ValueError("client counts must be >= 0")
+            largest = np.sort(self.counts)[-self.cohort_size:]
+            total_weight = int(max(1, largest.sum()))
+        self.spec = FieldSpec.for_budget(clip, total_weight)
+        self.threshold = max(1, math.ceil(threshold_frac * self.cohort_size))
+        self.stats = {
+            "rounds": 0,
+            "faulty_rounds": 0,
+            "recovered_pair_keys": 0,
+            "recovered_self_seeds": 0,
+            "unmask_failures": 0,
+        }
+        self._self_shares = None  # dealt lazily: [client][holder] -> (x, y)
+        self._ka_shares = None
+        self._truth = None
+
+    # -- setup ------------------------------------------------------------
+
+    def _ensure_shares(self) -> None:
+        if self._self_shares is not None:
+            return
+        from . import masks
+
+        # eager replay of the in-trace derivation chain; int() is the
+        # device->host fetch
+        b = [int(masks.self_seed(self.seed, g))
+             for g in range(self.nr_clients)]
+        sk = [int(masks.key_material(self.seed, g))
+              for g in range(self.nr_clients)]
+        rng = random.Random(self.seed ^ _DEAL_TAG)
+        self._self_shares = [
+            shamir.share(v, self.nr_clients, self.threshold, rng) for v in b
+        ]
+        self._ka_shares = [
+            shamir.share(v, self.nr_clients, self.threshold, rng) for v in sk
+        ]
+        self._truth = (b, sk)
+
+    # -- per-round recovery ----------------------------------------------
+
+    def recover(self, survivor_gids, dropped_gids, round_idx: int) -> bool:
+        """Host-side unmask bookkeeping for one round: reconstruct the
+        dropped clients' pair-key secrets and the survivors' self-mask
+        seeds from ``threshold`` survivor-held shares.  Returns False (and
+        counts an unmask failure) when too few clients survive — the same
+        predicate the jitted round's parameter floor applies."""
+        survivors = [int(g) for g in np.asarray(survivor_gids).ravel()]
+        dropped = [int(g) for g in np.asarray(dropped_gids).ravel()]
+        self.stats["rounds"] += 1
+        if not dropped and len(survivors) >= self.threshold:
+            # full survival: pairwise masks cancel, clients reveal their
+            # own b_i directly — nothing to reconstruct
+            return True
+        self.stats["faulty_rounds"] += 1
+        if len(survivors) < self.threshold:
+            self.stats["unmask_failures"] += 1
+            obs.inc("secagg_unmask_failures_total")
+            return False
+        self._ensure_shares()
+        holders = sorted(survivors)[: self.threshold]
+        b_true, sk_true = self._truth
+        for g in dropped:
+            got = shamir.reconstruct(
+                [self._ka_shares[g][h] for h in holders]
+            )
+            if got != sk_true[g]:
+                raise RuntimeError(
+                    f"Shamir recovery of client {g}'s pair key diverged "
+                    f"from its dealt secret at round {round_idx}"
+                )
+            self.stats["recovered_pair_keys"] += 1
+            obs.inc("secagg_mask_recovery_total", kind="pair_key")
+        for g in survivors:
+            got = shamir.reconstruct(
+                [self._self_shares[g][h] for h in holders]
+            )
+            if got != b_true[g]:
+                raise RuntimeError(
+                    f"Shamir recovery of client {g}'s self-mask seed "
+                    f"diverged from its dealt secret at round {round_idx}"
+                )
+            self.stats["recovered_self_seeds"] += 1
+            obs.inc("secagg_mask_recovery_total", kind="self_seed")
+        return True
+
+    # -- reporting --------------------------------------------------------
+
+    def describe(self) -> str:
+        w = ("uniform" if self.counts is None
+             else f"n_k (budget {self.spec.total_weight})")
+        return (f"field scale={self.spec.scale} clip={self.spec.clip:g} "
+                f"weights={w} shamir t={self.threshold}/{self.cohort_size} "
+                f"quant_err<={self.spec.quantization_error:.3g}")

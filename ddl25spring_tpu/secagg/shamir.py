@@ -1,0 +1,66 @@
+"""Shamir secret sharing over GF(p), p = 2⁶¹ − 1 (a Mersenne prime).
+
+The dropout-recovery half of secure aggregation: every client deals shares
+of its mask seeds to the whole cohort at setup, and when it drops
+mid-round the server reconstructs the seed from any ``threshold`` shares
+held by survivors (protocol.py wires this to the resilience layer's
+drop/straggle masks).
+
+Pure Python by design — secrets here are 32-bit PRNG seeds, not tensors,
+so there is nothing to accelerate, and keeping the module jax-free lets
+host-side tooling (tools/obs_report.py pipelines, tests' import guard)
+load it without dragging a runtime in.  Determinism comes from the
+caller-supplied ``random.Random``; nothing in this module draws global
+randomness.
+"""
+
+from __future__ import annotations
+
+import random
+
+# 2**61 - 1: large enough that uint32 seeds embed without reduction, small
+# enough that Lagrange arithmetic stays in native ints
+PRIME = (1 << 61) - 1
+
+
+def share(secret: int, nr_shares: int, threshold: int,
+          rng: random.Random) -> list[tuple[int, int]]:
+    """Split ``secret`` into ``nr_shares`` points of a random degree
+    ``threshold - 1`` polynomial with ``f(0) = secret``; any ``threshold``
+    of the returned ``(x, f(x))`` pairs reconstruct it, fewer reveal
+    nothing (information-theoretically)."""
+    if not 1 <= threshold <= nr_shares:
+        raise ValueError(
+            f"threshold={threshold} must be in [1, nr_shares={nr_shares}]"
+        )
+    secret = int(secret) % PRIME
+    coeffs = [secret] + [rng.randrange(PRIME) for _ in range(threshold - 1)]
+    shares = []
+    for x in range(1, nr_shares + 1):
+        # Horner evaluation of the polynomial at x
+        acc = 0
+        for c in reversed(coeffs):
+            acc = (acc * x + c) % PRIME
+        shares.append((x, acc))
+    return shares
+
+
+def reconstruct(shares: list[tuple[int, int]]) -> int:
+    """Lagrange-interpolate ``f(0)`` from ``(x, y)`` shares.  The caller
+    must pass at least the dealing threshold many DISTINCT points; with
+    fewer, the result is an arbitrary field element (no error is
+    detectable — that is the security property)."""
+    xs = [x for x, _ in shares]
+    if len(set(xs)) != len(xs):
+        raise ValueError(f"duplicate share x-coordinates: {sorted(xs)}")
+    secret = 0
+    for i, (xi, yi) in enumerate(shares):
+        num, den = 1, 1
+        for j, (xj, _) in enumerate(shares):
+            if i == j:
+                continue
+            num = (num * (-xj)) % PRIME
+            den = (den * (xi - xj)) % PRIME
+        # Fermat inverse: p is prime, den != 0 since x-coords are distinct
+        secret = (secret + yi * num * pow(den, PRIME - 2, PRIME)) % PRIME
+    return secret

@@ -355,3 +355,67 @@ def test_int8_tensor_parallel_generate_matches_replicated():
         functools.partial(generate, qcfg, max_new_tokens=10)
     ).lower(qparams_tp, prompt).compile()
     assert "all-reduce" in compiled.as_text()
+
+
+# --------------------------------------------------------------------------
+# int8 uplink codec: round-trip properties (parallel/compress.py)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_int8_roundtrip_error_bounded_per_leaf(seed):
+    """Property-style round-trip bound: stochastic rounding moves a value
+    to floor or ceil of x/scale, so the per-coordinate error is strictly
+    below ONE quantization step (1 x scale) — NOT scale/2, which only
+    round-to-nearest would give.  Checked per leaf over a pytree of mixed
+    shapes/magnitudes, plus unbiasedness within 4 sigma."""
+    from ddl25spring_tpu.parallel.compress import int8_decode, int8_encode
+
+    key = jax.random.key(seed)
+    k1, k2, k3, kq = jax.random.split(key, 4)
+    tree = {
+        "w": 3.0 * jax.random.normal(k1, (64, 32)),
+        "b": 1e-3 * jax.random.normal(k2, (128,)),
+        "s": 50.0 * jax.random.normal(k3, ()),
+        "step": jnp.int32(7),  # non-inexact: must pass through untouched
+    }
+    q, s = int8_encode(tree, kq)
+    dec = int8_decode(q, s, like=tree)
+
+    for name in ("w", "b", "s"):
+        leaf = np.asarray(tree[name], np.float64)
+        got = np.asarray(dec[name], np.float64)
+        scale = float(np.max(np.abs(leaf)) / 127.0) if leaf.size else 0.0
+        err = np.max(np.abs(got - leaf)) if leaf.size else 0.0
+        assert err < scale * (1.0 + 1e-6), (
+            f"{name}: err {err} >= one step {scale}"
+        )
+    # integer leaves ride through the codec bit-identically
+    assert dec["step"].dtype == jnp.int32
+    assert int(dec["step"]) == 7
+
+    # unbiasedness: E[decode(encode(x))] == x; the mean error over n
+    # coordinates concentrates within ~4*scale/sqrt(12 n)
+    w = np.asarray(tree["w"], np.float64)
+    got_w = np.asarray(dec["w"], np.float64)
+    scale_w = float(np.max(np.abs(w)) / 127.0)
+    tol = 4.0 * scale_w / np.sqrt(12.0 * w.size)
+    assert abs(np.mean(got_w - w)) < tol
+
+
+def test_int8_roundtrip_zero_preserving():
+    """Exact zeros encode to exactly zero (floor(0) = 0, p_up = 0) and
+    decode to exactly zero — sparsity survives the codec, and an all-zero
+    leaf survives despite the 1e-12 scale floor."""
+    from ddl25spring_tpu.parallel.compress import int8_decode, int8_encode
+
+    key = jax.random.key(9)
+    dense = np.array(jax.random.normal(key, (32, 16)))
+    dense[::2] = 0.0  # half the rows exactly zero
+    tree = {"mixed": jnp.asarray(dense), "allzero": jnp.zeros((17,))}
+    q, s = int8_encode(tree, jax.random.key(10))
+    dec = int8_decode(q, s, like=tree)
+
+    assert np.all(np.asarray(q["mixed"])[::2] == 0)
+    assert np.all(np.asarray(dec["mixed"])[::2] == 0.0)
+    assert np.all(np.asarray(q["allzero"]) == 0)
+    assert np.all(np.asarray(dec["allzero"]) == 0.0)

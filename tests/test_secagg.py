@@ -1,0 +1,587 @@
+"""Secure-aggregation tests (ddl25spring_tpu.secagg + fl engine wiring).
+
+The load-bearing oracle: for every linear server type the masked field sum
+must equal — BIT-EXACTLY — a plaintext integer-field sum computed with no
+mask code at all, including rounds where clients drop and Shamir recovery
+runs.  The two sides use independent bookkeeping (client-side vmap
+masking vs server-side survivor x dropped residue), so agreement checks
+the cancellation algebra rather than restating it.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.data import load_mnist, split_dataset
+from ddl25spring_tpu.fl import (
+    FedAvgServer,
+    FedOptServer,
+    FedSgdGradientServer,
+    FedSgdWeightServer,
+    mnist_task,
+)
+from ddl25spring_tpu.fl.fedbuff import FedBuffServer
+from ddl25spring_tpu.resilience.faults import FaultPlan
+from ddl25spring_tpu.secagg import shamir
+from ddl25spring_tpu.secagg.field import FieldSpec, decode_sum, encode
+from ddl25spring_tpu.secagg.protocol import SecAgg
+
+REPO = Path(__file__).resolve().parent.parent
+
+NR_CLIENTS = 16
+COHORT = 8  # client_fraction 0.5
+
+
+@pytest.fixture(scope="module")
+def small_mnist():
+    return load_mnist(n_train=512, n_test=128)
+
+
+@pytest.fixture(scope="module")
+def task(small_mnist):
+    ds = small_mnist
+    return mnist_task(ds.test_x, ds.test_y)
+
+
+@pytest.fixture(scope="module")
+def clients_padded(small_mnist):
+    ds = small_mnist
+    return split_dataset(ds.train_x, ds.train_y, nr_clients=NR_CLIENTS,
+                         iid=True, seed=0, pad_multiple=32)
+
+
+@pytest.fixture(scope="module")
+def clients_pad1(small_mnist):
+    ds = small_mnist
+    return split_dataset(ds.train_x, ds.train_y, nr_clients=NR_CLIENTS,
+                         iid=True, seed=0, pad_multiple=1)
+
+
+def make_secagg(client_data, threshold_frac=0.5, clip=4.0, seed=3):
+    return SecAgg(NR_CLIENTS, COHORT, counts=np.asarray(client_data.counts),
+                  clip=clip, threshold_frac=threshold_frac, seed=seed)
+
+
+def trees_bitwise_equal(a, b):
+    return all(
+        (np.asarray(x) == np.asarray(y)).all()
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def max_tree_diff(a, b):
+    return max(
+        float(np.max(np.abs(np.asarray(x, np.float64)
+                            - np.asarray(y, np.float64))))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# --------------------------------------------------------------------------
+# shamir.py
+# --------------------------------------------------------------------------
+
+def test_shamir_roundtrip_any_threshold_subset():
+    import itertools
+    import random as pyrandom
+
+    rng = pyrandom.Random(7)
+    secret = 0xDEADBEEF
+    shares = shamir.share(secret, nr_shares=6, threshold=3, rng=rng)
+    assert len(shares) == 6
+    for subset in itertools.combinations(shares, 3):
+        assert shamir.reconstruct(list(subset)) == secret
+
+
+def test_shamir_below_threshold_reveals_nothing_detectable():
+    import random as pyrandom
+
+    # with t-1 shares the interpolation yields SOME field element with no
+    # error signal — that absence of detectability IS the security property
+    rng = pyrandom.Random(1)
+    secret = 12345
+    shares = shamir.share(secret, nr_shares=5, threshold=3, rng=rng)
+    got = shamir.reconstruct(shares[:2])
+    assert isinstance(got, int)
+    assert got != secret  # overwhelmingly likely for this seed; pinned
+
+
+def test_shamir_rejects_bad_inputs():
+    import random as pyrandom
+
+    rng = pyrandom.Random(0)
+    with pytest.raises(ValueError, match="threshold"):
+        shamir.share(1, nr_shares=3, threshold=4, rng=rng)
+    with pytest.raises(ValueError, match="threshold"):
+        shamir.share(1, nr_shares=3, threshold=0, rng=rng)
+    shares = shamir.share(1, nr_shares=3, threshold=2, rng=rng)
+    with pytest.raises(ValueError, match="duplicate"):
+        shamir.reconstruct([shares[0], shares[0]])
+
+
+# --------------------------------------------------------------------------
+# field.py: the overflow budget and the quantization bound
+# --------------------------------------------------------------------------
+
+def test_fieldspec_picks_largest_scale_satisfying_budget():
+    int32_max = (1 << 31) - 1
+    for clip, w in [(4.0, 250), (1.0, 8), (0.5, 100000), (10.0, 26)]:
+        spec = FieldSpec.for_budget(clip, w)
+        # the documented budget formula holds at the chosen scale ...
+        assert w * (clip * spec.scale + 0.5) <= int32_max
+        # ... and fails at the next integer scale (largest-scale property)
+        assert w * (clip * (spec.scale + 1) + 0.5) > int32_max
+        assert spec.quantization_error == 0.5 / spec.scale
+        spec.check_budget()
+
+
+def test_fieldspec_budget_exhausted_raises():
+    with pytest.raises(ValueError, match="overflow budget exhausted"):
+        FieldSpec.for_budget(clip=1e6, total_weight=1 << 20)
+    with pytest.raises(ValueError, match="clip"):
+        FieldSpec.for_budget(clip=0.0, total_weight=10)
+
+
+def test_encode_decode_weighted_sum_exact_and_bounded():
+    # worst-case-ish load: values beyond the clip (must clamp), weights
+    # summing to the budgeted total — the modular sum must still be EXACT
+    # in the integer field, and the weighted mean within 0.5/scale of the
+    # float64 mean of the clipped messages
+    clip = 1.0
+    weights = np.array([7000, 9000, 5000, 11000], dtype=np.int64)
+    spec = FieldSpec.for_budget(clip, int(weights.sum()))
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(-2.0, 2.0, size=(4, 33)).astype(np.float32)
+
+    encs = [np.asarray(encode({"v": jnp.asarray(v)}, spec)["v"])
+            for v in vals]
+    # modular weighted sum, wraparound emulated exactly in uint64
+    total = np.zeros(33, dtype=np.uint64)
+    for w, e in zip(weights, encs):
+        total = (total + np.uint64(w) * e.astype(np.uint64)) & 0xFFFFFFFF
+    total = total.astype(np.uint32)
+
+    # the float32 clip+round the encoder applies, replayed in float64:
+    # scale is < 2^24 here so float32(v)*scale rounds identically
+    clipped = np.clip(vals.astype(np.float64), -clip, clip)
+    q = np.asarray(jnp.round(jnp.float32(clipped) * spec.scale), np.int64)
+    true_int_sum = (weights[:, None] * q).sum(0)
+
+    # exactness: two's-complement reinterpretation of the modular sum IS
+    # the true integer sum (the overflow budget at work)
+    assert np.array_equal(total.astype(np.int32).astype(np.int64),
+                          true_int_sum)
+
+    # documented quantization bound on the weighted mean (pure math,
+    # float64 — no float32 decode noise in the way)
+    w_total = weights.sum()
+    mean_err = np.max(np.abs(true_int_sum / spec.scale / w_total
+                             - (weights[:, None] * clipped).sum(0)
+                             / w_total))
+    assert mean_err <= spec.quantization_error + 1e-15
+
+    # and the float32 decode path agrees with the exact decode to float32
+    # roundoff
+    dec = np.asarray(
+        decode_sum({"v": jnp.asarray(total)}, spec)["v"], np.float64
+    )
+    np.testing.assert_allclose(dec, true_int_sum / spec.scale, rtol=1e-6)
+
+
+def test_encode_sanitises_nonfinite_and_rejects_int_leaves():
+    # scale < 2^24 keeps the float32 quantizer exactly reproducible here
+    spec = FieldSpec.for_budget(1.0, 1000)
+    bad = {"v": jnp.array([jnp.nan, jnp.inf, -jnp.inf, 0.25, -0.25])}
+    enc = np.asarray(encode(bad, spec)["v"])
+    # corrupt coordinates become ZERO field elements (the server cannot
+    # screen what it cannot see — docs/SECURITY.md)
+    assert enc[0] == 0 and enc[1] == 0 and enc[2] == 0
+    q = int(np.round(0.25 * spec.scale))
+    assert enc[3] == np.uint32(q)
+    # negative values land as two's complement
+    assert enc[4] == np.uint32((1 << 32) - q)
+    with pytest.raises(TypeError, match="float leaves"):
+        encode({"v": jnp.arange(3)}, spec)
+
+
+# --------------------------------------------------------------------------
+# masks.py: pairwise cancellation, the algebra the whole protocol rests on
+# --------------------------------------------------------------------------
+
+def test_mask_residue_equals_survivor_mask_sum_bitwise():
+    template = {"w": jnp.zeros((5, 3), jnp.float32),
+                "b": jnp.zeros((7,), jnp.float32)}
+    gids = jnp.array([11, 3, 8, 0, 13, 5])
+    live = jnp.array([True, True, True, True, True, False])
+    from ddl25spring_tpu.secagg import masks
+
+    for surv_np in [
+        [True, True, True, True, True, False],   # full survival
+        [True, False, True, True, False, False],  # two dropped
+        [False, False, True, False, False, False],  # one survivor
+    ]:
+        surv = jnp.array(surv_np)
+        for r in (0, 5):
+            cm = masks.cohort_masks(0, gids, live, jnp.int32(r), template)
+            res = masks.unmask_total(0, gids, live, surv, jnp.int32(r),
+                                     template)
+            tot = jax.tree.map(
+                lambda l: jnp.sum(
+                    jnp.where(surv.reshape((-1,) + (1,) * (l.ndim - 1)),
+                              l, jnp.uint32(0)),
+                    axis=0, dtype=jnp.uint32),
+                cm,
+            )
+            assert trees_bitwise_equal(tot, res), (surv_np, r)
+
+
+def test_masks_vary_by_round_and_pair_seed_is_symmetric():
+    from ddl25spring_tpu.secagg import masks
+
+    t = {"w": jnp.zeros((4,), jnp.float32)}
+    gids = jnp.array([2, 9])
+    live = jnp.ones((2,), jnp.bool_)
+    m0 = masks.cohort_masks(0, gids, live, jnp.int32(0), t)
+    m1 = masks.cohort_masks(0, gids, live, jnp.int32(1), t)
+    assert not trees_bitwise_equal(m0, m1)
+    assert int(masks.pair_seed(0, 2, 9)) == int(masks.pair_seed(0, 9, 2))
+    assert int(masks.pair_seed(0, 2, 9)) != int(masks.pair_seed(1, 2, 9))
+
+
+# --------------------------------------------------------------------------
+# protocol.py: host-side Shamir bookkeeping
+# --------------------------------------------------------------------------
+
+def test_secagg_recover_counts_and_verifies():
+    sa = SecAgg(10, 5, counts=np.full(10, 40), clip=2.0,
+                threshold_frac=0.6, seed=1)
+    assert sa.threshold == 3
+    assert sa.recover(list(range(5)), [], 0)  # full survival: no recovery
+    assert sa.stats["faulty_rounds"] == 0
+    assert sa.recover([0, 2, 4], [6, 8], 1)
+    assert sa.stats["recovered_pair_keys"] == 2
+    assert sa.stats["recovered_self_seeds"] == 3
+    assert not sa.recover([1, 2], [3, 4, 5], 2)  # below threshold
+    assert sa.stats["unmask_failures"] == 1
+
+
+def test_secagg_validates_construction():
+    with pytest.raises(ValueError, match="threshold_frac"):
+        SecAgg(10, 5, threshold_frac=0.0)
+    with pytest.raises(ValueError, match="cohort_size"):
+        SecAgg(10, 11)
+    with pytest.raises(ValueError, match="counts shape"):
+        SecAgg(10, 5, counts=np.ones(3))
+
+
+# --------------------------------------------------------------------------
+# import hygiene: host-side secagg modules must stay jax-free
+# --------------------------------------------------------------------------
+
+def test_secagg_host_modules_are_jax_free():
+    # the package itself (lazy __getattr__), the Shamir arithmetic and the
+    # FieldSpec budget accounting must import AND work without pulling jax
+    # — same guard as tests/test_obs.py for the obs surface
+    code = ("import sys, random; "
+            "import ddl25spring_tpu.secagg; "
+            "import ddl25spring_tpu.secagg.shamir as sh; "
+            "from ddl25spring_tpu.secagg.field import FieldSpec; "
+            "spec = FieldSpec.for_budget(4.0, 250); "
+            "assert spec.scale >= 1; spec.check_budget(); "
+            "s = sh.share(99, 5, 3, random.Random(0)); "
+            "assert sh.reconstruct(s[:3]) == 99; "
+            "assert 'jax' not in sys.modules, 'secagg import pulled jax'; "
+            "print('ok')")
+    out = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+# --------------------------------------------------------------------------
+# engine wiring: the bit-exact oracle, tier-1 edition
+# --------------------------------------------------------------------------
+
+DROP_PLAN = "drop=0.3,seed=11"
+
+
+def test_tiny_masked_round_bit_exact_with_dropout():
+    """End-to-end masked round on a toy least-squares task — small enough
+    to compile inside the tier-1 budget, still exercising the full path:
+    sampling, fault masks, encode, two independent mask codepaths, in-trace
+    unmask, Shamir host recovery.  The MNIST-scale versions of this check
+    (every server type) are the @slow tests below."""
+    from ddl25spring_tpu.fl.engine import make_fl_round
+
+    nr_clients, n_i, d = 12, 4, 6
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(nr_clients, n_i, d)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(nr_clients, n_i)), jnp.float32)
+    counts = jnp.full((nr_clients,), n_i, jnp.int32)
+
+    def client_update(params, xi, yi, ci, key):
+        resid = xi @ params["w"] - yi
+        grad = xi.T @ resid / n_i
+        return {"w": params["w"] - 0.1 * grad}
+
+    sa = SecAgg(nr_clients, 6, counts=np.full(nr_clients, n_i), clip=4.0,
+                threshold_frac=0.5, seed=5)
+    rf = make_fl_round(client_update, x, y, counts, nr_sampled=6,
+                       secagg=sa,
+                       fault_plan=FaultPlan.parse("drop=0.4,seed=3"))
+    params = {"w": jnp.zeros((d,), jnp.float32)}
+    base_key = jax.random.PRNGKey(42)
+    saw_drop = False
+    for r in range(4):
+        field_sum, plain, nr_surv = rf.secagg_oracle(params, base_key, r)
+        assert trees_bitwise_equal(field_sum, plain), f"round {r}"
+        saw_drop |= int(nr_surv) < 6
+        params = rf(params, base_key, r)
+    assert saw_drop, "seeded plan injected no drops in 4 rounds"
+    assert sa.stats["rounds"] == 4
+    assert (sa.stats["recovered_pair_keys"]
+            + sa.stats["recovered_self_seeds"]) > 0
+    assert np.isfinite(np.asarray(params["w"])).all()
+
+
+def _assert_bit_exact_rounds(server, sa, nr_rounds=4):
+    """Every round's masked field sum equals the plaintext integer-field
+    sum bitwise, while params advance through the real secagg round (so
+    dropout draws differ per round and Shamir recovery actually runs)."""
+    rf = server.round_fn
+    params = server.params
+    nr_exercised = 0
+    for r in range(nr_rounds):
+        field_sum, plain, nr_surv = rf.secagg_oracle(
+            params, server.run_key, r
+        )
+        assert trees_bitwise_equal(field_sum, plain), f"round {r}"
+        if int(nr_surv) < COHORT:
+            nr_exercised += 1
+        params = rf(params, server.run_key, r)
+    return nr_exercised
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedavg_secagg_bit_exact_with_dropout(task, clients_padded):
+    sa = make_secagg(clients_padded)
+    srv = FedAvgServer(task, 0.05, 32, clients_padded, 0.5, 1, 3,
+                       secagg=sa, fault_plan=FaultPlan.parse(DROP_PLAN))
+    dropped_rounds = _assert_bit_exact_rounds(srv, sa)
+    assert dropped_rounds > 0, "seeded plan injected no drops in 4 rounds"
+    assert sa.stats["recovered_pair_keys"] > 0
+    assert sa.stats["recovered_self_seeds"] > 0
+    assert sa.stats["unmask_failures"] == 0
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedsgd_gradient_secagg_bit_exact_with_dropout(task, clients_pad1):
+    sa = make_secagg(clients_pad1)
+    srv = FedSgdGradientServer(task, 0.05, clients_pad1, 0.5, 3,
+                               secagg=sa,
+                               fault_plan=FaultPlan.parse(DROP_PLAN))
+    _assert_bit_exact_rounds(srv, sa, nr_rounds=3)
+    assert sa.stats["rounds"] == 3
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedsgd_weight_secagg_bit_exact_with_dropout(task, clients_pad1):
+    sa = make_secagg(clients_pad1)
+    srv = FedSgdWeightServer(task, 0.05, clients_pad1, 0.5, 3,
+                             secagg=sa,
+                             fault_plan=FaultPlan.parse(DROP_PLAN))
+    _assert_bit_exact_rounds(srv, sa, nr_rounds=3)
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedopt_secagg_bit_exact_with_dropout(task, clients_padded):
+    sa = make_secagg(clients_padded)
+    srv = FedOptServer(task, 0.05, 32, clients_padded, 0.5, 1, 3,
+                       server_optimizer="adam", server_lr=0.01,
+                       secagg=sa, fault_plan=FaultPlan.parse(DROP_PLAN))
+    # FedOpt's round_fn wraps the aggregate round; the oracle must be
+    # surfaced through the wrapper
+    assert srv.round_fn.secagg is sa
+    _assert_bit_exact_rounds(srv, sa, nr_rounds=3)
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedbuff_secagg_bit_exact_with_dropout(task, clients_padded):
+    sa = make_secagg(clients_padded)
+    srv = FedBuffServer(task, 0.05, 32, clients_padded, 0.5, 1, 3,
+                        staleness_window=3, secagg=sa,
+                        fault_plan=FaultPlan.parse(DROP_PLAN))
+    rf = srv.round_fn
+    h = srv.params
+    for r in range(3):
+        field_sum, plain, _ = rf.secagg_oracle(h, srv.run_key, r)
+        assert trees_bitwise_equal(field_sum, plain), f"tick {r}"
+        h = rf(h, srv.run_key, r)
+    assert sa.stats["rounds"] == 3
+
+
+# --------------------------------------------------------------------------
+# accuracy: secagg tracks plaintext within the documented bound
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedavg_secagg_matches_plaintext_within_quant_bound(
+        task, clients_padded):
+    sa = make_secagg(clients_padded)
+    sec = FedAvgServer(task, 0.05, 32, clients_padded, 0.5, 1, 3, secagg=sa)
+    plain = FedAvgServer(task, 0.05, 32, clients_padded, 0.5, 1, 3)
+    p_sec = sec.round_fn(sec.params, sec.run_key, 0)
+    p_plain = plain.round_fn(plain.params, plain.run_key, 0)
+    # one round's delta-mean differs by at most the fixed-point
+    # quantization error (clip is far above any first-round delta, so the
+    # clamp is inactive and the plaintext mean IS the clipped mean);
+    # 2x headroom for float32 normalisation order
+    assert max_tree_diff(p_sec, p_plain) <= 2 * sa.spec.quantization_error
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedbuff_secagg_matches_plaintext_within_quant_bound(
+        task, clients_padded):
+    sa = make_secagg(clients_padded)
+    sec = FedBuffServer(task, 0.05, 32, clients_padded, 0.5, 1, 3,
+                        staleness_window=1, secagg=sa)
+    plain = FedBuffServer(task, 0.05, 32, clients_padded, 0.5, 1, 3,
+                          staleness_window=1)
+    h_sec = sec.round_fn(sec.params, sec.run_key, 0)
+    h_plain = plain.round_fn(plain.params, plain.run_key, 0)
+    assert max_tree_diff(h_sec, h_plain) <= 2 * sa.spec.quantization_error
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_secagg_off_is_the_plaintext_program(task, clients_padded):
+    # secagg=None must take the exact pre-secagg code path: same build,
+    # same round_fn attrs, deterministic params
+    a = FedAvgServer(task, 0.05, 32, clients_padded, 0.5, 1, 3)
+    b = FedAvgServer(task, 0.05, 32, clients_padded, 0.5, 1, 3, secagg=None)
+    assert a.round_fn.secagg is None and b.round_fn.secagg is None
+    assert not hasattr(a.round_fn, "secagg_oracle")
+    pa = a.round_fn(a.params, a.run_key, 0)
+    pb = b.round_fn(b.params, b.run_key, 0)
+    assert trees_bitwise_equal(pa, pb)
+
+
+# --------------------------------------------------------------------------
+# below-threshold rounds: the in-trace floor and the host accounting agree
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_below_threshold_round_keeps_params_and_counts_failure(
+        task, clients_padded):
+    # drop rate high enough that some seeded round falls under t = 0.9*8
+    sa = make_secagg(clients_padded, threshold_frac=0.9)
+    srv = FedAvgServer(task, 0.05, 32, clients_padded, 0.5, 1, 3,
+                       secagg=sa,
+                       fault_plan=FaultPlan.parse("drop=0.5,seed=2"))
+    rf = srv.round_fn
+    params = srv.params
+    nr_failed = 0
+    for r in range(6):
+        _, _, nr_surv = rf.secagg_oracle(params, srv.run_key, r)
+        new_params = rf(params, srv.run_key, r)
+        if int(nr_surv) < sa.threshold:
+            nr_failed += 1
+            # jitted floor: params carried over bit-identically
+            assert trees_bitwise_equal(new_params, params), f"round {r}"
+        else:
+            assert not trees_bitwise_equal(new_params, params), f"round {r}"
+        params = new_params
+    assert nr_failed > 0, "seeded plan never fell below threshold"
+    # host accounting saw the SAME rounds fail
+    assert sa.stats["unmask_failures"] == nr_failed
+
+
+# --------------------------------------------------------------------------
+# build-time rejections
+# --------------------------------------------------------------------------
+
+def test_engine_rejects_incompatible_secagg_combinations(
+        task, clients_padded):
+    from ddl25spring_tpu.robust import make_krum
+
+    sa = make_secagg(clients_padded)
+    with pytest.raises(ValueError, match="robust"):
+        FedAvgServer(task, 0.05, 32, clients_padded, 0.5, 1, 3,
+                     secagg=sa, aggregator=make_krum(1, 1))
+    with pytest.raises(ValueError, match="dropout_rate"):
+        FedAvgServer(task, 0.05, 32, clients_padded, 0.5, 1, 3,
+                     secagg=sa, dropout_rate=0.2)
+    with pytest.raises(ValueError, match="compress"):
+        FedAvgServer(task, 0.05, 32, clients_padded, 0.5, 1, 3,
+                     secagg=sa, compress="int8")
+
+
+def test_hfl_config_validates_secagg_fields():
+    from ddl25spring_tpu.configs import HflConfig
+
+    with pytest.raises(ValueError, match="secagg_clip"):
+        HflConfig(secagg=True, secagg_clip=0.0)
+    with pytest.raises(ValueError, match="secagg_threshold"):
+        HflConfig(secagg=True, secagg_threshold=1.5)
+    cfg = HflConfig(secagg=True)  # defaults validate
+    assert cfg.secagg_clip == 4.0 and cfg.secagg_threshold == 0.5
+
+
+def test_run_hfl_guards_reject_secagg_combinations():
+    from ddl25spring_tpu.configs import HflConfig
+    from ddl25spring_tpu.run_hfl import build_server
+
+    base = dict(secagg=True, nr_clients=NR_CLIENTS, client_fraction=0.5,
+                nr_rounds=1)
+    with pytest.raises(ValueError, match="robust aggregator"):
+        build_server(HflConfig(aggregator="krum", **base))
+    with pytest.raises(ValueError, match="dropout-rate"):
+        build_server(HflConfig(dropout_rate=0.1, **base))
+    with pytest.raises(ValueError, match="double-quantize"):
+        build_server(HflConfig(compress="topk", **base))
+    with pytest.raises(ValueError, match="scaffold"):
+        build_server(HflConfig(algorithm="scaffold", **base))
+
+
+# --------------------------------------------------------------------------
+# obs counters
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_secagg_obs_counters(task, clients_padded, tmp_path):
+    from ddl25spring_tpu import obs
+
+    sa = make_secagg(clients_padded)
+    srv = FedAvgServer(task, 0.05, 32, clients_padded, 0.5, 1, 3,
+                       secagg=sa,
+                       fault_plan=FaultPlan.parse(DROP_PLAN))
+    obs.enable(str(tmp_path / "t.jsonl"))
+    try:
+        params = srv.params
+        for r in range(4):
+            params = srv.round_fn(params, srv.run_key, r)
+        snap = obs.get().snapshot()
+    finally:
+        obs.disable()
+    counters = snap["counter"]
+    assert counters["secagg_rounds_total"]["value"] == 4
+    # uplink model: 4 bytes/coordinate x sampled clients x rounds
+    nr_coords = sum(l.size for l in jax.tree.leaves(params))
+    assert (counters["secagg_bytes_total"]["value"]
+            == 4 * COHORT * 4 * nr_coords)
+    assert snap["gauge"]["secagg_bytes_per_round"]["value"] \
+        == COHORT * 4 * nr_coords
+    # the drop plan forced Shamir recoveries, labelled by kind
+    recovered = sum(
+        st["value"] for name, st in counters.items()
+        if name.startswith("secagg_mask_recovery_total")
+    )
+    assert recovered == (sa.stats["recovered_pair_keys"]
+                         + sa.stats["recovered_self_seeds"])
+    assert recovered > 0

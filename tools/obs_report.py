@@ -494,6 +494,33 @@ def report(events: list[dict], top: int) -> None:
             print("  serving: " + "   ".join(
                 f"{k.replace('_', ' ')}: {v}" for k, v in serv_res.items()))
 
+    # -- secure aggregation ----------------------------------------------
+    sa_rounds = _value(counters, "secagg_rounds_total")
+    take(counters, "secagg_rounds_total")
+    sa_bytes = _value(counters, "secagg_bytes_total")
+    take(counters, "secagg_bytes_total")
+    sa_bpr = _value(gauges, "secagg_bytes_per_round")
+    take(gauges, "secagg_bytes_per_round")
+    sa_recov = take(counters, "secagg_mask_recovery_total")
+    sa_fail = _value(counters, "secagg_unmask_failures_total")
+    take(counters, "secagg_unmask_failures_total")
+    if (sa_rounds is not None or sa_bytes is not None or sa_recov
+            or sa_fail is not None):
+        section("secure aggregation")
+        if sa_rounds is not None or sa_bytes is not None:
+            print(f"  masked rounds: {sa_rounds or 0}   encoded uplink: "
+                  f"{fmt_bytes(sa_bytes or 0)}"
+                  + (f"   ({fmt_bytes(sa_bpr)}/round)" if sa_bpr else ""))
+        if sa_recov:
+            kinds_s = ", ".join(
+                f"{lb.get('kind', '?')} x{st['value']}"
+                for lb, st in sorted(sa_recov,
+                                     key=lambda ls: -ls[1]["value"]))
+            print(f"  Shamir mask recoveries: {kinds_s}")
+        if sa_fail is not None:
+            print(f"  unmask failures (below-threshold rounds, params "
+                  f"kept): {sa_fail}")
+
     # -- timeline / critical path ----------------------------------------
     report_timeline(events, top)
 
