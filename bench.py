@@ -445,10 +445,12 @@ def _probe_device_with_retry(attempts: int = 6, timeout_s: float = 90.0,
         # structured probe trail (round 5 ran blind for ~10 min against an
         # unreachable device with only log-tail evidence): one event per
         # attempt, flushed line-by-line, survives the os._exit failure path
-        obs.event("bench.probe", attempt=i + 1, attempts=attempts,
-                  timeout_s=timeout_s,
-                  outcome="ok" if up else "timeout",
-                  elapsed_s=round(time.perf_counter() - t0, 3))
+        probe = {"attempt": i + 1, "attempts": attempts,
+                 "timeout_s": timeout_s,
+                 "outcome": "ok" if up else "timeout",
+                 "elapsed_s": round(time.perf_counter() - t0, 3)}
+        _PROBE_TRAIL.append(probe)
+        obs.event("bench.probe", **probe)
         if up:
             _stamp("device reachable")
             return True
@@ -459,9 +461,145 @@ def _probe_device_with_retry(attempts: int = 6, timeout_s: float = 90.0,
 
 
 METRIC = "fedavg_cifar10_resnet18_256clients_rounds_per_sec"
+CPU_TREND_METRIC = METRIC + "_cpu_trend"
 # module-scope so the first two emitters can't each lazily create their own
 # lock and both slip past the guard (the exact race the guard exists for)
 _EMIT_LOCK = threading.Lock()
+# probe trail mirrored host-side so the partial capture can persist it even
+# when telemetry is disabled (obs events only land in --telemetry's JSONL)
+_PROBE_TRAIL: list = []
+
+
+def run_cpu_trend(nr_rounds: int = 2):
+    """Fixed tiny-config CPU trend: FedAvg, synthetic data, ResNet-18,
+    8 clients, C=0.25, B=16 — the same jitted engine round as the
+    headline metric at a scale a CPU finishes in seconds.
+
+    NOT comparable to the TPU headline (different scale on a different
+    chip); it IS comparable to every other cpu_trend number, which is the
+    point: when the device is unreachable, BENCH_*.json still lands a
+    number that moves when the engine regresses.  Prints its own single
+    JSON line (metric ``*_cpu_trend``)."""
+    t_start = time.perf_counter()
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.data.cifar import cifar_input_transform
+    from ddl25spring_tpu.data.synth_device import device_synthetic_clients
+    from ddl25spring_tpu.fl import FedAvgServer
+    from ddl25spring_tpu.fl.task import classification_task
+    from ddl25spring_tpu.models import ResNet18
+
+    client_data, test_x, test_y = device_synthetic_clients(
+        nr_clients=8, n_train=256, n_test=64, seed=10, pad_multiple=16,
+    )
+    task = classification_task(
+        ResNet18(), (32, 32, 3), test_x, test_y,
+        input_transform=cifar_input_transform(jnp.float32),
+    )
+    server = FedAvgServer(
+        task, lr=0.05, batch_size=16, client_data=client_data,
+        client_fraction=0.25, nr_local_epochs=1, seed=10,
+    )
+    _stamp("cpu trend: warmup round (jit compile) ...")
+    params = server.round_fn(server.params, server.run_key, 0)
+    _sync(params)
+    _stamp("cpu trend: timing ...")
+    t0 = time.perf_counter()
+    for r in range(1, nr_rounds + 1):
+        params = server.round_fn(params, server.run_key, r)
+    _sync(params)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": CPU_TREND_METRIC,
+        "value": round(nr_rounds / dt, 4),
+        "unit": "rounds/sec",
+        "config": {"nr_clients": 8, "cohort": 2, "batch_size": 16,
+                   "n_train": 256, "rounds_timed": nr_rounds,
+                   "model": "resnet18", "data": "synthetic"},
+        "wall_s": round(time.perf_counter() - t_start, 1),
+    }))
+    sys.stdout.flush()
+
+
+def _cpu_fallback_trend(timeout_s: float) -> dict:
+    """Measure the CPU trend in a FRESH ``JAX_PLATFORMS=cpu`` subprocess.
+
+    The parent's backend may be the very thing that's wedged (ops that
+    block forever, round-1 postmortem), so the trend never runs in this
+    process: a clean interpreter with a pinned-CPU env either finishes
+    inside ``timeout_s`` or is killed, and the parent stays in control
+    of its one-JSON-line contract either way."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.abspath(__file__), "--cpu-trend"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        return {"error": f"cpu trend subprocess exceeded {timeout_s:.0f}s"}
+    except OSError as e:
+        return {"error": f"cpu trend subprocess failed to start: {e}"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if parsed.get("metric") == CPU_TREND_METRIC:
+            return parsed
+    return {"error": f"cpu trend subprocess exited {proc.returncode} "
+                     "without a metric line",
+            "stderr_tail": proc.stderr[-500:]}
+
+
+def _persist_partial_capture(reason: str, args, **extra) -> str | None:
+    """Write what the failed run DID learn (probe trail, elapsed, argv,
+    telemetry pointer) next to the other bench artifacts; returns the
+    path, or None when even that write fails.  A dead tunnel used to
+    reduce a whole bench invocation to one error string — the capture
+    keeps the evidence."""
+    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "bench_partial_capture.json")
+        payload = {
+            "error": reason,
+            "elapsed_s": round(time.perf_counter() - _T0, 1),
+            "argv": sys.argv[1:],
+            "telemetry": args.telemetry or None,
+            "probe_events": list(_PROBE_TRAIL),
+            **extra,
+        }
+        with open(path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+        return path
+    except OSError:
+        return None
+
+
+def _fail_with_cpu_fallback(reason: str, args):
+    """Shared device-unreachable exit: persist the partial capture, land
+    the CPU-fallback trend, emit the one JSON line, exit nonzero."""
+    obs.flush()
+    capture = _persist_partial_capture(reason, args)
+    trend: dict = {"error": "cpu fallback disabled"}
+    if args.cpu_fallback_timeout_s > 0:
+        _stamp("device unreachable -> measuring CPU-fallback trend ...")
+        trend = _cpu_fallback_trend(args.cpu_fallback_timeout_s)
+        if "value" in trend:
+            _stamp(f"cpu trend: {trend['value']} rounds/sec")
+        else:
+            _stamp(f"cpu trend failed: {trend.get('error')}")
+        obs.event("bench.cpu_fallback", **{
+            k: v for k, v in trend.items() if k in ("value", "error")})
+        obs.flush()
+    _emit_json(0.0, error=reason, partial_capture=capture,
+               cpu_fallback=trend)
+    # nonzero so scripts/CI keyed on exit status see the failure; daemon
+    # probe threads may be wedged in the backend, so skip shutdown
+    os._exit(1)
 
 
 def _emit_json(value: float, *, error: str | None = None, **extra) -> bool:
@@ -534,6 +672,11 @@ class _Watchdog:
 
 
 def main():
+    # --cpu-trend must pin CPU BEFORE any platform selection touches the
+    # backend — it exists precisely for the case where the accelerator
+    # path is broken (also the fresh-subprocess entry of the fallback)
+    if "--cpu-trend" in sys.argv[1:]:
+        os.environ["JAX_PLATFORMS"] = "cpu"
     from ddl25spring_tpu.utils.platform import select_platform
 
     select_platform()
@@ -568,6 +711,20 @@ def main():
                          "one fused fori_loop program (the gap measures "
                          "per-dispatch tunnel latency)")
     ap.add_argument("--measure-cpu-baseline", action="store_true")
+    ap.add_argument("--cpu-trend", action="store_true",
+                    help="run ONLY the tiny fixed-config CPU trend "
+                         "(8 synthetic clients, C=0.25, ResNet-18) and "
+                         "print its JSON line — the probe-failure path "
+                         "runs this in a fresh subprocess so every "
+                         "BENCH_*.json carries a comparable number even "
+                         "with the device down")
+    ap.add_argument("--cpu-fallback-timeout-s", type=float,
+                    default=float(os.environ.get(
+                        "DDL25_CPU_FALLBACK_TIMEOUT_S", 300.0)),
+                    help="wall-clock cap for the CPU-fallback trend "
+                         "subprocess on the device-unreachable path; "
+                         "0 disables the fallback "
+                         "(env DDL25_CPU_FALLBACK_TIMEOUT_S)")
     ap.add_argument("--cost-analysis", action="store_true",
                     help="emit XLA's cost analysis of one compiled round "
                          "(flops, bytes accessed) as the JSON line instead "
@@ -639,6 +796,9 @@ def main():
     if args.measure_cpu_baseline:
         measure_cpu_baseline()
         return
+    if args.cpu_trend:
+        run_cpu_trend()
+        return
 
     if args.telemetry:
         # per-line JSONL flushes, so probe events survive even the
@@ -659,26 +819,23 @@ def main():
         reason = _cpu_only_error(args.probe_timeout_s)
         if reason is not None:
             _stamp(f"fail-fast: {reason}")
+            _PROBE_TRAIL.append({"attempt": 0, "outcome": "cpu_only",
+                                 "reason": reason})
             obs.event("bench.probe", attempt=0, outcome="cpu_only",
                       reason=reason)
-            obs.flush()
-            _emit_json(0.0, error=reason)
-            os._exit(1)
+            _fail_with_cpu_fallback(reason, args)
 
     _stamp("probing device ...")
     if not _probe_device_with_retry(attempts=args.probe_attempts,
                                     timeout_s=args.probe_timeout_s,
                                     pause_s=args.probe_pause_s):
-        obs.flush()
         # one well-formed JSON line either way: a hung tunnel must not hang
-        # the driver, and value 0 is unambiguous about what happened
-        _emit_json(0.0, error="device unreachable: trivial op never "
-                              f"completed across {args.probe_attempts} "
-                              f"probe attempts of {args.probe_timeout_s:.0f}s "
-                              "(remote TPU tunnel down?)")
-        # nonzero so scripts/CI keyed on exit status see the failure; daemon
-        # probe threads may be wedged in the backend, so skip shutdown
-        os._exit(1)
+        # the driver, value 0 is unambiguous about what happened, and the
+        # cpu_fallback trend keeps a comparable engine number in BENCH_*.json
+        _fail_with_cpu_fallback(
+            "device unreachable: trivial op never completed across "
+            f"{args.probe_attempts} probe attempts of "
+            f"{args.probe_timeout_s:.0f}s (remote TPU tunnel down?)", args)
 
     global _WATCHDOG
     _WATCHDOG = _Watchdog(args.deadline_s)

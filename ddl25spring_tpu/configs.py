@@ -79,6 +79,16 @@ class HflConfig:
     attack: str = "none"       # none | label-flip | gaussian | sign-flip |
     #                            alie (collusive mu + z*sigma; robust/attacks)
     nr_malicious: int = 0
+    attack_fraction: float = 0.0  # in-round Byzantine draw: each sampled
+    #                            client turns malicious with this probability
+    #                            per round (seeded, composes with
+    #                            nr_malicious; robust.byzantine_round_mask)
+    attack_seed: int = 0       # seed of the per-round Byzantine draw
+    # validation round gate (resilience.ValidationGate): server holdout
+    # eval of each round's decoded aggregate; "" = off
+    val_gate: str = ""         # "" | skip | clip | restore
+    val_gate_tolerance: float = 1.0  # accuracy points below best-so-far
+    #                            a round may score before rejection
     # operational fault injection (resilience/faults.py spec grammar, e.g.
     # "drop=0.2,nan=0.05,seed=7"; "" = no plan, exact fault-free program)
     fault_spec: str = ""
@@ -92,6 +102,12 @@ class HflConfig:
     #                            encoding (the field's value bound)
     secagg_threshold: float = 0.5  # fraction of the cohort whose Shamir
     #                            shares must survive to unmask a round
+    secagg_groups: int = 1     # > 1: group-wise masked sessions — the
+    #                            server decodes one aggregate per group and
+    #                            can robust-reduce over them (the ONLY way
+    #                            secagg composes with --aggregator; privacy
+    #                            granularity drops to group-of-size-m sums,
+    #                            docs/SECURITY.md)
     # harness
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # rounds; 0 = off
@@ -138,6 +154,25 @@ class HflConfig:
             raise ValueError(
                 f"secagg_threshold must be in (0, 1], got "
                 f"{self.secagg_threshold}"
+            )
+        if self.secagg_groups < 1:
+            raise ValueError(
+                f"secagg_groups must be >= 1, got {self.secagg_groups}"
+            )
+        if not 0.0 <= self.attack_fraction <= 1.0:
+            raise ValueError(
+                f"attack_fraction must be in [0, 1], got "
+                f"{self.attack_fraction}"
+            )
+        if self.val_gate not in ("", "skip", "clip", "restore"):
+            raise ValueError(
+                f"val_gate must be '' | skip | clip | restore, got "
+                f"{self.val_gate!r}"
+            )
+        if self.val_gate_tolerance < 0:
+            raise ValueError(
+                f"val_gate_tolerance must be >= 0, got "
+                f"{self.val_gate_tolerance}"
             )
 
 

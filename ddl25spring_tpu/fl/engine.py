@@ -256,6 +256,8 @@ def make_fl_round(
     apply_aggregate=None,
     attack=None,
     malicious_mask=None,
+    attack_fraction: float = 0.0,
+    attack_seed: int = 0,
     mesh=None,
     clients_axis: str = "clients",
     dropout_rate: float = 0.0,
@@ -285,6 +287,15 @@ def make_fl_round(
 
     ``attack(update_i, params, key_i) -> update_i`` optionally corrupts the
     updates of clients where ``malicious_mask`` is set (Byzantine simulation).
+    ``attack_fraction > 0`` adds IN-ROUND injection on top: a seeded
+    per-round Byzantine membership draw (``robust.attacks.
+    byzantine_round_mask``, a pure function of ``(attack_seed, round_idx)``
+    in the
+    resilience/faults.py discipline — it traces under jit and replays
+    eagerly for the telemetry counter) is OR-ed into the static mask, so
+    the malicious coalition re-rolls every round and composes with
+    dropout, stragglers, and ``client_chunk`` streaming exactly like the
+    fault masks (drawn cohort-globally, sliced per chunk).
 
     ``dropout_rate`` simulates client failures/stragglers — the failure class
     the reference has no handling for (SURVEY.md §5: no retry, no straggler
@@ -383,11 +394,17 @@ def make_fl_round(
     ``encode`` degrades non-finite uplinks to zero contributions instead),
     rounds with fewer than the Shamir threshold of survivors keep the
     previous params (the same in-trace floor as an all-faulted round), the
-    round is forced onto the stacked path, and robust aggregators /
-    ``dropout_rate`` / ``compress`` are rejected at build time
-    (docs/SECURITY.md).  DP composes as clip → encode → mask → sum →
-    decode → noise: the Gaussian mechanism lands on the decoded aggregate
-    server-side.
+    round is forced onto the stacked path, and ``dropout_rate`` /
+    ``compress`` are rejected at build time (docs/SECURITY.md).  Robust
+    aggregators are rejected only for FLAT sessions: with
+    ``secagg.nr_groups > 1`` the cohort is partitioned per round into G
+    masking groups (``masks.group_assignment``), each group is its own
+    field-sum session with its own Shamir floor, and the robust rule
+    consumes the G decoded GROUP aggregates weighted by surviving group
+    weight — the server learns one aggregate per group instead of one per
+    cohort, the privacy-granularity tradeoff docs/SECURITY.md documents.
+    DP composes as clip → encode → mask → sum → decode → noise: the
+    Gaussian mechanism lands on the decoded aggregate server-side.
 
     ``donate = True`` donates the params argument of the jitted round so
     XLA may write the new params into the input buffer (the scan-carry
@@ -409,6 +426,17 @@ def make_fl_round(
             "dropout_rate cannot combine with a custom aggregator: robust "
             "aggregators ignore aggregation weights, so zero-weight dropout "
             "would silently not exclude anyone"
+        )
+    if not 0.0 <= attack_fraction <= 1.0:
+        raise ValueError(
+            f"attack_fraction={attack_fraction} outside [0, 1] — it is the "
+            "per-round probability that a sampled client turns Byzantine"
+        )
+    if attack_fraction and attack is None:
+        raise ValueError(
+            "attack_fraction > 0 needs an update attack: the in-round draw "
+            "only selects WHO is malicious, the attack callable says what "
+            "they send"
         )
     if dp_clip < 0 or dp_noise_mult < 0:
         raise ValueError("dp_clip and dp_noise_mult must be >= 0")
@@ -463,13 +491,17 @@ def make_fl_round(
             "full-precision stack is materialised first, so a reduced-"
             "precision copy would only ADD memory"
         )
+    secagg_groups = getattr(secagg, "nr_groups", 1) if secagg is not None else 1
     if secagg is not None:
-        if aggregator is not None:
+        if aggregator is not None and secagg_groups <= 1:
             raise ValueError(
-                "secagg cannot combine with a custom (robust) aggregator: "
-                "robust rules need per-client updates in the clear, and the "
-                "whole point of secure aggregation is that the server only "
-                "ever sees the masked sum"
+                "secagg cannot combine with a custom (robust) aggregator at "
+                "nr_groups=1: robust rules need per-client updates in the "
+                "clear, and flat secure aggregation only ever shows the "
+                "server ONE masked sum.  Build the SecAgg session with "
+                "nr_groups > 1 (group-wise masked sums) so the robust rule "
+                "consumes decoded GROUP aggregates instead — the "
+                "privacy-granularity tradeoff docs/SECURITY.md documents"
             )
         if dropout_rate:
             raise ValueError(
@@ -503,7 +535,11 @@ def make_fl_round(
     if mesh is not None:
         axis = mesh.shape[clients_axis]
         padded = -(-nr_sampled // axis) * axis
-        if padded != nr_sampled and aggregator is not None:
+        if padded != nr_sampled and (aggregator is not None
+                                     or secagg_groups > 1):
+            # robust aggregators would be distorted by zero-weight duplicate
+            # rows; group-mode secagg sizes its static per-group thresholds
+            # from the UNPADDED cohort, so padding would shift the floors
             mesh = None
         elif padded > nr_clients:
             mesh = None
@@ -551,9 +587,15 @@ def make_fl_round(
     if apply_aggregate is None:
         apply_aggregate = lambda params, agg: agg
 
-    mal_mask = (
-        jnp.asarray(malicious_mask) if attack is not None else jnp.zeros((0,))
-    )
+    if attack is not None:
+        # a static mask is optional once the in-round draw exists: pure
+        # attack_fraction runs pass malicious_mask=None
+        mal_mask = (
+            jnp.zeros((nr_clients,), jnp.bool_) if malicious_mask is None
+            else jnp.asarray(malicious_mask)
+        )
+    else:
+        mal_mask = jnp.zeros((0,))
 
     # Client data enters the jitted program as ARGUMENTS, not closure
     # captures: a captured concrete array is baked into the lowered HLO as a
@@ -596,6 +638,15 @@ def make_fl_round(
         mal = (
             jnp.take(mal_mask, sel, axis=0) if attack is not None else None
         )
+        if attack is not None and attack_fraction > 0:
+            from ..robust.attacks import byzantine_round_mask
+
+            # in-round Byzantine injection: drawn cohort-globally (like the
+            # fault masks) so the chunked paths slice it and see the exact
+            # stacked-path coalition
+            mal = mal | byzantine_round_mask(
+                attack_seed, round_idx, nr_shard, attack_fraction
+            )
 
         def client_messages(sel_g, keys_g, mal_g, f_nan_g, f_inf_g):
             """Local updates + uplink pipeline (attack, compression, fault
@@ -786,7 +837,7 @@ def make_fl_round(
             return _secagg_aggregate(
                 params, sel, live, round_idx, updates, cs,
                 (f_keep, f_nan, f_inf, f_late), add_dp_noise, clip_updates,
-                oracle,
+                agg_key, oracle,
             )
 
         if fault_plan is not None:
@@ -838,7 +889,7 @@ def make_fl_round(
         return tree_select(any_survivor, new_params, params), stats
 
     def _secagg_aggregate(params, sel, live, round_idx, updates, cs, fmasks,
-                          add_dp_noise, clip_updates, oracle):
+                          add_dp_noise, clip_updates, agg_key, oracle):
         """Masked fixed-point aggregation replacing the plaintext weighted
         sum: encode each client's message into the shared uint32 field, add
         its pairwise-cancelling + self masks, modular-sum the SURVIVORS'
@@ -887,6 +938,12 @@ def make_fl_round(
 
         def wrow(t, m):
             return m.reshape((-1,) + (1,) * (t.ndim - 1))
+
+        if secagg_groups > 1:
+            return _secagg_grouped_aggregate(
+                params, sel, live, surv, stats, round_idx, enc, omega_f,
+                omega_u, wrow, add_dp_noise, agg_key, oracle,
+            )
 
         cohort = sa_masks.cohort_masks(
             secagg.seed, sel, live, round_idx, params
@@ -943,6 +1000,113 @@ def make_fl_round(
         aggregate = add_dp_noise(aggregate, jnp.maximum(nr_surv, 1))
         new_params = apply_aggregate(params, aggregate)
         out = tree_select(ok, new_params, params)
+        return (out, stats) if fault_plan is not None else out
+
+    def _secagg_grouped_aggregate(params, sel, live, surv, stats, round_idx,
+                                  enc, omega_f, omega_u, wrow, add_dp_noise,
+                                  agg_key, oracle):
+        """Group-wise masked aggregation (``secagg.nr_groups > 1``): the
+        cohort is partitioned per round into G masking groups
+        (``masks.group_assignment``, a seeded fold_in chain), pair masks
+        cancel only WITHIN a group, and each group's modular sum decodes
+        independently — so the ``aggregator`` (by construction a robust
+        rule, or the default mean) consumes G decoded group aggregates
+        weighted by surviving group weight instead of per-client updates.
+        Per-group Shamir floors exclude an unrecoverable group by
+        substitution (neutral row + zero weight, the faulted-client
+        discipline); only an all-groups-unrecoverable round keeps the
+        previous params.  The floors apply the SAME predicate as
+        ``protocol.SecAgg.recover_grouped``'s host bookkeeping, so obs
+        unmask-failure counts match the compiled exclusions round for
+        round.  ``oracle=True`` returns ``(group field sums, plaintext
+        group field sums, per-group survivor counts)`` — all stacked with
+        leading axis G — for the per-group bit-exactness tests."""
+        from ..secagg import field as sa_field
+        from ..secagg import masks as sa_masks
+
+        G = secagg_groups
+        groups = sa_masks.group_assignment(
+            secagg.seed, round_idx, nr_shard, G
+        )
+        cohort = sa_masks.cohort_masks(
+            secagg.seed, sel, live, round_idx, params, groups=groups
+        )
+        masked = jax.tree.map(
+            lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
+        )
+
+        def gsum(ml):
+            contrib = jnp.where(wrow(ml, surv), ml, jnp.uint32(0))
+            return jnp.zeros(
+                (G,) + ml.shape[1:], jnp.uint32
+            ).at[groups].add(contrib)
+
+        totals = jax.tree.map(gsum, masked)
+        residues = sa_masks.group_unmask_totals(
+            secagg.seed, sel, live, surv, groups, G, round_idx, params
+        )
+        field_sums = jax.tree.map(jnp.subtract, totals, residues)
+        nr_surv_g = jnp.zeros((G,), jnp.int32).at[groups].add(
+            surv.astype(jnp.int32)
+        )
+        if oracle:
+            # plaintext per-group integer field sums, again with no mask
+            # code involved — the group-gated cancellation algebra is what
+            # the bitwise assertion checks
+            plain = jax.tree.map(
+                lambda e: jnp.zeros(
+                    (G,) + e.shape[1:], jnp.uint32
+                ).at[groups].add(
+                    jnp.where(wrow(e, surv), e * wrow(e, omega_u),
+                              jnp.uint32(0))
+                ),
+                enc,
+            )
+            return field_sums, plain, nr_surv_g
+
+        denom_g = jnp.zeros((G,), jnp.float32).at[groups].add(
+            jnp.where(surv, omega_f, 0.0)
+        )
+        thresholds = jnp.asarray(secagg.group_thresholds, jnp.int32)
+        ok_g = (nr_surv_g >= thresholds) & (denom_g > 0)
+        dec = sa_field.decode_sum(field_sums, secagg.spec)
+
+        def grow(t, v):  # broadcast a (G,) vector over group rows
+            return v.reshape((-1,) + (1,) * (t.ndim - 1))
+
+        safe_denom = jnp.where(ok_g, denom_g, jnp.float32(1.0))
+        gmean = jax.tree.map(lambda d: d / grow(d, safe_denom), dec)
+        if compress_deltas:
+            gupdates = jax.tree.map(
+                lambda p, m: jnp.where(
+                    grow(m, ok_g),
+                    p[None].astype(jnp.float32) + m,
+                    p[None].astype(jnp.float32),
+                ).astype(p.dtype),
+                params, gmean,
+            )
+        else:
+            gupdates = jax.tree.map(
+                lambda p, m: jnp.where(
+                    grow(m, ok_g), m, jnp.float32(0.0)
+                ).astype(p.dtype),
+                params, gmean,
+            )
+        any_ok = jnp.any(ok_g)
+        gweights = jnp.where(ok_g, denom_g, 0.0)
+        gweights = gweights / jnp.where(any_ok, jnp.sum(gweights), 1.0)
+        aggregate = aggregator(gupdates, gweights, agg_key)
+        aggregate = jax.tree.map(
+            lambda a, p: a.astype(p.dtype), aggregate, params
+        )
+        # DP sensitivity: survivors inside recoverable groups are the
+        # clients that actually contribute to what the server decodes
+        surv_ok = jnp.sum(
+            (jnp.take(ok_g, groups) & surv).astype(jnp.int32)
+        )
+        aggregate = add_dp_noise(aggregate, jnp.maximum(surv_ok, 1))
+        new_params = apply_aggregate(params, aggregate)
+        out = tree_select(any_ok, new_params, params)
         return (out, stats) if fault_plan is not None else out
 
     def _streaming_linear_round(params, sel, keys, mal, live, fmasks,
@@ -1157,12 +1321,16 @@ def make_fl_round(
         if (chunk is not None and custom_agg) else 1
     )
 
-    def _secagg_host_round(base_key, step):
+    def _secagg_host_round(base_key, step) -> bool:
         """Eager replay of the jitted round's sampling + fault draws so
-        the host-side Shamir bookkeeping (protocol.SecAgg.recover) sees
-        exactly the survivor set the compiled program unmasked against —
-        every input is a pure function of (key/seed, round), the property
-        resilience/faults.py establishes for its masks."""
+        the host-side Shamir bookkeeping (protocol.SecAgg.recover /
+        recover_grouped) sees exactly the survivor set — and in group
+        mode the exact per-round partition — the compiled program
+        unmasked against; every input is a pure function of (key/seed,
+        round), the property resilience/faults.py establishes for its
+        masks.  Returns True when the round is REJECTED (flat: below the
+        cohort threshold; grouped: every group unrecoverable), i.e. the
+        jitted floor kept the previous params."""
         round_key = jax.random.fold_in(base_key, step)
         sample_key = jax.random.split(round_key, 4)[0]
         sel = sample_clients(sample_key, nr_clients, nr_shard)
@@ -1174,8 +1342,45 @@ def make_fl_round(
             surv = live & f_keep & ~f_late
         else:
             surv = live
+        if secagg_groups > 1:
+            from ..secagg import masks as sa_masks
+
+            groups = sa_masks.group_assignment(
+                secagg.seed, step, nr_shard, secagg_groups
+            )
+            sel_h, live_h, surv_h, groups_h = jax.device_get(
+                (sel, live, surv, groups)
+            )
+            per_group = [
+                (
+                    sel_h[surv_h & (groups_h == g)],
+                    sel_h[live_h & ~surv_h & (groups_h == g)],
+                )
+                for g in range(secagg_groups)
+            ]
+            failures = secagg.recover_grouped(per_group, step)
+            return failures >= secagg_groups
         sel_h, live_h, surv_h = jax.device_get((sel, live, surv))
-        secagg.recover(sel_h[surv_h], sel_h[live_h & ~surv_h], step)
+        ok = secagg.recover(sel_h[surv_h], sel_h[live_h & ~surv_h], step)
+        return not ok
+
+    def _byzantine_host_count(base_key, step) -> int:
+        """Eager replay of the round's malicious-coalition draw (static
+        mask ∪ in-round byzantine_round_mask) for the telemetry counter —
+        the same pure-function-of-(seed, round) replay discipline as
+        ``_secagg_host_round``."""
+        round_key = jax.random.fold_in(base_key, step)
+        sample_key = jax.random.split(round_key, 4)[0]
+        sel = sample_clients(sample_key, nr_clients, nr_shard)
+        live = jnp.arange(nr_shard) < nr_sampled
+        mal = jnp.take(mal_mask, sel, axis=0)
+        if attack_fraction > 0:
+            from ..robust.attacks import byzantine_round_mask
+
+            mal = mal | byzantine_round_mask(
+                attack_seed, step, nr_shard, attack_fraction
+            )
+        return int(jnp.sum(mal & live))
 
     def round_fn(params, base_key, round_idx):
         # telemetry wraps the DISPATCH boundary only; under an outer
@@ -1187,7 +1392,8 @@ def make_fl_round(
             # host bookkeeping BEFORE the dispatch: a below-threshold round
             # must be counted as an unmask failure even though the jitted
             # floor silently keeps the old params
-            _secagg_host_round(base_key, int(round_idx))
+            if _secagg_host_round(base_key, int(round_idx)):
+                obs.inc("fl_round_rejected_total", reason="secagg_floor")
         if not obs.enabled() or tracer:
             out = _round(params, base_key, round_idx, x, y, counts,
                          mal_mask)
@@ -1214,6 +1420,10 @@ def make_fl_round(
         obs.inc("fl_rounds_total")
         obs.inc("fl_clients_sampled_total", nr_sampled)
         obs.set_gauge("fl_clients_per_round", nr_sampled)
+        if attack is not None:
+            nbyz = _byzantine_host_count(base_key, step)
+            if nbyz:
+                obs.inc("fl_byzantine_clients_total", nbyz)
         # traffic model: each sampled client downloads + uploads one full
         # param tree per round (2 messages/client, servers.py's count)
         obs.inc("fl_bytes_aggregated_total",

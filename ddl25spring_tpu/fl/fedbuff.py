@@ -48,6 +48,10 @@ def make_fedbuff_round(
     staleness_window: int = 4,
     staleness_exp: float = 0.5,
     server_eta: float = 1.0,
+    attack=None,
+    malicious_mask=None,
+    attack_fraction: float = 0.0,
+    attack_seed: int = 0,
     fault_plan=None,
     round_deadline_s: float | None = None,
     client_chunk: int = 0,
@@ -58,6 +62,13 @@ def make_fedbuff_round(
     ``history`` is the params pytree with a leading ``staleness_window``
     version axis (index 0 = current).  ``client_update`` has the engine
     contract ``(params, x_i, y_i, count_i, key_i) -> local_params``.
+
+    ``attack``/``malicious_mask``/``attack_fraction``/``attack_seed`` have
+    ``engine.make_fl_round`` semantics, applied to the outgoing client
+    DELTA (the async message): per-client attacks are vmapped and
+    where-selected on the malicious rows, collusive attacks see the whole
+    delta stack once (and force the stacked tick), and ``attack_fraction``
+    OR-s a seeded per-tick Byzantine membership draw into the static mask.
 
     ``fault_plan``/``round_deadline_s`` have ``engine.make_fl_round``
     semantics: in-trace per-client masks drop/corrupt/straggle the sampled
@@ -82,6 +93,15 @@ def make_fedbuff_round(
         raise ValueError(
             f"round_deadline_s={round_deadline_s} must be > 0"
         )
+    if not 0.0 <= attack_fraction <= 1.0:
+        raise ValueError(
+            f"attack_fraction={attack_fraction} outside [0, 1]"
+        )
+    if attack_fraction > 0.0 and attack is None:
+        raise ValueError(
+            "attack_fraction > 0 needs an update attack to apply — pass "
+            "attack= (robust.make_sign_flip_attack & co)"
+        )
     if fault_plan is not None and not fault_plan.affects_fl_round:
         fault_plan = None
     x = jnp.asarray(x)
@@ -90,6 +110,16 @@ def make_fedbuff_round(
     nr_clients = x.shape[0]
     W = staleness_window
     chunk = _resolve_chunk(client_chunk, nr_sampled)
+    if attack is not None and getattr(attack, "collusive", False):
+        # collusive attacks need the whole delta stack at once (shared
+        # coalition statistics) — the streaming scan never materialises it
+        chunk = None
+    if attack is not None:
+        mal_mask = (
+            jnp.zeros((nr_clients,), jnp.bool_)
+            if malicious_mask is None
+            else jnp.asarray(malicious_mask)
+        )
     if secagg is not None:
         # masked aggregation spans every live pair (engine.make_fl_round's
         # reasoning), so secagg forces the stacked tick.  The staleness
@@ -120,6 +150,18 @@ def make_fedbuff_round(
             else jax.random.randint(stale_key, (nr_sampled,), 0, W)
         )
         keys = jax.vmap(lambda c: jax.random.fold_in(round_key, c))(sel)
+        if attack is not None:
+            mal = jnp.take(mal_mask, sel, axis=0)
+            if attack_fraction > 0.0:
+                from ..robust.attacks import byzantine_round_mask
+
+                # in-round Byzantine membership, cohort-global like the
+                # fault masks so the streaming path slices it
+                mal = mal | byzantine_round_mask(
+                    attack_seed, tick_idx, nr_sampled, attack_fraction
+                )
+        else:
+            mal = jnp.zeros((nr_sampled,), jnp.bool_)
         if fault_plan is not None:
             f_keep, f_nan, f_inf, f_late = fault_plan.round_masks(
                 tick_idx, nr_sampled, round_deadline_s
@@ -127,9 +169,9 @@ def make_fedbuff_round(
         else:
             f_keep = f_nan = f_inf = f_late = None
 
-        def chunk_deltas(stale_g, sel_g, keys_g, f_nan_g, f_inf_g):
-            """Deltas + fault corruption for one group of sampled clients
-            (the whole sample on the stacked path, one chunk when
+        def chunk_deltas(stale_g, sel_g, keys_g, mal_g, f_nan_g, f_inf_g):
+            """Deltas + attack + fault corruption for one group of sampled
+            clients (the whole sample on the stacked path, one chunk when
             streaming) — shared so the two paths cannot drift."""
             xs = jnp.take(x, sel_g, axis=0)
             ys = jnp.take(y, sel_g, axis=0)
@@ -141,6 +183,27 @@ def make_fedbuff_round(
                 return jax.tree.map(jnp.subtract, local, base)
 
             deltas = jax.vmap(one_client)(stale_g, xs, ys, cs, keys_g)
+
+            if attack is not None:
+                # attacks transform the outgoing DELTA (the async message),
+                # keyed per client like the engine's update attacks
+                base0 = jax.tree.map(lambda h: h[0], history)
+                if getattr(attack, "collusive", False):
+                    deltas = attack(
+                        deltas, mal_g, base0,
+                        jax.random.fold_in(round_key, 0x5EED),
+                    )
+                else:
+                    adv = jax.vmap(attack, in_axes=(0, None, 0))(
+                        deltas, base0, keys_g
+                    )
+                    deltas = jax.tree.map(
+                        lambda a, d: jnp.where(
+                            mal_g.reshape((-1,) + (1,) * (d.ndim - 1)),
+                            a.astype(d.dtype), d,
+                        ),
+                        adv, deltas,
+                    )
 
             if fault_plan is not None and fault_plan.corrupts:
                 def _poison(d):
@@ -191,7 +254,7 @@ def make_fedbuff_round(
 
             zb = jnp.zeros((nr_sampled,), jnp.bool_)
             xs_scan = (
-                rs(stale), rs(sel), rs(keys), rs(weights),
+                rs(stale), rs(sel), rs(keys), rs(weights), rs(mal),
                 rs(f_keep if f_keep is not None else zb),
                 rs(f_nan if f_nan is not None else zb),
                 rs(f_inf if f_inf is not None else zb),
@@ -206,8 +269,11 @@ def make_fedbuff_round(
 
             def body(carry, inp):
                 acc, wsum, stats = carry
-                stale_c, sel_c, keys_c, w_c, fk_c, fn_c, fi_c, fl_c = inp
-                deltas = chunk_deltas(stale_c, sel_c, keys_c, fn_c, fi_c)
+                (stale_c, sel_c, keys_c, w_c, mal_c,
+                 fk_c, fn_c, fi_c, fl_c) = inp
+                deltas = chunk_deltas(
+                    stale_c, sel_c, keys_c, mal_c, fn_c, fi_c
+                )
                 if fault_plan is not None:
                     deltas, faulted, stats_c = screen(
                         deltas, fk_c, fn_c, fi_c, fl_c
@@ -227,7 +293,7 @@ def make_fedbuff_round(
             from ..secagg import field as sa_field
             from ..secagg import masks as sa_masks
 
-            deltas = chunk_deltas(stale, sel, keys, f_nan, f_inf)
+            deltas = chunk_deltas(stale, sel, keys, mal, f_nan, f_inf)
             live = jnp.ones((nr_sampled,), jnp.bool_)
             if fault_plan is not None:
                 surv = f_keep & ~f_late
@@ -258,6 +324,90 @@ def make_fedbuff_round(
 
             def wrow(t, m):
                 return m.reshape((-1,) + (1,) * (t.ndim - 1))
+
+            G = getattr(secagg, "nr_groups", 1)
+            if G > 1:
+                # group-wise masked sessions (the async twin of
+                # engine._secagg_grouped_aggregate): per-group field sums
+                # over the disc-folded messages, per-group Shamir floors,
+                # surviving group aggregates recombined by staleness
+                # weight.  FedBuff has no robust-aggregator hook, so the
+                # recombination is the weighted mean — equal to the flat
+                # tick (up to float order) when every group clears its
+                # floor, but degrading group-by-group instead of
+                # round-at-once when dropout bites.
+                groups = sa_masks.group_assignment(
+                    secagg.seed, tick_idx, nr_sampled, G
+                )
+                cohort = sa_masks.cohort_masks(
+                    secagg.seed, sel, live, tick_idx, current,
+                    groups=groups,
+                )
+                masked = jax.tree.map(
+                    lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
+                )
+
+                def gsum(ml):
+                    z = jnp.zeros((G,) + ml.shape[1:], jnp.uint32)
+                    return z.at[groups].add(
+                        jnp.where(wrow(ml, surv), ml, jnp.uint32(0))
+                    )
+
+                totals = jax.tree.map(gsum, masked)
+                residues = sa_masks.group_unmask_totals(
+                    secagg.seed, sel, live, surv, groups, G, tick_idx,
+                    current,
+                )
+                field_sums = jax.tree.map(jnp.subtract, totals, residues)
+                nr_surv_g = jnp.zeros((G,), jnp.int32).at[groups].add(
+                    surv.astype(jnp.int32)
+                )
+                if oracle:
+                    plain = jax.tree.map(
+                        lambda e: jnp.zeros(
+                            (G,) + e.shape[1:], jnp.uint32
+                        ).at[groups].add(
+                            jnp.where(
+                                wrow(e, surv), e * wrow(e, omega_u),
+                                jnp.uint32(0),
+                            )
+                        ),
+                        enc,
+                    )
+                    return field_sums, plain, nr_surv_g
+                denom_g = jnp.zeros((G,), jnp.float32).at[groups].add(
+                    jnp.where(surv, weights, 0.0)
+                )
+                thresholds = jnp.asarray(
+                    secagg.group_thresholds, jnp.int32
+                )
+                ok_g = (nr_surv_g >= thresholds) & (denom_g > 0)
+                dec = sa_field.decode_sum(field_sums, secagg.spec)
+                gdelta = jax.tree.map(
+                    lambda d: d / jnp.where(
+                        ok_g, denom_g, jnp.float32(1.0)
+                    ).reshape((-1,) + (1,) * (d.ndim - 1)),
+                    dec,
+                )
+                any_ok = jnp.any(ok_g)
+                gw = jnp.where(ok_g, denom_g, 0.0)
+                gw = gw / jnp.where(
+                    any_ok, jnp.sum(gw), jnp.float32(1.0)
+                )
+                delta = jax.tree.map(
+                    lambda d, c: d.astype(c.dtype),
+                    tree_weighted_mean(gdelta, gw), current,
+                )
+                new = jax.tree.map(
+                    lambda p, d: p + server_eta * d, current, delta
+                )
+                rolled = jax.tree.map(
+                    lambda h, n: jnp.roll(h, 1, axis=0).at[0].set(n),
+                    history, new,
+                )
+                # every group below its floor -> keep the whole history
+                out = tree_select(any_ok, rolled, history)
+                return (out, stats) if fault_plan is not None else out
 
             cohort = sa_masks.cohort_masks(
                 secagg.seed, sel, live, tick_idx, current
@@ -311,7 +461,7 @@ def make_fedbuff_round(
             out = tree_select(ok, rolled, history)
             return (out, stats) if fault_plan is not None else out
         else:
-            deltas = chunk_deltas(stale, sel, keys, f_nan, f_inf)
+            deltas = chunk_deltas(stale, sel, keys, mal, f_nan, f_inf)
             if fault_plan is not None:
                 # zero-weight + renormalise over survivors; an all-faulted
                 # tick divides by 1 and applies a ZERO delta (params carry
@@ -337,7 +487,10 @@ def make_fedbuff_round(
     def _secagg_host_tick(base_key, step):
         """Eager replay of the tick's sampling + fault draws for the
         host-side Shamir bookkeeping (engine._secagg_host_round's twin,
-        with the fedbuff key-split arity)."""
+        with the fedbuff key-split arity).  Returns True when the tick
+        was REJECTED (kept the previous history)."""
+        from ..secagg import masks as sa_masks
+
         round_key = jax.random.fold_in(base_key, step)
         sample_key = jax.random.split(round_key, 3)[0]
         sel = sample_clients(sample_key, nr_clients, nr_sampled)
@@ -348,15 +501,43 @@ def make_fedbuff_round(
             surv = f_keep & ~f_late
         else:
             surv = jnp.ones((nr_sampled,), jnp.bool_)
+        G = getattr(secagg, "nr_groups", 1)
+        if G > 1:
+            groups = sa_masks.group_assignment(
+                secagg.seed, step, nr_sampled, G
+            )
+            sel_h, surv_h, groups_h = jax.device_get((sel, surv, groups))
+            per_group = [
+                (sel_h[surv_h & (groups_h == g)],
+                 sel_h[~surv_h & (groups_h == g)])
+                for g in range(G)
+            ]
+            return secagg.recover_grouped(per_group, step) >= G
         sel_h, surv_h = jax.device_get((sel, surv))
-        secagg.recover(sel_h[surv_h], sel_h[~surv_h], step)
+        return not secagg.recover(sel_h[surv_h], sel_h[~surv_h], step)
+
+    def _byzantine_host_count(base_key, step) -> int:
+        """Eager replay of the tick's Byzantine coalition for the exact
+        ``fl_byzantine_clients_total`` counter."""
+        from ..robust.attacks import byzantine_round_mask
+
+        round_key = jax.random.fold_in(base_key, step)
+        sample_key = jax.random.split(round_key, 3)[0]
+        sel = sample_clients(sample_key, nr_clients, nr_sampled)
+        mal = jnp.take(mal_mask, sel, axis=0)
+        if attack_fraction > 0.0:
+            mal = mal | byzantine_round_mask(
+                attack_seed, step, nr_sampled, attack_fraction
+            )
+        return int(jnp.sum(mal.astype(jnp.int32)))
 
     def tick(history, base_key, tick_idx):
         # dispatch-boundary telemetry, same shape as engine.make_fl_round's
         # round_fn (skipped under an outer trace / with obs disabled)
         tracer = isinstance(tick_idx, jax.core.Tracer)
         if secagg is not None and not tracer:
-            _secagg_host_tick(base_key, int(tick_idx))
+            if _secagg_host_tick(base_key, int(tick_idx)):
+                obs.inc("fl_round_rejected_total", reason="secagg_floor")
         if not obs.enabled() or tracer:
             out = _tick(history, base_key, tick_idx, x, y, counts)
             return out[0] if fault_plan is not None else out
@@ -374,6 +555,10 @@ def make_fedbuff_round(
         obs.inc("fl_rounds_total")
         obs.inc("fl_clients_sampled_total", nr_sampled)
         obs.set_gauge("fl_clients_per_round", nr_sampled)
+        if attack is not None:
+            nbyz = _byzantine_host_count(base_key, step)
+            if nbyz:
+                obs.inc("fl_byzantine_clients_total", nbyz)
         # per-client traffic is ONE model version each way, not the whole
         # W-deep history
         obs.inc("fl_bytes_aggregated_total",
@@ -428,7 +613,9 @@ class FedBuffServer(_DecentralizedServer):
     def __init__(self, task, lr: float, batch_size: int, client_data,
                  client_fraction: float, nr_local_epochs: int, seed: int,
                  staleness_window: int = 4, staleness_exp: float = 0.5,
-                 server_eta: float = 1.0, fault_plan=None,
+                 server_eta: float = 1.0, attack=None, malicious_mask=None,
+                 attack_fraction: float = 0.0, attack_seed: int = 0,
+                 fault_plan=None,
                  round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
                  secagg=None):
@@ -446,6 +633,8 @@ class FedBuffServer(_DecentralizedServer):
             self.nr_clients_per_round,
             staleness_window=staleness_window,
             staleness_exp=staleness_exp, server_eta=server_eta,
+            attack=attack, malicious_mask=malicious_mask,
+            attack_fraction=attack_fraction, attack_seed=attack_seed,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate, secagg=secagg,
         )

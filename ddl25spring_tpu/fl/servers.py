@@ -132,14 +132,19 @@ class DecentralizedServer(Server):
         # 2 = weights down + up, hfl_complete.py:309,387); stateful variants
         # override (SCAFFOLD: +2 control variates)
         self.messages_per_client = 2
+        # optional resilience.ValidationGate; run_hfl installs it post-build
+        # (it needs the server's evaluator).  None -> rounds install
+        # unconditionally, the exact pre-gate behavior.
+        self.val_gate = None
 
     def _advance(self, r: int) -> None:
         """Execute round ``r`` and install its outputs — the ONE hook a
         stateful server overrides (SCAFFOLD threads c/ci through here) so
         every variant shares the timing/accounting loop below."""
-        self.params = device_sync(
-            self.round_fn(self.params, self.run_key, r)
-        )
+        new = device_sync(self.round_fn(self.params, self.run_key, r))
+        if self.val_gate is not None:
+            new, _ = self.val_gate.admit(r, self.params, new)
+        self.params = new
 
     def run(self, nr_rounds: int, start_round: int = 0,
             on_round=None) -> RunResult:
@@ -174,7 +179,9 @@ class FedSgdGradientServer(DecentralizedServer):
 
     def __init__(self, task: Task, lr: float, client_data: ClientDatasets,
                  client_fraction: float, seed: int,
-                 aggregator=None, attack=None, malicious_mask=None, mesh=None,
+                 aggregator=None, attack=None, malicious_mask=None,
+                 attack_fraction: float = 0.0, attack_seed: int = 0,
+                 mesh=None,
                  compress: str = "none", compress_ratio: float = 0.01,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
@@ -192,6 +199,7 @@ class FedSgdGradientServer(DecentralizedServer):
                 lambda p, gg: p - lr * gg, params, g
             ),
             attack=attack, malicious_mask=malicious_mask,
+            attack_fraction=attack_fraction, attack_seed=attack_seed,
             mesh=mesh,
             # gradient server: the client message IS the gradient, so
             # compression acts on it directly, not on a params delta
@@ -211,7 +219,9 @@ class FedSgdWeightServer(DecentralizedServer):
 
     def __init__(self, task: Task, lr: float, client_data: ClientDatasets,
                  client_fraction: float, seed: int,
-                 aggregator=None, attack=None, malicious_mask=None, mesh=None,
+                 aggregator=None, attack=None, malicious_mask=None,
+                 attack_fraction: float = 0.0, attack_seed: int = 0,
+                 mesh=None,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
                  robust_stack: str = "float32", secagg=None):
@@ -225,6 +235,7 @@ class FedSgdWeightServer(DecentralizedServer):
             self.nr_clients_per_round,
             aggregator=aggregator,
             attack=attack, malicious_mask=malicious_mask,
+            attack_fraction=attack_fraction, attack_seed=attack_seed,
             mesh=mesh,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate,
@@ -247,7 +258,9 @@ class FedAvgServer(DecentralizedServer):
     def __init__(self, task: Task, lr: float, batch_size: int,
                  client_data: ClientDatasets, client_fraction: float,
                  nr_local_epochs: int, seed: int,
-                 aggregator=None, attack=None, malicious_mask=None, mesh=None,
+                 aggregator=None, attack=None, malicious_mask=None,
+                 attack_fraction: float = 0.0, attack_seed: int = 0,
+                 mesh=None,
                  prox_mu: float = 0.0, dropout_rate: float = 0.0,
                  dp_clip: float = 0.0, dp_noise_mult: float = 0.0,
                  compress: str = "none", compress_ratio: float = 0.01,
@@ -269,6 +282,7 @@ class FedAvgServer(DecentralizedServer):
             self.nr_clients_per_round,
             aggregator=aggregator,
             attack=attack, malicious_mask=malicious_mask,
+            attack_fraction=attack_fraction, attack_seed=attack_seed,
             mesh=mesh, dropout_rate=dropout_rate,
             dp_clip=dp_clip, dp_noise_mult=dp_noise_mult,
             # weight server: the client message is its params delta
@@ -299,7 +313,9 @@ class FedOptServer(DecentralizedServer):
                  client_data: ClientDatasets, client_fraction: float,
                  nr_local_epochs: int, seed: int,
                  server_optimizer: str = "adam", server_lr: float = 1e-2,
-                 aggregator=None, attack=None, malicious_mask=None, mesh=None,
+                 aggregator=None, attack=None, malicious_mask=None,
+                 attack_fraction: float = 0.0, attack_seed: int = 0,
+                 mesh=None,
                  prox_mu: float = 0.0, dropout_rate: float = 0.0,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, robust_stack: str = "float32",
@@ -336,6 +352,7 @@ class FedOptServer(DecentralizedServer):
             aggregator=aggregator,
             apply_aggregate=lambda params, agg: agg,  # return w_avg itself
             attack=attack, malicious_mask=malicious_mask,
+            attack_fraction=attack_fraction, attack_seed=attack_seed,
             mesh=mesh, dropout_rate=dropout_rate,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             # no donate here: round_fn below reuses params after the

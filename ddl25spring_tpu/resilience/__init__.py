@@ -14,13 +14,15 @@ See ``docs/RESILIENCE.md`` for the failure model and recipes.
 """
 
 from .faults import FaultPlan, InjectedCrash
-from .guard import DivergenceGuard, screen_nonfinite, tree_client_isfinite
+from .guard import (DivergenceGuard, ValidationGate, screen_nonfinite,
+                    tree_client_isfinite)
 from .retry import Deadline, RetryError, backoff_delays, retry_call
 
 __all__ = [
     "FaultPlan",
     "InjectedCrash",
     "DivergenceGuard",
+    "ValidationGate",
     "screen_nonfinite",
     "tree_client_isfinite",
     "Deadline",

@@ -139,3 +139,66 @@ class DivergenceGuard:
             return self._snapshot, False
         # skip (and clip-of-nonfinite: nothing finite to scale)
         return old_params, False
+
+
+class ValidationGate:
+    """Server-side validation round gate: re-score candidate params on a
+    holdout evaluator and refuse to install rounds whose score dropped
+    more than ``tolerance`` points below the best accepted score so far.
+
+    :class:`DivergenceGuard`'s ``admit(step, old, new) -> (params, ok)``
+    contract and policy family, but the health signal is TASK-LEVEL
+    (holdout accuracy) instead of numeric (finiteness/norm) — it catches
+    Byzantine aggregates that are perfectly finite yet wreck the model.
+    The gate only ever sees the DECODED aggregate, so it composes with
+    secure aggregation: no per-client update is inspected, though the
+    accept/reject bit itself leaks one predicate of the round's aggregate
+    (docs/SECURITY.md documents the caveat).
+
+    - ``skip``     reject the round, keep the previous params;
+    - ``clip``     install a half-step ``old + 0.5 * (new - old)`` (a
+                   damped probe, accepted without re-evaluation);
+    - ``restore``  roll back to the best-scoring accepted params.
+
+    Every rejection counts through
+    ``fl_round_rejected_total{reason="val_gate"}``.
+    """
+
+    POLICIES = ("skip", "clip", "restore")
+
+    def __init__(self, evaluate, policy: str = "skip",
+                 tolerance: float = 1.0):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"policy={policy!r} not in {self.POLICIES}"
+            )
+        if tolerance < 0:
+            raise ValueError(f"tolerance must be >= 0, got {tolerance}")
+        self.evaluate = evaluate  # params -> holdout score (higher better)
+        self.policy = policy
+        self.tolerance = float(tolerance)
+        self.best_score = None  # best accepted holdout score so far
+        self._best_params = None
+        self.events = 0  # rejections so far (tests/report)
+
+    def admit(self, step: int, old_params, new_params):
+        """-> (params_to_install, ok).  ``ok`` False means the candidate
+        scored below ``best - tolerance`` and the policy intervened."""
+        score = float(self.evaluate(new_params))
+        if self.best_score is None or \
+                score >= self.best_score - self.tolerance:
+            if self.best_score is None or score > self.best_score:
+                self.best_score = score
+                self._best_params = new_params
+            return new_params, True
+
+        self.events += 1
+        obs.inc("fl_round_rejected_total", reason="val_gate")
+        obs.event("fl.val_gate_reject", step=step, policy=self.policy,
+                  score=score, best=self.best_score)
+        if self.policy == "clip":
+            damped = _clip_delta(new_params, old_params, jnp.float32(0.5))
+            return damped, False
+        if self.policy == "restore":
+            return self._best_params, False
+        return old_params, False

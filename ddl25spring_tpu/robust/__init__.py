@@ -7,6 +7,7 @@ from .aggregators import (
     make_bulyan,
 )
 from .attacks import (
+    byzantine_round_mask,
     make_alie_attack,
     make_gaussian_attack,
     make_sign_flip_attack,
@@ -14,6 +15,7 @@ from .attacks import (
 )
 
 __all__ = [
+    "byzantine_round_mask",
     "weighted_mean",
     "coordinate_median",
     "make_trimmed_mean",

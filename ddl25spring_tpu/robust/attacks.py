@@ -21,6 +21,27 @@ import numpy as np
 
 from ..data.split import ClientDatasets
 
+# domain-separation tag for the in-round Byzantine membership draw, same
+# discipline as resilience/faults.py's fault-kind tags
+_TAG_BYZ = 0xB42
+
+
+def byzantine_round_mask(seed: int, round_idx, nr: int, fraction: float):
+    """Seeded per-round Byzantine membership: each of the ``nr`` cohort
+    positions independently turns malicious with probability ``fraction``
+    this round.  A pure function of ``(seed, round_idx)`` built from the
+    same fold_in chain as ``resilience.FaultPlan.round_masks`` — it traces
+    inside the jitted round AND replays eagerly on the host, which is what
+    keeps the ``fl_byzantine_clients_total`` counter exact.  Drawn
+    cohort-globally so the streaming ``client_chunk`` paths slice it and
+    see the identical coalition as the stacked path."""
+    if fraction <= 0.0:
+        return jnp.zeros((nr,), jnp.bool_)
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(seed), _TAG_BYZ), round_idx
+    )
+    return jax.random.uniform(key, (nr,)) < fraction
+
 
 def make_gaussian_attack(sigma: float = 1.0):
     """Replace the update with pure Gaussian noise of scale ``sigma``."""

@@ -18,7 +18,14 @@ fedopt/fedbuff) over masked fixed-point sums (ddl25spring_tpu.secagg): the
 server only ever sees the cohort's modular sum, dropped clients are
 excluded via Shamir mask recovery (combine with --fault-spec drop=...),
 and --secagg-clip/--secagg-threshold size the field's overflow budget and
-the recovery threshold.  Threat model and caveats: docs/SECURITY.md.
+the recovery threshold.  ``--secagg-groups G`` (G > 1) splits each round's
+cohort into G masked sessions so the server decodes G group aggregates —
+the ONLY configuration where --secagg composes with a robust --aggregator
+(the rule then reduces over group sums instead of per-client updates;
+privacy granularity drops accordingly).  ``--attack-fraction`` draws a
+fresh seeded Byzantine coalition each round, and ``--val-gate
+skip|clip|restore`` re-scores every round's aggregate on the holdout set
+before installing it.  Threat model and caveats: docs/SECURITY.md.
 """
 
 from __future__ import annotations
@@ -111,7 +118,8 @@ def build_secagg(cfg: HflConfig, client_data):
     counts = None if cfg.dp_clip else np.asarray(client_data.counts)
     return SecAgg(cfg.nr_clients, clients_per_round, counts=counts,
                   clip=cfg.secagg_clip,
-                  threshold_frac=cfg.secagg_threshold, seed=cfg.seed)
+                  threshold_frac=cfg.secagg_threshold, seed=cfg.seed,
+                  nr_groups=cfg.secagg_groups)
 
 
 def build_server(cfg: HflConfig):
@@ -139,6 +147,23 @@ def build_server(cfg: HflConfig):
             f"algorithm {cfg.algorithm!r} would silently train with "
             "uncompressed uplinks"
         )
+    if cfg.attack_fraction and cfg.attack in ("none", "label-flip"):
+        raise ValueError(
+            "--attack-fraction draws per-round UPDATE attackers and needs "
+            f"an update attack to apply (--attack {cfg.attack!r} "
+            "is not one); pass --attack gaussian|sign-flip|alie"
+        )
+    if cfg.secagg_groups > 1 and not cfg.secagg:
+        raise ValueError(
+            "--secagg-groups > 1 configures group-wise MASKED sessions and "
+            "needs --secagg true"
+        )
+    if cfg.val_gate and cfg.algorithm in ("centralized", "scaffold"):
+        raise ValueError(
+            f"--val-gate is not wired into {cfg.algorithm!r} (it hooks the "
+            "decentralized round-install boundary, which centralized lacks "
+            "and scaffold overrides for its control-variate state)"
+        )
     if cfg.secagg:
         # reject every incompatible combination BEFORE the dataset loads;
         # docs/SECURITY.md explains each one
@@ -149,12 +174,20 @@ def build_server(cfg: HflConfig):
                 "control variates are a second per-client message the "
                 "masked-sum protocol does not cover)"
             )
-        if cfg.aggregator != "mean":
+        if cfg.aggregator != "mean" and cfg.secagg_groups <= 1:
             raise ValueError(
                 "--secagg cannot combine with a robust aggregator "
-                f"({cfg.aggregator!r}): robust rules need per-client "
-                "updates in the clear, and under secure aggregation the "
-                "server only ever sees the masked sum"
+                f"({cfg.aggregator!r}) at --secagg-groups 1: robust rules "
+                "need more than the single cohort sum the server decodes. "
+                "Pass --secagg-groups G > 1 to decode one masked sum per "
+                "group and robust-reduce over the G group aggregates "
+                "(granularity-vs-robustness tradeoff: docs/SECURITY.md)"
+            )
+        if cfg.aggregator != "mean" and cfg.algorithm == "fedbuff":
+            raise ValueError(
+                "fedbuff has no robust-aggregator hook (its grouped secagg "
+                "mode recombines group sums with the staleness-weighted "
+                "mean); drop --aggregator or use a synchronous server"
             )
         if cfg.dropout_rate:
             raise ValueError(
@@ -193,24 +226,35 @@ def build_server(cfg: HflConfig):
                                  train_x=ds.train_x, train_y=ds.train_y)
 
     if cfg.algorithm == "fedbuff":
-        # async server: deltas + staleness weights; robust aggregators and
-        # attacks operate on whole updates and are not defined for it here
-        if cfg.aggregator != "mean" or cfg.attack != "none" or cfg.dropout_rate:
+        # async server: robust aggregators reduce whole update stacks and
+        # have no hook here; attacks DO apply (they poison the outgoing
+        # delta, the async message)
+        if cfg.aggregator != "mean" or cfg.dropout_rate:
             raise ValueError(
-                "fedbuff does not combine with robust aggregators, attacks, "
-                "or dropout_rate (async staleness already models lag; "
-                "failure simulation is not wired into the delta buffer)"
+                "fedbuff does not combine with robust aggregators or "
+                "dropout_rate (async staleness already models lag; "
+                "failure simulation rides --fault-spec)"
             )
         from .fl import FedBuffServer
 
         client_data = split_dataset(ds.train_x, ds.train_y, cfg.nr_clients,
                                     cfg.iid, cfg.seed,
                                     pad_multiple=cfg.batch_size)
+        malicious = np.zeros(cfg.nr_clients, dtype=bool)
+        if cfg.nr_malicious:
+            malicious[np.random.default_rng(cfg.seed).choice(
+                cfg.nr_clients, cfg.nr_malicious, replace=False)] = True
+        attack = build_attack(cfg)
+        if cfg.attack == "label-flip":
+            client_data = flip_labels(client_data, malicious, nr_classes=10)
         return FedBuffServer(
             task, cfg.lr, cfg.batch_size, client_data, cfg.client_fraction,
             cfg.nr_local_epochs, cfg.seed,
             staleness_window=cfg.staleness_window,
             staleness_exp=cfg.staleness_exp, server_eta=cfg.server_eta,
+            attack=attack,
+            malicious_mask=malicious if attack is not None else None,
+            attack_fraction=cfg.attack_fraction, attack_seed=cfg.attack_seed,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=cfg.client_chunk,
             secagg=build_secagg(cfg, client_data),
@@ -263,6 +307,8 @@ def build_server(cfg: HflConfig):
     # it would let XLA overwrite a buffer the save is still serializing
     kw = dict(aggregator=build_aggregator(cfg), attack=attack,
               malicious_mask=malicious if attack is not None else None,
+              attack_fraction=cfg.attack_fraction,
+              attack_seed=cfg.attack_seed,
               mesh=mesh, fault_plan=fault_plan,
               round_deadline_s=round_deadline_s,
               client_chunk=cfg.client_chunk, robust_stack=cfg.robust_stack,
@@ -304,6 +350,16 @@ def run(cfg: HflConfig):
         obs.trace.ensure()  # adopt DDL25_TRACEPARENT or start a new trace
         obs_watchdog.install()
     server = build_server(cfg)
+    if cfg.val_gate:
+        from .resilience import ValidationGate
+
+        # the gate re-scores each round's candidate params with the
+        # server's own holdout evaluator (for FedBuff that wrapper already
+        # evaluates the newest history slot)
+        server.val_gate = ValidationGate(
+            server._evaluate, policy=cfg.val_gate,
+            tolerance=cfg.val_gate_tolerance,
+        )
     logger = MetricsLogger(cfg.metrics_path) if cfg.metrics_path else None
     ckpt = (Checkpointer(cfg.checkpoint_dir)
             if cfg.checkpoint_dir and cfg.checkpoint_every else None)
@@ -386,6 +442,13 @@ def run(cfg: HflConfig):
               f"self_seeds={s['recovered_self_seeds']} "
               f"unmask_failures={s['unmask_failures']} "
               f"(simulated key agreement — see docs/SECURITY.md)")
+
+    gate = getattr(server, "val_gate", None)
+    if gate is not None:
+        best = "n/a" if gate.best_score is None else f"{gate.best_score:.2f}"
+        print(f"[val-gate] policy={gate.policy} "
+              f"tolerance={gate.tolerance:g} rejections={gate.events} "
+              f"best_holdout={best}")
 
     if logger is not None:
         logger.close()

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 _TAG_SELF = 0x5E1F
 _TAG_KA = 0xCA11
 _TAG_PAIR = 0x9A12
+_TAG_GROUP = 0x6209
 
 
 def _u32(key):
@@ -94,12 +95,38 @@ def _signed(gid_a, gid_b, leaf):
     return jnp.where(gid_a < gid_b, leaf, (jnp.uint32(0) - leaf))
 
 
-def cohort_masks(seed: int, gids, live, round_idx, template):
+def group_assignment(seed: int, round_idx, nr: int, nr_groups: int):
+    """Seeded per-round random partition of the ``nr`` cohort positions
+    into ``nr_groups`` groups: a fresh permutation per round (fold_in
+    chain, same discipline as the mask seeds) dealt round-robin, so group
+    ``g`` always holds exactly ``len(range(g, nr, nr_groups))`` positions
+    — static sizes, random membership.  Pure function of
+    ``(seed, round_idx)``: traces inside the jitted round AND replays
+    eagerly for host-side per-group Shamir recovery."""
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(seed), _TAG_GROUP), round_idx
+    )
+    perm = jax.random.permutation(key, nr)
+    slots = jnp.arange(nr, dtype=jnp.int32) % jnp.int32(nr_groups)
+    return jnp.zeros((nr,), jnp.int32).at[perm].set(slots)
+
+
+def group_sizes(nr: int, nr_groups: int):
+    """Static per-group position counts under :func:`group_assignment`."""
+    return [len(range(g, nr, nr_groups)) for g in range(nr_groups)]
+
+
+def cohort_masks(seed: int, gids, live, round_idx, template, groups=None):
     """The CLIENT-side masks: a stacked pytree (leading cohort axis) where
     row a is what client ``gids[a]`` adds to its encoded message this
     round.  Rows of non-``live`` (shard padding) positions are zero, and
     pair terms are gated on the PARTNER being live — a client only runs
-    key agreement with cohort members that actually exist this round."""
+    key agreement with cohort members that actually exist this round.
+
+    With ``groups`` (a per-position group id vector, group mode) the pair
+    terms are additionally gated on SAME group membership: each group is
+    its own masking session, pairwise cancellation spans only within-group
+    live pairs, and the per-group modular sums decode independently."""
     m = gids.shape[0]
     leaves, treedef = jax.tree.flatten(template)
 
@@ -111,6 +138,8 @@ def cohort_masks(seed: int, gids, live, round_idx, template):
             gb = gids[c]
             pair = _prg_leaves(pair_seed(seed, ga, gb), round_idx, leaves)
             use = live[c] & (c != a)
+            if groups is not None:
+                use = use & (groups[c] == groups[a])
             return [
                 al + jnp.where(use, _signed(ga, gb, pl), jnp.uint32(0))
                 for al, pl in zip(acc, pair)
@@ -158,5 +187,48 @@ def unmask_total(seed: int, gids, live, survivors, round_idx, template):
         return jax.lax.fori_loop(0, m, crossing, acc)
 
     zeros = [jnp.zeros(l.shape, jnp.uint32) for l in leaves]
+    total = jax.lax.fori_loop(0, m, outer, zeros)
+    return jax.tree.unflatten(treedef, total)
+
+
+def group_unmask_totals(seed: int, gids, live, survivors, groups,
+                        nr_groups: int, round_idx, template):
+    """Group-mode server-side residues: a stacked pytree with leading axis
+    ``nr_groups`` where row g is the mask residue of group g's survivor
+    sum — that group's survivors' self masks plus its survivor×dropped
+    crossing pair terms.  One O(m²) pass accumulating into group rows,
+    instead of ``nr_groups`` calls to :func:`unmask_total`.  Like the flat
+    function this is a bookkeeping path INDEPENDENT of
+    :func:`cohort_masks`, so the per-group masked-sum == plaintext oracle
+    stays a real check of the group-gated sign conventions."""
+    m = gids.shape[0]
+    leaves, treedef = jax.tree.flatten(template)
+    dropped = live & ~survivors
+
+    def outer(i, acc):
+        gi = gids[i]
+        row = groups[i]
+        own = _prg_leaves(self_seed(seed, gi), round_idx, leaves)
+        acc = [
+            al.at[row].add(jnp.where(survivors[i], ol, jnp.uint32(0)))
+            for al, ol in zip(acc, own)
+        ]
+
+        def crossing(j, acc):
+            gj = gids[j]
+            pair = _prg_leaves(pair_seed(seed, gi, gj), round_idx, leaves)
+            use = survivors[i] & dropped[j] & (groups[j] == groups[i])
+            return [
+                al.at[row].add(
+                    jnp.where(use, _signed(gi, gj, pl), jnp.uint32(0))
+                )
+                for al, pl in zip(acc, pair)
+            ]
+
+        return jax.lax.fori_loop(0, m, crossing, acc)
+
+    zeros = [
+        jnp.zeros((nr_groups,) + l.shape, jnp.uint32) for l in leaves
+    ]
     total = jax.lax.fori_loop(0, m, outer, zeros)
     return jax.tree.unflatten(treedef, total)

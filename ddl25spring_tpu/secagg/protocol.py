@@ -52,7 +52,7 @@ class SecAgg:
 
     def __init__(self, nr_clients: int, cohort_size: int, counts=None,
                  clip: float = 4.0, threshold_frac: float = 0.5,
-                 seed: int = 0):
+                 seed: int = 0, nr_groups: int = 1):
         if not 0.0 < threshold_frac <= 1.0:
             raise ValueError(
                 f"threshold_frac={threshold_frac} outside (0, 1] — it is "
@@ -63,12 +63,30 @@ class SecAgg:
                 f"cohort_size={cohort_size} outside [1, nr_clients="
                 f"{nr_clients}]"
             )
+        if not 1 <= nr_groups <= cohort_size:
+            raise ValueError(
+                f"nr_groups={nr_groups} outside [1, cohort_size="
+                f"{cohort_size}] — every masking group needs at least one "
+                "member"
+            )
         self.nr_clients = int(nr_clients)
         self.cohort_size = int(cohort_size)
+        self.nr_groups = int(nr_groups)
         self.seed = int(seed)
+        # static per-group sizes under masks.group_assignment's round-robin
+        # deal; group membership is random per round, sizes are not
+        self.group_sizes = [
+            len(range(g, self.cohort_size, self.nr_groups))
+            for g in range(self.nr_groups)
+        ]
+        # the overflow budget only has to cover ONE group's field sum (each
+        # group decodes independently), so group mode sizes it against the
+        # largest group's worst-case weight — a strictly larger scale
+        # (better precision) than the flat cohort budget
+        budget_members = max(self.group_sizes)
         if counts is None:
             self.counts = None
-            total_weight = self.cohort_size
+            total_weight = budget_members
         else:
             self.counts = np.asarray(counts, dtype=np.int64)
             if self.counts.shape != (self.nr_clients,):
@@ -77,10 +95,21 @@ class SecAgg:
                 )
             if (self.counts < 0).any():
                 raise ValueError("client counts must be >= 0")
-            largest = np.sort(self.counts)[-self.cohort_size:]
+            largest = np.sort(self.counts)[-budget_members:]
             total_weight = int(max(1, largest.sum()))
         self.spec = FieldSpec.for_budget(clip, total_weight)
         self.threshold = max(1, math.ceil(threshold_frac * self.cohort_size))
+        self.group_thresholds = [
+            max(1, math.ceil(threshold_frac * s)) for s in self.group_sizes
+        ]
+        # Shamir dealing threshold: flat mode reconstructs from `threshold`
+        # cohort survivors; group mode reconstructs from a single GROUP's
+        # survivors, so shares must interpolate from the smallest per-group
+        # floor — the weakened collusion bound docs/SECURITY.md documents
+        self.share_threshold = (
+            self.threshold if self.nr_groups == 1
+            else min(self.group_thresholds)
+        )
         self.stats = {
             "rounds": 0,
             "faulty_rounds": 0,
@@ -107,10 +136,12 @@ class SecAgg:
               for g in range(self.nr_clients)]
         rng = random.Random(self.seed ^ _DEAL_TAG)
         self._self_shares = [
-            shamir.share(v, self.nr_clients, self.threshold, rng) for v in b
+            shamir.share(v, self.nr_clients, self.share_threshold, rng)
+            for v in b
         ]
         self._ka_shares = [
-            shamir.share(v, self.nr_clients, self.threshold, rng) for v in sk
+            shamir.share(v, self.nr_clients, self.share_threshold, rng)
+            for v in sk
         ]
         self._truth = (b, sk)
 
@@ -134,8 +165,15 @@ class SecAgg:
             self.stats["unmask_failures"] += 1
             obs.inc("secagg_unmask_failures_total")
             return False
+        self._reconstruct(survivors, dropped, round_idx)
+        return True
+
+    def _reconstruct(self, survivors, dropped, round_idx) -> None:
+        """Reconstruct the dropped clients' pair keys and the survivors'
+        self-mask seeds from ``share_threshold`` survivor-held shares,
+        verifying each against the directly-derived truth."""
         self._ensure_shares()
-        holders = sorted(survivors)[: self.threshold]
+        holders = sorted(survivors)[: self.share_threshold]
         b_true, sk_true = self._truth
         for g in dropped:
             got = shamir.reconstruct(
@@ -159,13 +197,56 @@ class SecAgg:
                 )
             self.stats["recovered_self_seeds"] += 1
             obs.inc("secagg_mask_recovery_total", kind="self_seed")
-        return True
+
+    def recover_grouped(self, per_group, round_idx: int) -> int:
+        """Group-mode host recovery for one round: ``per_group`` is a list
+        of ``(survivor_gids, dropped_gids)`` per group, in group order.
+        Each group is its own masked session with its own floor
+        ``group_thresholds[g]`` — the SAME predicate as the jitted round's
+        per-group exclusion, so every returned failure corresponds to
+        exactly one group the compiled round zero-weighted.  Returns the
+        number of unrecoverable groups (``nr_groups`` means the whole
+        round kept the previous params)."""
+        if len(per_group) != self.nr_groups:
+            raise ValueError(
+                f"per_group has {len(per_group)} entries for "
+                f"{self.nr_groups} groups"
+            )
+        self.stats["rounds"] += 1
+        failures = 0
+        faulty = False
+        for g, (survivor_gids, dropped_gids) in enumerate(per_group):
+            survivors = [int(i) for i in np.asarray(survivor_gids).ravel()]
+            dropped = [int(i) for i in np.asarray(dropped_gids).ravel()]
+            if not dropped and len(survivors) >= self.group_thresholds[g]:
+                continue  # full group survival: nothing to reconstruct
+            faulty = True
+            if len(survivors) < self.group_thresholds[g]:
+                failures += 1
+                self.stats["unmask_failures"] += 1
+                obs.inc("secagg_unmask_failures_total")
+                continue
+            self._reconstruct(survivors, dropped, round_idx)
+        if faulty:
+            self.stats["faulty_rounds"] += 1
+        return failures
 
     # -- reporting --------------------------------------------------------
 
     def describe(self) -> str:
         w = ("uniform" if self.counts is None
              else f"n_k (budget {self.spec.total_weight})")
+        if self.nr_groups > 1:
+            sz = self.group_sizes
+            th = self.group_thresholds
+            shape = (f"{sz[0]}" if min(sz) == max(sz)
+                     else f"{min(sz)}-{max(sz)}")
+            tsh = (f"{th[0]}" if min(th) == max(th)
+                   else f"{min(th)}-{max(th)}")
+            return (f"field scale={self.spec.scale} clip={self.spec.clip:g} "
+                    f"weights={w} groups={self.nr_groups}x{shape} "
+                    f"shamir t={tsh}/group (deal t={self.share_threshold}) "
+                    f"quant_err<={self.spec.quantization_error:.3g}")
         return (f"field scale={self.spec.scale} clip={self.spec.clip:g} "
                 f"weights={w} shamir t={self.threshold}/{self.cohort_size} "
                 f"quant_err<={self.spec.quantization_error:.3g}")

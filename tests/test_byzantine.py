@@ -1,0 +1,734 @@
+"""Byzantine robustness x secure aggregation (PR: group-wise masked
+aggregation, in-round attack injection, validation round gate).
+
+Oracles, mirroring the repo's established contracts:
+
+- in-round coalition draws and group partitions are pure functions of
+  ``(seed, round)`` — jit-traced and host-replayed draws agree exactly;
+- per-group masked field sums ≡ plaintext per-group integer field sums
+  BIT-EXACTLY, dropout + Shamir recovery included (the group-gated
+  cancellation algebra, two independent bookkeepings);
+- the in-trace per-group Shamir floor and the host-side
+  ``recover_grouped`` bookkeeping count the same failures round for
+  round;
+- ``attack=off`` / ``secagg=off`` paths are bit-identical to the
+  pre-existing programs; chunked vs stacked stays within the documented
+  float-sum-reorder tolerance with attacks ON;
+- robust aggregators stay near the honest mean (and beat the weighted
+  mean) under sign-flip / gaussian / ALIE coalitions at f < m/2.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.fl.engine import make_fl_round, make_local_sgd_update
+from ddl25spring_tpu.fl.fedbuff import make_fedbuff_round
+from ddl25spring_tpu.resilience import FaultPlan, ValidationGate
+from ddl25spring_tpu.robust import (
+    byzantine_round_mask,
+    coordinate_median,
+    make_alie_attack,
+    make_bulyan,
+    make_gaussian_attack,
+    make_krum,
+    make_sign_flip_attack,
+    make_trimmed_mean,
+    weighted_mean,
+)
+from ddl25spring_tpu.secagg import masks as sa_masks
+from ddl25spring_tpu.secagg.protocol import SecAgg
+
+REPO = Path(__file__).resolve().parent.parent
+
+# same tiny logistic pattern as tests/test_fl_chunked.py: jit-cheap,
+# 2 local steps so the key chain matters, ragged counts
+N, PER, D, K, BS = 12, 16, 8, 4, 8
+NR_SAMPLED = 8
+_rng = np.random.default_rng(21)
+X = _rng.normal(size=(N, PER, D)).astype(np.float32)
+Y = _rng.integers(0, K, size=(N, PER)).astype(np.int32)
+COUNTS = np.full((N,), PER, np.int32)
+COUNTS[0] = PER - 3
+
+P0 = {"w": jnp.zeros((D, K), jnp.float32),
+      "b": jnp.zeros((K,), jnp.float32)}
+KEY = jax.random.PRNGKey(3)
+
+
+def loss_fn(params, xb, yb, mask, key):
+    logits = xb @ params["w"] + params["b"]
+    ls = -jax.nn.log_softmax(logits)[jnp.arange(yb.shape[0]), yb]
+    return jnp.sum(ls * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+UPDATE = make_local_sgd_update(loss_fn, 0.05, BS, 1)
+
+
+def build(**kw):
+    return make_fl_round(UPDATE, X, Y, COUNTS, NR_SAMPLED,
+                         device_put_data=False, **kw)
+
+
+def run_rounds(rf, nr=3, p0=P0):
+    p = p0
+    for r in range(nr):
+        p = rf(p, KEY, r)
+    return p
+
+
+def max_err(a, b):
+    return max(float(jnp.max(jnp.abs(x - y)))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def tree_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def make_grouped_secagg(nr_groups=3, threshold_frac=0.5, seed=5,
+                        clip=8.0):
+    return SecAgg(N, NR_SAMPLED, counts=np.asarray(COUNTS), clip=clip,
+                  threshold_frac=threshold_frac, seed=seed,
+                  nr_groups=nr_groups)
+
+
+# --------------------------------------------------------------------------
+# byzantine_round_mask: the seeded in-round coalition draw
+# --------------------------------------------------------------------------
+
+def test_byzantine_mask_deterministic_and_varies_by_round():
+    a = byzantine_round_mask(7, 3, 64, 0.3)
+    b = byzantine_round_mask(7, 3, 64, 0.3)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert a.dtype == jnp.bool_ and a.shape == (64,)
+    c = byzantine_round_mask(7, 4, 64, 0.3)
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    # a different seed is a different coalition stream
+    d = byzantine_round_mask(8, 3, 64, 0.3)
+    assert not np.array_equal(np.asarray(a), np.asarray(d))
+
+
+def test_byzantine_mask_edges_and_rate():
+    assert not np.asarray(byzantine_round_mask(0, 0, 16, 0.0)).any()
+    assert np.asarray(byzantine_round_mask(0, 0, 16, 1.0)).all()
+    # empirical rate over many rounds tracks the fraction
+    hits = sum(int(np.sum(np.asarray(byzantine_round_mask(1, r, 32, 0.3))))
+               for r in range(50))
+    assert 0.2 < hits / (50 * 32) < 0.4
+
+
+def test_byzantine_mask_traces_under_jit():
+    eager = byzantine_round_mask(9, 2, 16, 0.25)
+    jitted = jax.jit(
+        lambda r: byzantine_round_mask(9, r, 16, 0.25)
+    )(jnp.int32(2))
+    assert np.array_equal(np.asarray(eager), np.asarray(jitted))
+
+
+# --------------------------------------------------------------------------
+# group partition: seeded, static sizes, host/trace agreement
+# --------------------------------------------------------------------------
+
+def test_group_assignment_deterministic_static_sizes():
+    G = 3
+    sizes = sa_masks.group_sizes(NR_SAMPLED, G)
+    assert sum(sizes) == NR_SAMPLED and len(sizes) == G
+    for r in range(5):
+        g1 = np.asarray(sa_masks.group_assignment(5, r, NR_SAMPLED, G))
+        g2 = np.asarray(sa_masks.group_assignment(5, r, NR_SAMPLED, G))
+        assert np.array_equal(g1, g2)
+        assert set(g1) <= set(range(G))
+        # membership is random per round but sizes NEVER change (static
+        # shapes inside jit depend on it)
+        assert [int((g1 == g).sum()) for g in range(G)] == list(sizes)
+    r0 = np.asarray(sa_masks.group_assignment(5, 0, NR_SAMPLED, G))
+    r1 = np.asarray(sa_masks.group_assignment(5, 1, NR_SAMPLED, G))
+    assert not np.array_equal(r0, r1)
+
+
+def test_group_assignment_traces_under_jit():
+    eager = sa_masks.group_assignment(5, 2, NR_SAMPLED, 3)
+    jitted = jax.jit(
+        lambda r: sa_masks.group_assignment(5, r, NR_SAMPLED, 3)
+    )(jnp.int32(2))
+    assert np.array_equal(np.asarray(eager), np.asarray(jitted))
+
+
+def test_secagg_group_construction_validates():
+    with pytest.raises(ValueError, match="nr_groups"):
+        make_grouped_secagg(nr_groups=0)
+    with pytest.raises(ValueError, match="nr_groups"):
+        make_grouped_secagg(nr_groups=NR_SAMPLED + 1)
+    sa = make_grouped_secagg(nr_groups=3)
+    assert sa.nr_groups == 3
+    assert len(sa.group_thresholds) == 3
+    # per-group threshold = ceil(frac * group size), at least 1
+    for t, s in zip(sa.group_thresholds, sa.group_sizes):
+        assert t == max(1, -(-s * 5 // 10))
+    assert "groups" in sa.describe()
+
+
+# --------------------------------------------------------------------------
+# grouped engine round: the per-group bit-exact oracle, tier-1 edition
+# --------------------------------------------------------------------------
+
+def test_tiny_grouped_masked_round_bit_exact_with_dropout_and_attack():
+    """The tentpole end-to-end, tier-1 scale: grouped masked sums under a
+    robust aggregator, seeded dropout with live Shamir recovery, an
+    in-round sign-flip coalition — per-group masked sums must equal the
+    plaintext per-group integer field sums BITWISE every round."""
+    sa = make_grouped_secagg(nr_groups=3)
+    rf = build(secagg=sa, aggregator=coordinate_median,
+               attack=make_sign_flip_attack(3.0), attack_fraction=0.3,
+               attack_seed=17,
+               fault_plan=FaultPlan.parse("drop=0.4,seed=3"))
+    params = P0
+    saw_drop = False
+    for r in range(4):
+        field_sums, plain, nr_surv_g = rf.secagg_oracle(params, KEY, r)
+        assert tree_equal(field_sums, plain), f"round {r}"
+        # oracle shapes: stacked per group
+        assert nr_surv_g.shape == (3,)
+        for leaf in jax.tree.leaves(field_sums):
+            assert leaf.shape[0] == 3 and leaf.dtype == jnp.uint32
+        saw_drop |= int(jnp.sum(nr_surv_g)) < NR_SAMPLED
+        params = rf(params, KEY, r)
+    assert saw_drop, "seeded plan injected no drops in 4 rounds"
+    assert sa.stats["rounds"] == 4
+    assert (sa.stats["recovered_pair_keys"]
+            + sa.stats["recovered_self_seeds"]) > 0
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(params))
+
+
+def test_grouped_secagg_with_robust_aggregator_not_rejected():
+    # the lifted build-time rejection: groups > 1 + robust rule builds;
+    # groups == 1 + robust rule still refuses with the pinned message
+    sa = make_grouped_secagg(nr_groups=4)
+    rf = build(secagg=sa, aggregator=make_krum(1, 1))
+    assert rf.secagg is sa
+    flat = SecAgg(N, NR_SAMPLED, counts=np.asarray(COUNTS), clip=8.0,
+                  threshold_frac=0.5, seed=5)
+    with pytest.raises(ValueError, match="robust"):
+        build(secagg=flat, aggregator=make_krum(1, 1))
+
+
+def test_grouped_unmask_failures_match_in_trace_floor_round_for_round():
+    """Satellite bugfix pin: the host-side per-group Shamir-floor
+    bookkeeping (``recover_grouped``) must count exactly the groups the
+    compiled round floored, every round.  Both sides replay the same
+    seeded draws through INDEPENDENT code (host numpy bookkeeping vs the
+    in-trace ``nr_surv_g >= thresholds`` predicate)."""
+    # high threshold + heavy dropout so groups actually fail
+    sa = make_grouped_secagg(nr_groups=3, threshold_frac=0.9)
+    rf = build(secagg=sa, aggregator=coordinate_median,
+               fault_plan=FaultPlan.parse("drop=0.5,seed=2"))
+    thresholds = np.asarray(sa.group_thresholds)
+    params = P0
+    total_floored = 0
+    for r in range(6):
+        _, _, nr_surv_g = rf.secagg_oracle(params, KEY, r)
+        floored = int((np.asarray(nr_surv_g) < thresholds).sum())
+        before = sa.stats["unmask_failures"]
+        params = rf(params, KEY, r)
+        assert sa.stats["unmask_failures"] - before == floored, f"round {r}"
+        total_floored += floored
+    assert total_floored > 0, "seeded plan floored no group in 6 rounds"
+    assert sa.stats["unmask_failures"] == total_floored
+
+
+def test_grouped_all_groups_failed_keeps_params():
+    # drop enough that some round floors EVERY group -> previous params
+    # kept bit-identically, counted as a rejected round
+    from ddl25spring_tpu import obs
+
+    sa = make_grouped_secagg(nr_groups=2, threshold_frac=1.0)
+    rf = build(secagg=sa, aggregator=coordinate_median,
+               fault_plan=FaultPlan.parse("drop=0.6,seed=9"))
+    thresholds = np.asarray(sa.group_thresholds)
+    params = P0
+    nr_all_failed = 0
+    for r in range(6):
+        _, _, nr_surv_g = rf.secagg_oracle(params, KEY, r)
+        all_failed = bool((np.asarray(nr_surv_g) < thresholds).all())
+        new = rf(params, KEY, r)
+        if all_failed:
+            nr_all_failed += 1
+            assert tree_equal(new, params), f"round {r}"
+        params = new
+    assert nr_all_failed > 0, "seeded plan never floored every group"
+
+
+def test_grouped_secagg_tracks_plaintext_grouped_mean():
+    # aggregator=None reduces the decoded group sums with the group-weight
+    # mean — one full-survival round must match the plaintext round within
+    # the fixed-point quantization error
+    sa = make_grouped_secagg(nr_groups=4)
+    rf_g = build(secagg=sa)
+    rf_p = build()
+    pg = rf_g(P0, KEY, 0)
+    pp = rf_p(P0, KEY, 0)
+    assert max_err(pg, pp) <= 2 * sa.spec.quantization_error
+
+
+# --------------------------------------------------------------------------
+# in-round attack injection: identity, composition, host-replay exactness
+# --------------------------------------------------------------------------
+
+def test_attack_off_is_bit_identical_to_no_attack_build():
+    rf_plain = build()
+    rf_armed = build(attack=make_sign_flip_attack(5.0),
+                     malicious_mask=np.zeros(N, bool),
+                     attack_fraction=0.0)
+    assert tree_equal(run_rounds(rf_plain), run_rounds(rf_armed))
+
+
+def test_chunked_matches_stacked_with_attacks_on():
+    # float-sum-reorder tolerance, the chunking module's documented
+    # contract — attacks must not break streaming equivalence
+    kw = dict(attack=make_sign_flip_attack(5.0), attack_fraction=0.3,
+              attack_seed=11)
+    assert max_err(run_rounds(build(**kw)),
+                   run_rounds(build(client_chunk=2, **kw))) < 1e-6
+
+
+def test_collusive_attack_forces_stacked_round():
+    rf = build(attack=make_alie_attack(1.5), attack_fraction=0.3,
+               client_chunk=2)
+    assert rf.client_chunk is None  # collusive sees the whole stack
+
+
+def test_in_round_draw_composes_with_dropout_and_recovers():
+    # robust rule + in-round coalition + operational dropout in one round
+    rf = build(aggregator=coordinate_median,
+               attack=make_gaussian_attack(5.0), attack_fraction=0.3,
+               attack_seed=2, fault_plan=FaultPlan.parse("drop=0.3,seed=4"))
+    p = run_rounds(rf, nr=3)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(p))
+
+
+def test_attack_fraction_validation():
+    with pytest.raises(ValueError, match="attack_fraction"):
+        build(attack_fraction=1.5, attack=make_sign_flip_attack(2.0))
+    with pytest.raises(ValueError, match="attack_fraction"):
+        build(attack_fraction=0.3)  # no attack to apply
+
+
+def test_byzantine_counter_matches_host_replay(tmp_path):
+    from ddl25spring_tpu import obs
+
+    rf = build(attack=make_sign_flip_attack(5.0), attack_fraction=0.4,
+               attack_seed=23)
+    obs.enable(str(tmp_path / "t.jsonl"))
+    try:
+        p = P0
+        for r in range(5):
+            p = rf(p, KEY, r)
+        snap = obs.get().snapshot()
+    finally:
+        obs.disable()
+    expected = sum(
+        int(np.sum(np.asarray(
+            byzantine_round_mask(23, r, NR_SAMPLED, 0.4))))
+        for r in range(5)
+    )
+    assert expected > 0
+    got = snap["counter"]["fl_byzantine_clients_total"]["value"]
+    assert got == expected
+
+
+# --------------------------------------------------------------------------
+# fedbuff: attack + grouped secagg on the async path
+# --------------------------------------------------------------------------
+
+def fedbuff_build(**kw):
+    return make_fedbuff_round(UPDATE, X, Y, COUNTS, NR_SAMPLED,
+                              staleness_window=2, **kw)
+
+
+def fedbuff_run(tick, nr=3):
+    h = jax.tree.map(lambda l: jnp.stack([l, l]), P0)
+    for r in range(nr):
+        h = tick(h, KEY, r)
+    return h
+
+
+def test_fedbuff_attack_off_is_bit_identical():
+    plain = fedbuff_build()
+    armed = fedbuff_build(attack=make_sign_flip_attack(5.0),
+                          malicious_mask=np.zeros(N, bool),
+                          attack_fraction=0.0)
+    assert tree_equal(fedbuff_run(plain), fedbuff_run(armed))
+
+
+def test_fedbuff_attack_fraction_validation():
+    with pytest.raises(ValueError, match="attack"):
+        fedbuff_build(attack_fraction=0.3)
+
+
+def test_fedbuff_grouped_masked_tick_bit_exact_under_attack():
+    sa = make_grouped_secagg(nr_groups=3, seed=8)
+    tick = fedbuff_build(secagg=sa, attack=make_sign_flip_attack(3.0),
+                         attack_fraction=0.3, attack_seed=5,
+                         fault_plan=FaultPlan.parse("drop=0.4,seed=6"))
+    h = jax.tree.map(lambda l: jnp.stack([l, l]), P0)
+    for r in range(3):
+        field_sums, plain, nr_surv_g = tick.secagg_oracle(h, KEY, r)
+        assert tree_equal(field_sums, plain), f"tick {r}"
+        assert nr_surv_g.shape == (3,)
+        h = tick(h, KEY, r)
+    assert sa.stats["rounds"] == 3
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(h))
+
+
+# --------------------------------------------------------------------------
+# robust aggregators under coalitions: bounded, and beats the mean
+# --------------------------------------------------------------------------
+
+M, DIM = 12, 24
+MU = 0.5
+
+
+def _coalition_stack(attack_name, key, f):
+    """Honest rows ~ mu + 0.05 N(0,1); the first ``f`` rows attacked
+    through the REAL attack builders (the same fns the engine vmaps).
+    ALIE at the canonical stealthy z barely biases anything at this sigma,
+    so the property test cranks z until the coalition measurably moves the
+    mean — the contract under test is "robust rule shrugs off what the
+    mean cannot", not ALIE's stealth margin."""
+    k1, k2 = jax.random.split(key)
+    honest = MU + 0.05 * jax.random.normal(k1, (M, DIM))
+    stacked = {"w": honest}
+    mal = jnp.arange(M) < f
+    params = {"w": jnp.zeros((DIM,))}
+    if attack_name == "alie":
+        attack = make_alie_attack(30.0)
+        return attack(stacked, mal, params, k2), mal
+    attack = {"sign-flip": make_sign_flip_attack(5.0),
+              "gaussian": make_gaussian_attack(5.0)}[attack_name]
+    keys = jax.random.split(k2, M)
+    adv = jax.vmap(attack, in_axes=(0, None, 0))(stacked, params, keys)
+    out = jax.tree.map(
+        lambda a, h: jnp.where(mal[:, None], a, h), adv, stacked
+    )
+    return out, mal
+
+
+AGGS = [
+    ("median", lambda f: coordinate_median, 5),
+    ("trimmed", lambda f: make_trimmed_mean(f / M), 5),
+    ("krum", lambda f: make_krum(f, 1), 5),
+    ("bulyan", lambda f: make_bulyan(f), 2),  # m >= 4f+3 caps f at 2
+]
+
+
+@pytest.mark.parametrize("attack_name", ["sign-flip", "gaussian", "alie"])
+@pytest.mark.parametrize("agg_name,make_agg,f", AGGS,
+                         ids=[a[0] for a in AGGS])
+def test_robust_aggregator_bounded_and_beats_mean(attack_name, agg_name,
+                                                  make_agg, f):
+    stacked, mal = _coalition_stack(attack_name, jax.random.PRNGKey(4), f)
+    w = jnp.full((M,), 1.0 / M)
+    key = jax.random.PRNGKey(9)
+    agg = make_agg(f)(stacked, w, key)
+    naive = weighted_mean(stacked, w, key)
+    err_r = float(jnp.max(jnp.abs(agg["w"] - MU)))
+    err_m = float(jnp.max(jnp.abs(naive["w"] - MU)))
+    # the robust rule stays near the honest center ...
+    assert err_r < 0.5, f"{agg_name} vs {attack_name}: err {err_r}"
+    # ... and strictly beats the weighted mean, which the coalition moves
+    assert err_m > 2 * err_r, \
+        f"{agg_name} vs {attack_name}: mean {err_m} robust {err_r}"
+
+
+# --------------------------------------------------------------------------
+# ValidationGate
+# --------------------------------------------------------------------------
+
+def _score_of(p):
+    return float(p["s"])
+
+
+def test_val_gate_accepts_improving_and_skips_degrading():
+    gate = ValidationGate(_score_of, policy="skip", tolerance=1.0)
+    p0 = {"s": jnp.float32(10.0)}
+    p1 = {"s": jnp.float32(12.0)}
+    out, ok = gate.admit(0, p0, p1)
+    assert ok and out is p1 and gate.best_score == 12.0
+    # within tolerance: accepted, best unchanged
+    p2 = {"s": jnp.float32(11.5)}
+    out, ok = gate.admit(1, p1, p2)
+    assert ok and out is p2 and gate.best_score == 12.0
+    # below best - tolerance: skipped, previous params kept
+    p3 = {"s": jnp.float32(3.0)}
+    out, ok = gate.admit(2, p2, p3)
+    assert not ok and out is p2
+    assert gate.events == 1
+
+
+def test_val_gate_restore_rolls_back_to_best():
+    gate = ValidationGate(_score_of, policy="restore", tolerance=0.5)
+    best = {"s": jnp.float32(20.0)}
+    gate.admit(0, {"s": jnp.float32(0.0)}, best)
+    worse = {"s": jnp.float32(18.0)}
+    out, ok = gate.admit(1, best, worse)
+    assert not ok and out is best  # rolled back to the best snapshot
+
+
+def test_val_gate_clip_installs_damped_half_step():
+    gate = ValidationGate(_score_of, policy="clip", tolerance=0.5)
+    old = {"s": jnp.float32(10.0)}
+    gate.admit(0, {"s": jnp.float32(0.0)}, old)
+    bad = {"s": jnp.float32(2.0)}
+    out, ok = gate.admit(1, old, bad)
+    assert not ok
+    assert float(out["s"]) == pytest.approx(6.0)  # old + 0.5 * (new-old)
+
+
+def test_val_gate_validates_and_counts(tmp_path):
+    from ddl25spring_tpu import obs
+
+    with pytest.raises(ValueError, match="policy"):
+        ValidationGate(_score_of, policy="bogus")
+    with pytest.raises(ValueError, match="tolerance"):
+        ValidationGate(_score_of, tolerance=-1.0)
+    gate = ValidationGate(_score_of, policy="skip", tolerance=0.0)
+    obs.enable(str(tmp_path / "t.jsonl"))
+    try:
+        gate.admit(0, {"s": jnp.float32(0.0)}, {"s": jnp.float32(5.0)})
+        gate.admit(1, {"s": jnp.float32(5.0)}, {"s": jnp.float32(1.0)})
+        snap = obs.get().snapshot()
+    finally:
+        obs.disable()
+    key = 'fl_round_rejected_total{reason="val_gate"}'
+    matches = [v for k, v in snap["counter"].items()
+               if k.startswith("fl_round_rejected_total")]
+    assert matches and matches[0]["value"] == 1
+
+
+# --------------------------------------------------------------------------
+# config + run_hfl guard matrix for the new flags
+# --------------------------------------------------------------------------
+
+def test_hfl_config_validates_new_fields():
+    from ddl25spring_tpu.configs import HflConfig
+
+    with pytest.raises(ValueError, match="secagg_groups"):
+        HflConfig(secagg=True, secagg_groups=0)
+    with pytest.raises(ValueError, match="attack_fraction"):
+        HflConfig(attack="sign-flip", attack_fraction=1.5)
+    with pytest.raises(ValueError, match="val_gate"):
+        HflConfig(val_gate="bogus")
+    with pytest.raises(ValueError, match="val_gate_tolerance"):
+        HflConfig(val_gate="skip", val_gate_tolerance=-2.0)
+    cfg = HflConfig(secagg=True, secagg_groups=3, attack="sign-flip",
+                    attack_fraction=0.3, val_gate="restore")
+    assert cfg.secagg_groups == 3
+
+
+def test_run_hfl_guards_new_flag_matrix():
+    from ddl25spring_tpu.configs import HflConfig
+    from ddl25spring_tpu.run_hfl import build_server
+
+    base = dict(nr_clients=12, client_fraction=0.5, nr_rounds=1)
+    with pytest.raises(ValueError, match="attack-fraction"):
+        build_server(HflConfig(attack_fraction=0.3, **base))
+    with pytest.raises(ValueError, match="secagg-groups"):
+        build_server(HflConfig(secagg_groups=2, **base))
+    with pytest.raises(ValueError, match="val-gate"):
+        build_server(HflConfig(val_gate="skip", algorithm="centralized",
+                               nr_rounds=1))
+    # groups == 1 + robust aggregator: still the pinned rejection,
+    # now pointing at group mode
+    with pytest.raises(ValueError, match="robust aggregator"):
+        build_server(HflConfig(secagg=True, aggregator="krum", **base))
+    # fedbuff has no robust hook even in group mode
+    with pytest.raises(ValueError, match="fedbuff"):
+        build_server(HflConfig(secagg=True, secagg_groups=2,
+                               aggregator="median", algorithm="fedbuff",
+                               **base))
+
+
+def test_run_hfl_builds_grouped_robust_server_with_gate():
+    from ddl25spring_tpu.configs import HflConfig
+    from ddl25spring_tpu.run_hfl import build_server
+
+    server = build_server(HflConfig(
+        secagg=True, secagg_groups=3, aggregator="median",
+        attack="sign-flip", attack_fraction=0.3,
+        nr_clients=12, client_fraction=0.5, nr_rounds=1,
+    ))
+    assert server.round_fn.secagg.nr_groups == 3
+    # the gate is installed post-build by run(); servers expose the slot
+    assert server.val_gate is None
+
+
+# --------------------------------------------------------------------------
+# MNIST-scale: grouped masked rounds bit-exact for EVERY server type
+# --------------------------------------------------------------------------
+
+NR_CLIENTS_MNIST, COHORT_MNIST, G_MNIST = 16, 8, 3
+
+
+@pytest.fixture(scope="module")
+def mnist_parts():
+    from ddl25spring_tpu.data import load_mnist, split_dataset
+    from ddl25spring_tpu.fl import mnist_task
+
+    ds = load_mnist(n_train=512, n_test=128)
+    task = mnist_task(ds.test_x, ds.test_y)
+    clients = split_dataset(ds.train_x, ds.train_y,
+                            nr_clients=NR_CLIENTS_MNIST, iid=True, seed=0,
+                            pad_multiple=32)
+    clients1 = split_dataset(ds.train_x, ds.train_y,
+                             nr_clients=NR_CLIENTS_MNIST, iid=True, seed=0,
+                             pad_multiple=1)
+    return task, clients, clients1
+
+
+def _mnist_grouped_secagg(client_data):
+    return SecAgg(NR_CLIENTS_MNIST, COHORT_MNIST,
+                  counts=np.asarray(client_data.counts), clip=4.0,
+                  threshold_frac=0.5, seed=3, nr_groups=G_MNIST)
+
+
+def _assert_grouped_bit_exact(server, sa, nr_rounds=3):
+    rf = server.round_fn
+    params = server.params
+    nr_dropped = 0
+    for r in range(nr_rounds):
+        field_sums, plain, nr_surv_g = rf.secagg_oracle(
+            params, server.run_key, r)
+        assert tree_equal(field_sums, plain), f"round {r}"
+        assert nr_surv_g.shape == (G_MNIST,)
+        if int(jnp.sum(nr_surv_g)) < COHORT_MNIST:
+            nr_dropped += 1
+        params = rf(params, server.run_key, r)
+    assert sa.stats["rounds"] == nr_rounds
+    return nr_dropped
+
+
+DROP_PLAN = "drop=0.3,seed=11"
+ATTACK_KW = dict(attack=make_sign_flip_attack(3.0), attack_fraction=0.3,
+                 attack_seed=13)
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedavg_grouped_secagg_robust_bit_exact(mnist_parts):
+    from ddl25spring_tpu.fl import FedAvgServer
+
+    task, clients, _ = mnist_parts
+    sa = _mnist_grouped_secagg(clients)
+    srv = FedAvgServer(task, 0.05, 32, clients, 0.5, 1, 3,
+                       secagg=sa, aggregator=coordinate_median,
+                       fault_plan=FaultPlan.parse(DROP_PLAN), **ATTACK_KW)
+    dropped = _assert_grouped_bit_exact(srv, sa, nr_rounds=4)
+    assert dropped > 0, "seeded plan injected no drops in 4 rounds"
+    assert (sa.stats["recovered_pair_keys"]
+            + sa.stats["recovered_self_seeds"]) > 0
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedsgd_gradient_grouped_secagg_robust_bit_exact(mnist_parts):
+    from ddl25spring_tpu.fl import FedSgdGradientServer
+
+    task, _, clients1 = mnist_parts
+    sa = _mnist_grouped_secagg(clients1)
+    srv = FedSgdGradientServer(task, 0.05, clients1, 0.5, 3,
+                               secagg=sa, aggregator=coordinate_median,
+                               fault_plan=FaultPlan.parse(DROP_PLAN),
+                               **ATTACK_KW)
+    _assert_grouped_bit_exact(srv, sa)
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedsgd_weight_grouped_secagg_robust_bit_exact(mnist_parts):
+    from ddl25spring_tpu.fl import FedSgdWeightServer
+
+    task, _, clients1 = mnist_parts
+    sa = _mnist_grouped_secagg(clients1)
+    srv = FedSgdWeightServer(task, 0.05, clients1, 0.5, 3,
+                             secagg=sa, aggregator=coordinate_median,
+                             fault_plan=FaultPlan.parse(DROP_PLAN),
+                             **ATTACK_KW)
+    _assert_grouped_bit_exact(srv, sa)
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedopt_grouped_secagg_robust_bit_exact(mnist_parts):
+    from ddl25spring_tpu.fl import FedOptServer
+
+    task, clients, _ = mnist_parts
+    sa = _mnist_grouped_secagg(clients)
+    srv = FedOptServer(task, 0.05, 32, clients, 0.5, 1, 3,
+                       server_optimizer="adam", server_lr=0.01,
+                       secagg=sa, aggregator=coordinate_median,
+                       fault_plan=FaultPlan.parse(DROP_PLAN), **ATTACK_KW)
+    assert srv.round_fn.secagg is sa
+    _assert_grouped_bit_exact(srv, sa)
+
+
+@pytest.mark.slow  # MNIST-scale compile; the tiny tier-1 round covers the path
+def test_fedbuff_grouped_secagg_bit_exact(mnist_parts):
+    # fedbuff has no robust-aggregator hook: grouped sessions recombine by
+    # staleness weight, so no aggregator kwarg here — attack still applies
+    from ddl25spring_tpu.fl.fedbuff import FedBuffServer
+
+    task, clients, _ = mnist_parts
+    sa = _mnist_grouped_secagg(clients)
+    srv = FedBuffServer(task, 0.05, 32, clients, 0.5, 1, 3,
+                        staleness_window=3, secagg=sa,
+                        fault_plan=FaultPlan.parse(DROP_PLAN), **ATTACK_KW)
+    rf = srv.round_fn
+    h = srv.params
+    for r in range(3):
+        field_sums, plain, nr_surv_g = rf.secagg_oracle(h, srv.run_key, r)
+        assert tree_equal(field_sums, plain), f"tick {r}"
+        assert nr_surv_g.shape == (G_MNIST,)
+        h = rf(h, srv.run_key, r)
+    assert sa.stats["rounds"] == 3
+
+
+# --------------------------------------------------------------------------
+# scenario matrix: the smoke cells ARE the acceptance demonstration
+# --------------------------------------------------------------------------
+
+def test_scenario_matrix_smoke_shows_robust_recovery(tmp_path):
+    """30%% sign-flip coalition: the weighted mean degrades while the
+    robust defense stack (median over decoded aggregates + validation
+    gate) recovers final accuracy — in plain AND secagg-grouped mode."""
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import scenario_matrix
+    finally:
+        sys.path.pop(0)
+    rc = scenario_matrix.main([
+        "--smoke", "--out", str(tmp_path), "--nr-rounds", "30",
+    ])
+    assert rc == 0
+    rows = {}
+    for cell in ("sign-flip_mean_plain_c8", "sign-flip_mean_secagg_c8",
+                 "sign-flip_median_plain_c8",
+                 "sign-flip_median_secagg_c8"):
+        res = json.loads((tmp_path / f"{cell}.json").read_text())
+        assert "skipped" not in res, cell
+        rows[cell] = res
+    for mode in ("plain", "secagg"):
+        mean_acc = rows[f"sign-flip_mean_{mode}_c8"]["final_accuracy"]
+        rob_acc = rows[f"sign-flip_median_{mode}_c8"]["final_accuracy"]
+        assert rob_acc >= 70.0, (mode, rob_acc)
+        assert mean_acc <= rob_acc - 15.0, (mode, mean_acc, rob_acc)
+    # the grouped cell really ran grouped sessions with live stats
+    g = rows["sign-flip_median_secagg_c8"]
+    assert g.get("secagg_groups", 0) > 1
+    assert g["secagg_stats"]["rounds"] == 30
+    assert (tmp_path / "summary.json").exists()

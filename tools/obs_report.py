@@ -521,6 +521,26 @@ def report(events: list[dict], top: int) -> None:
             print(f"  unmask failures (below-threshold rounds, params "
                   f"kept): {sa_fail}")
 
+    # -- attacks & defenses ----------------------------------------------
+    byz = _value(counters, "fl_byzantine_clients_total")
+    take(counters, "fl_byzantine_clients_total")
+    rejected = take(counters, "fl_round_rejected_total")
+    if byz is not None or rejected:
+        section("attacks & defenses")
+        if byz is not None:
+            line = f"  Byzantine client-rounds: {byz}"
+            if fl_clients:
+                line += (f" of {fl_clients} sampled "
+                         f"({100.0 * byz / fl_clients:.1f}%)")
+            print(line)
+        if rejected:
+            reasons = ", ".join(
+                f"{lb.get('reason', '?')} x{st['value']}"
+                for lb, st in sorted(rejected,
+                                     key=lambda ls: -ls[1]["value"]))
+            print(f"  rounds rejected (previous params kept / gated): "
+                  f"{reasons}")
+
     # -- timeline / critical path ----------------------------------------
     report_timeline(events, top)
 

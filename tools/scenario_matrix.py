@@ -1,0 +1,322 @@
+"""Attack x defense scenario matrix over the FL engine.
+
+Sweeps Byzantine update attacks against aggregation defenses on a tiny
+synthetic softmax-classification task, one jitted ``make_fl_round`` per
+cell, and writes one results JSON per cell plus a summary:
+
+- **attack**: sign-flip (scaled negation), gaussian (pure-noise updates),
+  alie (collusive mu + z*sigma) — all injected IN-ROUND via
+  ``attack_fraction`` (robust.byzantine_round_mask), so the coalition is
+  redrawn every round;
+- **aggregator**: mean | median | trimmed-mean | krum
+  (robust/aggregators.py);
+- **mode**: plain | secagg (group-wise masked sessions, the aggregator
+  reduces over decoded GROUP sums — ddl25spring_tpu.secagg with
+  ``nr_groups > 1``) | dp (DP-FedAvg clip+noise; mean only) | compress
+  (top-k sparsified uplinks);
+- **cohort**: sampled clients per round (population is 2x the cohort).
+
+The task is deliberately tiny — a linear softmax probe whose accuracy
+collapses under a successful attack and saturates without one — so every
+cell is a seconds-scale CPU program and a 1k-client cohort is still only
+a [1000, P] stack.  ``--smoke`` runs the 2x2x2 tier-1 matrix
+(sign-flip x {mean, median} x {plain, secagg}) the test suite pins: the
+robust aggregator must recover final accuracy under a 30% sign-flip
+coalition that degrades the weighted mean, in BOTH modes.
+
+Usage:
+    python tools/scenario_matrix.py --smoke --out results/scenario_smoke
+    python tools/scenario_matrix.py --cohorts 8,32,1024 \
+        --out results/scenario_matrix --telemetry results/scenario.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+ATTACKS = ("sign-flip", "gaussian", "alie")
+AGGREGATORS = ("mean", "median", "trimmed-mean", "krum")
+MODES = ("plain", "secagg", "dp", "compress")
+
+
+def make_synthetic(nr_clients: int, n_per_client: int, d: int, k: int,
+                   seed: int):
+    """Linearly separable k-class blobs, IID across clients, plus a
+    held-out test split — small enough that the fault-free FedAvg probe
+    reaches ~100% in a handful of rounds (headroom for attacks to
+    destroy)."""
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(d, k)).astype(np.float32)
+
+    def draw(n):
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = np.argmax(x @ w_true, axis=1).astype(np.int32)
+        return x, y
+
+    xs, ys = [], []
+    for _ in range(nr_clients):
+        x, y = draw(n_per_client)
+        xs.append(x)
+        ys.append(y)
+    test_x, test_y = draw(512)
+    return (np.stack(xs), np.stack(ys),
+            np.full((nr_clients,), n_per_client, np.int64),
+            test_x, test_y)
+
+
+def build_round(cell: dict, data, seed: int):
+    """One jitted engine round for this cell; returns (round_fn, secagg,
+    skip_reason).  Infeasible combinations return a reason instead of a
+    round (e.g. DP's uniform clip excludes custom aggregators, Krum needs
+    rows - f - 2 >= 1 over whatever the rule actually sees)."""
+    import jax
+
+    from ddl25spring_tpu.fl.engine import make_fl_round
+    from ddl25spring_tpu.robust import (coordinate_median, make_alie_attack,
+                                        make_gaussian_attack, make_krum,
+                                        make_sign_flip_attack,
+                                        make_trimmed_mean)
+
+    x, y, counts, _, _ = data
+    cohort = cell["cohort"]
+    fraction = cell["attack_fraction"]
+
+    # sign-flip scale > cohort so ONE attacker already flips the round
+    # mean (m-1 honest u's vs one -s*u: sum < 0 when s > m-1) — the
+    # robust rules are magnitude-insensitive so only the mean cells care
+    attack = {
+        "sign-flip": lambda: make_sign_flip_attack(cohort + 2.0),
+        "gaussian": lambda: make_gaussian_attack(5.0),
+        "alie": lambda: make_alie_attack(1.5),
+    }[cell["attack"]]()
+
+    mode = cell["mode"]
+    secagg = None
+    kw = {}
+    # the robust rule reduces over per-client updates in plain mode but
+    # over decoded GROUP aggregates under grouped secagg
+    rows = cohort
+    if mode == "secagg":
+        from ddl25spring_tpu.secagg import SecAgg
+
+        nr_groups = max(2, cohort // 2)
+        secagg = SecAgg(x.shape[0], cohort, counts=counts, clip=8.0,
+                        threshold_frac=0.5, seed=seed,
+                        nr_groups=nr_groups)
+        rows = nr_groups
+        kw["secagg"] = secagg
+    elif mode == "dp":
+        if cell["aggregator"] != "mean":
+            return None, None, "dp clips to a UNIFORM-weight mean; custom " \
+                               "aggregators are rejected at build time"
+        kw.update(dp_clip=2.0, dp_noise_mult=0.1)
+    elif mode == "compress":
+        kw.update(compress="topk", compress_ratio=0.5)
+
+    f = max(1, round(fraction * rows))
+    if cell["aggregator"] == "mean":
+        aggregator = None
+    elif cell["aggregator"] == "median":
+        aggregator = coordinate_median
+    elif cell["aggregator"] == "trimmed-mean":
+        ratio = min(0.45, f / rows)
+        if 2 * int(ratio * rows) >= rows:
+            return None, None, f"trimmed-mean needs 2k < m over {rows} rows"
+        aggregator = make_trimmed_mean(ratio)
+    else:  # krum
+        if rows - f - 2 < 1:
+            return None, None, f"krum needs rows - f - 2 >= 1 over {rows} " \
+                               f"rows (f={f})"
+        aggregator = make_krum(f, 1)
+    if mode == "secagg" and cell["aggregator"] == "mean":
+        # still exercised: grouped masked sums recombined by group weight
+        aggregator = None
+
+    import jax.numpy as jnp
+
+    def client_update(params, x_i, y_i, c_i, k_i):
+        def loss(p):
+            logits = x_i @ p["w"] + p["b"]
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(
+                logp, y_i[:, None].astype(jnp.int32), axis=1))
+
+        p = params
+        for _ in range(2):
+            g = jax.grad(loss)(p)
+            p = jax.tree.map(lambda w, gg: w - 0.5 * gg, p, g)
+        return p
+
+    round_fn = make_fl_round(
+        client_update, x, y, counts, cohort,
+        aggregator=aggregator, attack=attack,
+        attack_fraction=fraction, attack_seed=seed + 17,
+        **kw,
+    )
+    return round_fn, secagg, None
+
+
+def run_cell(cell: dict, nr_rounds: int, seed: int,
+             val_gate: str = "restore") -> dict:
+    """Execute one cell end-to-end; returns the result row (or the skip
+    reason for infeasible combinations).
+
+    Every cell runs behind the same :class:`resilience.ValidationGate`
+    (``val_gate`` policy, "" disables): the gate re-scores each round's
+    aggregate on the held-out split and refuses rounds that drop below
+    best-so-far.  It is applied UNIFORMLY — to mean and robust cells
+    alike — so the matrix compares full defense stacks, not aggregators
+    in isolation.  The gate matters most for grouped secagg: a group of
+    size s is poisoned with probability 1 - (1-p)^s, which at p = 0.3 and
+    s = 2 sits right at the coordinate-median breakdown point — the gate
+    rejects the majority-poisoned rounds the group-level rule loses
+    (docs/SECURITY.md's granularity-vs-robustness tradeoff)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu import obs
+    from ddl25spring_tpu.resilience import ValidationGate
+
+    d, k = 16, 4
+    nr_clients = 2 * cell["cohort"]
+    data = make_synthetic(nr_clients, 32, d, k, seed)
+    _, _, _, test_x, test_y = data
+    t0 = time.perf_counter()
+    round_fn, secagg, skip = build_round(cell, data, seed)
+    if skip is not None:
+        return {"cell": cell, "skipped": skip}
+
+    @jax.jit
+    def accuracy(params):
+        pred = jnp.argmax(test_x @ params["w"] + params["b"], axis=1)
+        return 100.0 * jnp.mean((pred == test_y).astype(jnp.float32))
+
+    gate = (ValidationGate(accuracy, policy=val_gate, tolerance=1.0)
+            if val_gate else None)
+    init = jax.random.normal(jax.random.PRNGKey(seed), (d, k),
+                             jnp.float32) * 0.01
+    params = {"w": init, "b": jnp.zeros((k,), jnp.float32)}
+    base_key = jax.random.PRNGKey(seed + 1)
+    curve = []
+    with obs.span("scenario.cell", **{k_: str(v)
+                                      for k_, v in cell.items()}):
+        for r in range(nr_rounds):
+            new = round_fn(params, base_key, r)
+            if gate is not None:
+                new, _ = gate.admit(r, params, new)
+            params = new
+            curve.append(float(accuracy(params)))
+    result = {
+        "cell": cell,
+        "final_accuracy": curve[-1],
+        "best_accuracy": max(curve),
+        "round_accuracy": curve,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+    if gate is not None:
+        result["val_gate"] = {"policy": val_gate,
+                              "rejections": gate.events}
+    if secagg is not None:
+        result["secagg_stats"] = dict(secagg.stats)
+        result["secagg_groups"] = secagg.nr_groups
+    return result
+
+
+def build_cells(attacks, aggregators, modes, cohorts,
+                attack_fraction: float) -> list[dict]:
+    return [
+        {"attack": a, "aggregator": g, "mode": m, "cohort": c,
+         "attack_fraction": attack_fraction}
+        for a in attacks for g in aggregators for m in modes
+        for c in cohorts
+    ]
+
+
+def cell_name(cell: dict) -> str:
+    return (f"{cell['attack']}_{cell['aggregator']}_{cell['mode']}"
+            f"_c{cell['cohort']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="attack x defense scenario matrix over the FL engine")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 matrix: sign-flip x {mean, median} x "
+                         "{plain, secagg} at one tiny cohort")
+    ap.add_argument("--cohorts", default="8,32",
+                    help="comma-separated cohort sizes (e.g. 8,32,1024)")
+    ap.add_argument("--attack-fraction", type=float, default=0.3)
+    ap.add_argument("--nr-rounds", type=int, default=30)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", type=Path,
+                    default=Path("results/scenario_matrix"))
+    ap.add_argument("--val-gate", default="restore",
+                    choices=("", "skip", "clip", "restore"),
+                    help="holdout validation-gate policy applied to every "
+                         "cell ('' disables the gate)")
+    ap.add_argument("--telemetry", default=None,
+                    help="obs telemetry JSONL path (tools/obs_report.py "
+                         "renders the attacks & defenses section from it)")
+    args = ap.parse_args(argv)
+
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ddl25spring_tpu import obs
+
+    if args.telemetry:
+        obs.enable(args.telemetry)
+
+    if args.smoke:
+        cells = build_cells(("sign-flip",), ("mean", "median"),
+                            ("plain", "secagg"), (8,),
+                            args.attack_fraction)
+    else:
+        cohorts = tuple(int(c) for c in args.cohorts.split(","))
+        cells = build_cells(ATTACKS, AGGREGATORS, MODES, cohorts,
+                            args.attack_fraction)
+
+    args.out.mkdir(parents=True, exist_ok=True)
+    rows = []
+    for cell in cells:
+        res = run_cell(cell, args.nr_rounds, args.seed,
+                       val_gate=args.val_gate)
+        rows.append(res)
+        path = args.out / f"{cell_name(cell)}.json"
+        path.write_text(json.dumps(res, indent=2) + "\n")
+        if "skipped" in res:
+            print(f"[skip] {cell_name(cell)}: {res['skipped']}")
+        else:
+            print(f"[cell] {cell_name(cell)}: "
+                  f"final={res['final_accuracy']:.1f}% "
+                  f"best={res['best_accuracy']:.1f}% "
+                  f"({res['wall_s']}s)")
+
+    summary = {
+        "nr_rounds": args.nr_rounds,
+        "attack_fraction": args.attack_fraction,
+        "seed": args.seed,
+        "cells": [
+            {**({"final_accuracy": r.get("final_accuracy")}
+                if "skipped" not in r else {"skipped": r["skipped"]}),
+             "name": cell_name(r["cell"])}
+            for r in rows
+        ],
+    }
+    (args.out / "summary.json").write_text(
+        json.dumps(summary, indent=2) + "\n")
+    print(f"wrote {len(rows)} cell files + summary.json to {args.out}")
+    obs.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
