@@ -13,6 +13,9 @@ _LAZY = {
     "flash_causal_attention": "flash_attention",
     "flash_block_attention": "flash_attention",
     "ring_flash_causal_attention": "ring_flash",
+    "pairwise_sq_dists": "pairwise",
+    "dist_pass_bytes": "pairwise",
+    "row_norms": "pairwise",
 }
 
 
@@ -35,4 +38,7 @@ __all__ = [
     "flash_causal_attention",
     "flash_block_attention",
     "ring_flash_causal_attention",
+    "pairwise_sq_dists",
+    "dist_pass_bytes",
+    "row_norms",
 ]

@@ -1,0 +1,210 @@
+"""Tiled pairwise squared distances for the robust-aggregation hot path.
+
+``make_krum``/``make_bulyan`` score every client update by its squared
+distances to every other update in the (m, P) round stack.  The naive
+broadcast form ``sum((mat[:, None] - mat[None, :])**2, -1)`` materialises an
+(m, m, P) intermediate — the scaling wall of the attack/defense matrix at
+1k+ clients (m=1024, P=11M f32 is ~44 TB).  Both paths here compute the same
+(m, m) result via the Gram identity ``‖a-b‖² = ‖a‖² + ‖b‖² - 2·a·b``:
+
+- ``impl="gram"``: plain XLA — one (m, m) matmul plus row norms, peak
+  O(m² + m·P).  Works on every backend; this is the portable win.
+- ``impl="pallas"``: a blockwise TPU kernel (conventions follow
+  ``ops/flash_attention.py``) that never holds more than two (bm, bd)
+  operand tiles plus an (bm, bm) f32 accumulator in VMEM — peak
+  O(m² + m·P_tile).  Reduced-precision ``robust_stack`` storage (bf16 /
+  int8) is upcast to f32 PER TILE inside the kernel, so the f32 copy of
+  the stack is never materialised either.
+- ``impl="naive"``: the broadcast reference, kept for parity tests only.
+
+Accumulation is f32 everywhere (selection becomes tie-unstable otherwise),
+and the identity is clamped at zero: round-off can push ‖a‖²+‖b‖²-2a·b
+slightly negative for near-identical rows, which would poison downstream
+sorts and score sums.
+
+Block sizes are picked as the largest divisor ≤ the target (flash
+convention): the m axis targets 128 (MXU edge), the feature axis 512.  A
+prime P degrades the feature block to 1 — pad the stack if that ever
+matters; real update stacks have highly composite P.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# m-axis tile targets the MXU edge; the feature axis reuses the flash
+# kernels' 512 sweet spot (pipeline overhead amortisation vs VMEM residency:
+# two f32 operand tiles at (128, 512) + the (128, 128) accumulator is ~0.6 MB)
+BLOCK_M_TARGET = 128
+BLOCK_D_TARGET = 512
+
+#: Test/AOT hook (same contract as flash_attention.INTERPRET_OVERRIDE):
+#: force interpret mode on/off regardless of the detected backend.
+INTERPRET_OVERRIDE: bool | None = None
+
+
+def _pick_block(t: int, target: int) -> int:
+    b = min(t, target)
+    while t % b:
+        b -= 1
+    return b
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        if INTERPRET_OVERRIDE is not None:
+            return INTERPRET_OVERRIDE
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def _resolve_impl(impl: str) -> str:
+    if impl == "auto":
+        # the Pallas path only pays off where it compiles to Mosaic; in
+        # interpret mode it is strictly slower than the fused XLA gram
+        return "pallas" if jax.default_backend() == "tpu" else "gram"
+    if impl not in ("naive", "gram", "pallas"):
+        raise ValueError(
+            f"impl={impl!r} not in ('auto', 'naive', 'gram', 'pallas')"
+        )
+    return impl
+
+
+def _upcast(mat):
+    return mat.astype(jnp.float32) if mat.dtype != jnp.float32 else mat
+
+
+def _sq_dists_naive(mat):
+    mat = _upcast(mat)
+    sq = jnp.sum((mat[:, None, :] - mat[None, :, :]) ** 2, axis=-1)
+    return jnp.maximum(sq, 0.0)
+
+
+def _sq_dists_gram(mat):
+    mat = _upcast(mat)
+    sq_norms = jnp.sum(mat * mat, axis=1)
+    gram = mat @ mat.T
+    sq = sq_norms[:, None] + sq_norms[None, :] - 2.0 * gram
+    return jnp.maximum(sq, 0.0)
+
+
+# --------------------------------------------------------------------------
+# Pallas kernel
+# --------------------------------------------------------------------------
+
+def _pairwise_kernel(a_ref, b_ref, out_ref, acc, rn, cn, *, nr_d):
+    """One (i, j) output tile, accumulated over the feature-block axis k
+    (innermost grid axis).  Per step the kernel holds two (bm, bd) operand
+    tiles — upcast to f32 HERE, so bf16/int8 stacks never get an f32 copy
+    in HBM — an (bm, bm) f32 Gram accumulator and two (bm,) norm
+    accumulators; VMEM residency is bounded by the block sizes alone."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        rn[...] = jnp.zeros_like(rn)
+        cn[...] = jnp.zeros_like(cn)
+
+    a = a_ref[...].astype(jnp.float32)               # (bm, bd)
+    b = b_ref[...].astype(jnp.float32)               # (bm, bd)
+    acc[...] = acc[...] + jnp.dot(
+        a, b.T, preferred_element_type=jnp.float32
+    )
+    rn[...] = rn[...] + jnp.sum(a * a, axis=1)
+    cn[...] = cn[...] + jnp.sum(b * b, axis=1)
+
+    @pl.when(k == nr_d - 1)
+    def _finalize():
+        sq = rn[...][:, None] + cn[...][None, :] - 2.0 * acc[...]
+        out_ref[...] = jnp.maximum(sq, 0.0)
+
+
+def _sq_dists_pallas(mat, interpret):
+    m, d = mat.shape
+    bm = _pick_block(m, BLOCK_M_TARGET)
+    bd = _pick_block(d, BLOCK_D_TARGET)
+    nr_d = d // bd
+    grid = (m // bm, m // bm, nr_d)
+    kernel = functools.partial(_pairwise_kernel, nr_d=nr_d)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bd), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bm, bd), lambda i, j, k: (j, k)),
+        ],
+        out_specs=pl.BlockSpec((bm, bm), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, m), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((bm, bm), jnp.float32),
+            pltpu.VMEM((bm,), jnp.float32),
+            pltpu.VMEM((bm,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(mat, mat)
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+def pairwise_sq_dists(mat, *, impl: str = "auto",
+                      interpret: bool | None = None):
+    """All-pairs squared distances of the rows of ``mat`` (m, d) as an
+    (m, m) f32 array with zeros on the diagonal (callers wanting
+    self-exclusion add their own inf diagonal).  ``impl`` is one of
+    ``auto`` (pallas on TPU, gram elsewhere), ``gram``, ``pallas``,
+    ``naive``; ``interpret`` follows the flash-attention convention
+    (None = auto: interpreter off-TPU)."""
+    if mat.ndim != 2:
+        raise ValueError(f"mat must be (m, d), got shape {mat.shape}")
+    impl = _resolve_impl(impl)
+    if impl == "naive":
+        return _sq_dists_naive(mat)
+    if impl == "gram":
+        return _sq_dists_gram(mat)
+    return _sq_dists_pallas(mat, _resolve_interpret(interpret))
+
+
+def row_norms(mat):
+    """Per-row L2 norms in f32 — the consensus aggregator's normalisation
+    pass, shared here so every robust rule upcasts storage dtypes the same
+    way (f32 accumulation regardless of ``robust_stack``)."""
+    mat = _upcast(mat)
+    return jnp.sqrt(jnp.sum(mat * mat, axis=1))
+
+
+def dist_pass_bytes(m: int, d: int, *, impl: str = "gram",
+                    itemsize: int = 4) -> dict:
+    """Analytic byte accounting for one distance pass over an (m, d) stack
+    stored at ``itemsize`` bytes/element: ``moved`` approximates total HBM
+    traffic, ``peak_intermediate`` the largest temporary the pass holds
+    beyond inputs/outputs.  Used by the ``fl_aggregator_dist_bytes`` obs
+    gauge and bench.py's achieved-bandwidth gauges (interpret-mode timings
+    would be meaningless, so the Pallas column is analytic by design)."""
+    impl = _resolve_impl(impl)
+    out = m * m * 4
+    if impl == "naive":
+        inter = m * m * d * 4
+        return {"impl": impl, "moved": m * d * itemsize + 2 * inter + out,
+                "peak_intermediate": inter}
+    if impl == "gram":
+        # one read of the stack (+ an f32 upcast copy when stored reduced),
+        # the (m, m) gram product, norms are noise
+        upcast = m * d * 4 if itemsize != 4 else 0
+        return {"impl": impl,
+                "moved": m * d * itemsize + upcast + 2 * out,
+                "peak_intermediate": out + upcast}
+    bm = _pick_block(m, BLOCK_M_TARGET)
+    bd = _pick_block(d, BLOCK_D_TARGET)
+    # each of the (m/bm)² output tiles streams two (bm, d) operand strips;
+    # upcast happens per-tile in VMEM so it adds no HBM traffic
+    moved = (m // bm) * (m // bm) * 2 * bm * d * itemsize + out
+    return {"impl": impl, "moved": moved,
+            "peak_intermediate": bm * bm * 4 + 2 * bm * bd * 4}

@@ -33,16 +33,19 @@ import jax.numpy as jnp
 from ..utils.trees import tree_weighted_mean
 
 
-def _stack_to_matrix(stacked):
+def _stack_to_matrix(stacked, upcast: bool = True):
     """Flatten a stacked pytree (m, ...) into an (m, D) matrix plus a
-    function mapping a (D,) vector back to one update pytree."""
+    function mapping a (D,) vector back to one update pytree.
+
+    ``upcast=False`` keeps reduced-precision stacks in their storage dtype
+    for consumers that upcast tile-by-tile themselves (the pairwise
+    distance kernels) — everyone else gets f32, because pairwise distances
+    and sorted means must accumulate in f32 or selection becomes
+    tie-unstable."""
     leaves = jax.tree.leaves(stacked)
     m = leaves[0].shape[0]
     mat = jnp.concatenate([leaf.reshape(m, -1) for leaf in leaves], axis=1)
-    if mat.dtype in (jnp.bfloat16, jnp.float16):
-        # reduced-precision update stacks (make_fl_round robust_stack=
-        # 'bfloat16') are a storage format only — pairwise distances and
-        # sorted means accumulate in f32 or selection becomes tie-unstable
+    if upcast and mat.dtype in (jnp.bfloat16, jnp.float16):
         mat = mat.astype(jnp.float32)
 
     treedef = jax.tree.structure(stacked)
@@ -60,6 +63,19 @@ def _stack_to_matrix(stacked):
         return jax.tree.unflatten(treedef, parts)
 
     return mat, unflatten
+
+
+def _sq_dists(mat, impl: str):
+    """All-pairs squared distances via :mod:`..ops.pairwise` (Gram identity
+    ``‖a-b‖² = ‖a‖² + ‖b‖² - 2·a·b``, clamped at zero against round-off) —
+    one (m, m) matmul instead of the naive (m, m, D) broadcast, so the
+    distance pass peaks at O(m² + m·D) instead of O(m²·D), and on TPU the
+    tiled Pallas kernel drops the m·D term to m·D_tile.  Imported lazily so
+    robust rules don't pull jax.experimental.pallas into processes that
+    never score a distance (the ops/__init__ discipline)."""
+    from ..ops import pairwise
+
+    return pairwise.pairwise_sq_dists(mat, impl=impl)
 
 
 def weighted_mean(stacked, weights, key=None):
@@ -110,8 +126,10 @@ def make_consensus(nr_iterations: int = 2, temperature: float = 4.0):
     """
 
     def consensus(stacked, weights=None, key=None):
+        from ..ops import pairwise
+
         mat, unflatten = _stack_to_matrix(stacked)
-        norms = jnp.linalg.norm(mat, axis=1, keepdims=True) + 1e-12
+        norms = pairwise.row_norms(mat)[:, None] + 1e-12
         unit = mat / norms
         # robust anchor: a scaled sign-flip attack can cancel (or invert)
         # the uniform mean, making a mean-seeded iteration lock onto the
@@ -129,31 +147,42 @@ def make_consensus(nr_iterations: int = 2, temperature: float = 4.0):
     return consensus
 
 
-def make_krum(nr_byzantine: int, nr_selected: int = 1):
+def make_krum(nr_byzantine: int, nr_selected: int = 1,
+              pairwise_impl: str = "auto"):
     """(multi-)Krum: score each update by the sum of its m - f - 2 smallest
     squared distances to the other updates; keep the ``nr_selected``
     best-scoring updates and average them (``nr_selected=1`` is classic Krum).
+
+    ``pairwise_impl`` selects the distance-pass backend (see
+    ``ops.pairwise``): ``auto`` compiles the tiled Pallas kernel on TPU and
+    the XLA Gram path elsewhere; reduced-precision stacks stay in storage
+    dtype until the kernel's per-tile upcast.
     """
 
     def krum(stacked, weights=None, key=None):
-        mat, unflatten = _stack_to_matrix(stacked)
+        mat, unflatten = _stack_to_matrix(stacked, upcast=False)
         m = mat.shape[0]
         nr_neighbors = m - nr_byzantine - 2
         if nr_neighbors < 1:
             raise ValueError(
                 f"krum needs m - f - 2 >= 1 (m={m}, f={nr_byzantine})"
             )
-        sq = jnp.sum((mat[:, None, :] - mat[None, :, :]) ** 2, axis=-1)
+        sq = _sq_dists(mat, pairwise_impl)
         sq = sq + jnp.diag(jnp.full(m, jnp.inf))  # exclude self-distance
         neighbor_d = jnp.sort(sq, axis=1)[:, :nr_neighbors]
         scores = jnp.sum(neighbor_d, axis=1)
         chosen = jnp.argsort(scores)[:nr_selected]
-        return unflatten(jnp.mean(mat[chosen], axis=0))
+        # only the selected rows get the f32 upcast (the full-matrix copy
+        # is exactly what the tiled distance pass avoided)
+        return unflatten(jnp.mean(mat[chosen].astype(jnp.float32), axis=0))
 
+    # telemetry hook: marks this rule as distance-based so the round loop
+    # can account the pass's bytes (obs gauge fl_aggregator_dist_bytes)
+    krum.pairwise_impl = pairwise_impl
     return krum
 
 
-def make_bulyan(nr_byzantine: int):
+def make_bulyan(nr_byzantine: int, pairwise_impl: str = "auto"):
     """Bulyan (El Mhamdi et al., ICML 2018, public): Krum-select a
     θ = m - 2f committee, then aggregate it with a per-coordinate trimmed
     mean keeping the θ - 2f values closest to the committee's coordinate
@@ -170,7 +199,7 @@ def make_bulyan(nr_byzantine: int):
     """
 
     def bulyan(stacked, weights=None, key=None):
-        mat, unflatten = _stack_to_matrix(stacked)
+        mat, unflatten = _stack_to_matrix(stacked, upcast=False)
         m = mat.shape[0]
         f = nr_byzantine
         theta = m - 2 * f
@@ -182,10 +211,13 @@ def make_bulyan(nr_byzantine: int):
         # selection stage: the theta best one-shot Krum scores (see the
         # docstring's selection note vs the paper's iterative variant)
         nr_neighbors = m - f - 2
-        sq = jnp.sum((mat[:, None, :] - mat[None, :, :]) ** 2, axis=-1)
+        sq = _sq_dists(mat, pairwise_impl)
         sq = sq + jnp.diag(jnp.full(m, jnp.inf))
         scores = jnp.sum(jnp.sort(sq, axis=1)[:, :nr_neighbors], axis=1)
-        committee = mat[jnp.argsort(scores)[:theta]]  # (theta, d)
+        # the committee upcasts to f32 — the coordinate-wise stage sorts
+        # and averages it whole, and at (theta, d) that is O(m·d), not the
+        # O(m²·d) the distance pass just avoided
+        committee = mat[jnp.argsort(scores)[:theta]].astype(jnp.float32)
         # aggregation stage: per-coordinate, keep the beta values nearest
         # the committee median and average them
         med = jnp.median(committee, axis=0)
@@ -194,4 +226,5 @@ def make_bulyan(nr_byzantine: int):
         kept = jnp.take_along_axis(committee, nearest, axis=0)
         return unflatten(jnp.mean(kept, axis=0))
 
+    bulyan.pairwise_impl = pairwise_impl
     return bulyan
