@@ -470,6 +470,96 @@ _EMIT_LOCK = threading.Lock()
 _PROBE_TRAIL: list = []
 
 
+def kernel_microbench(pairwise_shape=(256, 16384),
+                      secagg_shape=(32, 16384)) -> dict:
+    """Time the two tiled aggregation kernels on THIS process's backend and
+    convert the analytic bytes-moved models into achieved bandwidth:
+
+    - ``pairwise_dist``: the krum/bulyan all-pairs distance pass
+      (ops/pairwise.py) under ``impl='auto'`` — the Pallas kernel on TPU,
+      the XLA Gram path on CPU (interpret-mode Pallas timings would
+      measure the interpreter, not the kernel);
+    - ``secagg_encode_mask``: one masked-aggregation pass
+      (secagg/kernels.py) — the fused clip->encode->mask->sum kernel on
+      TPU, the separate-ops XLA graph on CPU.
+
+    Both cells land in BENCH_*.json (and the cpu_trend fallback), so a
+    kernel-level regression moves a tracked number even when the device is
+    unreachable.  Bandwidth figures come from analytic models
+    (``dist_pass_bytes`` / ``mask_pass_bytes``), not hardware counters —
+    they are trend metrics, not roofline measurements."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.ops import pairwise
+    from ddl25spring_tpu.secagg import field as sa_field
+    from ddl25spring_tpu.secagg import kernels as sa_kernels
+    from ddl25spring_tpu.secagg import masks as sa_masks
+
+    def timed(fn, *args, trials: int = 3) -> float:
+        jax.block_until_ready(fn(*args))  # compile + warm
+        times = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times)
+
+    out = {}
+    m, d = pairwise_shape
+    mat = jax.random.normal(jax.random.PRNGKey(0), (m, d), jnp.float32)
+    dist_fn = jax.jit(lambda t: pairwise.pairwise_sq_dists(t, impl="auto"))
+    dt = timed(dist_fn, mat)
+    acct = pairwise.dist_pass_bytes(m, d, impl="auto")
+    out["pairwise_dist"] = {
+        "impl": acct["impl"], "shape": [m, d], "ms": round(dt * 1e3, 3),
+        "moved_bytes": acct["moved"],
+        "achieved_gbps": round(acct["moved"] / dt / 1e9, 3),
+    }
+
+    m, length = secagg_shape
+    spec = sa_field.FieldSpec.for_budget(clip=4.0, total_weight=m)
+    gids = jnp.arange(m, dtype=jnp.int32)
+    live = jnp.ones((m,), jnp.bool_)
+    omega = jnp.ones((m,), jnp.uint32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (m, length), jnp.float32)
+    fused = jax.default_backend() == "tpu"
+    if fused:
+        def mask_fn(t):
+            return sa_kernels.fused_masked_sums(
+                {"x": t}, spec, 0, gids, live, live, omega, 0,
+            )
+    else:
+        def mask_fn(t):
+            tree = {"x": t}
+            enc = sa_field.encode(tree, spec)
+            cohort = sa_masks.cohort_masks(0, gids, live, 0, tree)
+            return jax.tree.map(
+                lambda e, mk: jnp.sum(
+                    e * omega[:, None] + mk, axis=0, dtype=jnp.uint32
+                ),
+                enc, cohort,
+            )
+    dt = timed(jax.jit(mask_fn), x)
+    acct = sa_kernels.mask_pass_bytes(
+        m, length, impl="fused" if fused else "xla"
+    )
+    out["secagg_encode_mask"] = {
+        "impl": acct["impl"], "shape": [m, length],
+        "ms": round(dt * 1e3, 3), "moved_bytes": acct["moved"],
+        "achieved_gbps": round(acct["moved"] / dt / 1e9, 3),
+    }
+    if obs.enabled():
+        for kernel, cell in out.items():
+            obs.set_gauge("bench_kernel_achieved_gbps",
+                          cell["achieved_gbps"], kernel=kernel)
+            obs.set_gauge("bench_kernel_moved_bytes",
+                          cell["moved_bytes"], kernel=kernel)
+    return out
+
+
 def run_cpu_trend(nr_rounds: int = 2):
     """Fixed tiny-config CPU trend: FedAvg, synthetic data, ResNet-18,
     8 clients, C=0.25, B=16 — the same jitted engine round as the
@@ -509,6 +599,25 @@ def run_cpu_trend(nr_rounds: int = 2):
         params = server.round_fn(params, server.run_key, r)
     _sync(params)
     dt = time.perf_counter() - t0
+    # kernel cells ride the trend so a kernel regression moves a tracked
+    # number even on the device-unreachable path (smaller shapes than the
+    # main bench: the trend's budget is seconds)
+    _stamp("cpu trend: kernel microbench ...")
+    kernels = kernel_microbench(pairwise_shape=(64, 8192),
+                                secagg_shape=(16, 8192))
+    _stamp("cpu trend: krum aggregation cell ...")
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.robust.aggregators import make_krum
+
+    stack = {"w": jax.random.normal(jax.random.PRNGKey(2), (16, 1 << 16),
+                                    jnp.float32)}
+    krum_fn = jax.jit(make_krum(nr_byzantine=3))
+    jax.block_until_ready(krum_fn(stack, None, None))
+    t0 = time.perf_counter()
+    jax.block_until_ready(krum_fn(stack, None, None))
+    krum_ms = (time.perf_counter() - t0) * 1e3
     print(json.dumps({
         "metric": CPU_TREND_METRIC,
         "value": round(nr_rounds / dt, 4),
@@ -516,6 +625,8 @@ def run_cpu_trend(nr_rounds: int = 2):
         "config": {"nr_clients": 8, "cohort": 2, "batch_size": 16,
                    "n_train": 256, "rounds_timed": nr_rounds,
                    "model": "resnet18", "data": "synthetic"},
+        "kernels": kernels,
+        "krum_agg": {"shape": [16, 1 << 16], "ms": round(krum_ms, 3)},
         "wall_s": round(time.perf_counter() - t_start, 1),
     }))
     sys.stdout.flush()
@@ -901,7 +1012,14 @@ def main():
     else:
         rates = timed_rounds(server, args.rounds,
                              fused=not args.no_fused, trials=args.trials)
-    _stamp("timed rounds done; evaluating ...")
+    _stamp("timed rounds done; kernel microbench ...")
+    try:
+        kernels = kernel_microbench()
+    except Exception as e:  # noqa: BLE001 — the headline metric already
+        # exists; a microbench crash must not void the one-JSON-line
+        # contract minutes into remote-TPU time
+        kernels = {"error": f"{type(e).__name__}: {e}"}
+    _stamp("kernel microbench done; evaluating ...")
     # the north star is rounds/sec AND final accuracy (BASELINE.md): report
     # test accuracy after the timed rounds (real CIFAR when available;
     # deterministic synthetic data on the zero-egress container)
@@ -928,6 +1046,7 @@ def main():
                trials=[round(r, 4) for r in rates],
                spread_pct=round(spread_pct, 2),
                first_execution_rps=round(rates[0], 4),
+               kernels=kernels,
                **stack_bytes)
 
 

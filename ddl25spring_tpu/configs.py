@@ -76,6 +76,10 @@ class HflConfig:
     compress_ratio: float = 0.01  # topk: fraction of entries kept
     # robust aggregation (the missing course part 3; SURVEY.md §2.2)
     aggregator: str = "mean"   # mean | krum | multi-krum | bulyan | trimmed-mean | median | consensus (fedsgd only)
+    pairwise_impl: str = "auto"  # krum/bulyan distance-pass backend
+    #                            (ops/pairwise.py): auto (Pallas kernel on
+    #                            TPU, XLA Gram elsewhere) | gram | pallas |
+    #                            naive (reference; O(m²·P) — tests only)
     attack: str = "none"       # none | label-flip | gaussian | sign-flip |
     #                            alie (collusive mu + z*sigma; robust/attacks)
     nr_malicious: int = 0
@@ -108,6 +112,10 @@ class HflConfig:
     #                            secagg composes with --aggregator; privacy
     #                            granularity drops to group-of-size-m sums,
     #                            docs/SECURITY.md)
+    secagg_impl: str = "auto"  # masked-sum backend (secagg/kernels.py):
+    #                            auto (fused Pallas encode+mask+sum on TPU,
+    #                            XLA graph elsewhere) | fused | xla — both
+    #                            are bit-identical, tests/test_kernels.py
     # harness
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # rounds; 0 = off
@@ -140,6 +148,11 @@ class HflConfig:
                 f"robust_stack must be float32 | bfloat16 | int8, got "
                 f"{self.robust_stack!r}"
             )
+        if self.pairwise_impl not in ("auto", "gram", "pallas", "naive"):
+            raise ValueError(
+                f"pairwise_impl must be auto | gram | pallas | naive, got "
+                f"{self.pairwise_impl!r}"
+            )
         if self.fault_spec:
             # parse eagerly so a typo'd spec fails at config time, not
             # mid-run (parse is pure validation; the plan is rebuilt where
@@ -158,6 +171,11 @@ class HflConfig:
         if self.secagg_groups < 1:
             raise ValueError(
                 f"secagg_groups must be >= 1, got {self.secagg_groups}"
+            )
+        if self.secagg_impl not in ("auto", "fused", "xla"):
+            raise ValueError(
+                f"secagg_impl must be auto | fused | xla, got "
+                f"{self.secagg_impl!r}"
             )
         if not 0.0 <= self.attack_fraction <= 1.0:
             raise ValueError(

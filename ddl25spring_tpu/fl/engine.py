@@ -273,6 +273,7 @@ def make_fl_round(
     donate: bool = False,
     robust_stack: str = "float32",
     secagg=None,
+    secagg_impl: str = "auto",
 ):
     """Build the jitted one-round function of a decentralized server.
 
@@ -491,6 +492,17 @@ def make_fl_round(
             "full-precision stack is materialised first, so a reduced-"
             "precision copy would only ADD memory"
         )
+    if secagg_impl not in ("auto", "fused", "xla"):
+        raise ValueError(
+            f"secagg_impl={secagg_impl!r} not in ('auto', 'fused', 'xla')"
+        )
+    # the fused Pallas kernel (secagg/kernels.py) collapses encode + mask +
+    # survivor-sum into one pass; 'auto' compiles it on TPU only — in
+    # interpret mode it is strictly slower than the fused XLA graph, so CPU
+    # runs keep the XLA path unless a test forces 'fused'
+    secagg_fused = secagg_impl == "fused" or (
+        secagg_impl == "auto" and jax.default_backend() == "tpu"
+    )
     secagg_groups = getattr(secagg, "nr_groups", 1) if secagg is not None else 1
     if secagg is not None:
         if aggregator is not None and secagg_groups <= 1:
@@ -928,7 +940,6 @@ def make_fl_round(
             msgs = updates
 
         spec = secagg.spec
-        enc = sa_field.encode(msgs, spec)
         if dp_clip:
             omega_f = jnp.where(live, 1.0, 0.0)
             omega_u = live.astype(jnp.uint32)
@@ -941,23 +952,40 @@ def make_fl_round(
 
         if secagg_groups > 1:
             return _secagg_grouped_aggregate(
-                params, sel, live, surv, stats, round_idx, enc, omega_f,
+                params, sel, live, surv, stats, round_idx, msgs, omega_f,
                 omega_u, wrow, add_dp_noise, agg_key, oracle,
             )
 
-        cohort = sa_masks.cohort_masks(
-            secagg.seed, sel, live, round_idx, params
-        )
-        masked = jax.tree.map(
-            lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
-        )
-        total = jax.tree.map(
-            lambda ml: jnp.sum(
-                jnp.where(wrow(ml, surv), ml, jnp.uint32(0)),
-                axis=0, dtype=jnp.uint32,
-            ),
-            masked,
-        )
+        if secagg_fused:
+            # one fused pass (secagg/kernels.py): clip -> encode -> weight
+            # -> self + gated pair masks -> survivor modular sum, without
+            # the per-client masked (m, P) intermediate.  Bit-identical to
+            # the XLA branch below — same encode arithmetic, same counter
+            # PRG as masks.unmask_total's residue
+            from ..secagg import kernels as sa_kernels
+
+            total = jax.tree.map(
+                lambda t: t[0],
+                sa_kernels.fused_masked_sums(
+                    msgs, spec, secagg.seed, sel, live, surv, omega_u,
+                    round_idx,
+                ),
+            )
+        else:
+            enc = sa_field.encode(msgs, spec)
+            cohort = sa_masks.cohort_masks(
+                secagg.seed, sel, live, round_idx, params
+            )
+            masked = jax.tree.map(
+                lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
+            )
+            total = jax.tree.map(
+                lambda ml: jnp.sum(
+                    jnp.where(wrow(ml, surv), ml, jnp.uint32(0)),
+                    axis=0, dtype=jnp.uint32,
+                ),
+                masked,
+            )
         residue = sa_masks.unmask_total(
             secagg.seed, sel, live, surv, round_idx, params
         )
@@ -974,7 +1002,7 @@ def make_fl_round(
                               jnp.uint32(0)),
                     axis=0, dtype=jnp.uint32,
                 ),
-                enc,
+                sa_field.encode(msgs, spec),
             )
             return field_sum, plain, nr_surv
 
@@ -1003,7 +1031,7 @@ def make_fl_round(
         return (out, stats) if fault_plan is not None else out
 
     def _secagg_grouped_aggregate(params, sel, live, surv, stats, round_idx,
-                                  enc, omega_f, omega_u, wrow, add_dp_noise,
+                                  msgs, omega_f, omega_u, wrow, add_dp_noise,
                                   agg_key, oracle):
         """Group-wise masked aggregation (``secagg.nr_groups > 1``): the
         cohort is partitioned per round into G masking groups
@@ -1028,20 +1056,31 @@ def make_fl_round(
         groups = sa_masks.group_assignment(
             secagg.seed, round_idx, nr_shard, G
         )
-        cohort = sa_masks.cohort_masks(
-            secagg.seed, sel, live, round_idx, params, groups=groups
-        )
-        masked = jax.tree.map(
-            lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
-        )
+        if secagg_fused:
+            # fused kernel with group-gated pair masks and per-group
+            # survivor reduction in one pass — see the flat branch
+            from ..secagg import kernels as sa_kernels
 
-        def gsum(ml):
-            contrib = jnp.where(wrow(ml, surv), ml, jnp.uint32(0))
-            return jnp.zeros(
-                (G,) + ml.shape[1:], jnp.uint32
-            ).at[groups].add(contrib)
+            totals = sa_kernels.fused_masked_sums(
+                msgs, secagg.spec, secagg.seed, sel, live, surv, omega_u,
+                round_idx, groups=groups, nr_groups=G,
+            )
+        else:
+            enc = sa_field.encode(msgs, secagg.spec)
+            cohort = sa_masks.cohort_masks(
+                secagg.seed, sel, live, round_idx, params, groups=groups
+            )
+            masked = jax.tree.map(
+                lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
+            )
 
-        totals = jax.tree.map(gsum, masked)
+            def gsum(ml):
+                contrib = jnp.where(wrow(ml, surv), ml, jnp.uint32(0))
+                return jnp.zeros(
+                    (G,) + ml.shape[1:], jnp.uint32
+                ).at[groups].add(contrib)
+
+            totals = jax.tree.map(gsum, masked)
         residues = sa_masks.group_unmask_totals(
             secagg.seed, sel, live, surv, groups, G, round_idx, params
         )
@@ -1060,7 +1099,7 @@ def make_fl_round(
                     jnp.where(wrow(e, surv), e * wrow(e, omega_u),
                               jnp.uint32(0))
                 ),
-                enc,
+                sa_field.encode(msgs, secagg.spec),
             )
             return field_sums, plain, nr_surv_g
 
@@ -1417,6 +1456,23 @@ def make_fl_round(
             "fl_update_stack_bytes",
             stack_rows * (_tree_bytes(new_params) // stack_shrink),
         )
+        agg_pairwise = getattr(aggregator, "pairwise_impl", None)
+        if agg_pairwise is not None:
+            # distance-based rule (krum/bulyan): account the all-pairs
+            # pass's HBM traffic under the resolved backend — the number
+            # docs/PERFORMANCE.md's scaling table reasons about
+            from ..ops.pairwise import dist_pass_bytes
+            nr_coords = sum(
+                l.size for l in jax.tree.leaves(new_params)
+                if hasattr(l, "size")
+            )
+            obs.set_gauge(
+                "fl_aggregator_dist_bytes",
+                dist_pass_bytes(
+                    nr_shard, nr_coords, impl=agg_pairwise,
+                    itemsize=4 // stack_shrink,
+                )["moved"],
+            )
         obs.inc("fl_rounds_total")
         obs.inc("fl_clients_sampled_total", nr_sampled)
         obs.set_gauge("fl_clients_per_round", nr_sampled)
@@ -1463,6 +1519,9 @@ def make_fl_round(
     # tests: (masked field sum, independently-computed plaintext field sum,
     # nr_survivors) for one round, no params update
     round_fn.secagg = secagg
+    # the RESOLVED secagg backend (tests + docs read this): True means the
+    # fused Pallas encode+mask+sum kernel, False the reference XLA graph
+    round_fn.secagg_fused = secagg is not None and secagg_fused
     if secagg is not None:
         def _secagg_oracle(params, base_key, round_idx):
             return _round(params, base_key, round_idx, x, y, counts,

@@ -57,6 +57,7 @@ def make_fedbuff_round(
     client_chunk: int = 0,
     donate: bool = False,
     secagg=None,
+    secagg_impl: str = "auto",
 ):
     """Build ``tick(history, base_key, tick_idx) -> history`` where
     ``history`` is the params pytree with a leading ``staleness_window``
@@ -128,6 +129,15 @@ def make_fedbuff_round(
         # message instead: encode(disc_i·Δ_i) with weight n_i, and the
         # denominator is the float Σ n_i·disc_i over survivors.
         chunk = None
+    if secagg_impl not in ("auto", "fused", "xla"):
+        raise ValueError(
+            f"secagg_impl={secagg_impl!r} not in ('auto', 'fused', 'xla')"
+        )
+    # same resolution as engine.make_fl_round: the fused Pallas kernel only
+    # wins on TPU; interpret mode would slow CPU ticks
+    secagg_fused = secagg_impl == "fused" or (
+        secagg_impl == "auto" and jax.default_backend() == "tpu"
+    )
 
     # client data enters as ARGUMENTS, not closure captures (see
     # engine.make_fl_round: captured arrays are baked into the HLO as
@@ -319,7 +329,6 @@ def make_fedbuff_round(
                 lambda d: d * disc.reshape((-1,) + (1,) * (d.ndim - 1)),
                 deltas,
             )
-            enc = sa_field.encode(msgs, secagg.spec)
             omega_u = cs_all.astype(jnp.uint32)
 
             def wrow(t, m):
@@ -339,21 +348,30 @@ def make_fedbuff_round(
                 groups = sa_masks.group_assignment(
                     secagg.seed, tick_idx, nr_sampled, G
                 )
-                cohort = sa_masks.cohort_masks(
-                    secagg.seed, sel, live, tick_idx, current,
-                    groups=groups,
-                )
-                masked = jax.tree.map(
-                    lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
-                )
+                if secagg_fused:
+                    from ..secagg import kernels as sa_kernels
 
-                def gsum(ml):
-                    z = jnp.zeros((G,) + ml.shape[1:], jnp.uint32)
-                    return z.at[groups].add(
-                        jnp.where(wrow(ml, surv), ml, jnp.uint32(0))
+                    totals = sa_kernels.fused_masked_sums(
+                        msgs, secagg.spec, secagg.seed, sel, live, surv,
+                        omega_u, tick_idx, groups=groups, nr_groups=G,
+                    )
+                else:
+                    enc = sa_field.encode(msgs, secagg.spec)
+                    cohort = sa_masks.cohort_masks(
+                        secagg.seed, sel, live, tick_idx, current,
+                        groups=groups,
+                    )
+                    masked = jax.tree.map(
+                        lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
                     )
 
-                totals = jax.tree.map(gsum, masked)
+                    def gsum(ml):
+                        z = jnp.zeros((G,) + ml.shape[1:], jnp.uint32)
+                        return z.at[groups].add(
+                            jnp.where(wrow(ml, surv), ml, jnp.uint32(0))
+                        )
+
+                    totals = jax.tree.map(gsum, masked)
                 residues = sa_masks.group_unmask_totals(
                     secagg.seed, sel, live, surv, groups, G, tick_idx,
                     current,
@@ -372,7 +390,7 @@ def make_fedbuff_round(
                                 jnp.uint32(0),
                             )
                         ),
-                        enc,
+                        sa_field.encode(msgs, secagg.spec),
                     )
                     return field_sums, plain, nr_surv_g
                 denom_g = jnp.zeros((G,), jnp.float32).at[groups].add(
@@ -409,19 +427,31 @@ def make_fedbuff_round(
                 out = tree_select(any_ok, rolled, history)
                 return (out, stats) if fault_plan is not None else out
 
-            cohort = sa_masks.cohort_masks(
-                secagg.seed, sel, live, tick_idx, current
-            )
-            masked = jax.tree.map(
-                lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
-            )
-            total = jax.tree.map(
-                lambda ml: jnp.sum(
-                    jnp.where(wrow(ml, surv), ml, jnp.uint32(0)),
-                    axis=0, dtype=jnp.uint32,
-                ),
-                masked,
-            )
+            if secagg_fused:
+                from ..secagg import kernels as sa_kernels
+
+                total = jax.tree.map(
+                    lambda t: t[0],
+                    sa_kernels.fused_masked_sums(
+                        msgs, secagg.spec, secagg.seed, sel, live, surv,
+                        omega_u, tick_idx,
+                    ),
+                )
+            else:
+                enc = sa_field.encode(msgs, secagg.spec)
+                cohort = sa_masks.cohort_masks(
+                    secagg.seed, sel, live, tick_idx, current
+                )
+                masked = jax.tree.map(
+                    lambda e, mk: e * wrow(e, omega_u) + mk, enc, cohort
+                )
+                total = jax.tree.map(
+                    lambda ml: jnp.sum(
+                        jnp.where(wrow(ml, surv), ml, jnp.uint32(0)),
+                        axis=0, dtype=jnp.uint32,
+                    ),
+                    masked,
+                )
             residue = sa_masks.unmask_total(
                 secagg.seed, sel, live, surv, tick_idx, current
             )
@@ -434,7 +464,7 @@ def make_fedbuff_round(
                                   jnp.uint32(0)),
                         axis=0, dtype=jnp.uint32,
                     ),
-                    enc,
+                    sa_field.encode(msgs, secagg.spec),
                 )
                 return field_sum, plain, nr_surv
             # decoded field sum ≈ Σ_surv n_i·disc_i·Δ_i, so the matching
@@ -575,6 +605,7 @@ def make_fedbuff_round(
         return new_history
 
     tick.secagg = secagg
+    tick.secagg_fused = secagg is not None and secagg_fused
     if secagg is not None:
         def _secagg_oracle(history, base_key, tick_idx):
             return _tick(history, base_key, tick_idx, x, y, counts,
@@ -618,7 +649,7 @@ class FedBuffServer(_DecentralizedServer):
                  fault_plan=None,
                  round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
-                 secagg=None):
+                 secagg=None, secagg_impl: str = "auto"):
         from .engine import make_local_sgd_update
 
         super().__init__(task, lr, batch_size, client_data, client_fraction,
@@ -637,6 +668,7 @@ class FedBuffServer(_DecentralizedServer):
             attack_fraction=attack_fraction, attack_seed=attack_seed,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate, secagg=secagg,
+            secagg_impl=secagg_impl,
         )
         self.params = init_history(self.params, staleness_window)
         # evaluate the CURRENT version of the stacked history

@@ -185,7 +185,8 @@ class FedSgdGradientServer(DecentralizedServer):
                  compress: str = "none", compress_ratio: float = 0.01,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
-                 robust_stack: str = "float32", secagg=None):
+                 robust_stack: str = "float32", secagg=None,
+                 secagg_impl: str = "auto"):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDGradient"
@@ -208,6 +209,7 @@ class FedSgdGradientServer(DecentralizedServer):
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate,
             robust_stack=robust_stack, secagg=secagg,
+            secagg_impl=secagg_impl,
         )
 
 
@@ -224,7 +226,8 @@ class FedSgdWeightServer(DecentralizedServer):
                  mesh=None,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
-                 robust_stack: str = "float32", secagg=None):
+                 robust_stack: str = "float32", secagg=None,
+                 secagg_impl: str = "auto"):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDWeight"
@@ -240,6 +243,7 @@ class FedSgdWeightServer(DecentralizedServer):
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate,
             robust_stack=robust_stack, secagg=secagg,
+            secagg_impl=secagg_impl,
         )
 
 
@@ -266,7 +270,8 @@ class FedAvgServer(DecentralizedServer):
                  compress: str = "none", compress_ratio: float = 0.01,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
-                 robust_stack: str = "float32", secagg=None):
+                 robust_stack: str = "float32", secagg=None,
+                 secagg_impl: str = "auto"):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         self.algorithm = "FedAvg" if prox_mu == 0.0 else "FedProx"
@@ -291,6 +296,7 @@ class FedAvgServer(DecentralizedServer):
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate,
             robust_stack=robust_stack, secagg=secagg,
+            secagg_impl=secagg_impl,
         )
 
 
@@ -319,7 +325,7 @@ class FedOptServer(DecentralizedServer):
                  prox_mu: float = 0.0, dropout_rate: float = 0.0,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, robust_stack: str = "float32",
-                 secagg=None):
+                 secagg=None, secagg_impl: str = "auto"):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         if server_optimizer not in self.OPTIMIZERS:
@@ -359,7 +365,7 @@ class FedOptServer(DecentralizedServer):
             # aggregate (server_step takes the same buffer) — donating it
             # would hand XLA a buffer the next line still reads
             client_chunk=client_chunk, robust_stack=robust_stack,
-            secagg=secagg,
+            secagg=secagg, secagg_impl=secagg_impl,
         )
 
         @jax.jit
@@ -379,6 +385,7 @@ class FedOptServer(DecentralizedServer):
         # run_hfl reporting see FedOpt like the direct servers
         round_fn.secagg = getattr(aggregate_fn, "secagg", None)
         round_fn.secagg_oracle = getattr(aggregate_fn, "secagg_oracle", None)
+        round_fn.secagg_fused = getattr(aggregate_fn, "secagg_fused", False)
         self.round_fn = round_fn
 
     def extra_state(self):

@@ -94,12 +94,15 @@ def build_aggregator(cfg: HflConfig):
     if cfg.aggregator == "trimmed-mean":
         return make_trimmed_mean(min(0.45, max(1, cfg.nr_malicious) / sampled))
     if cfg.aggregator == "krum":
-        return make_krum(cfg.nr_malicious, 1)
+        return make_krum(cfg.nr_malicious, 1,
+                         pairwise_impl=cfg.pairwise_impl)
     if cfg.aggregator == "multi-krum":
         return make_krum(cfg.nr_malicious,
-                         max(1, sampled - 2 * cfg.nr_malicious))
+                         max(1, sampled - 2 * cfg.nr_malicious),
+                         pairwise_impl=cfg.pairwise_impl)
     if cfg.aggregator == "bulyan":
-        return make_bulyan(cfg.nr_malicious)
+        return make_bulyan(cfg.nr_malicious,
+                           pairwise_impl=cfg.pairwise_impl)
     raise ValueError(f"unknown aggregator {cfg.aggregator!r}")
 
 
@@ -257,7 +260,13 @@ def build_server(cfg: HflConfig):
             attack_fraction=cfg.attack_fraction, attack_seed=cfg.attack_seed,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=cfg.client_chunk,
+            # same donation predicate as the sync servers below: the tick
+            # donates its history carry only when no async checkpointer or
+            # validation gate holds a reference to it past the dispatch
+            donate=(cfg.client_chunk > 0 and not cfg.val_gate
+                    and not (cfg.checkpoint_dir and cfg.checkpoint_every)),
             secagg=build_secagg(cfg, client_data),
+            secagg_impl=cfg.secagg_impl,
         )
 
     if cfg.algorithm == "scaffold":
@@ -302,9 +311,19 @@ def build_server(cfg: HflConfig):
     # sampled clients as devices — below that, padding wastes compute
     mesh = (make_mesh({"clients": nr_devices})
             if nr_devices > 1 and clients_per_round >= nr_devices else None)
-    # donate stays off here: the async checkpointer (on_round) holds a live
-    # reference to server.params across the next round's dispatch — donating
-    # it would let XLA overwrite a buffer the save is still serializing
+    # donate params on the chunked round when no async checkpointer can
+    # hold a live reference to server.params across the next dispatch (the
+    # on_round save serializes the buffer donation would let XLA overwrite)
+    # — the server reassignment pattern is then safe, the chunked round's
+    # scan carry aliases in place, and engine.donation_safe still retracts
+    # the donation whenever the persistent compilation cache is on (the
+    # jax-0.4.37 deserialized-executable ordering bug its docstring
+    # documents).  FedOpt stays off: its round_fn reuses the params it
+    # passed (server_step reads the same buffer after the aggregate).  A
+    # validation gate also blocks donation — _advance hands the gate the
+    # ROUND-INPUT params for the rollback comparison after the round ran.
+    donate = (cfg.client_chunk > 0 and not cfg.val_gate
+              and not (cfg.checkpoint_dir and cfg.checkpoint_every))
     kw = dict(aggregator=build_aggregator(cfg), attack=attack,
               malicious_mask=malicious if attack is not None else None,
               attack_fraction=cfg.attack_fraction,
@@ -312,15 +331,18 @@ def build_server(cfg: HflConfig):
               mesh=mesh, fault_plan=fault_plan,
               round_deadline_s=round_deadline_s,
               client_chunk=cfg.client_chunk, robust_stack=cfg.robust_stack,
-              secagg=build_secagg(cfg, client_data))
+              secagg=build_secagg(cfg, client_data),
+              secagg_impl=cfg.secagg_impl)
     if cfg.algorithm == "fedsgd":
         return FedSgdGradientServer(task, cfg.lr, client_data,
                                     cfg.client_fraction, cfg.seed,
                                     compress=cfg.compress,
-                                    compress_ratio=cfg.compress_ratio, **kw)
+                                    compress_ratio=cfg.compress_ratio,
+                                    donate=donate, **kw)
     if cfg.algorithm == "fedsgd-weight":
         return FedSgdWeightServer(task, cfg.lr, client_data,
-                                  cfg.client_fraction, cfg.seed, **kw)
+                                  cfg.client_fraction, cfg.seed,
+                                  donate=donate, **kw)
     if cfg.algorithm in ("fedavg", "fedprox"):
         prox_mu = cfg.prox_mu if cfg.algorithm == "fedprox" else 0.0
         if cfg.algorithm == "fedprox" and prox_mu <= 0:
@@ -332,7 +354,8 @@ def build_server(cfg: HflConfig):
                             dp_clip=cfg.dp_clip,
                             dp_noise_mult=cfg.dp_noise_mult,
                             compress=cfg.compress,
-                            compress_ratio=cfg.compress_ratio, **kw)
+                            compress_ratio=cfg.compress_ratio,
+                            donate=donate, **kw)
     if cfg.algorithm == "fedopt":
         return FedOptServer(task, cfg.lr, cfg.batch_size, client_data,
                             cfg.client_fraction, cfg.nr_local_epochs,

@@ -1,0 +1,254 @@
+"""Fused secure-aggregation kernels and the counter-based mask PRG.
+
+Two things live here, deliberately together:
+
+1. **The counter PRG** (:func:`counter_base` / :func:`counter_bits`): a
+   stateless uint32 mixing chain (two rounds of the murmur3-style 32-bit
+   finalizer) over ``(seed, round, leaf, element-offset)``.  It is plain
+   ``jnp`` uint32 arithmetic, so the SAME function traces inside a Pallas
+   kernel body and in ordinary XLA — which is the whole design: the
+   client-side mask expansion (``masks.cohort_masks`` / the fused kernel
+   below) and the server-side residue (``masks.unmask_total`` /
+   ``group_unmask_totals``) call one implementation, making pairwise
+   cancellation — and therefore the masked == plaintext field-sum oracles —
+   bit-exact BY CONSTRUCTION rather than by two implementations happening
+   to agree.  Like the ``fold_in`` chain it replaces, this is a
+   SIMULATION-grade PRG (statistical, not cryptographic); a deployment
+   swaps :func:`counter_bits` for AES-CTR keyed by the same seeds and
+   nothing downstream changes (the Shamir layer shares seeds, not bits).
+
+2. **The fused round kernel** (:func:`fused_masked_sums`): one pass over
+   each (m, L) client-stacked float leaf computing the survivor sum of
+
+       ω_a · encode(x_a)  +  PRG(b_a)  +  Σ_b ±PRG(s_ab)      (mod 2³²)
+
+   i.e. clip → nan-sanitise → fixed-point encode → weight → self mask →
+   gated pair masks → per-group modular reduction, without ever
+   materialising the per-client masked tree (the XLA path's (m, P)
+   intermediate) or making separate full passes for encode, mask
+   generation, mask add and sum.  The partner axis rides the innermost
+   grid dimension (flash-attention accumulator idiom,
+   ``ops/flash_attention.py``): each step DMAs one (m, 1) pair-seed/sign
+   column picked by the BlockSpec index map — no in-kernel dynamic
+   indexing — and accumulates into an (m, bl) VMEM scratch; the float
+   block, per-client vectors and accumulator bound VMEM regardless of P.
+
+The per-pair seed/sign precomputation is O(m²) uint32 scalars (computed
+once per round in XLA from the SAME ``masks.pair_seed`` fold-in chain the
+Shamir protocol deals shares of) — noise next to the O(m²·P) mask algebra
+itself.
+
+Padding note: leaves are zero-padded up to the feature block; padded
+offsets acquire mask bits like any other column, but the pad region is
+sliced off before reshaping, and the server-side residue is only ever
+computed (and subtracted) on real offsets — the padded field values never
+meet the unmask algebra.
+
+This module imports jax (and pallas) at module level and therefore must
+only be imported lazily from inside functions — ``ddl25spring_tpu.secagg``
+package import stays jax-free (tests/test_secagg.py guards it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# feature-axis block: same pipeline-overhead/VMEM tradeoff as the flash
+# kernels' BLOCK_TARGET (the (m, bl) f32 block + uint32 accumulator at
+# m=256, bl=512 is ~1 MB)
+BLOCK_L = 512
+
+#: Test/AOT hook (same contract as flash_attention.INTERPRET_OVERRIDE).
+INTERPRET_OVERRIDE: bool | None = None
+
+# distinct odd mixing constants for the round / leaf / offset domains
+_C_ROUND = 0x9E3779B9
+_C_LEAF = 0x85EBCA6B
+_C_OFF = 0xC2B2AE35
+_M1 = 0x7FEB352D
+_M2 = 0x846CA68B
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        if INTERPRET_OVERRIDE is not None:
+            return INTERPRET_OVERRIDE
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def _u32(x):
+    return jnp.asarray(x).astype(jnp.uint32)
+
+
+def _mix(h):
+    """One round of the 32-bit finalizer (xor-shift / odd-multiply)."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(_M1)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(_M2)
+    h = h ^ (h >> 16)
+    return h
+
+
+def counter_base(seed_u32, round_idx, leaf_idx):
+    """Collapse ``(seed, round, leaf)`` into one uint32 counter-stream base.
+    Pure jnp — broadcasts over array seeds (the per-pair seed matrix)."""
+    h = _mix(_u32(seed_u32) ^ (_u32(round_idx) * jnp.uint32(_C_ROUND)))
+    return _mix(h ^ (_u32(leaf_idx) * jnp.uint32(_C_LEAF)))
+
+
+def counter_bits(base, offsets):
+    """The PRG output at element ``offsets`` of the stream ``base`` — the
+    one function BOTH mask sides share.  Broadcasts: a (m, 1) base against
+    a (1, bl) offset block yields the (m, bl) mask tile in one shot."""
+    return _mix(_mix(_u32(base) ^ (_u32(offsets) * jnp.uint32(_C_OFF))))
+
+
+# --------------------------------------------------------------------------
+# fused clip -> encode -> mask -> survivor-sum kernel
+# --------------------------------------------------------------------------
+
+def _fused_kernel(x_ref, selfb_ref, omega_ref, pairb_ref, coef_ref, s_ref,
+                  out_ref, acc, *, m, nr_groups, bl, scale, clip):
+    """Grid is (L-blocks, partners).  Step (i, b) adds partner b's signed
+    pair mask to every client row of feature block i; b == 0 seeds the
+    accumulator with the encoded-weighted values and self masks, b == m-1
+    reduces survivor rows into the per-group modular sums."""
+    i = pl.program_id(0)
+    b = pl.program_id(1)
+    offs = (i * bl + jax.lax.broadcasted_iota(
+        jnp.int32, (1, bl), 1)).astype(jnp.uint32)
+
+    @pl.when(b == 0)
+    def _seed():
+        x = x_ref[...].astype(jnp.float32)
+        # field.encode, verbatim: sanitise, clamp, round-to-nearest-even
+        v = jnp.clip(jnp.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0),
+                     -clip, clip)
+        q = jnp.round(v * scale).astype(jnp.int32).astype(jnp.uint32)
+        acc[...] = q * omega_ref[...] + counter_bits(selfb_ref[...], offs)
+
+    # coef is 1 / 2³²-1 / 0: +mask, -mask (additive inverse via the ring
+    # multiply), or gated off (dead partner, self, cross-group pair)
+    acc[...] = acc[...] + counter_bits(pairb_ref[...], offs) * coef_ref[...]
+
+    @pl.when(b == m - 1)
+    def _reduce():
+        for g in range(nr_groups):
+            out_ref[g, :] = jnp.sum(
+                acc[...] * s_ref[:, g:g + 1], axis=0, dtype=jnp.uint32
+            )
+
+
+def _fused_leaf(x, selfb, omega_u, pairb, coef, s_mat, nr_groups, scale,
+                clip, interpret):
+    m, length = x.shape
+    bl = min(BLOCK_L, length)
+    padded = pl.cdiv(length, bl) * bl
+    if padded != length:
+        x = jnp.pad(x, ((0, 0), (0, padded - length)))
+    grid = (padded // bl, m)
+    kernel = functools.partial(
+        _fused_kernel, m=m, nr_groups=nr_groups, bl=bl,
+        scale=float(scale), clip=float(clip),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m, bl), lambda i, b: (0, i)),
+            pl.BlockSpec((m, 1), lambda i, b: (0, 0)),
+            pl.BlockSpec((m, 1), lambda i, b: (0, 0)),
+            # partner b's pair-seed bases / signed-use coefficients: the
+            # index map slices the column, so the kernel never indexes
+            # dynamically (and repeated i steps re-use the same block DMA)
+            pl.BlockSpec((m, 1), lambda i, b: (0, b)),
+            pl.BlockSpec((m, 1), lambda i, b: (0, b)),
+            pl.BlockSpec((m, nr_groups), lambda i, b: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((nr_groups, bl), lambda i, b: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((nr_groups, padded), jnp.uint32),
+        scratch_shapes=[pltpu.VMEM((m, bl), jnp.uint32)],
+        interpret=interpret,
+    )(x, selfb, omega_u, pairb, coef, s_mat)
+    return out[:, :length]
+
+
+def mask_pass_bytes(m: int, length: int, *, impl: str = "fused",
+                    nr_groups: int = 1) -> dict:
+    """Analytic byte accounting for one masked-aggregation pass over an
+    (m, length) float32 message stack — the secagg twin of
+    ``ops.pairwise.dist_pass_bytes``, feeding bench.py's achieved-bandwidth
+    gauges.  ``fused`` reads the stack once and writes the per-group sums
+    (masks are generated in VMEM, never touching HBM); ``xla`` additionally
+    round-trips the encoded, mask and masked (m, length) trees the separate
+    XLA ops materialise."""
+    if impl not in ("fused", "xla"):
+        raise ValueError(f"impl={impl!r} not in ('fused', 'xla')")
+    x = m * length * 4
+    out = nr_groups * length * 4
+    if impl == "fused":
+        bl = min(BLOCK_L, length)
+        return {"impl": impl, "moved": x + out,
+                "peak_intermediate": m * bl * 4}
+    # encode write+read, cohort-mask write+read, masked write+read on top
+    # of the input read and output write
+    return {"impl": impl, "moved": 7 * x + out, "peak_intermediate": 3 * x}
+
+
+def fused_masked_sums(msgs, spec, seed: int, gids, live, surv, omega_u,
+                      round_idx, *, groups=None, nr_groups: int = 1,
+                      interpret: bool | None = None):
+    """Per-group survivor sums of the masked encoded messages, as a pytree
+    like ``msgs`` with a leading ``nr_groups`` axis on every leaf — the
+    quantity ``fl.engine`` subtracts the ``masks.unmask_total`` /
+    ``group_unmask_totals`` residue from.  Equals the XLA path
+    (``field.encode`` + ``masks.cohort_masks`` + weighted survivor
+    reduction) BITWISE: same encode arithmetic, same PRG
+    (:func:`counter_bits`), same gates; flat mode is ``nr_groups=1`` with
+    every position in group 0."""
+    from . import masks
+
+    m = gids.shape[0]
+    if groups is None:
+        groups = jnp.zeros((m,), jnp.int32)
+    interpret = _resolve_interpret(interpret)
+
+    # per-client seed vectors and the symmetric per-pair seed matrix — the
+    # SAME fold-in derivations protocol.SecAgg Shamir-shares
+    self_seeds = jax.vmap(lambda g: masks.self_seed(seed, g))(gids)
+    pair_seeds = jax.vmap(
+        lambda ga: jax.vmap(lambda gb: masks.pair_seed(seed, ga, gb))(gids)
+    )(gids)
+
+    ar = jnp.arange(m)
+    use = (live[None, :] & (ar[:, None] != ar[None, :])
+           & (groups[:, None] == groups[None, :]))
+    sign_pos = gids[:, None] < gids[None, :]
+    coef = jnp.where(
+        use,
+        jnp.where(sign_pos, jnp.uint32(1), jnp.uint32(0xFFFFFFFF)),
+        jnp.uint32(0),
+    )
+    s_mat = (surv[:, None]
+             & (groups[:, None] == jnp.arange(nr_groups)[None, :])
+             ).astype(jnp.uint32)
+    omega_col = jnp.asarray(omega_u, jnp.uint32)[:, None]
+
+    leaves, treedef = jax.tree.flatten(msgs)
+    out = []
+    for idx, leaf in enumerate(leaves):
+        base_self = counter_base(self_seeds, round_idx, idx)[:, None]
+        base_pair = counter_base(pair_seeds, round_idx, idx)
+        flat = _fused_leaf(
+            leaf.reshape(m, -1), base_self, omega_col, base_pair, coef,
+            s_mat, nr_groups, spec.scale, spec.clip, interpret,
+        )
+        out.append(flat.reshape((nr_groups,) + leaf.shape[1:]))
+    return jax.tree.unflatten(treedef, out)

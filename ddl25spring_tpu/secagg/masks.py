@@ -81,12 +81,20 @@ def pair_seed(seed: int, gid_a, gid_b):
 
 def _prg_leaves(seed_u32, round_idx, leaves):
     """Expand one 32-bit seed into per-leaf uint32 tensors for one round —
-    the counter-based PRG: fold the round index, then one fold per leaf."""
-    key = jax.random.fold_in(jax.random.PRNGKey(seed_u32), round_idx)
-    return [
-        jax.random.bits(jax.random.fold_in(key, i), l.shape, jnp.uint32)
-        for i, l in enumerate(leaves)
-    ]
+    the counter-based PRG of :mod:`.kernels`: one stream base per
+    ``(seed, round, leaf)``, then stateless bits at each element's flat
+    offset.  Both mask sides (client expansion here and in the fused
+    Pallas kernel, server residue in :func:`unmask_total`) call the SAME
+    ``counter_bits``, so pairwise cancellation is bit-exact by
+    construction — see kernels.py for the PRG-strength caveat."""
+    from .kernels import counter_base, counter_bits
+
+    out = []
+    for i, l in enumerate(leaves):
+        base = counter_base(seed_u32, round_idx, i)
+        offs = jnp.arange(l.size, dtype=jnp.uint32).reshape(l.shape)
+        out.append(counter_bits(base, offs))
+    return out
 
 
 def _signed(gid_a, gid_b, leaf):

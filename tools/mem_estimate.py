@@ -126,6 +126,77 @@ def estimate(build, chunk: int, device=None) -> dict:
     }
 
 
+def dist_pass_estimate(cohorts, d: int, device=None) -> tuple:
+    """AOT peak-memory of the robust-rule distance pass (ops/pairwise.py)
+    across cohort sizes: compile ``pairwise_sq_dists`` under the naive
+    broadcast and the Gram identity and read XLA's temp bytes next to the
+    analytic model; the Pallas column is analytic only (its VMEM scratch
+    is invisible to the host compiler's memory analysis).  Asserts the
+    O(m²·d) intermediate actually left the compiled Gram program, and that
+    the krum winner is bit-identical across the implementations."""
+    import functools
+
+    from ddl25spring_tpu.ops import pairwise
+
+    rows = []
+    for m in cohorts:
+        aval = jax.ShapeDtypeStruct((m, d), jnp.float32)
+        jit_kw = {"device": device} if device is not None else {}
+        cell = {"m": m, "d": d}
+        for impl in ("naive", "gram"):
+            compiled = jax.jit(
+                functools.partial(pairwise.pairwise_sq_dists, impl=impl),
+                **jit_kw,
+            ).lower(aval).compile()
+            mem = compiled.memory_analysis()
+            cell[impl] = {
+                "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+                "analytic_peak": pairwise.dist_pass_bytes(
+                    m, d, impl=impl)["peak_intermediate"],
+            }
+        cell["pallas"] = {
+            "analytic_peak": pairwise.dist_pass_bytes(
+                m, d, impl="pallas")["peak_intermediate"],
+        }
+        # the claim this tool exists to check: the compiled Gram program
+        # carries no m²·d temp — its whole temp footprint is far below the
+        # intermediate the naive broadcast materialises
+        naive_inter = m * m * d * 4
+        assert cell["naive"]["temp_bytes"] >= naive_inter, (
+            f"naive path no longer materialises the (m, m, d) intermediate "
+            f"at m={m} — the comparison below is stale"
+        )
+        assert cell["gram"]["temp_bytes"] < naive_inter // 8, (
+            f"gram path temp {cell['gram']['temp_bytes']:,} B at m={m} is "
+            f"within 8x of the naive m²·d intermediate {naive_inter:,} B — "
+            "the O(m²·d) term is back"
+        )
+        rows.append(cell)
+
+    # decision identity at the largest cohort: same krum winner (and full
+    # score order) from the naive reference, the Gram path and the
+    # interpret-mode Pallas kernel on identical random data
+    m = max(cohorts)
+    mat = jax.random.normal(jax.random.PRNGKey(0), (m, d), jnp.float32)
+    nr_neighbors = max(m - m // 4 - 2, 1)
+
+    def scores(impl):
+        sq = pairwise.pairwise_sq_dists(mat, impl=impl, interpret=True)
+        sq = sq + jnp.diag(jnp.full(m, jnp.inf))
+        return jnp.argsort(
+            jnp.sum(jnp.sort(sq, axis=1)[:, :nr_neighbors], axis=1)
+        )
+    order = {impl: scores(impl) for impl in ("naive", "gram", "pallas")}
+    winners_identical = bool(
+        jnp.all(order["naive"] == order["gram"])
+        & jnp.all(order["naive"] == order["pallas"])
+    )
+    assert winners_identical, (
+        "krum selection order diverges between pairwise implementations"
+    )
+    return rows, winners_identical
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--target", default="cpu",
@@ -140,6 +211,17 @@ def main(argv=None) -> int:
     ap.add_argument("--northstar", action="store_true",
                     help="use the bench.py ResNet-18 shape instead of the "
                          "tiny MLP (minutes of compile per chunk size)")
+    ap.add_argument("--dist-pass", action="store_true",
+                    help="estimate the robust-rule distance pass instead "
+                         "of the FL round: naive vs Gram AOT temp bytes "
+                         "across --cohorts at --dim, analytic Pallas "
+                         "column, krum decision-identity check")
+    ap.add_argument("--cohorts", default="32,64,128,256",
+                    help="comma-separated cohort sizes for --dist-pass")
+    ap.add_argument("--dim", type=int, default=4096,
+                    help="flattened update length for --dist-pass (the "
+                         "naive column compiles an m²·dim·4-byte temp — "
+                         "1 GiB at m=256, dim=4096)")
     args = ap.parse_args(argv)
 
     device = None
@@ -147,6 +229,26 @@ def main(argv=None) -> int:
         from jax.experimental import topologies
 
         device = topologies.get_topology_desc(args.target, "tpu").devices[0]
+
+    if args.dist_pass:
+        cohorts = [int(c) for c in args.cohorts.split(",") if c.strip()]
+        rows, identical = dist_pass_estimate(cohorts, args.dim,
+                                             device=device)
+        for r in rows:
+            print(f"  m={r['m']:>4} d={r['d']}: "
+                  f"naive temp {r['naive']['temp_bytes']:>14,} B   "
+                  f"gram temp {r['gram']['temp_bytes']:>12,} B   "
+                  f"pallas analytic {r['pallas']['analytic_peak']:>10,} B",
+                  file=sys.stderr)
+        print(f"  krum order identical across impls at m={max(cohorts)}: "
+              f"{identical}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "dist_pass_memory_estimate",
+            "target": args.target,
+            "cohorts": rows,
+            "krum_order_identical": identical,
+        }))
+        return 0
 
     chunks = [int(c) for c in args.chunks.split(",") if c.strip()]
     if args.northstar:

@@ -420,10 +420,12 @@ def report(events: list[dict], top: int) -> None:
     fl_clients = _value(counters, "fl_clients_sampled_total")
     fl_bytes = _value(counters, "fl_bytes_aggregated_total")
     fl_cpr = _value(gauges, "fl_clients_per_round")
+    fl_dist = _value(gauges, "fl_aggregator_dist_bytes")
     for n in ("fl_rounds_total", "fl_clients_sampled_total",
               "fl_bytes_aggregated_total"):
         take(counters, n)
     take(gauges, "fl_clients_per_round")
+    take(gauges, "fl_aggregator_dist_bytes")
     if fl_rounds is not None:
         section("federated learning")
         print(f"  rounds: {fl_rounds}   clients sampled: {fl_clients}"
@@ -431,6 +433,9 @@ def report(events: list[dict], top: int) -> None:
         if fl_bytes is not None:
             print(f"  bytes aggregated (down+up, dense model): "
                   f"{fmt_bytes(fl_bytes)}")
+        if fl_dist is not None:
+            print(f"  robust-rule distance pass (HBM traffic/round): "
+                  f"{fmt_bytes(fl_dist)}")
 
     # -- collectives -----------------------------------------------------
     coll_calls = take(counters, "collective_calls_total")
