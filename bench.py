@@ -618,6 +618,8 @@ def run_cpu_trend(nr_rounds: int = 2):
     t0 = time.perf_counter()
     jax.block_until_ready(krum_fn(stack, None, None))
     krum_ms = (time.perf_counter() - t0) * 1e3
+    _stamp("cpu trend: cohort scaling cell ...")
+    cohort_scaling = _cohort_scaling_cell()
     print(json.dumps({
         "metric": CPU_TREND_METRIC,
         "value": round(nr_rounds / dt, 4),
@@ -627,9 +629,56 @@ def run_cpu_trend(nr_rounds: int = 2):
                    "model": "resnet18", "data": "synthetic"},
         "kernels": kernels,
         "krum_agg": {"shape": [16, 1 << 16], "ms": round(krum_ms, 3)},
+        "cohort_scaling": cohort_scaling,
         "wall_s": round(time.perf_counter() - t_start, 1),
     }))
     sys.stdout.flush()
+
+
+def _cohort_scaling_cell(cohorts=(64, 256, 1024), rounds_timed: int = 3):
+    """Rounds/sec of the cohort-SHARDED round (fl/sharding.py map_clients
+    path, shard_map world 1 — bit-identical to the local program) across
+    cohort sizes on a tiny logistic model: the trend that moves when the
+    sharded MapReduce program regresses, comparable only to itself like
+    the other cpu_trend cells."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.fl.engine import (
+        make_fl_round,
+        make_local_sgd_update,
+    )
+    from ddl25spring_tpu.parallel import make_mesh
+
+    per, d, k, bs = 32, 32, 10, 32
+
+    def loss_fn(params, xb, yb, mask, key):
+        logits = xb @ params["w"] + params["b"]
+        ls = -jax.nn.log_softmax(logits)[jnp.arange(yb.shape[0]), yb]
+        return jnp.sum(ls * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+    update = make_local_sgd_update(loss_fn, 0.05, bs, 1)
+    mesh = make_mesh({"clients": 1}, devices=jax.devices()[:1])
+    params = {"w": jnp.zeros((d, k), jnp.float32),
+              "b": jnp.zeros((k,), jnp.float32)}
+    key = jax.random.PRNGKey(0)
+    out = {"world": 1, "rounds_per_sec": {}}
+    for cohort in cohorts:
+        x = jax.random.normal(key, (cohort, per, d), jnp.float32)
+        y = jax.random.randint(key, (cohort, per), 0, k, jnp.int32)
+        counts = jnp.full((cohort,), per, jnp.int32)
+        rf = make_fl_round(update, x, y, counts, cohort, mesh=mesh,
+                           device_put_data=False)
+        assert rf.cohort_shard == 1
+        p = rf(params, key, 0)
+        jax.block_until_ready(jax.tree.leaves(p)[0])  # compile + warm
+        t0 = time.perf_counter()
+        for r in range(1, rounds_timed + 1):
+            p = rf(p, key, r)
+        jax.block_until_ready(jax.tree.leaves(p)[0])
+        dt = time.perf_counter() - t0
+        out["rounds_per_sec"][str(cohort)] = round(rounds_timed / dt, 4)
+    return out
 
 
 def _cpu_fallback_trend(timeout_s: float) -> dict:
@@ -965,9 +1014,15 @@ def main():
     cohort = server.nr_clients_per_round
     eff_chunk = getattr(server.round_fn, "client_chunk", None) or cohort
     param_bytes = _tree_bytes(server.params)
+    # cohort-sharding geometry: with the shard_map path on, each replica
+    # materializes only its 1/W slice of the (possibly chunked) stack
+    shard = getattr(server.round_fn, "cohort_shard", 1) or 1
     stack_bytes = {
         "update_stack_bytes_stacked": cohort * param_bytes,
         "update_stack_bytes_effective": eff_chunk * param_bytes,
+        "update_stack_bytes_per_replica":
+            max(1, eff_chunk // shard) * param_bytes,
+        "cohort_shard": shard,
         "client_chunk_requested": args.client_chunk,
         "client_chunk_effective": eff_chunk if eff_chunk != cohort else 0,
     }
@@ -989,6 +1044,9 @@ def main():
                       stack_bytes["update_stack_bytes_stacked"])
         obs.set_gauge("fl_update_stack_bytes_effective",
                       stack_bytes["update_stack_bytes_effective"])
+        obs.set_gauge("fl_cohort_shard_size", max(1, cohort // shard))
+        obs.set_gauge("fl_update_stack_bytes_per_replica",
+                      stack_bytes["update_stack_bytes_per_replica"])
     if args.cost_analysis:
         costs = cost_breakdown(server)
         _WATCHDOG.cancel()

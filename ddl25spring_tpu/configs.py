@@ -116,6 +116,16 @@ class HflConfig:
     #                            auto (fused Pallas encode+mask+sum on TPU,
     #                            XLA graph elsewhere) | fused | xla — both
     #                            are bit-identical, tests/test_kernels.py
+    # cohort sharding (fl/sharding.py): size of the DrJAX-style "clients"
+    # mesh axis the sampled cohort is sharded over.  "auto" = the old
+    # heuristic (all local devices when the cohort divides evenly),
+    # "0" = off (single-device round, the exact pre-mesh program),
+    # "N" = explicitly N devices (fails loudly if unavailable)
+    mesh_clients: str = "auto"
+    zero_server: bool = False  # fedopt only: shard the server optimizer
+    #                            state 1/W per replica of the clients mesh
+    #                            (parallel/zero.py ZeRO-1 server update);
+    #                            needs mesh_clients to resolve to a mesh
     # harness
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # rounds; 0 = off
@@ -192,6 +202,29 @@ class HflConfig:
                 f"val_gate_tolerance must be >= 0, got "
                 f"{self.val_gate_tolerance}"
             )
+        if self.mesh_clients != "auto":
+            try:
+                nr = int(self.mesh_clients)
+            except ValueError:
+                raise ValueError(
+                    f"mesh_clients must be 'auto' or an integer >= 0, got "
+                    f"{self.mesh_clients!r}"
+                ) from None
+            if nr < 0:
+                raise ValueError(
+                    f"mesh_clients must be >= 0, got {nr}"
+                )
+        if self.zero_server:
+            if self.algorithm != "fedopt":
+                raise ValueError(
+                    "zero_server shards the FedOpt server optimizer state "
+                    f"and needs algorithm='fedopt', got {self.algorithm!r}"
+                )
+            if self.mesh_clients == "0":
+                raise ValueError(
+                    "zero_server needs a clients mesh "
+                    "(mesh_clients='auto' or > 0)"
+                )
 
 
 @dataclass(frozen=True)

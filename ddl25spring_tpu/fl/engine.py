@@ -564,13 +564,32 @@ def make_fl_round(
         client_chunk, nr_shard,
         mesh.shape[clients_axis] if mesh is not None else 1,
     )
-    if attack is not None and getattr(attack, "collusive", False):
+    collusive = attack is not None and getattr(attack, "collusive", False)
+    if collusive:
         chunk = None
     if secagg is not None:
         # masked aggregation needs the whole cohort's messages and masks in
         # one place (the pairwise cancellation spans every live pair), so —
         # like collusive attacks — it forces the stacked path
         chunk = None
+
+    # Cohort-sharded MapReduce (fl/sharding.py): the client-update map and
+    # the weighted-sum / fault-stat / secagg field-sum reductions run as
+    # per-shard PARTIAL reductions combined with one psum over the clients
+    # axis.  Plaintext robust aggregators genuinely consume the full
+    # [m, D] stack (and collusive attacks need cross-attacker statistics),
+    # so those stay on the GSPMD sharding-constraint path below; grouped
+    # secagg DOES shard — its robust rule runs on the psum'd per-group
+    # aggregates, not per-client rows.
+    use_shard = mesh is not None and not collusive and not (
+        aggregator is not None and secagg_groups <= 1
+    )
+    shard_world = mesh.shape[clients_axis] if use_shard else 1
+    if use_shard and secagg is not None:
+        # the fused Pallas kernel operates on the whole cohort's pair
+        # masks; the sharded reduction computes per-shard mask rows with
+        # the XLA graph instead (bit-identical field sums either way)
+        secagg_fused = False
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -660,33 +679,35 @@ def make_fl_round(
                 attack_seed, round_idx, nr_shard, attack_fraction
             )
 
-        def client_messages(sel_g, keys_g, mal_g, f_nan_g, f_inf_g):
+        def messages_from_data(params_g, xs, ys, cs, keys_g, mal_g,
+                               f_nan_g, f_inf_g):
             """Local updates + uplink pipeline (attack, compression, fault
             corruption) for one GROUP of sampled clients — the whole cohort
-            on the stacked path, one chunk on the streaming paths.  One
-            shared function so the two paths cannot drift semantically."""
-            xs = constrain(jnp.take(x, sel_g, axis=0))
-            ys = constrain(jnp.take(y, sel_g, axis=0))
-            cs = constrain(jnp.take(counts, sel_g, axis=0))
+            on the stacked path, one chunk on the streaming paths, one
+            SHARD's slice on the cohort-sharded path.  One shared function
+            so the paths cannot drift semantically; it is a pure function
+            of its arguments (params and the gathered client data enter
+            explicitly, never by closure) so it traces unchanged inside a
+            ``shard_map`` body."""
             updates = jax.vmap(client_update, in_axes=(None, 0, 0, 0, 0))(
-                params, xs, ys, cs, keys_g
+                params_g, xs, ys, cs, keys_g
             )
-            updates = constrain(updates)
 
             if attack is not None:
                 if getattr(attack, "collusive", False):
                     # collusive attacks (ALIE) need cross-attacker
                     # statistics: one call with the whole stack + mask, not
                     # a per-client vmap — the attack itself only rewrites
-                    # masked rows.  Chunking is disabled for these (above),
-                    # so this group IS the whole cohort.
+                    # masked rows.  Chunking AND cohort sharding are
+                    # disabled for these (above), so this group IS the
+                    # whole cohort.
                     updates = attack(
-                        updates, mal_g, params,
+                        updates, mal_g, params_g,
                         jax.random.fold_in(round_key, 0x5EED),
                     )
                 else:
                     attacked = jax.vmap(attack, in_axes=(0, None, 0))(
-                        updates, params, keys_g
+                        updates, params_g, keys_g
                     )
                     updates = jax.tree.map(
                         lambda a, b: jnp.where(
@@ -709,7 +730,9 @@ def make_fl_round(
                 from ..parallel.compress import quantize_int8, topk_sparsify
 
                 if compress_deltas:
-                    space = jax.tree.map(lambda u, p: u - p, updates, params)
+                    space = jax.tree.map(
+                        lambda u, p: u - p, updates, params_g
+                    )
                 else:
                     space = updates
                 if compress == "topk":
@@ -726,7 +749,7 @@ def make_fl_round(
                     space = jax.vmap(quantize_int8)(space, ckeys)
                 if compress_deltas:
                     updates = jax.tree.map(
-                        lambda s, p: s + p, space, params
+                        lambda s, p: s + p, space, params_g
                     )
                 else:
                     updates = space
@@ -743,6 +766,19 @@ def make_fl_round(
                     return jnp.where(f_inf_g.reshape(shape), jnp.inf, u)
 
                 updates = jax.tree.map(_poison, updates)
+            return updates
+
+        def client_messages(sel_g, keys_g, mal_g, f_nan_g, f_inf_g):
+            """Gather + GSPMD-constraint wrapper around
+            ``messages_from_data`` for the local and sharding-constraint
+            paths (the cohort-sharded path gathers once up front and calls
+            ``messages_from_data`` inside its shard_map body instead)."""
+            xs = constrain(jnp.take(x, sel_g, axis=0))
+            ys = constrain(jnp.take(y, sel_g, axis=0))
+            cs = constrain(jnp.take(counts, sel_g, axis=0))
+            updates = constrain(messages_from_data(
+                params, xs, ys, cs, keys_g, mal_g, f_nan_g, f_inf_g
+            ))
             return updates, cs
 
         def screen_and_stats(updates, f_keep_g, f_nan_g, f_inf_g, f_late_g,
@@ -762,10 +798,12 @@ def make_fl_round(
             ]).astype(jnp.int32)
             return faulted, stats
 
-        def clip_updates(updates):
+        def clip_updates(params_g, updates):
             # client-level DP: clip each client's delta from the round-start
-            # params to L2 <= dp_clip; uniform weights (n_k would leak)
-            deltas = jax.tree.map(lambda u, p: u - p, updates, params)
+            # params to L2 <= dp_clip; uniform weights (n_k would leak).
+            # params passed explicitly (not closed over) so this traces
+            # inside shard_map bodies on the cohort-sharded path.
+            deltas = jax.tree.map(lambda u, p: u - p, updates, params_g)
             sq = sum(
                 jnp.sum(jnp.square(l).reshape(l.shape[0], -1), axis=1)
                 for l in jax.tree.leaves(deltas)
@@ -777,7 +815,7 @@ def make_fl_round(
                 lambda d, p: p + d * scale.reshape(
                     (-1,) + (1,) * (d.ndim - 1)
                 ),
-                deltas, params,
+                deltas, params_g,
             )
 
         def base_weights(cs_all):
@@ -828,6 +866,36 @@ def make_fl_round(
             ]
             return jax.tree.unflatten(treedef, noisy)
 
+        if use_shard:
+            # ---- cohort-sharded MapReduce path (fl/sharding.py) ----
+            # gather the cohort's data OUTSIDE shard_map (GSPMD inserts the
+            # population→cohort reshard); everything the body needs enters
+            # as explicit shard_map operands, never by closure
+            xs = constrain(jnp.take(x, sel, axis=0))
+            ys = constrain(jnp.take(y, sel, axis=0))
+            cs = constrain(jnp.take(counts, sel, axis=0))
+            zb = jnp.zeros((nr_shard,), jnp.bool_)
+            if secagg is not None:
+                shard_data = (
+                    xs, ys, cs, keys,
+                    mal if mal is not None else zb,
+                    f_nan if f_nan is not None else zb,
+                    f_inf if f_inf is not None else zb,
+                )
+                return _secagg_aggregate(
+                    params, sel, live, round_idx, None, cs,
+                    (f_keep, f_nan, f_inf, f_late), add_dp_noise,
+                    clip_updates, agg_key, oracle,
+                    shard_data=shard_data,
+                    messages_from_data=messages_from_data,
+                )
+            return _shard_mapped_round(
+                params, xs, ys, cs, keys, mal, live,
+                (f_keep, f_nan, f_inf, f_late), agg_key,
+                messages_from_data, screen_and_stats, clip_updates,
+                base_weights, hard_zero, add_dp_noise,
+            )
+
         if chunk is not None and not custom_agg:
             return _streaming_linear_round(
                 params, sel, keys, mal, live,
@@ -871,7 +939,7 @@ def make_fl_round(
                 updates = jax.tree.map(_neutralise, updates, params)
 
         if dp_clip:
-            updates = clip_updates(updates)
+            updates = clip_updates(params, updates)
         weights = base_weights(cs)
         if fault_plan is not None and not custom_agg:
             # zero-weight the faulted set (dropout + deadline stragglers +
@@ -901,7 +969,8 @@ def make_fl_round(
         return tree_select(any_survivor, new_params, params), stats
 
     def _secagg_aggregate(params, sel, live, round_idx, updates, cs, fmasks,
-                          add_dp_noise, clip_updates, agg_key, oracle):
+                          add_dp_noise, clip_updates, agg_key, oracle,
+                          shard_data=None, messages_from_data=None):
         """Masked fixed-point aggregation replacing the plaintext weighted
         sum: encode each client's message into the shared uint32 field, add
         its pairwise-cancelling + self masks, modular-sum the SURVIVORS'
@@ -911,7 +980,14 @@ def make_fl_round(
         multiplied into the encoded message inside the field, so the
         modular sum equals the true integer sum while the FieldSpec budget
         holds.  ``oracle=True`` short-circuits to ``(field_sum, plaintext
-        field sum, nr_survivors)`` for the tests' bit-exactness check."""
+        field sum, nr_survivors)`` for the tests' bit-exactness check.
+
+        ``shard_data`` switches the cohort-sharded reduction: ``updates``
+        arrives as None and the clip→encode→mask→modular-sum pipeline runs
+        inside one shard_map program (``_sharded_secagg_totals``) whose
+        per-shard uint32 partial sums psum to BITWISE the same field sums
+        (mod-2³² addition is order-independent); everything from the
+        residue subtraction down is shared verbatim with the local path."""
         from ..secagg import field as sa_field
         from ..secagg import masks as sa_masks
 
@@ -932,12 +1008,15 @@ def make_fl_round(
             surv = live
             stats = None
 
-        if dp_clip:
-            updates = clip_updates(updates)
-        if compress_deltas:
-            msgs = jax.tree.map(lambda u, p: u - p, updates, params)
+        if updates is None:
+            msgs = None  # sharded: messages materialize inside shard_map
         else:
-            msgs = updates
+            if dp_clip:
+                updates = clip_updates(params, updates)
+            if compress_deltas:
+                msgs = jax.tree.map(lambda u, p: u - p, updates, params)
+            else:
+                msgs = updates
 
         spec = secagg.spec
         if dp_clip:
@@ -954,9 +1033,20 @@ def make_fl_round(
             return _secagg_grouped_aggregate(
                 params, sel, live, surv, stats, round_idx, msgs, omega_f,
                 omega_u, wrow, add_dp_noise, agg_key, oracle,
+                clip_updates=clip_updates, shard_data=shard_data,
+                messages_from_data=messages_from_data,
             )
 
-        if secagg_fused:
+        plain_sharded = None
+        if shard_data is not None:
+            res = _sharded_secagg_totals(
+                params, shard_data, sel, live, surv, omega_u, round_idx,
+                None, oracle, messages_from_data, clip_updates,
+            )
+            total = res[0]
+            if oracle:
+                plain_sharded = res[1]
+        elif secagg_fused:
             # one fused pass (secagg/kernels.py): clip -> encode -> weight
             # -> self + gated pair masks -> survivor modular sum, without
             # the per-client masked (m, P) intermediate.  Bit-identical to
@@ -995,15 +1085,20 @@ def make_fl_round(
         if oracle:
             # the plaintext integer-field sum over the same survivors —
             # computed WITHOUT any mask code so the masked==plain assertion
-            # in tests/test_secagg.py checks the cancellation algebra
-            plain = jax.tree.map(
-                lambda e: jnp.sum(
-                    jnp.where(wrow(e, surv), e * wrow(e, omega_u),
-                              jnp.uint32(0)),
-                    axis=0, dtype=jnp.uint32,
-                ),
-                sa_field.encode(msgs, spec),
-            )
+            # in tests/test_secagg.py checks the cancellation algebra (the
+            # sharded variant built its plain sums next to the masked ones,
+            # inside the same shard_map program)
+            if plain_sharded is not None:
+                plain = plain_sharded
+            else:
+                plain = jax.tree.map(
+                    lambda e: jnp.sum(
+                        jnp.where(wrow(e, surv), e * wrow(e, omega_u),
+                                  jnp.uint32(0)),
+                        axis=0, dtype=jnp.uint32,
+                    ),
+                    sa_field.encode(msgs, spec),
+                )
             return field_sum, plain, nr_surv
 
         denom = jnp.sum(jnp.where(surv, omega_f, 0.0))
@@ -1032,7 +1127,8 @@ def make_fl_round(
 
     def _secagg_grouped_aggregate(params, sel, live, surv, stats, round_idx,
                                   msgs, omega_f, omega_u, wrow, add_dp_noise,
-                                  agg_key, oracle):
+                                  agg_key, oracle, clip_updates=None,
+                                  shard_data=None, messages_from_data=None):
         """Group-wise masked aggregation (``secagg.nr_groups > 1``): the
         cohort is partitioned per round into G masking groups
         (``masks.group_assignment``, a seeded fold_in chain), pair masks
@@ -1056,7 +1152,19 @@ def make_fl_round(
         groups = sa_masks.group_assignment(
             secagg.seed, round_idx, nr_shard, G
         )
-        if secagg_fused:
+        plain_sharded = None
+        if shard_data is not None:
+            # cohort-sharded group sums: per-shard rows scatter-add into
+            # replicated (G, ...) partials, psum'd — modular-exact, so the
+            # downstream per-group floors/decode/aggregator are untouched
+            res = _sharded_secagg_totals(
+                params, shard_data, sel, live, surv, omega_u, round_idx,
+                groups, oracle, messages_from_data, clip_updates,
+            )
+            totals = res[0]
+            if oracle:
+                plain_sharded = res[1]
+        elif secagg_fused:
             # fused kernel with group-gated pair masks and per-group
             # survivor reduction in one pass — see the flat branch
             from ..secagg import kernels as sa_kernels
@@ -1092,15 +1200,18 @@ def make_fl_round(
             # plaintext per-group integer field sums, again with no mask
             # code involved — the group-gated cancellation algebra is what
             # the bitwise assertion checks
-            plain = jax.tree.map(
-                lambda e: jnp.zeros(
-                    (G,) + e.shape[1:], jnp.uint32
-                ).at[groups].add(
-                    jnp.where(wrow(e, surv), e * wrow(e, omega_u),
-                              jnp.uint32(0))
-                ),
-                sa_field.encode(msgs, secagg.spec),
-            )
+            if plain_sharded is not None:
+                plain = plain_sharded
+            else:
+                plain = jax.tree.map(
+                    lambda e: jnp.zeros(
+                        (G,) + e.shape[1:], jnp.uint32
+                    ).at[groups].add(
+                        jnp.where(wrow(e, surv), e * wrow(e, omega_u),
+                                  jnp.uint32(0))
+                    ),
+                    sa_field.encode(msgs, secagg.spec),
+                )
             return field_sums, plain, nr_surv_g
 
         denom_g = jnp.zeros((G,), jnp.float32).at[groups].add(
@@ -1148,6 +1259,252 @@ def make_fl_round(
         out = tree_select(any_ok, new_params, params)
         return (out, stats) if fault_plan is not None else out
 
+    def _shard_mapped_round(params, xs, ys, cs, keys, mal, live, fmasks,
+                            agg_key, messages_from_data, screen_and_stats,
+                            clip_updates, base_weights, hard_zero,
+                            add_dp_noise):
+        """Cohort-sharded linear round (DrJAX MapReduce, fl/sharding.py):
+        each of the W shards runs the client-update map on its 1/W slice of
+        the sampled cohort, reduces its weighted partial sum, fault stats,
+        weight sum, and contributor count locally, and one psum over the
+        clients axis combines the shards — so the update stack, backward
+        temporaries, and local-training FLOPs are all cohort/W per replica.
+
+        Bit-exactness contract (tests/test_fl_sharded.py): all randomness
+        is the cohort-global draw from ``_round`` (sliced by the P(clients)
+        operand specs, exactly like the chunked paths slice it), so no
+        random stream moves; int stats psum exactly; at world size 1 every
+        float op below is THE stacked/streaming op (psum is the identity),
+        so shard count 1 is bitwise the local program.  Larger worlds
+        differ only in float summation order — per-shard partials, then
+        one psum — the same class of difference as ``client_chunk``.  With
+        a chunk set, each shard scans chunk/W-row chunks (the streaming
+        accumulator, per shard)."""
+        from . import sharding as shx
+
+        f_keep, f_nan, f_inf, f_late = fmasks
+        weights0 = base_weights(cs)  # cohort-global: dropout draw + any()
+        zb = jnp.zeros((nr_shard,), jnp.bool_)
+        mal_a = mal if mal is not None else zb
+        fk_a = f_keep if f_keep is not None else zb
+        fn_a = f_nan if f_nan is not None else zb
+        fi_a = f_inf if f_inf is not None else zb
+        fl_a = f_late if f_late is not None else zb
+
+        if chunk is None:
+
+            def body(params, xs_l, ys_l, cs_l, keys_l, w_l, live_l, mal_l,
+                     fk_l, fn_l, fi_l, fl_l):
+                updates = messages_from_data(
+                    params, xs_l, ys_l, cs_l, keys_l, mal_l, fn_l, fi_l
+                )
+                if fault_plan is not None:
+                    faulted, stats_l = screen_and_stats(
+                        updates, fk_l, fn_l, fi_l, fl_l, live_l
+                    )
+                    stats = shx.reduce_sum(stats_l, clients_axis)
+                else:
+                    stats = jnp.zeros((4,), jnp.int32)
+                if dp_clip:
+                    updates = clip_updates(params, updates)
+                # the stacked path's weight pipeline with the two global
+                # scalars (Σw, #contributing) psum'd before the ONE
+                # normalisation — bitwise the stacked sequence at W=1
+                if fault_plan is not None:
+                    w_l = jnp.where(faulted, 0.0, w_l)
+                    updates = hard_zero(updates, faulted)
+                wsum = jax.lax.psum(jnp.sum(w_l), clients_axis)
+                nct = jax.lax.psum(
+                    jnp.sum(w_l > 0).astype(jnp.int32), clients_axis
+                )
+                if fault_plan is not None:
+                    w_n = w_l / jnp.where(wsum > 0, wsum, 1.0)
+                else:
+                    w_n = w_l / wsum
+                aggregate = shx.reduce_sum(
+                    tree_weighted_mean(updates, w_n), clients_axis
+                )
+                return aggregate, wsum, nct, stats
+
+            aggregate, wsum, nct, stats = shx.map_clients(
+                body, mesh, clients_axis
+            )(params, xs, ys, cs, keys, weights0, live, mal_a,
+              fk_a, fn_a, fi_a, fl_a)
+        else:
+            # chunk WITHIN each shard: _resolve_chunk rounded chunk to a
+            # multiple of W, so every shard scans the same nr_chunks of
+            # chunk/W rows — the streaming accumulator discipline, with
+            # the final psum+divide replacing the local divide
+            lchunk = chunk // shard_world
+            nr_chunks = nr_shard // chunk
+
+            def body(params, xs_l, ys_l, cs_l, keys_l, w_l, live_l, mal_l,
+                     fk_l, fn_l, fi_l, fl_l):
+                def rsl(a):
+                    return a.reshape((nr_chunks, lchunk) + a.shape[1:])
+
+                scan_xs = tuple(
+                    rsl(a) for a in (xs_l, ys_l, cs_l, keys_l, w_l, live_l,
+                                     mal_l, fk_l, fn_l, fi_l, fl_l)
+                )
+                carry0 = (
+                    jax.tree.map(jnp.zeros_like, params),
+                    jnp.float32(0.0),
+                    jnp.int32(0),
+                    jnp.zeros((4,), jnp.int32),
+                )
+
+                def chunk_body(carry, inp):
+                    acc, wsum, nct, stats = carry
+                    (xs_c, ys_c, cs_c, keys_c, w_c, live_c, mal_c,
+                     fk_c, fn_c, fi_c, fl_c) = inp
+                    updates = messages_from_data(
+                        params, xs_c, ys_c, cs_c, keys_c, mal_c, fn_c, fi_c
+                    )
+                    if fault_plan is not None:
+                        faulted, stats_c = screen_and_stats(
+                            updates, fk_c, fn_c, fi_c, fl_c, live_c
+                        )
+                        stats = stats + stats_c
+                    if dp_clip:
+                        updates = clip_updates(params, updates)
+                    if fault_plan is not None:
+                        w_c = jnp.where(faulted, 0.0, w_c)
+                        updates = hard_zero(updates, faulted)
+                    acc = jax.tree.map(
+                        jnp.add, acc, tree_weighted_mean(updates, w_c)
+                    )
+                    return (
+                        acc, wsum + jnp.sum(w_c),
+                        nct + jnp.sum(w_c > 0), stats
+                    ), None
+
+                (acc, wsum, nct, stats), _ = jax.lax.scan(
+                    chunk_body, carry0, scan_xs
+                )
+                return shx.reduce_sum((acc, wsum, nct, stats), clients_axis)
+
+            acc, wsum, nct, stats = shx.map_clients(
+                body, mesh, clients_axis
+            )(params, xs, ys, cs, keys, weights0, live, mal_a,
+              fk_a, fn_a, fi_a, fl_a)
+            denom = (
+                jnp.where(wsum > 0, wsum, 1.0)
+                if fault_plan is not None else wsum
+            )
+            aggregate = jax.tree.map(
+                lambda a: (a / denom).astype(a.dtype), acc
+            )
+
+        aggregate = add_dp_noise(aggregate, nct)
+        if fault_plan is None:
+            return apply_aggregate(params, aggregate)
+        any_survivor = wsum > 0
+        new_params = apply_aggregate(params, aggregate)
+        return tree_select(any_survivor, new_params, params), stats
+
+    def _sharded_secagg_totals(params, shard_data, sel, live, surv,
+                               omega_u, round_idx, groups, want_plain,
+                               messages_from_data, clip_updates):
+        """One shard_map program producing the masked modular field sums
+        (and, under the oracle, the mask-free plaintext field sums) as
+        per-shard uint32 partial sums combined with psum.  Each shard maps
+        client updates over its cohort slice, encodes into the field,
+        expands only ITS mask rows — ``masks.cohort_masks(positions=...)``
+        against the FULL replicated sel/live/groups vectors, so the rows
+        are bit-identical to the local call's — weights in the field, and
+        survivor-gates before its local sum.  Mod-2³² addition commutes,
+        so the psum'd totals are BITWISE the local path's at any world
+        size.  ``groups`` switches to per-group scatter-add partials with
+        leading axis G.  The fused Pallas kernel is bypassed here: it
+        wants the whole cohort's pair masks in one pass."""
+        from . import sharding as shx
+        from ..secagg import field as sa_field
+        from ..secagg import masks as sa_masks
+
+        xs, ys, cs, keys, mal_a, fn_a, fi_a = shard_data
+        grouped = groups is not None
+        G = secagg_groups if grouped else 1
+        groups_a = (
+            groups if grouped else jnp.zeros((nr_shard,), jnp.int32)
+        )
+
+        def wrow(t, m):
+            return m.reshape((-1,) + (1,) * (t.ndim - 1))
+
+        def body(params, sel_f, live_f, surv_f, omega_f, groups_f, round_i,
+                 xs_l, ys_l, cs_l, keys_l, mal_l, fn_l, fi_l):
+            pos = shx.shard_positions(nr_shard, mesh, clients_axis)
+            updates = messages_from_data(
+                params, xs_l, ys_l, cs_l, keys_l, mal_l, fn_l, fi_l
+            )
+            if dp_clip:
+                updates = clip_updates(params, updates)
+            if compress_deltas:
+                msgs = jax.tree.map(lambda u, p: u - p, updates, params)
+            else:
+                msgs = updates
+            enc = sa_field.encode(msgs, secagg.spec)
+            rows = sa_masks.cohort_masks(
+                secagg.seed, sel_f, live_f, round_i, params,
+                groups=groups_f if grouped else None, positions=pos,
+            )
+            om_l = jnp.take(omega_f, pos)
+            surv_l = jnp.take(surv_f, pos)
+            masked = jax.tree.map(
+                lambda e, mk: e * wrow(e, om_l) + mk, enc, rows
+            )
+            if grouped:
+                g_l = jnp.take(groups_f, pos)
+
+                def gsum(ml):
+                    contrib = jnp.where(
+                        wrow(ml, surv_l), ml, jnp.uint32(0)
+                    )
+                    return jnp.zeros(
+                        (G,) + ml.shape[1:], jnp.uint32
+                    ).at[g_l].add(contrib)
+
+                part = jax.tree.map(gsum, masked)
+            else:
+                part = jax.tree.map(
+                    lambda ml: jnp.sum(
+                        jnp.where(wrow(ml, surv_l), ml, jnp.uint32(0)),
+                        axis=0, dtype=jnp.uint32,
+                    ),
+                    masked,
+                )
+            out = [shx.reduce_sum(part, clients_axis)]
+            if want_plain:
+                if grouped:
+
+                    def pgsum(e):
+                        contrib = jnp.where(
+                            wrow(e, surv_l), e * wrow(e, om_l),
+                            jnp.uint32(0),
+                        )
+                        return jnp.zeros(
+                            (G,) + e.shape[1:], jnp.uint32
+                        ).at[g_l].add(contrib)
+
+                    pl = jax.tree.map(pgsum, enc)
+                else:
+                    pl = jax.tree.map(
+                        lambda e: jnp.sum(
+                            jnp.where(wrow(e, surv_l),
+                                      e * wrow(e, om_l), jnp.uint32(0)),
+                            axis=0, dtype=jnp.uint32,
+                        ),
+                        enc,
+                    )
+                out.append(shx.reduce_sum(pl, clients_axis))
+            return tuple(out)
+
+        return shx.map_clients(body, mesh, clients_axis, nr_replicated=7)(
+            params, sel, live, surv, omega_u, groups_a, round_idx,
+            xs, ys, cs, keys, mal_a, fn_a, fi_a,
+        )
+
     def _streaming_linear_round(params, sel, keys, mal, live, fmasks,
                                 counts, agg_key, client_messages,
                                 screen_and_stats, clip_updates,
@@ -1194,7 +1551,7 @@ def make_fl_round(
                 )
                 stats = stats + stats_c
             if dp_clip:
-                updates = clip_updates(updates)
+                updates = clip_updates(params, updates)
             if fault_plan is not None:
                 w_c = jnp.where(faulted, 0.0, w_c)
                 updates = hard_zero(updates, faulted)
@@ -1353,12 +1710,42 @@ def make_fl_round(
     # stack geometry for the peak-update-bytes gauge: the streaming linear
     # path holds chunk rows (accumulator is 1 extra row); the chunked
     # robust build holds the full cohort at robust_stack precision; the
-    # stacked path holds the full cohort at param precision
+    # stacked path holds the full cohort at param precision.  Under cohort
+    # sharding every row count divides by the world size PER REPLICA.
     stack_rows = chunk if (chunk is not None and not custom_agg) else nr_shard
     stack_shrink = (
         {"float32": 1, "bfloat16": 2, "int8": 4}[robust_stack]
         if (chunk is not None and custom_agg) else 1
     )
+
+    if use_shard:
+        # host-side accounting of the sharded round's psum traffic through
+        # the shared collectives counters (parallel/collectives.py), same
+        # discipline as the DP train step: one signature per dispatch,
+        # cached after the first obs-enabled call
+        from ..parallel.collectives import (
+            instrument_collectives, tree_nr_leaves, tree_payload_bytes,
+        )
+
+        def _psum_sig(params, *_args, **_kw):
+            if secagg is not None:
+                # uint32 field-sum tree: 4 bytes/coordinate, ×G group rows
+                coords = sum(
+                    int(l.size) for l in jax.tree.leaves(params)
+                    if hasattr(l, "size")
+                )
+                return [("psum", tree_nr_leaves(params),
+                         4 * coords * secagg_groups)]
+            # linear: the params-shaped partial-sum tree + wsum + nct +
+            # the (4,) int32 stats vector
+            return [("psum", tree_nr_leaves(params) + 3,
+                     tree_payload_bytes(params) + 24)]
+
+        _round_dispatch = instrument_collectives(
+            _round, _psum_sig, op="fl.round"
+        )
+    else:
+        _round_dispatch = _round
 
     def _secagg_host_round(base_key, step) -> bool:
         """Eager replay of the jitted round's sampling + fault draws so
@@ -1434,15 +1821,15 @@ def make_fl_round(
             if _secagg_host_round(base_key, int(round_idx)):
                 obs.inc("fl_round_rejected_total", reason="secagg_floor")
         if not obs.enabled() or tracer:
-            out = _round(params, base_key, round_idx, x, y, counts,
-                         mal_mask)
+            out = _round_dispatch(params, base_key, round_idx, x, y,
+                                  counts, mal_mask)
             return out[0] if fault_plan is not None else out
         step = int(round_idx)
         with obs.span("fl.round", round=step) as sp:
             with obs.step_annotation("fl.round", step):
                 out = sp.fence(
-                    _round(params, base_key, round_idx, x, y, counts,
-                           mal_mask)
+                    _round_dispatch(params, base_key, round_idx, x, y,
+                                    counts, mal_mask)
                 )
         if fault_plan is not None:
             new_params, stats = out
@@ -1455,6 +1842,15 @@ def make_fl_round(
         obs.set_gauge(
             "fl_update_stack_bytes",
             stack_rows * (_tree_bytes(new_params) // stack_shrink),
+        )
+        # cohort-sharding geometry: clients per replica and the PER-REPLICA
+        # update-stack bytes (the number each chip actually holds — equals
+        # the cohort-wide gauge at world size 1)
+        obs.set_gauge("fl_cohort_shard_size", nr_shard // shard_world)
+        obs.set_gauge(
+            "fl_update_stack_bytes_per_replica",
+            (stack_rows // shard_world)
+            * (_tree_bytes(new_params) // stack_shrink),
         )
         agg_pairwise = getattr(aggregator, "pairwise_impl", None)
         if agg_pairwise is not None:
@@ -1515,6 +1911,10 @@ def make_fl_round(
     # would materialize — tools/mem_estimate.py's stack-rows denominator
     round_fn.client_chunk = chunk
     round_fn.nr_sampled = nr_shard
+    # cohort-sharding world size the round actually runs at: 1 when the
+    # shard_map path is off (no mesh, or a configuration that fell back to
+    # the GSPMD-constraint / local path) — bench and tests read this
+    round_fn.cohort_shard = shard_world
     # the session object (None when off) + a bit-exactness probe for the
     # tests: (masked field sum, independently-computed plaintext field sum,
     # nr_survivors) for one round, no params update

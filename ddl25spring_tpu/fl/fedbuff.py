@@ -58,6 +58,8 @@ def make_fedbuff_round(
     donate: bool = False,
     secagg=None,
     secagg_impl: str = "auto",
+    mesh=None,
+    clients_axis: str = "clients",
 ):
     """Build ``tick(history, base_key, tick_idx) -> history`` where
     ``history`` is the params pytree with a leading ``staleness_window``
@@ -87,7 +89,16 @@ def make_fedbuff_round(
     ``client_chunk = 0`` IS the stacked program.  ``donate = True``
     donates the history argument of the jitted tick (the caller must not
     reuse the history it passed in; the server reassignment pattern is
-    safe, async checkpointers are not)."""
+    safe, async checkpointers are not).
+
+    ``mesh`` with a ``clients_axis`` switches the PLAINTEXT tick to the
+    cohort-sharded MapReduce of ``fl/sharding.py``: each shard maps its
+    1/W slice of the sampled set (history replicated — every shard gathers
+    its clients' stale versions locally) and the staleness-weighted delta
+    sum, weight sum, and fault stats psum over the axis.  Shard count 1 is
+    bitwise the local tick; secagg and collusive-attack ticks, and a
+    ``nr_sampled`` not divisible by the axis extent, fall back to the
+    unsharded program."""
     if staleness_window < 1:
         raise ValueError(f"staleness_window must be >= 1, got {staleness_window}")
     if round_deadline_s is not None and round_deadline_s <= 0:
@@ -110,8 +121,18 @@ def make_fedbuff_round(
     counts = jnp.asarray(counts)
     nr_clients = x.shape[0]
     W = staleness_window
-    chunk = _resolve_chunk(client_chunk, nr_sampled)
-    if attack is not None and getattr(attack, "collusive", False):
+    collusive = attack is not None and getattr(attack, "collusive", False)
+    # cohort sharding (fl/sharding.py): plaintext ticks only — secagg
+    # wants the cohort's mask algebra in one place here (the engine has
+    # the sharded variant), collusive attacks need the whole delta stack,
+    # and a non-divisible sample can't split evenly over the axis
+    use_shard = (
+        mesh is not None and not collusive and secagg is None
+        and nr_sampled % mesh.shape[clients_axis] == 0
+    )
+    shard_world = mesh.shape[clients_axis] if use_shard else 1
+    chunk = _resolve_chunk(client_chunk, nr_sampled, shard_world)
+    if collusive:
         # collusive attacks need the whole delta stack at once (shared
         # coalition statistics) — the streaming scan never materialises it
         chunk = None
@@ -179,16 +200,17 @@ def make_fedbuff_round(
         else:
             f_keep = f_nan = f_inf = f_late = None
 
-        def chunk_deltas(stale_g, sel_g, keys_g, mal_g, f_nan_g, f_inf_g):
+        def deltas_from_data(history_g, stale_g, xs, ys, cs, keys_g, mal_g,
+                             f_nan_g, f_inf_g):
             """Deltas + attack + fault corruption for one group of sampled
             clients (the whole sample on the stacked path, one chunk when
-            streaming) — shared so the two paths cannot drift."""
-            xs = jnp.take(x, sel_g, axis=0)
-            ys = jnp.take(y, sel_g, axis=0)
-            cs = jnp.take(counts, sel_g, axis=0)
+            streaming, one shard's slice under cohort sharding) — shared so
+            the paths cannot drift.  History and the gathered client data
+            enter explicitly, never by closure, so this traces inside a
+            shard_map body."""
 
             def one_client(d, x_i, y_i, c_i, k_i):
-                base = jax.tree.map(lambda h: h[d], history)
+                base = jax.tree.map(lambda h: h[d], history_g)
                 local = client_update(base, x_i, y_i, c_i, k_i)
                 return jax.tree.map(jnp.subtract, local, base)
 
@@ -197,7 +219,7 @@ def make_fedbuff_round(
             if attack is not None:
                 # attacks transform the outgoing DELTA (the async message),
                 # keyed per client like the engine's update attacks
-                base0 = jax.tree.map(lambda h: h[0], history)
+                base0 = jax.tree.map(lambda h: h[0], history_g)
                 if getattr(attack, "collusive", False):
                     deltas = attack(
                         deltas, mal_g, base0,
@@ -226,6 +248,15 @@ def make_fedbuff_round(
                 deltas = jax.tree.map(_poison, deltas)
             return deltas
 
+        def chunk_deltas(stale_g, sel_g, keys_g, mal_g, f_nan_g, f_inf_g):
+            """Gather wrapper around ``deltas_from_data`` for the local
+            paths (the sharded tick gathers once up front instead)."""
+            xs = jnp.take(x, sel_g, axis=0)
+            ys = jnp.take(y, sel_g, axis=0)
+            cs = jnp.take(counts, sel_g, axis=0)
+            return deltas_from_data(history, stale_g, xs, ys, cs, keys_g,
+                                    mal_g, f_nan_g, f_inf_g)
+
         def screen(deltas, f_keep_g, f_nan_g, f_inf_g, f_late_g):
             from ..resilience.guard import tree_client_isfinite
 
@@ -253,7 +284,118 @@ def make_fedbuff_round(
             / (1.0 + stale.astype(jnp.float32)) ** staleness_exp
         )
 
-        if chunk is not None:
+        if use_shard:
+            # ---- cohort-sharded MapReduce tick (fl/sharding.py) ----
+            # gather the sampled set's data OUTSIDE shard_map; everything
+            # the body needs enters as explicit operands (history
+            # replicated — each shard gathers its clients' stale versions
+            # from the full W-deep stack locally).  Shard count 1 is
+            # bitwise the plaintext stacked/streaming tick; larger worlds
+            # differ only in float summation order.
+            from . import sharding as shx
+
+            xs_all = jnp.take(x, sel, axis=0)
+            ys_all = jnp.take(y, sel, axis=0)
+            zb = jnp.zeros((nr_sampled,), jnp.bool_)
+            fk_a = f_keep if f_keep is not None else zb
+            fn_a = f_nan if f_nan is not None else zb
+            fi_a = f_inf if f_inf is not None else zb
+            fl_a = f_late if f_late is not None else zb
+
+            if chunk is None:
+
+                def body(history, stale_l, xs_l, ys_l, cs_l, keys_l,
+                         mal_l, w_l, fk_l, fn_l, fi_l, fl_l):
+                    deltas = deltas_from_data(
+                        history, stale_l, xs_l, ys_l, cs_l, keys_l,
+                        mal_l, fn_l, fi_l,
+                    )
+                    if fault_plan is not None:
+                        deltas, faulted, stats_l = screen(
+                            deltas, fk_l, fn_l, fi_l, fl_l
+                        )
+                        stats = shx.reduce_sum(stats_l, clients_axis)
+                        w_l = jnp.where(faulted, 0.0, w_l)
+                    else:
+                        stats = jnp.zeros((4,), jnp.int32)
+                    wsum = jax.lax.psum(jnp.sum(w_l), clients_axis)
+                    if fault_plan is not None:
+                        w_n = w_l / jnp.where(wsum > 0, wsum, 1.0)
+                    else:
+                        w_n = w_l / wsum
+                    delta = shx.reduce_sum(
+                        tree_weighted_mean(deltas, w_n), clients_axis
+                    )
+                    return delta, stats
+
+                delta, stats = shx.map_clients(body, mesh, clients_axis)(
+                    history, stale, xs_all, ys_all, cs_all, keys, mal,
+                    weights, fk_a, fn_a, fi_a, fl_a,
+                )
+            else:
+                # chunk WITHIN each shard (chunk is a multiple of the axis
+                # extent by _resolve_chunk): the streaming accumulator per
+                # shard, psum'd once, single divide outside
+                lchunk = chunk // shard_world
+                nr_chunks = nr_sampled // chunk
+
+                def body(history, stale_l, xs_l, ys_l, cs_l, keys_l,
+                         mal_l, w_l, fk_l, fn_l, fi_l, fl_l):
+                    def rsl(a):
+                        return a.reshape(
+                            (nr_chunks, lchunk) + a.shape[1:]
+                        )
+
+                    scan_xs = tuple(
+                        rsl(a) for a in (stale_l, xs_l, ys_l, cs_l,
+                                         keys_l, mal_l, w_l, fk_l, fn_l,
+                                         fi_l, fl_l)
+                    )
+                    carry0 = (
+                        jax.tree.map(
+                            lambda h: jnp.zeros(h.shape[1:], h.dtype),
+                            history,
+                        ),
+                        jnp.float32(0.0),
+                        jnp.zeros((4,), jnp.int32),
+                    )
+
+                    def chunk_body(carry, inp):
+                        acc, wsum, stats = carry
+                        (stale_c, xs_c, ys_c, cs_c, keys_c, mal_c, w_c,
+                         fk_c, fn_c, fi_c, fl_c) = inp
+                        deltas = deltas_from_data(
+                            history, stale_c, xs_c, ys_c, cs_c, keys_c,
+                            mal_c, fn_c, fi_c,
+                        )
+                        if fault_plan is not None:
+                            deltas, faulted, stats_c = screen(
+                                deltas, fk_c, fn_c, fi_c, fl_c
+                            )
+                            stats = stats + stats_c
+                            w_c = jnp.where(faulted, 0.0, w_c)
+                        acc = jax.tree.map(
+                            jnp.add, acc, tree_weighted_mean(deltas, w_c)
+                        )
+                        return (acc, wsum + jnp.sum(w_c), stats), None
+
+                    (acc, wsum, stats), _ = jax.lax.scan(
+                        chunk_body, carry0, scan_xs
+                    )
+                    return shx.reduce_sum(
+                        (acc, wsum, stats), clients_axis
+                    )
+
+                acc, wsum, stats = shx.map_clients(
+                    body, mesh, clients_axis
+                )(history, stale, xs_all, ys_all, cs_all, keys, mal,
+                  weights, fk_a, fn_a, fi_a, fl_a)
+                denom = jnp.where(wsum > 0, wsum, 1.0) \
+                    if fault_plan is not None else wsum
+                delta = jax.tree.map(
+                    lambda a: (a / denom).astype(a.dtype), acc
+                )
+        elif chunk is not None:
             # streaming tick: scan over chunks, folding each chunk's
             # weighted delta sum into a fixed-size accumulator (the
             # engine's O(chunk·P) recipe; single renormalisation below)
@@ -514,6 +656,24 @@ def make_fedbuff_round(
         )
         return (out, stats) if fault_plan is not None else out
 
+    if use_shard:
+        # psum traffic of the sharded tick through the shared collectives
+        # counters (parallel/collectives.py): the model-shaped delta
+        # partial (history bytes / window) + weight sum + stats vector
+        from ..parallel.collectives import (
+            instrument_collectives, tree_nr_leaves, tree_payload_bytes,
+        )
+
+        def _psum_sig(history, *_args, **_kw):
+            return [("psum", tree_nr_leaves(history) + 2,
+                     tree_payload_bytes(history) // W + 20)]
+
+        _tick_dispatch = instrument_collectives(
+            _tick, _psum_sig, op="fl.tick"
+        )
+    else:
+        _tick_dispatch = _tick
+
     def _secagg_host_tick(base_key, step):
         """Eager replay of the tick's sampling + fault draws for the
         host-side Shamir bookkeeping (engine._secagg_host_round's twin,
@@ -569,13 +729,14 @@ def make_fedbuff_round(
             if _secagg_host_tick(base_key, int(tick_idx)):
                 obs.inc("fl_round_rejected_total", reason="secagg_floor")
         if not obs.enabled() or tracer:
-            out = _tick(history, base_key, tick_idx, x, y, counts)
+            out = _tick_dispatch(history, base_key, tick_idx, x, y, counts)
             return out[0] if fault_plan is not None else out
         step = int(tick_idx)
         with obs.span("fl.tick", tick=step, staleness_window=W) as sp:
             with obs.step_annotation("fl.tick", step):
                 out = sp.fence(
-                    _tick(history, base_key, tick_idx, x, y, counts)
+                    _tick_dispatch(history, base_key, tick_idx, x, y,
+                                   counts)
                 )
         if fault_plan is not None:
             new_history, f_stats = out
@@ -606,6 +767,10 @@ def make_fedbuff_round(
 
     tick.secagg = secagg
     tick.secagg_fused = secagg is not None and secagg_fused
+    # cohort-sharding world size the tick actually runs at (1 = off or
+    # fallen back) and the resolved chunk — tests and bench read these
+    tick.cohort_shard = shard_world
+    tick.client_chunk = chunk
     if secagg is not None:
         def _secagg_oracle(history, base_key, tick_idx):
             return _tick(history, base_key, tick_idx, x, y, counts,
@@ -649,7 +814,7 @@ class FedBuffServer(_DecentralizedServer):
                  fault_plan=None,
                  round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
-                 secagg=None, secagg_impl: str = "auto"):
+                 secagg=None, secagg_impl: str = "auto", mesh=None):
         from .engine import make_local_sgd_update
 
         super().__init__(task, lr, batch_size, client_data, client_fraction,
@@ -668,7 +833,7 @@ class FedBuffServer(_DecentralizedServer):
             attack_fraction=attack_fraction, attack_seed=attack_seed,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate, secagg=secagg,
-            secagg_impl=secagg_impl,
+            secagg_impl=secagg_impl, mesh=mesh,
         )
         self.params = init_history(self.params, staleness_window)
         # evaluate the CURRENT version of the stacked history

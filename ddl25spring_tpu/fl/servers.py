@@ -321,7 +321,7 @@ class FedOptServer(DecentralizedServer):
                  server_optimizer: str = "adam", server_lr: float = 1e-2,
                  aggregator=None, attack=None, malicious_mask=None,
                  attack_fraction: float = 0.0, attack_seed: int = 0,
-                 mesh=None,
+                 mesh=None, zero_server: bool = False,
                  prox_mu: float = 0.0, dropout_rate: float = 0.0,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, robust_stack: str = "float32",
@@ -346,7 +346,14 @@ class FedOptServer(DecentralizedServer):
             "adam": lambda: optax.adam(server_lr, eps=1e-3),
             "yogi": lambda: optax.yogi(server_lr, eps=1e-3),
         }[server_optimizer]()
-        self._opt_state = opt.init(self.params)
+        if zero_server and mesh is None:
+            raise ValueError(
+                "zero_server=True needs a clients mesh to shard the server "
+                "optimizer state over (set mesh_clients)"
+            )
+        self.zero_server = zero_server
+        if not zero_server:
+            self._opt_state = opt.init(self.params)
 
         client_update = _make_weight_client_update(
             task, lr, batch_size, nr_local_epochs, client_data, prox_mu
@@ -368,11 +375,47 @@ class FedOptServer(DecentralizedServer):
             secagg=secagg, secagg_impl=secagg_impl,
         )
 
-        @jax.jit
-        def server_step(params, opt_state, w_avg):
-            delta = jax.tree.map(jnp.subtract, params, w_avg)
-            updates, opt_state = opt.update(delta, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state
+        if zero_server:
+            # ZeRO-1 server update: moments and update live on a 1/W slice
+            # per replica of the clients mesh (parallel.zero); the scatter+
+            # gather pair is accounted like the round's own psums
+            from ..parallel.collectives import instrument_collectives
+            from ..parallel.zero import make_zero_server_step
+
+            server_step, self._opt_state = make_zero_server_step(
+                opt, mesh, self.params, axis="clients"
+            )
+            nbytes = 4 * sum(
+                l.size for l in jax.tree.leaves(self.params)
+            )
+            server_step = instrument_collectives(
+                server_step,
+                lambda *a, **k: [
+                    ("psum_scatter", 1, nbytes),
+                    ("all_gather", 1, nbytes),
+                ],
+                op="fl.server_zero",
+            )
+            from .. import obs
+
+            # per-replica server-optimizer bytes: the sharded state's array
+            # leaves carry a leading (W,) shard axis, so one replica holds
+            # leaf.size / W elements of each
+            W = mesh.shape["clients"]
+            opt_bytes = sum(
+                (l.size // W) * l.dtype.itemsize
+                for l in jax.tree.leaves(self._opt_state)
+                if hasattr(l, "size") and l.ndim
+            )
+            if obs.enabled():
+                obs.set_gauge("fl_server_opt_bytes_per_replica", opt_bytes)
+                obs.set_gauge("fl_zero_server_world", W)
+        else:
+            @jax.jit
+            def server_step(params, opt_state, w_avg):
+                delta = jax.tree.map(jnp.subtract, params, w_avg)
+                updates, opt_state = opt.update(delta, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state
 
         def round_fn(params, base_key, round_idx):
             w_avg = aggregate_fn(params, base_key, round_idx)
@@ -386,6 +429,9 @@ class FedOptServer(DecentralizedServer):
         round_fn.secagg = getattr(aggregate_fn, "secagg", None)
         round_fn.secagg_oracle = getattr(aggregate_fn, "secagg_oracle", None)
         round_fn.secagg_fused = getattr(aggregate_fn, "secagg_fused", False)
+        round_fn.cohort_shard = getattr(aggregate_fn, "cohort_shard", 1)
+        round_fn.server_step = server_step  # tests drive the zero step raw
+        self._server_step = server_step
         self.round_fn = round_fn
 
     def extra_state(self):

@@ -1,0 +1,111 @@
+"""DrJAX-style cohort-sharding primitives for the FL round.
+
+DrJAX (arXiv 2403.07128) expresses a federated round as MapReduce over a
+dedicated ``clients`` mesh axis: ``map_clients`` runs the per-client
+computation on each shard's slice of the sampled cohort, and the reduce
+primitives combine per-shard PARTIAL reductions with one ``psum`` over the
+axis — so the update stack, the backward-pass temporaries, and the local
+training FLOPs all scale with ``cohort / W`` per replica instead of the
+whole cohort.  ``engine.make_fl_round`` / ``fedbuff.make_fedbuff_round``
+build their sharded paths from these three primitives plus the shared
+chunk-scan discipline (``client_chunk`` streams chunks WITHIN each shard).
+
+Reduction algebra and bit-exactness (the contract tests/test_fl_sharded.py
+pins):
+
+- integer reductions (fault stats, secagg's uint32 modular field sums) are
+  order-independent, so sharded == local must hold BITWISE at any world
+  size — uint32 addition mod 2³² is associative and commutative;
+- float reductions change only the summation ORDER (per-shard partials,
+  then one psum), the same class of difference as the ``client_chunk``
+  streaming accumulator — shard count 1 is bit-identical to the local
+  program by construction, larger worlds match within summation-order
+  tolerance.
+
+The primitives run INSIDE a ``shard_map`` body (``map_clients`` is the
+wrapper that opens one); they lower to a single all-reduce over ICI when
+the mesh axis spans devices, and to the identity at world size 1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.compat import shard_map
+from ..utils.trees import tree_weighted_mean
+
+CLIENTS_AXIS = "clients"
+
+
+def axis_world(mesh, axis: str = CLIENTS_AXIS) -> int:
+    """Extent of the clients axis (the shard-map world size W)."""
+    return mesh.shape[axis]
+
+
+def map_clients(body, mesh, axis: str = CLIENTS_AXIS,
+                nr_replicated: int = 1):
+    """Wrap ``body`` as a shard_map program over the clients axis.
+
+    ``body(*replicated, *per_client)`` receives the first
+    ``nr_replicated`` arguments replicated (``P()`` — params, cohort-global
+    id/liveness vectors, scalars) and every remaining argument sharded on
+    its LEADING axis (``P(axis)`` — the sampled-cohort slice this shard
+    owns).  Outputs must already be replicated when they leave the body:
+    reduce them with :func:`reduce_sum` / :func:`reduce_weighted` (which
+    end in a ``psum``) before returning.  Axes of ``mesh`` other than
+    ``axis`` (e.g. a multihost ``dcn`` axis) stay replicated throughout.
+    """
+
+    def run(*args):
+        nr_sharded = len(args) - nr_replicated
+        in_specs = (P(),) * nr_replicated + (P(axis),) * nr_sharded
+        return shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+            check_vma=False,
+        )(*args)
+
+    return run
+
+
+def shard_positions(nr_cohort: int, mesh, axis: str = CLIENTS_AXIS):
+    """Global cohort positions owned by the calling shard (use inside a
+    :func:`map_clients` body): shard ``s`` of ``W`` owns the contiguous
+    block ``[s·(nr/W), (s+1)·(nr/W))`` — the same layout ``P(axis)``
+    gives the sharded operands."""
+    shard = nr_cohort // axis_world(mesh, axis)
+    return jax.lax.axis_index(axis) * shard + jnp.arange(shard)
+
+
+def reduce_sum(tree, axis: str = CLIENTS_AXIS):
+    """Cross-shard sum of a pytree of per-shard partial reductions (one
+    logical psum per leaf).  Exact for integer/uint32 leaves — modular
+    addition commutes — which is what keeps fault stats order-exact and
+    secagg field sums bitwise identical to the local path."""
+    return jax.tree.map(lambda l: jax.lax.psum(l, axis), tree)
+
+
+def reduce_weighted(updates, weights, axis: str = CLIENTS_AXIS):
+    """Weighted-sum reduction over the cohort: each shard computes its
+    partial Σᵢ wᵢ·uᵢ over its LOCAL rows (``tree_weighted_mean`` with
+    unnormalized weights IS that partial sum), then one psum combines the
+    shards.  Returns ``(sum_tree, weight_sum)`` — the caller performs the
+    single normalizing divide, so the float structure matches the
+    ``client_chunk`` streaming accumulator."""
+    partial = tree_weighted_mean(updates, weights)
+    return reduce_sum((partial, jnp.sum(weights)), axis)
+
+
+def psum_signature(tree, extra_scalar_leaves: int = 0):
+    """Host-side collective signature of one sharded-round dispatch for
+    ``parallel.collectives.instrument_collectives``: one logical psum per
+    array leaf of ``tree`` (the partial-reduction payload) plus
+    ``extra_scalar_leaves`` scalar psums (weight sum, contributor count,
+    stats vector...).  Pure shape math — safe to call with ShapeDtypeStruct
+    trees."""
+    from ..parallel.collectives import tree_nr_leaves, tree_payload_bytes
+
+    calls = tree_nr_leaves(tree) + extra_scalar_leaves
+    nbytes = tree_payload_bytes(tree) + 4 * extra_scalar_leaves
+    return [("psum", calls, nbytes)]

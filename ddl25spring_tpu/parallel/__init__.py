@@ -16,7 +16,7 @@ from .compress import (
     topk_sparsify,
 )
 from .multihost import initialize_multihost, make_multihost_mesh
-from .zero import make_zero_dp_train_step
+from .zero import make_zero_dp_train_step, make_zero_server_step
 from .sp import (
     make_sp_forward,
     make_sp_generate,
@@ -65,6 +65,7 @@ __all__ = [
     "initialize_multihost",
     "make_multihost_mesh",
     "make_zero_dp_train_step",
+    "make_zero_server_step",
     "instrument_collectives",
     "tree_payload_bytes",
 ]

@@ -125,6 +125,47 @@ def build_secagg(cfg: HflConfig, client_data):
                   nr_groups=cfg.secagg_groups)
 
 
+def build_clients_mesh(spec: str, clients_per_round: int):
+    """Resolve ``HflConfig.mesh_clients`` into the cohort-sharding mesh.
+
+    ``"0"`` — no mesh, the exact single-device program.  ``"auto"`` — the
+    historical heuristic: all local devices, but only when more than one
+    exists and the sampled cohort is at least that large (below that,
+    shard padding wastes compute).  ``"N"`` — exactly N devices, failing
+    LOUDLY when unavailable instead of silently degrading — the point of
+    making the choice explicit config.  Under multi-controller JAX the
+    clients axis subdivides each host's local devices and an outer ``dcn``
+    axis spans hosts (parallel/multihost.py).
+    """
+    import jax
+
+    from .parallel import make_mesh, make_multihost_mesh
+
+    nr_devices = len(jax.devices())
+    if spec == "auto":
+        nr = nr_devices
+        if nr <= 1 or clients_per_round < nr:
+            return None
+    else:
+        nr = int(spec)
+        if nr == 0:
+            return None
+        if nr > nr_devices:
+            raise ValueError(
+                f"mesh_clients={nr} but only {nr_devices} device(s) "
+                f"available"
+            )
+    if jax.process_count() > 1:
+        local = nr // jax.process_count()
+        if local * jax.process_count() != nr:
+            raise ValueError(
+                f"mesh_clients={nr} does not split evenly over "
+                f"{jax.process_count()} processes"
+            )
+        return make_multihost_mesh(ici_axes={"clients": local})
+    return make_mesh({"clients": nr}, devices=jax.devices()[:nr])
+
+
 def build_server(cfg: HflConfig):
     from .resilience.faults import FaultPlan
 
@@ -250,11 +291,13 @@ def build_server(cfg: HflConfig):
         attack = build_attack(cfg)
         if cfg.attack == "label-flip":
             client_data = flip_labels(client_data, malicious, nr_classes=10)
+        buff_cohort = max(1, round(cfg.client_fraction * cfg.nr_clients))
         return FedBuffServer(
             task, cfg.lr, cfg.batch_size, client_data, cfg.client_fraction,
             cfg.nr_local_epochs, cfg.seed,
             staleness_window=cfg.staleness_window,
             staleness_exp=cfg.staleness_exp, server_eta=cfg.server_eta,
+            mesh=build_clients_mesh(cfg.mesh_clients, buff_cohort),
             attack=attack,
             malicious_mask=malicious if attack is not None else None,
             attack_fraction=cfg.attack_fraction, attack_seed=cfg.attack_seed,
@@ -301,16 +344,10 @@ def build_server(cfg: HflConfig):
     if cfg.attack == "label-flip":  # data attack: poisons the datasets
         client_data = flip_labels(client_data, malicious, nr_classes=10)
 
-    import jax
-
-    from .parallel import make_mesh
-
-    nr_devices = len(jax.devices())
     clients_per_round = max(1, round(cfg.client_fraction * cfg.nr_clients))
-    # shard clients over the mesh only when there are at least as many
-    # sampled clients as devices — below that, padding wastes compute
-    mesh = (make_mesh({"clients": nr_devices})
-            if nr_devices > 1 and clients_per_round >= nr_devices else None)
+    # cohort-sharding mesh from EXPLICIT config (mesh_clients), not a
+    # silent device-count heuristic — "auto" reproduces the old behaviour
+    mesh = build_clients_mesh(cfg.mesh_clients, clients_per_round)
     # donate params on the chunked round when no async checkpointer can
     # hold a live reference to server.params across the next dispatch (the
     # on_round save serializes the buffer donation would let XLA overwrite)
@@ -357,11 +394,18 @@ def build_server(cfg: HflConfig):
                             compress_ratio=cfg.compress_ratio,
                             donate=donate, **kw)
     if cfg.algorithm == "fedopt":
+        if cfg.zero_server and mesh is None:
+            raise ValueError(
+                "--zero-server needs the clients mesh to resolve "
+                "(mesh_clients='auto' found no usable devices; pass "
+                "--mesh-clients N explicitly)"
+            )
         return FedOptServer(task, cfg.lr, cfg.batch_size, client_data,
                             cfg.client_fraction, cfg.nr_local_epochs,
                             cfg.seed, server_optimizer=cfg.server_optimizer,
                             server_lr=cfg.server_lr, prox_mu=cfg.prox_mu,
-                            dropout_rate=cfg.dropout_rate, **kw)
+                            dropout_rate=cfg.dropout_rate,
+                            zero_server=cfg.zero_server, **kw)
     raise ValueError(f"unknown algorithm {cfg.algorithm!r}")
 
 
@@ -373,6 +417,18 @@ def run(cfg: HflConfig):
         obs.trace.ensure()  # adopt DDL25_TRACEPARENT or start a new trace
         obs_watchdog.install()
     server = build_server(cfg)
+    shard = getattr(server.round_fn, "cohort_shard", 1) or 1
+    if shard > 1 or getattr(server, "zero_server", False):
+        chunk = getattr(server.round_fn, "client_chunk", None)
+        cohort = getattr(server.round_fn, "nr_sampled",
+                         server.nr_clients_per_round)
+        print(f"[mesh] clients axis = {shard} replicas; "
+              f"cohort {cohort} -> {cohort // shard} clients/replica"
+              + (f", streamed in chunks of {chunk // shard}" if chunk
+                 else "")
+              + ("; zero-server: optimizer state sharded "
+                 f"1/{shard} per replica"
+                 if getattr(server, "zero_server", False) else ""))
     if cfg.val_gate:
         from .resilience import ValidationGate
 

@@ -124,7 +124,8 @@ def group_sizes(nr: int, nr_groups: int):
     return [len(range(g, nr, nr_groups)) for g in range(nr_groups)]
 
 
-def cohort_masks(seed: int, gids, live, round_idx, template, groups=None):
+def cohort_masks(seed: int, gids, live, round_idx, template, groups=None,
+                 positions=None):
     """The CLIENT-side masks: a stacked pytree (leading cohort axis) where
     row a is what client ``gids[a]`` adds to its encoded message this
     round.  Rows of non-``live`` (shard padding) positions are zero, and
@@ -134,7 +135,14 @@ def cohort_masks(seed: int, gids, live, round_idx, template, groups=None):
     With ``groups`` (a per-position group id vector, group mode) the pair
     terms are additionally gated on SAME group membership: each group is
     its own masking session, pairwise cancellation spans only within-group
-    live pairs, and the per-group modular sums decode independently."""
+    live pairs, and the per-group modular sums decode independently.
+
+    ``positions`` restricts the computed rows to those cohort positions
+    (cohort-sharded rounds: each shard expands only ITS clients' masks
+    against the FULL ``gids``/``live``/``groups`` vectors, so the rows are
+    bit-identical to the corresponding rows of the full call — every mask
+    is a pure function of the ids involved, not of which device computes
+    it)."""
     m = gids.shape[0]
     leaves, treedef = jax.tree.flatten(template)
 
@@ -161,7 +169,9 @@ def cohort_masks(seed: int, gids, live, round_idx, template, groups=None):
         ]
         return jax.tree.unflatten(treedef, total)
 
-    return jax.vmap(one_client)(jnp.arange(m))
+    if positions is None:
+        positions = jnp.arange(m)
+    return jax.vmap(one_client)(positions)
 
 
 def unmask_total(seed: int, gids, live, survivors, round_idx, template):

@@ -1,0 +1,371 @@
+"""Cohort-sharded federated MapReduce (fl/sharding.py): sharded == local.
+
+The shard_map round (``make_fl_round(mesh=...)`` / ``make_fedbuff_round``)
+promises the DrJAX-style decomposition — per-shard client maps combined by
+``psum`` partial reductions — changes results exactly as much as the
+``client_chunk`` streaming accumulator does, and no more:
+
+- shard count 1 is BIT-IDENTICAL to the local program (psum over a
+  singleton axis is the identity; every random draw is cohort-global and
+  sliced, never re-keyed per shard);
+- W > 1 float paths agree with the local oracle to float-sum-reorder
+  tolerance (per-shard partials then one psum vs a single flat sum);
+- int32 fault statistics are order-exact partial sums — EXACTLY equal;
+- secagg's uint32 modular field sums are order-INDEPENDENT (mod-2³²
+  addition is associative+commutative), so masked sums, independently
+  computed plaintext field sums, and the fully decoded round must all be
+  BITWISE identical at every world size, with dropout faults and Shamir
+  recovery in the loop;
+- the ZeRO server step composes: FedOpt with ``zero_server=True`` matches
+  the replicated-optimizer server element-for-element (tests/test_zero.py
+  tolerance discipline).
+
+The 8-device virtual CPU mesh comes from conftest.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.data.split import ClientDatasets
+from ddl25spring_tpu.fl.engine import make_fl_round, make_local_sgd_update
+from ddl25spring_tpu.fl.fedbuff import init_history, make_fedbuff_round
+from ddl25spring_tpu.fl.task import Task
+from ddl25spring_tpu.parallel import make_mesh
+from ddl25spring_tpu.resilience.faults import FaultPlan
+from ddl25spring_tpu.secagg.protocol import SecAgg
+
+# same tiny logistic-regression geometry as tests/test_fl_chunked.py
+N, PER, D, K, BS = 12, 16, 8, 4, 8
+NR_SAMPLED = 8
+_rng = np.random.default_rng(42)
+X = _rng.normal(size=(N, PER, D)).astype(np.float32)
+Y = _rng.integers(0, K, size=(N, PER)).astype(np.int32)
+COUNTS = np.full((N,), PER, np.int32)
+COUNTS[0] = PER - 3
+COUNTS[5] = PER - 5
+
+P0 = {"w": jnp.zeros((D, K), jnp.float32),
+      "b": jnp.zeros((K,), jnp.float32)}
+KEY = jax.random.PRNGKey(3)
+
+
+def loss_fn(params, xb, yb, mask, key):
+    logits = xb @ params["w"] + params["b"]
+    ls = -jax.nn.log_softmax(logits)[jnp.arange(yb.shape[0]), yb]
+    return jnp.sum(ls * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+UPDATE = make_local_sgd_update(loss_fn, 0.05, BS, 1)
+
+
+def clients_mesh(w):
+    return make_mesh({"clients": w}, devices=jax.devices()[:w])
+
+
+def build(mesh=None, **kw):
+    return make_fl_round(UPDATE, X, Y, COUNTS, NR_SAMPLED,
+                         device_put_data=False, mesh=mesh, **kw)
+
+
+def run_rounds(rf, nr=3, p0=P0):
+    p = p0
+    for r in range(nr):
+        p = rf(p, KEY, r)
+    return p
+
+
+def max_err(a, b):
+    return max(float(jnp.max(jnp.abs(x - y)))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def trees_bitwise(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# --- engine: linear paths --------------------------------------------------
+
+@pytest.mark.parametrize("chunk", [0, 4], ids=["stacked", "chunk4"])
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_sharded_matches_local(world, chunk):
+    rf_local = build(client_chunk=chunk)
+    rf_shard = build(mesh=clients_mesh(world), client_chunk=chunk)
+    assert rf_shard.cohort_shard == world
+    assert rf_local.cohort_shard == 1
+    p_local = run_rounds(rf_local)
+    p_shard = run_rounds(rf_shard)
+    err = max_err(p_local, p_shard)
+    if world == 1:
+        # singleton psum is the identity: no tolerance, bit-identical
+        assert err == 0.0
+    else:
+        assert err < 1e-6
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_fault_stats_order_exact(world):
+    # int32 partial-sum stats must be EXACTLY the local round's stats
+    plan = FaultPlan(seed=7, drop=0.2, nan=0.1)
+    rf_local = build(fault_plan=plan, round_deadline_s=1.0)
+    rf_shard = build(mesh=clients_mesh(world), fault_plan=plan,
+                     round_deadline_s=1.0)
+    for r in range(2):
+        p_l, s_l = rf_local.raw(P0, KEY, r, *rf_local.data)
+        p_s, s_s = rf_shard.raw(P0, KEY, r, *rf_shard.data)
+        assert np.array_equal(np.asarray(s_l), np.asarray(s_s))
+        assert max_err(p_l, p_s) < 1e-6
+
+
+def test_weighted_mean_weights_respected():
+    # ragged counts drive the n_k weighting through the sharded
+    # reduce_weighted; a wrong normalization would show on round 1 already
+    rf = build(mesh=clients_mesh(4))
+    p1 = rf(P0, KEY, 0)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(p1))
+    assert max_err(p1, build()(P0, KEY, 0)) < 1e-6
+
+
+# --- secagg: bitwise field sums --------------------------------------------
+
+def secagg_round(mesh, groups=1, plan=None):
+    sa = SecAgg(N, NR_SAMPLED, counts=np.asarray(COUNTS), clip=4.0, seed=3,
+                nr_groups=groups)
+    kw = {}
+    if plan is not None:
+        kw = dict(fault_plan=plan, round_deadline_s=1.0)
+    return make_fl_round(UPDATE, X, Y, COUNTS, NR_SAMPLED, mesh=mesh,
+                         device_put_data=False, secagg=sa, **kw)
+
+
+@pytest.mark.parametrize("groups", [1, 3], ids=["flat", "grouped"])
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_secagg_field_sums_bitwise(world, groups):
+    # masked uint32 sums AND the independently computed plaintext field
+    # sums (the oracle pair) must be bitwise identical under sharding —
+    # with seeded dropout faults exercising Shamir mask recovery
+    plan = FaultPlan(seed=7, drop=0.2)
+    rf_local = secagg_round(None, groups, plan)
+    rf_shard = secagg_round(clients_mesh(world), groups, plan)
+    assert rf_shard.cohort_shard == world
+    # the fused Pallas kernel cannot run per-shard; the sharded path must
+    # have resolved to the XLA mask graph
+    assert not rf_shard.secagg_fused
+    f_l, p_l, s_l = rf_local.secagg_oracle(P0, KEY, 1)
+    f_s, p_s, s_s = rf_shard.secagg_oracle(P0, KEY, 1)
+    assert trees_bitwise(f_l, f_s), "masked field sums diverged"
+    assert trees_bitwise(p_l, p_s), "plaintext field sums diverged"
+    assert np.array_equal(np.asarray(s_l), np.asarray(s_s))
+
+
+@pytest.mark.parametrize("world", [1, 4])
+def test_secagg_full_round_bitwise(world):
+    # decode + fixed-point floor + apply: everything downstream of the
+    # modular sum is a pure function of it, so whole rounds stay bitwise
+    plan = FaultPlan(seed=7, drop=0.2)
+    p_local = secagg_round(None, plan=plan)(P0, KEY, 0)
+    p_shard = secagg_round(clients_mesh(world), plan=plan)(P0, KEY, 0)
+    assert max_err(p_local, p_shard) == 0.0
+
+
+def test_secagg_collusive_attack_falls_back():
+    # collusive attacks need cross-attacker statistics over the whole
+    # cohort; the sharded path must refuse, not silently mis-shard
+    from ddl25spring_tpu.robust.attacks import make_alie_attack
+
+    mal = np.zeros(N, bool)
+    mal[:3] = True
+    rf = build(mesh=clients_mesh(4), attack=make_alie_attack(),
+               malicious_mask=mal)
+    assert rf.cohort_shard == 1
+
+
+# --- fedbuff ---------------------------------------------------------------
+
+def fedbuff_tick(mesh, **kw):
+    return make_fedbuff_round(UPDATE, X, Y, COUNTS, NR_SAMPLED,
+                              staleness_window=3,
+                              fault_plan=FaultPlan(seed=7, drop=0.2),
+                              round_deadline_s=1.0, mesh=mesh, **kw)
+
+
+@pytest.mark.parametrize("chunk", [0, 4], ids=["plain", "chunk4"])
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_fedbuff_sharded_matches_local(world, chunk):
+    tk_local = fedbuff_tick(None, client_chunk=chunk)
+    tk_shard = fedbuff_tick(clients_mesh(world), client_chunk=chunk)
+    assert tk_shard.cohort_shard == world
+    h_l = init_history(P0, 3)
+    h_s = init_history(P0, 3)
+    for r in range(3):
+        h_l = tk_local(h_l, KEY, r)
+        h_s = tk_shard(h_s, KEY, r)
+    err = max_err(h_l, h_s)
+    if world == 1:
+        assert err == 0.0
+    else:
+        assert err < 1e-6
+
+
+def test_fedbuff_secagg_falls_back():
+    # the sharded fedbuff tick is plaintext-only: a secagg session forces
+    # the local path rather than a wrong program
+    sa = SecAgg(N, NR_SAMPLED, counts=np.asarray(COUNTS), clip=4.0, seed=3)
+    tk = make_fedbuff_round(UPDATE, X, Y, COUNTS, NR_SAMPLED,
+                            staleness_window=3, secagg=sa,
+                            mesh=clients_mesh(4))
+    assert tk.cohort_shard == 1
+
+
+# --- server matrix: sharded vs local oracle --------------------------------
+
+def _tiny_task():
+    return Task(
+        init=lambda key: {"w": jnp.zeros((D, K), jnp.float32),
+                          "b": jnp.zeros((K,), jnp.float32)},
+        loss_fn=loss_fn,
+        score_fn=lambda params, x: x @ params["w"] + params["b"],
+        test_x=X[0], test_y=Y[0],
+    )
+
+
+CD = ClientDatasets(x=X, y=Y, counts=COUNTS)
+FRACTION = NR_SAMPLED / N
+
+
+def _fedsgd_grad(mesh):
+    from ddl25spring_tpu.fl.servers import FedSgdGradientServer
+
+    return FedSgdGradientServer(
+        _tiny_task(), lr=0.05, client_data=CD, client_fraction=FRACTION,
+        seed=0, mesh=mesh)
+
+
+def _fedsgd_weight(mesh):
+    from ddl25spring_tpu.fl.servers import FedSgdWeightServer
+
+    return FedSgdWeightServer(
+        _tiny_task(), lr=0.05, client_data=CD, client_fraction=FRACTION,
+        seed=0, mesh=mesh)
+
+
+def _fedavg(mesh):
+    from ddl25spring_tpu.fl.servers import FedAvgServer
+
+    return FedAvgServer(
+        _tiny_task(), lr=0.05, batch_size=BS, client_data=CD,
+        client_fraction=FRACTION, nr_local_epochs=2, seed=0, mesh=mesh)
+
+
+def _fedopt(mesh):
+    from ddl25spring_tpu.fl.servers import FedOptServer
+
+    return FedOptServer(
+        _tiny_task(), lr=0.05, batch_size=BS, client_data=CD,
+        client_fraction=FRACTION, nr_local_epochs=1, seed=0,
+        server_optimizer="adam", server_lr=0.01, mesh=mesh)
+
+
+def _fedbuff(mesh):
+    from ddl25spring_tpu.fl.fedbuff import FedBuffServer
+
+    return FedBuffServer(
+        _tiny_task(), lr=0.05, batch_size=BS, client_data=CD,
+        client_fraction=FRACTION, nr_local_epochs=1, seed=0,
+        staleness_window=2, mesh=mesh)
+
+
+@pytest.mark.parametrize("build_server", [
+    _fedsgd_grad, _fedsgd_weight, _fedavg, _fedopt, _fedbuff,
+], ids=["fedsgd_grad", "fedsgd_weight", "fedavg", "fedopt", "fedbuff"])
+@pytest.mark.parametrize("world", [1, 4])
+def test_server_sharded_matches_local(build_server, world):
+    local, shard = build_server(None), build_server(clients_mesh(world))
+    p_l, p_s = local.params, shard.params
+    for r in range(2):
+        p_l = local.round_fn(p_l, local.run_key, r)
+        p_s = shard.round_fn(p_s, shard.run_key, r)
+    err = max_err(p_l, p_s)
+    if world == 1:
+        assert err == 0.0
+    else:
+        assert err < 1e-6
+    assert abs(local.test() - shard.test()) < 1e-6
+
+
+def test_fedopt_zero_server_matches_replicated():
+    # the ZeRO server step composed with the sharded round: parameters
+    # must track the replicated-optimizer FedOpt element for element
+    replicated = _fedopt(None)
+    mesh = clients_mesh(4)
+    from ddl25spring_tpu.fl.servers import FedOptServer
+
+    zero = FedOptServer(
+        _tiny_task(), lr=0.05, batch_size=BS, client_data=CD,
+        client_fraction=FRACTION, nr_local_epochs=1, seed=0,
+        server_optimizer="adam", server_lr=0.01, mesh=mesh,
+        zero_server=True)
+    assert zero.zero_server
+    p_r, p_z = replicated.params, zero.params
+    for r in range(3):
+        p_r = replicated.round_fn(p_r, replicated.run_key, r)
+        p_z = zero.round_fn(p_z, zero.run_key, r)
+    assert max_err(p_r, p_z) < 1e-6
+    # the sharded optimizer state round-trips through extra_state (the
+    # checkpoint template path)
+    state = zero.extra_state()
+    zero.restore_extra_state(state)
+    # moments live sharded: array leaves carry the leading (W, ...) axis
+    moment_leaves = [l for l in jax.tree.leaves(state["server_opt_state"])
+                     if hasattr(l, "ndim") and l.ndim]
+    assert moment_leaves and all(l.shape[0] == 4 for l in moment_leaves)
+
+
+def test_fedopt_zero_server_requires_mesh():
+    from ddl25spring_tpu.fl.servers import FedOptServer
+
+    with pytest.raises(ValueError, match="mesh"):
+        FedOptServer(
+            _tiny_task(), lr=0.05, batch_size=BS, client_data=CD,
+            client_fraction=FRACTION, nr_local_epochs=1, seed=0,
+            zero_server=True)
+
+
+# --- config / CLI plumbing -------------------------------------------------
+
+def test_mesh_clients_config_validation():
+    from ddl25spring_tpu.configs import HflConfig
+
+    HflConfig(mesh_clients="auto")
+    HflConfig(mesh_clients="0")
+    HflConfig(mesh_clients="4")
+    with pytest.raises(ValueError, match="mesh_clients"):
+        HflConfig(mesh_clients="lots")
+    with pytest.raises(ValueError, match="mesh_clients"):
+        HflConfig(mesh_clients="-2")
+    with pytest.raises(ValueError, match="fedopt"):
+        HflConfig(zero_server=True)  # default algorithm is fedavg
+    with pytest.raises(ValueError, match="mesh"):
+        HflConfig(algorithm="fedopt", zero_server=True, mesh_clients="0")
+    HflConfig(algorithm="fedopt", zero_server=True)
+
+
+def test_build_clients_mesh_resolution():
+    from ddl25spring_tpu.run_hfl import build_clients_mesh
+
+    # explicit N wins regardless of cohort size
+    mesh = build_clients_mesh("4", clients_per_round=2)
+    assert mesh.shape["clients"] == 4
+    # "0" is off
+    assert build_clients_mesh("0", clients_per_round=64) is None
+    # auto: all devices when the cohort is at least that large...
+    mesh = build_clients_mesh("auto", clients_per_round=64)
+    assert mesh.shape["clients"] == len(jax.devices())
+    # ...and off below it (the historical heuristic)
+    assert build_clients_mesh("auto", clients_per_round=2) is None
+    # asking for more devices than exist fails loudly, not silently
+    with pytest.raises(ValueError, match="device"):
+        build_clients_mesh("9999", clients_per_round=9999)

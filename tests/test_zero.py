@@ -17,6 +17,7 @@ from ddl25spring_tpu.parallel import (
     make_dp_train_step,
     make_mesh,
     make_zero_dp_train_step,
+    make_zero_server_step,
 )
 
 
@@ -82,6 +83,69 @@ def test_zero_rejects_non_elementwise_optimizer(problem):
     opt = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
     with pytest.raises(ValueError, match="elementwise"):
         make_zero_dp_train_step(loss_fn, opt, mesh, params)
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "avgm", "adam", "yogi"])
+@pytest.mark.parametrize("world", [1, 2, 8])
+def test_zero_server_step_matches_replicated(world, opt_name):
+    """The federated variant (FedOpt's pseudo-gradient update on a 1/W
+    parameter slice per replica) must track the replicated server
+    optimizer element for element across steps — same oracle discipline
+    as the DP test above, over the FedOptServer optimizer family."""
+    opt = {"sgd": lambda: optax.sgd(0.5),
+           "avgm": lambda: optax.sgd(0.5, momentum=0.9),
+           "adam": lambda: optax.adam(1e-2, eps=1e-3),
+           "yogi": lambda: optax.yogi(1e-2, eps=1e-3)}[opt_name]()
+    mesh = make_mesh({"clients": world},
+                     devices=jax.devices()[:world])
+    key = jax.random.key(7)
+    params = {"w": jax.random.normal(key, (7, 5)),
+              "b": jnp.zeros((5,))}
+    step, z_state = make_zero_server_step(opt, mesh, params,
+                                          axis="clients")
+    r_state = opt.init(params)
+
+    @jax.jit
+    def replicated(params, opt_state, w_avg):
+        delta = jax.tree.map(jnp.subtract, params, w_avg)
+        updates, opt_state = opt.update(delta, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    p_z = p_r = params
+    for t in range(4):
+        w_avg = jax.tree.map(
+            lambda p: p + 0.1 * jax.random.normal(
+                jax.random.fold_in(key, t), p.shape),
+            p_r,
+        )
+        p_z, z_state = step(p_z, z_state, w_avg)
+        p_r, r_state = replicated(p_r, r_state, w_avg)
+    for a, b in zip(jax.tree.leaves(p_z), jax.tree.leaves(p_r)):
+        assert jnp.allclose(a, b, atol=1e-6), "server params diverged"
+
+
+def test_zero_server_state_is_sharded():
+    mesh = make_mesh({"clients": 4}, devices=jax.devices()[:4])
+    params = {"w": jnp.zeros((7, 5)), "b": jnp.zeros((5,))}
+    _, state = make_zero_server_step(optax.adam(1e-2), mesh, params,
+                                     axis="clients")
+    total = sum(p.size for p in jax.tree.leaves(params))
+    chunk = -(-total // 4)
+    arrays = [l for l in jax.tree.leaves(state)
+              if hasattr(l, "ndim") and l.ndim > 0]
+    assert arrays, "expected sharded moment arrays"
+    for leaf in arrays:
+        assert leaf.shape == (4, chunk)
+        spec = leaf.sharding.spec
+        assert spec and spec[0] == "clients"
+
+
+def test_zero_server_rejects_non_elementwise_optimizer():
+    mesh = make_mesh({"clients": 4}, devices=jax.devices()[:4])
+    params = {"w": jnp.zeros((7, 5))}
+    opt = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-2))
+    with pytest.raises(ValueError, match="elementwise"):
+        make_zero_server_step(opt, mesh, params, axis="clients")
 
 
 def test_zero_trains(problem):

@@ -38,6 +38,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# --cohort-shard compiles shard_map programs over several virtual CPU
+# devices; the flag must land in XLA_FLAGS BEFORE the backend initialises
+if "--cohort-shard" in sys.argv:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
 import numpy as np
 
 import jax
@@ -47,7 +55,8 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 
 
-def _tiny_mlp_round(nr_clients: int, nr_sampled: int, chunk: int):
+def _tiny_mlp_round(nr_clients: int, nr_sampled: int, chunk: int,
+                    mesh=None):
     """A deliberately small FL round (logistic regression, synthetic data)
     whose compile time is seconds — enough to show the stack-vs-chunk
     scaling because the update-stack bytes dominate the tiny params."""
@@ -67,7 +76,7 @@ def _tiny_mlp_round(nr_clients: int, nr_sampled: int, chunk: int):
     update = make_local_sgd_update(loss_fn, 0.05, bs, 1)
     rf = make_fl_round(update, x, y, counts, nr_sampled=nr_sampled,
                        device_put_data=False, client_chunk=chunk,
-                       donate=True)
+                       donate=mesh is None, mesh=mesh)
     params = {"w": jax.ShapeDtypeStruct((d, k), jnp.float32),
               "b": jax.ShapeDtypeStruct((k,), jnp.float32)}
     return rf, params
@@ -197,6 +206,99 @@ def dist_pass_estimate(cohorts, d: int, device=None) -> tuple:
     return rows, winners_identical
 
 
+def cohort_shard_estimate(nr_clients: int, nr_sampled: int, chunk: int,
+                          worlds) -> dict:
+    """AOT memory of the cohort-SHARDED round (fl/sharding.py) across
+    shard counts: the same tiny-MLP round compiled stacked, chunked, and
+    sharded×chunked at each world size W, reading XLA's per-device
+    ``memory_analysis()`` next to the analytic per-replica update-stack
+    bytes — plus the ZeRO server-optimizer footprint from a REAL sharded
+    state (parallel.make_zero_server_step), not a formula.
+
+    Asserts the two ~W× claims docs/PERFORMANCE.md makes at W=4: the
+    analytic per-replica stack is exactly stacked/W, and the sharded Adam
+    moment bytes drop ~W× vs the replicated optimizer (exact up to the
+    flatten-pad to a multiple of W)."""
+    import optax
+
+    from ddl25spring_tpu.fl.engine import _tree_bytes
+    from ddl25spring_tpu.parallel import make_mesh
+    from ddl25spring_tpu.parallel.zero import make_zero_server_step
+
+    nr_devices = len(jax.devices())
+    worlds = [w for w in worlds if w <= nr_devices]
+
+    def cell(label, ch, mesh=None, world=1):
+        r = estimate(
+            lambda c: _tiny_mlp_round(nr_clients, nr_sampled, c, mesh=mesh),
+            ch,
+        )
+        rows = r["client_chunk_effective"] or nr_sampled
+        r["mode"] = label
+        r["world"] = world
+        # per-replica stack rows: the chunk scan streams chunk//W rows per
+        # shard; the stacked sharded path holds nr_shard//W
+        r["update_stack_bytes_per_replica"] = (
+            r["update_stack_bytes"] // world
+        )
+        del rows
+        return r
+
+    cells = [cell("stacked", 0), cell("chunked", chunk)]
+    for w in worlds:
+        mesh = make_mesh({"clients": w}, devices=jax.devices()[:w])
+        cells.append(cell("sharded+chunked", chunk, mesh=mesh, world=w))
+
+    # ZeRO server-optimizer footprint measured off the real sharded state
+    d, k = 64, 10
+    params = {"w": jnp.zeros((d, k), jnp.float32),
+              "b": jnp.zeros((k,), jnp.float32)}
+    opt = optax.adam(1e-2, eps=1e-3)
+    replicated = sum(
+        l.size * l.dtype.itemsize
+        for l in jax.tree.leaves(opt.init(params))
+        if hasattr(l, "size") and l.ndim
+    )
+    zero_rows = []
+    for w in worlds:
+        mesh = make_mesh({"clients": w}, devices=jax.devices()[:w])
+        _, state = make_zero_server_step(opt, mesh, params, axis="clients")
+        per_replica = sum(
+            (l.size // w) * l.dtype.itemsize
+            for l in jax.tree.leaves(state)
+            if hasattr(l, "size") and l.ndim
+        )
+        zero_rows.append({"world": w,
+                          "opt_state_bytes_replicated": replicated,
+                          "opt_state_bytes_per_replica": per_replica})
+
+    if 4 in worlds:
+        stacked = next(c for c in cells if c["mode"] == "stacked")
+        s4 = next(c for c in cells
+                  if c["mode"] == "sharded+chunked" and c["world"] == 4)
+        c1 = next(c for c in cells if c["mode"] == "chunked")
+        assert (s4["update_stack_bytes_per_replica"] * 4
+                == c1["update_stack_bytes"]), (
+            "sharded+chunked per-replica stack at W=4 is not chunked/4: "
+            f"{s4['update_stack_bytes_per_replica']:,} * 4 != "
+            f"{c1['update_stack_bytes']:,}"
+        )
+        assert (stacked["update_stack_bytes"]
+                >= 4 * s4["update_stack_bytes_per_replica"]), (
+            "stacked cohort stack does not dominate the W=4 per-replica "
+            "slice by 4x"
+        )
+        z4 = next(z for z in zero_rows if z["world"] == 4)
+        ratio = z4["opt_state_bytes_replicated"] / max(
+            1, z4["opt_state_bytes_per_replica"]
+        )
+        assert 3.0 <= ratio <= 5.0, (
+            f"zero-server moment bytes at W=4 dropped {ratio:.2f}x, "
+            "expected ~4x (flatten-pad slack only)"
+        )
+    return {"cells": cells, "zero_server": zero_rows}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--target", default="cpu",
@@ -218,6 +320,16 @@ def main(argv=None) -> int:
                          "column, krum decision-identity check")
     ap.add_argument("--cohorts", default="32,64,128,256",
                     help="comma-separated cohort sizes for --dist-pass")
+    ap.add_argument("--cohort-shard", action="store_true",
+                    help="estimate the cohort-SHARDED round instead: "
+                         "stacked vs chunked vs sharded×chunked AOT bytes "
+                         "across --worlds (virtual CPU devices), plus the "
+                         "ZeRO server-optimizer per-replica footprint; "
+                         "asserts the ~Wx drops at W=4")
+    ap.add_argument("--worlds", default="1,2,4",
+                    help="comma-separated shard counts for --cohort-shard")
+    ap.add_argument("--chunk", type=int, default=4,
+                    help="client_chunk for --cohort-shard's chunked cells")
     ap.add_argument("--dim", type=int, default=4096,
                     help="flattened update length for --dist-pass (the "
                          "naive column compiles an m²·dim·4-byte temp — "
@@ -229,6 +341,27 @@ def main(argv=None) -> int:
         from jax.experimental import topologies
 
         device = topologies.get_topology_desc(args.target, "tpu").devices[0]
+
+    if args.cohort_shard:
+        worlds = [int(w) for w in args.worlds.split(",") if w.strip()]
+        out = cohort_shard_estimate(args.clients, args.sampled, args.chunk,
+                                    worlds)
+        for c in out["cells"]:
+            print(f"  {c['mode']:>15} W={c['world']}: "
+                  f"stack {c['update_stack_bytes']:>10,} B   "
+                  f"per-replica {c['update_stack_bytes_per_replica']:>10,} B"
+                  f"   temp {c['temp_bytes']:>12,} B", file=sys.stderr)
+        for z in out["zero_server"]:
+            print(f"  zero-server W={z['world']}: replicated "
+                  f"{z['opt_state_bytes_replicated']:>8,} B -> per-replica "
+                  f"{z['opt_state_bytes_per_replica']:>8,} B",
+                  file=sys.stderr)
+        print(json.dumps({
+            "metric": "cohort_shard_memory_estimate",
+            "target": args.target,
+            **out,
+        }))
+        return 0
 
     if args.dist_pass:
         cohorts = [int(c) for c in args.cohorts.split(",") if c.strip()]

@@ -17,7 +17,12 @@ Verified per worker, printed as one MULTIHOST-OK line each:
     form computed from the deterministic global batch (every element is its
     own global index), which no single process holds;
   - SPMD consistency: the updated replicated param is bit-identical on
-    both workers (printed digest compared by the parent).
+    both workers (printed digest compared by the parent);
+  - cohort-sharded FL round: ``make_fl_round`` over a ``clients`` axis
+    spanning all 8 global devices — the per-shard partial reductions are
+    combined by a cross-process psum — matches each worker's own local
+    (mesh=None) round to 1e-6 and yields the identical model on both
+    workers (second digest compared by the parent).
 
 Run:  python tools/multihost_dryrun.py        # exits 0 iff both workers OK
 """
@@ -42,6 +47,9 @@ def worker(port: str, pid: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # the default CPU client refuses cross-process computations; gloo is
+    # the collectives transport jaxlib ships for exactly this harness
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -82,11 +90,14 @@ def worker(port: str, pid: int) -> None:
     )
     def global_grad(w, x_local):
         # d/dw sum(w * x) = sum(x): once via an EXPLICIT psum over both
-        # axes (crosses the process boundary), once via autodiff — w is
-        # replicated (unvarying), so shard_map's VJP inserts the same
-        # psum itself to keep the replication invariant; both must agree
+        # axes (crosses the process boundary), once via autodiff — with
+        # check_vma/check_rep off, shard_map's VJP does NOT reinsert the
+        # reduction for the unvarying w, so the DP recipe psums the
+        # per-shard grad itself (exactly what parallel/dp.py does)
         g_explicit = jax.lax.psum(jnp.sum(x_local), ("dcn", "data"))
-        g_autodiff = jax.grad(lambda w: jnp.sum(w * x_local))(w)
+        g_autodiff = jax.lax.psum(
+            jax.grad(lambda w: jnp.sum(w * x_local))(w), ("dcn", "data")
+        )
         return g_explicit, g_autodiff
 
     g, g_ad = jax.jit(global_grad)(w, x)
@@ -99,6 +110,54 @@ def worker(port: str, pid: int) -> None:
     digest = float(jnp.asarray(w_new.addressable_data(0)))
     print(f"MULTIHOST-OK pid={pid} psum={got:.1f} w'={digest!r}",
           flush=True)
+
+    # --- cohort-sharded FL round across the process boundary ------------
+    # Put the clients axis over ALL EIGHT global devices, so the sharded
+    # round's per-shard partial reductions are combined by a psum that
+    # crosses processes — then demand the result match the purely LOCAL
+    # (mesh=None) round each worker can compute on its own.
+    import numpy as np
+
+    from ddl25spring_tpu.fl.engine import (
+        make_fl_round,
+        make_local_sgd_update,
+    )
+    from ddl25spring_tpu.parallel.mesh import make_mesh
+
+    n_cl, per, d, k, bs = 8, 4, 4, 2, 4
+    rng = np.random.default_rng(11)  # identical data on both workers
+    fx = rng.normal(size=(n_cl, per, d)).astype(np.float32)
+    fy = rng.integers(0, k, size=(n_cl, per)).astype(np.int32)
+    fcounts = np.full((n_cl,), per, np.int32)
+    p0 = {"w": jnp.zeros((d, k), jnp.float32),
+          "b": jnp.zeros((k,), jnp.float32)}
+
+    def loss_fn(params, xb, yb, mask, key):
+        logits = xb @ params["w"] + params["b"]
+        ls = -jax.nn.log_softmax(logits)[jnp.arange(yb.shape[0]), yb]
+        return jnp.sum(ls * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+    update = make_local_sgd_update(loss_fn, 0.05, bs, 1)
+    cmesh = make_mesh({"clients": 8}, devices=jax.devices())
+    rf = make_fl_round(update, fx, fy, fcounts, n_cl,
+                       mesh=cmesh, device_put_data=False)
+    assert rf.cohort_shard == 8, rf.cohort_shard
+    rf_local = make_fl_round(update, fx, fy, fcounts, n_cl,
+                             device_put_data=False)
+    fl_key = jax.random.PRNGKey(5)
+    p_shard = rf(p0, fl_key, 0)
+    p_ref = rf_local(p0, fl_key, 0)
+    host = jax.tree.map(lambda a: np.asarray(a.addressable_data(0))
+                        if hasattr(a, "addressable_data")
+                        else np.asarray(a), p_shard)
+    err = max(float(np.max(np.abs(a - np.asarray(b))))
+              for a, b in zip(jax.tree.leaves(host),
+                              jax.tree.leaves(p_ref)))
+    assert np.isfinite(err) and err < 1e-6, err
+    # abs: a plain sum of softmax-loss steps cancels to 0 across classes
+    fl_digest = float(sum(np.sum(np.abs(a)) for a in jax.tree.leaves(host)))
+    print(f"MULTIHOST-FL-OK pid={pid} shard=8 err={err:.1e} "
+          f"digest={fl_digest!r}", flush=True)
 
 
 def main() -> int:
@@ -126,22 +185,32 @@ def main() -> int:
             print("TIMEOUT waiting for workers")
             return 1
         outs.append(out)
-    ok_lines = []
+    ok_lines, fl_lines = [], []
     for pid, (p, out) in enumerate(zip(procs, outs)):
         ok = [ln for ln in out.splitlines() if ln.startswith("MULTIHOST-OK")]
-        if p.returncode != 0 or not ok:
+        fl = [ln for ln in out.splitlines()
+              if ln.startswith("MULTIHOST-FL-OK")]
+        if p.returncode != 0 or not ok or not fl:
             print(f"worker {pid} FAILED (rc={p.returncode}):\n{out}")
             return 1
         ok_lines.append(ok[0])
+        fl_lines.append(fl[0])
         print(ok_lines[-1])
+        print(fl_lines[-1])
     # SPMD consistency: both replicas stepped to the identical param
     w0 = ok_lines[0].split("w'=")[1]
     w1 = ok_lines[1].split("w'=")[1]
     if w0 != w1:
         print(f"param divergence across processes: {w0} vs {w1}")
         return 1
-    print("multihost dryrun: rendezvous + cross-process psum + SPMD "
-          "consistency verified (2 processes x 4 devices)")
+    # ... and the cohort-sharded FL round reduced to the identical model
+    f0 = fl_lines[0].split("digest=")[1]
+    f1 = fl_lines[1].split("digest=")[1]
+    if f0 != f1:
+        print(f"FL round divergence across processes: {f0} vs {f1}")
+        return 1
+    print("multihost dryrun: rendezvous + cross-process psum + sharded "
+          "FL round + SPMD consistency verified (2 processes x 4 devices)")
     return 0
 
 

@@ -421,11 +421,17 @@ def report(events: list[dict], top: int) -> None:
     fl_bytes = _value(counters, "fl_bytes_aggregated_total")
     fl_cpr = _value(gauges, "fl_clients_per_round")
     fl_dist = _value(gauges, "fl_aggregator_dist_bytes")
+    fl_shard = _value(gauges, "fl_cohort_shard_size")
+    fl_stack_pr = _value(gauges, "fl_update_stack_bytes_per_replica")
+    fl_zero_w = _value(gauges, "fl_zero_server_world")
+    fl_opt_pr = _value(gauges, "fl_server_opt_bytes_per_replica")
     for n in ("fl_rounds_total", "fl_clients_sampled_total",
               "fl_bytes_aggregated_total"):
         take(counters, n)
-    take(gauges, "fl_clients_per_round")
-    take(gauges, "fl_aggregator_dist_bytes")
+    for n in ("fl_clients_per_round", "fl_aggregator_dist_bytes",
+              "fl_cohort_shard_size", "fl_update_stack_bytes_per_replica",
+              "fl_zero_server_world", "fl_server_opt_bytes_per_replica"):
+        take(gauges, n)
     if fl_rounds is not None:
         section("federated learning")
         print(f"  rounds: {fl_rounds}   clients sampled: {fl_clients}"
@@ -436,6 +442,18 @@ def report(events: list[dict], top: int) -> None:
         if fl_dist is not None:
             print(f"  robust-rule distance pass (HBM traffic/round): "
                   f"{fmt_bytes(fl_dist)}")
+        if fl_shard is not None:
+            line = f"  cohort sharding: {fl_shard:.0f} clients/replica"
+            if fl_stack_pr is not None:
+                line += (f"   update stack/replica: "
+                         f"{fmt_bytes(fl_stack_pr)}")
+            print(line)
+        if fl_zero_w is not None:
+            line = f"  zero server: W={fl_zero_w:.0f}"
+            if fl_opt_pr is not None:
+                line += (f"   optimizer state/replica: "
+                         f"{fmt_bytes(fl_opt_pr)}")
+            print(line)
 
     # -- collectives -----------------------------------------------------
     coll_calls = take(counters, "collective_calls_total")
