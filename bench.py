@@ -620,6 +620,8 @@ def run_cpu_trend(nr_rounds: int = 2):
     krum_ms = (time.perf_counter() - t0) * 1e3
     _stamp("cpu trend: cohort scaling cell ...")
     cohort_scaling = _cohort_scaling_cell()
+    _stamp("cpu trend: serving saturation cell ...")
+    serving_saturation = _serving_saturation_cell()
     print(json.dumps({
         "metric": CPU_TREND_METRIC,
         "value": round(nr_rounds / dt, 4),
@@ -630,6 +632,7 @@ def run_cpu_trend(nr_rounds: int = 2):
         "kernels": kernels,
         "krum_agg": {"shape": [16, 1 << 16], "ms": round(krum_ms, 3)},
         "cohort_scaling": cohort_scaling,
+        "serving_saturation": serving_saturation,
         "wall_s": round(time.perf_counter() - t_start, 1),
     }))
     sys.stdout.flush()
@@ -679,6 +682,62 @@ def _cohort_scaling_cell(cohorts=(64, 256, 1024), rounds_timed: int = 3):
         dt = time.perf_counter() - t0
         out["rounds_per_sec"][str(cohort)] = round(rounds_timed / dt, 4)
     return out
+
+
+def _serving_saturation_cell(qps_factors=(0.5, 1.0, 2.0),
+                             nr_requests: int = 8):
+    """Goodput/queue-wait of the PAGED streaming batcher under a seeded
+    heavy-tailed arrival trace at three offered rates straddling a
+    measured peak-goodput probe (models/loadgen.py).  The trend that
+    moves when the paged KV pool, admission path, or streaming scheduler
+    regresses — comparable only to itself like the other cpu_trend
+    cells."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu.models import loadgen
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+
+    cfg = LlamaConfig(vocab_size=128, dmodel=48, nr_heads=4,
+                      nr_kv_heads=2, nr_layers=2, ctx_size=48,
+                      dtype=jnp.float32)
+    params = Llama(cfg).init(jax.random.PRNGKey(0),
+                             jnp.ones((1, 4), jnp.int32))
+    budget = 6
+
+    def make_batcher():
+        return ContinuousBatcher(cfg, params, max_batch=2,
+                                 prefill_width=8, kv_layout="paged",
+                                 kv_page=8)
+
+    def prompt_fn(i, prng):
+        return prng.integers(1, 128,
+                             size=int(prng.integers(3, 8))).tolist()
+
+    prng = np.random.default_rng(0)
+    prompts = [prompt_fn(i, prng) for i in range(nr_requests)]
+    loadgen.warm(make_batcher, prompts, [budget] * nr_requests)
+    probe = loadgen.replay(
+        make_batcher(),
+        loadgen.arrival_trace(nr_requests, 1e4, "lognormal", 0),
+        prompts, [budget] * nr_requests)
+    peak = max(probe["goodput_rps"], 1e-3)
+    sweep = loadgen.saturation_sweep(
+        make_batcher, [peak * f for f in qps_factors], nr_requests,
+        prompt_fn, budget, dist="lognormal", seed=0, warmup=False)
+    return {
+        "probe_goodput_rps": round(peak, 3),
+        "knee_qps": (round(sweep["knee_qps"], 3)
+                     if sweep["knee_qps"] else None),
+        "points": [{
+            "offered_qps": round(p["offered_qps"], 3),
+            "goodput_rps": round(p["goodput_rps"], 3),
+            "queue_wait_p99_s": round(p["queue_wait_p99_s"], 4),
+            "kv_pages_peak": p["kv_pages_peak"],
+        } for p in sweep["points"]],
+    }
 
 
 def _cpu_fallback_trend(timeout_s: float) -> dict:

@@ -1,0 +1,194 @@
+"""Host-side paged KV-cache accounting: page allocator + prefix registry.
+
+The serving cache (models/serving.py) historically gave every slot a full
+``ctx_size`` contiguous KV row — resident KV bytes were
+``max_batch * ctx_size`` regardless of how many tokens were actually live.
+The paged layout carves one physical pool of ``nr_pages`` fixed-size blocks
+(``kv_page`` tokens each) and gives each slot an int32 BLOCK TABLE mapping
+its logical pages to physical ones; resident KV then tracks live tokens
+(``pages_in_use * kv_page``), and a pool provisioned for expected
+concurrency is several times smaller than the worst-case contiguous cache
+(tools/mem_estimate.py ``--kv-pages`` verifies the drop AOT).
+
+Everything here is HOST state (plain Python ints and lists): the device
+only ever sees the pool tree and the per-dispatch block-table array, both
+static-shaped.  The allocator is deliberately boring — a LIFO free list
+with per-page refcounts — because the scheduler calls it inside its
+dispatch loop and determinism matters more than allocation policy (same
+admission order => same tables => same compiled-program inputs).
+
+Page 0 is RESERVED as the null/dump page: freed slots' table rows are
+zeroed, so their still-decoding lanes write garbage into page 0 instead of
+into pages that may have been reallocated to live requests (the read side
+masks page-0 content out — models/llama.py ``_decode_attention``).
+
+``PrefixRegistry`` keys precomputed shared-prefix pages by the hash of the
+prefix token ids: requests sharing a system prompt map their block-table
+heads onto the same read-only pages (one extra refcount each) and skip
+that prefill work entirely (``serving_prefix_hits_total``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class KVPagePool:
+    """Refcounted free-list allocator over ``nr_pages`` physical pages.
+
+    Page 0 is reserved (never handed out) — the null/dump page freed
+    lanes' writes are parked on.  ``alloc`` returns ``None`` when the
+    request cannot be satisfied (callers queue, they don't partially
+    allocate); ``free`` raises on double-free or on page 0, because a
+    bookkeeping bug here silently corrupts live requests' KV."""
+
+    __slots__ = ("nr_pages", "pages_peak", "_rc", "_free")
+
+    def __init__(self, nr_pages: int):
+        if nr_pages < 2:
+            raise ValueError(
+                f"nr_pages must be >= 2 (page 0 is reserved), got {nr_pages}"
+            )
+        self.nr_pages = nr_pages
+        # high-water mark of pages_in_use — callers that only observe the
+        # pool between scheduler steps (loadgen) miss allocations freed
+        # within one step, so the pool records its own peak
+        self.pages_peak = 0
+        self._rc = [0] * nr_pages
+        # pop() hands out pages in ascending order from a fresh pool;
+        # freed pages are reused LIFO — deterministic either way, which is
+        # what the bit-identity contract needs
+        self._free = list(range(nr_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        """Allocated pages (page 0 excluded) — ``* kv_page`` = live KV
+        tokens resident in the pool."""
+        return self.nr_pages - 1 - len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Take ``n`` pages (refcount 1 each); ``None`` if fewer are free
+        (all-or-nothing: a partial grant would deadlock the scheduler's
+        head-of-line admission)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._rc[p] = 1
+        self.pages_peak = max(self.pages_peak, self.pages_in_use)
+        return pages
+
+    def share(self, pages) -> None:
+        """Add one reference to each page (shared prefix heads: the
+        registry holds the base reference, every admitted slot adds one)."""
+        for p in pages:
+            if p <= 0 or self._rc[p] <= 0:
+                raise ValueError(f"share of unallocated page {p}")
+        for p in pages:
+            self._rc[p] += 1
+
+    def free(self, pages) -> None:
+        """Drop one reference per page; pages hitting zero return to the
+        free list.  Raises on page 0 or an already-free page — double
+        frees are how one request's KV ends up inside another's."""
+        for p in pages:
+            if p == 0:
+                raise ValueError("page 0 is the reserved null page")
+            if self._rc[p] <= 0:
+                raise ValueError(f"double free of page {p}")
+            self._rc[p] -= 1
+            if self._rc[p] == 0:
+                self._free.append(p)
+
+    def refcount(self, page: int) -> int:
+        return self._rc[page]
+
+
+def pages_needed(prompt_window: int, budget: int, kv_page: int, *,
+                 prefix_len: int = 0, decode_chunk: int = 1) -> int:
+    """Private pages one request needs for its whole trajectory: logical
+    slots ``[prefix_len // kv_page * kv_page, prefix_len + prompt_window +
+    budget + decode_chunk - 1)`` minus the shared whole-prefix head pages.
+    The chunk tail mirrors ``_validate_workload``'s ctx formula — chunked
+    decode scratch-writes up to ``decode_chunk - 1`` slots past the budget
+    before the slot recycles, and those writes need real pages too."""
+    overrun = (decode_chunk - 1) if budget > 0 else 0
+    top = prefix_len + prompt_window + budget + overrun
+    return -(-top // kv_page) - prefix_len // kv_page
+
+
+def kv_bytes(nr_tokens: int, nr_layers: int, kv_heads: int, head_dim: int,
+             *, itemsize: int = 4, int8: bool = False) -> int:
+    """Analytic resident-KV bytes for ``nr_tokens`` cached slots: K + V
+    per layer (int8 adds the two float32 per-(token, head) scale planes).
+    ``nr_tokens`` is ``max_batch * ctx_size`` for the contiguous layout
+    and ``nr_pages * kv_page`` for the paged pool — the formula both
+    docs/PERFORMANCE.md §7 and mem_estimate ``--kv-pages`` quote."""
+    per_tok = 2 * kv_heads * head_dim * (1 if int8 else itemsize)
+    if int8:
+        per_tok += 2 * kv_heads * 4  # k_s / v_s float32 scales
+    return nr_tokens * nr_layers * per_tok
+
+
+@dataclass
+class PrefixEntry:
+    """One registered shared prefix: its physical pages (base reference
+    held by the registry), token length, and hit count."""
+
+    pages: list
+    nr_tokens: int
+    hits: int = 0
+
+
+class PrefixRegistry:
+    """Refcounted registry of precomputed prefix pages, keyed by the
+    prefix token ids.
+
+    Lifecycle: ``put`` records pages the caller already allocated (the
+    registry takes over their base reference); ``acquire`` adds one pool
+    reference per admitted request mapping its table head onto them
+    (released with ``pool.free`` when the slot recycles); ``drop``
+    releases the base reference — outstanding request references keep the
+    pages allocated until the last slot frees them (plain refcounting, no
+    epochs needed: the scheduler is single-threaded)."""
+
+    def __init__(self, pool: KVPagePool):
+        self._pool = pool
+        self._entries: dict = {}
+
+    @staticmethod
+    def key_of(tokens) -> tuple:
+        return tuple(int(t) for t in tokens)
+
+    def put(self, tokens, pages) -> None:
+        key = self.key_of(tokens)
+        if key in self._entries:
+            raise ValueError(f"prefix of {len(key)} tokens already registered")
+        self._entries[key] = PrefixEntry(list(pages), len(key))
+
+    def lookup(self, tokens) -> PrefixEntry | None:
+        return self._entries.get(self.key_of(tokens))
+
+    def acquire(self, tokens) -> list[int] | None:
+        """Pages for a matching prefix with one reference added per page
+        (the caller frees them when its slot recycles); ``None`` on miss."""
+        e = self._entries.get(self.key_of(tokens))
+        if e is None:
+            return None
+        self._pool.share(e.pages)
+        e.hits += 1
+        return list(e.pages)
+
+    def drop(self, tokens) -> None:
+        """Release the registry's base reference and forget the entry."""
+        e = self._entries.pop(self.key_of(tokens))
+        self._pool.free(e.pages)
+
+    def __len__(self) -> int:
+        return len(self._entries)

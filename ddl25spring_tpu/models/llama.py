@@ -245,7 +245,8 @@ class Attention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, pad=None, prefix_len: int = 0):
+    def __call__(self, x, positions, pad=None, prefix_len: int = 0,
+                 block_tables=None):
         cfg = self.config
         B, T, _ = x.shape
         mk = _dense_cls(cfg)
@@ -271,7 +272,8 @@ class Attention(nn.Module):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if cfg.decode:
-            out = self._decode_attention(q, k, v, positions, pad, prefix_len)
+            out = self._decode_attention(q, k, v, positions, pad, prefix_len,
+                                         block_tables)
             out = out.reshape(B, T, cfg.dmodel)
             return dense("wo", cfg.dmodel)(out)
         # single-device training paths: expand KV heads to the query heads
@@ -307,7 +309,7 @@ class Attention(nn.Module):
         return dense("wo", cfg.dmodel)(out)
 
     def _decode_attention(self, q, k, v, positions, pad=None,
-                          prefix_len: int = 0):
+                          prefix_len: int = 0, block_tables=None):
         """Attention against a fixed-size KV cache (``cache`` collection).
 
         The cache keeps static shape (B, ctx_size, Hkv, hd) — TPU-friendly:
@@ -317,14 +319,40 @@ class Attention(nn.Module):
         decode step (T = 1, offset = tokens seen so far).  Under GQA the
         cache holds only the kv_heads (the capability's whole point:
         nr_heads/kv_heads times less cache HBM and read bandwidth per decode
-        step); queries ride a grouped einsum against it, no repeat."""
+        step); queries ride a grouped einsum against it, no repeat.
+
+        ``block_tables`` (B, ctx_size // kv_page) int32 switches the cache
+        to the PAGED layout (models/kv_pool.py): the ``cache`` collection
+        then holds one physical pool per leaf, (nr_pages, kv_page, Hkv, hd),
+        and row b's logical slot s lives at
+        ``pool[block_tables[b, s // kv_page], s % kv_page]``.  The write
+        scatters this step's token into its page; the read gathers the
+        pages back into the exact (B, ctx_size, ...) logical view the
+        einsum/mask code below already consumes — identical values in an
+        identical layout, so paged serving is BIT-identical to contiguous
+        (tests/test_serving_paged.py).  Table entries of 0 denote the
+        reserved null page (freed lanes park there); its content is zeroed
+        at the read so garbage another lane dumped on it can never leak a
+        NaN through a masked-out attention term (0 * NaN).  Serving-decode
+        only: per-row positions, T = 1."""
         cfg = self.config
         B, T = q.shape[:2]
         S = cfg.ctx_size
         Hkv = cfg.kv_heads
         if cfg.decode_seq_shards > 1:
+            if block_tables is not None:
+                raise NotImplementedError(
+                    "paged KV over the sequence-sharded cache"
+                )
             return self._sharded_decode_attention(q, k, v, positions, pad)
         per_row = positions.ndim == 2  # (B, T) row-local slots (speculative)
+        paged = block_tables is not None
+        if paged and not (per_row and T == 1):
+            raise NotImplementedError(
+                "paged KV serves per-row single-token decode; prefill rows "
+                "are built contiguous and page-copied into the pool "
+                "(models/serving.py admit)"
+            )
         if pad is not None:
             # scrub pad-slot K/V before they enter the cache: pad-slot
             # QUERIES see no keys, so deeper layers' activations there are
@@ -339,7 +367,16 @@ class Attention(nn.Module):
         def write(var, blk):
             """Scatter a (B, T, Hkv, ...) block at the query positions —
             shared by the value buffers and the int8 scale buffers (whose
-            trailing dims just shrink)."""
+            trailing dims just shrink).  Paged: the single token routes
+            through the block table to its physical page; freed lanes
+            (table row all zero) land on the null page, whose content the
+            read below masks to zero."""
+            if paged:
+                p = positions[:, 0]
+                page = var.value.shape[1]
+                phys = block_tables[jnp.arange(B), p // page]
+                var.value = var.value.at[phys, p % page].set(blk[:, 0])
+                return
             trail = (0,) * (blk.ndim - 2)
             if per_row:
                 var.value = jax.vmap(
@@ -402,14 +439,44 @@ class Attention(nn.Module):
                 out = flash_decode_attention(
                     q[:, 0], ck_q.value, cv_q.value, pos_arg, pad,
                     cache_k_scale=ck_s.value, cache_v_scale=cv_s.value,
-                    prefix_len=prefix_len,
+                    prefix_len=prefix_len, block_tables=block_tables,
                 )
             else:
                 out = flash_decode_attention(
                     q[:, 0], ck.value, cv.value, pos_arg, pad,
-                    prefix_len=prefix_len,
+                    prefix_len=prefix_len, block_tables=block_tables,
                 )
             return out[:, None]  # (B, 1, H, hd)
+        if paged:
+            # gather the pool pages back into the (B, S, ...) logical view
+            # the einsum/mask code below already consumes — identical
+            # values in an identical layout is WHY paged == contiguous
+            # bit-for-bit.  Null-page (entry 0) content is zeroed: those
+            # logical slots sit past every live position and are masked,
+            # but a NaN parked there by a freed/quarantined lane would
+            # survive masking as 0 * NaN through the value einsum.
+            nt = block_tables.shape[1]
+            keep = block_tables > 0
+
+            class _Paged:  # .value shim: the gathered logical view
+                def __init__(self, var):
+                    pool = var.value
+                    if nt * pool.shape[1] != S:
+                        raise ValueError(
+                            f"block table width {nt} x kv_page "
+                            f"{pool.shape[1]} must equal ctx_size {S}"
+                        )
+                    g = pool[block_tables]  # (B, nt, page, ...)
+                    m = keep.reshape((B, nt) + (1,) * (g.ndim - 2))
+                    self.value = jnp.where(m, g, 0).reshape(
+                        (B, nt * pool.shape[1]) + pool.shape[2:]
+                    )
+
+            if cfg.kv_cache_int8:
+                ck_q, ck_s = _Paged(ck_q), _Paged(ck_s)
+                cv_q, cv_s = _Paged(cv_q), _Paged(cv_s)
+            else:
+                ck, cv = _Paged(ck), _Paged(cv)
         if cfg.kv_cache_int8:
             # einsum path: dequantize the whole cache up front (XLA fuses
             # the multiply into the operand load)
@@ -564,11 +631,12 @@ class Block(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, pad=None, prefix_len: int = 0):
+    def __call__(self, x, positions, pad=None, prefix_len: int = 0,
+                 block_tables=None):
         cfg = self.config
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions, pad,
-            prefix_len,
+            prefix_len, block_tables,
         )
         h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
         if cfg.nr_experts:
@@ -687,7 +755,7 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, pad=None,
-                 prefix_len: int = 0):
+                 prefix_len: int = 0, block_tables=None):
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.dmodel,
@@ -698,11 +766,14 @@ class Llama(nn.Module):
         # local block starts at a nonzero global offset (parallel/sp.py);
         # ``pad`` (B,) supports ragged left-padded decode (models/generate);
         # ``prefix_len`` marks shared prefix-cache slots (generate.py
-        # precompute_prefix) that stay visible below the pad window
+        # precompute_prefix) that stay visible below the pad window;
+        # ``block_tables`` (B, ctx // kv_page) switches decode to the paged
+        # KV-pool layout (models/kv_pool.py, serving kv_layout="paged")
         pos = _positions(tokens.shape[1]) if positions is None else positions
         block = _block_cls(cfg)
         for i in range(cfg.nr_layers):
-            x = block(cfg, name=f"block{i}")(x, pos, pad, prefix_len)
+            x = block(cfg, name=f"block{i}")(x, pos, pad, prefix_len,
+                                             block_tables)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = _dense_cls(cfg)(cfg.vocab_size, "lm_head")(x)
         return logits.astype(jnp.float32)

@@ -1,0 +1,224 @@
+"""Closed-loop load generator and saturation sweep for the serving
+batcher.
+
+The generator replays a SEEDED heavy-tailed arrival trace (lognormal or
+Pareto inter-arrival gaps, unit mean, scaled to the offered QPS) against
+a live :class:`~ddl25spring_tpu.models.serving.ContinuousBatcher` on the
+wall clock: requests are submitted when their arrival time passes, the
+batcher is stepped whenever work is in flight, and every completion is
+stamped host-side.  It is closed-loop in the scheduling sense — the
+generator and the batcher share one thread, so decode chunks and
+admissions interleave exactly as a single-host serving loop would, and
+queue growth feeds back into measured latency instead of being hidden
+by an unbounded submission thread.
+
+``saturation_sweep`` replays the same trace shape at increasing offered
+QPS and reports one point per rate with goodput, latency percentiles,
+queue wait, reject/evict rates and peak KV-page residency.  The knee is
+the last offered rate the batcher still serves at >= ``knee_frac`` of
+the offered load — past it, queue wait (and therefore latency) grows
+without bound and extra offered load only converts to rejects.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["arrival_trace", "replay", "saturation_sweep", "warm"]
+
+
+def arrival_trace(nr: int, qps: float, dist: str = "lognormal",
+                  seed: int = 0, *, sigma: float = 1.0,
+                  alpha: float = 2.5) -> np.ndarray:
+    """Absolute arrival times (seconds) for ``nr`` requests at an
+    offered rate of ``qps``, with heavy-tailed inter-arrival gaps.
+
+    Gaps are drawn with UNIT mean and divided by ``qps`` so the offered
+    rate is exact in expectation whatever the tail shape:
+
+    - ``"lognormal"``: ``exp(N(mu, sigma))`` with ``mu = -sigma**2/2``
+      (the mean-one parameterisation).
+    - ``"pareto"``: Lomax with shape ``alpha > 1`` scaled by
+      ``alpha - 1`` (numpy's ``pareto(a)`` has mean ``1/(a-1)``).
+
+    The trace is a deterministic function of ``(nr, qps, dist, seed)``
+    and the tail parameters — sweeps at different rates reuse the same
+    seed so every point replays the same burst STRUCTURE, only faster.
+    """
+    if nr < 1:
+        raise ValueError(f"nr={nr} must be >= 1")
+    if qps <= 0:
+        raise ValueError(f"qps={qps} must be > 0")
+    rng = np.random.default_rng(seed)
+    if dist == "lognormal":
+        gaps = rng.lognormal(mean=-0.5 * sigma * sigma, sigma=sigma,
+                             size=nr)
+    elif dist == "pareto":
+        if alpha <= 1:
+            raise ValueError(f"alpha={alpha} must be > 1 for a finite "
+                             "mean")
+        gaps = rng.pareto(alpha, size=nr) * (alpha - 1.0)
+    else:
+        raise ValueError(f"unknown arrival dist {dist!r}; expected "
+                         "'lognormal' or 'pareto'")
+    return np.cumsum(gaps / qps)
+
+
+def _pct(xs, q):
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs \
+        else 0.0
+
+
+def replay(batcher, trace, prompts, budgets, *,
+           deadline_s: float | None = None) -> dict:
+    """Replay one arrival trace through a live batcher and measure it.
+
+    ``prompts[i]``/``budgets[i]`` arrive at ``trace[i]`` seconds after
+    the replay starts.  Requests the batcher rejects (queue full, SLO,
+    pool) are counted by reason and NOT retried — the sweep wants the
+    reject rate at the offered load, not a retry storm.  Returns one
+    point dict; see :func:`saturation_sweep` for the schema.
+    """
+    trace = np.asarray(trace, np.float64)
+    nr = len(trace)
+    if not (len(prompts) == len(budgets) == nr):
+        raise ValueError(
+            f"trace/prompts/budgets length mismatch: {nr} vs "
+            f"{len(prompts)} vs {len(budgets)}")
+    paged = getattr(batcher, "_paged", False)
+    submit_t: dict = {}      # rid -> wall submit time
+    admit_t: dict = {}       # rid -> wall admission time (left queue)
+    waiting: set = set()     # submitted rids still in the batcher queue
+    rejects: dict = {}       # reason -> count
+    finished: dict = {}      # rid -> (latency_s, status, nr_tokens)
+    tokens_out = 0
+    pages_peak = 0
+
+    def note_pages():
+        # the pool's own high-water mark: step-boundary sampling misses
+        # pages allocated and freed within one step() call
+        nonlocal pages_peak
+        if paged:
+            pages_peak = max(pages_peak, batcher._pool.pages_peak)
+
+    def mark_admitted(now):
+        # a submitted rid that is no longer queued was admitted (or
+        # resolved) this step; its queue wait ends here
+        still = {q[0] for q in batcher._queue}
+        for rid in [r for r in waiting if r not in still]:
+            waiting.discard(rid)
+            admit_t[rid] = now
+
+    def absorb(done, now):
+        nonlocal tokens_out
+        for rid, toks in done.items():
+            status = getattr(toks, "status", "ok")
+            finished[rid] = (now - submit_t[rid], status, len(toks))
+            tokens_out += len(toks)
+
+    t0 = time.perf_counter()
+    nxt = 0
+    while nxt < nr or batcher.in_flight:
+        now = time.perf_counter() - t0
+        if nxt < nr and now >= trace[nxt]:
+            rid = nxt
+            try:
+                submit_t[rid] = now
+                batcher.submit(rid, list(prompts[nxt]),
+                               int(budgets[nxt]), deadline_s=deadline_s)
+                waiting.add(rid)
+            except Exception as e:                # AdmissionRejected
+                reason = getattr(e, "reason", None) or "rejected"
+                rejects[reason] = rejects.get(reason, 0) + 1
+                submit_t.pop(rid, None)
+            nxt += 1
+            continue
+        if batcher.in_flight:
+            done = batcher.step()
+            now = time.perf_counter() - t0
+            mark_admitted(now)
+            note_pages()
+            absorb(done, now)
+        elif nxt < nr:
+            time.sleep(min(0.002, max(0.0, trace[nxt] - now)))
+    elapsed = max(time.perf_counter() - t0, 1e-9)
+    note_pages()
+
+    ok = [lat for lat, status, _ in finished.values() if status == "ok"]
+    lats = [lat for lat, _, _ in finished.values()]
+    waits = [admit_t[r] - submit_t[r] for r in admit_t if r in submit_t]
+    evicted = sum(1 for _, status, _ in finished.values()
+                  if status != "ok")
+    nr_rej = sum(rejects.values())
+    return {
+        "offered_qps": nr / float(trace[-1]),
+        "elapsed_s": elapsed,
+        "completed": len(finished),
+        "goodput_rps": len(ok) / elapsed,
+        "tokens_per_sec": tokens_out / elapsed,
+        "latency_p50_s": _pct(lats, 50),
+        "latency_p99_s": _pct(lats, 99),
+        "queue_wait_p50_s": _pct(waits, 50),
+        "queue_wait_p99_s": _pct(waits, 99),
+        "reject_rate": nr_rej / nr,
+        "rejects_by_reason": dict(sorted(rejects.items())),
+        "evict_rate": evicted / nr,
+        "kv_pages_peak": pages_peak,
+    }
+
+
+def warm(make_batcher, prompts, budgets, *,
+         deadline_s: float | None = None) -> None:
+    """Compile every program shape a replay can hit, outside the timed
+    points.  Admissions pad the group to a power of two, so a burst
+    trace only compiles the full-group admit — a request trickling in
+    alone at low offered rate would then eat the G=1 compile inside a
+    measured point.  One batcher replays each power-of-two group size
+    up to ``max_batch``; the program cache is keyed on shapes, so every
+    later batcher of the same shape runs warm."""
+    wb = make_batcher()
+    mb = max(1, int(getattr(wb, "max_batch", 1)))
+    g = 1
+    while g <= min(mb, len(prompts)):
+        replay(wb, arrival_trace(g, 1e4, "lognormal", 0), prompts[:g],
+               budgets[:g], deadline_s=deadline_s)
+        g *= 2
+
+
+def saturation_sweep(make_batcher, qps_points, nr_requests, prompt_fn,
+                     budget, *, dist: str = "lognormal", seed: int = 0,
+                     deadline_s: float | None = None,
+                     knee_frac: float = 0.9,
+                     warmup: bool = True) -> dict:
+    """Replay the same seeded trace shape at each offered rate in
+    ``qps_points`` (ascending) against a FRESH batcher per point from
+    ``make_batcher()`` — program caches inside the batcher make the
+    rebuild cheap, and a fresh queue/pool per point keeps the points
+    independent.
+
+    ``prompt_fn(i, rng)`` produces request ``i``'s token list from a
+    per-sweep ``numpy`` generator, so the workload is identical across
+    points.  The knee is the LAST point whose goodput is at least
+    ``knee_frac`` of the offered rate; past it the batcher is saturated
+    and queue wait grows with offered load instead of goodput.
+    """
+    qps_points = sorted(float(q) for q in qps_points)
+    rng = np.random.default_rng(seed)
+    prompts = [prompt_fn(i, rng) for i in range(nr_requests)]
+    budgets = [int(budget)] * nr_requests
+    if warmup:
+        warm(make_batcher, prompts, budgets, deadline_s=deadline_s)
+    points = []
+    for qps in qps_points:
+        trace = arrival_trace(nr_requests, qps, dist, seed)
+        batcher = make_batcher()
+        points.append(replay(batcher, trace, prompts, budgets,
+                             deadline_s=deadline_s))
+    knee = None
+    for pt in points:
+        if pt["goodput_rps"] >= knee_frac * pt["offered_qps"]:
+            knee = pt["offered_qps"]
+    return {"dist": dist, "seed": seed, "nr_requests": nr_requests,
+            "knee_qps": knee, "knee_frac": knee_frac, "points": points}

@@ -52,6 +52,17 @@ driver's remote chip even though the device work was smaller):
   precomputed admission/output tables; EOS mode runs a
   ``lax.while_loop`` that admits, decodes, and retires on device.
 
+KV residency (``kv_layout="paged"``): the contiguous serving cache pins
+``max_batch * ctx_size`` KV slots whether or not anything lives in them;
+the paged layout (models/kv_pool.py + the block-table read/write path in
+models/llama.py) carves one physical pool of ``kv_page``-token pages,
+bit-identical in output, whose residency tracks live tokens — and whose
+shared-prefix pages are refcounted across requests (prefix-cache-aware
+admission).  ``serve_fused`` stays contiguous BY DESIGN: its cache is
+built in-trace, lives for exactly one dispatch, and is sized by the
+workload it was compiled for — there is no long-lived pool for paging to
+shrink.
+
 Composes with the rest of the serving stack: LoRA fine-tune -> merge ->
 serve (merged trees are plain params), int8 (quantized trees load the same
 way), and the sequence-sharded cache for long contexts.
@@ -69,18 +80,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from . import kv_pool
 from .llama import Llama, LlamaConfig
 
 
 class AdmissionRejected(RuntimeError):
-    """Bounded-queue backpressure: the batcher's waiting queue is full.
-    ``retry_after_s`` is the scheduler's estimate of when a queue lane
-    frees up — clients back off (``resilience.retry.retry_call`` with
+    """Admission backpressure: the request cannot be accepted right now.
+    ``reason`` names the binding constraint (``"queue_full"``,
+    ``"slo"``, or ``"kv_pool"``) and ``retry_after_s`` is the
+    scheduler's estimate of when it clears — clients back off
+    (``resilience.retry.retry_call`` with
     ``retry_on=(AdmissionRejected,)``) instead of piling on."""
 
-    def __init__(self, message: str, retry_after_s: float):
+    def __init__(self, message: str, retry_after_s: float,
+                 reason: str = "queue_full"):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+        self.reason = reason
 
 
 class ServedTokens(list):
@@ -172,7 +188,27 @@ def _make_empty_cache(model, max_batch: int):
     return jax.jit(functools.partial(_empty_cache_of, model, max_batch))
 
 
-def _decode_step(model, P: int, params, pad, carry, _=None, *, check=False):
+def _make_empty_pool(model, kv_page: int):
+    """Jitted PAGED-pool builder: same cache tree as :func:`_empty_cache_of`
+    but with every (B, ctx, ...) leaf re-carved into (nr_pages, kv_page,
+    ...) physical pages (models/kv_pool.py; page 0 is the reserved null
+    page).  ``nr_pages`` is static — the pool is sized once at batcher
+    construction, not per max_batch*ctx worst case (that being the whole
+    point)."""
+
+    @functools.partial(jax.jit, static_argnames=("nr_pages",))
+    def build(params, nr_pages: int):
+        tmpl = _empty_cache_of(model, 1, params)
+        return jax.tree.map(
+            lambda a: jnp.zeros((nr_pages, kv_page) + a.shape[2:], a.dtype),
+            tmpl,
+        )
+
+    return build
+
+
+def _decode_step(model, P: int, params, pad, carry, _=None, *, check=False,
+                 tables=None):
     """One lockstep greedy decode step for all slots at their own depths —
     the scan body every serving path shares (host batcher chunks, fused
     while_loop, scheduled scan), so the bit-identical-to-generate()
@@ -181,12 +217,18 @@ def _decode_step(model, P: int, params, pad, carry, _=None, *, check=False):
     ``check`` (keyword-only: the fused call sites pass positionally and
     stay on the plain path) additionally emits a per-row all-finite flag
     over the step's logits — the batcher's poison guard.  The token math
-    is untouched either way."""
+    is untouched either way.
+
+    ``tables`` (keyword-only, (B, ctx // kv_page) int32) switches the
+    carry's cache to the PAGED pool layout (models/kv_pool.py): the model
+    routes every cache read/write through the block table; the logical
+    values the attention math sees are identical, so paged streams stay
+    bit-equal to contiguous ones."""
     cache, tok, pos = carry
     logits, state = model.apply(
         {**params, "cache": cache}, tok[:, None],
         positions=pos[:, None], pad=pad, prefix_len=P,
-        mutable=["cache"],
+        block_tables=tables, mutable=["cache"],
     )
     nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
     if check:
@@ -234,15 +276,79 @@ def _validate_workload(requests, budgets, *, prefill_width: int,
             )
 
 
+def _paged_programs(model, W: int, P: int, kv_page: int):
+    """The paged-layout admit/decode pair (cached under :func:`_programs`'
+    lru with ``kv_page`` in the key).
+
+    Prefill itself stays CONTIGUOUS — the vmapped right-aligned window
+    math is untouched, so its outputs cannot drift from the contiguous
+    path's.  What changes is where the row caches land: ``admit`` copies
+    each prefilled row's logical pages ``[P // kv_page, ceil((P + W) /
+    kv_page))`` into the slot's freshly allocated physical pages (a static
+    G x n_copy unrolled ``dynamic_update_slice`` loop over the
+    ``copy_dst`` table the host allocator filled).  The boundary page of a
+    non-page-aligned prefix is exact because the row cache was built ON
+    the prefix cache and carries the prefix KV below the window.
+    ``decode`` is the same chunk scan with the block tables threaded to
+    the model."""
+
+    @jax.jit
+    def admit(params, pool, rows, lengths, slots, tokens, pos, pad,
+              copy_dst, prefix_cache=None):
+        """copy_dst (G, n_copy) int32: physical destination page for each
+        admitted row's c-th copied logical page.  Pad lanes repeat the
+        last real admission (same pages, same data — idempotent), exactly
+        like the contiguous scatter."""
+        row_caches, firsts, pads = jax.vmap(
+            functools.partial(_right_aligned_prefill, model, W, P),
+            in_axes=(None, 0, 0, None),
+        )(params, rows, lengths, prefix_cache)
+        lo = P // kv_page
+        for g in range(rows.shape[0]):
+            for c in range(copy_dst.shape[1]):
+                start = (lo + c) * kv_page
+                pool = jax.tree.map(
+                    lambda big, rc: jax.lax.dynamic_update_slice(
+                        big,
+                        rc[g][:, start:start + kv_page].astype(big.dtype),
+                        (copy_dst[g, c],) + (0,) * (big.ndim - 1),
+                    ),
+                    pool, row_caches,
+                )
+        tokens = tokens.at[slots].set(firsts)
+        pos = pos.at[slots].set(P + W)
+        pad = pad.at[slots].set(pads)
+        return pool, tokens, pos, pad, firsts
+
+    @functools.partial(jax.jit, static_argnames=("nr", "check"))
+    def decode(params, pool, tokens, pos, pad, tables, nr=1, check=False):
+        """Contiguous ``decode`` with the block tables riding along — the
+        scan body is the same single copy of the math (_decode_step), so
+        the bit-identity contract is structural, not empirical."""
+        (pool, last, final_pos), ys = jax.lax.scan(
+            functools.partial(_decode_step, model, P, params, pad,
+                              check=check, tables=tables),
+            (pool, tokens, pos), None, length=nr,
+        )
+        if check:
+            toks, ok = ys
+            return pool, toks.T, final_pos, last, ok.all(axis=0)
+        return pool, ys.T, final_pos, last
+
+    return admit, decode, _make_empty_pool(model, kv_page)
+
+
 @functools.lru_cache(maxsize=8)
 def _programs(config: LlamaConfig, max_batch: int, prefill_width: int,
-              prefix_len: int = 0):
+              prefix_len: int = 0, kv_page: int = 0):
     # eos handling is entirely host-side (the scheduler), so it is NOT part
     # of the compiled programs or their cache key
     cfg = dataclasses.replace(config, decode=True)
     model = Llama(cfg)
     W = prefill_width
     P = prefix_len
+    if kv_page:
+        return _paged_programs(model, W, P, kv_page)
 
     @jax.jit
     def admit(params, cache, rows, lengths, slots, tokens, pos, pad,
@@ -312,13 +418,27 @@ class ContinuousBatcher:
     (prefix_len = 0 without a shared prefix) — the chunk tail are scratch
     writes a recycled slot overwrites, but they must land inside the
     cache.
+
+    ``kv_layout="paged"`` swaps the (max_batch, ctx) serving cache for a
+    pool of ``kv_page``-token physical pages with per-slot block tables
+    (models/kv_pool.py; docs/PERFORMANCE.md §7): outputs stay
+    BIT-IDENTICAL for every trajectory (tests/test_serving_paged.py pins
+    the full fault matrix), but resident KV bytes track LIVE tokens —
+    pages return to the pool the moment a slot completes, times out, or
+    is scrubbed — so a pool sized for expected concurrency (``kv_pages``)
+    runs the same traffic in a fraction of the contiguous footprint.
+    Requests sharing ``prefix_tokens`` map their block-table heads onto
+    one refcounted copy of the prefix pages and skip its prefill work
+    entirely.
     """
 
     def __init__(self, config: LlamaConfig, params, *, max_batch: int = 8,
                  prefill_width: int = 64, eos_id: int | None = None,
                  decode_chunk: int = 1, prefix: tuple | None = None,
                  max_queue: int | None = None, poison_guard: bool = False,
-                 fault_plan=None):
+                 fault_plan=None, kv_layout: str = "contiguous",
+                 kv_page: int = 16, kv_pages: int | None = None,
+                 prefix_tokens=None, slo_deadline_s: float | None = None):
         # ``params`` is the full variables dict ({"params": ...}), the same
         # contract as models.generate.generate / speculative_generate.
         # ``decode_chunk``: tokens per decode dispatch — admissions happen
@@ -333,10 +453,36 @@ class ContinuousBatcher:
         # ``fault_plan``    resilience.FaultPlan — its ``serve_timeout``
         #                   rate injects deterministic request stalls
         #                   (evicted as ``timed_out``).
+        #
+        # Paged KV (docs/PERFORMANCE.md §7):
+        # ``kv_layout``     "contiguous" (default; one (max_batch, ctx) KV
+        #                   row per slot) or "paged" — the cache becomes a
+        #                   pool of ``kv_page``-token physical pages and
+        #                   per-slot block tables (models/kv_pool.py);
+        #                   bit-identical outputs, resident KV tracks live
+        #                   tokens instead of the worst case;
+        # ``kv_pages``      pool size (default: enough that allocation can
+        #                   never fail — sizing it SMALLER is the memory
+        #                   win; admission then queues on the pool);
+        # ``prefix_tokens`` shared system-prompt token ids — the batcher
+        #                   precomputes the prefix itself, every prompt
+        #                   must start with it (stripped on submit; the
+        #                   skipped prefill work is counted as
+        #                   serving_prefix_hits_total) and paged slots map
+        #                   their block-table heads onto ONE shared
+        #                   refcounted copy of its whole pages;
+        # ``slo_deadline_s`` admission SLO: reject (with a drain-rate
+        #                   derived ``retry_after_s``) requests whose
+        #                   estimated queue + pool wait already exceeds it.
         if config.decode_seq_shards > 1:
             raise NotImplementedError(
                 "continuous batching over the sequence-sharded cache: use "
                 "one batcher per replica today"
+            )
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'contiguous' or 'paged', got "
+                f"{kv_layout!r}"
             )
         self.config = config
         self.params = params
@@ -346,19 +492,110 @@ class ContinuousBatcher:
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = decode_chunk
+        self.kv_layout = kv_layout
+        self._paged = kv_layout == "paged"
+        if slo_deadline_s is not None and slo_deadline_s <= 0:
+            raise ValueError(
+                f"slo_deadline_s={slo_deadline_s} must be > 0"
+            )
+        self.slo_deadline_s = slo_deadline_s
         # shared-prefix serving (system prompt / few-shot header): the
         # result of generate.precompute_prefix; every admission prefills
-        # on top of it and every slot decodes past it
+        # on top of it and every slot decodes past it.  ``prefix_tokens``
+        # is the self-service form: the batcher precomputes the prefix and
+        # owns the prompt-stripping contract (prefix-cache-aware
+        # admission).
+        if prefix_tokens is not None:
+            if prefix is not None:
+                raise ValueError(
+                    "pass prefix= (a precomputed cache) or prefix_tokens= "
+                    "(token ids the batcher precomputes), not both"
+                )
+            from .generate import precompute_prefix
+            self._prefix_tokens = tuple(int(t) for t in prefix_tokens)
+            prefix = precompute_prefix(
+                config, params,
+                jnp.asarray(self._prefix_tokens, jnp.int32),
+            )
+        else:
+            self._prefix_tokens = None
         self._prefix_cache, self.prefix_len = (
             prefix if prefix is not None else (None, 0)
         )
         # pin 'auto' decode_impl from the params' device before the config
         # becomes _programs' lru_cache key
         config = self.config = config.with_resolved_decode_impl(params)
+        self.kv_page = int(kv_page) if self._paged else 0
+        if self._paged:
+            if self.kv_page < 1:
+                raise ValueError(f"kv_page must be >= 1, got {kv_page}")
+            if config.ctx_size % self.kv_page:
+                raise ValueError(
+                    f"ctx_size {config.ctx_size} must be a multiple of "
+                    f"kv_page {self.kv_page}"
+                )
         self._admit_fn, self._decode, empty = _programs(
-            config, max_batch, prefill_width, self.prefix_len
+            config, max_batch, prefill_width, self.prefix_len, self.kv_page
         )
-        self.cache = empty(params)
+        if self._paged:
+            pg = self.kv_page
+            P = self.prefix_len
+            self._n_slot_pages = config.ctx_size // pg
+            self._head_len = P // pg  # WHOLE pages of shared prefix
+            # logical pages the admit program copies from the prefill row
+            # cache: [P // pg, ceil((P + W) / pg)) — the boundary page of
+            # an unaligned prefix rides along (private, exact: the row
+            # cache carries the prefix KV below the window)
+            self._n_copy = -(-(P + prefill_width) // pg) - self._head_len
+            if kv_pages is None:
+                # never-fails sizing: the head pages once, plus every
+                # slot's worst-case private pages, plus the null page.
+                # Sizing SMALLER is the point of paging — admission then
+                # waits on the pool (head-of-line, deterministic).
+                kv_pages = 1 + self._head_len + max_batch * (
+                    self._n_slot_pages - self._head_len
+                )
+            self._pool = kv_pool.KVPagePool(int(kv_pages))
+            self._registry = kv_pool.PrefixRegistry(self._pool)
+            self._tables = np.zeros(
+                (max_batch, self._n_slot_pages), np.int32
+            )
+            self._head_pages: list = []
+            if self._head_len:
+                head = self._pool.alloc(self._head_len)
+                if head is None:
+                    raise ValueError(
+                        f"kv_pages={kv_pages} cannot hold the "
+                        f"{self._head_len} shared prefix pages"
+                    )
+                self._head_pages = head
+            self.cache = empty(params, nr_pages=self._pool.nr_pages)
+            if self._head_pages:
+                # install the precomputed prefix KV into its shared
+                # read-only pages (once; every admission just points its
+                # table head here)
+                ix = jnp.asarray(self._head_pages, jnp.int32)
+                n_tok = self._head_len * pg
+                self.cache = jax.tree.map(
+                    lambda pool_a, pc: pool_a.at[ix].set(
+                        pc[0, :n_tok].reshape(
+                            (self._head_len, pg) + pc.shape[2:]
+                        ).astype(pool_a.dtype)
+                    ),
+                    self.cache, self._prefix_cache,
+                )
+                if self._prefix_tokens is not None:
+                    # the registry takes over the base reference; each
+                    # admitted slot adds (and later drops) one more
+                    self._registry.put(self._prefix_tokens,
+                                       self._head_pages)
+        else:
+            self._pool = None
+            self._registry = None
+            self._tables = None
+            self._head_pages = []
+            self._head_len = 0
+            self.cache = empty(params)
         self.pos = jnp.zeros((max_batch,), jnp.int32)
         self.pad = jnp.zeros((max_batch,), jnp.int32)
         self.tokens = jnp.zeros((max_batch,), jnp.int32)
@@ -370,6 +607,13 @@ class ContinuousBatcher:
         self.poison_guard = bool(poison_guard)
         self.fault_plan = fault_plan
         self._quarantined: set[int] = set()  # poisoned slots, out of rotation
+        # paged quarantine: a poisoned slot's PRIVATE pages hold NaN K/V a
+        # reallocated page would leak (0 * NaN through the value einsum),
+        # so they are held out of the pool until scrub() zeroes them
+        self._qpages: dict = {}  # slot -> held private pages
+        self._hit_rids: set = set()  # queued rids that matched the prefix
+        self._drain_pps = 0.0  # EWMA pages-freed/sec (SLO admission)
+        self._free_t: float | None = None
         self._status: dict = {}  # rid -> non-ok status for the current run
         self._deadlines: dict = {}  # rid -> deadline_s; the clock starts
         # at ADMISSION (decode-time bound; queue wait is the backpressure
@@ -381,7 +625,7 @@ class ContinuousBatcher:
         self._instant: dict = {}  # zero-budget submissions, returned next step
         # serving telemetry: how full the batch ran, admissions, steps
         self.stats = {"decode_steps": 0, "slot_steps": 0, "active_steps": 0,
-                      "admitted": 0}
+                      "admitted": 0, "prefix_hits": 0, "prefix_hit_tokens": 0}
         # obs stamps: rid -> submit/run-entry perf_counter (only written
         # while telemetry is enabled; queue-wait and request-latency
         # histograms are derived from these host-side)
@@ -410,6 +654,104 @@ class ContinuousBatcher:
             if t0 is not None:
                 obs.observe("serving_request_seconds", now - t0)
 
+    # -- paged-pool + prefix bookkeeping ---------------------------------
+
+    def _strip_prefix(self, prompt):
+        """With ctor-level ``prefix_tokens`` every prompt must carry the
+        shared prefix verbatim (the compiled programs bake its static
+        length in); returns the remainder that actually gets prefilled.
+        Raises on a mismatch — silently serving a prompt AGAINST a prefix
+        it doesn't share would answer the wrong question."""
+        if self._prefix_tokens is None:
+            return prompt
+        p = [int(t) for t in prompt]
+        n = len(self._prefix_tokens)
+        if len(p) <= n or tuple(p[:n]) != self._prefix_tokens:
+            raise ValueError(
+                f"prompt must start with the {n} shared prefix tokens "
+                "(prefix_tokens=) and continue past them"
+            )
+        return p[n:]
+
+    def _pages_needed(self, budget: int) -> int:
+        """Private pages one admission holds for its whole trajectory."""
+        return kv_pool.pages_needed(
+            self.prefill_width, budget, self.kv_page,
+            prefix_len=self.prefix_len, decode_chunk=self.decode_chunk,
+        )
+
+    def _check_pool_capacity(self, budgets, label=None):
+        """Upfront rejection of requests the pool could NEVER admit (need
+        exceeds total private capacity) — queueing them would deadlock the
+        head-of-line admission."""
+        if not self._paged:
+            return
+        cap = self._pool.nr_pages - 1 - self._head_len
+        for i, b in enumerate(budgets):
+            need = self._pages_needed(b) if b > 0 else 0
+            if need > cap:
+                who = label if label is not None else f"request {i}"
+                raise ValueError(
+                    f"{who}: needs {need} KV pages but the pool holds "
+                    f"only {cap} private pages (raise kv_pages or lower "
+                    "max_new_tokens)"
+                )
+
+    def _release_pages(self, s: int):
+        """Return slot ``s``'s pages to the pool at recycle time
+        (completion or deadline eviction): the shared prefix head drops
+        one reference, private pages free outright, and the table row
+        zeroes so the lane's post-recycle scratch writes land on the null
+        page.  Also feeds the drain-rate EWMA the SLO admission estimates
+        ride on."""
+        if not self._paged:
+            return
+        hp = self._head_len
+        private = [int(p) for p in self._tables[s, hp:] if p > 0]
+        if hp and self._tables[s, 0] > 0:
+            self._pool.free(self._head_pages)
+        if private:
+            self._pool.free(private)
+            now = time.perf_counter()
+            if self._free_t is not None and now > self._free_t:
+                rate = len(private) / (now - self._free_t)
+                self._drain_pps = (0.7 * self._drain_pps + 0.3 * rate
+                                   if self._drain_pps else rate)
+            self._free_t = now
+        self._tables[s, :] = 0
+        if obs.enabled():
+            obs.set_gauge("serving_kv_pages_in_use",
+                          self._pool.pages_in_use)
+
+    def _reject(self, reason: str, message: str, retry_after: float):
+        obs.inc("serving_rejected_total")
+        obs.inc("serving_reject_reason_total", reason=reason)
+        raise AdmissionRejected(message, retry_after, reason)
+
+    def _admission_wait_estimate(self, budget: int):
+        """Estimated seconds until a new request could be ADMITTED, and
+        which constraint binds (``"slo"`` = queue drain, ``"kv_pool"`` =
+        page deficit).  Queue component: recent fenced chunk times spread
+        over the backlog; pool component (paged): pages this request plus
+        the queued-ahead requests need beyond what's free, over the
+        measured page drain rate (EWMA fed by :meth:`_release_pages`).
+        Deliberately cheap and host-only — admission control must not cost
+        a device round trip."""
+        est_chunk = self._chunk_s if self._chunk_s > 0 else 0.05
+        wait = est_chunk * (len(self._queue) / self.max_batch)
+        bound = "slo"
+        if self._paged:
+            ahead = sum(self._pages_needed(b) for _r, _p, b in self._queue)
+            deficit = (self._pages_needed(budget) + ahead
+                       - self._pool.free_pages)
+            if deficit > 0:
+                pool_wait = (deficit / self._drain_pps
+                             if self._drain_pps > 0
+                             else est_chunk * deficit)
+                if pool_wait > wait:
+                    wait, bound = pool_wait, "kv_pool"
+        return wait, bound
+
     # -- scheduling ------------------------------------------------------
 
     def _admit_group(self, admissions):
@@ -432,15 +774,57 @@ class ContinuousBatcher:
         rows[G0:] = rows[G0 - 1]
         lengths[G0:] = lengths[G0 - 1]
         slot_ix[G0:] = slot_ix[G0 - 1]
+        if self._paged:
+            hp = self._head_len
+            copy_dst = np.zeros((G, self._n_copy), np.int32)
+            for g, (s, rid, _prompt, budget) in enumerate(admissions):
+                pages = self._pool.alloc(self._pages_needed(budget))
+                if pages is None:
+                    # _admit_from sized the group to the free-page count
+                    raise RuntimeError("KV pool exhausted mid-group")
+                if self._head_pages:
+                    # map the table head onto the shared prefix pages
+                    # (one reference per occupant)
+                    if self._prefix_tokens is not None:
+                        self._registry.acquire(self._prefix_tokens)
+                    else:
+                        self._pool.share(self._head_pages)
+                    self._tables[s, :hp] = self._head_pages
+                self._tables[s, hp:hp + len(pages)] = pages
+                self._tables[s, hp + len(pages):] = 0
+                copy_dst[g] = pages[:self._n_copy]
+                self._hit_rids.discard(rid)
+            # pad lanes re-copy the last real admission's pages (idempotent)
+            copy_dst[G0:] = copy_dst[G0 - 1]
+        if self.prefix_len:
+            # every admission skipped prefix_len tokens of prefill work
+            # (the prefix prefilled ONCE at construction)
+            self.stats["prefix_hits"] += G0
+            self.stats["prefix_hit_tokens"] += G0 * self.prefix_len
+            obs.inc("serving_prefix_hits_total", G0)
+            obs.inc("serving_prefix_hit_tokens_total",
+                    G0 * self.prefix_len)
         # span times DISPATCH only (no fence): budget mode's pipelining —
         # never block on device results mid-run — is the whole design
         with obs.span("serving.admit", group=G0):
-            (self.cache, self.tokens, self.pos, self.pad,
-             firsts) = self._admit_fn(
-                self.params, self.cache, jnp.asarray(rows),
-                jnp.asarray(lengths), jnp.asarray(slot_ix), self.tokens,
-                self.pos, self.pad, self._prefix_cache,
-            )
+            if self._paged:
+                (self.cache, self.tokens, self.pos, self.pad,
+                 firsts) = self._admit_fn(
+                    self.params, self.cache, jnp.asarray(rows),
+                    jnp.asarray(lengths), jnp.asarray(slot_ix),
+                    self.tokens, self.pos, self.pad,
+                    jnp.asarray(copy_dst), self._prefix_cache,
+                )
+                if obs.enabled():
+                    obs.set_gauge("serving_kv_pages_in_use",
+                                  self._pool.pages_in_use)
+            else:
+                (self.cache, self.tokens, self.pos, self.pad,
+                 firsts) = self._admit_fn(
+                    self.params, self.cache, jnp.asarray(rows),
+                    jnp.asarray(lengths), jnp.asarray(slot_ix), self.tokens,
+                    self.pos, self.pad, self._prefix_cache,
+                )
         now = (time.perf_counter()
                if self._deadlines or self.fault_plan is not None else 0.0)
         for g, (s, rid, _prompt, budget) in enumerate(admissions):
@@ -503,6 +887,7 @@ class ContinuousBatcher:
                 finished[sl.request_id] = out
                 done_rids.append(sl.request_id)
                 self._deadlines.pop(sl.request_id, None)
+                self._release_pages(s)
                 self.slots[s] = _Slot()
         if resolve:
             # tokens are host ints right here — this IS completion.  In
@@ -534,6 +919,7 @@ class ContinuousBatcher:
                 obs.event("serving.timed_out", rid=repr(sl.request_id),
                           emitted=len(sl.emitted))
                 self._deadlines.pop(sl.request_id, None)
+                self._release_pages(s)
                 self.slots[s] = _Slot()
         if rids:
             self._obs_finish(rids)
@@ -553,6 +939,21 @@ class ContinuousBatcher:
             self._status[sl.request_id] = "poisoned"
             rids.append(sl.request_id)
             self._quarantined.add(s)
+            if self._paged:
+                # shared head pages drop their reference (their content is
+                # clean — the poison lands at decode positions, past them);
+                # PRIVATE pages hold NaN K/V and stay out of the pool until
+                # scrub() zeroes them.  The zeroed table row parks the
+                # lane's further scratch writes on the null page.
+                hp = self._head_len
+                self._qpages[s] = [int(p) for p in self._tables[s, hp:]
+                                   if p > 0]
+                if hp and self._tables[s, 0] > 0:
+                    self._pool.free(self._head_pages)
+                self._tables[s, :] = 0
+                if obs.enabled():
+                    obs.set_gauge("serving_kv_pages_in_use",
+                                  self._pool.pages_in_use)
             obs.inc("serving_poisoned_total")
             obs.event("serving.poisoned", rid=repr(sl.request_id), slot=s)
             self._deadlines.pop(sl.request_id, None)
@@ -561,16 +962,36 @@ class ContinuousBatcher:
             self._obs_finish(rids)
 
     def scrub(self):
-        """Zero the cache rows of quarantined slots and return them to
-        rotation (one dispatch).  The scheduler calls this itself when
-        admissions starve with every usable slot quarantined; callers can
-        also scrub eagerly between workloads."""
+        """Zero the cache state of quarantined slots and return them to
+        rotation (one dispatch).  Contiguous: the slots' cache rows.
+        Paged: the held PRIVATE pages — zeroed on device, then returned to
+        the pool (a reallocated page's stale NaN would otherwise leak
+        through the value einsum as 0 * NaN).  The scheduler calls this
+        itself when admissions starve with every usable slot quarantined;
+        callers can also scrub eagerly between workloads."""
         if not self._quarantined:
             return
-        ix = jnp.asarray(sorted(self._quarantined), jnp.int32)
-        self.cache = jax.tree.map(
-            lambda big: big.at[ix].set(jnp.zeros((), big.dtype)), self.cache
-        )
+        if self._paged:
+            pages = sorted(p for ps in self._qpages.values() for p in ps)
+            if pages:
+                ix = jnp.asarray(pages, jnp.int32)
+                self.cache = jax.tree.map(
+                    lambda big: big.at[ix].set(jnp.zeros((), big.dtype)),
+                    self.cache,
+                )
+                for ps in self._qpages.values():
+                    if ps:
+                        self._pool.free(ps)
+            self._qpages.clear()
+            if obs.enabled():
+                obs.set_gauge("serving_kv_pages_in_use",
+                              self._pool.pages_in_use)
+        else:
+            ix = jnp.asarray(sorted(self._quarantined), jnp.int32)
+            self.cache = jax.tree.map(
+                lambda big: big.at[ix].set(jnp.zeros((), big.dtype)),
+                self.cache,
+            )
         obs.inc("serving_slots_scrubbed_total", len(self._quarantined))
         self._quarantined.clear()
 
@@ -604,6 +1025,9 @@ class ContinuousBatcher:
             budgets = [int(max_new_tokens)] * len(requests)
         else:
             budgets = [int(b) for b in max_new_tokens]
+        # ctor-level prefix_tokens: prompts carry the shared prefix and
+        # are stripped to the part that actually prefills
+        requests = [self._strip_prefix(r) for r in requests]
         # validate EVERYTHING before mutating any slot state: a mid-stream
         # raise would otherwise leave earlier admissions decoding, and a
         # reused batcher would hand their stale outputs to the next run's
@@ -613,6 +1037,7 @@ class ContinuousBatcher:
             prefix_len=self.prefix_len, decode_chunk=self.decode_chunk,
             ctx_size=self.config.ctx_size,
         )
+        self._check_pool_capacity(budgets)
         if deadline_s is None:
             deadlines = {}
         elif isinstance(deadline_s, (int, float, np.floating, np.integer)):
@@ -773,19 +1198,22 @@ class ContinuousBatcher:
         K = self.decode_chunk
         # dispatch-boundary span, unfenced: budget mode streams chunks
         # back-to-back and a block here would serialise the pipeline
+        args = (self.params, self.cache, self.tokens, self.pos, self.pad)
+        if self._paged:
+            # the block tables are host numpy and the allocator mutates
+            # them in place; jnp.asarray on CPU aliases the numpy buffer
+            # zero-copy, so an in-flight async chunk would read tables the
+            # host has already rewritten — ship an owned copy per chunk
+            args = args + (jnp.asarray(self._tables.copy()),)
         with obs.span("serving.decode", chunk=K):
             with obs.step_annotation("serving.decode",
                                      self.stats["decode_steps"] // K):
                 if check:
                     (self.cache, toks, self.pos, self.tokens,
-                     ok) = self._decode(
-                        self.params, self.cache, self.tokens, self.pos,
-                        self.pad, nr=K, check=True,
-                    )
+                     ok) = self._decode(*args, nr=K, check=True)
                 else:
                     self.cache, toks, self.pos, self.tokens = self._decode(
-                        self.params, self.cache, self.tokens, self.pos,
-                        self.pad, nr=K,
+                        *args, nr=K,
                     )
         self.stats["decode_steps"] += K
         self.stats["slot_steps"] += self.max_batch * K
@@ -800,8 +1228,18 @@ class ContinuousBatcher:
         free = [s for s, sl in enumerate(self.slots)
                 if sl.free and s not in self._quarantined]
         group = []
+        avail = self._pool.free_pages if self._paged else 0
         while pending and free:
-            rid, prompt, budget = pending.pop(0)
+            rid, prompt, budget = pending[0]
+            if self._paged:
+                need = self._pages_needed(budget)
+                if need > avail:
+                    # head-of-line blocking ON PURPOSE: skipping ahead to
+                    # a smaller request would make the admission order
+                    # (and so the whole trajectory) depend on pool timing
+                    break
+                avail -= need
+            pending.pop(0)
             group.append((free.pop(0), rid, prompt, budget))
         return group
 
@@ -868,17 +1306,31 @@ class ContinuousBatcher:
             est = self._chunk_s if self._chunk_s > 0 else 0.05
             retry_after = max(0.01, est * (1 + len(self._queue)
                                            / self.max_batch))
-            obs.inc("serving_rejected_total")
-            raise AdmissionRejected(
+            self._reject(
+                "queue_full",
                 f"queue full ({len(self._queue)}/{self.max_queue}); "
                 f"retry in ~{retry_after:.3f}s", retry_after,
             )
         budget = int(max_new_tokens)
+        prompt = self._strip_prefix(prompt)
         _validate_workload(
             [prompt], [budget], prefill_width=self.prefill_width,
             prefix_len=self.prefix_len, decode_chunk=self.decode_chunk,
             ctx_size=self.config.ctx_size,
         )
+        self._check_pool_capacity([budget], label=f"request {rid!r}")
+        if self.slo_deadline_s is not None and budget > 0:
+            obs.set_gauge("serving_slo_deadline_s", self.slo_deadline_s)
+            wait, bound = self._admission_wait_estimate(budget)
+            if wait > self.slo_deadline_s:
+                retry_after = max(0.01, wait - self.slo_deadline_s)
+                self._reject(
+                    bound,
+                    f"request {rid!r} would miss the "
+                    f"{self.slo_deadline_s}s admission SLO (estimated "
+                    f"wait ~{wait:.3f}s, bound by {bound}); retry in "
+                    f"~{retry_after:.3f}s", retry_after,
+                )
         if obs.enabled():
             self._req_ts[rid] = time.perf_counter()
         if deadline_s is not None:
@@ -886,6 +1338,8 @@ class ContinuousBatcher:
         if budget == 0:
             self._instant[rid] = []
             return
+        if self._prefix_tokens is not None:
+            self._hit_rids.add(rid)
         self._queue.append((rid, list(prompt), budget))
 
     def step(self) -> dict:
@@ -899,6 +1353,18 @@ class ContinuousBatcher:
         finished: dict = dict(self._instant)
         self._instant.clear()
         self._obs_finish(list(finished))  # zero-budget instants
+        if self._deadlines or self._hit_rids:
+            # SLO-driven admission order: tightest deadline slack first
+            # (the clock starts at admission, so a request's slack IS its
+            # deadline budget), prefix hits before misses at equal slack
+            # (they skip prefill work — cheaper to start).  The sort is
+            # stable, so with neither signal set this is plain FIFO and
+            # the pre-SLO trajectories are unchanged.
+            inf = float("inf")
+            self._queue.sort(key=lambda q: (
+                self._deadlines.get(q[0], inf),
+                0 if q[0] in self._hit_rids else 1,
+            ))
         group = self._admit_from(self._queue)
         if group:
             self._sync_admit_bookkeep(group, self._admit_group(group))

@@ -76,24 +76,33 @@ class Counter:
 
 
 class Gauge:
-    """Last-value-wins instrument (``set``); ``add`` for deltas."""
+    """Last-value-wins instrument (``set``); ``add`` for deltas.
+
+    ``max`` rides along in the snapshot: a sampled gauge (pool residency,
+    queue depth) read at the END of a run has usually drained back to
+    zero — the peak is the number capacity questions need."""
 
     kind = "gauge"
-    __slots__ = ("name", "labels", "value")
+    __slots__ = ("name", "labels", "value", "max")
 
     def __init__(self, name: str, labels: dict):
         self.name = name
         self.labels = labels
         self.value = 0.0
+        self.max = 0.0
 
     def set(self, v: float):
         self.value = v
+        if v > self.max:
+            self.max = v
 
     def add(self, v: float):
         self.value += v
+        if self.value > self.max:
+            self.max = self.value
 
     def snapshot(self):
-        return {"value": self.value}
+        return {"value": self.value, "max": self.max}
 
 
 class Histogram:

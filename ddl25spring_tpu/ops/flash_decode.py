@@ -153,9 +153,22 @@ def _kernel_int8(pos_ref, pad_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
         o_ref[0] = (acc[...] / l_scr[...]).astype(o_ref.dtype)
 
 
+def _paged_kernel(kernel):
+    """Adapter for the paged layout: the block table rides as a THIRD
+    scalar-prefetch argument consumed entirely by the BlockSpec index maps
+    (it picks which physical page each grid step DMAs) — the kernel body
+    never sees it, so the float and int8 attention math stay the single
+    shared copy above."""
+
+    def wrapped(pos_ref, pad_ref, tbl_ref, *rest):
+        return kernel(pos_ref, pad_ref, *rest)
+
+    return wrapped
+
+
 def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
                            cache_k_scale=None, cache_v_scale=None,
-                           prefix_len: int = 0,
+                           prefix_len: int = 0, block_tables=None,
                            interpret: bool | None = None):
     """One decode step against the cache, reading only live blocks.
 
@@ -177,6 +190,19 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     hold REAL KV and the ragged garbage window shifts to ``[prefix_len,
     prefix_len + pad)`` — the mask follows; 0 (no prefix) compiles the
     exact pre-existing program.
+
+    ``block_tables`` ((B, nr_logical_pages) int32) switches the cache to
+    the PAGED layout (models/kv_pool.py): ``cache_k``/``cache_v`` are then
+    physical pools (nr_pages, kv_page, Hkv, hd) and row b's logical block
+    j lives at page ``block_tables[b, j]``.  The kernel grid, masks, and
+    math are UNCHANGED — ``block_k`` is pinned to ``kv_page`` and the K/V
+    index maps look the physical page up through the table (one extra
+    scalar-prefetch argument), so the live-block DMA clamp works exactly
+    as before: steps past ``pos // kv_page`` repeat the last live page's
+    index and skip the DMA.  Bit-identity with the contiguous kernel
+    holds when ``kv_page`` equals the block size the contiguous call
+    would pick (same online-softmax block sequence); other page sizes
+    reduce in a different block order — same result to float tolerance.
     """
     from .flash_attention import _resolve_interpret
 
@@ -185,15 +211,25 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     if int8 != (cache_v_scale is not None):
         raise ValueError("pass both cache scales or neither")
     B, Hq, hd = q.shape
-    _, S, Hkv, _ = cache_k.shape
+    paged = block_tables is not None
+    _, kv1, Hkv, _ = cache_k.shape
     g = Hq // Hkv
-    block_k = _pick_block(S)
-    # all Hkv heads ride in one K/V block (Mosaic tiling, see _kernel);
-    # keep the chunk within a ~1 MiB VMEM budget so double-buffering fits
-    itemsize = jnp.dtype(cache_k.dtype).itemsize
-    while block_k > 128 and block_k * Hkv * hd * itemsize > (1 << 20):
-        block_k = _pick_block(S, target=block_k // 2)
-    nr_k = S // block_k
+    if paged:
+        # one K/V page per grid step: block_k IS the page size, the table
+        # width IS the logical block count
+        block_k = kv1
+        nr_k = block_tables.shape[1]
+        S = nr_k * block_k
+    else:
+        S = kv1
+        block_k = _pick_block(S)
+        # all Hkv heads ride in one K/V block (Mosaic tiling, see _kernel);
+        # keep the chunk within a ~1 MiB VMEM budget so double-buffering
+        # fits
+        itemsize = jnp.dtype(cache_k.dtype).itemsize
+        while block_k > 128 and block_k * Hkv * hd * itemsize > (1 << 20):
+            block_k = _pick_block(S, target=block_k // 2)
+        nr_k = S // block_k
     scale = 1.0 / (hd ** 0.5)
     if pad is None:
         pad = jnp.zeros((B,), jnp.int32)
@@ -213,15 +249,27 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
         # index -> the pipeline skips the DMA
         return jnp.minimum(j, pos_v[b] // block_k)
 
-    kv_spec = pl.BlockSpec((1, block_k, Hkv, hd),
-                           lambda b, j, pos_v, pad_v:
-                           (b, live(b, j, pos_v), 0, 0))
-    scale_spec = pl.BlockSpec((1, block_k, Hkv),
-                              lambda b, j, pos_v, pad_v:
-                              (b, live(b, j, pos_v), 0))
+    if paged:
+        # physical page from the block table; the live clamp happens on the
+        # LOGICAL index first, so dead trailing steps repeat the last live
+        # PHYSICAL page and the DMA skip works exactly as contiguous
+        kv_spec = pl.BlockSpec((1, block_k, Hkv, hd),
+                               lambda b, j, pos_v, pad_v, tbl:
+                               (tbl[b, live(b, j, pos_v)], 0, 0, 0))
+        scale_spec = pl.BlockSpec((1, block_k, Hkv),
+                                  lambda b, j, pos_v, pad_v, tbl:
+                                  (tbl[b, live(b, j, pos_v)], 0, 0))
+        q_map = lambda b, j, pos_v, pad_v, tbl: (b, 0, 0, 0)
+    else:
+        kv_spec = pl.BlockSpec((1, block_k, Hkv, hd),
+                               lambda b, j, pos_v, pad_v:
+                               (b, live(b, j, pos_v), 0, 0))
+        scale_spec = pl.BlockSpec((1, block_k, Hkv),
+                                  lambda b, j, pos_v, pad_v:
+                                  (b, live(b, j, pos_v), 0))
+        q_map = lambda b, j, pos_v, pad_v: (b, 0, 0, 0)
     in_specs = [
-        pl.BlockSpec((1, Hkv, g_pad, hd),
-                     lambda b, j, pos_v, pad_v: (b, 0, 0, 0)),
+        pl.BlockSpec((1, Hkv, g_pad, hd), q_map),
     ]
     operands = [qg]
     if int8:
@@ -232,13 +280,21 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
         in_specs += [kv_spec, kv_spec]
         operands += [cache_k, cache_v]
         kernel = _kernel
+    kernel = functools.partial(kernel, block_k=block_k, scale=scale,
+                               nr_k=nr_k, nr_kv_heads=Hkv,
+                               prefix_len=int(prefix_len))
+    prefetch = [pos, jnp.asarray(pad, jnp.int32)]
+    if paged:
+        # the table is index-map-only state: _paged_kernel drops its ref so
+        # the kernel bodies above stay layout-agnostic
+        kernel = _paged_kernel(kernel)
+        prefetch.append(jnp.asarray(block_tables, jnp.int32))
     # index maps receive (*grid_indices, *scalar_prefetch_refs)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(prefetch),
         grid=(B, nr_k),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, Hkv, g_pad, hd),
-                               lambda b, j, pos_v, pad_v: (b, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, Hkv, g_pad, hd), q_map),
         scratch_shapes=[
             pltpu.VMEM((Hkv, g_pad, 1), jnp.float32),
             pltpu.VMEM((Hkv, g_pad, 1), jnp.float32),
@@ -246,10 +302,9 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(kernel, block_k=block_k, scale=scale, nr_k=nr_k,
-                          nr_kv_heads=Hkv, prefix_len=int(prefix_len)),
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, g_pad, hd), q.dtype),
         interpret=interpret,
-    )(pos, jnp.asarray(pad, jnp.int32), *operands)
+    )(*prefetch, *operands)
     return out[:, :, :g].reshape(B, Hq, hd)

@@ -13,8 +13,22 @@ this bench makes it measurable on one chip:
 - reports wall seconds, tokens/sec, and the batcher's own occupancy
   telemetry (active_steps / slot_steps).
 
+``--sweep`` replaces the contender race with a SATURATION sweep: the
+closed-loop load generator (models/loadgen.py) replays a seeded
+heavy-tailed arrival trace at increasing offered QPS through the real
+streaming batcher and emits one JSON curve — per-point goodput, p50/p99
+latency, queue wait, reject/evict rates and peak KV-page residency,
+with the detected knee (last offered rate still served at >=90% of
+offered) as the headline.  Points are auto-placed around a measured
+peak-goodput probe unless ``--sweep-qps`` pins them.
+
+Every compiled program is built once and reused across reps and sweep
+points (the batcher's program cache is keyed on shapes, not instances).
+If the device dies mid-run, the partial capture lands in
+``results/bench_partial_capture.json`` like bench.py's.
+
 Run: python examples/bench_serving.py [--batch 4] [--requests 16]
-         [--dmodel 288] [--cpu]
+         [--dmodel 288] [--cpu] [--sweep] [--kv-layout paged]
 """
 
 from __future__ import annotations
@@ -26,6 +40,34 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_T0 = time.perf_counter()
+
+
+def _persist_partial_capture(reason: str, telemetry, **extra):
+    """Mirror bench.py's dead-device contract: write what the failed run
+    DID learn next to the other bench artifacts; returns the path, or
+    None when even that write fails."""
+    out_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "results")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "bench_partial_capture.json")
+        payload = {
+            "error": reason,
+            "elapsed_s": round(time.perf_counter() - _T0, 1),
+            "argv": sys.argv[1:],
+            "telemetry": telemetry or None,
+            "probe_events": [],
+            **extra,
+        }
+        with open(path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+        return path
+    except OSError:
+        return None
 
 
 def main() -> int:
@@ -47,6 +89,31 @@ def main() -> int:
                          "reported (single shots over the shared tunnel "
                          "vary 10-25%%, round-5 bench.py finding)")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--kv-layout", choices=("contiguous", "paged"),
+                    default="contiguous",
+                    help="KV residency for the continuous batcher and "
+                         "the sweep (paged = block-table pool)")
+    ap.add_argument("--kv-page", type=int, default=16,
+                    help="tokens per KV page when --kv-layout paged")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the closed-loop saturation sweep instead "
+                         "of the contender race; emits one JSON curve "
+                         "with the detected knee")
+    ap.add_argument("--sweep-qps", default=None,
+                    help="comma-separated offered-QPS points; default "
+                         "places 6 points around a measured peak-"
+                         "goodput probe")
+    ap.add_argument("--sweep-requests", type=int, default=32,
+                    help="requests replayed per sweep point")
+    ap.add_argument("--arrival-dist", choices=("lognormal", "pareto"),
+                    default="lognormal")
+    ap.add_argument("--arrival-seed", type=int, default=0)
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="bound the batcher's waiting queue (rejects "
+                         "surface in the sweep's reject rate)")
+    ap.add_argument("--slo", type=float, default=None,
+                    help="admission SLO seconds (slo_deadline_s); "
+                         "estimated-wait violations reject at submit")
     ap.add_argument("--telemetry", metavar="PATH", default=None,
                     help="enable ddl25spring_tpu.obs telemetry and stream "
                          "events (spans, request latency, tokens/sec, "
@@ -65,6 +132,7 @@ def main() -> int:
     import numpy as np
 
     from ddl25spring_tpu import obs
+    from ddl25spring_tpu.models import loadgen
     from ddl25spring_tpu.models.generate import generate
     from ddl25spring_tpu.models.llama import Llama, LlamaConfig
     from ddl25spring_tpu.models.serving import (ContinuousBatcher,
@@ -75,10 +143,12 @@ def main() -> int:
         os.makedirs(os.path.dirname(args.telemetry) or ".", exist_ok=True)
         obs.enable(args.telemetry)
 
+    ctx = args.prefill_width + args.max_new + args.decode_chunk
+    if args.kv_layout == "paged":
+        ctx = -(-ctx // args.kv_page) * args.kv_page  # page-aligned
     cfg = LlamaConfig(
         vocab_size=args.vocab, dmodel=args.dmodel, nr_heads=args.heads,
-        nr_layers=args.layers,
-        ctx_size=args.prefill_width + args.max_new + args.decode_chunk,
+        nr_layers=args.layers, ctx_size=ctx,
         dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
         else jnp.float32,
     )
@@ -92,9 +162,91 @@ def main() -> int:
         jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32),
         positions=jnp.arange(4),
     )
+    kv_kwargs = ({"kv_layout": "paged", "kv_page": args.kv_page}
+                 if args.kv_layout == "paged" else {})
     print(f"backend={jax.default_backend()} d={args.dmodel} "
           f"B={args.batch} requests={args.requests} "
-          f"new=[{args.min_new},{args.max_new}]", flush=True)
+          f"new=[{args.min_new},{args.max_new}] kv={args.kv_layout}",
+          flush=True)
+
+    try:
+        if args.sweep:
+            return _run_sweep(args, cfg, params, kv_kwargs, loadgen,
+                              ContinuousBatcher, jax, obs)
+        return _run_contenders(args, cfg, params, kv_kwargs, prompts,
+                               budgets, generate, ContinuousBatcher,
+                               serve_fused, serve_fused_speculative,
+                               Llama, LlamaConfig, jax, jnp, obs)
+    except Exception as e:  # device death lands the partial capture
+        obs.flush()
+        path = _persist_partial_capture(
+            f"{type(e).__name__}: {e}", args.telemetry,
+            mode="sweep" if args.sweep else "contenders")
+        if path:
+            print(f"partial capture -> {path}", file=sys.stderr,
+                  flush=True)
+        raise
+
+
+def _run_sweep(args, cfg, params, kv_kwargs, loadgen,
+               ContinuousBatcher, jax, obs) -> int:
+    import numpy as np
+
+    budget = (args.min_new + args.max_new) // 2
+
+    def make_batcher():
+        return ContinuousBatcher(
+            cfg, params, max_batch=args.batch,
+            prefill_width=args.prefill_width,
+            decode_chunk=args.decode_chunk, max_queue=args.max_queue,
+            slo_deadline_s=args.slo, **kv_kwargs)
+
+    def prompt_fn(i, prng):
+        n = int(prng.integers(4, args.prefill_width))
+        return prng.integers(1, args.vocab, size=n).tolist()
+
+    nr = args.sweep_requests
+    if args.sweep_qps:
+        qps_points = [float(q) for q in args.sweep_qps.split(",")]
+        warmup = True
+    else:
+        # probe peak goodput with an effectively-instantaneous trace,
+        # then straddle it: three points below the knee, three at/past
+        prng = np.random.default_rng(args.arrival_seed)
+        probe_prompts = [prompt_fn(i, prng) for i in range(nr)]
+        loadgen.warm(make_batcher, probe_prompts, [budget] * nr)
+        probe = loadgen.replay(
+            make_batcher(),
+            loadgen.arrival_trace(nr, 1e4, args.arrival_dist,
+                                  args.arrival_seed),
+            probe_prompts, [budget] * nr)
+        peak = max(probe["goodput_rps"], 1e-3)
+        qps_points = [round(peak * f, 4)
+                      for f in (0.3, 0.55, 0.8, 1.0, 1.25, 1.6)]
+        warmup = False
+    sweep = loadgen.saturation_sweep(
+        make_batcher, qps_points, nr, prompt_fn, budget,
+        dist=args.arrival_dist, seed=args.arrival_seed,
+        warmup=warmup)
+    if args.telemetry:
+        obs.flush()
+    print(json.dumps({
+        "metric": "serving_saturation_sweep",
+        "backend": jax.default_backend(),
+        "batch": args.batch, "kv_layout": args.kv_layout,
+        "kv_page": args.kv_page if kv_kwargs else None,
+        "budget": budget, "max_queue": args.max_queue,
+        "slo_s": args.slo, **sweep,
+    }), flush=True)
+    return 0
+
+
+def _run_contenders(args, cfg, params, kv_kwargs, prompts, budgets,
+                    generate, ContinuousBatcher, serve_fused,
+                    serve_fused_speculative, Llama, LlamaConfig, jax,
+                    jnp, obs) -> int:
+    import numpy as np  # noqa: F401  (kept local like the other deps)
+    import statistics
 
     # --- static: fixed batches, everyone decodes to the bucket max -------
     # (the standard fixed-batch regime: a batch runs until its LONGEST
@@ -119,8 +271,6 @@ def main() -> int:
             done += sum(int(budgets[i]) for i in chunk)
         return done
 
-    import statistics
-
     def timed_median(fn):
         """Median wall seconds over --reps runs (fn already ran once for
         compile warmup) — single shots over the shared tunnel vary
@@ -139,10 +289,14 @@ def main() -> int:
     static_s, _ = timed_median(run_static)
 
     # --- continuous ------------------------------------------------------
+    # ONE batcher serves every rep: the programs compile once and the
+    # queue/slots drain between runs, so reps measure serving, not setup
+    batcher = ContinuousBatcher(cfg, params, max_batch=args.batch,
+                                prefill_width=args.prefill_width,
+                                decode_chunk=args.decode_chunk,
+                                **kv_kwargs)
+
     def run_continuous():
-        batcher = ContinuousBatcher(cfg, params, max_batch=args.batch,
-                                    prefill_width=args.prefill_width,
-                                    decode_chunk=args.decode_chunk)
         served = batcher.run(prompts, [int(b) for b in budgets])
         assert all(len(o) == b for o, b in zip(served, budgets))
         return batcher
@@ -202,6 +356,7 @@ def main() -> int:
         "metric": "serving_throughput",
         "backend": jax.default_backend(),
         "requests": args.requests, "batch": args.batch,
+        "kv_layout": args.kv_layout,
         "static_s": round(static_s, 3),
         "static_tok_s": round(toks / static_s, 1),
         "continuous_s": round(cont_s, 3),

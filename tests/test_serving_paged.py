@@ -1,0 +1,364 @@
+"""Paged KV-pool serving oracle: paged layout == contiguous, bit for bit.
+
+The paged pool (models/kv_pool.py) re-carves the batcher's KV cache into
+fixed-size physical pages indexed through per-slot block tables.  The
+logical values the attention math sees are identical, so every
+trajectory the contiguous batcher produces — staggered admissions, EOS,
+chunked decode, per-request budgets, deadline evictions, poison
+quarantine, fault-plan stalls — must come back BIT-identical under
+``kv_layout="paged"``, while the pool's accounting invariants (no leaked
+pages after drain, double-free raises, refcounted prefix sharing) hold
+on the host side.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.models import kv_pool, loadgen
+from ddl25spring_tpu.models.generate import precompute_prefix
+from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+from ddl25spring_tpu.models.serving import (AdmissionRejected,
+                                            ContinuousBatcher)
+
+CFG = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                  nr_layers=2, ctx_size=48)
+PAGED = {"kv_layout": "paged", "kv_page": 8}
+
+
+@pytest.fixture(scope="module")
+def setup():
+    prompt = jnp.ones((1, 4), jnp.int32)
+    return Llama(CFG).init(
+        jax.random.PRNGKey(0), prompt, positions=jnp.arange(4)
+    )
+
+
+def _prompts(seed=3, sizes=(3, 7, 4, 8, 5)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 97, size=n).tolist() for n in sizes]
+
+
+def _pair(params, **kwargs):
+    contiguous = ContinuousBatcher(CFG, params, max_batch=2,
+                                   prefill_width=8, **kwargs)
+    paged = ContinuousBatcher(CFG, params, max_batch=2, prefill_width=8,
+                              **PAGED, **kwargs)
+    return contiguous, paged
+
+
+def _streams(served):
+    return [(list(s), getattr(s, "status", "ok")) for s in served]
+
+
+# -- pool accounting invariants (host-side, no model) ----------------------
+
+
+def test_pool_alloc_free_invariants():
+    pool = kv_pool.KVPagePool(6)  # pages 1..5 usable, 0 reserved
+    assert pool.free_pages == 5 and pool.pages_in_use == 0
+    a = pool.alloc(3)
+    assert sorted(a) == [1, 2, 3] and pool.pages_in_use == 3
+    assert pool.alloc(3) is None          # all-or-nothing: only 2 free
+    assert pool.pages_in_use == 3         # failed alloc changed nothing
+    pool.free(a)
+    assert pool.free_pages == 5
+    with pytest.raises(ValueError):
+        pool.free([a[0]])                 # double free
+    with pytest.raises(ValueError):
+        pool.free([0])                    # the null page is never freed
+    with pytest.raises(ValueError):
+        pool.share([a[0]])                # sharing a freed page
+    b = pool.alloc(2)
+    pool.share(b)
+    pool.free(b)                          # drops to rc=1, still resident
+    assert pool.pages_in_use == 2
+    pool.free(b)
+    assert pool.pages_in_use == 0
+    with pytest.raises(ValueError):
+        kv_pool.KVPagePool(1)             # nothing but the null page
+
+
+def test_pages_needed_formula():
+    # prompt window + budget + chunk overrun, less the whole prefix pages
+    assert kv_pool.pages_needed(8, 6, 8) == 2
+    assert kv_pool.pages_needed(8, 0, 8) == 1    # zero budget: no overrun
+    assert kv_pool.pages_needed(8, 6, 8, decode_chunk=4) == 3
+    assert kv_pool.pages_needed(8, 6, 8, prefix_len=10) == 2
+
+
+def test_prefix_registry_refcount_lifecycle():
+    pool = kv_pool.KVPagePool(8)
+    reg = kv_pool.PrefixRegistry(pool)
+    pages = pool.alloc(2)
+    reg.put((1, 2, 3), pages)
+    with pytest.raises(ValueError):
+        reg.put((1, 2, 3), pages)                 # duplicate key
+    assert reg.acquire((9, 9)) is None            # miss
+    got = reg.acquire((1, 2, 3))
+    assert got == pages and pool.refcount(pages[0]) == 2
+    assert reg.lookup((1, 2, 3)).hits == 1
+    pool.free(got)                                # occupant departs
+    assert pool.refcount(pages[0]) == 1           # registry still holds
+    reg.drop((1, 2, 3))
+    assert pool.pages_in_use == 0 and len(reg) == 0
+
+
+# -- bit-identity against the contiguous layout ----------------------------
+
+
+def test_paged_matches_contiguous_staggered(setup):
+    contiguous, paged = _pair(setup)
+    prompts = _prompts()
+    want = contiguous.run(prompts, 6)
+    got = paged.run(prompts, 6)
+    assert _streams(got) == _streams(want)
+    assert paged.stats["admitted"] == 5
+    # resident KV tracked live tokens: everything drained back
+    assert paged._pool.pages_in_use == 0
+
+
+def test_paged_matches_contiguous_eos_chunked(setup):
+    contiguous, paged = _pair(setup, eos_id=5, decode_chunk=4)
+    prompts = _prompts()
+    budgets = [9, 4, 7, 6, 8]
+    assert _streams(paged.run(prompts, budgets)) == \
+        _streams(contiguous.run(prompts, budgets))
+    assert paged._pool.pages_in_use == 0
+
+
+def test_paged_int8_cache_matches(setup):
+    cfg8 = dataclasses.replace(CFG, kv_cache_int8=True)
+    prompts = _prompts()
+    want = ContinuousBatcher(cfg8, setup, max_batch=2,
+                             prefill_width=8).run(prompts, 5)
+    got = ContinuousBatcher(cfg8, setup, max_batch=2, prefill_width=8,
+                            **PAGED).run(prompts, 5)
+    assert _streams(got) == _streams(want)
+
+
+def test_paged_deadline_eviction_matches(setup):
+    contiguous, paged = _pair(setup)
+    prompts = _prompts()
+    want = contiguous.run(prompts, 6, deadline_s=1e-9)
+    got = paged.run(prompts, 6, deadline_s=1e-9)
+    assert _streams(got) == _streams(want)
+    assert all(s == "timed_out" for _, s in _streams(got))
+    # eviction released every page
+    assert paged._pool.pages_in_use == 0
+
+
+def test_paged_fault_plan_matches(setup):
+    from ddl25spring_tpu.resilience import FaultPlan
+
+    prompts = _prompts()
+    want = ContinuousBatcher(
+        CFG, setup, max_batch=2, prefill_width=8,
+        fault_plan=FaultPlan(seed=5, serve_timeout=0.5),
+    ).run(prompts, 6)
+    paged = ContinuousBatcher(
+        CFG, setup, max_batch=2, prefill_width=8, **PAGED,
+        fault_plan=FaultPlan(seed=5, serve_timeout=0.5),
+    )
+    assert _streams(paged.run(prompts, 6)) == _streams(want)
+    assert paged._pool.pages_in_use == 0
+
+
+def test_paged_poison_quarantine_holds_pages_until_scrub(setup):
+    poisoned = jax.tree_util.tree_map_with_path(
+        lambda kp, leaf: leaf.at[0, 0].set(jnp.nan)
+        if "lm_head" in jax.tree_util.keystr(kp) else leaf, setup)
+    prompts = _prompts()
+    # eos mode fences every chunk, so the guard evicts EAGERLY and the
+    # tainted private pages land in quarantine instead of the free list
+    contiguous = ContinuousBatcher(CFG, poisoned, max_batch=2,
+                                   prefill_width=8, poison_guard=True,
+                                   eos_id=96)
+    paged = ContinuousBatcher(CFG, poisoned, max_batch=2,
+                              prefill_width=8, poison_guard=True,
+                              eos_id=96, **PAGED)
+    want = contiguous.run(prompts, 6)
+    got = paged.run(prompts, 6)
+    assert _streams(got) == _streams(want)
+    assert all(s == "poisoned" for _, s in _streams(got))
+    held = sum(len(ps) for ps in paged._qpages.values())
+    assert held > 0 and paged._pool.pages_in_use == held
+    paged.scrub()
+    assert paged._qpages == {} and paged._pool.pages_in_use == 0
+
+
+def test_paged_pool_no_leak_over_rounds(setup):
+    paged = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                              **PAGED)
+    prompts = _prompts()
+    for _ in range(3):
+        out = paged.run(prompts, 5)
+        assert all(len(o) == 5 for o in out)
+        assert paged._pool.pages_in_use == 0
+
+
+def test_paged_tight_pool_head_of_line(setup):
+    # pool sized for ONE slot's worth of pages: requests queue on page
+    # availability, not just slots, and the streams still match
+    contiguous, _ = _pair(setup)
+    prompts = _prompts()
+    want = contiguous.run(prompts, 6)
+    paged = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                              kv_layout="paged", kv_page=8, kv_pages=7)
+    assert _streams(paged.run(prompts, 6)) == _streams(want)
+    assert paged._pool.pages_in_use == 0
+
+
+def test_paged_prefix_tokens_shared_pages(setup):
+    rng = np.random.default_rng(11)
+    pre = [int(t) for t in rng.integers(1, 97, size=10)]
+    tails = [rng.integers(1, 97, size=n).tolist() for n in (3, 5, 4)]
+    # contiguous reference: precomputed prefix cache + tail prompts
+    pc = precompute_prefix(CFG, setup, jnp.asarray(pre, jnp.int32))
+    contiguous = ContinuousBatcher(CFG, setup, max_batch=2,
+                                   prefill_width=8, prefix=pc)
+    want = contiguous.run(tails, 6)
+    # paged takes the prefix TOKENS and maps block-table heads onto the
+    # shared read-only pages; prompts carry the full text
+    paged = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                              prefix_tokens=pre, **PAGED)
+    got = paged.run([pre + t for t in tails], 6)
+    assert _streams(got) == _streams(want)
+    assert paged.stats["prefix_hits"] == 3
+    assert paged.stats["prefix_hit_tokens"] == 3 * len(pre)
+    # after drain only the registry's base reference holds the head page
+    head = paged._head_pages
+    assert head and all(paged._pool.refcount(p) == 1 for p in head)
+    assert paged._pool.pages_in_use == len(head)
+    # a prompt that does not carry the prefix is a workload error
+    with pytest.raises(ValueError, match="prefix"):
+        paged.run([[1, 2, 3]], 4)
+
+
+def test_paged_backpressure_and_reject_reasons(setup):
+    paged = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                              max_queue=2, **PAGED)
+    paged.submit("a", [1, 2, 3], 4)
+    paged.submit("b", [4, 5], 4)     # queue now full
+    with pytest.raises(AdmissionRejected) as ei:
+        paged.submit("c", [6], 4)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_s > 0
+    out = paged.drain()
+    assert set(out) == {"a", "b"}
+    assert paged._pool.pages_in_use == 0
+
+
+def test_slo_admission_rejects_before_queueing(setup):
+    paged = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                              slo_deadline_s=1e-4, **PAGED)
+    paged.submit("a", [1, 2, 3], 4)   # empty queue: zero estimated wait
+    with pytest.raises(AdmissionRejected) as ei:
+        paged.submit("b", [4, 5], 4)  # one ahead: estimate breaks the SLO
+    assert ei.value.reason in ("slo", "kv_pool")
+    assert ei.value.retry_after_s > 0
+    out = paged.drain()
+    assert set(out) == {"a"}
+
+
+# -- flash kernel: paged block-table gather --------------------------------
+
+
+def _xla_decode(q, ck, cv, pos, pad):
+    B, Hq, hd = q.shape
+    _, S, Hkv, _ = ck.shape
+    g = Hq // Hkv
+    qg = q.reshape(B, Hkv, g, hd)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, ck).astype(jnp.float32) * scale
+    valid = (jnp.arange(S)[None, :] <= pos[:, None]) & (
+        jnp.arange(S)[None, :] >= pad[:, None])
+    scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
+    att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgs,bskd->bkgd", att, cv)
+    return out.reshape(B, Hq, hd)
+
+
+def test_flash_decode_paged_matches_contiguous():
+    from ddl25spring_tpu.ops.flash_decode import flash_decode_attention
+
+    B, S, Hq, Hkv, hd, pg = 3, 64, 4, 2, 8, 16
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, Hq, hd))
+    ck = jax.random.normal(ks[1], (B, S, Hkv, hd))
+    cv = jax.random.normal(ks[2], (B, S, Hkv, hd))
+    pad = jnp.asarray([0, 3, 10])
+    pos = jnp.asarray([12, 37, S - 1])
+    # scatter the logical pages into a shuffled physical pool (page 0
+    # reserved): tables[b, j] -> physical page of logical page j
+    nt = S // pg
+    perm = np.random.default_rng(7).permutation(B * nt) + 1
+    tables = jnp.asarray(perm.reshape(B, nt), jnp.int32)
+    pool_k = np.zeros((B * nt + 1, pg, Hkv, hd), np.float32)
+    pool_v = np.zeros((B * nt + 1, pg, Hkv, hd), np.float32)
+    for b in range(B):
+        for j in range(nt):
+            pool_k[perm[b * nt + j]] = np.asarray(
+                ck[b, j * pg:(j + 1) * pg])
+            pool_v[perm[b * nt + j]] = np.asarray(
+                cv[b, j * pg:(j + 1) * pg])
+    got = flash_decode_attention(
+        q, jnp.asarray(pool_k), jnp.asarray(pool_v), pos, pad,
+        block_tables=tables, interpret=True)
+    want = _xla_decode(q, ck, cv, pos, pad)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # and against the contiguous kernel at matching accumulation order:
+    # one page per row makes block_k == S on both sides, so the online
+    # softmax visits values identically and the outputs are bit-equal
+    tables1 = jnp.asarray([[2], [3], [1]], jnp.int32)
+    pool1_k = np.zeros((4, S, Hkv, hd), np.float32)
+    pool1_v = np.zeros((4, S, Hkv, hd), np.float32)
+    for b, p in enumerate([2, 3, 1]):
+        pool1_k[p] = np.asarray(ck[b])
+        pool1_v[p] = np.asarray(cv[b])
+    got1 = flash_decode_attention(
+        q, jnp.asarray(pool1_k), jnp.asarray(pool1_v), pos, pad,
+        block_tables=tables1, interpret=True)
+    want1 = flash_decode_attention(q, ck, cv, pos, pad, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got1), np.asarray(want1))
+
+
+# -- saturation sweep smoke ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sweep_smoke_queue_wait_grows_past_saturation(setup):
+    def make_batcher():
+        return ContinuousBatcher(CFG, setup, max_batch=2,
+                                 prefill_width=8, **PAGED)
+
+    out = loadgen.saturation_sweep(
+        make_batcher, [25.0, 2500.0], 10,
+        lambda i, rng: rng.integers(1, 97,
+                                    size=int(rng.integers(3, 8))).tolist(),
+        5, dist="lognormal", seed=11)
+    assert len(out["points"]) == 2
+    lo, hi = out["points"]
+    assert lo["completed"] == hi["completed"] == 10
+    # past saturation the queue is the buffer: waiting grows
+    assert hi["queue_wait_p99_s"] > lo["queue_wait_p99_s"]
+    for pt in out["points"]:
+        for key in ("offered_qps", "goodput_rps", "latency_p50_s",
+                    "latency_p99_s", "queue_wait_p50_s", "reject_rate",
+                    "evict_rate", "kv_pages_peak"):
+            assert key in pt
+
+
+def test_arrival_trace_seeded_and_mean_one():
+    a = loadgen.arrival_trace(500, 4.0, "pareto", 3)
+    b = loadgen.arrival_trace(500, 4.0, "pareto", 3)
+    np.testing.assert_array_equal(a, b)
+    gaps = np.diff(np.concatenate([[0.0], a]))
+    assert 0.15 < gaps.mean() < 0.40          # ~1/qps with a heavy tail
+    with pytest.raises(ValueError):
+        loadgen.arrival_trace(10, 1.0, "uniform", 0)
+    with pytest.raises(ValueError):
+        loadgen.arrival_trace(10, 1.0, "pareto", 0, alpha=1.0)

@@ -206,6 +206,106 @@ def dist_pass_estimate(cohorts, d: int, device=None) -> tuple:
     return rows, winners_identical
 
 
+def kv_pages_estimate(occupancies, *, max_batch: int = 8, ctx: int = 256,
+                      kv_page: int = 16, device=None) -> list:
+    """AOT resident-KV bytes of the serving decode step: contiguous
+    (max_batch, ctx) cache vs the paged pool (models/kv_pool.py) sized
+    for each occupancy fraction of the contiguous token capacity.
+
+    Both layouts compile the SAME decode apply (models/serving.py's
+    ``_decode_step`` math) and the comparison reads XLA's
+    ``memory_analysis()`` argument bytes, so the drop is a property of
+    the compiled program's resident arguments, not a formula.  Asserts
+    the claim docs/PERFORMANCE.md makes: at 25%% occupancy the KV DATA
+    bytes drop >= 4x (the null page and the int32 block tables are
+    reported separately — they are the constant overhead paged pays),
+    and the compiled argument-byte delta matches the analytic one."""
+    import functools
+
+    from ddl25spring_tpu.models import serving as srv
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+
+    import dataclasses
+
+    cfg = LlamaConfig(vocab_size=128, dmodel=48, nr_heads=4,
+                      nr_kv_heads=2, nr_layers=2, ctx_size=ctx,
+                      decode_impl="xla")
+    # init under the non-decode config (a decode-mode init would bake a
+    # B=1 cache collection into the param avals); decode model separate
+    params = jax.eval_shape(Llama(cfg).init, jax.random.key(0),
+                            jnp.zeros((1, 4), jnp.int32))
+    model = Llama(dataclasses.replace(cfg, decode=True))
+
+    def decode(params, cache, tok, pos, pad, tables=None):
+        logits, state = model.apply(
+            {**params, "cache": cache}, tok[:, None],
+            positions=pos[:, None], pad=pad, prefix_len=0,
+            block_tables=tables, mutable=["cache"],
+        )
+        return jnp.argmax(logits[:, 0], axis=-1), state["cache"]
+
+    B = max_batch
+    tok = jax.ShapeDtypeStruct((B,), jnp.int32)
+    pos = jax.ShapeDtypeStruct((B,), jnp.int32)
+    pad = jax.ShapeDtypeStruct((B,), jnp.int32)
+    cache = jax.eval_shape(
+        functools.partial(srv._empty_cache_of, model, B), params)
+    tree_bytes = lambda t: sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree.leaves(t))
+    jit_kw = {"device": device} if device is not None else {}
+    contig = jax.jit(decode, **jit_kw).lower(
+        params, cache, tok, pos, pad).compile()
+    contig_args = int(getattr(contig.memory_analysis(),
+                              "argument_size_in_bytes", 0))
+    contig_kv = tree_bytes(cache)
+
+    rows = []
+    for occ in occupancies:
+        data_pages = max(1, int(round(occ * B * ctx / kv_page)))
+        nr_pages = data_pages + 1  # + the reserved null page
+        pool = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(
+                (nr_pages, kv_page) + a.shape[2:], a.dtype), cache)
+        tables = jax.ShapeDtypeStruct((B, ctx // kv_page), jnp.int32)
+        paged = jax.jit(decode, **jit_kw).lower(
+            params, pool, tok, pos, pad, tables).compile()
+        paged_args = int(getattr(paged.memory_analysis(),
+                                 "argument_size_in_bytes", 0))
+        pool_kv = tree_bytes(pool)
+        data_kv = pool_kv * data_pages // nr_pages
+        table_b = int(np.prod(tables.shape)) * 4
+        rows.append({
+            "occupancy": occ,
+            "nr_pages": nr_pages,
+            "contig_kv_bytes": contig_kv,
+            "pool_kv_bytes": pool_kv,
+            "pool_data_bytes": data_kv,
+            "table_bytes": table_b,
+            "kv_data_drop": round(contig_kv / data_kv, 3),
+            "kv_total_drop": round(contig_kv / (pool_kv + table_b), 3),
+            "argument_bytes_contiguous": contig_args,
+            "argument_bytes_paged": paged_args,
+        })
+        # the compiled programs must carry exactly the argument bytes
+        # the analytic model says they do — otherwise the drop below is
+        # a formula, not a measurement
+        delta_args = contig_args - paged_args
+        delta_kv = contig_kv - (pool_kv + table_b)
+        assert abs(delta_args - delta_kv) <= max(4096, delta_kv // 50), (
+            f"compiled argument delta {delta_args:,} B at occupancy "
+            f"{occ} diverges from the analytic KV delta {delta_kv:,} B"
+        )
+    by_occ = {r["occupancy"]: r for r in rows}
+    if 0.25 in by_occ:
+        r = by_occ[0.25]
+        assert r["kv_data_drop"] >= 4.0, (
+            f"resident KV data at 25% occupancy dropped only "
+            f"{r['kv_data_drop']}x, expected >= 4x"
+        )
+    return rows
+
+
 def cohort_shard_estimate(nr_clients: int, nr_sampled: int, chunk: int,
                           worlds) -> dict:
     """AOT memory of the cohort-SHARDED round (fl/sharding.py) across
@@ -326,6 +426,20 @@ def main(argv=None) -> int:
                          "across --worlds (virtual CPU devices), plus the "
                          "ZeRO server-optimizer per-replica footprint; "
                          "asserts the ~Wx drops at W=4")
+    ap.add_argument("--kv-pages", action="store_true",
+                    help="estimate the serving decode's resident-KV bytes "
+                         "instead: contiguous (max_batch, ctx) cache vs "
+                         "the paged pool at --kv-occupancy fractions; "
+                         "asserts the >=4x data drop at 25%% occupancy")
+    ap.add_argument("--kv-occupancy", default="1.0,0.5,0.25",
+                    help="comma-separated pool occupancy fractions for "
+                         "--kv-pages")
+    ap.add_argument("--kv-batch", type=int, default=8,
+                    help="serving max_batch for --kv-pages")
+    ap.add_argument("--kv-ctx", type=int, default=256,
+                    help="serving ctx_size for --kv-pages")
+    ap.add_argument("--kv-page", type=int, default=16,
+                    help="tokens per KV page for --kv-pages")
     ap.add_argument("--worlds", default="1,2,4",
                     help="comma-separated shard counts for --cohort-shard")
     ap.add_argument("--chunk", type=int, default=4,
@@ -360,6 +474,28 @@ def main(argv=None) -> int:
             "metric": "cohort_shard_memory_estimate",
             "target": args.target,
             **out,
+        }))
+        return 0
+
+    if args.kv_pages:
+        occupancies = [float(o) for o in args.kv_occupancy.split(",")
+                       if o.strip()]
+        rows = kv_pages_estimate(occupancies, max_batch=args.kv_batch,
+                                 ctx=args.kv_ctx, kv_page=args.kv_page,
+                                 device=device)
+        for r in rows:
+            print(f"  occ={r['occupancy']:<5} pages={r['nr_pages']:>4}: "
+                  f"contig {r['contig_kv_bytes']:>10,} B   "
+                  f"pool {r['pool_kv_bytes']:>10,} B "
+                  f"(+tables {r['table_bytes']:,} B)   "
+                  f"data drop {r['kv_data_drop']}x   "
+                  f"total drop {r['kv_total_drop']}x", file=sys.stderr)
+        print(json.dumps({
+            "metric": "kv_pages_memory_estimate",
+            "target": args.target,
+            "max_batch": args.kv_batch, "ctx_size": args.kv_ctx,
+            "kv_page": args.kv_page,
+            "occupancies": rows,
         }))
         return 0
 

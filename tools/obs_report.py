@@ -382,7 +382,17 @@ def report(events: list[dict], top: int) -> None:
     take(gauges, "serving_tokens_per_sec")
     req_hist = take(hists, "serving_request_seconds")
     wait_hist = take(hists, "serving_queue_wait_seconds")
-    if nr_req is not None or req_hist:
+    slo_s = _value(gauges, "serving_slo_deadline_s")
+    take(gauges, "serving_slo_deadline_s")
+    pfx_hits = _value(counters, "serving_prefix_hits_total")
+    pfx_toks = _value(counters, "serving_prefix_hit_tokens_total")
+    take(counters, "serving_prefix_hits_total")
+    take(counters, "serving_prefix_hit_tokens_total")
+    pages = _pick(gauges, "serving_kv_pages_in_use")
+    take(gauges, "serving_kv_pages_in_use")
+    reject_reasons = take(counters, "serving_reject_reason_total")
+    if (nr_req is not None or req_hist or reject_reasons
+            or pfx_hits is not None or pages):
         section("serving")
         if nr_req is not None:
             print(f"  requests served: {nr_req}   tokens: {nr_tok}"
@@ -398,6 +408,34 @@ def report(events: list[dict], top: int) -> None:
                   f"mean={fmt_seconds(h['sum'] / max(h['count'], 1))} "
                   f"p90={fmt_seconds(hist_quantile(h, 0.90))} "
                   f"max={fmt_seconds(h['max'] or 0)}")
+        # -- SLO block: latency percentiles against the admission
+        #    deadline, prefix-cache work skipped, pool residency, and
+        #    why admissions were turned away
+        if slo_s is not None and req_hist:
+            h = req_hist[0][1]
+            p50 = hist_quantile(h, 0.50)
+            p99 = hist_quantile(h, 0.99)
+            verdict = "within" if p99 <= slo_s else "OVER"
+            print(f"  SLO: deadline {fmt_seconds(slo_s)}   "
+                  f"p50 {fmt_seconds(p50)}   p99 {fmt_seconds(p99)}   "
+                  f"(p99 {verdict} deadline)")
+        if pfx_hits is not None:
+            print(f"  prefix cache: {pfx_hits} admissions on shared "
+                  f"pages"
+                  + (f"   ({pfx_toks} prefill tokens skipped)"
+                     if pfx_toks is not None else ""))
+        if pages:
+            snap = pages[0][1]
+            print(f"  kv pages in use: last {snap['value']:.0f}   "
+                  f"peak {snap.get('max', snap['value']):.0f}")
+        if reject_reasons:
+            parts = "   ".join(
+                f"{labels.get('reason', '?')}={state['value']}"
+                for labels, state in sorted(
+                    reject_reasons,
+                    key=lambda kv: kv[0].get("reason", "")))
+            total = sum(state["value"] for _, state in reject_reasons)
+            print(f"  admission rejects: {parts}   (total {total})")
 
     # -- speculative decoding --------------------------------------------
     proposed = _value(counters, "spec_proposed_total")
