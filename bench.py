@@ -622,6 +622,8 @@ def run_cpu_trend(nr_rounds: int = 2):
     cohort_scaling = _cohort_scaling_cell()
     _stamp("cpu trend: serving saturation cell ...")
     serving_saturation = _serving_saturation_cell()
+    _stamp("cpu trend: fleet routing cell ...")
+    fleet_routing = _fleet_routing_cell()
     print(json.dumps({
         "metric": CPU_TREND_METRIC,
         "value": round(nr_rounds / dt, 4),
@@ -633,6 +635,7 @@ def run_cpu_trend(nr_rounds: int = 2):
         "krum_agg": {"shape": [16, 1 << 16], "ms": round(krum_ms, 3)},
         "cohort_scaling": cohort_scaling,
         "serving_saturation": serving_saturation,
+        "fleet_routing": fleet_routing,
         "wall_s": round(time.perf_counter() - t_start, 1),
     }))
     sys.stdout.flush()
@@ -736,6 +739,75 @@ def _serving_saturation_cell(qps_factors=(0.5, 1.0, 2.0),
             "goodput_rps": round(p["goodput_rps"], 3),
             "queue_wait_p99_s": round(p["queue_wait_p99_s"], 4),
             "kv_pages_peak": p["kv_pages_peak"],
+        } for p in sweep["points"]],
+    }
+
+
+def _fleet_routing_cell(qps_factors=(0.5, 1.0, 2.0),
+                        nr_requests: int = 8):
+    """The serving-saturation workload replayed through a 2-replica
+    ``serving_fleet.FleetRouter`` (prefix-affinity + least-load + SLO-
+    slack placement, bounded re-route on rejection): routed/re-routed
+    counts and the FLEET knee.  Both replicas share one compiled program
+    set, so the cell's extra cost over the single-replica cell is host
+    routing, not compiles — the trend that moves when the router or the
+    fleet replay path regresses."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu.models import loadgen
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+    from ddl25spring_tpu.serving_fleet import FleetRouter
+
+    cfg = LlamaConfig(vocab_size=128, dmodel=48, nr_heads=4,
+                      nr_kv_heads=2, nr_layers=2, ctx_size=48,
+                      dtype=jnp.float32)
+    params = Llama(cfg).init(jax.random.PRNGKey(0),
+                             jnp.ones((1, 4), jnp.int32))
+    budget = 6
+
+    def make_replica():
+        return ContinuousBatcher(cfg, params, max_batch=2,
+                                 prefill_width=8, kv_layout="paged",
+                                 kv_page=8)
+
+    def make_fleet():
+        return FleetRouter([make_replica(), make_replica()])
+
+    def prompt_fn(i, prng):
+        return prng.integers(1, 128,
+                             size=int(prng.integers(3, 8))).tolist()
+
+    prng = np.random.default_rng(0)
+    prompts = [prompt_fn(i, prng) for i in range(nr_requests)]
+    # warm ONE replica: the program cache is shared fleet-wide
+    loadgen.warm(make_replica, prompts, [budget] * nr_requests)
+    probe = loadgen.replay_fleet(
+        make_fleet(),
+        loadgen.arrival_trace(nr_requests, 1e4, "lognormal", 0),
+        prompts, [budget] * nr_requests)
+    peak = max(probe["goodput_rps"], 1e-3)
+    sweep = loadgen.saturation_sweep(
+        make_fleet, [peak * f for f in qps_factors], nr_requests,
+        prompt_fn, budget, dist="lognormal", seed=0, warmup=False,
+        replay_fn=loadgen.replay_fleet)
+    return {
+        "replicas": 2,
+        "probe_goodput_rps": round(peak, 3),
+        "knee_qps": (round(sweep["knee_qps"], 3)
+                     if sweep["knee_qps"] else None),
+        "points": [{
+            "offered_qps": round(p["offered_qps"], 3),
+            "goodput_rps": round(p["goodput_rps"], 3),
+            "queue_wait_p99_s": round(p["queue_wait_p99_s"], 4),
+            "kv_pages_peak": p["kv_pages_peak"],
+            "routed": p["routed"],
+            "rerouted": p["rerouted"],
+            "rerouted_by_reason": p["rerouted_by_reason"],
+            "per_replica_assigned": [r["assigned"]
+                                     for r in p["per_replica"]],
         } for p in sweep["points"]],
     }
 

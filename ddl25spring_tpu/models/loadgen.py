@@ -26,7 +26,8 @@ import time
 
 import numpy as np
 
-__all__ = ["arrival_trace", "replay", "saturation_sweep", "warm"]
+__all__ = ["arrival_trace", "replay", "replay_fleet", "saturation_sweep",
+           "warm"]
 
 
 def arrival_trace(nr: int, qps: float, dist: str = "lognormal",
@@ -169,6 +170,41 @@ def replay(batcher, trace, prompts, budgets, *,
     }
 
 
+def replay_fleet(router, trace, prompts, budgets, *,
+                 deadline_s: float | None = None) -> dict:
+    """Fleet replay mode: :func:`replay` driven through a
+    ``serving_fleet.FleetRouter`` (which exposes the same
+    submit/step/in_flight surface as one batcher), extended with the
+    routing view a fleet point needs — per-replica completion counts and
+    page peaks, requests routed/re-routed, and re-routes by rejection
+    reason.  The base point's ``kv_pages_peak`` is the SUM of per-replica
+    pool peaks (the fleet's resident-KV high-water bound)."""
+    routed0 = router.stats["routed"]
+    rerouted0 = router.stats["rerouted"]
+    by0 = dict(router.stats["rerouted_by_reason"])
+    pt = replay(router, trace, prompts, budgets, deadline_s=deadline_s)
+    assigned = router.assignments()
+    pt["replicas"] = len(router.replicas)
+    pt["routed"] = router.stats["routed"] - routed0
+    pt["rerouted"] = router.stats["rerouted"] - rerouted0
+    pt["rerouted_by_reason"] = {
+        k: v - by0.get(k, 0)
+        for k, v in sorted(router.stats["rerouted_by_reason"].items())
+        if v - by0.get(k, 0)
+    }
+    pt["per_replica"] = [
+        {
+            "assigned": len(assigned.get(i, ())),
+            "queue_len": len(r._queue),
+            "kv_pages_peak": (r._pool.pages_peak
+                              if getattr(r, "_pool", None) is not None
+                              else 0),
+        }
+        for i, r in enumerate(router.replicas)
+    ]
+    return pt
+
+
 def warm(make_batcher, prompts, budgets, *,
          deadline_s: float | None = None) -> None:
     """Compile every program shape a replay can hit, outside the timed
@@ -177,7 +213,11 @@ def warm(make_batcher, prompts, budgets, *,
     alone at low offered rate would then eat the G=1 compile inside a
     measured point.  One batcher replays each power-of-two group size
     up to ``max_batch``; the program cache is keyed on shapes, so every
-    later batcher of the same shape runs warm."""
+    later batcher of the same shape runs warm.  That includes every
+    replica of a fleet: warm ONE replica-shaped batcher and all N
+    replicas behind a ``FleetRouter`` reuse the same compiled set (a
+    router passed here also works — its duck surface matches — but
+    warming one replica is N times cheaper)."""
     wb = make_batcher()
     mb = max(1, int(getattr(wb, "max_batch", 1)))
     g = 1
@@ -191,7 +231,8 @@ def saturation_sweep(make_batcher, qps_points, nr_requests, prompt_fn,
                      budget, *, dist: str = "lognormal", seed: int = 0,
                      deadline_s: float | None = None,
                      knee_frac: float = 0.9,
-                     warmup: bool = True) -> dict:
+                     warmup: bool = True,
+                     replay_fn=None) -> dict:
     """Replay the same seeded trace shape at each offered rate in
     ``qps_points`` (ascending) against a FRESH batcher per point from
     ``make_batcher()`` — program caches inside the batcher make the
@@ -203,6 +244,11 @@ def saturation_sweep(make_batcher, qps_points, nr_requests, prompt_fn,
     points.  The knee is the LAST point whose goodput is at least
     ``knee_frac`` of the offered rate; past it the batcher is saturated
     and queue wait grows with offered load instead of goodput.
+
+    ``replay_fn`` swaps the per-point measurement (default
+    :func:`replay`); pass :func:`replay_fleet` with a ``make_batcher``
+    that builds a ``FleetRouter`` to sweep a fleet — every point then
+    also carries the routing view.
     """
     qps_points = sorted(float(q) for q in qps_points)
     rng = np.random.default_rng(seed)
@@ -210,12 +256,13 @@ def saturation_sweep(make_batcher, qps_points, nr_requests, prompt_fn,
     budgets = [int(budget)] * nr_requests
     if warmup:
         warm(make_batcher, prompts, budgets, deadline_s=deadline_s)
+    measure = replay if replay_fn is None else replay_fn
     points = []
     for qps in qps_points:
         trace = arrival_trace(nr_requests, qps, dist, seed)
         batcher = make_batcher()
-        points.append(replay(batcher, trace, prompts, budgets,
-                             deadline_s=deadline_s))
+        points.append(measure(batcher, trace, prompts, budgets,
+                              deadline_s=deadline_s))
     knee = None
     for pt in points:
         if pt["goodput_rps"] >= knee_frac * pt["offered_qps"]:

@@ -14,8 +14,6 @@ See ``docs/RESILIENCE.md`` for the failure model and recipes.
 """
 
 from .faults import FaultPlan, InjectedCrash
-from .guard import (DivergenceGuard, ValidationGate, screen_nonfinite,
-                    tree_client_isfinite)
 from .retry import Deadline, RetryError, backoff_delays, retry_call
 
 __all__ = [
@@ -33,10 +31,22 @@ __all__ = [
 ]
 
 
+_LAZY = {
+    # guard pulls in jax; autoresume pulls in utils.checkpoint (orbax) —
+    # keep both off the package's import path so host-only users
+    # (faults/retry, the fleet router) never pay for them
+    "DivergenceGuard": "guard",
+    "ValidationGate": "guard",
+    "screen_nonfinite": "guard",
+    "tree_client_isfinite": "guard",
+    "run_with_autoresume": "autoresume",
+}
+
+
 def __getattr__(name):
-    # autoresume pulls in utils.checkpoint (orbax) — keep that import out
-    # of the package's import path so fault/guard users never pay for it
-    if name == "run_with_autoresume":
-        from .autoresume import run_with_autoresume
-        return run_with_autoresume
-    raise AttributeError(name)
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

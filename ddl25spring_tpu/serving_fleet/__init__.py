@@ -1,0 +1,51 @@
+"""Fleet serving: TP-sharded paged decode replicas, disaggregated
+prefill, and a prefix-affinity router over N batcher replicas.
+
+Three layers (docs/PERFORMANCE.md §8):
+
+- ``tp``      — :class:`TPShardedBatcher`: llama decode tensor-parallel
+                over a ``model`` mesh axis (``parallel/tp.py``
+                shardings) with the KV page pool partitioned along KV
+                heads; plus the ``shard_map``-per-shard flash-decode
+                path.
+- ``disagg``  — :class:`DisaggregatedBatcher` / :class:`PrefillWorker`:
+                admit-side prefill off the decode critical path, pages
+                handed over through the shared ``PrefixRegistry``.
+- ``router``  — :class:`FleetRouter`: host-side prefix-affinity +
+                least-load + SLO-slack routing over N replicas, bounded
+                re-route on rejection, autoscaling gauges via ``obs``.
+
+``policy`` and ``router`` are HOST modules and never import jax (so
+routing logic is unit-testable anywhere); importing this package keeps
+that property — the jax-backed layers load lazily on first attribute
+access.
+"""
+
+from __future__ import annotations
+
+from .policy import ReplicaSnapshot, rank_replicas, snapshot_replica
+from .router import FleetRouter
+
+__all__ = [
+    "DisaggregatedBatcher", "FleetRouter", "PrefillWorker",
+    "ReplicaSnapshot", "TPShardedBatcher", "headsharded_flash_decode",
+    "make_model_mesh", "rank_replicas", "snapshot_replica",
+]
+
+_LAZY = {
+    "TPShardedBatcher": "tp",
+    "headsharded_flash_decode": "tp",
+    "make_model_mesh": "tp",
+    "DisaggregatedBatcher": "disagg",
+    "PrefillWorker": "disagg",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,331 @@
+"""Disaggregated prefill: admit-side prefill runs in a dedicated
+worker, decode replicas install finished pages without stalling.
+
+The paged admit program (``serving._paged_programs``) is one fused
+dispatch: vmapped right-aligned prefill + page copies + tokens/pos/pad
+scatter.  Disaggregation splits it at its natural seam:
+
+- **prefill** (worker side, at ``submit`` time, while the request still
+  waits in the queue): the SAME ``_right_aligned_prefill`` math writes
+  the prompt's KV into pool pages the worker allocated, and the pages
+  are handed to the decode side through the shared
+  :class:`~ddl25spring_tpu.models.kv_pool.PrefixRegistry` (the registry
+  holds the base reference until the slot acquires ownership — the same
+  refcount discipline shared system prompts use).
+- **install** (decode side, at admission): a scatter of the staged first
+  tokens / pads into the scheduler vectors.  No prefill work happens on
+  the decode replica's critical path — a long prompt costs the decode
+  loop one ``.at[].set`` dispatch instead of a full forward.
+
+Bit-identity with colocated mode is structural: prefill rows are
+vmapped and row-independent (the group shape cannot change a row's
+math — the same property ``serve_fused`` vs the batcher already
+relies on), the page contents are written by the same
+``dynamic_update_slice`` slices, and decode reads them through the same
+block tables.  Only the PHYSICAL page numbers differ (allocation order
+moves from admission time to submit time); streams never see them.
+
+Staging is bounded by a deadlock guard: the worker never takes prompt
+pages the FIFO head's decode tail will need (staged pages are pinned
+until admission, so unguarded staging could wedge head-of-line
+admission on a small pool).  A request the guard skips simply falls
+back to the colocated fused admit — same tokens, one fused dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+from ..models.llama import Llama
+from ..models.serving import ContinuousBatcher, _right_aligned_prefill
+
+__all__ = ["DisaggregatedBatcher", "PrefillWorker"]
+
+
+@functools.lru_cache(maxsize=8)
+def _prefill_programs(config, prefill_width: int, prefix_len: int,
+                      kv_page: int):
+    """The split admit pair: ``prefill`` (worker) + ``install`` (decode
+    replica).  Cached like ``serving._programs`` — same-shape workers
+    across a fleet share one compiled set."""
+    cfg = dataclasses.replace(config, decode=True)
+    model = Llama(cfg)
+    W = prefill_width
+    P = prefix_len
+    lo = P // kv_page
+
+    @jax.jit
+    def prefill(params, pool, rows, lengths, copy_dst, prefix_cache=None):
+        """The admit program's first half: vmapped prefill of the (G, W)
+        prompt block and the static G x n_copy page copies into the
+        pool (``serving._paged_programs.admit`` minus the scheduler
+        scatter)."""
+        row_caches, firsts, pads = jax.vmap(
+            functools.partial(_right_aligned_prefill, model, W, P),
+            in_axes=(None, 0, 0, None),
+        )(params, rows, lengths, prefix_cache)
+        for g in range(rows.shape[0]):
+            for c in range(copy_dst.shape[1]):
+                start = (lo + c) * kv_page
+                pool = jax.tree.map(
+                    lambda big, rc: jax.lax.dynamic_update_slice(
+                        big,
+                        rc[g][:, start:start + kv_page].astype(big.dtype),
+                        (copy_dst[g, c],) + (0,) * (big.ndim - 1),
+                    ),
+                    pool, row_caches,
+                )
+        return pool, firsts, pads
+
+    @jax.jit
+    def install(tokens, pos, pad, slots, firsts, pads):
+        """The admit program's second half: scheduler-vector scatter
+        (pad lanes repeat a real admission — idempotent)."""
+        return (tokens.at[slots].set(firsts),
+                pos.at[slots].set(P + W),
+                pad.at[slots].set(pads))
+
+    return prefill, install
+
+
+class PrefillWorker:
+    """Admit-side prefill bound to one paged decode replica.
+
+    Shares the replica's pool, registry, params and cache tree — on a
+    disaggregated deployment this is the prefill process's view of the
+    shared KV store; here it is the same host object, which is what
+    makes colocated-vs-disaggregated bit-identity testable.  Handoff
+    keys are ``(-1, seq) + prompt`` — the ``-1`` sentinel keeps them
+    disjoint from real token prefixes in the shared registry (token ids
+    are non-negative), ``seq`` disambiguates duplicate prompts."""
+
+    def __init__(self, batcher):
+        if not getattr(batcher, "_paged", False):
+            raise ValueError(
+                "disaggregated prefill needs kv_layout='paged' (the "
+                "page pool IS the handoff medium)")
+        self.batcher = batcher
+        self._prefill, self._install = _prefill_programs(
+            batcher.config, batcher.prefill_width, batcher.prefix_len,
+            batcher.kv_page)
+        self._staged: dict = {}  # rid -> (key, firsts (1,), pads (1,))
+        self._tails: dict = {}   # rid -> decode-tail pages still needed
+        self._seq = 0
+        self.stats = {"prefilled": 0, "skipped": 0}
+
+    def _key(self, seq: int, prompt) -> tuple:
+        return (-1, seq) + tuple(int(t) for t in prompt)
+
+    def staged(self, rid) -> bool:
+        return rid in self._staged
+
+    def tail_of(self, rid) -> int:
+        return self._tails[rid]
+
+    def stage(self, rid, prompt, budget: int) -> bool:
+        """Prefill ``prompt`` into freshly allocated pool pages and
+        register them for handoff; False when the deadlock guard or an
+        empty pool skips it (the request admits colocated instead)."""
+        b = self.batcher
+        n_copy = b._n_copy
+        tail = b._pages_needed(budget) - n_copy
+        pool = b._pool
+        # the FIFO head's decode tail must stay allocatable after this
+        # staging pins n_copy more pages, else admission wedges
+        worst_tail = max(list(self._tails.values()) + [tail])
+        if pool.free_pages - n_copy < worst_tail:
+            self.stats["skipped"] += 1
+            return False
+        pages = pool.alloc(n_copy)
+        if pages is None:
+            self.stats["skipped"] += 1
+            return False
+        W = b.prefill_width
+        rows = np.zeros((1, W), np.int32)
+        rows[0, :len(prompt)] = prompt
+        lengths = np.asarray([len(prompt)], np.int32)
+        copy_dst = np.asarray([pages], np.int32)
+        with obs.span("serving.prefill_offload", tokens=len(prompt)):
+            b.cache, firsts, pads = self._prefill(
+                b.params, b.cache, jnp.asarray(rows),
+                jnp.asarray(lengths), jnp.asarray(copy_dst),
+                b._prefix_cache)
+        key = self._key(self._seq, prompt)
+        self._seq += 1
+        b._registry.put(key, pages)  # registry takes the base reference
+        self._staged[rid] = (key, firsts, pads)
+        self._tails[rid] = tail
+        self.stats["prefilled"] += 1
+        obs.inc("serving_prefill_offloaded_total")
+        return True
+
+    def collect(self, rid):
+        """Admission-side handoff: ownership of the prefilled pages
+        moves from the registry to the admitting slot (acquire adds the
+        occupant reference, drop releases the registry's base one)."""
+        key, firsts, pads = self._staged.pop(rid)
+        self._tails.pop(rid)
+        b = self.batcher
+        pages = b._registry.acquire(key)
+        b._registry.drop(key)
+        return pages, firsts, pads
+
+
+class DisaggregatedBatcher(ContinuousBatcher):
+    """Paged batcher whose streaming admissions prefill in a
+    :class:`PrefillWorker` at ``submit`` time.
+
+    ``prefill_mode="colocated"`` disables the worker entirely — the
+    exact base batcher, which the bit-identity tests compare against.
+    ``run()`` (workload known up front) always takes the colocated
+    fused path; disaggregation pays off when requests ARRIVE over time
+    and prefill can overlap queue wait.
+    """
+
+    def __init__(self, config, params, *,
+                 prefill_mode: str = "disaggregated", **kwargs):
+        if prefill_mode not in ("disaggregated", "colocated"):
+            raise ValueError(
+                f"prefill_mode must be 'disaggregated' or 'colocated', "
+                f"got {prefill_mode!r}")
+        kwargs.setdefault("kv_layout", "paged")
+        super().__init__(config, params, **kwargs)
+        self.prefill_mode = prefill_mode
+        self.prefill_worker = (PrefillWorker(self)
+                               if prefill_mode == "disaggregated" else None)
+
+    def submit(self, rid, prompt, max_new_tokens: int,
+               deadline_s: float | None = None) -> None:
+        super().submit(rid, prompt, max_new_tokens,
+                       deadline_s=deadline_s)
+        w = self.prefill_worker
+        if (w is not None and int(max_new_tokens) > 0
+                and self._queue and self._queue[-1][0] == rid):
+            # the queue entry carries the STRIPPED prompt the compiled
+            # programs expect
+            w.stage(rid, self._queue[-1][1], self._queue[-1][2])
+
+    def _admit_from(self, pending: list) -> list:
+        """Base head-of-line admission, but a staged request's prompt
+        pages are already held — only its decode tail counts against the
+        free-page budget."""
+        w = self.prefill_worker
+        if w is None:
+            return super()._admit_from(pending)
+        free = [s for s, sl in enumerate(self.slots)
+                if sl.free and s not in self._quarantined]
+        group = []
+        avail = self._pool.free_pages
+        while pending and free:
+            rid, _prompt, budget = pending[0]
+            need = (w.tail_of(rid) if w.staged(rid)
+                    else self._pages_needed(budget))
+            if need > avail:
+                break
+            avail -= need
+            pending.pop(0)
+            group.append((free.pop(0), rid, _prompt, budget))
+        return group
+
+    def _admit_group(self, admissions):
+        w = self.prefill_worker
+        if w is None:
+            return super()._admit_group(admissions)
+        staged = [a for a in admissions if w.staged(a[1])]
+        rest = [a for a in admissions if not w.staged(a[1])]
+        if not staged:
+            return super()._admit_group(admissions)
+        if not rest:
+            return self._admit_staged(staged)
+        # mixed group: each sub-path books its own slots; the composed
+        # return only feeds _sync_admit_bookkeep's host fetch, in the
+        # caller's admission order
+        firsts = np.zeros((len(admissions),), np.int64)
+        pos_of = {rid: i for i, (_s, rid, _p, _b) in
+                  enumerate(admissions)}
+        sub = np.asarray(super()._admit_group(rest))
+        for j, (_s, rid, _p, _b) in enumerate(rest):
+            firsts[pos_of[rid]] = int(sub[j])
+        sub = np.asarray(self._admit_staged(staged))
+        for j, (_s, rid, _p, _b) in enumerate(staged):
+            firsts[pos_of[rid]] = int(sub[j])
+        return firsts
+
+    def _admit_staged(self, admissions):
+        """Admit a group whose prefill already ran: allocate decode
+        tails, wire block tables to the handed-off pages, and install
+        the staged first tokens in one scatter dispatch — no model
+        forward on the decode path."""
+        G0 = len(admissions)
+        self._obs_admitted(admissions)
+        G = 1 << (G0 - 1).bit_length()
+        w = self.prefill_worker
+        hp = self._head_len
+        slot_ix = np.zeros((G,), np.int32)
+        firsts_rows = []
+        pads_rows = []
+        for g, (s, rid, _prompt, _budget) in enumerate(admissions):
+            tail_need = w.tail_of(rid)
+            pages, firsts_g, pads_g = w.collect(rid)
+            tail = self._pool.alloc(tail_need) if tail_need else []
+            if tail is None:
+                raise RuntimeError("KV pool exhausted mid-group")
+            if self._head_pages:
+                if self._prefix_tokens is not None:
+                    self._registry.acquire(self._prefix_tokens)
+                else:
+                    self._pool.share(self._head_pages)
+                self._tables[s, :hp] = self._head_pages
+            allp = pages + tail
+            self._tables[s, hp:hp + len(allp)] = allp
+            self._tables[s, hp + len(allp):] = 0
+            slot_ix[g] = s
+            firsts_rows.append(firsts_g)
+            pads_rows.append(pads_g)
+            self._hit_rids.discard(rid)
+        slot_ix[G0:] = slot_ix[G0 - 1]
+        firsts = jnp.concatenate(
+            firsts_rows + [firsts_rows[-1]] * (G - G0))
+        pads = jnp.concatenate(pads_rows + [pads_rows[-1]] * (G - G0))
+        if self.prefix_len:
+            self.stats["prefix_hits"] += G0
+            self.stats["prefix_hit_tokens"] += G0 * self.prefix_len
+            obs.inc("serving_prefix_hits_total", G0)
+            obs.inc("serving_prefix_hit_tokens_total",
+                    G0 * self.prefix_len)
+        with obs.span("serving.admit", group=G0, disaggregated=True):
+            self.tokens, self.pos, self.pad = self._install_fn(
+                self.tokens, self.pos, self.pad, jnp.asarray(slot_ix),
+                firsts, pads)
+            if obs.enabled():
+                obs.set_gauge("serving_kv_pages_in_use",
+                              self._pool.pages_in_use)
+        now = (time.perf_counter()
+               if self._deadlines or self.fault_plan is not None else 0.0)
+        for g, (s, rid, _prompt, budget) in enumerate(admissions):
+            sl = self.slots[s]
+            sl.request_id = rid
+            sl.emitted = [(firsts, g, 1)]
+            sl.budget = budget - 1
+            sl.total = budget
+            sl.done_eos = False
+            sl.ok_refs = []
+            rel = self._deadlines.get(rid)
+            if (self.fault_plan is not None
+                    and self.fault_plan.serving_fault(rid)):
+                sl.deadline = now
+            else:
+                sl.deadline = None if rel is None else now + rel
+        self.stats["admitted"] += G0
+        return firsts
+
+    @property
+    def _install_fn(self):
+        return self.prefill_worker._install

@@ -1,0 +1,98 @@
+"""Fleet routing policy: pure host code, deliberately jax-free.
+
+The router (``serving_fleet.router``) decides WHERE a request goes; the
+replicas decide WHETHER it is admitted.  Everything the decision needs is
+already host state on the batcher (queue depth, free slots, the chunk-time
+EWMA, the shared-prefix tokens), so the policy is plain Python over
+:class:`ReplicaSnapshot` values — unit-testable without a model, a mesh,
+or even jax in the process (tests/test_serving_fleet.py guards that).
+
+Ranking order (ties broken by the next key, then by replica index so the
+routing trace is deterministic):
+
+1. **SLO feasibility** — replicas whose estimated admission wait already
+   exceeds their SLO would reject; they go last, whatever their affinity.
+2. **Prefix affinity** — a replica that already holds the request's
+   prefix pages (ctor ``prefix_tokens``) or served the same prompt head
+   recently skips prefill work and reuses warm KV pages.
+3. **Least load** — fewest queued + active requests.
+4. **SLO slack** — at equal load, the replica with the most headroom.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["ReplicaSnapshot", "rank_replicas", "snapshot_replica"]
+
+
+@dataclass(frozen=True)
+class ReplicaSnapshot:
+    """One replica's routing-relevant state at decision time.
+
+    ``est_wait_s`` is the replica's own admission-wait estimate (queue
+    drain + pool deficit); ``slo_slack_s`` is its SLO minus that wait,
+    ``inf`` when the replica has no admission SLO (it never rejects on
+    wait, so it is always feasible).
+    """
+
+    index: int
+    queue_len: int
+    active: int
+    free_slots: int
+    prefix_hit: bool = False
+    est_wait_s: float = 0.0
+    slo_slack_s: float = float("inf")
+
+    @property
+    def load(self) -> int:
+        return self.queue_len + self.active
+
+
+def rank_replicas(snapshots) -> list[int]:
+    """Replica indices in routing-preference order (best first)."""
+    return [s.index for s in sorted(
+        snapshots,
+        key=lambda s: (
+            1 if s.slo_slack_s <= 0.0 else 0,   # would reject: last
+            0 if s.prefix_hit else 1,            # warm prefix first
+            s.load,                              # then least loaded
+            -s.slo_slack_s,                      # then most headroom
+            s.index,                             # deterministic trace
+        ),
+    )]
+
+
+def snapshot_replica(index: int, batcher, prompt, budget: int, *,
+                     affinity_hit: bool = False) -> ReplicaSnapshot:
+    """Build a snapshot from a live batcher by reading HOST state only
+    (queue, slots, EWMAs) — no device round trip, no jax import.
+
+    ``affinity_hit`` is the router's own recency signal (same prompt head
+    routed here before); it ORs with the replica's ctor-level shared
+    prefix, which is the stronger signal (precomputed pages, prefill
+    skipped entirely).
+    """
+    hit = bool(affinity_hit)
+    ptoks = getattr(batcher, "_prefix_tokens", None)
+    if ptoks is not None:
+        n = len(ptoks)
+        p = list(prompt)
+        hit = hit or (len(p) > n
+                      and tuple(int(t) for t in p[:n]) == tuple(ptoks))
+    queue_len = len(getattr(batcher, "_queue", ()))
+    slots = getattr(batcher, "slots", ())
+    active = sum(1 for sl in slots if not sl.free)
+    slack = float("inf")
+    est_wait = 0.0
+    slo = getattr(batcher, "slo_deadline_s", None)
+    estimate = getattr(batcher, "_admission_wait_estimate", None)
+    if estimate is not None and budget > 0:
+        est_wait, _bound = estimate(budget)
+        if slo is not None:
+            slack = float(slo) - est_wait
+    return ReplicaSnapshot(
+        index=index, queue_len=queue_len, active=active,
+        free_slots=len(slots) - active, prefix_hit=hit,
+        est_wait_s=est_wait, slo_slack_s=slack,
+    )

@@ -1,0 +1,229 @@
+"""Host-side fleet router over N ``ContinuousBatcher`` replicas.
+
+The router owns request placement only; each replica keeps its own
+queue, pool, admission control and compiled programs (which the
+``_programs`` lru shares across same-shape replicas — N replicas compile
+ONCE).  Placement is prefix-affinity + least-load + SLO-slack
+(``serving_fleet.policy``); a replica that still rejects
+(:class:`~ddl25spring_tpu.models.serving.AdmissionRejected` — queue
+full, SLO, pool) triggers a bounded re-route to the next-ranked replica
+through :func:`~ddl25spring_tpu.resilience.retry.retry_call`, reusing
+the rejection's ``reason``/``retry_after_s`` for telemetry and for the
+error the caller finally sees (the rejection with the SOONEST
+``retry_after_s`` across the fleet).
+
+Autoscaling signals ride on ``obs``: per-replica queue-wait and
+measured page-drain-rate gauges (``fleet_replica_queue_wait_s``,
+``fleet_replica_drain_pps``) plus routing counters — these are the
+inputs a scaler needs to decide "add a replica" (queue wait growing
+fleet-wide) vs "rebalance" (one replica hot).
+
+Like ``policy``, this module never imports jax: rejections are matched
+structurally (``reason``/``retry_after_s`` attributes) so the router —
+and its tests — run with fake replicas in a jax-free process.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .. import obs
+from ..resilience.retry import RetryError, retry_call
+from . import policy
+
+__all__ = ["FleetRouter"]
+
+
+class _Rerouted(RuntimeError):
+    """Internal: one replica rejected; carries the original exception so
+    the retry loop can re-raise the real rejection when every candidate
+    is exhausted (keeping the router import-independent of serving)."""
+
+    def __init__(self, original):
+        super().__init__(str(original))
+        self.original = original
+
+
+def _is_rejection(e: BaseException) -> bool:
+    return hasattr(e, "reason") and hasattr(e, "retry_after_s")
+
+
+class _FleetPoolView:
+    """Duck-typed pool facade so :func:`loadgen.replay` can read fleet
+    page residency: the peak is summed per replica (each pool peaks
+    independently — the sum is the fleet's resident-KV high-water
+    bound)."""
+
+    def __init__(self, replicas):
+        self._replicas = replicas
+
+    @property
+    def pages_peak(self) -> int:
+        return sum(r._pool.pages_peak for r in self._replicas
+                   if getattr(r, "_pool", None) is not None)
+
+    @property
+    def pages_in_use(self) -> int:
+        return sum(r._pool.pages_in_use for r in self._replicas
+                   if getattr(r, "_pool", None) is not None)
+
+
+class FleetRouter:
+    """Route requests over ``replicas`` (each a ``ContinuousBatcher`` —
+    or anything with its submit/step/in_flight surface).
+
+    ``max_reroutes`` bounds how many ADDITIONAL replicas a rejected
+    request may try (default: all of them).  ``affinity_window`` is the
+    prompt-head length used for the router's recency affinity map —
+    requests sharing a head route to the replica that last served one,
+    where its KV pages are warmest.  Exposes the same
+    ``submit``/``step``/``drain``/``in_flight`` surface as a single
+    batcher, so ``loadgen.replay`` and ``saturation_sweep`` drive a
+    fleet unchanged.
+    """
+
+    def __init__(self, replicas, *, max_reroutes: int | None = None,
+                 affinity_window: int = 16):
+        replicas = list(replicas)
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        if max_reroutes is not None and max_reroutes < 0:
+            raise ValueError(
+                f"max_reroutes must be >= 0, got {max_reroutes}")
+        self.replicas = replicas
+        self.max_reroutes = (len(replicas) - 1 if max_reroutes is None
+                             else max_reroutes)
+        self.affinity_window = affinity_window
+        self._affinity: dict = {}   # prompt head -> last replica index
+        self._owner: dict = {}      # in-flight rid -> replica index
+        self.routing_trace: list = []  # (rid, replica index), append-only
+        self.stats = {"routed": 0, "rerouted": 0, "rejected": 0,
+                      "rerouted_by_reason": {}}
+
+    # -- loadgen duck-type surface (drive a fleet like one batcher) ------
+
+    @property
+    def max_batch(self) -> int:
+        return max(r.max_batch for r in self.replicas)
+
+    @property
+    def _paged(self) -> bool:
+        return any(getattr(r, "_paged", False) for r in self.replicas)
+
+    @property
+    def _queue(self) -> list:
+        return [q for r in self.replicas for q in r._queue]
+
+    @property
+    def _pool(self) -> _FleetPoolView:
+        return _FleetPoolView(self.replicas)
+
+    @property
+    def in_flight(self) -> int:
+        return sum(r.in_flight for r in self.replicas)
+
+    # -- routing ---------------------------------------------------------
+
+    def _head_key(self, prompt) -> tuple:
+        return tuple(int(t) for t in list(prompt)[:self.affinity_window])
+
+    def assignments(self) -> dict:
+        """replica index -> [rid, ...] in routed order (the pinned trace
+        the bit-identity contract replays per replica)."""
+        out: dict = {i: [] for i in range(len(self.replicas))}
+        for rid, ix in self.routing_trace:
+            out[ix].append(rid)
+        return out
+
+    def submit(self, rid, prompt, max_new_tokens: int,
+               deadline_s: float | None = None) -> int:
+        """Route and submit one request; returns the replica index it
+        landed on.  Raises the best (soonest-retry) rejection when every
+        candidate replica rejected."""
+        if rid in self._owner:
+            raise ValueError(f"request id {rid!r} already in flight")
+        head = self._head_key(prompt)
+        snaps = [policy.snapshot_replica(
+            i, r, prompt, int(max_new_tokens),
+            affinity_hit=self._affinity.get(head) == i,
+        ) for i, r in enumerate(self.replicas)]
+        order = policy.rank_replicas(snaps)
+        state = {"attempt": 0}
+        rejections: list = []
+
+        def attempt():
+            ix = order[state["attempt"]]
+            state["attempt"] += 1
+            try:
+                self.replicas[ix].submit(rid, prompt, max_new_tokens,
+                                         deadline_s=deadline_s)
+            except Exception as e:
+                if not _is_rejection(e):
+                    raise
+                rejections.append(e)
+                raise _Rerouted(e) from e
+            return ix
+
+        try:
+            ix = retry_call(
+                attempt, retries=min(self.max_reroutes, len(order) - 1),
+                base_delay_s=0.0, jitter=0.0, retry_on=(_Rerouted,),
+                label="fleet.route",
+            )
+        except (_Rerouted, RetryError):
+            # every candidate rejected: surface the rejection the caller
+            # can act on soonest (min retry_after_s across the fleet)
+            self.stats["rejected"] += 1
+            obs.inc("fleet_rejected_total")
+            raise min(rejections, key=lambda e: e.retry_after_s) from None
+        for e in rejections:
+            # count only the rejections that caused an onward re-route
+            by = self.stats["rerouted_by_reason"]
+            by[e.reason] = by.get(e.reason, 0) + 1
+            obs.inc("fleet_rerouted_total", reason=e.reason)
+        self.stats["rerouted"] += len(rejections)
+        self.stats["routed"] += 1
+        obs.inc("fleet_routed_total", replica=str(ix))
+        self._affinity[head] = ix
+        self._owner[rid] = ix
+        self.routing_trace.append((rid, ix))
+        return ix
+
+    # -- stepping --------------------------------------------------------
+
+    def _publish_gauges(self):
+        if not obs.enabled():
+            return
+        for i, r in enumerate(self.replicas):
+            est = getattr(r, "_chunk_s", 0.0)
+            mb = max(1, int(getattr(r, "max_batch", 1)))
+            wait = est * (len(r._queue) / mb)
+            obs.set_gauge("fleet_replica_queue_wait_s", wait,
+                          replica=str(i))
+            obs.set_gauge("fleet_replica_drain_pps",
+                          getattr(r, "_drain_pps", 0.0), replica=str(i))
+
+    def step(self) -> dict:
+        """Step every replica with work in flight; returns the merged
+        ``{rid: tokens}`` of everything that finished this step."""
+        finished: dict = {}
+        for r in self.replicas:
+            if r.in_flight:
+                finished.update(r.step())
+        for rid in finished:
+            self._owner.pop(rid, None)
+        self._publish_gauges()
+        return finished
+
+    def drain(self, *, timeout_s: float | None = None) -> dict:
+        """step() until the fleet is idle (optionally bounded)."""
+        t0 = time.perf_counter()
+        out: dict = {}
+        while self.in_flight:
+            out.update(self.step())
+            if (timeout_s is not None
+                    and time.perf_counter() - t0 > timeout_s):
+                raise TimeoutError(
+                    f"fleet drain exceeded {timeout_s}s with "
+                    f"{self.in_flight} requests in flight")
+        return out

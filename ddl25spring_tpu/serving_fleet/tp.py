@@ -1,0 +1,168 @@
+"""Tensor-parallel serving replica: llama decode sharded over a
+``model`` mesh axis with the paged KV pool partitioned along KV heads.
+
+The batcher's compiled programs are UNCHANGED — TP is pure data
+placement, the GSPMD discipline of ``parallel/tp.py``: params get the
+Megatron column/row shardings, every KV cache/pool leaf shards its head
+axis (pool leaves become ``(nr_pages, kv_page, Hkv/W, hd)`` per shard,
+int8 scale planes ``(nr_pages, kv_page, Hkv/W)``), and the block
+tables / token / pos / pad vectors stay replicated.  jit re-specializes
+the same lru-cached admit/decode programs on the input shardings and
+XLA inserts the collectives; attention itself needs NONE (heads are
+independent — the only cross-shard reduces are the Megatron row-matmul
+psums).  At ``W=1`` the annotations are no-ops, so the sharded batcher
+is bit-identical to today's paged batcher by construction.
+
+``decode_impl`` is pinned to ``"xla"`` for ``W > 1``: a ``pallas_call``
+inside a GSPMD-partitioned jit cannot be auto-sharded.  The flash-decode
+kernel still covers TP through :func:`headsharded_flash_decode` — a
+``shard_map`` wrapper that runs the UNMODIFIED paged kernel per shard on
+its own head slice (legal because the kernel's head loop is static and
+heads never interact), validated head-slice-for-head-slice against the
+full-pool kernel in tier-1 tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.serving import ContinuousBatcher
+from ..ops.flash_decode import flash_decode_attention
+from ..parallel.compat import shard_map
+from ..parallel.mesh import make_mesh
+from ..parallel.tp import apply_shardings, llama_tp_shardings
+
+__all__ = ["TPShardedBatcher", "headsharded_flash_decode",
+           "make_model_mesh"]
+
+
+def make_model_mesh(world: int, *, axis: str = "model", devices=None):
+    """A 1-D mesh of ``world`` devices on the ``model`` axis."""
+    if world < 1:
+        raise ValueError(f"tp world must be >= 1, got {world}")
+    return make_mesh({axis: world}, devices=devices)
+
+
+def kv_head_sharding(mesh, leaf, *, axis: str = "model") -> NamedSharding:
+    """Sharding for one KV cache/pool leaf: partition the head axis
+    (axis 2 in both the contiguous ``(B, S, Hkv, hd)`` and paged
+    ``(nr_pages, kv_page, Hkv[, hd])`` layouts) when divisible,
+    replicate otherwise (a non-divisible head count still serves — it
+    just forgoes the pool split)."""
+    W = mesh.shape[axis]
+    shape = getattr(leaf, "shape", ())
+    if len(shape) >= 3 and shape[2] % W == 0:
+        return NamedSharding(
+            mesh, P(*((None, None, axis) + (None,) * (len(shape) - 3))))
+    return NamedSharding(mesh, P())
+
+
+class TPShardedBatcher(ContinuousBatcher):
+    """:class:`ContinuousBatcher` with params and KV state sharded over
+    a ``model`` mesh axis.
+
+    ``tp_world`` picks the first N local devices (or pass a prebuilt
+    ``mesh`` that has ``model_axis``).  Requires ``nr_heads`` and the KV
+    head count divisible by the world size — GQA group structure must
+    survive the split (each shard keeps whole ``Hq/W : Hkv/W`` groups).
+    Everything else — queue, pool accounting, admission control, block
+    tables — is host state and identical to the base batcher, which is
+    what lets the ``FleetRouter`` mix sharded and unsharded replicas.
+    """
+
+    def __init__(self, config, params, *, mesh=None,
+                 tp_world: int | None = None, model_axis: str = "model",
+                 **kwargs):
+        if mesh is None:
+            mesh = make_model_mesh(tp_world or 1, axis=model_axis)
+        if model_axis not in mesh.shape:
+            raise ValueError(
+                f"mesh axes {dict(mesh.shape)} lack the model axis "
+                f"{model_axis!r}")
+        W = int(mesh.shape[model_axis])
+        kv_heads = config.nr_kv_heads or config.nr_heads
+        if W > 1:
+            if config.nr_heads % W or kv_heads % W:
+                raise ValueError(
+                    f"nr_heads={config.nr_heads} / kv_heads={kv_heads} "
+                    f"must both divide by the tp world {W} (whole GQA "
+                    "groups per shard)")
+            # pallas_call does not partition under GSPMD — pin the einsum
+            # decode path; the per-shard flash kernel lives in
+            # headsharded_flash_decode (shard_map, TPU serving path)
+            config = dataclasses.replace(config, decode_impl="xla")
+        self.mesh = mesh
+        self.model_axis = model_axis
+        self.tp_world = W
+        params = apply_shardings(
+            params, llama_tp_shardings(mesh, params, model_axis))
+        super().__init__(config, params, **kwargs)
+        # shard the serving state the programs thread through every
+        # dispatch: KV pool/cache on heads, scheduler vectors replicated
+        repl = NamedSharding(mesh, P())
+        shard_kv = lambda leaf: jax.device_put(
+            leaf, kv_head_sharding(mesh, leaf, axis=model_axis))
+        self.cache = jax.tree.map(shard_kv, self.cache)
+        if self._prefix_cache is not None:
+            self._prefix_cache = jax.tree.map(shard_kv, self._prefix_cache)
+        self.tokens = jax.device_put(self.tokens, repl)
+        self.pos = jax.device_put(self.pos, repl)
+        self.pad = jax.device_put(self.pad, repl)
+
+    def kv_shard_shapes(self) -> list:
+        """Per-device shapes of the sharded KV leaves (what ``--tp-kv``
+        cross-checks AOT): head axis divided by the world size."""
+        return [s.data.shape for leaf in jax.tree.leaves(self.cache)
+                for s in leaf.addressable_shards[:1]]
+
+
+def headsharded_flash_decode(mesh, q, cache_k, cache_v, pos, pad=None, *,
+                             block_tables=None, prefix_len: int = 0,
+                             cache_k_scale=None, cache_v_scale=None,
+                             model_axis: str = "model",
+                             interpret: bool | None = None):
+    """The paged flash-decode kernel over a head-sharded pool: each
+    shard runs the UNCHANGED ``ops/flash_decode.py`` kernel on its own
+    ``Hkv/W`` pool slice and ``Hq/W`` query slice; outputs concatenate
+    over heads with no collective (attention heads are independent, so
+    the head split is communication-free — the Megatron psums live in
+    the surrounding matmuls, not here)."""
+    W = int(mesh.shape[model_axis])
+    Hq = q.shape[1]
+    Hkv = cache_k.shape[2]
+    if Hq % W or Hkv % W:
+        raise ValueError(
+            f"Hq={Hq} / Hkv={Hkv} must divide by the model-axis size {W}")
+    head2 = P(None, model_axis, None)        # q / out: (B, Hq, hd)
+    pool = P(None, None, model_axis, None)   # (pages|B, kv_page|S, Hkv, hd)
+    scale = P(None, None, model_axis)        # int8 scale planes
+    args = [q, cache_k, cache_v, pos]
+    in_specs = [head2, pool, pool, P()]
+    if pad is not None:
+        args.append(pad)
+        in_specs.append(P())
+    if cache_k_scale is not None:
+        args += [cache_k_scale, cache_v_scale]
+        in_specs += [scale, scale]
+    if block_tables is not None:
+        args.append(block_tables)
+        in_specs.append(P())  # tables replicated: every shard reads all
+
+    def body(q_, k_, v_, pos_, *rest):
+        rest = list(rest)
+        pad_ = rest.pop(0) if pad is not None else None
+        ks_ = rest.pop(0) if cache_k_scale is not None else None
+        vs_ = rest.pop(0) if cache_k_scale is not None else None
+        tables_ = rest.pop(0) if block_tables is not None else None
+        return flash_decode_attention(
+            q_, k_, v_, pos_, pad_, cache_k_scale=ks_, cache_v_scale=vs_,
+            prefix_len=prefix_len, block_tables=tables_,
+            interpret=interpret)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=head2,
+        check_vma=False,
+    )(*args)

@@ -20,7 +20,10 @@ streaming batcher and emits one JSON curve — per-point goodput, p50/p99
 latency, queue wait, reject/evict rates and peak KV-page residency,
 with the detected knee (last offered rate still served at >=90% of
 offered) as the headline.  Points are auto-placed around a measured
-peak-goodput probe unless ``--sweep-qps`` pins them.
+peak-goodput probe unless ``--sweep-qps`` pins them.  ``--replicas N``
+routes the sweep through a ``serving_fleet.FleetRouter`` over N batcher
+replicas (one compiled program set shared fleet-wide) and measures the
+knee fleet-wide, with routed/re-routed counts per point.
 
 Every compiled program is built once and reused across reps and sweep
 points (the batcher's program cache is keyed on shapes, not instances).
@@ -99,6 +102,12 @@ def main() -> int:
                     help="run the closed-loop saturation sweep instead "
                          "of the contender race; emits one JSON curve "
                          "with the detected knee")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="with --sweep: serve through a FleetRouter over "
+                         "N batcher replicas (prefix-affinity + least-"
+                         "load + SLO-slack routing) and measure the knee "
+                         "fleet-wide; programs compile once and are "
+                         "shared across replicas")
     ap.add_argument("--sweep-qps", default=None,
                     help="comma-separated offered-QPS points; default "
                          "places 6 points around a measured peak-"
@@ -194,12 +203,24 @@ def _run_sweep(args, cfg, params, kv_kwargs, loadgen,
 
     budget = (args.min_new + args.max_new) // 2
 
-    def make_batcher():
+    def make_replica():
         return ContinuousBatcher(
             cfg, params, max_batch=args.batch,
             prefill_width=args.prefill_width,
             decode_chunk=args.decode_chunk, max_queue=args.max_queue,
             slo_deadline_s=args.slo, **kv_kwargs)
+
+    fleet = args.replicas > 1
+    if fleet:
+        from ddl25spring_tpu.serving_fleet import FleetRouter
+
+        def make_batcher():
+            return FleetRouter([make_replica()
+                                for _ in range(args.replicas)])
+        replay_fn = loadgen.replay_fleet
+    else:
+        make_batcher = make_replica
+        replay_fn = None
 
     def prompt_fn(i, prng):
         n = int(prng.integers(4, args.prefill_width))
@@ -209,12 +230,18 @@ def _run_sweep(args, cfg, params, kv_kwargs, loadgen,
     if args.sweep_qps:
         qps_points = [float(q) for q in args.sweep_qps.split(",")]
         warmup = True
+        if fleet:
+            # warm ONE replica; N replicas share the compiled programs
+            prng = np.random.default_rng(args.arrival_seed)
+            wp = [prompt_fn(i, prng) for i in range(nr)]
+            loadgen.warm(make_replica, wp, [budget] * nr)
+            warmup = False
     else:
         # probe peak goodput with an effectively-instantaneous trace,
         # then straddle it: three points below the knee, three at/past
         prng = np.random.default_rng(args.arrival_seed)
         probe_prompts = [prompt_fn(i, prng) for i in range(nr)]
-        loadgen.warm(make_batcher, probe_prompts, [budget] * nr)
+        loadgen.warm(make_replica, probe_prompts, [budget] * nr)
         probe = loadgen.replay(
             make_batcher(),
             loadgen.arrival_trace(nr, 1e4, args.arrival_dist,
@@ -227,7 +254,7 @@ def _run_sweep(args, cfg, params, kv_kwargs, loadgen,
     sweep = loadgen.saturation_sweep(
         make_batcher, qps_points, nr, prompt_fn, budget,
         dist=args.arrival_dist, seed=args.arrival_seed,
-        warmup=warmup)
+        warmup=warmup, replay_fn=replay_fn)
     if args.telemetry:
         obs.flush()
     print(json.dumps({
@@ -236,7 +263,12 @@ def _run_sweep(args, cfg, params, kv_kwargs, loadgen,
         "batch": args.batch, "kv_layout": args.kv_layout,
         "kv_page": args.kv_page if kv_kwargs else None,
         "budget": budget, "max_queue": args.max_queue,
-        "slo_s": args.slo, **sweep,
+        "slo_s": args.slo, "replicas": args.replicas,
+        **({"routed": sum(pt.get("routed", 0)
+                          for pt in sweep["points"]),
+            "rerouted": sum(pt.get("rerouted", 0)
+                            for pt in sweep["points"])} if fleet else {}),
+        **sweep,
     }), flush=True)
     return 0
 

@@ -80,6 +80,7 @@ def test_fedsgd_c1_single_client_equals_centralized_step(small_mnist, task):
     assert params_allclose(p1, manual, atol=1e-6)
 
 
+@pytest.mark.slow  # ~14s CPU convergence run; fedavg round math is pinned by the exactness oracles
 def test_fedavg_improves_and_schema(small_mnist, task):
     ds = small_mnist
     clients = split_dataset(ds.train_x, ds.train_y, nr_clients=8, iid=True,
@@ -118,6 +119,7 @@ def test_noniid_fedavg_runs(small_mnist, task):
     assert len(rr.test_accuracy) == 2
 
 
+@pytest.mark.slow  # ~9s CPU convergence run; the centralized step oracle stays fast
 def test_centralized_server_one_epoch_learns(small_mnist, task):
     ds = small_mnist
     server = CentralizedServer(task, lr=0.05, batch_size=128, seed=42,

@@ -540,6 +540,7 @@ def test_fl_compress_validation(small_fl):
                      compress="int8", dp_clip=1.0)
 
 
+@pytest.mark.slow  # ~11s CPU; compress exactness and Krum selection are pinned fast separately
 def test_fl_compress_composes_with_robust_aggregator(small_fl):
     """compress + Krum: distances are computed on the compressed messages
     the server actually receives — the combination must build and train."""
@@ -556,6 +557,7 @@ def test_fl_compress_composes_with_robust_aggregator(small_fl):
 
 # --- SCAFFOLD -------------------------------------------------------------
 
+@pytest.mark.slow  # ~22s CPU (two servers, two compiles); control-variate algebra units stay fast
 def test_scaffold_zero_controls_k1_is_fedsgd_weight(small_fl):
     """With c = ci = 0 and K = 1 full-batch step, the corrected gradient IS
     the plain gradient, so one SCAFFOLD round equals one FedSgdWeight round

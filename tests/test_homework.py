@@ -47,6 +47,7 @@ def mnist_tiny():
     return load_mnist(n_train=1024, n_test=256)
 
 
+@pytest.mark.slow  # ~15s CPU; the committed results/ battery pins the same ordering
 def test_a2_ordering_fast(mnist_tiny):
     rounds = 3
     task, data = _setup(mnist_tiny, 5, True, pad=256)
@@ -64,6 +65,7 @@ def test_a2_ordering_fast(mnist_tiny):
     assert sgd.message_count[-1] == 2 * rounds * 2
 
 
+@pytest.mark.slow  # ~27s CPU convergence demo; split_dataset non-iid units stay fast
 def test_a3_noniid_degrades_fast(mnist_tiny):
     rounds = 3
     task, data = _setup(mnist_tiny, 5, True, pad=32)

@@ -168,6 +168,7 @@ def test_im2col_conv_under_client_vmap():
     assert float(jnp.max(jnp.abs(a - b))) < 1e-5
 
 
+@pytest.mark.slow  # ~35s CPU; test_remat_matches_no_remat pins remat equivalence on llama fast
 def test_resnet_remat_matches_no_remat():
     """``remat=True`` (checkpointed blocks, added when im2col's 9x patch
     tensors pushed the north-star bench 172 MB past v5e HBM) must be a pure

@@ -392,6 +392,7 @@ def test_run_lm_ep_capacity_strategy():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # ~15s CPU composition sweep; per-layer MoE exactness tests stay fast
 def test_moe_serving_compositions():
     """MoE composes with the whole serving stack: KV-cache generation
     equals iterated full-forward argmax, the capacity-dispatch layer

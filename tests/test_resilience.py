@@ -453,6 +453,7 @@ sys.path.insert(0, {repo!r})
 """
 
 
+@pytest.mark.slow  # ~21s CPU; test_autoresume_crash_then_resume_bitexact covers resume in-process fast
 def test_autoresume_subprocess_kill_resumes_bitexact(fl_server_factory,
                                                      tmp_path):
     # SIGKILL-shaped crash: kill=2 hard-exits (os._exit(23)) before round 2

@@ -1,0 +1,415 @@
+"""Fleet serving oracle (serving_fleet/): TP sharding, disaggregated
+prefill and the prefix-affinity router are all REARRANGEMENTS of the
+paged batcher, so each layer must reproduce its streams bit for bit:
+
+- ``TPShardedBatcher`` at W=1 is the paged batcher (the annotations are
+  no-ops); at W=2 the streams still match and the KV pool's head axis is
+  physically split Hkv/W per shard,
+- ``headsharded_flash_decode`` equals the full-pool kernel head-slice
+  for head-slice (the shard_map split is communication-free),
+- ``DisaggregatedBatcher`` streams match the colocated mode and the
+  base batcher, with the prompt pages handed over through the registry
+  and the pool drained after,
+- a 2-replica fleet's merged streams equal the per-replica replays of
+  its pinned routing trace AND the single-batcher reference,
+- routing policy ordering and bounded re-route are pure host logic,
+  testable with fake replicas in a jax-free process (the import guard
+  subprocess proves ``serving_fleet``'s host modules never pull jax).
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.models import loadgen
+from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+from ddl25spring_tpu.models.serving import ContinuousBatcher, _programs
+from ddl25spring_tpu.ops.flash_decode import flash_decode_attention
+from ddl25spring_tpu.serving_fleet import (DisaggregatedBatcher,
+                                           FleetRouter, ReplicaSnapshot,
+                                           TPShardedBatcher,
+                                           headsharded_flash_decode,
+                                           make_model_mesh, rank_replicas)
+
+REPO = Path(__file__).resolve().parent.parent
+
+CFG = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                  nr_layers=2, ctx_size=48)
+PAGED = {"kv_layout": "paged", "kv_page": 8}
+BUDGETS = [6, 5, 4, 6, 3]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    prompt = jnp.ones((1, 4), jnp.int32)
+    return Llama(CFG).init(
+        jax.random.PRNGKey(0), prompt, positions=jnp.arange(4)
+    )
+
+
+def _prompts(seed=3, sizes=(3, 7, 4, 8, 5)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 97, size=n).tolist() for n in sizes]
+
+
+def _stream_all(batcher, prompts, budgets, rids=None):
+    """submit/step to completion; {rid: [tokens]}."""
+    rids = list(range(len(prompts))) if rids is None else rids
+    for rid, p, b in zip(rids, prompts, budgets):
+        batcher.submit(rid, p, b)
+    out = {}
+    while batcher.in_flight:
+        out.update(batcher.step())
+    return {rid: list(map(int, toks)) for rid, toks in out.items()}
+
+
+# -- routing policy (pure host) --------------------------------------------
+
+
+def test_rank_replicas_ordering():
+    # prefix hit beats load beats index; exhausted SLO slack demotes to
+    # the back regardless of everything else
+    snaps = [
+        ReplicaSnapshot(index=0, queue_len=3, active=0, free_slots=1),
+        ReplicaSnapshot(index=1, queue_len=0, active=0, free_slots=1,
+                        prefix_hit=True),
+        ReplicaSnapshot(index=2, queue_len=0, active=1, free_slots=1),
+        ReplicaSnapshot(index=3, queue_len=0, active=0, free_slots=1,
+                        slo_slack_s=-1.0),
+    ]
+    assert rank_replicas(snaps) == [1, 2, 0, 3]
+
+
+def test_rank_replicas_least_load_then_index():
+    snaps = [
+        ReplicaSnapshot(index=0, queue_len=1, active=1, free_slots=1),
+        ReplicaSnapshot(index=1, queue_len=0, active=1, free_slots=1),
+        ReplicaSnapshot(index=2, queue_len=0, active=1, free_slots=1),
+    ]
+    assert rank_replicas(snaps) == [1, 2, 0]
+
+
+def test_rank_replicas_more_slack_wins_at_equal_load():
+    snaps = [
+        ReplicaSnapshot(index=0, queue_len=0, active=0, free_slots=1,
+                        slo_slack_s=0.1),
+        ReplicaSnapshot(index=1, queue_len=0, active=0, free_slots=1,
+                        slo_slack_s=2.0),
+    ]
+    assert rank_replicas(snaps) == [1, 0]
+
+
+class _Rej(Exception):
+    def __init__(self, reason, retry_after_s):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class _FakeReplica:
+    """submit/step surface with a bounded queue — enough to exercise the
+    router's re-route and rejection paths without a model."""
+
+    def __init__(self, cap=2, reject=False, retry_after=0.5):
+        self.max_batch = 1
+        self._queue = []
+        self._slots = []
+        self._cap = cap
+        self._reject = reject
+        self._retry_after = retry_after
+        self.in_flight = 0
+
+    def submit(self, rid, prompt, budget, deadline_s=None):
+        if self._reject or len(self._queue) >= self._cap:
+            raise _Rej("queue_full", self._retry_after)
+        self._queue.append((rid, list(prompt), budget))
+        self.in_flight += 1
+
+    def step(self):
+        done = {}
+        if self._queue:
+            rid, prompt, _ = self._queue.pop(0)
+            done[rid] = prompt
+            self.in_flight -= 1
+        return done
+
+
+def test_router_reroutes_on_rejection():
+    router = FleetRouter([_FakeReplica(reject=True), _FakeReplica()])
+    assert router.submit(0, [1, 2, 3], 4) == 1
+    assert router.stats["routed"] == 1
+    assert router.stats["rerouted"] == 1
+    assert router.stats["rerouted_by_reason"] == {"queue_full": 1}
+    assert router.routing_trace == [(0, 1)]
+
+
+def test_router_fleetwide_rejection_surfaces_soonest_retry():
+    router = FleetRouter([_FakeReplica(cap=1, retry_after=0.9),
+                          _FakeReplica(cap=1, retry_after=0.2)])
+    router.submit(0, [5], 2)
+    router.submit(1, [6], 2)
+    with pytest.raises(_Rej) as exc:
+        router.submit(2, [7], 2)
+    assert exc.value.reason == "queue_full"
+    assert exc.value.retry_after_s == pytest.approx(0.2)
+    assert router.stats["rejected"] == 1
+    done = router.drain()
+    assert sorted(done) == [0, 1]
+    assert router.in_flight == 0
+
+
+def test_router_max_reroutes_bounds_candidates():
+    # max_reroutes=0: only the top-ranked replica is tried
+    full = _FakeReplica(reject=True)
+    spare = _FakeReplica()
+    router = FleetRouter([full, spare], max_reroutes=0)
+    with pytest.raises(_Rej):
+        router.submit(0, [1], 2)
+    assert spare.in_flight == 0
+
+
+def test_router_duplicate_rid_raises():
+    router = FleetRouter([_FakeReplica()])
+    router.submit(0, [1], 2)
+    with pytest.raises(ValueError):
+        router.submit(0, [2], 2)
+
+
+def test_serving_fleet_host_modules_never_import_jax():
+    # same contract as obs: policy/router (and the package itself) are
+    # host code — routing over fake replicas must run in a jax-free
+    # process so fleet control planes don't pay for (or depend on) jax
+    code = "\n".join([
+        "import sys",
+        "from ddl25spring_tpu.serving_fleet import (",
+        "    FleetRouter, ReplicaSnapshot, rank_replicas)",
+        "class R:",
+        "    max_batch = 1",
+        "    in_flight = 0",
+        "    def __init__(self): self._queue = []; self._slots = []",
+        "    def submit(self, rid, p, b, deadline_s=None):",
+        "        self._queue.append((rid, p, b))",
+        "    def step(self): return {}",
+        "r = FleetRouter([R(), R()])",
+        "r.submit(0, [1, 2], 4)",
+        "assert r.stats['routed'] == 1",
+        "assert 'jax' not in sys.modules, 'serving_fleet pulled jax'",
+        "print('ok')",
+    ])
+    out = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+# -- tensor-parallel replica -----------------------------------------------
+
+
+def test_tp1_bit_identical_to_paged_batcher(setup):
+    prompts = _prompts()
+    base = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                             **PAGED)
+    tp1 = TPShardedBatcher(CFG, setup, tp_world=1, max_batch=2,
+                           prefill_width=8, **PAGED)
+    assert _stream_all(base, prompts, BUDGETS) == \
+        _stream_all(tp1, prompts, BUDGETS)
+    assert tp1._pool.pages_in_use == 0
+
+
+def test_tp2_streams_match_and_pool_head_axis_splits(setup):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    prompts = _prompts()
+    base = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                             **PAGED)
+    tp2 = TPShardedBatcher(CFG, setup, tp_world=2, max_batch=2,
+                           prefill_width=8, **PAGED)
+    assert tp2.config.decode_impl == "xla"
+    assert _stream_all(base, prompts, BUDGETS) == \
+        _stream_all(tp2, prompts, BUDGETS)
+    # the pool is PHYSICALLY head-split: each shard holds Hkv/W = 1 head
+    kv_heads = CFG.nr_kv_heads or CFG.nr_heads
+    shard_shapes = tp2.kv_shard_shapes()
+    assert shard_shapes, "no sharded cache leaves"
+    assert any(s[2] == kv_heads // 2 for s in shard_shapes if len(s) >= 3)
+    assert tp2._pool.pages_in_use == 0
+
+
+def test_tp_world_must_divide_heads(setup):
+    with pytest.raises(ValueError, match="GQA groups"):
+        TPShardedBatcher(
+            LlamaConfig(vocab_size=97, dmodel=48, nr_heads=3,
+                        nr_kv_heads=3, nr_layers=1, ctx_size=48),
+            setup, tp_world=2)
+
+
+def test_headsharded_flash_decode_matches_full_kernel():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    B, Hq, Hkv, hd, kv_page, nr_pages = 3, 4, 2, 12, 8, 13
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv, kt = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (B, Hq, hd), jnp.float32)
+    cache_k = jax.random.normal(kk, (nr_pages, kv_page, Hkv, hd),
+                                jnp.float32)
+    cache_v = jax.random.normal(kv, (nr_pages, kv_page, Hkv, hd),
+                                jnp.float32)
+    # shuffled tables + ragged per-row positions: the head split must be
+    # invariant to page placement and row raggedness
+    n_log = (nr_pages - 1) // B
+    tables = jax.random.permutation(
+        kt, jnp.arange(1, 1 + B * n_log, dtype=jnp.int32)
+    ).reshape(B, n_log)
+    pos = jnp.asarray([5, 17, 11], jnp.int32)
+    pad = jnp.asarray([0, 2, 1], jnp.int32)
+    full = flash_decode_attention(q, cache_k, cache_v, pos, pad,
+                                  block_tables=tables, interpret=True)
+    mesh = make_model_mesh(2, devices=jax.devices()[:2])
+    sharded = headsharded_flash_decode(
+        mesh, q, cache_k, cache_v, pos, pad, block_tables=tables,
+        interpret=True)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(sharded))
+
+
+# -- disaggregated prefill -------------------------------------------------
+
+
+def test_disagg_streams_match_colocated_and_base(setup):
+    prompts = _prompts()
+    base = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                             **PAGED)
+    disagg = DisaggregatedBatcher(CFG, setup, max_batch=2,
+                                  prefill_width=8, kv_page=8)
+    coloc = DisaggregatedBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                                 kv_page=8, prefill_mode="colocated")
+    ref = _stream_all(base, prompts, BUDGETS)
+    assert _stream_all(disagg, prompts, BUDGETS) == ref
+    assert _stream_all(coloc, prompts, BUDGETS) == ref
+    # every admission really took the offloaded-prefill path, the
+    # handoff registry is empty again, and no page leaked
+    assert disagg.prefill_worker.stats["prefilled"] == len(prompts)
+    assert disagg.prefill_worker.stats["skipped"] == 0
+    assert not disagg.prefill_worker._staged
+    assert disagg._pool.pages_in_use == 0
+    assert coloc.prefill_worker is None
+    assert coloc._pool.pages_in_use == 0
+
+
+def test_disagg_pool_pressure_falls_back_to_admit_prefill(setup):
+    # a pool too tight to hold staged pages plus pending tails makes the
+    # worker SKIP staging (never deadlock); streams still match base
+    prompts = _prompts()
+    kwargs = dict(max_batch=2, prefill_width=8)
+    pages = {"kv_pages": 4}  # 3 usable: stagings + tails can't all fit
+    base = ContinuousBatcher(CFG, setup, **kwargs, **PAGED, **pages)
+    disagg = DisaggregatedBatcher(CFG, setup, kv_page=8, **kwargs,
+                                  **pages)
+    assert _stream_all(base, prompts, BUDGETS) == \
+        _stream_all(disagg, prompts, BUDGETS)
+    st = disagg.prefill_worker.stats
+    assert st["prefilled"] + st["skipped"] == len(prompts)
+    assert st["skipped"] > 0
+    assert disagg._pool.pages_in_use == 0
+
+
+def test_disagg_rejects_bad_mode(setup):
+    with pytest.raises(ValueError, match="prefill_mode"):
+        DisaggregatedBatcher(CFG, setup, prefill_mode="remote")
+
+
+# -- fleet bit-identity and knee -------------------------------------------
+
+
+def test_fleet_streams_match_per_replica_replays(setup):
+    prompts = _prompts()
+
+    def mk():
+        return ContinuousBatcher(CFG, setup, max_batch=2,
+                                 prefill_width=8, **PAGED)
+
+    router = FleetRouter([mk(), mk()])
+    fleet = _stream_all(router, prompts, BUDGETS)
+    assert router.stats["routed"] == len(prompts)
+    assert router.in_flight == 0
+    # reference: the same workload through ONE batcher — row
+    # independence makes each rid's stream a function of its prompt only
+    base = _stream_all(mk(), prompts, BUDGETS)
+    assert fleet == base
+    # replay each replica's pinned assignment on a fresh batcher: the
+    # routing trace fully determines the fleet's execution
+    assigned = router.assignments()
+    assert sorted(r for rids in assigned.values() for r in rids) == \
+        sorted(range(len(prompts)))
+    for rids in assigned.values():
+        if not rids:
+            continue
+        replayed = _stream_all(mk(), [prompts[r] for r in rids],
+                               [BUDGETS[r] for r in rids], rids=rids)
+        assert replayed == {r: fleet[r] for r in rids}
+
+
+def test_fleet_replay_point_carries_routing_view(setup):
+    prompts = _prompts()
+
+    def mk():
+        return ContinuousBatcher(CFG, setup, max_batch=2,
+                                 prefill_width=8, **PAGED)
+
+    router = FleetRouter([mk(), mk()])
+    pt = loadgen.replay_fleet(
+        router, loadgen.arrival_trace(len(prompts), 1e4, "lognormal", 0),
+        prompts, BUDGETS)
+    assert pt["replicas"] == 2
+    assert pt["routed"] == pt["completed"] == len(prompts)
+    assert sum(r["assigned"] for r in pt["per_replica"]) == len(prompts)
+    assert pt["kv_pages_peak"] == sum(
+        r["kv_pages_peak"] for r in pt["per_replica"])
+
+
+def test_fleet_knee_not_below_single_replica(setup):
+    budget = 6
+    nr = 6
+
+    def prompt_fn(i, prng):
+        return prng.integers(1, 97,
+                             size=int(prng.integers(3, 8))).tolist()
+
+    def mk():
+        return ContinuousBatcher(CFG, setup, max_batch=2,
+                                 prefill_width=8, **PAGED)
+
+    prng = np.random.default_rng(0)
+    prompts = [prompt_fn(i, prng) for i in range(nr)]
+    loadgen.warm(mk, prompts, [budget] * nr)
+    probe = loadgen.replay(
+        mk(), loadgen.arrival_trace(nr, 1e4, "lognormal", 0),
+        prompts, [budget] * nr)
+    peak = max(probe["goodput_rps"], 1e-3)
+    # the same conservative sub-saturation grid for both sweeps: the
+    # fleet must serve at least every rate one replica serves
+    grid = [peak * 0.4, peak * 0.8]
+    single = loadgen.saturation_sweep(
+        mk, grid, nr, prompt_fn, budget, seed=0, warmup=False)
+    fleet = loadgen.saturation_sweep(
+        lambda: FleetRouter([mk(), mk()]), grid, nr, prompt_fn, budget,
+        seed=0, warmup=False, replay_fn=loadgen.replay_fleet)
+    assert (fleet["knee_qps"] or 0.0) >= (single["knee_qps"] or 0.0)
+    assert all(pt["routed"] == nr for pt in fleet["points"])
+
+
+def test_fleet_replicas_share_compiled_programs(setup):
+    def mk():
+        return ContinuousBatcher(CFG, setup, max_batch=2,
+                                 prefill_width=8, **PAGED)
+
+    mk()
+    size0 = _programs.cache_info().currsize
+    router = FleetRouter([mk(), mk()])  # noqa: F841  (same-shape fleet)
+    assert _programs.cache_info().currsize == size0

@@ -58,7 +58,10 @@ def test_partition_permutation_changes_assignment(heart_df, heart):
     assert p1 != p2
 
 
-@pytest.mark.parametrize("nr_clients", [2, 4])
+@pytest.mark.parametrize(
+    "nr_clients",
+    [2, pytest.param(4, marks=pytest.mark.slow)],  # nr_clients=2 keeps train coverage fast
+)
 def test_vfl_network_trains(heart, heart_df, nr_clients):
     raw = [c for c in heart_df.columns if c != "target"]
     parts = partition_features(raw, heart.feature_names, CATEGORICAL, nr_clients)

@@ -38,9 +38,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# --cohort-shard compiles shard_map programs over several virtual CPU
+# --cohort-shard / --tp-kv compile SPMD programs over several virtual CPU
 # devices; the flag must land in XLA_FLAGS BEFORE the backend initialises
-if "--cohort-shard" in sys.argv:
+if "--cohort-shard" in sys.argv or "--tp-kv" in sys.argv:
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
@@ -306,6 +306,98 @@ def kv_pages_estimate(occupancies, *, max_batch: int = 8, ctx: int = 256,
     return rows
 
 
+def tp_kv_estimate(worlds, *, max_batch: int = 8, ctx: int = 256,
+                   kv_page: int = 16) -> list:
+    """AOT argument-bytes cross-check of the TP head-partitioned KV pool
+    (serving_fleet/tp.py): compile the paged decode step at each world
+    size W twice — params TP-sharded both times, pool HEAD-SHARDED vs
+    pool replicated — and read XLA's per-shard ``memory_analysis()``
+    argument bytes.  Under SPMD those are per-device, so the delta
+    between the two compiles IS the resident-KV saving of the head
+    split: ``pool_bytes * (1 - 1/W)`` per shard.  Asserts the measured
+    delta matches that analytic drop, i.e. the pool really is ~W× smaller
+    per device, as a compiled-program property and not a formula."""
+    import dataclasses
+    import functools
+
+    from ddl25spring_tpu.models import serving as srv
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.parallel.tp import llama_tp_shardings
+    from ddl25spring_tpu.serving_fleet.tp import (kv_head_sharding,
+                                                  make_model_mesh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nr_devices = len(jax.devices())
+    worlds = [w for w in worlds if w <= nr_devices]
+    # head counts divisible by every world size under test
+    cfg = LlamaConfig(vocab_size=128, dmodel=64, nr_heads=8,
+                      nr_kv_heads=4, nr_layers=2, ctx_size=ctx,
+                      decode_impl="xla")
+    params = jax.eval_shape(Llama(cfg).init, jax.random.key(0),
+                            jnp.zeros((1, 4), jnp.int32))
+    model = Llama(dataclasses.replace(cfg, decode=True))
+
+    def decode(params, pool, tok, pos, pad, tables):
+        logits, state = model.apply(
+            {**params, "cache": pool}, tok[:, None],
+            positions=pos[:, None], pad=pad, prefix_len=0,
+            block_tables=tables, mutable=["cache"],
+        )
+        return jnp.argmax(logits[:, 0], axis=-1), state["cache"]
+
+    B = max_batch
+    tok = jax.ShapeDtypeStruct((B,), jnp.int32)
+    pos = jax.ShapeDtypeStruct((B,), jnp.int32)
+    pad = jax.ShapeDtypeStruct((B,), jnp.int32)
+    cache = jax.eval_shape(
+        functools.partial(srv._empty_cache_of, model, B), params)
+    nr_pages = B * ctx // kv_page + 1  # + the reserved null page
+    pool = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(
+            (nr_pages, kv_page) + a.shape[2:], a.dtype), cache)
+    tables = jax.ShapeDtypeStruct((B, ctx // kv_page), jnp.int32)
+    pool_bytes = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                     for l in jax.tree.leaves(pool))
+
+    rows = []
+    for w in worlds:
+        mesh = make_model_mesh(w, devices=jax.devices()[:w])
+        repl = NamedSharding(mesh, P())
+        p_sh = llama_tp_shardings(mesh, params, "model")
+        pool_sh = jax.tree.map(
+            lambda l: kv_head_sharding(mesh, l), pool)
+        pool_repl = jax.tree.map(lambda l: repl, pool)
+
+        def compile_args(pool_in):
+            compiled = jax.jit(
+                decode,
+                in_shardings=(p_sh, pool_in, repl, repl, repl, repl),
+            ).lower(params, pool, tok, pos, pad, tables).compile()
+            return int(getattr(compiled.memory_analysis(),
+                               "argument_size_in_bytes", 0))
+
+        sharded = compile_args(pool_sh)
+        replicated = compile_args(pool_repl)
+        analytic = pool_bytes - pool_bytes // w
+        measured = replicated - sharded
+        rows.append({
+            "world": w,
+            "pool_bytes": pool_bytes,
+            "pool_bytes_per_shard": pool_bytes // w,
+            "argument_bytes_pool_sharded": sharded,
+            "argument_bytes_pool_replicated": replicated,
+            "measured_delta": measured,
+            "analytic_delta": analytic,
+        })
+        # per-shard argument bytes are the AOT ground truth: sharding the
+        # pool must shed exactly the (1 - 1/W) slice of its bytes
+        assert abs(measured - analytic) <= max(4096, analytic // 20), (
+            f"per-shard argument delta {measured:,} B at W={w} diverges "
+            f"from the analytic head-split saving {analytic:,} B"
+        )
+    return rows
+
+
 def cohort_shard_estimate(nr_clients: int, nr_sampled: int, chunk: int,
                           worlds) -> dict:
     """AOT memory of the cohort-SHARDED round (fl/sharding.py) across
@@ -440,8 +532,15 @@ def main(argv=None) -> int:
                     help="serving ctx_size for --kv-pages")
     ap.add_argument("--kv-page", type=int, default=16,
                     help="tokens per KV page for --kv-pages")
+    ap.add_argument("--tp-kv", action="store_true",
+                    help="estimate the TP head-partitioned KV pool "
+                         "instead (serving_fleet/tp.py): per-shard AOT "
+                         "argument bytes of the paged decode with the "
+                         "pool head-sharded vs replicated across "
+                         "--worlds; asserts the ~Wx per-shard drop")
     ap.add_argument("--worlds", default="1,2,4",
-                    help="comma-separated shard counts for --cohort-shard")
+                    help="comma-separated shard counts for --cohort-shard "
+                         "and --tp-kv")
     ap.add_argument("--chunk", type=int, default=4,
                     help="client_chunk for --cohort-shard's chunked cells")
     ap.add_argument("--dim", type=int, default=4096,
@@ -474,6 +573,26 @@ def main(argv=None) -> int:
             "metric": "cohort_shard_memory_estimate",
             "target": args.target,
             **out,
+        }))
+        return 0
+
+    if args.tp_kv:
+        worlds = [int(w) for w in args.worlds.split(",") if w.strip()]
+        rows = tp_kv_estimate(worlds, max_batch=args.kv_batch,
+                              ctx=args.kv_ctx, kv_page=args.kv_page)
+        for r in rows:
+            print(f"  W={r['world']}: pool {r['pool_bytes']:>10,} B -> "
+                  f"per-shard {r['pool_bytes_per_shard']:>10,} B   "
+                  f"args sharded {r['argument_bytes_pool_sharded']:>12,} B"
+                  f"   replicated "
+                  f"{r['argument_bytes_pool_replicated']:>12,} B",
+                  file=sys.stderr)
+        print(json.dumps({
+            "metric": "tp_kv_memory_estimate",
+            "target": args.target,
+            "max_batch": args.kv_batch, "ctx_size": args.kv_ctx,
+            "kv_page": args.kv_page,
+            "worlds": rows,
         }))
         return 0
 

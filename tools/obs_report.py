@@ -437,6 +437,52 @@ def report(events: list[dict], top: int) -> None:
             total = sum(state["value"] for _, state in reject_reasons)
             print(f"  admission rejects: {parts}   (total {total})")
 
+    # -- fleet serving (serving_fleet.FleetRouter) -----------------------
+    routed = take(counters, "fleet_routed_total")
+    rerouted = take(counters, "fleet_rerouted_total")
+    fleet_rej = _value(counters, "fleet_rejected_total")
+    take(counters, "fleet_rejected_total")
+    q_wait = take(gauges, "fleet_replica_queue_wait_s")
+    drain = {lb.get("replica"): st
+             for lb, st in take(gauges, "fleet_replica_drain_pps")}
+    offloaded = _value(counters, "serving_prefill_offloaded_total")
+    take(counters, "serving_prefill_offloaded_total")
+    if routed or rerouted or fleet_rej is not None or q_wait \
+            or offloaded is not None:
+        section("fleet serving")
+        if routed:
+            total = sum(st["value"] for _, st in routed)
+            parts = "   ".join(
+                f"r{lb.get('replica', '?')}={st['value']}"
+                for lb, st in sorted(
+                    routed, key=lambda ls: ls[0].get("replica", "")))
+            print(f"  requests routed: {total}   by replica: {parts}")
+        if rerouted:
+            reasons = "   ".join(
+                f"{lb.get('reason', '?')}={st['value']}"
+                for lb, st in sorted(
+                    rerouted, key=lambda ls: ls[0].get("reason", "")))
+            total = sum(st["value"] for _, st in rerouted)
+            print(f"  re-routes (replica rejected, next candidate took "
+                  f"it): {total}   by reason: {reasons}")
+        if fleet_rej is not None:
+            print(f"  rejected fleet-wide (every candidate refused): "
+                  f"{fleet_rej}")
+        if q_wait:
+            for lb, st in sorted(q_wait,
+                                 key=lambda ls: ls[0].get("replica", "")):
+                r = lb.get("replica", "?")
+                d = drain.get(r)
+                line = (f"  replica {r}: queue wait last "
+                        f"{fmt_seconds(st['value'])}  peak "
+                        f"{fmt_seconds(st.get('max', st['value']))}")
+                if d is not None:
+                    line += f"   drain {d['value']:.1f} pages/s"
+                print(line)
+        if offloaded is not None:
+            print(f"  prefills offloaded to dedicated workers "
+                  f"(disaggregated mode): {offloaded}")
+
     # -- speculative decoding --------------------------------------------
     proposed = _value(counters, "spec_proposed_total")
     accepted = _value(counters, "spec_accepted_total")
