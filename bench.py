@@ -624,6 +624,8 @@ def run_cpu_trend(nr_rounds: int = 2):
     serving_saturation = _serving_saturation_cell()
     _stamp("cpu trend: fleet routing cell ...")
     fleet_routing = _fleet_routing_cell()
+    _stamp("cpu trend: fleet chaos cell ...")
+    fleet_chaos = _fleet_chaos_cell()
     print(json.dumps({
         "metric": CPU_TREND_METRIC,
         "value": round(nr_rounds / dt, 4),
@@ -636,6 +638,7 @@ def run_cpu_trend(nr_rounds: int = 2):
         "cohort_scaling": cohort_scaling,
         "serving_saturation": serving_saturation,
         "fleet_routing": fleet_routing,
+        "fleet_chaos": fleet_chaos,
         "wall_s": round(time.perf_counter() - t_start, 1),
     }))
     sys.stdout.flush()
@@ -809,6 +812,70 @@ def _fleet_routing_cell(qps_factors=(0.5, 1.0, 2.0),
             "per_replica_assigned": [r["assigned"]
                                      for r in p["per_replica"]],
         } for p in sweep["points"]],
+    }
+
+
+def _fleet_chaos_cell(nr_requests: int = 8):
+    """Goodput-under-chaos next to the clean fleet replay: the fleet-
+    routing workload through a 3-replica fleet (breaker on) with replica
+    0 crashed mid-replay by the seeded fault schedule
+    (resilience/faults.py).  Exactly-once failover means every routed
+    request still completes with a dead replica; the cell tracks goodput
+    retention, failovers and tokens replayed — the trend that moves when
+    the failover or health path regresses."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu.models import loadgen
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+    from ddl25spring_tpu.resilience import ReplicaFaultSchedule
+    from ddl25spring_tpu.serving_fleet import (BreakerConfig, FleetHealth,
+                                               FleetRouter)
+
+    cfg = LlamaConfig(vocab_size=128, dmodel=48, nr_heads=4,
+                      nr_kv_heads=2, nr_layers=2, ctx_size=48,
+                      dtype=jnp.float32)
+    params = Llama(cfg).init(jax.random.PRNGKey(0),
+                             jnp.ones((1, 4), jnp.int32))
+    budget = 6
+
+    def make_replica():
+        return ContinuousBatcher(cfg, params, max_batch=2,
+                                 prefill_width=8, kv_layout="paged",
+                                 kv_page=8)
+
+    def make_fleet():
+        return FleetRouter(
+            [make_replica() for _ in range(3)],
+            health=FleetHealth(3, BreakerConfig()))
+
+    def prompt_fn(i, prng):
+        return prng.integers(1, 128,
+                             size=int(prng.integers(3, 8))).tolist()
+
+    prng = np.random.default_rng(0)
+    prompts = [prompt_fn(i, prng) for i in range(nr_requests)]
+    budgets = [budget] * nr_requests
+    # same shapes as the routing cell: everything is already compiled
+    loadgen.warm(make_replica, prompts, budgets)
+    trace = loadgen.arrival_trace(nr_requests, 1e4, "lognormal", 0)
+    clean = loadgen.replay_fleet(make_fleet(), trace, prompts, budgets)
+    sched = ReplicaFaultSchedule(crash_at=((0, 2),))
+    chaos = loadgen.replay_fleet(
+        loadgen.chaos_wrap(make_fleet(), sched), trace, prompts, budgets)
+    return {
+        "replicas": 3,
+        "schedule": sched.describe(),
+        "clean_goodput_rps": round(clean["goodput_rps"], 3),
+        "chaos_goodput_rps": round(chaos["goodput_rps"], 3),
+        "goodput_retention": round(
+            chaos["goodput_rps"] / max(clean["goodput_rps"], 1e-9), 3),
+        "completed": chaos["completed"],
+        "replicas_failed": chaos["replicas_failed"],
+        "failed_over": chaos["failed_over"],
+        "failover_tokens_replayed": chaos["failover_tokens_replayed"],
     }
 
 

@@ -26,8 +26,8 @@ import time
 
 import numpy as np
 
-__all__ = ["arrival_trace", "replay", "replay_fleet", "saturation_sweep",
-           "warm"]
+__all__ = ["arrival_trace", "chaos_wrap", "replay", "replay_fleet",
+           "saturation_sweep", "warm"]
 
 
 def arrival_trace(nr: int, qps: float, dist: str = "lognormal",
@@ -182,6 +182,9 @@ def replay_fleet(router, trace, prompts, budgets, *,
     routed0 = router.stats["routed"]
     rerouted0 = router.stats["rerouted"]
     by0 = dict(router.stats["rerouted_by_reason"])
+    fo0 = router.stats.get("failed_over", 0)
+    tr0 = router.stats.get("failover_tokens_replayed", 0)
+    rf0 = router.stats.get("replicas_failed", 0)
     pt = replay(router, trace, prompts, budgets, deadline_s=deadline_s)
     assigned = router.assignments()
     pt["replicas"] = len(router.replicas)
@@ -192,6 +195,10 @@ def replay_fleet(router, trace, prompts, budgets, *,
         for k, v in sorted(router.stats["rerouted_by_reason"].items())
         if v - by0.get(k, 0)
     }
+    pt["failed_over"] = router.stats.get("failed_over", 0) - fo0
+    pt["failover_tokens_replayed"] = (
+        router.stats.get("failover_tokens_replayed", 0) - tr0)
+    pt["replicas_failed"] = router.stats.get("replicas_failed", 0) - rf0
     pt["per_replica"] = [
         {
             "assigned": len(assigned.get(i, ())),
@@ -227,12 +234,28 @@ def warm(make_batcher, prompts, budgets, *,
         g *= 2
 
 
+def chaos_wrap(router, schedule):
+    """Wrap every replica of a ``FleetRouter`` in the seeded
+    :class:`~ddl25spring_tpu.resilience.faults.FaultyReplica` chaos
+    wrapper, in place.  Replica-level chaos needs a fleet — a crashed
+    single batcher has nothing to fail over to."""
+    from ..resilience.faults import FaultyReplica
+
+    if not hasattr(router, "replicas"):
+        raise ValueError(
+            "chaos replay needs a FleetRouter (something with "
+            ".replicas) — a single batcher cannot fail over")
+    router.replicas = [FaultyReplica(r, schedule, i)
+                       for i, r in enumerate(router.replicas)]
+    return router
+
+
 def saturation_sweep(make_batcher, qps_points, nr_requests, prompt_fn,
                      budget, *, dist: str = "lognormal", seed: int = 0,
                      deadline_s: float | None = None,
                      knee_frac: float = 0.9,
                      warmup: bool = True,
-                     replay_fn=None) -> dict:
+                     replay_fn=None, chaos=None) -> dict:
     """Replay the same seeded trace shape at each offered rate in
     ``qps_points`` (ascending) against a FRESH batcher per point from
     ``make_batcher()`` — program caches inside the batcher make the
@@ -249,6 +272,13 @@ def saturation_sweep(make_batcher, qps_points, nr_requests, prompt_fn,
     :func:`replay`); pass :func:`replay_fleet` with a ``make_batcher``
     that builds a ``FleetRouter`` to sweep a fleet — every point then
     also carries the routing view.
+
+    ``chaos`` (a ``resilience.ReplicaFaultSchedule``) adds one EXTRA
+    replay at the measured knee rate with every replica wrapped in the
+    seeded fault injector (:func:`chaos_wrap`): the result grows a
+    ``"chaos"`` block reporting goodput-under-chaos next to the clean
+    knee, plus the failover/replay counters and the faults actually
+    injected.  Fleet-only (``replay_fn=replay_fleet``).
     """
     qps_points = sorted(float(q) for q in qps_points)
     rng = np.random.default_rng(seed)
@@ -264,8 +294,32 @@ def saturation_sweep(make_batcher, qps_points, nr_requests, prompt_fn,
         points.append(measure(batcher, trace, prompts, budgets,
                               deadline_s=deadline_s))
     knee = None
+    knee_pt = None
     for pt in points:
         if pt["goodput_rps"] >= knee_frac * pt["offered_qps"]:
             knee = pt["offered_qps"]
-    return {"dist": dist, "seed": seed, "nr_requests": nr_requests,
-            "knee_qps": knee, "knee_frac": knee_frac, "points": points}
+            knee_pt = pt
+    out = {"dist": dist, "seed": seed, "nr_requests": nr_requests,
+           "knee_qps": knee, "knee_frac": knee_frac, "points": points}
+    if chaos is not None:
+        qps = knee if knee is not None else qps_points[0]
+        trace = arrival_trace(nr_requests, qps, dist, seed)
+        router = chaos_wrap(make_batcher(), chaos)
+        pt = measure(router, trace, prompts, budgets,
+                     deadline_s=deadline_s)
+        injected: dict = {}
+        for r in router.replicas:
+            for k, v in getattr(r, "fault_counts", {}).items():
+                if v:
+                    injected[k] = injected.get(k, 0) + v
+        clean = knee_pt["goodput_rps"] if knee_pt else None
+        out["chaos"] = {
+            "schedule": chaos.describe(),
+            "at_qps": qps,
+            "goodput_rps": pt["goodput_rps"],
+            "goodput_frac_of_clean": (pt["goodput_rps"] / clean
+                                      if clean else None),
+            "faults_injected": dict(sorted(injected.items())),
+            "point": pt,
+        }
+    return out

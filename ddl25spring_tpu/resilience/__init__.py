@@ -2,7 +2,8 @@
 
 - :mod:`.faults` — seeded deterministic :class:`FaultPlan` (dropout,
   stragglers, corrupted updates, serving stalls, crash points) parsed
-  from a compact spec string;
+  from a compact spec string, plus :class:`ReplicaFaultSchedule` /
+  :class:`FaultyReplica` for replica-level fleet chaos;
 - :mod:`.guard` — jit-side non-finite screening of stacked client
   updates and a host-side :class:`DivergenceGuard` for training loops;
 - :mod:`.retry` — bounded retry with exponential backoff + jitter and a
@@ -13,12 +14,21 @@
 See ``docs/RESILIENCE.md`` for the failure model and recipes.
 """
 
-from .faults import FaultPlan, InjectedCrash
+from .faults import (
+    FaultPlan,
+    FaultyReplica,
+    InjectedCrash,
+    ReplicaCrashed,
+    ReplicaFaultSchedule,
+)
 from .retry import Deadline, RetryError, backoff_delays, retry_call
 
 __all__ = [
     "FaultPlan",
+    "FaultyReplica",
     "InjectedCrash",
+    "ReplicaCrashed",
+    "ReplicaFaultSchedule",
     "DivergenceGuard",
     "ValidationGate",
     "screen_nonfinite",

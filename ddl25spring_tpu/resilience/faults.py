@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 import zlib
 from dataclasses import dataclass
 
@@ -49,6 +50,17 @@ class InjectedCrash(RuntimeError):
     """Raised by ``FaultPlan.maybe_crash`` at a ``crash=N`` point — an
     exception-shaped process death (stack unwinds; ``kill=N`` is the
     no-cleanup variant)."""
+
+
+class ReplicaCrashed(RuntimeError):
+    """A serving replica died: raised by :class:`FaultyReplica` at a
+    scheduled ``replica_crash`` point (and on every call after it — a
+    dead replica stays dead until swapped).  ``kind`` labels the fault
+    for the fleet failover counters."""
+
+    def __init__(self, message: str, kind: str = "replica_crash"):
+        super().__init__(message)
+        self.kind = kind
 
 
 _FLOAT_KEYS = ("drop", "nan", "inf", "serve_timeout")
@@ -222,3 +234,273 @@ class FaultPlan:
                 f"injected crash at step {step} (fault plan "
                 f"{self.describe() or 'crash'!r})"
             )
+
+
+# -- replica-level chaos (fleet serving) -----------------------------------
+
+
+_REPLICA_KINDS = ("replica_crash", "replica_hang", "replica_slow",
+                  "pool_leak")
+# spec key -> (kind tag used in the draw stream, counter label)
+_REPLICA_KEYS = {"crash": "replica_crash", "hang": "replica_hang",
+                 "slow": "replica_slow", "leak": "pool_leak"}
+
+
+def _parse_at(value: str) -> tuple:
+    """``R:S`` pairs joined by ``+`` -> ((replica, step), ...)."""
+    out = []
+    for tok in value.split("+"):
+        r, sep, s = tok.partition(":")
+        if not sep:
+            raise ValueError(f"expected replica:step, got {tok!r}")
+        out.append((int(r), int(s)))
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class ReplicaFaultSchedule:
+    """Seeded, deterministic replica-fault schedule for fleet chaos.
+
+    Every draw is a pure function of ``(seed, replica, step)`` — the
+    same crc32 host hashing :meth:`FaultPlan.serving_fault` uses, so a
+    chaos replay reproduces bit-for-bit across processes and tests can
+    re-derive exactly which faults fired where.  Kinds:
+
+    - ``replica_crash`` — the replica dies at the step boundary
+      (:class:`ReplicaCrashed` from ``step()``; stays dead);
+    - ``replica_hang``  — ``step()`` makes no progress for
+      ``hang_steps`` consecutive steps (a wedged device/host);
+    - ``replica_slow``  — ``slow_s`` of injected wall latency per step
+      (thermal throttling, a sick HBM lane);
+    - ``pool_leak``     — one KV page allocated and never freed
+      (allocator leak; residency-only, never corrupts streams).
+
+    Probabilistic rates (``crash``/``hang``/``slow``/``leak`` per
+    replica-step) and explicit points (``crash_at``/``hang_at``/
+    ``slow_at``/``leak_at`` as ``replica:step`` pairs joined by ``+``)
+    compose; spec grammar mirrors :class:`FaultPlan`::
+
+        ReplicaFaultSchedule.parse(
+            "crash_at=1:3,slow=0.2:0.01,hang=0.05:4,seed=7")
+    """
+
+    seed: int = 0
+    crash: float = 0.0        # per-(replica, step) death probability
+    hang: float = 0.0         # probability a hang window STARTS
+    hang_steps: int = 4       # length of each hang window
+    slow: float = 0.0         # per-step injected-latency probability
+    slow_s: float = 0.02      # injected wall latency per slow step
+    leak: float = 0.0         # per-step one-page pool-leak probability
+    crash_at: tuple = ()      # explicit ((replica, step), ...) points
+    hang_at: tuple = ()
+    slow_at: tuple = ()
+    leak_at: tuple = ()
+
+    @classmethod
+    def parse(cls, spec: str | None) -> "ReplicaFaultSchedule | None":
+        """``None``/empty -> ``None`` (no chaos; callers keep the exact
+        fault-free path)."""
+        if not spec:
+            return None
+        kw: dict = {}
+        for token in spec.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            key, sep, value = token.partition("=")
+            key, value = key.strip(), value.strip()
+            if not sep or not value:
+                raise ValueError(
+                    f"chaos spec token {token!r} is not key=value "
+                    f"(full spec: {spec!r})")
+            try:
+                if key in ("crash", "leak"):
+                    kw[key] = float(value)
+                elif key == "hang":
+                    prob, _, steps = value.partition(":")
+                    kw["hang"] = float(prob)
+                    if steps:
+                        kw["hang_steps"] = int(steps)
+                elif key == "slow":
+                    prob, _, delay = value.partition(":")
+                    kw["slow"] = float(prob)
+                    if delay:
+                        kw["slow_s"] = float(delay)
+                elif key in ("crash_at", "hang_at", "slow_at", "leak_at"):
+                    kw[key] = _parse_at(value)
+                elif key == "seed":
+                    kw[key] = int(value)
+                else:
+                    raise KeyError(key)
+            except KeyError:
+                raise ValueError(
+                    f"unknown chaos kind {key!r} in spec {spec!r}; known: "
+                    "crash, hang, slow, leak, crash_at, hang_at, slow_at, "
+                    "leak_at, seed") from None
+            except ValueError as e:
+                raise ValueError(
+                    f"bad value for {key!r} in chaos spec {spec!r}: {e}"
+                ) from None
+        sched = cls(**kw)
+        sched.validate()
+        return sched
+
+    def validate(self) -> None:
+        for key in ("crash", "hang", "slow", "leak"):
+            v = getattr(self, key)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(
+                    f"{key}={v} outside [0, 1] — chaos rates are "
+                    "probabilities")
+        if self.hang_steps < 1:
+            raise ValueError(f"hang_steps={self.hang_steps} must be >= 1")
+        if self.slow_s < 0:
+            raise ValueError(f"slow_s={self.slow_s} must be >= 0")
+
+    def describe(self) -> str:
+        """Round-trippable spec string (``parse(describe())`` is the
+        same schedule) — goes in bench JSON so a chaos point can be
+        replayed bit-for-bit."""
+        parts = []
+        if self.crash:
+            parts.append(f"crash={self.crash}")
+        if self.hang:
+            parts.append(f"hang={self.hang}:{self.hang_steps}")
+        if self.slow:
+            parts.append(f"slow={self.slow}:{self.slow_s}")
+        if self.leak:
+            parts.append(f"leak={self.leak}")
+        for name in ("crash_at", "hang_at", "slow_at", "leak_at"):
+            pts = getattr(self, name)
+            if pts:
+                parts.append(f"{name}="
+                             + "+".join(f"{r}:{s}" for r, s in pts))
+        if self.seed:
+            parts.append(f"seed={self.seed}")
+        return ",".join(parts)
+
+    def _hit(self, tag: str, replica: int, step: int, prob: float) -> bool:
+        if prob <= 0.0:
+            return False
+        h = zlib.crc32(f"{tag}:{replica}:{step}".encode()) \
+            ^ (self.seed * 0x9E3779B1)
+        return (h & 0xFFFFFFFF) / 2.0 ** 32 < prob
+
+    def faults_at(self, replica: int, step: int) -> tuple:
+        """Fault kinds active for ``replica`` at ``step`` — the pure
+        (seed, replica, step) function both the :class:`FaultyReplica`
+        wrapper and test oracles evaluate.  A hang window started at s
+        covers steps [s, s + hang_steps)."""
+        kinds = []
+        if ((replica, step) in self.crash_at
+                or self._hit("crash", replica, step, self.crash)):
+            kinds.append("replica_crash")
+        hung = any((replica, s) in self.hang_at
+                   or self._hit("hang", replica, s, self.hang)
+                   for s in range(max(0, step - self.hang_steps + 1),
+                                  step + 1))
+        if hung:
+            kinds.append("replica_hang")
+        if ((replica, step) in self.slow_at
+                or self._hit("slow", replica, step, self.slow)):
+            kinds.append("replica_slow")
+        if ((replica, step) in self.leak_at
+                or self._hit("leak", replica, step, self.leak)):
+            kinds.append("pool_leak")
+        return tuple(kinds)
+
+
+class FaultyReplica:
+    """Chaos wrapper over one serving replica (a ``ContinuousBatcher``
+    or any submit/step duck type) applying a
+    :class:`ReplicaFaultSchedule` at step boundaries.
+
+    Pure host code, jax-free — fleet chaos tests run in tier-1 with
+    fake replicas.  Every attribute the router/policy reads (queue,
+    slots, pool, EWMAs) forwards to the wrapped replica, so placement
+    decisions see through the wrapper unchanged; with an empty schedule
+    the wrapper is behaviorally invisible.
+
+    Fault semantics: ``replica_crash`` raises :class:`ReplicaCrashed`
+    from the current and every later call (a dead replica stays dead
+    until the router swaps it); ``replica_hang`` makes ``step()``
+    return ``{}`` without touching the replica; ``replica_slow`` sleeps
+    ``slow_s`` before the real step; ``pool_leak`` allocates one page
+    from the replica's KV pool and drops it on the floor.
+    """
+
+    def __init__(self, replica, schedule: ReplicaFaultSchedule,
+                 index: int):
+        self._replica = replica
+        self._schedule = schedule
+        self.index = int(index)
+        self.chaos_step = 0       # step-boundary clock for the schedule
+        self.dead = False
+        self.leaked_pages: list = []
+        self.fault_counts = {k: 0 for k in _REPLICA_KINDS}
+
+    def __getattr__(self, name):
+        # fallback only (submit/step/etc. defined below): the router and
+        # policy read host state straight through the wrapper
+        return getattr(self._replica, name)
+
+    def _note(self, kind: str):
+        self.fault_counts[kind] += 1
+        obs.inc("resilience_faults_injected_total", kind=kind)
+
+    def _check_dead(self):
+        if self.dead:
+            raise ReplicaCrashed(
+                f"replica {self.index} is dead (crashed at chaos step "
+                f"{self.chaos_step - 1})")
+
+    @property
+    def in_flight(self) -> int:
+        return self._replica.in_flight
+
+    def submit(self, rid, prompt, max_new_tokens, deadline_s=None):
+        self._check_dead()
+        return self._replica.submit(rid, prompt, max_new_tokens,
+                                    deadline_s=deadline_s)
+
+    def step(self) -> dict:
+        self._check_dead()
+        k = self.chaos_step
+        self.chaos_step += 1
+        kinds = self._schedule.faults_at(self.index, k)
+        if "replica_crash" in kinds:
+            self.dead = True
+            self._note("replica_crash")
+            raise ReplicaCrashed(
+                f"replica {self.index} crashed at chaos step {k} "
+                "(scheduled fault)")
+        if "pool_leak" in kinds:
+            pool = getattr(self._replica, "_pool", None)
+            if pool is not None:
+                page = pool.alloc(1)
+                if page is not None:
+                    self.leaked_pages.extend(page)
+                    self._note("pool_leak")
+        if "replica_hang" in kinds:
+            self._note("replica_hang")
+            return {}  # no progress: the wedged-host signature
+        if "replica_slow" in kinds:
+            self._note("replica_slow")
+            time.sleep(self._schedule.slow_s)
+        return self._replica.step()
+
+    def partial_tokens(self) -> dict:
+        """Host-int tokens already streamed per in-flight rid (active
+        slots; queued rids have none).  The fleet failover path salvages
+        these — they reached the router before the fault, so the
+        replacement replica re-prefills instead of re-decoding them.
+        Readable even after death: the tokens crossed the wire before
+        the crash."""
+        out: dict = {}
+        for sl in getattr(self._replica, "slots", ()):
+            rid = getattr(sl, "request_id", None)
+            if rid is None:
+                continue
+            out[rid] = [t for t in getattr(sl, "emitted", ())
+                        if isinstance(t, int)]
+        return out

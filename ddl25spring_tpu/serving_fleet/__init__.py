@@ -13,21 +13,27 @@ Three layers (docs/PERFORMANCE.md §8):
                 handed over through the shared ``PrefixRegistry``.
 - ``router``  — :class:`FleetRouter`: host-side prefix-affinity +
                 least-load + SLO-slack routing over N replicas, bounded
-                re-route on rejection, autoscaling gauges via ``obs``.
+                re-route on rejection, per-replica fault isolation with
+                exactly-once failover, autoscaling gauges via ``obs``.
+- ``health``  — :class:`FleetHealth`: per-replica circuit breaker
+                (healthy → suspect → open → half-open) fed by the
+                router's step signals (docs/RESILIENCE.md §9).
 
-``policy`` and ``router`` are HOST modules and never import jax (so
-routing logic is unit-testable anywhere); importing this package keeps
-that property — the jax-backed layers load lazily on first attribute
-access.
+``policy``, ``router`` and ``health`` are HOST modules and never import
+jax (so routing logic is unit-testable anywhere); importing this package
+keeps that property — the jax-backed layers load lazily on first
+attribute access.
 """
 
 from __future__ import annotations
 
+from .health import BreakerConfig, FleetHealth
 from .policy import ReplicaSnapshot, rank_replicas, snapshot_replica
-from .router import FleetRouter
+from .router import FleetRouter, NoReplicaAvailable
 
 __all__ = [
-    "DisaggregatedBatcher", "FleetRouter", "PrefillWorker",
+    "BreakerConfig", "DisaggregatedBatcher", "FleetHealth",
+    "FleetRouter", "NoReplicaAvailable", "PrefillWorker",
     "ReplicaSnapshot", "TPShardedBatcher", "headsharded_flash_decode",
     "make_model_mesh", "rank_replicas", "snapshot_replica",
 ]

@@ -1,0 +1,245 @@
+"""Per-replica health tracking and circuit breaking for the fleet.
+
+Pure host code, jax-free (like ``policy``/``router``): the breaker is a
+four-state machine per replica, fed only by signals the router already
+observes while stepping —
+
+- **step exceptions** (a replica raising from ``step()`` is a crash:
+  straight to ``open``);
+- **stalled steps** (in-flight work but zero progress — no finishes
+  and no new streamed tokens: the wedged-host signature a hang
+  injects);
+- **step-latency EWMA** (a step taking ``latency_factor``× the
+  replica's own smoothed step time is a sick-hardware strike);
+- **drain-rate collapse** (the ``fleet_replica_drain_pps`` gauge
+  falling below ``drain_collapse``× its own peak).
+
+States and routing consequences (``policy.rank_replicas``)::
+
+    healthy   --strikes >= suspect_after-->  suspect    (demoted)
+    suspect   --strikes >= open_after---->   open       (excluded)
+    open      --half_open_after ticks---->   half_open  (one canary)
+    half_open --canary finishes---------->   healthy    (closed)
+    half_open --any strike--------------->   open       (re-opened)
+
+``suspect`` replicas are demoted behind every healthy one but still
+eligible (graceful under false positives); ``open`` replicas receive no
+placements at all; ``half_open`` admits exactly one canary request —
+its completion is the recovery proof that closes the breaker, and any
+strike while probing re-opens it.  A crash is terminal for routing
+(the router never steps a dead replica again) but the breaker still
+records the ``open`` transition so the obs counters tell the story.
+
+Every transition increments
+``fleet_breaker_transitions_total{replica=,to=}`` and is mirrored in
+the host-side ``transitions`` dict so tests assert exact counts without
+the obs registry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import obs
+
+__all__ = ["BreakerConfig", "FleetHealth"]
+
+_STATES = ("healthy", "suspect", "open", "half_open")
+
+
+@dataclass(frozen=True)
+class BreakerConfig:
+    """Thresholds for the per-replica breaker state machine.
+
+    ``suspect_after``/``open_after`` are consecutive-strike counts (a
+    clean step resets them); ``half_open_after`` is in router steps
+    (the breaker's tick clock — the router ticks once per fleet
+    ``step()``).  ``latency_factor`` breaches only after
+    ``latency_warmup`` samples have seeded the EWMA, so cold replicas
+    are never punished for compile time.
+    """
+
+    suspect_after: int = 2       # consecutive strikes -> suspect
+    open_after: int = 4          # consecutive strikes -> open
+    half_open_after: int = 8     # ticks open -> half_open (canary)
+    latency_factor: float = 4.0  # step slower than factor*EWMA: strike
+    latency_warmup: int = 5      # EWMA samples before latency strikes
+    ewma_alpha: float = 0.2
+    drain_collapse: float = 0.1  # drain_pps below factor*peak: strike
+
+    def validate(self) -> None:
+        if not 0 < self.suspect_after <= self.open_after:
+            raise ValueError(
+                f"need 0 < suspect_after <= open_after, got "
+                f"{self.suspect_after}/{self.open_after}")
+        if self.half_open_after < 1:
+            raise ValueError(
+                f"half_open_after={self.half_open_after} must be >= 1")
+        if not 0.0 <= self.drain_collapse < 1.0:
+            raise ValueError(
+                f"drain_collapse={self.drain_collapse} outside [0, 1)")
+
+
+@dataclass
+class _ReplicaHealth:
+    state: str = "healthy"
+    strikes: int = 0
+    lat_ewma: float = 0.0
+    lat_n: int = 0
+    drain_peak: float = 0.0
+    opened_at: int = -1          # tick the breaker last opened
+    canary: object = None        # half-open probe rid, None when free
+
+
+class FleetHealth:
+    """Breaker state machine over ``nr_replicas`` replicas.
+
+    The router drives it: ``tick()`` once per fleet step,
+    ``record_step`` after each replica step, ``record_crash`` when a
+    replica raises, ``note_placed``/``note_finished`` around request
+    lifecycle, and ``admits``/``state`` when building routing
+    snapshots.
+    """
+
+    def __init__(self, nr_replicas: int,
+                 config: BreakerConfig | None = None):
+        if nr_replicas < 1:
+            raise ValueError("FleetHealth needs at least one replica")
+        self.config = config or BreakerConfig()
+        self.config.validate()
+        self._replicas = [_ReplicaHealth() for _ in range(nr_replicas)]
+        self._ticks = 0
+        self.transitions: dict = {}   # (replica, to_state) -> count
+
+    # -- state machine ---------------------------------------------------
+
+    def _goto(self, i: int, state: str) -> None:
+        h = self._replicas[i]
+        if h.state == state:
+            return
+        h.state = state
+        key = (i, state)
+        self.transitions[key] = self.transitions.get(key, 0) + 1
+        obs.inc("fleet_breaker_transitions_total", replica=str(i),
+                to=state)
+        if state == "open":
+            h.opened_at = self._ticks
+            h.canary = None
+        elif state == "healthy":
+            h.strikes = 0
+            h.canary = None
+
+    def _strike(self, i: int) -> None:
+        h = self._replicas[i]
+        if h.state == "open":
+            return
+        if h.state == "half_open":
+            # the probe disproved recovery: straight back to open
+            self._goto(i, "open")
+            return
+        h.strikes += 1
+        if h.strikes >= self.config.open_after:
+            self._goto(i, "open")
+        elif h.strikes >= self.config.suspect_after:
+            self._goto(i, "suspect")
+
+    def _clear(self, i: int) -> None:
+        h = self._replicas[i]
+        h.strikes = 0
+        if h.state == "suspect":
+            self._goto(i, "healthy")
+
+    # -- signals from the router ----------------------------------------
+
+    def tick(self) -> None:
+        """Advance the breaker clock one router step; open breakers old
+        enough become half-open (ready to take a canary)."""
+        self._ticks += 1
+        for i, h in enumerate(self._replicas):
+            if (h.state == "open" and h.opened_at >= 0
+                    and self._ticks - h.opened_at
+                    >= self.config.half_open_after):
+                self._goto(i, "half_open")
+
+    def record_step(self, i: int, latency_s: float, progress: int,
+                    in_flight: int, drain_pps: float | None = None
+                    ) -> None:
+        """One replica step completed without raising; classify it as a
+        strike (stall / latency breach / drain collapse) or a clean
+        step (resets the strike count).  ``progress`` is finishes plus
+        net new streamed tokens — the router's measure of whether the
+        step actually moved work."""
+        cfg = self.config
+        h = self._replicas[i]
+        struck = False
+        if in_flight > 0 and progress == 0:
+            struck = True             # work pending, zero progress
+        if (h.lat_n >= cfg.latency_warmup and h.lat_ewma > 0.0
+                and latency_s > cfg.latency_factor * h.lat_ewma):
+            struck = True
+        else:
+            # only clean-ish steps feed the EWMA, so a wedged replica
+            # cannot drag its own baseline up to mask the breach
+            h.lat_ewma = (latency_s if h.lat_n == 0 else
+                          (1.0 - cfg.ewma_alpha) * h.lat_ewma
+                          + cfg.ewma_alpha * latency_s)
+            h.lat_n += 1
+        if drain_pps is not None and drain_pps > 0.0:
+            if (h.drain_peak > 0.0
+                    and drain_pps < cfg.drain_collapse * h.drain_peak):
+                struck = True
+            h.drain_peak = max(h.drain_peak, drain_pps)
+        if struck:
+            self._strike(i)
+        elif progress > 0 or in_flight == 0:
+            self._clear(i)
+
+    def record_crash(self, i: int) -> None:
+        """A replica raised from ``step()``/``submit()``: open
+        immediately, whatever the strike count."""
+        self._goto(i, "open")
+
+    # -- queries from the router ----------------------------------------
+
+    def state(self, i: int) -> str:
+        return self._replicas[i].state
+
+    def admits(self, i: int) -> bool:
+        """May replica ``i`` receive a NEW placement right now?  Open:
+        never.  Half-open: only while no canary is outstanding."""
+        h = self._replicas[i]
+        if h.state == "open":
+            return False
+        if h.state == "half_open":
+            return h.canary is None
+        return True
+
+    def note_placed(self, i: int, rid) -> None:
+        h = self._replicas[i]
+        if h.state == "half_open" and h.canary is None:
+            h.canary = rid
+
+    def note_finished(self, i: int, rid) -> None:
+        """A request completed on replica ``i``; if it was the
+        half-open canary, that is the recovery proof — close."""
+        h = self._replicas[i]
+        if h.state == "half_open" and h.canary == rid:
+            self._goto(i, "healthy")
+
+    def note_evicted(self, i: int, rid) -> None:
+        """The canary left the replica without proving recovery
+        (deadline eviction, failover): free the probe slot so the next
+        placement can try again."""
+        h = self._replicas[i]
+        if h.canary == rid:
+            h.canary = None
+
+    def reset(self, i: int) -> None:
+        """Fresh state machine for slot ``i`` — the router swapped in a
+        new replica, so the old replica's history must not bias it."""
+        self._replicas[i] = _ReplicaHealth()
+
+    def describe(self) -> dict:
+        """Host-side summary for ``router.stats`` / debugging."""
+        return {i: {"state": h.state, "strikes": h.strikes}
+                for i, h in enumerate(self._replicas)}

@@ -10,13 +10,17 @@ or even jax in the process (tests/test_serving_fleet.py guards that).
 Ranking order (ties broken by the next key, then by replica index so the
 routing trace is deterministic):
 
-1. **SLO feasibility** — replicas whose estimated admission wait already
+1. **Breaker state** — ``open`` replicas are excluded from the ranking
+   entirely (no placements while the circuit is open); ``suspect``
+   replicas are demoted behind every healthy/half-open one, whatever
+   their affinity or load (``serving_fleet.health``).
+2. **SLO feasibility** — replicas whose estimated admission wait already
    exceeds their SLO would reject; they go last, whatever their affinity.
-2. **Prefix affinity** — a replica that already holds the request's
+3. **Prefix affinity** — a replica that already holds the request's
    prefix pages (ctor ``prefix_tokens``) or served the same prompt head
    recently skips prefill work and reuses warm KV pages.
-3. **Least load** — fewest queued + active requests.
-4. **SLO slack** — at equal load, the replica with the most headroom.
+4. **Least load** — fewest queued + active requests.
+5. **SLO slack** — at equal load, the replica with the most headroom.
 """
 
 from __future__ import annotations
@@ -43,6 +47,7 @@ class ReplicaSnapshot:
     prefix_hit: bool = False
     est_wait_s: float = 0.0
     slo_slack_s: float = float("inf")
+    health_state: str = "healthy"   # serving_fleet.health breaker state
 
     @property
     def load(self) -> int:
@@ -50,10 +55,17 @@ class ReplicaSnapshot:
 
 
 def rank_replicas(snapshots) -> list[int]:
-    """Replica indices in routing-preference order (best first)."""
+    """Replica indices in routing-preference order (best first).
+
+    ``open``-breaker replicas are dropped, not just demoted — placing
+    on them would feed a replica already proven unhealthy.  ``suspect``
+    replicas stay eligible (the breaker may be wrong) but behind every
+    non-suspect one.
+    """
     return [s.index for s in sorted(
-        snapshots,
+        (s for s in snapshots if s.health_state != "open"),
         key=lambda s: (
+            1 if s.health_state == "suspect" else 0,  # demote suspects
             1 if s.slo_slack_s <= 0.0 else 0,   # would reject: last
             0 if s.prefix_hit else 1,            # warm prefix first
             s.load,                              # then least loaded
@@ -64,7 +76,8 @@ def rank_replicas(snapshots) -> list[int]:
 
 
 def snapshot_replica(index: int, batcher, prompt, budget: int, *,
-                     affinity_hit: bool = False) -> ReplicaSnapshot:
+                     affinity_hit: bool = False,
+                     health_state: str = "healthy") -> ReplicaSnapshot:
     """Build a snapshot from a live batcher by reading HOST state only
     (queue, slots, EWMAs) — no device round trip, no jax import.
 
@@ -95,4 +108,5 @@ def snapshot_replica(index: int, batcher, prompt, budget: int, *,
         index=index, queue_len=queue_len, active=active,
         free_slots=len(slots) - active, prefix_hit=hit,
         est_wait_s=est_wait, slo_slack_s=slack,
+        health_state=health_state,
     )

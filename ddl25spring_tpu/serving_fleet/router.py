@@ -3,8 +3,8 @@
 The router owns request placement only; each replica keeps its own
 queue, pool, admission control and compiled programs (which the
 ``_programs`` lru shares across same-shape replicas — N replicas compile
-ONCE).  Placement is prefix-affinity + least-load + SLO-slack
-(``serving_fleet.policy``); a replica that still rejects
+ONCE).  Placement is breaker-state + prefix-affinity + least-load +
+SLO-slack (``serving_fleet.policy``); a replica that still rejects
 (:class:`~ddl25spring_tpu.models.serving.AdmissionRejected` — queue
 full, SLO, pool) triggers a bounded re-route to the next-ranked replica
 through :func:`~ddl25spring_tpu.resilience.retry.retry_call`, reusing
@@ -12,11 +12,28 @@ the rejection's ``reason``/``retry_after_s`` for telemetry and for the
 error the caller finally sees (the rejection with the SOONEST
 ``retry_after_s`` across the fleet).
 
+Fault tolerance (``docs/RESILIENCE.md`` §9):
+
+- **isolation** — ``step()`` steps each replica under its own
+  try/except; one replica raising no longer kills the fleet step;
+- **health** — pass ``health=FleetHealth(n)`` and every step feeds the
+  per-replica breaker (``serving_fleet.health``); open replicas receive
+  no placements, suspects are demoted, half-open admits one canary;
+- **exactly-once failover** — a replica that raises from ``step()`` is
+  dead for good (never stepped or placed again, so its in-flight work
+  can never surface twice); every rid it owned is re-submitted to a
+  surviving replica, re-prefilled from the original prompt plus the
+  tokens already streamed (salvaged from the dead replica's slots), and
+  the final stream is stitched so the caller sees no gap and no
+  duplicate.  ``fail_replica``/``drain_replica``/``swap_replica`` give
+  operators the same machinery for rolling restarts.
+
 Autoscaling signals ride on ``obs``: per-replica queue-wait and
 measured page-drain-rate gauges (``fleet_replica_queue_wait_s``,
-``fleet_replica_drain_pps``) plus routing counters — these are the
-inputs a scaler needs to decide "add a replica" (queue wait growing
-fleet-wide) vs "rebalance" (one replica hot).
+``fleet_replica_drain_pps``) plus routing/failover counters — these are
+the inputs a scaler needs to decide "add a replica" (queue wait growing
+fleet-wide) vs "rebalance" (one replica hot) vs "replace" (breakers
+opening).
 
 Like ``policy``, this module never imports jax: rejections are matched
 structurally (``reason``/``retry_after_s`` attributes) so the router —
@@ -26,12 +43,13 @@ and its tests — run with fake replicas in a jax-free process.
 from __future__ import annotations
 
 import time
+from collections import deque
 
 from .. import obs
 from ..resilience.retry import RetryError, retry_call
 from . import policy
 
-__all__ = ["FleetRouter"]
+__all__ = ["FleetRouter", "NoReplicaAvailable"]
 
 
 class _Rerouted(RuntimeError):
@@ -44,8 +62,46 @@ class _Rerouted(RuntimeError):
         self.original = original
 
 
+class NoReplicaAvailable(RuntimeError):
+    """Every replica is dead, draining, or breaker-excluded: there is no
+    candidate to even ASK.  Structurally a rejection (``reason`` +
+    ``retry_after_s``) so backpressure-aware clients handle it exactly
+    like admission rejection — back off and retry."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.05):
+        super().__init__(message)
+        self.reason = "no_replica"
+        self.retry_after_s = retry_after_s
+
+
 def _is_rejection(e: BaseException) -> bool:
     return hasattr(e, "reason") and hasattr(e, "retry_after_s")
+
+
+def _emitted_total(replica) -> int:
+    """Tokens currently streamed into active slots — the step-progress
+    signal the health tracker compares across one ``step()``."""
+    return sum(len(getattr(sl, "emitted", ()))
+               for sl in getattr(replica, "slots", ()))
+
+
+def _slot_partials(replica):
+    """Fallback salvage reader for replicas without ``partial_tokens``
+    (the ``FaultyReplica`` chaos wrapper provides its own): streamed
+    host-int tokens per active slot — in streaming mode a batcher's
+    ``emitted`` lists hold exactly the tokens the caller already saw."""
+
+    def read() -> dict:
+        out: dict = {}
+        for sl in getattr(replica, "slots", ()):
+            rid = getattr(sl, "request_id", None)
+            if rid is None:
+                continue
+            out[rid] = [t for t in getattr(sl, "emitted", ())
+                        if isinstance(t, int)]
+        return out
+
+    return read
 
 
 class _FleetPoolView:
@@ -76,29 +132,50 @@ class FleetRouter:
     request may try (default: all of them).  ``affinity_window`` is the
     prompt-head length used for the router's recency affinity map —
     requests sharing a head route to the replica that last served one,
-    where its KV pages are warmest.  Exposes the same
+    where its KV pages are warmest; the map is LRU-bounded at
+    ``affinity_cap`` heads so a long-lived service cannot leak memory
+    through prompt diversity.  ``trace_cap`` optionally bounds
+    ``routing_trace`` the same way (default ``None`` keeps the full
+    trace — the bit-identity replay contract needs it).  ``health`` is
+    an optional :class:`~ddl25spring_tpu.serving_fleet.health.FleetHealth`;
+    without one the router behaves exactly as before (no breaker, but
+    step isolation and failover still apply).  Exposes the same
     ``submit``/``step``/``drain``/``in_flight`` surface as a single
     batcher, so ``loadgen.replay`` and ``saturation_sweep`` drive a
     fleet unchanged.
     """
 
     def __init__(self, replicas, *, max_reroutes: int | None = None,
-                 affinity_window: int = 16):
+                 affinity_window: int = 16, affinity_cap: int = 4096,
+                 trace_cap: int | None = None, health=None):
         replicas = list(replicas)
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         if max_reroutes is not None and max_reroutes < 0:
             raise ValueError(
                 f"max_reroutes must be >= 0, got {max_reroutes}")
+        if affinity_cap < 1:
+            raise ValueError(
+                f"affinity_cap must be >= 1, got {affinity_cap}")
         self.replicas = replicas
         self.max_reroutes = (len(replicas) - 1 if max_reroutes is None
                              else max_reroutes)
         self.affinity_window = affinity_window
-        self._affinity: dict = {}   # prompt head -> last replica index
+        self.affinity_cap = affinity_cap
+        self.health = health
+        self._affinity: dict = {}   # prompt head -> last replica (LRU)
         self._owner: dict = {}      # in-flight rid -> replica index
-        self.routing_trace: list = []  # (rid, replica index), append-only
+        self._requests: dict = {}   # rid -> (prompt, budget, deadline_s)
+        self._salvaged: dict = {}   # failed-over rid -> tokens replayed
+        self._orphans: list = []    # [(rid, salvaged, kind)] awaiting place
+        self._dead: set = set()     # replica indices never used again
+        self._draining: set = set()  # no NEW placements (rolling restart)
+        self.routing_trace = (deque(maxlen=trace_cap)
+                              if trace_cap is not None else [])
         self.stats = {"routed": 0, "rerouted": 0, "rejected": 0,
-                      "rerouted_by_reason": {}}
+                      "rerouted_by_reason": {}, "rejected_by_reason": {},
+                      "failed_over": 0, "failover_tokens_replayed": 0,
+                      "replicas_failed": 0}
 
     # -- loadgen duck-type surface (drive a fleet like one batcher) ------
 
@@ -112,7 +189,8 @@ class FleetRouter:
 
     @property
     def _queue(self) -> list:
-        return [q for r in self.replicas for q in r._queue]
+        return [q for i, r in enumerate(self.replicas)
+                if i not in self._dead for q in r._queue]
 
     @property
     def _pool(self) -> _FleetPoolView:
@@ -120,16 +198,38 @@ class FleetRouter:
 
     @property
     def in_flight(self) -> int:
-        return sum(r.in_flight for r in self.replicas)
+        """Work the fleet still owes: live replicas' in-flight plus
+        orphans awaiting re-placement.  Dead replicas are excluded —
+        their in-flight can never finish and would wedge ``drain``."""
+        return (sum(r.in_flight for i, r in enumerate(self.replicas)
+                    if i not in self._dead)
+                + len(self._orphans))
 
     # -- routing ---------------------------------------------------------
 
     def _head_key(self, prompt) -> tuple:
         return tuple(int(t) for t in list(prompt)[:self.affinity_window])
 
+    def _note_affinity(self, head: tuple, ix: int) -> None:
+        self._affinity.pop(head, None)
+        self._affinity[head] = ix
+        while len(self._affinity) > self.affinity_cap:
+            self._affinity.pop(next(iter(self._affinity)))
+
+    def _eligible(self) -> list:
+        """Replica indices that may receive a NEW placement now: alive,
+        not draining, and (with a health tracker) breaker-admitted."""
+        return [i for i in range(len(self.replicas))
+                if i not in self._dead and i not in self._draining
+                and (self.health is None or self.health.admits(i))]
+
+    def _health_state(self, i: int) -> str:
+        return "healthy" if self.health is None else self.health.state(i)
+
     def assignments(self) -> dict:
         """replica index -> [rid, ...] in routed order (the pinned trace
-        the bit-identity contract replays per replica)."""
+        the bit-identity contract replays per replica).  A failed-over
+        rid appears once per placement — original then failover."""
         out: dict = {i: [] for i in range(len(self.replicas))}
         for rid, ix in self.routing_trace:
             out[ix].append(rid)
@@ -139,14 +239,26 @@ class FleetRouter:
                deadline_s: float | None = None) -> int:
         """Route and submit one request; returns the replica index it
         landed on.  Raises the best (soonest-retry) rejection when every
-        candidate replica rejected."""
-        if rid in self._owner:
+        candidate replica rejected, or :class:`NoReplicaAvailable` when
+        the breaker/drain state leaves nothing to ask."""
+        if rid in self._owner or rid in self._requests:
             raise ValueError(f"request id {rid!r} already in flight")
         head = self._head_key(prompt)
+        eligible = self._eligible()
+        if not eligible:
+            self.stats["rejected"] += 1
+            by = self.stats["rejected_by_reason"]
+            by["no_replica"] = by.get("no_replica", 0) + 1
+            obs.inc("fleet_rejected_total", reason="no_replica")
+            raise NoReplicaAvailable(
+                f"no replica can accept request {rid!r}: "
+                f"{len(self._dead)} dead, {len(self._draining)} "
+                "draining, rest breaker-excluded")
         snaps = [policy.snapshot_replica(
-            i, r, prompt, int(max_new_tokens),
+            i, self.replicas[i], prompt, int(max_new_tokens),
             affinity_hit=self._affinity.get(head) == i,
-        ) for i, r in enumerate(self.replicas)]
+            health_state=self._health_state(i),
+        ) for i in eligible]
         order = policy.rank_replicas(snaps)
         state = {"attempt": 0}
         rejections: list = []
@@ -171,10 +283,15 @@ class FleetRouter:
                 label="fleet.route",
             )
         except (_Rerouted, RetryError):
-            # every candidate rejected: surface the rejection the caller
-            # can act on soonest (min retry_after_s across the fleet)
+            # every candidate rejected: count each rejection under its
+            # reason (the re-route counter only sees rejections that had
+            # an onward candidate), then surface the rejection the
+            # caller can act on soonest (min retry_after_s)
             self.stats["rejected"] += 1
-            obs.inc("fleet_rejected_total")
+            by = self.stats["rejected_by_reason"]
+            for e in rejections:
+                by[e.reason] = by.get(e.reason, 0) + 1
+                obs.inc("fleet_rejected_total", reason=e.reason)
             raise min(rejections, key=lambda e: e.retry_after_s) from None
         for e in rejections:
             # count only the rejections that caused an onward re-route
@@ -184,9 +301,13 @@ class FleetRouter:
         self.stats["rerouted"] += len(rejections)
         self.stats["routed"] += 1
         obs.inc("fleet_routed_total", replica=str(ix))
-        self._affinity[head] = ix
+        self._note_affinity(head, ix)
         self._owner[rid] = ix
+        self._requests[rid] = (tuple(int(t) for t in list(prompt)),
+                               int(max_new_tokens), deadline_s)
         self.routing_trace.append((rid, ix))
+        if self.health is not None:
+            self.health.note_placed(ix, rid)
         return ix
 
     # -- stepping --------------------------------------------------------
@@ -195,6 +316,8 @@ class FleetRouter:
         if not obs.enabled():
             return
         for i, r in enumerate(self.replicas):
+            if i in self._dead:
+                continue
             est = getattr(r, "_chunk_s", 0.0)
             mb = max(1, int(getattr(r, "max_batch", 1)))
             wait = est * (len(r._queue) / mb)
@@ -203,27 +326,252 @@ class FleetRouter:
             obs.set_gauge("fleet_replica_drain_pps",
                           getattr(r, "_drain_pps", 0.0), replica=str(i))
 
-    def step(self) -> dict:
-        """Step every replica with work in flight; returns the merged
-        ``{rid: tokens}`` of everything that finished this step."""
-        finished: dict = {}
-        for r in self.replicas:
-            if r.in_flight:
-                finished.update(r.step())
-        for rid in finished:
+    def _absorb(self, ix: int, out: dict) -> dict:
+        """Book-keep one replica's finished requests: release ownership,
+        stitch salvaged failover tokens back onto the front of the
+        stream, and feed the breaker (a clean finish is the half-open
+        canary's recovery proof; a deadline eviction is not)."""
+        res: dict = {}
+        for rid, toks in out.items():
             self._owner.pop(rid, None)
+            self._requests.pop(rid, None)
+            if self.health is not None:
+                if getattr(toks, "status", "ok") == "ok":
+                    self.health.note_finished(ix, rid)
+                else:
+                    self.health.note_evicted(ix, rid)
+            sal = self._salvaged.pop(rid, None)
+            if sal:
+                merged = list(sal) + list(toks)
+                status = getattr(toks, "status", None)
+                toks = (type(toks)(merged, status) if status is not None
+                        else merged)
+            res[rid] = toks
+        return res
+
+    def _fail_over(self, ix: int, exc) -> dict:
+        """Replica ``ix`` is dead (raised from ``step()`` or was failed
+        by an operator): never step or place on it again, salvage the
+        tokens its slots already streamed, and orphan every rid it
+        owned for re-placement.  Returns requests that finished DURING
+        the failover (salvage already covered their whole budget)."""
+        self._dead.add(ix)
+        self._draining.discard(ix)
+        self.stats["replicas_failed"] += 1
+        kind = getattr(exc, "kind", None) or "replica_crash"
+        obs.inc("fleet_replica_failed_total", kind=kind,
+                replica=str(ix))
+        if self.health is not None:
+            self.health.record_crash(ix)
+        partials: dict = {}
+        getter = getattr(self.replicas[ix], "partial_tokens",
+                         _slot_partials(self.replicas[ix]))
+        try:
+            partials = getter()
+        except Exception:
+            partials = {}   # the host side died too; replay from 0
+        for rid, owner in list(self._owner.items()):
+            if owner != ix:
+                continue
+            del self._owner[rid]
+            if self.health is not None:
+                self.health.note_evicted(ix, rid)
+            # a second failover must keep the FIRST failover's salvage:
+            # the dying replica only ever streamed the post-salvage tail
+            salvaged = (self._salvaged.pop(rid, [])
+                        + [int(t) for t in partials.get(rid, ())])
+            self._orphans.append((rid, salvaged, kind))
+        return self._retry_orphans()
+
+    def _retry_orphans(self) -> dict:
+        """Re-place orphaned requests on surviving replicas.  Placement
+        is best-effort per step — an orphan that cannot place now (all
+        candidates rejecting or breaker-excluded) stays queued and is
+        retried next ``step()``."""
+        if not self._orphans:
+            return {}
+        if all(i in self._dead for i in range(len(self.replicas))):
+            raise RuntimeError(
+                f"all {len(self.replicas)} replicas dead with "
+                f"{len(self._orphans)} requests orphaned — nothing "
+                "left to fail over to")
+        finished: dict = {}
+        still: list = []
+        for rid, salvaged, kind in self._orphans:
+            prompt, budget, deadline_s = self._requests[rid]
+            remaining = budget - len(salvaged)
+            if remaining <= 0:
+                # the dead replica had already streamed the full budget;
+                # the salvage IS the answer
+                self._requests.pop(rid, None)
+                finished[rid] = list(salvaged)
+                self._count_failover(kind, len(salvaged))
+                continue
+            ix = self._place_orphan(rid, prompt, salvaged, remaining,
+                                    deadline_s)
+            if ix is None:
+                still.append((rid, salvaged, kind))
+                continue
+            self._count_failover(kind, len(salvaged))
+        self._orphans = still
+        return finished
+
+    def _count_failover(self, kind: str, nr_replayed: int) -> None:
+        self.stats["failed_over"] += 1
+        self.stats["failover_tokens_replayed"] += nr_replayed
+        obs.inc("fleet_failover_total", kind=kind)
+        if nr_replayed:
+            obs.inc("fleet_failover_tokens_replayed_total", nr_replayed)
+
+    def _place_orphan(self, rid, prompt, salvaged, remaining: int,
+                      deadline_s) -> int | None:
+        """Try to land one orphan on a surviving replica.  Preferred
+        form: continuation — re-prefill ``prompt + salvaged`` and decode
+        only the remaining budget (the salvaged tokens are replayed
+        through prefill, not re-decoded).  When the continuation does
+        not fit the target's prefill window, fall back to a full
+        resubmit (the whole stream re-decodes; greedy decode makes it
+        identical)."""
+        eligible = self._eligible()
+        if not eligible:
+            return None
+        snaps = [policy.snapshot_replica(
+            i, self.replicas[i], prompt, remaining,
+            affinity_hit=False, health_state=self._health_state(i),
+        ) for i in eligible]
+        for ix in policy.rank_replicas(snaps):
+            r = self.replicas[ix]
+            pw = getattr(r, "prefill_width", None)
+            cont = tuple(prompt) + tuple(salvaged)
+            try_cont = bool(salvaged) and (pw is None
+                                           or len(cont) <= int(pw))
+            try:
+                if try_cont:
+                    r.submit(rid, list(cont), remaining,
+                             deadline_s=deadline_s)
+                    self._salvaged[rid] = list(salvaged)
+                else:
+                    # full replay: drop the salvage, re-decode everything
+                    r.submit(rid, list(prompt),
+                             remaining + len(salvaged),
+                             deadline_s=deadline_s)
+                    self._salvaged.pop(rid, None)
+            except Exception as e:
+                if not _is_rejection(e):
+                    raise
+                continue
+            self._owner[rid] = ix
+            self.routing_trace.append((rid, ix))
+            if self.health is not None:
+                self.health.note_placed(ix, rid)
+            return ix
+        return None
+
+    def step(self) -> dict:
+        """Step every live replica with work in flight; returns the
+        merged ``{rid: tokens}`` of everything that finished this step.
+        A replica raising is isolated: it is marked dead, its requests
+        fail over, and the step continues with the survivors."""
+        if self.health is not None:
+            self.health.tick()
+        finished: dict = {}
+        for i, r in enumerate(self.replicas):
+            if i in self._dead:
+                continue
+            pre = r.in_flight
+            if not pre:
+                continue
+            em0 = _emitted_total(r) if self.health is not None else 0
+            t0 = time.perf_counter()
+            try:
+                out = r.step()
+            except Exception as e:
+                if _is_rejection(e):
+                    raise   # an admission error here is a router bug
+                finished.update(self._fail_over(i, e))
+                continue
+            if self.health is not None:
+                # progress = finishes + net new streamed tokens: a
+                # streaming batcher returns {} mid-decode, so finishes
+                # alone would strike every healthy long request
+                progress = len(out) + max(0, _emitted_total(r) - em0)
+                self.health.record_step(
+                    i, time.perf_counter() - t0, progress, pre,
+                    drain_pps=getattr(r, "_drain_pps", None))
+            finished.update(self._absorb(i, out))
+        if self._orphans:
+            finished.update(self._retry_orphans())
         self._publish_gauges()
         return finished
 
     def drain(self, *, timeout_s: float | None = None) -> dict:
-        """step() until the fleet is idle (optionally bounded)."""
+        """step() until the fleet is idle (optionally bounded).  On
+        timeout the raised ``TimeoutError`` carries everything that DID
+        finish as ``.partial`` so callers salvage completed requests."""
         t0 = time.perf_counter()
         out: dict = {}
         while self.in_flight:
             out.update(self.step())
             if (timeout_s is not None
                     and time.perf_counter() - t0 > timeout_s):
-                raise TimeoutError(
+                err = TimeoutError(
                     f"fleet drain exceeded {timeout_s}s with "
                     f"{self.in_flight} requests in flight")
+                err.partial = out
+                raise err
         return out
+
+    # -- operator surface (rolling restart / manual failover) -----------
+
+    def fail_replica(self, i: int) -> dict:
+        """Operator-initiated failover: treat replica ``i`` as dead NOW
+        (exactly the path a ``step()`` crash takes) and migrate its
+        in-flight requests.  Returns any that finished immediately
+        (salvage already covered their budget)."""
+        if not 0 <= i < len(self.replicas):
+            raise ValueError(f"no replica {i}")
+        if i in self._dead:
+            return {}
+        return self._fail_over(i, None)
+
+    def drain_replica(self, i: int, *,
+                      timeout_s: float | None = None) -> dict:
+        """Graceful drain for a rolling restart: replica ``i`` receives
+        no new placements, and the fleet steps until its in-flight work
+        completes — zero requests dropped.  Returns everything that
+        finished fleet-wide during the drain; the replica is left marked
+        draining (``swap_replica`` clears it)."""
+        if not 0 <= i < len(self.replicas):
+            raise ValueError(f"no replica {i}")
+        if i in self._dead:
+            return {}
+        self._draining.add(i)
+        t0 = time.perf_counter()
+        out: dict = {}
+        while i not in self._dead and self.replicas[i].in_flight:
+            out.update(self.step())
+            if (timeout_s is not None
+                    and time.perf_counter() - t0 > timeout_s):
+                err = TimeoutError(
+                    f"drain of replica {i} exceeded {timeout_s}s with "
+                    f"{self.replicas[i].in_flight} requests in flight")
+                err.partial = out
+                raise err
+        return out
+
+    def swap_replica(self, i: int, replica) -> None:
+        """Replace replica ``i`` (dead or drained) with a fresh one and
+        re-open it for placement.  Refuses to discard in-flight work —
+        ``drain_replica``/``fail_replica`` first."""
+        if not 0 <= i < len(self.replicas):
+            raise ValueError(f"no replica {i}")
+        if i not in self._dead and self.replicas[i].in_flight:
+            raise ValueError(
+                f"replica {i} still has {self.replicas[i].in_flight} "
+                "requests in flight — drain_replica() or "
+                "fail_replica() first")
+        self.replicas[i] = replica
+        self._dead.discard(i)
+        self._draining.discard(i)
+        if self.health is not None:
+            self.health.reset(i)

@@ -23,7 +23,10 @@ offered) as the headline.  Points are auto-placed around a measured
 peak-goodput probe unless ``--sweep-qps`` pins them.  ``--replicas N``
 routes the sweep through a ``serving_fleet.FleetRouter`` over N batcher
 replicas (one compiled program set shared fleet-wide) and measures the
-knee fleet-wide, with routed/re-routed counts per point.
+knee fleet-wide, with routed/re-routed counts per point.  ``--chaos
+SPEC`` replays the knee once more under a seeded replica fault schedule
+(crashes, hangs, slowdowns, pool leaks — docs/RESILIENCE.md §9) and
+reports goodput-under-chaos plus the exact failover counters.
 
 Every compiled program is built once and reused across reps and sweep
 points (the batcher's program cache is keyed on shapes, not instances).
@@ -114,6 +117,15 @@ def main() -> int:
                          "goodput probe")
     ap.add_argument("--sweep-requests", type=int, default=32,
                     help="requests replayed per sweep point")
+    ap.add_argument("--chaos", metavar="SPEC", default=None,
+                    help="with --sweep and --replicas N>1: after the "
+                         "clean sweep, replay once more at the knee with "
+                         "every replica wrapped in the seeded fault "
+                         "injector (resilience.ReplicaFaultSchedule "
+                         "spec, e.g. 'crash_at=0:40,slow=0.1:0.02,"
+                         "seed=7'); the JSON gains a 'chaos' block with "
+                         "goodput-under-chaos, failover counts and "
+                         "tokens replayed")
     ap.add_argument("--arrival-dist", choices=("lognormal", "pareto"),
                     default="lognormal")
     ap.add_argument("--arrival-seed", type=int, default=0)
@@ -212,15 +224,26 @@ def _run_sweep(args, cfg, params, kv_kwargs, loadgen,
 
     fleet = args.replicas > 1
     if fleet:
-        from ddl25spring_tpu.serving_fleet import FleetRouter
+        from ddl25spring_tpu.serving_fleet import (BreakerConfig,
+                                                   FleetHealth,
+                                                   FleetRouter)
 
         def make_batcher():
-            return FleetRouter([make_replica()
-                                for _ in range(args.replicas)])
+            return FleetRouter(
+                [make_replica() for _ in range(args.replicas)],
+                health=FleetHealth(args.replicas, BreakerConfig()))
         replay_fn = loadgen.replay_fleet
     else:
         make_batcher = make_replica
         replay_fn = None
+    chaos = None
+    if args.chaos:
+        if not fleet:
+            raise SystemExit("--chaos needs --replicas N>1 (replica "
+                             "chaos has nothing to fail over to on a "
+                             "single batcher)")
+        from ddl25spring_tpu.resilience import ReplicaFaultSchedule
+        chaos = ReplicaFaultSchedule.parse(args.chaos)
 
     def prompt_fn(i, prng):
         n = int(prng.integers(4, args.prefill_width))
@@ -254,7 +277,7 @@ def _run_sweep(args, cfg, params, kv_kwargs, loadgen,
     sweep = loadgen.saturation_sweep(
         make_batcher, qps_points, nr, prompt_fn, budget,
         dist=args.arrival_dist, seed=args.arrival_seed,
-        warmup=warmup, replay_fn=replay_fn)
+        warmup=warmup, replay_fn=replay_fn, chaos=chaos)
     if args.telemetry:
         obs.flush()
     print(json.dumps({

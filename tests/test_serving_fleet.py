@@ -26,12 +26,18 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from ddl25spring_tpu import obs
 from ddl25spring_tpu.models import loadgen
 from ddl25spring_tpu.models.llama import Llama, LlamaConfig
 from ddl25spring_tpu.models.serving import ContinuousBatcher, _programs
 from ddl25spring_tpu.ops.flash_decode import flash_decode_attention
-from ddl25spring_tpu.serving_fleet import (DisaggregatedBatcher,
-                                           FleetRouter, ReplicaSnapshot,
+from ddl25spring_tpu.resilience import (FaultyReplica, ReplicaCrashed,
+                                        ReplicaFaultSchedule)
+from ddl25spring_tpu.serving_fleet import (BreakerConfig,
+                                           DisaggregatedBatcher,
+                                           FleetHealth, FleetRouter,
+                                           NoReplicaAvailable,
+                                           ReplicaSnapshot,
                                            TPShardedBatcher,
                                            headsharded_flash_decode,
                                            make_model_mesh, rank_replicas)
@@ -404,6 +410,373 @@ def test_fleet_knee_not_below_single_replica(setup):
     assert all(pt["routed"] == nr for pt in fleet["points"])
 
 
+# -- fault tolerance: chaos, breaker, exactly-once failover ----------------
+
+
+class _FakeSlot:
+    free = False
+
+    def __init__(self, rid, budget, ctx):
+        self.request_id = rid
+        self.budget = budget
+        self.ctx = list(ctx)      # prompt (+ salvage) + generated tokens
+        self.emitted = []
+
+
+class _StreamFake:
+    """Streaming fake replica: each step admits queued requests into
+    slots and emits ONE token per active slot, a pure function of the
+    slot's full context — so a continuation submit (prompt + salvaged
+    tokens) provably continues the original stream, and exactly-once is
+    checkable by value."""
+
+    def __init__(self, max_batch=2):
+        self.max_batch = max_batch
+        self.prefill_width = 64
+        self._queue = []
+        self.slots = []
+
+    @property
+    def in_flight(self):
+        return len(self._queue) + len(self.slots)
+
+    def submit(self, rid, prompt, budget, deadline_s=None):
+        self._queue.append((rid, list(prompt), int(budget)))
+
+    def step(self):
+        while self._queue and len(self.slots) < self.max_batch:
+            rid, prompt, b = self._queue.pop(0)
+            self.slots.append(_FakeSlot(rid, b, prompt))
+        done = {}
+        for sl in list(self.slots):
+            tok = (sum(sl.ctx) + 7 * len(sl.ctx)) % 997
+            sl.ctx.append(tok)
+            sl.emitted.append(tok)
+            if len(sl.emitted) >= sl.budget:
+                done[sl.request_id] = list(sl.emitted)
+                self.slots.remove(sl)
+        return done
+
+
+def _fake_stream(prompt, budget):
+    """Reference stream for a _StreamFake request (no chaos)."""
+    ctx = list(prompt)
+    out = []
+    for _ in range(budget):
+        tok = (sum(ctx) + 7 * len(ctx)) % 997
+        ctx.append(tok)
+        out.append(tok)
+    return out
+
+
+def test_replica_fault_schedule_pure_and_roundtrips():
+    s = ReplicaFaultSchedule.parse(
+        "crash_at=1:3,hang=0.1:4,slow=0.2:0.01,seed=7")
+    assert s.faults_at(1, 3) == ("replica_crash",)
+    assert "replica_crash" not in s.faults_at(0, 3)
+    # pure function of (seed, replica, step): same draws every call and
+    # across a re-parse of the described spec
+    again = ReplicaFaultSchedule.parse(s.describe())
+    for r in range(3):
+        for k in range(32):
+            assert s.faults_at(r, k) == again.faults_at(r, k)
+    # a hang window started at s covers hang_steps steps
+    h = ReplicaFaultSchedule(hang_at=((0, 2),), hang_steps=3)
+    hung = [k for k in range(8) if "replica_hang" in h.faults_at(0, k)]
+    assert hung == [2, 3, 4]
+    with pytest.raises(ValueError, match="outside"):
+        ReplicaFaultSchedule.parse("crash=1.5")
+    with pytest.raises(ValueError, match="unknown chaos kind"):
+        ReplicaFaultSchedule.parse("explode=1")
+
+
+def test_faulty_replica_crash_is_permanent():
+    sched = ReplicaFaultSchedule(crash_at=((0, 1),))
+    rep = FaultyReplica(_StreamFake(), sched, 0)
+    rep.submit("a", [1, 2], 4)
+    rep.step()
+    with pytest.raises(ReplicaCrashed):
+        rep.step()
+    with pytest.raises(ReplicaCrashed):      # dead stays dead
+        rep.submit("b", [3], 1)
+    assert rep.partial_tokens() == {"a": _fake_stream([1, 2], 4)[:1]}
+
+
+def test_failover_exactly_once_with_salvage():
+    # 3 fake replicas, replica 0 crashes after two steps; every request
+    # finishes exactly once with the exact no-chaos stream, and the
+    # failover counters match the salvage arithmetic precisely
+    sched = ReplicaFaultSchedule(crash_at=((0, 2),))
+    reps = [FaultyReplica(_StreamFake(), sched, i) for i in range(3)]
+    router = FleetRouter(reps)
+    prompts = [[11], [23, 5], [7, 7, 7], [41]]
+    budget = 6
+    for rid, p in enumerate(prompts):
+        router.submit(rid, p, budget)
+    owners0 = dict(router._owner)
+    victims = [r for r, ix in owners0.items() if ix == 0]
+    assert victims, "ranking should place something on replica 0"
+    t = obs.enable()
+    try:
+        done = router.drain()
+    finally:
+        obs.disable()
+    assert sorted(done) == list(range(len(prompts)))
+    for rid, p in enumerate(prompts):
+        assert list(done[rid]) == _fake_stream(p, budget), rid
+    # exactly-once bookkeeping: nothing stale anywhere
+    assert router._owner == {} and router._requests == {}
+    assert router._salvaged == {} and router._orphans == []
+    assert router.in_flight == 0
+    # counters are exact: every victim failed over once, replaying the
+    # two tokens each had streamed before the crash (admitted at step 0,
+    # one token per step, crash at step 2)
+    assert router.stats["replicas_failed"] == 1
+    assert router.stats["failed_over"] == len(victims)
+    assert router.stats["failover_tokens_replayed"] == 2 * len(victims)
+    assert t.counter("fleet_failover_total",
+                     kind="replica_crash").value == len(victims)
+    assert t.counter("fleet_failover_tokens_replayed_total").value == \
+        2 * len(victims)
+    # the failed-over rids were re-placed on survivors, visible in the
+    # trace (original placement then failover placement)
+    for rid in victims:
+        placements = [ix for r, ix in router.routing_trace if r == rid]
+        assert placements[0] == 0 and placements[-1] != 0
+
+
+def test_fail_replica_manual_migration():
+    router = FleetRouter([_StreamFake(), _StreamFake()])
+    router.submit("a", [3, 4], 5)
+    router.submit("b", [9], 5)
+    router.step()                      # both streams one token in
+    moved_from = router._owner["a"]
+    router.fail_replica(moved_from)
+    assert router._owner["a"] != moved_from
+    done = router.drain()
+    assert list(done["a"]) == _fake_stream([3, 4], 5)
+    assert list(done["b"]) == _fake_stream([9], 5)
+    assert router.stats["replicas_failed"] == 1
+
+
+def test_circuit_breaker_hang_suspect_open_halfopen_close():
+    # replica 0 hangs for steps 1..4; with suspect_after=2/open_after=4
+    # it is demoted within two stalled steps, excluded at four, goes
+    # half-open after the cooldown, and one finished canary closes it —
+    # every transition counted exactly once
+    sched = ReplicaFaultSchedule(hang_at=((0, 1),), hang_steps=4)
+    reps = [FaultyReplica(_StreamFake(), sched, i) for i in range(2)]
+    health = FleetHealth(2, BreakerConfig(
+        suspect_after=2, open_after=4, half_open_after=6,
+        latency_warmup=1000))
+    router = FleetRouter(reps, health=health)
+    t = obs.enable()
+    try:
+        assert router.submit("long0", [2, 2], 12) == 0
+        assert router.submit("long1", [5, 5], 12) == 1
+        router.step()                        # both progress (step 0)
+        assert health.state(0) == "healthy"
+        for _ in range(2):                   # hung steps 1, 2
+            router.step()
+        assert health.state(0) == "suspect"
+        # demoted behind the equally-loaded healthy replica: the next
+        # placement avoids the suspect within the suspect threshold
+        assert router.submit("after_suspect", [8], 1) == 1
+        for _ in range(2):                   # hung steps 3, 4
+            router.step()
+        assert health.state(0) == "open"
+        assert not health.admits(0)
+        assert router.submit("after_open", [6], 1) == 1
+        # hang cleared: replica 0 streams again, but the breaker stays
+        # open until the cooldown elapses
+        for _ in range(6):
+            router.step()
+        assert health.state(0) == "half_open"
+        # half-open admits exactly one canary; replica 0 is empty
+        # (long0 finished during the cooldown) so it wins on load
+        assert router.submit("canary", [1], 1) == 0
+        assert not health.admits(0)          # probe slot is taken
+        assert router.submit("queued_off", [4], 1) == 1
+        router.drain()
+        assert health.state(0) == "healthy"
+        trans = t.counter  # exact per-transition counts, obs view
+        for to in ("suspect", "open", "half_open", "healthy"):
+            assert trans("fleet_breaker_transitions_total",
+                         replica="0", to=to).value == 1, to
+        assert health.transitions == {(0, "suspect"): 1, (0, "open"): 1,
+                                      (0, "half_open"): 1,
+                                      (0, "healthy"): 1}
+    finally:
+        obs.disable()
+
+
+def test_owner_lifecycle_no_stale_entries():
+    # finish, manual failover, and replica drain all clear _owner /
+    # _requests; any drain() leaves zero bookkeeping behind
+    router = FleetRouter([_StreamFake(), _StreamFake()])
+    for rid in range(4):
+        router.submit(rid, [rid + 1], 3)
+    router.step()
+    router.fail_replica(0)
+    router.drain()
+    assert router._owner == {} and router._requests == {}
+    assert router._salvaged == {} and router._orphans == []
+    # graceful drain of a replica: zero dropped requests, no staleness
+    router2 = FleetRouter([_StreamFake(), _StreamFake()])
+    for rid in range(4):
+        router2.submit(rid, [rid + 1], 3)
+    drained = router2.drain_replica(0)
+    assert all(not isinstance(v, Exception) for v in drained.values())
+    assert router2.replicas[0].in_flight == 0
+    rest = router2.drain()
+    got = {**drained, **rest}
+    assert sorted(got) == [0, 1, 2, 3]
+    for rid in range(4):
+        assert list(got[rid]) == _fake_stream([rid + 1], 3)
+    assert router2._owner == {} and router2._requests == {}
+    # draining replica receives no new placements until swapped
+    assert router2.submit("post", [9], 1) == 1
+    router2.swap_replica(0, _StreamFake())
+    assert router2.submit("swapped", [10], 1) in (0, 1)
+    router2.drain()
+
+
+def test_drain_timeout_attaches_partial():
+    sched = ReplicaFaultSchedule(hang_at=((0, 1),), hang_steps=10 ** 6)
+    reps = [FaultyReplica(_StreamFake(), sched, i) for i in range(2)]
+    router = FleetRouter(reps)
+    router.submit("stuck", [1], 4)       # -> replica 0 (index order)
+    router.submit("fine", [2], 2)        # -> replica 1
+    with pytest.raises(TimeoutError) as exc:
+        router.drain(timeout_s=0.05)
+    assert list(exc.value.partial["fine"]) == _fake_stream([2], 2)
+    assert "stuck" not in exc.value.partial
+
+
+def test_fleetwide_rejection_counts_by_reason():
+    router = FleetRouter([_FakeReplica(reject=True, retry_after=0.4),
+                          _FakeReplica(reject=True, retry_after=0.1)])
+    t = obs.enable()
+    try:
+        with pytest.raises(_Rej):
+            router.submit(0, [1], 2)
+    finally:
+        obs.disable()
+    assert router.stats["rejected"] == 1
+    assert router.stats["rejected_by_reason"] == {"queue_full": 2}
+    assert t.counter("fleet_rejected_total",
+                     reason="queue_full").value == 2
+
+
+def test_no_replica_available_is_structural_rejection():
+    router = FleetRouter([_StreamFake()])
+    router._draining.add(0)
+    with pytest.raises(NoReplicaAvailable) as exc:
+        router.submit("r", [1], 1)
+    assert exc.value.reason == "no_replica"
+    assert exc.value.retry_after_s > 0
+    assert router.stats["rejected_by_reason"] == {"no_replica": 1}
+
+
+def test_affinity_lru_cap_and_trace_cap():
+    router = FleetRouter([_StreamFake(), _StreamFake()],
+                         affinity_window=2, affinity_cap=2, trace_cap=3)
+    for rid, head in enumerate([[1, 1], [2, 2], [3, 3], [4, 4]]):
+        router.submit(rid, head, 1)
+    assert len(router._affinity) == 2
+    assert (3, 3) in router._affinity and (4, 4) in router._affinity
+    assert len(router.routing_trace) == 3     # deque-capped
+    router.drain()
+
+
+def test_chaos_wrap_requires_fleet():
+    with pytest.raises(ValueError, match="FleetRouter"):
+        loadgen.chaos_wrap(_StreamFake(), ReplicaFaultSchedule())
+
+
+def test_fleet_fault_modules_never_import_jax():
+    # the whole fault-tolerance plane — schedule, wrapper, health,
+    # router failover — must run in a jax-free process
+    code = "\n".join([
+        "import sys",
+        "from ddl25spring_tpu.resilience import (",
+        "    FaultyReplica, ReplicaFaultSchedule)",
+        "from ddl25spring_tpu.serving_fleet import (",
+        "    BreakerConfig, FleetHealth, FleetRouter)",
+        "class Slot:",
+        "    free = False",
+        "    def __init__(s, rid): s.request_id = rid; s.emitted = []",
+        "class R:",
+        "    max_batch = 2",
+        "    def __init__(s): s._queue = []; s.slots = []",
+        "    @property",
+        "    def in_flight(s): return len(s._queue) + len(s.slots)",
+        "    def submit(s, rid, p, b, deadline_s=None):",
+        "        s._queue.append(rid)",
+        "    def step(s):",
+        "        if s._queue: s.slots.append(Slot(s._queue.pop(0)))",
+        "        done = {sl.request_id: [1] for sl in s.slots}",
+        "        s.slots = []",
+        "        return done",
+        "sched = ReplicaFaultSchedule(crash_at=((0, 0),))",
+        "reps = [FaultyReplica(R(), sched, i) for i in range(2)]",
+        "r = FleetRouter(reps, health=FleetHealth(2, BreakerConfig()))",
+        "r.submit('a', [1, 2], 1)",
+        "out = r.drain()",
+        "assert list(out) == ['a'], out",
+        "assert r.stats['replicas_failed'] in (0, 1)",
+        "assert 'jax' not in sys.modules, 'fault plane pulled jax'",
+        "print('ok')",
+    ])
+    out = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+def test_chaos_exactness_real_batchers(setup):
+    # acceptance: 1 of 3 real replicas crashes mid-replay under a seeded
+    # schedule -> every request completes exactly once with no missing
+    # or duplicated tokens; requests never placed on the crashed replica
+    # are bit-identical to the no-chaos run; chaos disabled is
+    # bit-identical to the single-batcher reference
+    prompts = _prompts()
+
+    def mk():
+        return ContinuousBatcher(CFG, setup, max_batch=2,
+                                 prefill_width=8, **PAGED)
+
+    base = _stream_all(mk(), prompts, BUDGETS)
+    clean_router = FleetRouter([mk(), mk(), mk()])
+    clean = _stream_all(clean_router, prompts, BUDGETS)
+    assert clean == base                      # chaos off: unchanged
+
+    sched = ReplicaFaultSchedule(crash_at=((0, 2),))
+    router = loadgen.chaos_wrap(FleetRouter([mk(), mk(), mk()]), sched)
+    for rid, (p, b) in enumerate(zip(prompts, BUDGETS)):
+        router.submit(rid, p, b)
+    out = {}
+    while router.in_flight:
+        out.update(router.step())
+    chaos = {rid: list(map(int, toks)) for rid, toks in out.items()}
+
+    assert sorted(chaos) == sorted(range(len(prompts)))   # exactly once
+    touched = {r for r, ix in router.routing_trace if ix == 0}
+    assert touched, "schedule should hit requests on replica 0"
+    for rid in range(len(prompts)):
+        assert len(chaos[rid]) == BUDGETS[rid], rid       # no gap/dup
+        if rid not in touched:
+            assert chaos[rid] == clean[rid], rid          # bit-identical
+    # greedy decode + row independence: even failed-over streams match
+    assert chaos == clean
+    assert router.stats["replicas_failed"] == 1
+    assert router.stats["failed_over"] == len(
+        [r for r in touched
+         if [ix for q, ix in router.routing_trace if q == r][-1] != 0])
+
+
 def test_fleet_replicas_share_compiled_programs(setup):
     def mk():
         return ContinuousBatcher(CFG, setup, max_batch=2,
@@ -413,3 +786,39 @@ def test_fleet_replicas_share_compiled_programs(setup):
     size0 = _programs.cache_info().currsize
     router = FleetRouter([mk(), mk()])  # noqa: F841  (same-shape fleet)
     assert _programs.cache_info().currsize == size0
+
+
+def test_obs_report_shows_fleet_health_section(tmp_path, capsys):
+    # crash one replica under telemetry, render the JSONL through
+    # tools/obs_report.py: breaker transitions, failovers by kind, and
+    # replayed-token counts must surface in a fleet-health section
+    jsonl = tmp_path / "fleet.jsonl"
+    obs.enable(str(jsonl))
+    try:
+        sched = ReplicaFaultSchedule(crash_at=((0, 2),))
+        reps = [FaultyReplica(_StreamFake(), sched, i) for i in range(3)]
+        router = FleetRouter(reps, health=FleetHealth(3, BreakerConfig()))
+        for rid in range(4):
+            router.submit(rid, (1 + rid, 2, 3), 6)
+        out = {}
+        for _ in range(60):
+            out.update(router.step())
+            if len(out) == 4:
+                break
+        assert len(out) == 4
+        assert router.stats["replicas_failed"] == 1
+        obs.flush()
+    finally:
+        obs.disable()
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from obs_report import load_events, report
+
+        report(load_events(jsonl), top=8)
+    finally:
+        sys.path.remove(str(REPO / "tools"))
+    text = capsys.readouterr().out
+    assert "== fleet health" in text
+    assert "breaker r0" in text and "open=1" in text
+    assert "replica_crash" in text
+    assert "tokens replayed into continuation prefills" in text

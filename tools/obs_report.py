@@ -440,14 +440,16 @@ def report(events: list[dict], top: int) -> None:
     # -- fleet serving (serving_fleet.FleetRouter) -----------------------
     routed = take(counters, "fleet_routed_total")
     rerouted = take(counters, "fleet_rerouted_total")
-    fleet_rej = _value(counters, "fleet_rejected_total")
-    take(counters, "fleet_rejected_total")
+    # fleet_rejected_total carries a reason label per candidate
+    # rejection (legacy files have one unlabeled series — rendered the
+    # same way, just without the breakdown)
+    fleet_rej = take(counters, "fleet_rejected_total")
     q_wait = take(gauges, "fleet_replica_queue_wait_s")
     drain = {lb.get("replica"): st
              for lb, st in take(gauges, "fleet_replica_drain_pps")}
     offloaded = _value(counters, "serving_prefill_offloaded_total")
     take(counters, "serving_prefill_offloaded_total")
-    if routed or rerouted or fleet_rej is not None or q_wait \
+    if routed or rerouted or fleet_rej or q_wait \
             or offloaded is not None:
         section("fleet serving")
         if routed:
@@ -465,9 +467,18 @@ def report(events: list[dict], top: int) -> None:
             total = sum(st["value"] for _, st in rerouted)
             print(f"  re-routes (replica rejected, next candidate took "
                   f"it): {total}   by reason: {reasons}")
-        if fleet_rej is not None:
-            print(f"  rejected fleet-wide (every candidate refused): "
-                  f"{fleet_rej}")
+        if fleet_rej:
+            total = sum(st["value"] for _, st in fleet_rej)
+            line = (f"  rejected fleet-wide (every candidate refused): "
+                    f"{total}")
+            reasons = "   ".join(
+                f"{lb.get('reason', '?')}={st['value']}"
+                for lb, st in sorted(
+                    fleet_rej, key=lambda ls: ls[0].get("reason", ""))
+                if lb)
+            if reasons:
+                line += f"   by reason: {reasons}"
+            print(line)
         if q_wait:
             for lb, st in sorted(q_wait,
                                  key=lambda ls: ls[0].get("replica", "")):
@@ -482,6 +493,48 @@ def report(events: list[dict], top: int) -> None:
         if offloaded is not None:
             print(f"  prefills offloaded to dedicated workers "
                   f"(disaggregated mode): {offloaded}")
+
+    # -- fleet health (serving_fleet.FleetHealth + failover) -------------
+    transitions = take(counters, "fleet_breaker_transitions_total")
+    rep_failed = take(counters, "fleet_replica_failed_total")
+    failovers = take(counters, "fleet_failover_total")
+    replayed = _value(counters, "fleet_failover_tokens_replayed_total")
+    take(counters, "fleet_failover_tokens_replayed_total")
+    if transitions or rep_failed or failovers or replayed is not None:
+        section("fleet health")
+        if transitions:
+            # one line per replica: the sequence of breaker states it
+            # entered, with counts (e.g. r0: suspect=1 open=1 healthy=1)
+            per_replica = {}
+            for lb, st in transitions:
+                r = lb.get("replica", "?")
+                per_replica.setdefault(r, []).append(
+                    (lb.get("to", "?"), st["value"]))
+            for r in sorted(per_replica):
+                parts = "   ".join(
+                    f"{to}={v}" for to, v in sorted(per_replica[r]))
+                print(f"  breaker r{r}: {parts}")
+        if rep_failed:
+            parts = "   ".join(
+                f"r{lb.get('replica', '?')}({lb.get('kind', '?')})"
+                f"={st['value']}"
+                for lb, st in sorted(
+                    rep_failed,
+                    key=lambda ls: (ls[0].get("replica", ""),
+                                    ls[0].get("kind", ""))))
+            total = sum(st["value"] for _, st in rep_failed)
+            print(f"  replicas failed: {total}   {parts}")
+        if failovers:
+            kinds = "   ".join(
+                f"{lb.get('kind', '?')}={st['value']}"
+                for lb, st in sorted(
+                    failovers, key=lambda ls: ls[0].get("kind", "")))
+            total = sum(st["value"] for _, st in failovers)
+            print(f"  requests failed over (exactly-once re-placement): "
+                  f"{total}   by fault kind: {kinds}")
+        if replayed is not None:
+            print(f"  tokens replayed into continuation prefills: "
+                  f"{replayed}")
 
     # -- speculative decoding --------------------------------------------
     proposed = _value(counters, "spec_proposed_total")
