@@ -1,0 +1,74 @@
+"""graftlint — static contracts for a TPU-native codebase.
+
+Five ``ast``-level passes over the tree (no code under analysis is ever
+imported, and this package itself never imports jax):
+
+- **import-purity** (``IMP*``) — the ``manifest.HOST_ONLY_MODULES``
+  closure must not reach a top-level ``import jax``;
+- **trace-hygiene** (``TRC*``) — functions reachable from
+  jit/pallas_call/shard_map must not branch on tracers, concretize
+  (``.item()``/``float()``), call ``np.*`` on traced values, ``print``,
+  or read clocks/RNGs at trace time;
+- **determinism** (``DET*``) — no unseeded global RNG state, no
+  wall-clock-derived seeds or identifiers;
+- **donation-safety** (``DON*``) — no reads of a donated buffer after
+  the donating jitted call;
+- **metric-drift** (``MET*``) — code, ``tools/obs_report.py`` and
+  ``docs/OBSERVABILITY.md`` must agree on every metric name and kind.
+
+CLI: ``python tools/graftlint.py [paths] [--json] [--baseline FILE]``.
+Accepted violations live in ``tools/graftlint_baseline.json``, each with
+a justification; ``tests/test_analysis.py`` keeps the shipped tree at
+zero non-baselined findings.  See ``docs/ANALYSIS.md``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .core import (  # noqa: F401  (re-exported API)
+    PASS_ORDER,
+    BaselineError,
+    Finding,
+    ProjectIndex,
+    assign_ids,
+    collect_paths,
+    load_baseline,
+    render_baseline,
+)
+
+__all__ = [
+    "PASS_ORDER", "BaselineError", "Finding", "ProjectIndex",
+    "assign_ids", "collect_paths", "load_baseline", "render_baseline",
+    "run_passes",
+]
+
+
+def _pass_modules():
+    from . import determinism, donation, hygiene, imports, metrics_drift
+    return {
+        imports.PASS_ID: imports,
+        hygiene.PASS_ID: hygiene,
+        determinism.PASS_ID: determinism,
+        donation.PASS_ID: donation,
+        metrics_drift.PASS_ID: metrics_drift,
+    }
+
+
+def run_passes(paths: list[Path], repo_root: Path,
+               passes: tuple[str, ...] | None = None) -> list["Finding"]:
+    """Run the selected passes (default: all, in ``PASS_ORDER``) over
+    ``paths`` and return findings with stable IDs assigned."""
+    mods = _pass_modules()
+    selected = passes or PASS_ORDER
+    unknown = [p for p in selected if p not in mods]
+    if unknown:
+        raise ValueError(f"unknown pass(es): {', '.join(unknown)} "
+                         f"(known: {', '.join(PASS_ORDER)})")
+    idx = collect_paths(paths, repo_root)
+    findings: list[Finding] = []
+    for pid in PASS_ORDER:
+        if pid in selected:
+            findings.extend(mods[pid].run(idx))
+    assign_ids(findings)
+    return findings

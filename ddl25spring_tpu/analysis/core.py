@@ -1,0 +1,310 @@
+"""graftlint core: findings, stable IDs, baselines, and the pass runner.
+
+This package is a *static* analyzer — it parses the tree with ``ast`` and
+never imports the code under analysis (and never imports jax itself; the
+``analysis`` modules are listed in their own host-only manifest and the
+tier-1 guard test holds them to it).  Everything here is stdlib-only.
+
+Finding identity
+----------------
+Baselines must survive unrelated edits, so a finding's ID deliberately
+excludes the line number.  The stable key is::
+
+    (rule, repo-relative path, enclosing scope qualname, detail, ordinal)
+
+where ``detail`` is the rule-specific discriminator (the symbol, metric
+name, or import chain) and ``ordinal`` disambiguates repeated identical
+violations inside one scope in source order.  Moving a function around a
+file keeps its findings' IDs; renaming the function or the symbol changes
+them — at which point a human should re-justify the baseline entry anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+PASS_ORDER = (
+    "import-purity",
+    "trace-hygiene",
+    "determinism",
+    "donation-safety",
+    "metric-drift",
+)
+
+
+@dataclass
+class Finding:
+    pass_id: str
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    scope: str         # module or dotted qualname context
+    message: str
+    detail: str = ""   # stable discriminator (symbol / metric / chain)
+    id: str = ""       # assigned by assign_ids()
+    baselined: bool = False
+    justification: str = ""
+
+    def to_json(self) -> dict:
+        out = {
+            "id": self.id, "pass": self.pass_id, "rule": self.rule,
+            "path": self.path, "line": self.line, "scope": self.scope,
+            "message": self.message, "detail": self.detail,
+            "baselined": self.baselined,
+        }
+        if self.baselined:
+            out["justification"] = self.justification
+        return out
+
+
+def _stable_hash(key: str) -> str:
+    return hashlib.blake2b(key.encode(), digest_size=5).hexdigest()
+
+
+def assign_ids(findings: list[Finding]) -> None:
+    """Assign stable IDs in-place (see module docstring for the key)."""
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail))
+    seen: dict[tuple, int] = {}
+    for f in findings:
+        key = (f.rule, f.path, f.scope, f.detail)
+        ordinal = seen.get(key, 0)
+        seen[key] = ordinal + 1
+        f.id = f"GL-{f.rule}-{_stable_hash('|'.join(map(str, key + (ordinal,))))}"
+
+
+# -- baseline --------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path: Path) -> dict[str, dict]:
+    """Load ``{finding_id: entry}``; every entry must carry a non-empty
+    ``justification`` — a baseline is an *accepted* violation, not a mute
+    button."""
+    data = json.loads(path.read_text())
+    if data.get("version") != BASELINE_VERSION:
+        raise BaselineError(f"{path}: unsupported baseline version "
+                            f"{data.get('version')!r}")
+    out: dict[str, dict] = {}
+    for entry in data.get("entries", ()):
+        fid = entry.get("id")
+        if not fid:
+            raise BaselineError(f"{path}: baseline entry without an id: "
+                                f"{entry!r}")
+        if not str(entry.get("justification", "")).strip():
+            raise BaselineError(f"{path}: baseline entry {fid} has no "
+                                "justification")
+        if fid in out:
+            raise BaselineError(f"{path}: duplicate baseline id {fid}")
+        out[fid] = entry
+    return out
+
+
+def render_baseline(findings: list[Finding],
+                    old: dict[str, dict] | None = None) -> str:
+    """Serialize *all* given findings as a baseline document, carrying
+    over justifications from ``old`` and marking new entries with a
+    placeholder a human must replace before the file passes
+    :func:`load_baseline`."""
+    old = old or {}
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.path, f.rule, f.id)):
+        prev = old.get(f.id, {})
+        entries.append({
+            "id": f.id, "rule": f.rule, "path": f.path, "scope": f.scope,
+            "detail": f.detail,
+            "justification": prev.get("justification", ""),
+        })
+    return json.dumps({"version": BASELINE_VERSION, "entries": entries},
+                      indent=2) + "\n"
+
+
+# -- project index ---------------------------------------------------------
+
+
+@dataclass
+class ModuleInfo:
+    name: str                   # dotted module name ("" for loose scripts)
+    path: Path
+    rel: str                    # repo-relative posix path
+    tree: ast.Module
+    is_pkg: bool = False
+    toplevel_imports: list = field(default_factory=list)   # resolved names
+
+
+def _module_name(path: Path) -> str:
+    """Dotted module name from package layout (walk up while __init__.py
+    exists); loose scripts (tools/*.py, bench.py) get their stem."""
+    parts = [path.stem] if path.name != "__init__.py" else []
+    d = path.parent
+    while (d / "__init__.py").exists():
+        parts.insert(0, d.name)
+        d = d.parent
+    return ".".join(parts)
+
+
+def _resolve_import(module: str, is_pkg: bool, node: ast.AST) -> list[str]:
+    """Absolute dotted targets of one Import/ImportFrom in ``module``.
+
+    ``from X import a, b`` yields both ``X`` (its __init__ runs) and
+    ``X.a``/``X.b`` (each may be a submodule; non-module attributes are
+    simply absent from the index and ignored downstream)."""
+    out: list[str] = []
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            out.append(alias.name)
+    elif isinstance(node, ast.ImportFrom):
+        if node.level == 0:
+            base = node.module or ""
+        else:
+            # level 1 = the containing package: for module a.b.c that is
+            # a.b, for the package __init__ a.b it is a.b itself
+            parts = module.split(".")
+            if not is_pkg:
+                parts = parts[:-1]
+            parts = parts[: len(parts) - (node.level - 1)]
+            base = ".".join(parts)
+            if node.module:
+                base = f"{base}.{node.module}" if base else node.module
+        if base:
+            out.append(base)
+            for alias in node.names:
+                out.append(f"{base}.{alias.name}")
+    return out
+
+
+def _iter_toplevel(tree: ast.Module):
+    """Statements executed at import time: module body descended through
+    If/Try/With/ClassDef but NOT into function bodies, and skipping
+    ``if TYPE_CHECKING:`` branches."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.If):
+            test = node.test
+            name = (test.attr if isinstance(test, ast.Attribute)
+                    else test.id if isinstance(test, ast.Name) else None)
+            if name == "TYPE_CHECKING":
+                stack.extend(node.orelse)
+                continue
+        yield node
+        for fld in ("body", "orelse", "finalbody", "handlers"):
+            for child in getattr(node, fld, ()):
+                if isinstance(child, ast.ExceptHandler):
+                    stack.extend(child.body)
+                else:
+                    stack.append(child)
+
+
+class ProjectIndex:
+    """Parsed ASTs + import graph for every scanned file."""
+
+    def __init__(self, repo_root: Path):
+        self.repo_root = repo_root
+        self.modules: dict[str, ModuleInfo] = {}
+        self.files: list[ModuleInfo] = []
+
+    def add_file(self, path: Path) -> ModuleInfo | None:
+        path = path.resolve()
+        try:
+            rel = path.relative_to(self.repo_root).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        if any(mi.path == path for mi in self.files):
+            return None
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            raise BaselineError(f"cannot parse {rel}: {e}") from e
+        name = _module_name(path)
+        mi = ModuleInfo(name=name, path=path, rel=rel, tree=tree,
+                        is_pkg=path.name == "__init__.py")
+        for node in _iter_toplevel(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mi.toplevel_imports.append(
+                    (node.lineno, _resolve_import(name, mi.is_pkg, node)))
+        self.files.append(mi)
+        if name:
+            self.modules[name] = mi
+        return mi
+
+    def add_tree(self, root: Path):
+        for p in sorted(root.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            self.add_file(p)
+
+
+def collect_paths(paths: list[Path], repo_root: Path) -> ProjectIndex:
+    idx = ProjectIndex(repo_root)
+    for p in paths:
+        if p.is_dir():
+            idx.add_tree(p)
+        else:
+            idx.add_file(p)
+    return idx
+
+
+# -- shared AST helpers ----------------------------------------------------
+
+
+def terminal_name(node: ast.AST) -> str | None:
+    """Rightmost identifier of a Name/Attribute chain (``jax.numpy.dot``
+    -> ``dot``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """Full dotted path of a Name/Attribute chain, or None if any link is
+    a call/subscript."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def literal_strings(node: ast.AST) -> set[str]:
+    """All string literals a (possibly conditional) expression can
+    evaluate to: handles ``"a"``, ``"a" if c else "b"``, and boolean
+    chains; anything dynamic contributes nothing."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, ast.IfExp):
+        return literal_strings(node.body) | literal_strings(node.orelse)
+    if isinstance(node, ast.BoolOp):
+        out: set[str] = set()
+        for v in node.values:
+            out |= literal_strings(v)
+        return out
+    return set()
+
+
+def int_literals(node: ast.AST) -> set[int]:
+    """All int literals inside an expression — used to recover donated
+    argument positions from shapes like ``(0, 1) if donate else ()`` or
+    ``donation_safe((0,))``."""
+    out: set[int] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                and not isinstance(n.value, bool):
+            out.add(n.value)
+    return out

@@ -1,0 +1,258 @@
+"""determinism pass: unseeded randomness and wall-clock-derived seeds.
+
+The repo's reproducibility contract (seeded FaultPlan draws, bit-exact
+secagg oracles, deterministic trace ids) requires every random draw to
+flow from an explicit seed — config, CLI flag, or ``fold_in`` chain.
+This pass flags, anywhere in the scanned tree:
+
+- ``DET001`` — stateful *global* ``random.*`` calls (``random.random()``,
+  ``random.shuffle`` ... the module-level Mersenne Twister seeded from OS
+  entropy);
+- ``DET002`` — RNG constructors with no seed argument
+  (``random.Random()``, ``np.random.default_rng()``,
+  ``np.random.RandomState()``);
+- ``DET003`` — stateful global ``np.random.*`` calls (legacy global
+  state, unseeded unless someone called ``np.random.seed`` — and then
+  shared across the whole process);
+- ``DET004`` — wall-clock entropy (``time.time``/``time_ns``,
+  ``datetime.now``) flowing into a seed or identifier derivation: a
+  ``seed=`` keyword, a PRNG constructor argument
+  (``Random``/``default_rng``/``RandomState``/``PRNGKey``/``fold_in``),
+  or a call result assigned to a ``*seed*``/``*_id`` name (the
+  trace-id-from-clock shape).  The flow is tracked intra-function through
+  simple assignments and f-strings.
+
+``random.Random(x)`` / ``default_rng(seed)`` with *any* argument is
+accepted — whether the caller threads a real seed or ``None`` is a
+runtime property the baseline (or a code-review of the call site) owns;
+see ``resilience/retry.py`` for the one deliberate ``seed=None`` case.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, ProjectIndex, dotted_name
+from .manifest import determinism_allowlisted
+
+PASS_ID = "determinism"
+
+GLOBAL_RANDOM_FNS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "betavariate",
+    "expovariate", "lognormvariate", "triangular", "vonmisesvariate",
+    "paretovariate", "weibullvariate", "getrandbits", "randbytes", "seed",
+}
+NP_GLOBAL_FNS = {
+    "rand", "randn", "randint", "random", "random_sample", "choice",
+    "shuffle", "permutation", "normal", "uniform", "standard_normal",
+    "exponential", "poisson", "binomial", "beta", "gamma", "bytes",
+    "seed",
+}
+RNG_CTORS = {"Random", "default_rng", "RandomState"}
+WALLCLOCK = {"time.time", "time.time_ns", "datetime.now",
+             "datetime.datetime.now", "datetime.utcnow",
+             "datetime.datetime.utcnow"}
+SEED_SINK_CALLS = {"Random", "default_rng", "RandomState", "PRNGKey",
+                   "fold_in", "seed"}
+SEED_NAME = re.compile(r"(^|_)seed|_id$|_ids$")
+
+
+class _ModAliases:
+    """Minimal alias resolution: local names for random / numpy / time /
+    datetime (mirrors hygiene.ModCtx.canon for external roots only)."""
+
+    ROOTS = ("random", "numpy", "time", "datetime", "jax", "secrets")
+
+    def __init__(self, tree: ast.Module):
+        self.alias: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    if target.split(".")[0] in self.ROOTS:
+                        self.alias[local] = target
+            elif isinstance(node, ast.ImportFrom) and node.level == 0 \
+                    and node.module:
+                base = node.module
+                if base.split(".")[0] in self.ROOTS:
+                    for a in node.names:
+                        self.alias[a.asname or a.name] = f"{base}.{a.name}"
+
+    def canon(self, node: ast.AST) -> str | None:
+        d = dotted_name(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        root = self.alias.get(head)
+        if root is None:
+            return d
+        return f"{root}.{rest}" if rest else root
+
+
+def _scan_function(mi, aliases: _ModAliases, scope_name: str,
+                   body, findings: list[Finding]):
+    """One lexical scope: flag unseeded RNG and track wall-clock flow
+    through simple assignments into seed sinks."""
+    clock_tainted: set[str] = set()
+
+    def expr_clock_tainted(node) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                d = aliases.canon(n.func)
+                if d in WALLCLOCK:
+                    return True
+            elif isinstance(n, ast.Name) and n.id in clock_tainted:
+                return True
+        return False
+
+    def flag(rule, node, message, detail):
+        findings.append(Finding(
+            pass_id=PASS_ID, rule=rule, path=mi.rel,
+            line=getattr(node, "lineno", 0),
+            scope=f"{mi.name or mi.rel}:{scope_name}" if scope_name
+            else (mi.name or mi.rel),
+            message=message, detail=detail,
+        ))
+
+    def check_call(n: ast.Call):
+        d = aliases.canon(n.func)
+        if d is None:
+            return
+        parts = d.split(".")
+        tail = parts[-1]
+        if d.startswith("random.") and len(parts) == 2 \
+                and tail in GLOBAL_RANDOM_FNS:
+            flag("DET001", n,
+                 f"{d}() uses the process-global RNG (unseeded / shared "
+                 "state); construct random.Random(seed) from config",
+                 d)
+            return
+        if d.startswith("numpy.random.") and len(parts) == 3 \
+                and tail in NP_GLOBAL_FNS:
+            flag("DET003", n,
+                 f"{d}() uses numpy's global RNG state; use "
+                 "np.random.default_rng(seed)", d)
+            return
+        if tail in RNG_CTORS and (d.startswith("numpy.random.")
+                                  or d == "random.Random"
+                                  or d == f"random.{tail}"
+                                  or d == tail):
+            if not n.args and not n.keywords:
+                flag("DET002", n,
+                     f"{d}() constructed without a seed draws OS "
+                     "entropy; thread a seed from config", d)
+                return
+        # wall-clock flowing into a seed sink
+        if tail in SEED_SINK_CALLS:
+            for a in list(n.args) + [k.value for k in n.keywords]:
+                if expr_clock_tainted(a):
+                    flag("DET004", n,
+                         f"wall-clock value feeds {d}() — seeds must "
+                         "flow from config/fold_in, not the clock", d)
+                    return
+        for k in n.keywords:
+            if k.arg == "seed" and expr_clock_tainted(k.value):
+                flag("DET004", n,
+                     f"wall-clock value passed as seed= to {d or '?'}()",
+                     d or "seed=")
+                return
+
+    def scan_exprs(*exprs):
+        for e in exprs:
+            if e is None:
+                continue
+            for n in ast.walk(e):
+                if isinstance(n, ast.Call):
+                    check_call(n)
+
+    def recurse(s):
+        for fld in ("body", "orelse", "finalbody"):
+            for child in getattr(s, fld, ()):
+                exec_stmt(child)
+        for h in getattr(s, "handlers", ()):
+            for child in h.body:
+                exec_stmt(child)
+
+    def exec_stmt(s):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_function(mi, aliases,
+                           f"{scope_name}.{s.name}" if scope_name
+                           else s.name, s.body, findings)
+            return
+        if isinstance(s, ast.ClassDef):
+            for c in s.body:
+                exec_stmt(c)
+            return
+        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = s.value
+            if value is None:
+                return
+            scan_exprs(value)
+            # assignment flow: clock taint + the *seed*/*_id sink rule
+            tainted = expr_clock_tainted(value)
+            targets = (s.targets if isinstance(s, ast.Assign)
+                       else [s.target])
+            for t in targets:
+                for nm in ast.walk(t):
+                    if not isinstance(nm, ast.Name):
+                        continue
+                    if tainted:
+                        clock_tainted.add(nm.id)
+                        if SEED_NAME.search(nm.id):
+                            flag("DET004", s,
+                                 f"{nm.id} is derived from the wall "
+                                 "clock — identifiers/seeds must come "
+                                 "from config or fold_in chains", nm.id)
+                    else:
+                        clock_tainted.discard(nm.id)
+            return
+        if isinstance(s, ast.If):
+            # branch union: taint from either arm survives the join (the
+            # seeded else-arm must not wash out the wall-clock if-arm)
+            scan_exprs(s.test)
+            before = set(clock_tainted)
+            for c in s.body:
+                exec_stmt(c)
+            after_body = set(clock_tainted)
+            clock_tainted.clear()
+            clock_tainted.update(before)
+            for c in s.orelse:
+                exec_stmt(c)
+            clock_tainted.update(after_body)
+            return
+        if isinstance(s, ast.While):
+            scan_exprs(s.test)
+            recurse(s)
+            return
+        if isinstance(s, ast.For):
+            scan_exprs(s.iter)
+            recurse(s)
+            return
+        if isinstance(s, ast.With):
+            scan_exprs(*[i.context_expr for i in s.items])
+            recurse(s)
+            return
+        if isinstance(s, ast.Try):
+            recurse(s)
+            return
+        # leaf statements (Expr/Return/Raise/Assert/...) hold no nested
+        # statements — a full walk cannot double-count
+        for n in ast.walk(s):
+            if isinstance(n, ast.Call):
+                check_call(n)
+
+    for s in body:
+        exec_stmt(s)
+
+
+def run(idx: ProjectIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mi in idx.files:
+        if determinism_allowlisted(mi.rel):
+            continue
+        aliases = _ModAliases(mi.tree)
+        _scan_function(mi, aliases, "", mi.tree.body, findings)
+    return findings

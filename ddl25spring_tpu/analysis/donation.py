@@ -1,0 +1,198 @@
+"""donation-safety pass: reads of a donated buffer after the donating
+call — the exact shape of the PR 4 miscompile.
+
+When a jitted function donates an argument (``donate_argnums`` /
+``donate_argnames``), the caller's buffer is dead the moment the call
+dispatches; reading it afterwards returns whatever the executable left in
+the aliased memory.  jax warns at runtime only when the read *happens*,
+and the PR 4 bug (persistent-cache-deserialized executables reordering
+donated-buffer scatters) showed the read can even be inside the compiled
+program.  Statically:
+
+1. collect *donating callables* per module — names bound to
+   ``jax.jit(f, donate_argnums=...)`` and functions decorated with a
+   donating jit.  Donated positions are every int literal inside the
+   ``donate_argnums`` expression, so conditional shapes
+   (``(0, 1) if donate else ()``) and wrappers (``donation_safe((0,))``)
+   count as "may donate" — the safe direction;
+2. scan every scope linearly: a ``Name`` passed at a donated position
+   becomes *dead* after the call statement; any later read of a dead name
+   in that scope is ``DON001``.  Rebinding (including the idiomatic
+   ``params = step(params)``) revives the name.
+
+Loop back-edges are not modeled (a read-before-rebind inside a loop body
+is caught only in source order) — the straight-line shape is the one that
+shipped a bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ProjectIndex, int_literals, terminal_name
+
+PASS_ID = "donation-safety"
+
+JIT_NAMES = {"jit", "pjit"}
+DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+def _donation_spec(keywords) -> tuple[set[int], set[str]] | None:
+    """Donated positions/names from a jit call's keywords, or None when
+    nothing (statically) donates."""
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in keywords:
+        if kw.arg == "donate_argnums":
+            nums |= int_literals(kw.value)
+        elif kw.arg == "donate_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+    if nums or names:
+        return nums, names
+    return None
+
+
+def _jit_call_spec(node: ast.Call):
+    """(is_jit_call, donation_spec) for ``jax.jit(...)`` call exprs."""
+    t = terminal_name(node.func)
+    if t in JIT_NAMES:
+        return True, _donation_spec(node.keywords)
+    if t == "partial" and node.args \
+            and terminal_name(node.args[0]) in JIT_NAMES:
+        return True, _donation_spec(node.keywords)
+    return False, None
+
+
+class _ScopeScanner:
+    """Linear scan of one scope's statements tracking dead (donated)
+    names."""
+
+    def __init__(self, mi, scope_name: str, donors: dict,
+                 findings: list[Finding]):
+        self.mi = mi
+        self.scope_name = scope_name
+        self.donors = donors            # name -> (positions, kwnames)
+        self.findings = findings
+        self.dead: dict[str, int] = {}  # name -> donating call lineno
+
+    def flag(self, node, name, call_line):
+        self.findings.append(Finding(
+            pass_id=PASS_ID, rule="DON001", path=self.mi.rel,
+            line=getattr(node, "lineno", 0),
+            scope=f"{self.mi.name or self.mi.rel}:{self.scope_name}"
+            if self.scope_name else (self.mi.name or self.mi.rel),
+            message=(f"`{name}` was donated to a jitted call at line "
+                     f"{call_line} and read afterwards — the buffer is "
+                     "dead (PR 4 shape: donated-buffer aliasing)"),
+            detail=name,
+        ))
+
+    def check_reads(self, expr: ast.AST, skip: set[int] = frozenset()):
+        for n in ast.walk(expr):
+            if id(n) in skip:
+                continue
+            if isinstance(n, ast.Name) \
+                    and isinstance(n.ctx, ast.Load) \
+                    and n.id in self.dead:
+                self.flag(n, n.id, self.dead[n.id])
+
+    def donating_calls(self, expr: ast.AST):
+        """(call node, donated Name args) for calls to known donors."""
+        out = []
+        for n in ast.walk(expr):
+            if not isinstance(n, ast.Call):
+                continue
+            t = terminal_name(n.func)
+            spec = self.donors.get(t)
+            if spec is None:
+                continue
+            positions, kwnames = spec
+            donated: list[str] = []
+            for i, a in enumerate(n.args):
+                if i in positions and isinstance(a, ast.Name):
+                    donated.append(a.id)
+            for kw in n.keywords:
+                if kw.arg in kwnames and isinstance(kw.value, ast.Name):
+                    donated.append(kw.value.id)
+            if donated:
+                out.append((n, donated))
+        return out
+
+    def revive(self, target: ast.AST):
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                self.dead.pop(n.id, None)
+
+    def exec_stmt(self, s):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return  # inner scopes scanned separately
+        exprs = [v for v in (getattr(s, "value", None),
+                             getattr(s, "test", None),
+                             getattr(s, "iter", None),
+                             getattr(s, "exc", None)) if v is not None]
+        if isinstance(s, ast.With):
+            exprs.extend(i.context_expr for i in s.items)
+        for e in exprs:
+            self.check_reads(e)
+            for call, donated in self.donating_calls(e):
+                for name in donated:
+                    self.dead[name] = call.lineno
+        if isinstance(s, (ast.Assign, ast.AnnAssign)):
+            targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+            for t in targets:
+                self.revive(t)
+        elif isinstance(s, ast.AugAssign):
+            # x += f(...) reads x first — already covered by check_reads
+            self.revive(s.target)
+        for fld in ("body", "orelse", "finalbody"):
+            for child in getattr(s, fld, ()):
+                self.exec_stmt(child)
+        for h in getattr(s, "handlers", ()):
+            for child in h.body:
+                self.exec_stmt(child)
+
+    def run(self, body):
+        for s in body:
+            self.exec_stmt(s)
+
+
+def _collect_donors(tree: ast.Module) -> dict:
+    """All names that (may) donate when called: jit-wrapped assignments
+    and donating-jit-decorated defs, collected module-wide (closures call
+    donors bound in enclosing scopes, so one flat namespace is the
+    pragmatic approximation)."""
+    donors: dict[str, tuple[set[int], set[str]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            is_jit, spec = _jit_call_spec(node.value)
+            if is_jit and spec is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donors[t.id] = spec
+        elif isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    is_jit, spec = _jit_call_spec(dec)
+                    if is_jit and spec is not None:
+                        donors[node.name] = spec
+    return donors
+
+
+def run(idx: ProjectIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mi in idx.files:
+        donors = _collect_donors(mi.tree)
+        if not donors:
+            continue
+        # module scope + every function scope, each scanned linearly
+        _ScopeScanner(mi, "", donors, findings).run(
+            [s for s in mi.tree.body
+             if not isinstance(s, (ast.FunctionDef, ast.ClassDef))])
+        for node in ast.walk(mi.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _ScopeScanner(mi, node.name, donors, findings).run(
+                    node.body)
+    return findings

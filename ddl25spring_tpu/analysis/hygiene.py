@@ -1,0 +1,642 @@
+"""trace-hygiene pass: host-Python constructs inside traced code.
+
+Entry points are found statically — functions decorated with (or passed
+to) ``jax.jit`` / ``pjit`` / ``pl.pallas_call`` / ``shard_map`` — and the
+pass walks the static call graph from them (same-module defs at any
+nesting depth, plus ``from .x import f`` edges).  Inside reachable
+functions a lightweight intra-function taint marks values derived from
+the function's array parameters and from ``jax.*`` calls, then flags:
+
+- ``TRC001/TRC002`` — Python ``if``/``while``/``assert`` on a traced
+  value (concretization error or silent trace-time constant);
+- ``TRC003`` — ``.item()``/``.tolist()``/``float()``/``int()``/
+  ``bool()`` on a traced value (host sync / ConcretizationTypeError);
+- ``TRC004`` — ``np.*`` applied to a traced value (silently falls back
+  to host numpy or fails, either way breaks the trace);
+- ``TRC005`` — ``print`` in traced code (runs at trace time only; use
+  ``jax.debug.print``);
+- ``TRC006/TRC007`` — ``time.*`` / ``random.*``/``np.random`` in traced
+  code (evaluated once at trace time, then baked in — the retrace
+  lottery).
+
+Heuristics, stated plainly:
+
+- parameters are traced unless they are ``self``/``cls``, named in
+  ``static_argnames``/``static_argnums``, carry a literal non-None
+  default, or are annotated with a clearly non-array type (``int``,
+  ``float``, ``LlamaConfig``, ...) — only annotations mentioning
+  ``Array``/``ndarray``/``Any``/pytree-ish names stay traced;
+- when a reached function *calls* another in-project function, the
+  callee's parameters matching call arguments that are untainted at the
+  call site are treated static (first call site to reach a function
+  wins);
+- ``.shape``/``.dtype``/``len()``/``jnp.issubdtype``/``is``-comparisons
+  are static under tracing and un-taint;
+- a tainted ``if`` whose body is only ``raise`` is a validation guard —
+  failing loudly at trace time is its purpose — and is not flagged,
+  and expressions inside ``raise`` statements are never flagged;
+- an ``isinstance(x, ...)`` test un-taints ``x`` in both branches (the
+  ``jax.core.Tracer`` host-guard idiom);
+- concretizations inside a ``try`` whose handler catches a
+  ``Tracer*``/``Concretization*`` error are explicitly handled and not
+  flagged;
+- functions passed to ``*_callback`` escape to the host and are not
+  followed.
+
+Residual false positives are baselined with a justification rather than
+special-cased here.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ProjectIndex, dotted_name, terminal_name
+
+PASS_ID = "trace-hygiene"
+
+TRACE_ENTRY = {"jit", "pjit", "pallas_call", "shard_map"}
+UNTAINT_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "aval",
+                 "weak_type", "itemsize", "nbytes"}
+SAFE_CALLS = {"len", "isinstance", "type", "repr", "hash", "getattr",
+              "hasattr", "callable", "id", "str", "format"}
+CAST_CALLS = {"float", "int", "bool", "complex"}
+ITEM_METHODS = {"item", "tolist"}
+CALLBACK_CALLS = {"pure_callback", "io_callback", "callback",
+                  "debug_callback"}
+EXTERNAL_ROOTS = ("jax", "numpy", "time", "random", "datetime", "os")
+# jax calls whose results are static metadata, not tracers
+JAXY_STATIC = {"jax.numpy.issubdtype", "jax.dtypes.issubdtype",
+               "jax.numpy.result_type", "jax.numpy.ndim",
+               "jax.numpy.shape", "jax.eval_shape",
+               "jax.tree_util.tree_structure",
+               "jax.experimental.pallas.cdiv"}
+# annotation tokens that mean "this parameter really is an array/pytree"
+ARRAYISH_ANN = {"Array", "ndarray", "ArrayLike", "array", "Any",
+                "PyTree", "object"}
+TRACER_EXC_MARKERS = ("Tracer", "Concretization")
+
+
+class ModCtx:
+    """Per-module resolution tables for the call-graph walk."""
+
+    def __init__(self, mi, idx: ProjectIndex):
+        self.mi = mi
+        self.idx = idx
+        self.alias: dict[str, str] = {}        # local name -> dotted ext
+        self.funcimports: dict[str, tuple[str, str]] = {}  # name->(mod,fn)
+        self.modalias: dict[str, str] = {}     # local name -> module
+        self.parent_func: dict[int, ast.AST | None] = {}
+        self.defs_in: dict[int | None, dict[str, ast.FunctionDef]] = {}
+        self.qualname: dict[int, str] = {}
+        self._build()
+
+    def _build(self):
+        mi = self.mi
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    if target.split(".")[0] in EXTERNAL_ROOTS:
+                        self.alias[name] = target
+                    if target in self.idx.modules:
+                        self.modalias[name] = target
+            elif isinstance(node, ast.ImportFrom):
+                from .core import _resolve_import
+                targets = _resolve_import(mi.name, mi.is_pkg, node)
+                base = targets[0] if targets else ""
+                for a in node.names:
+                    local = a.asname or a.name
+                    full = f"{base}.{a.name}" if base else a.name
+                    if any(base == r or base.startswith(r + ".")
+                           for r in EXTERNAL_ROOTS):
+                        self.alias[local] = full
+                    if full in self.idx.modules:
+                        self.modalias[local] = full
+                    elif base in self.idx.modules:
+                        self.funcimports[local] = (base, a.name)
+        # lexical function scopes + qualnames
+        def visit(node, parent, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    self.parent_func[id(child)] = parent
+                    self.defs_in.setdefault(
+                        id(parent) if parent is not None else None,
+                        {})[child.name] = child
+                    self.qualname[id(child)] = qn
+                    visit(child, child, qn + ".")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, parent, f"{prefix}{child.name}.")
+                else:
+                    visit(child, parent, prefix)
+        visit(self.mi.tree, None, "")
+
+    def canon(self, node: ast.AST) -> str | None:
+        """Canonical dotted path of an expression through import aliases
+        (``jnp.sum`` -> ``jax.numpy.sum``)."""
+        d = dotted_name(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        root = self.alias.get(head)
+        if root is None:
+            return d
+        return f"{root}.{rest}" if rest else root
+
+    def resolve(self, scope: ast.AST | None, expr: ast.AST):
+        """Resolve a function reference to ``(modctx_key, funcdef)`` —
+        same-module defs through the lexical chain, then ``from``-imports,
+        then ``module.attr`` via module aliases."""
+        if isinstance(expr, ast.Name):
+            cur = scope
+            while True:
+                defs = self.defs_in.get(id(cur) if cur is not None
+                                        else None, {})
+                if expr.id in defs:
+                    return (self.mi.name, defs[expr.id])
+                if cur is None:
+                    break
+                cur = self.parent_func.get(id(cur))
+            if expr.id in self.funcimports:
+                mod, fn = self.funcimports[expr.id]
+                return ("import", (mod, fn))
+        elif isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            mod = self.modalias.get(expr.value.id)
+            if mod is not None:
+                return ("import", (mod, expr.attr))
+        return None
+
+
+def _is_jaxy(dotted: str | None) -> bool:
+    return dotted is not None and (dotted == "jax"
+                                   or dotted.startswith("jax."))
+
+
+def _static_params(call_kwargs, func: ast.FunctionDef) -> set[str]:
+    """Parameter names made static by static_argnums/static_argnames."""
+    out: set[str] = set()
+    args = func.args
+    pos = [a.arg for a in args.posonlyargs + args.args]
+    for kw in call_kwargs:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) \
+                        and isinstance(n.value, int) \
+                        and not isinstance(n.value, bool):
+                    if 0 <= n.value < len(pos):
+                        out.add(pos[n.value])
+    return out
+
+
+def _arrayish_annotation(ann: ast.AST | None) -> bool:
+    """True when the annotation could denote an array/pytree (stays
+    traced); a plainly scalar/config annotation makes the param static."""
+    if ann is None:
+        return True  # unannotated: assume traced
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return any(tok in ann.value for tok in ARRAYISH_ANN)
+    for n in ast.walk(ann):
+        t = terminal_name(n)
+        if t is not None and t in ARRAYISH_ANN:
+            return True
+    return False
+
+
+def _initial_taint(func: ast.FunctionDef, statics: set[str]) -> set[str]:
+    args = func.args
+    tainted: set[str] = set()
+    named = args.posonlyargs + args.args + args.kwonlyargs
+    defaults = dict(zip([a.arg for a in args.args[::-1]],
+                        [d for d in args.defaults[::-1]]))
+    for a in args.kwonlyargs:
+        d = args.kw_defaults[args.kwonlyargs.index(a)]
+        if d is not None:
+            defaults[a.arg] = d
+    for i, a in enumerate(named):
+        if a.arg in statics:
+            continue
+        if i == 0 and a.arg in ("self", "cls"):
+            continue
+        d = defaults.get(a.arg)
+        if isinstance(d, ast.Constant) and d.value is not None:
+            continue  # literal config default -> treated static
+        if a.annotation is not None \
+                and not _arrayish_annotation(a.annotation):
+            continue  # int/float/Config-style annotation -> static
+        tainted.add(a.arg)
+    if args.vararg is not None:
+        tainted.add(args.vararg.arg)
+    return tainted
+
+
+def _isinstance_names(test: ast.AST) -> set[str]:
+    """Names whose type is being inspected anywhere in a test — the
+    ``isinstance(x, jax.core.Tracer)`` host-guard idiom un-taints them."""
+    out: set[str] = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call) \
+                and terminal_name(n.func) == "isinstance" \
+                and n.args and isinstance(n.args[0], ast.Name):
+            out.add(n.args[0].id)
+    return out
+
+
+def _handles_tracer_error(handlers) -> bool:
+    for h in handlers:
+        if h.type is None:
+            return True  # bare except swallows the concretization too
+        for n in ast.walk(h.type):
+            t = terminal_name(n)
+            if t and any(m in t for m in TRACER_EXC_MARKERS):
+                return True
+    return False
+
+
+class _FuncChecker:
+    def __init__(self, ctx: ModCtx, func: ast.FunctionDef,
+                 statics: set[str], findings: list[Finding]):
+        self.ctx = ctx
+        self.func = func
+        self.findings = findings
+        self.scope_name = ctx.qualname.get(id(func), func.name)
+        self.tainted = _initial_taint(func, statics)
+        self.suppress = 0
+
+    # -- taint ------------------------------------------------------------
+
+    def is_tainted(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in UNTAINT_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return (self.is_tainted(node.left)
+                    or any(self.is_tainted(c) for c in node.comparators))
+        if isinstance(node, ast.Call):
+            dotted = self.ctx.canon(node.func)
+            if dotted in JAXY_STATIC:
+                return False
+            if _is_jaxy(dotted):
+                return True
+            t = terminal_name(node.func)
+            if t in SAFE_CALLS or t in CAST_CALLS or t in ITEM_METHODS:
+                return False
+            if isinstance(node.func, ast.Attribute):
+                # method call: taint flows through the receiver — x.sum()
+                # is traced, config.with_resolved(...) is config (even
+                # when handed a traced arg it only inspects metadata)
+                return self.is_tainted(node.func.value)
+            return (any(self.is_tainted(a) for a in node.args)
+                    or any(self.is_tainted(k.value) for k in node.keywords))
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, (ast.Lambda, ast.FunctionDef)):
+            return False
+        return any(self.is_tainted(c) for c in ast.iter_child_nodes(node))
+
+    # -- findings ---------------------------------------------------------
+
+    def flag(self, rule: str, node: ast.AST, message: str, detail: str):
+        if self.suppress:
+            return
+        self.findings.append(Finding(
+            pass_id=PASS_ID, rule=rule, path=self.ctx.mi.rel,
+            line=getattr(node, "lineno", 0),
+            scope=f"{self.ctx.mi.name}:{self.scope_name}",
+            message=message, detail=detail,
+        ))
+
+    def scan_expr(self, node: ast.AST):
+        """Flag violating calls anywhere inside an expression."""
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not isinstance(n, ast.Call):
+                continue
+            dotted = self.ctx.canon(n.func)
+            t = terminal_name(n.func)
+            if dotted is not None:
+                if dotted.startswith("time."):
+                    self.flag("TRC006", n,
+                              f"{dotted}() inside traced code runs once "
+                              "at trace time (timings are baked into the "
+                              "compiled program)", dotted)
+                    continue
+                if dotted.startswith("random.") \
+                        or dotted.startswith("numpy.random."):
+                    self.flag("TRC007", n,
+                              f"{dotted}() inside traced code draws once "
+                              "at trace time; thread a jax PRNG key "
+                              "instead", dotted)
+                    continue
+                if dotted.startswith("numpy.") and (
+                        any(self.is_tainted(a) for a in n.args)
+                        or any(self.is_tainted(k.value)
+                               for k in n.keywords)):
+                    self.flag("TRC004", n,
+                              f"{dotted}() applied to a traced value "
+                              "(host numpy cannot consume tracers; use "
+                              "jnp)", dotted)
+                    continue
+            if isinstance(n.func, ast.Name) and n.func.id == "print":
+                self.flag("TRC005", n,
+                          "print() inside traced code runs at trace time "
+                          "only; use jax.debug.print", "print")
+                continue
+            if t in CAST_CALLS and any(self.is_tainted(a)
+                                       for a in n.args):
+                self.flag("TRC003", n,
+                          f"{t}() on a traced value concretizes the "
+                          "tracer (ConcretizationTypeError / host sync)",
+                          f"{t}()")
+                continue
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ITEM_METHODS \
+                    and self.is_tainted(n.func.value):
+                self.flag("TRC003", n,
+                          f".{n.func.attr}() on a traced value forces a "
+                          "host transfer inside the trace",
+                          f".{n.func.attr}()")
+
+    # -- statement walk ---------------------------------------------------
+
+    def assign_target(self, target: ast.AST, tainted: bool):
+        if isinstance(target, ast.Name):
+            (self.tainted.add if tainted
+             else self.tainted.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self.assign_target(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign_target(target.value, tainted)
+
+    def exec_block(self, stmts):
+        for s in stmts:
+            self.exec_stmt(s)
+
+    def exec_stmt(self, s):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return  # nested defs analyzed separately via the worklist
+        if isinstance(s, ast.Assign):
+            self.scan_expr(s.value)
+            t = self.is_tainted(s.value)
+            for target in s.targets:
+                self.assign_target(target, t)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self.scan_expr(s.value)
+                self.assign_target(s.target, self.is_tainted(s.value))
+        elif isinstance(s, ast.AugAssign):
+            self.scan_expr(s.value)
+            if isinstance(s.target, ast.Name):
+                if self.is_tainted(s.value) or self.is_tainted(s.target):
+                    self.tainted.add(s.target.id)
+        elif isinstance(s, ast.If) or isinstance(s, ast.While):
+            guard_raise = (isinstance(s, ast.If) and not s.orelse
+                           and s.body
+                           and all(isinstance(b, ast.Raise)
+                                   for b in s.body))
+            if guard_raise:
+                # validation guard: failing loudly at trace time is the
+                # point — neither the branch nor its test is a finding
+                self.suppress += 1
+                self.scan_expr(s.test)
+                self.suppress -= 1
+            else:
+                self.scan_expr(s.test)
+                if self.is_tainted(s.test):
+                    kind = "if" if isinstance(s, ast.If) else "while"
+                    self.flag("TRC001", s,
+                              f"Python `{kind}` on a traced value (use "
+                              "jnp.where / lax.cond / lax.while_loop)",
+                              kind)
+            checked = {n for n in _isinstance_names(s.test)
+                       if n in self.tainted}
+            self.tainted -= checked
+            before = set(self.tainted)
+            self.exec_block(s.body)
+            after_body = set(self.tainted)
+            self.tainted = set(before)
+            self.exec_block(s.orelse)
+            self.tainted |= after_body
+            self.tainted |= checked
+        elif isinstance(s, ast.Assert):
+            self.scan_expr(s.test)
+            if self.is_tainted(s.test):
+                self.flag("TRC002", s,
+                          "assert on a traced value (silently ignored "
+                          "under jit or a concretization error; use "
+                          "checkify or static shape checks)", "assert")
+        elif isinstance(s, ast.For):
+            self.scan_expr(s.iter)
+            self.assign_target(s.target, self.is_tainted(s.iter))
+            self.exec_block(s.body)
+            self.exec_block(s.orelse)
+        elif isinstance(s, ast.With):
+            for item in s.items:
+                self.scan_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self.assign_target(item.optional_vars,
+                                       self.is_tainted(item.context_expr))
+            self.exec_block(s.body)
+        elif isinstance(s, ast.Try):
+            if _handles_tracer_error(s.handlers):
+                # the code expects and handles trace-time concretization
+                self.suppress += 1
+                self.exec_block(s.body)
+                self.suppress -= 1
+            else:
+                self.exec_block(s.body)
+            for h in s.handlers:
+                self.exec_block(h.body)
+            self.exec_block(s.orelse)
+            self.exec_block(s.finalbody)
+        elif isinstance(s, ast.Raise):
+            if s.exc is not None:
+                # error-message formatting; a tracer here raises loudly
+                # anyway, which is what the raise wants
+                self.suppress += 1
+                self.scan_expr(s.exc)
+                self.suppress -= 1
+        elif isinstance(s, (ast.Return, ast.Expr)):
+            if s.value is not None:
+                self.scan_expr(s.value)
+        elif isinstance(s, ast.Delete):
+            for target in s.targets:
+                if isinstance(target, ast.Name):
+                    self.tainted.discard(target.id)
+
+    def run(self):
+        self.exec_block(self.func.body)
+
+
+# -- root discovery & reachability ----------------------------------------
+
+
+def _decorator_root(func: ast.FunctionDef):
+    """(is_traced, statics) from the decorator list."""
+    for dec in func.decorator_list:
+        t = terminal_name(dec)
+        if t in ("jit", "pjit"):
+            return True, set()
+        if isinstance(dec, ast.Call):
+            ct = terminal_name(dec.func)
+            if ct in ("jit", "pjit"):
+                return True, _static_params(dec.keywords, func)
+            if ct == "partial" and dec.args:
+                inner = terminal_name(dec.args[0])
+                if inner in ("jit", "pjit"):
+                    return True, _static_params(dec.keywords, func)
+    return False, set()
+
+
+def _callsite_statics(call: ast.Call, callee: ast.FunctionDef,
+                      checker: _FuncChecker) -> set[str]:
+    """Callee params whose matching call-site argument is untainted in
+    the caller — host config threaded through the call graph."""
+    args = callee.args
+    pos = [a.arg for a in args.posonlyargs + args.args]
+    statics: set[str] = set()
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            break
+        if i < len(pos) and not checker.is_tainted(a):
+            statics.add(pos[i])
+    kw_ok = set(pos) | {a.arg for a in args.kwonlyargs}
+    for kw in call.keywords:
+        if kw.arg and kw.arg in kw_ok and not checker.is_tainted(kw.value):
+            statics.add(kw.arg)
+    return statics
+
+
+def run(idx: ProjectIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    ctxs = {mi.name: ModCtx(mi, idx) for mi in idx.files if mi.name}
+
+    # seed the worklist: (ctx, funcdef, statics)
+    work: list[tuple[ModCtx, ast.FunctionDef, set[str]]] = []
+    seen: set[tuple[str, int]] = set()
+
+    def enqueue(ctx: ModCtx, func: ast.FunctionDef, statics: set[str]):
+        key = (ctx.mi.name, id(func))
+        if key in seen:
+            return
+        seen.add(key)
+        work.append((ctx, func, statics))
+
+    def resolved_def(ctx: ModCtx, scope, expr):
+        hit = ctx.resolve(scope, expr)
+        if hit is None:
+            return None
+        kind, payload = hit
+        if kind == "import":
+            mod, fn = payload
+            other = ctxs.get(mod)
+            if other is None:
+                return None
+            func = other.defs_in.get(None, {}).get(fn)
+            return (other, func) if func is not None else None
+        return (ctx, payload)
+
+    def resolve_and_enqueue(ctx: ModCtx, scope, expr, statics: set[str]):
+        hit = resolved_def(ctx, scope, expr)
+        if hit is not None:
+            enqueue(hit[0], hit[1], statics)
+
+    for ctx in ctxs.values():
+        # decorated roots
+        for node in ast.walk(ctx.mi.tree):
+            if isinstance(node, ast.FunctionDef):
+                traced, statics = _decorator_root(node)
+                if traced:
+                    enqueue(ctx, node, statics)
+            elif isinstance(node, ast.Call):
+                t = terminal_name(node.func)
+                if t not in TRACE_ENTRY or not node.args:
+                    continue
+                scope = _enclosing_function(ctx, node)
+                statics = set()
+                first = node.args[0]
+                if isinstance(first, (ast.Name, ast.Attribute)):
+                    # static argnames only apply to the jit family
+                    if t in ("jit", "pjit"):
+                        hit = resolved_def(ctx, scope, first)
+                        if hit is not None:
+                            statics = _static_params(node.keywords, hit[1])
+                    resolve_and_enqueue(ctx, scope, first, statics)
+                elif isinstance(first, ast.Lambda):
+                    pass  # lambdas get checked via their parent function
+
+    # walk the call graph: any referenced in-project function is traced
+    out_findings: list[Finding] = []
+    while work:
+        ctx, func, statics = work.pop()
+        checker = _FuncChecker(ctx, func, statics, out_findings)
+        checker.run()
+        skip_ids: set[int] = set()
+        # the decorator expressions run at def time, on the host
+        for dec in func.decorator_list:
+            for n in ast.walk(dec):
+                skip_ids.add(id(n))
+        for n in ast.walk(func):
+            if isinstance(n, ast.Call) \
+                    and terminal_name(n.func) in CALLBACK_CALLS:
+                for a in n.args:
+                    skip_ids.add(id(a))
+        for n in ast.walk(func):
+            if id(n) in skip_ids:
+                continue
+            if isinstance(n, ast.Call):
+                # direct call: propagate which args are host-static
+                hit = resolved_def(ctx, _enclosing_function(ctx, n)
+                                   or func, n.func)
+                if hit is not None:
+                    enqueue(hit[0], hit[1],
+                            _callsite_statics(n, hit[1], checker))
+                    skip_ids.add(id(n.func))
+                    continue
+            if isinstance(n, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(n, "ctx", None), ast.Load):
+                resolve_and_enqueue(ctx, _enclosing_function(ctx, n)
+                                    or func, n, set())
+        # nested defs inside a traced function body are traced closures
+        for child in ast.walk(func):
+            if isinstance(child, ast.FunctionDef) and child is not func \
+                    and ctx.parent_func.get(id(child)) is func:
+                enqueue(ctx, child, set())
+
+    findings.extend(out_findings)
+    return findings
+
+
+def _enclosing_function(ctx: ModCtx, node: ast.AST):
+    """Nearest enclosing FunctionDef of a node (via a lazily-built parent
+    map per module)."""
+    pm = getattr(ctx, "_parents", None)
+    if pm is None:
+        pm = {}
+        for parent in ast.walk(ctx.mi.tree):
+            for child in ast.iter_child_nodes(parent):
+                pm[id(child)] = parent
+        ctx._parents = pm
+    cur = pm.get(id(node))
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = pm.get(id(cur))
+    return None

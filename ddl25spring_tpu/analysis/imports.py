@@ -1,0 +1,98 @@
+"""import-purity pass: host-only modules must never import jax,
+transitively, at import time.
+
+Builds the static module import graph from top-level ``import`` /
+``from`` statements (function-local imports are lazy by construction and
+deliberately excluded — that is exactly the escape hatch
+``resilience/__init__.py`` and ``secagg/__init__.py`` use) and walks the
+closure of every ``HOST_ONLY_MODULES`` entry.  A module that reaches a
+top-level ``import jax`` anywhere in its closure gets one finding naming
+the full chain, which is far more actionable than the subprocess guard's
+"pulled jax" assertion ever was.
+"""
+
+from __future__ import annotations
+
+from .core import Finding, ProjectIndex
+from .manifest import HOST_ONLY_MODULES, JAX_ROOTS
+
+PASS_ID = "import-purity"
+
+
+def _is_jax(target: str) -> bool:
+    return any(target == r or target.startswith(r + ".")
+               for r in JAX_ROOTS)
+
+
+def build_graph(idx: ProjectIndex):
+    """Per module: in-package import edges and direct jax imports.
+
+    Returns ``(edges, direct)`` where ``edges[name]`` is a sorted list of
+    in-index module names imported at top level (including ancestor
+    packages, whose __init__ executes on any submodule import) and
+    ``direct[name]`` is ``(lineno, target)`` of the first top-level jax
+    import, if any."""
+    edges: dict[str, list[str]] = {}
+    direct: dict[str, tuple[int, str]] = {}
+    for name, mi in idx.modules.items():
+        out: set[str] = set()
+        for lineno, targets in mi.toplevel_imports:
+            for t in targets:
+                if _is_jax(t):
+                    direct.setdefault(name, (lineno, t))
+                    continue
+                # the target module and every ancestor package that is
+                # part of the scanned tree
+                parts = t.split(".")
+                for i in range(1, len(parts) + 1):
+                    prefix = ".".join(parts[:i])
+                    if prefix in idx.modules and prefix != name:
+                        out.add(prefix)
+        edges[name] = sorted(out)
+    return edges, direct
+
+
+def run(idx: ProjectIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    edges, direct = build_graph(idx)
+    in_scope = {n for n in idx.modules}
+    for root in HOST_ONLY_MODULES:
+        if root not in in_scope:
+            # only meaningful when the package was actually scanned
+            if any(n.startswith(root.split(".")[0]) for n in in_scope):
+                findings.append(Finding(
+                    pass_id=PASS_ID, rule="IMP002", path="<manifest>",
+                    line=0, scope=root, detail=root,
+                    message=(f"host-only manifest entry {root} does not "
+                             "exist in the scanned tree"),
+                ))
+            continue
+        # BFS with parent pointers so the finding names the chain
+        parent: dict[str, str | None] = {root: None}
+        queue = [root]
+        hit: str | None = None
+        while queue and hit is None:
+            cur = queue.pop(0)
+            if cur in direct:
+                hit = cur
+                break
+            for nxt in edges.get(cur, ()):
+                if nxt not in parent:
+                    parent[nxt] = cur
+                    queue.append(nxt)
+        if hit is None:
+            continue
+        chain = [hit]
+        while parent[chain[-1]] is not None:
+            chain.append(parent[chain[-1]])
+        chain.reverse()
+        lineno, target = direct[hit]
+        mi = idx.modules[hit]
+        findings.append(Finding(
+            pass_id=PASS_ID, rule="IMP001", path=mi.rel, line=lineno,
+            scope=root, detail=" -> ".join(chain) + f" -> {target}",
+            message=(f"host-only module {root} transitively imports "
+                     f"{target} at import time "
+                     f"(via {' -> '.join(chain)}; {mi.rel}:{lineno})"),
+        ))
+    return findings

@@ -1,0 +1,81 @@
+"""The repo's static contracts, in one place.
+
+``HOST_ONLY_MODULES`` is the declared list of modules that must stay
+importable — *transitively* — without pulling jax into the process.  This
+is the contract the old per-file subprocess guard tests
+(tests/test_obs.py, test_secagg.py, test_serving_fleet.py) enforced one
+module at a time; the import-purity pass now proves it statically for the
+whole list and ``tests/test_analysis.py`` keeps a single subprocess smoke
+as the end-to-end anchor.
+
+Rules for membership: anything a control plane, CPU-only CI job, or
+spawned child process imports before (or instead of) loading a backend —
+telemetry, trace export, host-side secagg accounting, fleet routing,
+fault scheduling, retry/backoff, and this analyzer itself.
+
+``DETERMINISM_ALLOWLIST`` holds repo-relative path globs the determinism
+pass skips entirely (none today: per-finding baselining with a
+justification is preferred because it names each accepted case — add a
+glob only for generated or vendored trees).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+
+PACKAGE = "ddl25spring_tpu"
+
+HOST_ONLY_MODULES = (
+    # package root: importing any submodule executes this first
+    "ddl25spring_tpu",
+    # telemetry surface (obs.enable + spans must work in jax-free children)
+    "ddl25spring_tpu.obs",
+    "ddl25spring_tpu.obs.core",
+    "ddl25spring_tpu.obs.trace",
+    "ddl25spring_tpu.obs.export",
+    "ddl25spring_tpu.obs.watchdog",
+    # host-side secure-aggregation accounting (Shamir, field budgets,
+    # session bookkeeping — the jnp mask math lives in masks/kernels)
+    "ddl25spring_tpu.secagg",
+    "ddl25spring_tpu.secagg.field",
+    "ddl25spring_tpu.secagg.shamir",
+    "ddl25spring_tpu.secagg.protocol",
+    # fleet control plane (routing/health decisions run anywhere)
+    "ddl25spring_tpu.serving_fleet",
+    "ddl25spring_tpu.serving_fleet.policy",
+    "ddl25spring_tpu.serving_fleet.router",
+    "ddl25spring_tpu.serving_fleet.health",
+    # fault scheduling + retry/backoff (wrap arbitrary host callables)
+    "ddl25spring_tpu.resilience",
+    "ddl25spring_tpu.resilience.faults",
+    "ddl25spring_tpu.resilience.retry",
+    # JSONL metrics sink shared by obs and the run scripts
+    "ddl25spring_tpu.utils.logging",
+    # the analyzer itself: graftlint must run in bare CI images
+    "ddl25spring_tpu.analysis",
+    "ddl25spring_tpu.analysis.core",
+    "ddl25spring_tpu.analysis.manifest",
+    "ddl25spring_tpu.analysis.imports",
+    "ddl25spring_tpu.analysis.hygiene",
+    "ddl25spring_tpu.analysis.determinism",
+    "ddl25spring_tpu.analysis.donation",
+    "ddl25spring_tpu.analysis.metrics_drift",
+)
+
+# Modules whose *top-level* import of jax marks the whole transitive
+# closure as jax-tainted.  jaxlib rides along: importing it initializes
+# the same backend machinery.
+JAX_ROOTS = ("jax", "jaxlib", "flax", "optax")
+
+DETERMINISM_ALLOWLIST: tuple[str, ...] = ()
+
+# Anchor files for the metric-drift pass, relative to the repo root.
+OBS_REPORT = "tools/obs_report.py"
+OBS_DOC = "docs/OBSERVABILITY.md"
+# Where metric declarations live beyond the package itself.
+METRIC_DECL_EXTRA = ("bench.py", "tools", "examples")
+
+
+def determinism_allowlisted(rel_path: str) -> bool:
+    return any(fnmatch.fnmatch(rel_path, pat)
+               for pat in DETERMINISM_ALLOWLIST)

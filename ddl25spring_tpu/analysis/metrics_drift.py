@@ -1,0 +1,263 @@
+"""metric-drift pass: the three copies of every metric name must agree.
+
+A metric name lives in (up to) three places that nothing previously tied
+together:
+
+1. **code** — ``obs.inc/observe/set_gauge`` call sites (and registry
+   accessors ``counter/gauge/histogram`` with a literal name, which is how
+   ``obs/core.py`` declares the span histograms and ``obs/watchdog.py``
+   the memory gauges);
+2. **report** — the names ``tools/obs_report.py`` pulls out of a
+   telemetry snapshot via ``_value``/``take``/``_pick``;
+3. **docs** — the ``## Metric reference`` table in
+   ``docs/OBSERVABILITY.md``.
+
+Names drift independently: a renamed counter keeps rendering — into the
+catch-all "other instruments" section — so nothing fails, the report just
+quietly loses its serving/FL/fleet story.  Rules:
+
+- ``MET001`` — declared in code, missing from the doc's metric reference;
+- ``MET002`` — documented, declared nowhere;
+- ``MET003`` — parsed by obs_report, declared nowhere (a report section
+  that can never render);
+- ``MET004`` — kind conflict: the same name is a counter in one place and
+  a gauge/histogram in another (code vs code, report vs code, doc vs
+  code);
+- ``MET005`` — ``docs/OBSERVABILITY.md`` has no parseable
+  ``## Metric reference`` section at all.
+
+Declarations are collected from the scanned package plus
+``manifest.METRIC_DECL_EXTRA`` (bench.py, tools/, examples/ — run scripts
+declare bench gauges the package never touches).  A name passed as a
+variable declares nothing; conditional literals (the watchdog's
+``"..._requests_total" if ... else "..._hits_total"``) declare every
+branch.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .core import Finding, ProjectIndex, literal_strings, terminal_name
+from .manifest import METRIC_DECL_EXTRA, OBS_DOC, OBS_REPORT
+
+PASS_ID = "metric-drift"
+
+# terminal call name -> instrument kind it declares
+DECL_CALLS = {
+    "inc": "counter",
+    "observe": "histogram",
+    "set_gauge": "gauge",
+    "counter": "counter",
+    "gauge": "gauge",
+    "histogram": "histogram",
+}
+
+REPORT_ACCESSORS = {"_value", "take", "_pick"}
+# snapshot-dict variable name at an accessor call site -> kind
+REPORT_KINDS = {"counters": "counter", "gauges": "gauge",
+                "hists": "histogram"}
+
+_DOC_HEADING = re.compile(r"^##\s+Metric reference\s*$", re.MULTILINE)
+_DOC_ROW = re.compile(
+    r"^\|\s*`(?P<name>[A-Za-z_][A-Za-z0-9_]*)(?:\{[^`]*\})?`"
+    r"\s*\|\s*(?P<kind>counter|gauge|histogram)\b", re.MULTILINE)
+_METRIC_NAME = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _decl_from_tree(tree: ast.Module, rel: str, declared: dict) -> None:
+    """Record ``name -> {kind: (rel, line)}`` for every literal-name
+    instrument call in one file."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        kind = DECL_CALLS.get(terminal_name(node.func))
+        if kind is None:
+            continue
+        for name in literal_strings(node.args[0]):
+            if _METRIC_NAME.match(name):
+                declared.setdefault(name, {}).setdefault(
+                    kind, (rel, node.lineno))
+
+
+def collect_declared(idx: ProjectIndex) -> dict:
+    declared: dict[str, dict[str, tuple[str, int]]] = {}
+    seen = {mi.path for mi in idx.files}
+    for mi in idx.files:
+        _decl_from_tree(mi.tree, mi.rel, declared)
+    report_path = (idx.repo_root / OBS_REPORT).resolve()
+    for extra in METRIC_DECL_EXTRA:
+        p = idx.repo_root / extra
+        files = sorted(p.rglob("*.py")) if p.is_dir() else \
+            [p] if p.suffix == ".py" and p.exists() else []
+        for f in files:
+            f = f.resolve()
+            if f in seen or f == report_path:
+                continue  # obs_report *parses* names, it declares none
+            seen.add(f)
+            try:
+                tree = ast.parse(f.read_text(), filename=str(f))
+            except SyntaxError:
+                continue
+            _decl_from_tree(tree, f.relative_to(idx.repo_root).as_posix(),
+                            declared)
+    return declared
+
+
+def collect_report(report_path: Path) -> dict:
+    """``name -> {kind or None: line}`` for every metric the report tool
+    statically pulls from a snapshot."""
+    parsed: dict[str, dict] = {}
+    tree = ast.parse(report_path.read_text(), filename=str(report_path))
+
+    def record(name: str, kind: str | None, line: int) -> None:
+        if _METRIC_NAME.match(name):
+            parsed.setdefault(name, {}).setdefault(kind, line)
+
+    def accessor_kind(call: ast.Call) -> str | None:
+        if call.args and isinstance(call.args[0], ast.Name):
+            return REPORT_KINDS.get(call.args[0].id)
+        return None
+
+    for node in ast.walk(tree):
+        # _value(counters, "name") / take(hists, "name") / _pick(...)
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id in REPORT_ACCESSORS \
+                and len(node.args) >= 2:
+            arg = node.args[1]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                record(arg.value, accessor_kind(node), node.lineno)
+        # for n in ("a_total", "b_total"): take(counters, n)
+        elif isinstance(node, ast.For) and isinstance(node.target, ast.Name) \
+                and isinstance(node.iter, (ast.Tuple, ast.List)):
+            names = [e.value for e in node.iter.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str)]
+            if not names:
+                continue
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call) \
+                        and isinstance(inner.func, ast.Name) \
+                        and inner.func.id in REPORT_ACCESSORS \
+                        and len(inner.args) >= 2 \
+                        and isinstance(inner.args[1], ast.Name) \
+                        and inner.args[1].id == node.target.id:
+                    kind = accessor_kind(inner)
+                    for name in names:
+                        record(name, kind, node.lineno)
+                    break
+        # parse_key(disp)[0] == "span_seconds"
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if not any(isinstance(s, ast.Subscript)
+                       and isinstance(s.value, ast.Call)
+                       and terminal_name(s.value.func) == "parse_key"
+                       for s in sides):
+                continue
+            for s in sides:
+                if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                    record(s.value, None, node.lineno)
+    return parsed
+
+
+def collect_doc(doc_path: Path):
+    """``(section_found, {name: (kind, line)})`` from the doc's
+    ``## Metric reference`` table."""
+    text = doc_path.read_text()
+    m = _DOC_HEADING.search(text)
+    if m is None:
+        return False, {}
+    section = text[m.end():]
+    nxt = re.search(r"^##\s", section, re.MULTILINE)
+    if nxt:
+        section = section[:nxt.start()]
+    base_line = text[:m.end()].count("\n") + 1
+    documented: dict[str, tuple[str, int]] = {}
+    for row in _DOC_ROW.finditer(section):
+        line = base_line + section[:row.start()].count("\n")
+        documented.setdefault(row.group("name"), (row.group("kind"), line))
+    return True, documented
+
+
+def run(idx: ProjectIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    declared = collect_declared(idx)
+    doc_rel = OBS_DOC
+    report_rel = OBS_REPORT
+    report_path = idx.repo_root / report_rel
+    doc_path = idx.repo_root / doc_rel
+
+    parsed = collect_report(report_path) if report_path.exists() else {}
+    if doc_path.exists():
+        section_found, documented = collect_doc(doc_path)
+        if not section_found:
+            findings.append(Finding(
+                pass_id=PASS_ID, rule="MET005", path=doc_rel, line=0,
+                scope=doc_rel, detail="metric-reference",
+                message=(f"{doc_rel} has no '## Metric reference' section "
+                         "— the doc side of the drift check cannot run"),
+            ))
+    else:
+        section_found, documented = False, {}
+
+    for name, kinds in sorted(declared.items()):
+        (kind, (rel, line)) = sorted(kinds.items())[0]
+        if len(kinds) > 1:
+            pretty = ", ".join(f"{k} at {r}:{ln}"
+                               for k, (r, ln) in sorted(kinds.items()))
+            findings.append(Finding(
+                pass_id=PASS_ID, rule="MET004", path=rel, line=line,
+                scope=name, detail=f"{name}:code-kinds",
+                message=(f"metric {name} is declared with conflicting "
+                         f"kinds: {pretty}"),
+            ))
+        if section_found and name not in documented:
+            findings.append(Finding(
+                pass_id=PASS_ID, rule="MET001", path=rel, line=line,
+                scope=name, detail=name,
+                message=(f"metric {name} ({kind}, {rel}:{line}) is not in "
+                         f"{doc_rel}'s metric reference"),
+            ))
+        doc_entry = documented.get(name)
+        if doc_entry and doc_entry[0] not in kinds:
+            findings.append(Finding(
+                pass_id=PASS_ID, rule="MET004", path=doc_rel,
+                line=doc_entry[1], scope=name, detail=f"{name}:doc-kind",
+                message=(f"{doc_rel} documents {name} as {doc_entry[0]} "
+                         f"but code declares it as "
+                         f"{'/'.join(sorted(kinds))}"),
+            ))
+
+    for name, (kind, line) in sorted(documented.items()):
+        if name not in declared:
+            findings.append(Finding(
+                pass_id=PASS_ID, rule="MET002", path=doc_rel, line=line,
+                scope=name, detail=name,
+                message=(f"{doc_rel} documents metric {name} but nothing "
+                         "declares it — stale doc or renamed metric"),
+            ))
+
+    for name, kinds in sorted(parsed.items()):
+        line = min(kinds.values())
+        if name not in declared:
+            findings.append(Finding(
+                pass_id=PASS_ID, rule="MET003", path=report_rel, line=line,
+                scope=name, detail=name,
+                message=(f"{report_rel}:{line} parses metric {name} but "
+                         "nothing declares it — that report section can "
+                         "never render"),
+            ))
+            continue
+        for kind, kline in sorted(kinds.items(), key=lambda kv: str(kv[0])):
+            if kind is not None and kind not in declared[name]:
+                findings.append(Finding(
+                    pass_id=PASS_ID, rule="MET004", path=report_rel,
+                    line=kline, scope=name, detail=f"{name}:report-kind",
+                    message=(f"{report_rel}:{kline} reads {name} from the "
+                             f"{kind} snapshot but code declares it as "
+                             f"{'/'.join(sorted(declared[name]))}"),
+                ))
+    return findings

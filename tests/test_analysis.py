@@ -1,0 +1,519 @@
+"""graftlint (ddl25spring_tpu.analysis) — the static-contract gate.
+
+Four layers:
+
+1. fixture-proven passes — every pass has a positive fixture (a known-bad
+   snippet it must flag) and a negative fixture (idiomatic code it must
+   stay silent on), including the PR 4 donated-buffer-read regression
+   shape;
+2. machinery — stable finding IDs and the baseline round-trip;
+3. CLI contract — the ``--json`` document schema and exit codes;
+4. the tree itself — the shipped package carries zero non-baselined
+   findings, and every ``HOST_ONLY_MODULES`` entry is statically jax-free
+   (this subsumes the per-file subprocess guards that used to live in
+   test_obs.py / test_secagg.py / test_serving_fleet.py; one combined
+   subprocess smoke below keeps an end-to-end runtime anchor).
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from ddl25spring_tpu.analysis import PASS_ORDER, run_passes
+from ddl25spring_tpu.analysis import imports as imports_pass
+from ddl25spring_tpu.analysis import manifest
+from ddl25spring_tpu.analysis.core import (
+    BaselineError,
+    Finding,
+    assign_ids,
+    collect_paths,
+    load_baseline,
+    render_baseline,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+GRAFTLINT = REPO / "tools" / "graftlint.py"
+
+
+def lint_fixture(tmp_path, sources, passes):
+    """Write ``{relpath: source}`` under tmp_path and run the selected
+    passes over ``tmp_path/pkg`` with tmp_path as the repo root."""
+    for rel, text in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return run_passes([tmp_path / "pkg"], tmp_path, passes=passes)
+
+
+def rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# -------------------------------------------------------------------------
+# 1a. import-purity fixtures
+# -------------------------------------------------------------------------
+
+def test_import_purity_flags_transitive_jax(tmp_path):
+    # ddl25spring_tpu.obs is in the manifest; route it to jax through a
+    # helper module and expect the full chain in the finding
+    fs = {
+        "pkg/ddl25spring_tpu/__init__.py": "",
+        "pkg/ddl25spring_tpu/obs/__init__.py": (
+            "from ddl25spring_tpu import helper\n"),
+        "pkg/ddl25spring_tpu/helper.py": "import jax\n",
+    }
+    found = lint_fixture(tmp_path, fs, ("import-purity",))
+    imp = [f for f in found if f.rule == "IMP001"]
+    assert imp, rules(found)
+    chains = {f.detail for f in imp}
+    assert any("ddl25spring_tpu.obs -> ddl25spring_tpu.helper -> jax"
+               in c for c in chains), chains
+
+
+def test_import_purity_reports_missing_manifest_entries(tmp_path):
+    # a scanned ddl25spring_tpu tree that lacks manifest modules is drift
+    # in the manifest itself (IMP002), not silence
+    fs = {"pkg/ddl25spring_tpu/__init__.py": "import os\n"}
+    found = lint_fixture(tmp_path, fs, ("import-purity",))
+    missing = {f.detail for f in found if f.rule == "IMP002"}
+    assert "ddl25spring_tpu.obs" in missing
+
+
+def test_import_purity_accepts_lazy_function_local_import(tmp_path):
+    # the sanctioned escape hatch: jax imported inside a function body
+    fs = {
+        "pkg/ddl25spring_tpu/__init__.py": "",
+        "pkg/ddl25spring_tpu/obs/__init__.py": (
+            "def attach():\n"
+            "    import jax\n"
+            "    return jax\n"),
+    }
+    found = lint_fixture(tmp_path, fs, ("import-purity",))
+    assert not [f for f in found if f.rule == "IMP001"], rules(found)
+
+
+# -------------------------------------------------------------------------
+# 1b. trace-hygiene fixtures
+# -------------------------------------------------------------------------
+
+HYGIENE_BAD = """
+    import time
+    import random
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def bad(x):
+        if x > 0:                      # TRC001
+            x = x + 1
+        assert x.shape[0] > 0 or x > 0 # TRC002 (value term taints test)
+        y = float(x)                   # TRC003
+        z = np.log(x)                  # TRC004
+        print(x)                       # TRC005
+        t0 = time.time()               # TRC006
+        r = random.random()            # TRC007
+        return x + y + z + t0 + r
+"""
+
+
+def test_hygiene_flags_all_rules(tmp_path):
+    found = lint_fixture(tmp_path, {"pkg/mod.py": HYGIENE_BAD},
+                         ("trace-hygiene",))
+    got = set(rules(found))
+    assert {"TRC001", "TRC002", "TRC003", "TRC004", "TRC005", "TRC006",
+            "TRC007"} <= got, got
+
+
+def test_hygiene_reaches_helpers_called_from_jit(tmp_path):
+    # reachability: the violation lives in a helper, not the jitted def
+    fs = {"pkg/mod.py": """
+        import jax
+
+        def helper(x):
+            if x > 0:
+                return x
+            return -x
+
+        @jax.jit
+        def entry(x):
+            return helper(x)
+    """}
+    found = lint_fixture(tmp_path, fs, ("trace-hygiene",))
+    assert any(f.rule == "TRC001" and "helper" in f.scope for f in found), \
+        [(f.rule, f.scope) for f in found]
+
+
+def test_hygiene_negative_idioms_stay_clean(tmp_path):
+    # the idioms the real tree uses: lax control flow, validation guards
+    # that raise, dtype predicates, isinstance(Tracer) host gates, and
+    # host-static parameters threaded via static_argnames
+    fs = {"pkg/mod.py": """
+        from functools import partial
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnames=("n",))
+        def clean(x, n: int):
+            if n > 4:                               # static: annotated int
+                x = x * 2
+            if x.ndim != 2:                         # guard-raise: allowed
+                raise ValueError("need a matrix")
+            if jnp.issubdtype(x.dtype, jnp.inexact):  # dtype predicate
+                x = x.astype(jnp.float32)
+            return jnp.where(x > 0, x, -x)
+
+        def host_side(x):
+            if not isinstance(x, jax.core.Tracer):  # host gate
+                print(x)
+            return x
+    """}
+    found = lint_fixture(tmp_path, fs, ("trace-hygiene",))
+    assert not found, [(f.rule, f.line, f.message) for f in found]
+
+
+# -------------------------------------------------------------------------
+# 1c. determinism fixtures
+# -------------------------------------------------------------------------
+
+DETERMINISM_BAD = """
+    import random
+    import time
+    import numpy as np
+
+    def f():
+        random.shuffle([1, 2])         # DET001
+        rng = random.Random()          # DET002
+        np.random.rand(3)              # DET003
+        seed = time.time_ns()          # DET004 (seed name)
+        return rng, seed
+
+    def g(seed=None):
+        if seed is None:
+            material = str(time.time_ns())
+        else:
+            material = f"run:{seed}"
+        run_id = material              # DET004 survives the seeded arm
+        return run_id
+"""
+
+
+def test_determinism_flags_all_rules(tmp_path):
+    found = lint_fixture(tmp_path, {"pkg/mod.py": DETERMINISM_BAD},
+                         ("determinism",))
+    got = set(rules(found))
+    assert {"DET001", "DET002", "DET003", "DET004"} <= got, got
+    # branch-union taint: the run_id assignment in g() must be flagged
+    assert any(f.rule == "DET004" and f.detail == "run_id" for f in found)
+
+
+def test_determinism_negative_seeded_idioms(tmp_path):
+    fs = {"pkg/mod.py": """
+        import random
+        import numpy as np
+
+        def f(seed):
+            rng = random.Random(seed)
+            g = np.random.default_rng(seed)
+            trace_id = f"run:{seed}"
+            return rng.random() + g.standard_normal(), trace_id
+    """}
+    found = lint_fixture(tmp_path, fs, ("determinism",))
+    assert not found, [(f.rule, f.message) for f in found]
+
+
+# -------------------------------------------------------------------------
+# 1d. donation-safety fixtures (the PR 4 regression shape)
+# -------------------------------------------------------------------------
+
+DONATION_PR4 = """
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, batch):
+        return state + batch
+
+    def run(state, batch):
+        new_state = train_step(state, batch)
+        # PR 4 bug shape: divergence guard reads the *old* state after
+        # its buffer was donated to the step
+        drift = abs(state.sum() - new_state.sum())
+        return new_state, drift
+"""
+
+
+def test_donation_flags_pr4_read_after_donate(tmp_path):
+    found = lint_fixture(tmp_path, {"pkg/mod.py": DONATION_PR4},
+                         ("donation-safety",))
+    don = [f for f in found if f.rule == "DON001"]
+    assert don and don[0].detail == "state", rules(found)
+    assert "donated" in don[0].message
+
+
+def test_donation_rebinding_revives_the_name(tmp_path):
+    fs = {"pkg/mod.py": """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train_step(state, batch):
+            return state + batch
+
+        def run(state, batches):
+            state = train_step(state, batches)
+            return state.sum()          # fine: rebound to the new buffer
+    """}
+    found = lint_fixture(tmp_path, fs, ("donation-safety",))
+    assert not found, [(f.rule, f.message) for f in found]
+
+
+def test_donation_non_donated_args_stay_live(tmp_path):
+    fs = {"pkg/mod.py": """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train_step(state, batch):
+            return state + batch
+
+        def run(state, batch):
+            out = train_step(state, batch)
+            return out + batch.sum()    # batch (argnum 1) is not donated
+    """}
+    found = lint_fixture(tmp_path, fs, ("donation-safety",))
+    assert not found, [(f.rule, f.message) for f in found]
+
+
+# -------------------------------------------------------------------------
+# 1e. metric-drift fixtures
+# -------------------------------------------------------------------------
+
+DRIFT_DOC = """
+    # Observability
+
+    ## Metric reference
+
+    | metric | kind | meaning |
+    | --- | --- | --- |
+    | `foo_total` | counter | declared and documented |
+    | `ghost_seconds` | histogram | documented, declared nowhere |
+    | `qux_total{op}` | gauge | kind conflicts with code |
+
+    ## Next section
+"""
+
+DRIFT_CODE = """
+    from . import obs
+
+    def work():
+        obs.inc("foo_total")
+        obs.inc("qux_total")            # doc says gauge -> MET004
+        obs.set_gauge("undoc_bytes", 1) # not in the doc -> MET001
+"""
+
+DRIFT_REPORT = """
+    def render(counters):
+        _value(counters, "foo_total")
+        _value(counters, "phantom_total")   # declared nowhere -> MET003
+"""
+
+
+def test_metric_drift_three_way_cross_check(tmp_path):
+    fs = {
+        "pkg/mod.py": DRIFT_CODE,
+        "tools/obs_report.py": DRIFT_REPORT,
+        "docs/OBSERVABILITY.md": DRIFT_DOC,
+    }
+    found = lint_fixture(tmp_path, fs, ("metric-drift",))
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, []).append(f.detail)
+    assert by_rule.get("MET001") == ["undoc_bytes"], by_rule
+    assert by_rule.get("MET002") == ["ghost_seconds"], by_rule
+    assert by_rule.get("MET003") == ["phantom_total"], by_rule
+    assert "qux_total:doc-kind" in by_rule.get("MET004", []), by_rule
+    assert "MET005" not in by_rule
+
+
+def test_metric_drift_missing_reference_section(tmp_path):
+    fs = {
+        "pkg/mod.py": "from . import obs\nobs.inc('foo_total')\n",
+        "docs/OBSERVABILITY.md": "# Observability\n\nno table here\n",
+    }
+    found = lint_fixture(tmp_path, fs, ("metric-drift",))
+    assert "MET005" in rules(found)
+
+
+# -------------------------------------------------------------------------
+# 2. machinery: stable IDs + baseline round-trip
+# -------------------------------------------------------------------------
+
+def _finding(line=10, detail="float()"):
+    return Finding(pass_id="trace-hygiene", rule="TRC003", path="a/b.py",
+                   line=line, scope="a.b:f", message="m", detail=detail)
+
+
+def test_finding_ids_survive_line_moves():
+    f1, f2 = [_finding(line=10)], [_finding(line=99)]
+    assign_ids(f1)
+    assign_ids(f2)
+    assert f1[0].id == f2[0].id
+    assert f1[0].id.startswith("GL-TRC003-")
+
+
+def test_finding_ids_disambiguate_repeats_and_details():
+    pair = [_finding(line=10), _finding(line=11)]
+    assign_ids(pair)
+    assert pair[0].id != pair[1].id      # ordinal splits identical keys
+    other = [_finding(line=10, detail="int()")]
+    assign_ids(other)
+    assert other[0].id != pair[0].id     # detail is part of the key
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = [_finding()]
+    assign_ids(findings)
+    path = tmp_path / "baseline.json"
+    path.write_text(render_baseline(findings))
+    with pytest.raises(BaselineError, match="justification"):
+        load_baseline(path)              # empty justification is rejected
+    doc = json.loads(path.read_text())
+    doc["entries"][0]["justification"] = "accepted: fixture"
+    path.write_text(json.dumps(doc))
+    loaded = load_baseline(path)
+    assert set(loaded) == {findings[0].id}
+
+
+def test_baseline_rejects_bad_version_and_duplicates(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"version": 99, "entries": []}))
+    with pytest.raises(BaselineError, match="version"):
+        load_baseline(path)
+    entry = {"id": "GL-X-1", "justification": "ok"}
+    path.write_text(json.dumps({"version": 1, "entries": [entry, entry]}))
+    with pytest.raises(BaselineError, match="duplicate"):
+        load_baseline(path)
+
+
+# -------------------------------------------------------------------------
+# 3. CLI contract: JSON schema + exit codes
+# -------------------------------------------------------------------------
+
+def _cli(*args, cwd=REPO):
+    return subprocess.run([sys.executable, str(GRAFTLINT), *args],
+                          capture_output=True, text=True, timeout=300,
+                          cwd=cwd)
+
+
+FINDING_KEYS = {"id", "pass", "rule", "path", "line", "scope", "message",
+                "detail", "baselined"}
+
+
+def test_cli_json_schema_is_stable_and_tree_is_clean():
+    # acceptance: the shipped tree exits 0 (everything baselined) and the
+    # JSON document keeps its pinned shape
+    out = _cli("ddl25spring_tpu", "--json")
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["version"] == 1
+    assert doc["passes"] == list(PASS_ORDER)
+    assert set(doc["summary"]) == {"total", "baselined", "new",
+                                   "stale_baseline"}
+    assert doc["summary"]["new"] == 0
+    assert doc["summary"]["stale_baseline"] == 0
+    for f in doc["findings"]:
+        assert FINDING_KEYS <= set(f), f
+        assert f["baselined"] and f["justification"].strip()
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nseed = time.time_ns()\n")
+    out = _cli(str(bad), "--passes", "determinism", "--no-baseline")
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "DET004" in out.stdout
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("import os\nseed = int(os.environ.get('SEED', 0))\n")
+    out = _cli(str(clean), "--passes", "determinism", "--no-baseline")
+    assert out.returncode == 0, out.stdout + out.stderr
+
+    out = _cli("--passes", "no-such-pass")
+    assert out.returncode == 2
+    assert "unknown pass" in out.stderr
+
+
+# -------------------------------------------------------------------------
+# 4. the tree itself: manifest-driven purity + one subprocess anchor
+# -------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def purity_findings():
+    idx = collect_paths([REPO / "ddl25spring_tpu"], REPO)
+    return {f.scope: f for f in imports_pass.run(idx)}
+
+
+@pytest.mark.parametrize("module", manifest.HOST_ONLY_MODULES)
+def test_host_only_module_is_statically_jax_free(purity_findings, module):
+    f = purity_findings.get(module)
+    assert f is None, f"{module}: {f.message}"
+
+
+def test_host_only_surface_works_in_a_jax_free_process():
+    # end-to-end anchor for the static proof above: exercise the obs,
+    # secagg, fleet-routing and fault-tolerance surfaces (the workloads
+    # the four retired per-file guard tests ran) in ONE child process and
+    # assert jax never loads
+    code = "\n".join([
+        "import os, random, sys, tempfile",
+        # obs: enable a sink, trace, span, flush
+        "import ddl25spring_tpu.obs as obs",
+        "import ddl25spring_tpu.obs.trace, ddl25spring_tpu.obs.export",
+        "import ddl25spring_tpu.obs.watchdog",
+        "p = os.path.join(tempfile.mkdtemp(), 't.jsonl')",
+        "obs.enable(p); obs.trace.ensure()",
+        "obs.span('x').__enter__(); obs.flush()",
+        # secagg host math: Shamir + field budgets
+        "import ddl25spring_tpu.secagg.shamir as sh",
+        "from ddl25spring_tpu.secagg.field import FieldSpec",
+        "spec = FieldSpec.for_budget(4.0, 250); spec.check_budget()",
+        "assert sh.reconstruct(sh.share(99, 5, 3, random.Random(0))[:3]) "
+        "== 99",
+        # fleet routing + health/failover over fake replicas
+        "from ddl25spring_tpu.resilience import (",
+        "    FaultyReplica, ReplicaFaultSchedule)",
+        "from ddl25spring_tpu.serving_fleet import (",
+        "    BreakerConfig, FleetHealth, FleetRouter)",
+        "class Slot:",
+        "    free = False",
+        "    def __init__(s, rid): s.request_id = rid; s.emitted = []",
+        "class R:",
+        "    max_batch = 2",
+        "    def __init__(s): s._queue = []; s.slots = []",
+        "    @property",
+        "    def in_flight(s): return len(s._queue) + len(s.slots)",
+        "    def submit(s, rid, p, b, deadline_s=None):",
+        "        s._queue.append(rid)",
+        "    def step(s):",
+        "        if s._queue: s.slots.append(Slot(s._queue.pop(0)))",
+        "        done = {sl.request_id: [1] for sl in s.slots}",
+        "        s.slots = []",
+        "        return done",
+        "sched = ReplicaFaultSchedule(crash_at=((0, 0),))",
+        "reps = [FaultyReplica(R(), sched, i) for i in range(2)]",
+        "r = FleetRouter(reps, health=FleetHealth(2, BreakerConfig()))",
+        "r.submit('a', [1, 2], 1)",
+        "assert list(r.drain()) == ['a']",
+        "obs.disable()",
+        "assert 'jax' not in sys.modules, 'host surface pulled jax'",
+        "print('ok')",
+    ])
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"

@@ -13,11 +13,11 @@ paged batcher, so each layer must reproduce its streams bit for bit:
 - a 2-replica fleet's merged streams equal the per-replica replays of
   its pinned routing trace AND the single-batcher reference,
 - routing policy ordering and bounded re-route are pure host logic,
-  testable with fake replicas in a jax-free process (the import guard
-  subprocess proves ``serving_fleet``'s host modules never pull jax).
+  testable with fake replicas in a jax-free process (graftlint's
+  import-purity pass + tests/test_analysis.py prove the host modules
+  never pull jax).
 """
 
-import subprocess
 import sys
 from pathlib import Path
 
@@ -186,33 +186,9 @@ def test_router_duplicate_rid_raises():
         router.submit(0, [2], 2)
 
 
-def test_serving_fleet_host_modules_never_import_jax():
-    # same contract as obs: policy/router (and the package itself) are
-    # host code — routing over fake replicas must run in a jax-free
-    # process so fleet control planes don't pay for (or depend on) jax
-    code = "\n".join([
-        "import sys",
-        "from ddl25spring_tpu.serving_fleet import (",
-        "    FleetRouter, ReplicaSnapshot, rank_replicas)",
-        "class R:",
-        "    max_batch = 1",
-        "    in_flight = 0",
-        "    def __init__(self): self._queue = []; self._slots = []",
-        "    def submit(self, rid, p, b, deadline_s=None):",
-        "        self._queue.append((rid, p, b))",
-        "    def step(self): return {}",
-        "r = FleetRouter([R(), R()])",
-        "r.submit(0, [1, 2], 4)",
-        "assert r.stats['routed'] == 1",
-        "assert 'jax' not in sys.modules, 'serving_fleet pulled jax'",
-        "print('ok')",
-    ])
-    out = subprocess.run(
-        [sys.executable, "-c", code], cwd=REPO,
-        capture_output=True, text=True, timeout=120,
-    )
-    assert out.returncode == 0, out.stderr
-    assert out.stdout.strip() == "ok"
+# (the serving_fleet jax-free guard now lives in tests/test_analysis.py:
+# graftlint's import-purity pass proves it statically for every
+# HOST_ONLY_MODULES entry, and one combined subprocess smoke anchors it)
 
 
 # -- tensor-parallel replica -----------------------------------------------
@@ -694,46 +670,8 @@ def test_chaos_wrap_requires_fleet():
         loadgen.chaos_wrap(_StreamFake(), ReplicaFaultSchedule())
 
 
-def test_fleet_fault_modules_never_import_jax():
-    # the whole fault-tolerance plane — schedule, wrapper, health,
-    # router failover — must run in a jax-free process
-    code = "\n".join([
-        "import sys",
-        "from ddl25spring_tpu.resilience import (",
-        "    FaultyReplica, ReplicaFaultSchedule)",
-        "from ddl25spring_tpu.serving_fleet import (",
-        "    BreakerConfig, FleetHealth, FleetRouter)",
-        "class Slot:",
-        "    free = False",
-        "    def __init__(s, rid): s.request_id = rid; s.emitted = []",
-        "class R:",
-        "    max_batch = 2",
-        "    def __init__(s): s._queue = []; s.slots = []",
-        "    @property",
-        "    def in_flight(s): return len(s._queue) + len(s.slots)",
-        "    def submit(s, rid, p, b, deadline_s=None):",
-        "        s._queue.append(rid)",
-        "    def step(s):",
-        "        if s._queue: s.slots.append(Slot(s._queue.pop(0)))",
-        "        done = {sl.request_id: [1] for sl in s.slots}",
-        "        s.slots = []",
-        "        return done",
-        "sched = ReplicaFaultSchedule(crash_at=((0, 0),))",
-        "reps = [FaultyReplica(R(), sched, i) for i in range(2)]",
-        "r = FleetRouter(reps, health=FleetHealth(2, BreakerConfig()))",
-        "r.submit('a', [1, 2], 1)",
-        "out = r.drain()",
-        "assert list(out) == ['a'], out",
-        "assert r.stats['replicas_failed'] in (0, 1)",
-        "assert 'jax' not in sys.modules, 'fault plane pulled jax'",
-        "print('ok')",
-    ])
-    out = subprocess.run(
-        [sys.executable, "-c", code], cwd=REPO,
-        capture_output=True, text=True, timeout=120,
-    )
-    assert out.returncode == 0, out.stderr
-    assert out.stdout.strip() == "ok"
+# (the fault-plane jax-free guard also moved to tests/test_analysis.py —
+# same static proof + combined smoke as the router guard above)
 
 
 def test_chaos_exactness_real_batchers(setup):

@@ -1,0 +1,141 @@
+"""graftlint CLI — run the ddl25spring_tpu static contract passes.
+
+Usage:
+    python tools/graftlint.py                       # lint ddl25spring_tpu
+    python tools/graftlint.py ddl25spring_tpu/fl    # subtree only
+    python tools/graftlint.py --json                # machine-readable
+    python tools/graftlint.py --passes determinism,donation-safety
+    python tools/graftlint.py --write-baseline      # accept current state
+    python tools/graftlint.py --no-baseline         # raw findings
+
+Exit codes: 0 — clean (every finding baselined, no stale baseline
+entries); 1 — non-baselined findings (or stale baseline entries naming
+findings that no longer exist); 2 — usage/configuration errors (bad
+baseline file, unknown pass, unparseable source).
+
+The JSON document is a stable contract (tests/test_analysis.py pins it):
+
+    {"version": 1,
+     "passes": ["import-purity", ...],
+     "findings": [{"id", "pass", "rule", "path", "line", "scope",
+                   "message", "detail", "baselined", "justification"?}],
+     "summary": {"total", "baselined", "new", "stale_baseline"}}
+
+Baselining: ``--write-baseline`` rewrites the baseline with *all*
+current findings, carrying existing justifications over and leaving new
+entries' justifications empty — fill each one in by hand; the loader
+rejects empty justifications, so an unexplained entry cannot ship.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from ddl25spring_tpu.analysis import (  # noqa: E402
+    PASS_ORDER,
+    BaselineError,
+    load_baseline,
+    render_baseline,
+    run_passes,
+)
+
+JSON_VERSION = 1
+DEFAULT_BASELINE = REPO_ROOT / "tools" / "graftlint_baseline.json"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="static trace-hygiene / determinism / contract "
+                    "analyzer for the ddl25spring_tpu tree")
+    ap.add_argument("paths", nargs="*", type=Path,
+                    default=[REPO_ROOT / "ddl25spring_tpu"],
+                    help="files or directories to lint "
+                         "(default: ddl25spring_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the JSON document instead of human output")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                    help="baseline file of accepted findings "
+                         "(default: tools/graftlint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding as new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(carries over existing justifications)")
+    ap.add_argument("--passes", type=str, default=None,
+                    help="comma-separated subset of: "
+                         + ", ".join(PASS_ORDER))
+    args = ap.parse_args(argv)
+
+    passes = None
+    if args.passes:
+        passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+
+    try:
+        findings = run_passes(list(args.paths), REPO_ROOT, passes)
+    except (ValueError, OSError, BaselineError) as e:
+        print(f"graftlint: error: {e}", file=sys.stderr)
+        return 2
+
+    baseline: dict[str, dict] = {}
+    if not args.no_baseline and args.baseline.exists():
+        try:
+            baseline = load_baseline(args.baseline)
+        except (BaselineError, json.JSONDecodeError) as e:
+            print(f"graftlint: error: {e}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        args.baseline.write_text(render_baseline(findings, baseline))
+        blank = sum(1 for f in findings
+                    if not baseline.get(f.id, {}).get("justification"))
+        print(f"graftlint: wrote {args.baseline} "
+              f"({len(findings)} entries, {blank} needing a "
+              "justification)")
+        return 0
+
+    for f in findings:
+        entry = baseline.get(f.id)
+        if entry is not None:
+            f.baselined = True
+            f.justification = str(entry.get("justification", ""))
+    current_ids = {f.id for f in findings}
+    stale = sorted(fid for fid in baseline if fid not in current_ids)
+    new = [f for f in findings if not f.baselined]
+
+    doc = {
+        "version": JSON_VERSION,
+        "passes": list(passes or PASS_ORDER),
+        "findings": [f.to_json() for f in findings],
+        "summary": {
+            "total": len(findings),
+            "baselined": len(findings) - len(new),
+            "new": len(new),
+            "stale_baseline": len(stale),
+        },
+    }
+    if args.as_json:
+        print(json.dumps(doc, indent=2))
+    else:
+        for f in findings:
+            mark = "baselined" if f.baselined else "NEW"
+            print(f"{f.path}:{f.line}: [{f.rule}] {f.message} "
+                  f"({f.id}, {mark})")
+        for fid in stale:
+            print(f"{args.baseline.name}: stale baseline entry {fid} "
+                  "(finding no longer produced — remove it)")
+        s = doc["summary"]
+        print(f"graftlint: {s['total']} finding(s): {s['new']} new, "
+              f"{s['baselined']} baselined, {s['stale_baseline']} stale "
+              "baseline entr(ies)")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
