@@ -34,6 +34,9 @@ HOST_ONLY_MODULES = (
     "ddl25spring_tpu.obs.trace",
     "ddl25spring_tpu.obs.export",
     "ddl25spring_tpu.obs.watchdog",
+    # windowed telemetry plane (ring-buffer series + burn-rate monitors)
+    "ddl25spring_tpu.obs.timeseries",
+    "ddl25spring_tpu.obs.slo",
     # host-side secure-aggregation accounting (Shamir, field budgets,
     # session bookkeeping — the jnp mask math lives in masks/kernels)
     "ddl25spring_tpu.secagg",
@@ -45,6 +48,7 @@ HOST_ONLY_MODULES = (
     "ddl25spring_tpu.serving_fleet.policy",
     "ddl25spring_tpu.serving_fleet.router",
     "ddl25spring_tpu.serving_fleet.health",
+    "ddl25spring_tpu.serving_fleet.autoscale",
     # fault scheduling + retry/backoff (wrap arbitrary host callables)
     "ddl25spring_tpu.resilience",
     "ddl25spring_tpu.resilience.faults",

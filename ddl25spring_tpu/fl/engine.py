@@ -1892,6 +1892,9 @@ def make_fl_round(
             obs.inc("secagg_rounds_total")
             obs.inc("secagg_bytes_total", nr_sampled * u32)
             obs.set_gauge("secagg_bytes_per_round", nr_sampled * u32)
+        # step hook for the windowed telemetry plane: one time-series
+        # sample per round (host side only — never under a tracer)
+        obs.record_samples()
         return new_params
 
     # expose the raw jitted step + its device-resident data so callers can

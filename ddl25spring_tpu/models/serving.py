@@ -1395,6 +1395,12 @@ class ContinuousBatcher:
             obs.inc("serving_requests_total", len(finished))
             obs.inc("serving_tokens_total",
                     sum(len(v) for v in finished.values()))
+        if obs.enabled():
+            # the queue-depth series the autoscaler and the burn-rate
+            # monitors window over (one sample per decode chunk)
+            obs.set_gauge("serving_queue_depth",
+                          len(self._queue) + len(self._instant))
+        obs.record_samples()
         # tag evicted requests (their partial streams still compare equal
         # to the same plain list); clean completions stay plain lists
         for rid in list(finished):

@@ -41,16 +41,24 @@ from pathlib import Path
 from . import trace
 from .core import (DEFAULT_BUCKETS, NULL_SPAN, Counter, Gauge, Histogram,
                    Telemetry)
+from .slo import BurnRateMonitor, BurnWindows, SloSpec
+from .timeseries import HistogramRing, SeriesRing, TimeSeriesRecorder
 
 __all__ = [
     "Telemetry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
     "trace",
+    "TimeSeriesRecorder", "SeriesRing", "HistogramRing",
+    "BurnRateMonitor", "BurnWindows", "SloSpec",
     "enable", "disable", "enabled", "get",
+    "install_recorder", "uninstall_recorder", "recorder", "monitors",
+    "record_samples",
     "span", "inc", "observe", "set_gauge", "event", "flush", "render_prom",
     "step_annotation",
 ]
 
 _T: Telemetry | None = None
+_RECORDER: TimeSeriesRecorder | None = None
+_MONITORS: tuple = ()
 
 
 class _JsonlSink:
@@ -113,6 +121,47 @@ def get() -> Telemetry | None:
     return _T
 
 
+def install_recorder(rec: TimeSeriesRecorder, *, monitors=()) -> None:
+    """Install the process-global :class:`TimeSeriesRecorder` that
+    :func:`record_samples` feeds (the step hook called from
+    ``ContinuousBatcher.step``, ``FleetRouter.step`` and the FL round
+    loop).  ``monitors`` are :class:`BurnRateMonitor` instances
+    evaluated after every sample, so burn-rate state advances in
+    lockstep with the series."""
+    global _RECORDER, _MONITORS
+    _RECORDER = rec
+    _MONITORS = tuple(monitors)
+
+
+def uninstall_recorder() -> None:
+    global _RECORDER, _MONITORS
+    if _RECORDER is not None:
+        _RECORDER.detach()
+    _RECORDER = None
+    _MONITORS = ()
+
+
+def recorder() -> TimeSeriesRecorder | None:
+    return _RECORDER
+
+
+def monitors() -> tuple:
+    return _MONITORS
+
+
+def record_samples() -> None:
+    """Step hook: snapshot the installed recorder's tracked instruments
+    and advance its burn-rate monitors.  A single ``is None`` check when
+    no recorder (or no telemetry) is installed — instrumented loops pay
+    nothing in the default configuration."""
+    t, rec = _T, _RECORDER
+    if t is None or rec is None:
+        return
+    rec.sample(t)
+    for m in _MONITORS:
+        m.evaluate(t)
+
+
 def span(name: str, **fields):
     """Timing context manager (see :meth:`Telemetry.span`); a shared no-op
     when disabled."""
@@ -145,10 +194,16 @@ def event(name: str, **fields):
 
 
 def flush():
-    """Emit the aggregate snapshot as one ``telemetry_summary`` event."""
+    """Emit the aggregate snapshot as one ``telemetry_summary`` event —
+    plus, with a recorder installed, one ``timeseries`` event carrying
+    the recorded series and monitor states (what the report's
+    time-series section renders)."""
     t = _T
     if t is not None:
         t.flush()
+        if _RECORDER is not None:
+            t.event("timeseries", series=_RECORDER.snapshot(),
+                    monitors=[m.describe() for m in _MONITORS])
 
 
 def render_prom() -> str:

@@ -1,0 +1,174 @@
+"""Multi-window SLO burn-rate monitors over recorded time series.
+
+An SLO here is an error budget: ``objective`` is the target good
+fraction (0.99 means 1% of observations may be "bad" before the budget
+is spent).  A monitor recomputes, at every sample, the *bad fraction*
+over a fast and a slow trailing window and divides each by the budget —
+the classic burn rate.  ``burn == 1`` spends the budget exactly at the
+sustainable pace; the alert trips only when BOTH windows burn at or
+above ``threshold`` — the fast window proves the problem is happening
+*now*, the slow window proves it is not a single-sample blip (the
+multiwindow, multi-burn-rate recipe from the SRE workbook, with sample
+windows instead of wall-clock windows so the math stays deterministic).
+
+Two spec kinds, both computed from :class:`TimeSeriesRecorder` rings:
+
+- ``quantile`` — a histogram series vs a latency threshold: the bad
+  fraction is :meth:`HistogramRing.window_frac_over` (e.g. queue-wait
+  observations over ``slo_deadline_s``; objective 0.99 makes this
+  exactly "p99 queue-wait under the deadline").
+- ``ratio`` — two counter series: ``delta(bad)/delta(total)`` over the
+  window (e.g. rejects vs requests, reroutes vs routed).  Label sets of
+  the named counters are summed.
+
+Alert transitions increment ``slo_burn_alerts_total{slo,window}`` and
+stream ``slo.burn`` events; ``tools/obs_report.py`` renders both.
+Stdlib-only; listed in ``analysis/manifest.HOST_ONLY_MODULES``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .timeseries import HistogramRing, SeriesRing, TimeSeriesRecorder
+
+__all__ = ["BurnWindows", "SloSpec", "BurnRateMonitor"]
+
+
+@dataclass(frozen=True)
+class BurnWindows:
+    """A fast/slow trailing-window pair (in sample intervals) and the
+    burn multiplier that trips the alert in both."""
+
+    fast: int = 6
+    slow: int = 36
+    threshold: float = 2.0
+
+    @property
+    def label(self) -> str:
+        return f"{self.fast}/{self.slow}"
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """One error budget.  ``kind`` is ``"quantile"`` (``source`` names a
+    histogram, ``threshold_s`` is the latency bound) or ``"ratio"``
+    (``source`` names the bad-event counter, ``total`` the denominator
+    counter)."""
+
+    name: str
+    objective: float
+    kind: str
+    source: str
+    threshold_s: float = 0.0
+    total: str = ""
+
+    def __post_init__(self):
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f"objective must be in (0, 1), got {self.objective}")
+        if self.kind not in ("quantile", "ratio"):
+            raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if self.kind == "ratio" and not self.total:
+            raise ValueError("ratio SLO needs a total counter name")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+
+class BurnRateMonitor:
+    """Evaluates one :class:`SloSpec` against a recorder's rings.
+
+    Call :meth:`evaluate` after each ``recorder.sample`` (the module
+    helper ``obs.record_samples`` does this for installed monitors).
+    State per window pair is ``"ok"``/``"burning"``; only the
+    ok->burning transition counts as an alert, so a sustained burn is
+    one alert, not one per sample."""
+
+    def __init__(self, recorder: TimeSeriesRecorder, spec: SloSpec,
+                 windows=(BurnWindows(),)):
+        self.recorder = recorder
+        self.spec = spec
+        self.windows = tuple(windows)
+        if not self.windows:
+            raise ValueError("need at least one window pair")
+        self._state = {w.label: "ok" for w in self.windows}
+        self.history: list = []      # [(step, label, fast, slow, state)]
+        self.alerts = 0
+        self.first_alert_step: int | None = None
+
+    # -- bad-fraction sources --------------------------------------------
+
+    def _bad_frac(self, window: int) -> float:
+        spec = self.spec
+        if spec.kind == "quantile":
+            over = 0
+            total = 0
+            for ring in self.recorder.matching(spec.source).values():
+                if not isinstance(ring, HistogramRing):
+                    continue
+                n = ring.window_count(window)
+                over += ring.window_frac_over(spec.threshold_s, window) * n
+                total += n
+            return over / total if total else 0.0
+        bad = sum(r.delta(window)
+                  for r in self.recorder.matching(spec.source).values()
+                  if isinstance(r, SeriesRing))
+        total = sum(r.delta(window)
+                    for r in self.recorder.matching(spec.total).values()
+                    if isinstance(r, SeriesRing))
+        return bad / total if total else 0.0
+
+    # -- evaluation ------------------------------------------------------
+
+    def evaluate(self, telemetry=None) -> dict:
+        """Recompute burn rates for every window pair at the recorder's
+        current sample position; returns ``{label: {...}}``.  With a
+        registry, transitions bump the alert counter and stream
+        ``slo.burn`` events."""
+        step = self.recorder._step - 1
+        budget = self.spec.budget
+        out: dict = {}
+        for w in self.windows:
+            fast = self._bad_frac(w.fast) / budget
+            slow = self._bad_frac(w.slow) / budget
+            burning = fast >= w.threshold and slow >= w.threshold
+            state = "burning" if burning else "ok"
+            prev = self._state[w.label]
+            if state != prev:
+                if burning:
+                    self.alerts += 1
+                    if self.first_alert_step is None:
+                        self.first_alert_step = step
+                    if telemetry is not None:
+                        telemetry.counter("slo_burn_alerts_total",
+                                          slo=self.spec.name,
+                                          window=w.label).inc()
+                if telemetry is not None:
+                    telemetry.event("slo.burn", slo=self.spec.name,
+                                    window=w.label, step=step, state=state,
+                                    burn_fast=round(fast, 4),
+                                    burn_slow=round(slow, 4))
+                self.history.append((step, w.label, round(fast, 4),
+                                     round(slow, 4), state))
+            self._state[w.label] = state
+            out[w.label] = {"burn_fast": fast, "burn_slow": slow,
+                            "state": state}
+        return out
+
+    def describe(self) -> dict:
+        """JSON-able monitor state for reports and the sweep output."""
+        return {
+            "slo": self.spec.name,
+            "kind": self.spec.kind,
+            "objective": self.spec.objective,
+            "alerts": self.alerts,
+            "first_alert_step": self.first_alert_step,
+            "state": dict(self._state),
+            "transitions": [
+                {"step": s, "window": w, "burn_fast": f, "burn_slow": sl,
+                 "state": st}
+                for s, w, f, sl, st in self.history[-64:]
+            ],
+        }

@@ -1,0 +1,310 @@
+"""Windowed time series over the obs registry: ring-buffer samplers.
+
+The registry (:mod:`ddl25spring_tpu.obs.core`) holds *cumulative*
+instrument state — a counter only ever grows, a histogram only ever
+accumulates.  This module turns those point-in-time snapshots into
+bounded time series: a :class:`TimeSeriesRecorder` copies the tracked
+instruments' state into fixed-capacity rings at every sample point
+(a span exit via :func:`ddl25spring_tpu.obs.core.add_span_exit_hook`,
+or an explicit step hook — ``obs.record_samples()`` is called from
+``ContinuousBatcher.step``, ``FleetRouter.step`` and the FL round loop),
+and the derived views — :meth:`SeriesRing.delta`, :meth:`SeriesRing.rate`,
+:meth:`SeriesRing.ewma`, :meth:`HistogramRing.window_quantile` — are
+computed from ring contents only.
+
+Windowed histogram quantiles need no per-observation storage: the
+log-bucket counts are cumulative, so the observations that landed inside
+a window are exactly the *difference* of two bucket-count snapshots, and
+the same within-bucket interpolation the live :class:`Histogram` uses
+recovers the quantile of just that window.
+
+Determinism contract (graftlint DET rules): nothing here reads a wall
+clock or an RNG.  The x-axis is a monotone sample index maintained by the
+recorder, so two identical seeded runs that sample at the same program
+points produce bit-identical series — the property the fleet chaos test
+asserts.  Stdlib-only; listed in ``analysis/manifest.HOST_ONLY_MODULES``.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from .core import Counter, Gauge, Histogram, _labels_key, add_span_exit_hook, \
+    remove_span_exit_hook
+
+__all__ = ["SeriesRing", "HistogramRing", "TimeSeriesRecorder"]
+
+
+def _display(name: str, lk: tuple) -> str:
+    """Same ``name{k=v,...}`` format as ``Telemetry.snapshot``."""
+    return name + ("{" + ",".join(f"{k}={v}" for k, v in lk) + "}"
+                   if lk else "")
+
+
+class SeriesRing:
+    """Fixed-capacity ring of ``(step, value)`` samples for one scalar
+    instrument (counter or gauge)."""
+
+    __slots__ = ("kind", "_q")
+
+    def __init__(self, kind: str, capacity: int):
+        self.kind = kind
+        self._q: deque = deque(maxlen=capacity)
+
+    def append(self, step: int, value) -> None:
+        self._q.append((int(step), value))
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def steps(self) -> list:
+        return [s for s, _v in self._q]
+
+    def values(self) -> list:
+        return [v for _s, v in self._q]
+
+    def last(self):
+        return self._q[-1][1] if self._q else None
+
+    def delta(self, window: int = 1):
+        """Value change over the last ``window`` sample intervals (the
+        whole buffer when fewer are held).  0 with under two samples."""
+        if len(self._q) < 2:
+            return 0
+        items = list(self._q)
+        base = items[max(0, len(items) - 1 - max(1, int(window)))]
+        return items[-1][1] - base[1]
+
+    def rate(self, window: int = 1) -> float:
+        """Per-step rate: :meth:`delta` divided by the sample-index span
+        it covers.  Deterministic — steps, not wall seconds."""
+        if len(self._q) < 2:
+            return 0.0
+        items = list(self._q)
+        base = items[max(0, len(items) - 1 - max(1, int(window)))]
+        span = items[-1][0] - base[0]
+        return (items[-1][1] - base[1]) / span if span else 0.0
+
+    def ewma(self, alpha: float = 0.3) -> float:
+        """Exponentially weighted average over the buffered values."""
+        out = None
+        for _s, v in self._q:
+            out = v if out is None else (1 - alpha) * out + alpha * v
+        return 0.0 if out is None else out
+
+    def window(self, n: int) -> list:
+        """The last ``n`` values (oldest first)."""
+        return [v for _s, v in list(self._q)[-max(1, int(n)):]]
+
+
+class HistogramRing:
+    """Ring of cumulative log-bucket snapshots for one histogram.
+
+    Each sample stores ``(step, counts, count, total)`` where ``counts``
+    is the full per-bucket tuple; windowed views difference two samples,
+    which recovers exactly the observations that landed between them."""
+
+    __slots__ = ("kind", "bounds", "_q")
+
+    def __init__(self, capacity: int):
+        self.kind = "histogram"
+        self.bounds: tuple = ()
+        self._q: deque = deque(maxlen=capacity)
+
+    def append(self, step: int, hist: Histogram) -> None:
+        if not self.bounds:
+            self.bounds = hist.bounds
+        self._q.append((int(step), tuple(hist.counts), hist.count,
+                        hist.total))
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def steps(self) -> list:
+        return [s for s, _c, _n, _t in self._q]
+
+    def counts_series(self) -> list:
+        """Cumulative observation count at each sample."""
+        return [n for _s, _c, n, _t in self._q]
+
+    def _window_pair(self, window):
+        items = list(self._q)
+        if not items:
+            return None, None
+        if window is None:
+            base = items[0] if len(items) > 1 else None
+        else:
+            i = max(0, len(items) - 1 - max(1, int(window)))
+            base = items[i] if i < len(items) - 1 else None
+        return items[-1], base
+
+    def window_count(self, window: int | None = None) -> int:
+        new, old = self._window_pair(window)
+        if new is None:
+            return 0
+        return new[2] - (old[2] if old else 0)
+
+    def window_frac_over(self, threshold: float,
+                         window: int | None = None) -> float:
+        """Fraction of the window's observations in buckets whose upper
+        bound exceeds ``threshold`` — bucket-resolution, so an
+        observation counts as "over" when its whole bucket is not
+        provably under (the conservative direction for an SLO)."""
+        new, old = self._window_pair(window)
+        if new is None:
+            return 0.0
+        counts = (list(new[1]) if old is None
+                  else [a - b for a, b in zip(new[1], old[1])])
+        total = sum(counts)
+        if not total:
+            return 0.0
+        bad = sum(c for i, c in enumerate(counts)
+                  if i == len(self.bounds) or self.bounds[i] > threshold)
+        return bad / total
+
+    def window_quantile(self, q: float, window: int | None = None) -> float:
+        """q-quantile of the observations inside the window, recovered
+        from the bucket-count difference with the live histogram's
+        within-bucket interpolation (the overflow bucket's upper edge is
+        approximated by the largest finite bound)."""
+        new, old = self._window_pair(window)
+        if new is None:
+            return 0.0
+        counts = (list(new[1]) if old is None
+                  else [a - b for a, b in zip(new[1], old[1])])
+        total = sum(counts)
+        if not total:
+            return 0.0
+        rank = q * total
+        seen = 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= rank and c:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else self.bounds[-1])
+                frac = (rank - (seen - c)) / c
+                return lo + (hi - lo) * frac
+        return self.bounds[-1]
+
+
+class TimeSeriesRecorder:
+    """Samples tracked registry instruments into fixed-size rings.
+
+    ``track(name)`` registers an instrument by name (every label set of
+    that name is followed; pass labels to pin one series).  ``sample(t)``
+    copies current state into the rings under a monotone sample index.
+    ``attach(span_names=...)`` additionally samples on matching span
+    exits via the registry's span-exit hook (the watchdog's mechanism),
+    so long-running spans feed the series without explicit step calls."""
+
+    def __init__(self, capacity: int = 512):
+        if capacity < 2:
+            raise ValueError(f"capacity must be >= 2, got {capacity}")
+        self.capacity = capacity
+        self._tracked: list = []       # [(name, labels_key or None)]
+        self._series: dict = {}        # (name, labels_key) -> ring
+        self._step = 0                 # monotone sample index
+        self._hook = None
+        self._span_names: tuple | None = None
+
+    # -- configuration ---------------------------------------------------
+
+    def track(self, name: str, **labels) -> "TimeSeriesRecorder":
+        """Follow ``name`` (all label sets) or one pinned label set."""
+        key = (name, _labels_key(labels) if labels else None)
+        if key not in self._tracked:
+            self._tracked.append(key)
+        return self
+
+    def _matches(self, name: str, lk: tuple) -> bool:
+        for tname, tlk in self._tracked:
+            if tname == name and (tlk is None or tlk == lk):
+                return True
+        return False
+
+    # -- sampling --------------------------------------------------------
+
+    def sample(self, telemetry) -> int:
+        """Snapshot every tracked instrument; returns the sample index
+        used.  Iteration is sorted, so two runs that created the same
+        instruments in any order sample identically."""
+        step = self._step
+        self._step += 1
+        if telemetry is None:
+            return step
+        for (name, lk), inst in sorted(telemetry._metrics.items()):
+            if not self._matches(name, lk):
+                continue
+            ring = self._series.get((name, lk))
+            if ring is None:
+                if isinstance(inst, Histogram):
+                    ring = HistogramRing(self.capacity)
+                elif isinstance(inst, (Counter, Gauge)):
+                    ring = SeriesRing(inst.kind, self.capacity)
+                else:
+                    continue
+                self._series[(name, lk)] = ring
+            if isinstance(ring, HistogramRing):
+                ring.append(step, inst)
+            else:
+                ring.append(step, inst.value)
+        return step
+
+    def attach(self, span_names=None) -> None:
+        """Sample on span exits (``span_names=None`` means every span)."""
+        if self._hook is not None:
+            return
+        names = tuple(span_names) if span_names is not None else None
+        self._span_names = names
+
+        def hook(t, rec):
+            if names is None or rec.get("name") in names:
+                self.sample(t)
+
+        self._hook = hook
+        add_span_exit_hook(hook)
+
+    def detach(self) -> None:
+        if self._hook is not None:
+            remove_span_exit_hook(self._hook)
+            self._hook = None
+
+    # -- access ----------------------------------------------------------
+
+    def series(self, name: str, **labels):
+        """The ring for one exact ``(name, labels)`` series, or None."""
+        return self._series.get((name, _labels_key(labels)))
+
+    def matching(self, name: str) -> dict:
+        """display-name -> ring for every label set of ``name``."""
+        return {_display(n, lk): ring
+                for (n, lk), ring in sorted(self._series.items())
+                if n == name}
+
+    def keys(self) -> list:
+        return sorted(_display(n, lk) for n, lk in self._series)
+
+    def snapshot(self) -> dict:
+        """JSON-able export: scalar series carry their raw values;
+        histogram series carry the cumulative count plus a trailing-
+        window p99 trajectory (what the report sparklines render)."""
+        out: dict = {}
+        for (name, lk), ring in sorted(self._series.items()):
+            disp = _display(name, lk)
+            if isinstance(ring, HistogramRing):
+                items = list(ring._q)
+                p99 = []
+                for i in range(len(items)):
+                    sub = HistogramRing(self.capacity)
+                    sub.bounds = ring.bounds
+                    sub._q = deque(items[:i + 1], maxlen=self.capacity)
+                    p99.append(round(sub.window_quantile(0.99, 8), 6))
+                out[disp] = {"kind": "histogram", "steps": ring.steps(),
+                             "count": ring.counts_series(), "p99": p99}
+            else:
+                out[disp] = {"kind": ring.kind, "steps": ring.steps(),
+                             "values": [round(v, 6)
+                                        if isinstance(v, float) else v
+                                        for v in ring.values()]}
+        return out

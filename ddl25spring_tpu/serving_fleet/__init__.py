@@ -18,6 +18,11 @@ Three layers (docs/PERFORMANCE.md §8):
 - ``health``  — :class:`FleetHealth`: per-replica circuit breaker
                 (healthy → suspect → open → half-open) fed by the
                 router's step signals (docs/RESILIENCE.md §9).
+- ``autoscale`` — :class:`AutoscalePolicy`: desired-replica-count
+                signal from the queue-wait/drain-rate/SLO-slack series
+                with hysteresis + cooldown, consumed by
+                :meth:`FleetRouter.apply_scaling_hint`
+                (docs/OBSERVABILITY.md §time series).
 
 ``policy``, ``router`` and ``health`` are HOST modules and never import
 jax (so routing logic is unit-testable anywhere); importing this package
@@ -27,11 +32,13 @@ attribute access.
 
 from __future__ import annotations
 
+from .autoscale import AutoscaleConfig, AutoscalePolicy
 from .health import BreakerConfig, FleetHealth
 from .policy import ReplicaSnapshot, rank_replicas, snapshot_replica
 from .router import FleetRouter, NoReplicaAvailable
 
 __all__ = [
+    "AutoscaleConfig", "AutoscalePolicy",
     "BreakerConfig", "DisaggregatedBatcher", "FleetHealth",
     "FleetRouter", "NoReplicaAvailable", "PrefillWorker",
     "ReplicaSnapshot", "TPShardedBatcher", "headsharded_flash_decode",

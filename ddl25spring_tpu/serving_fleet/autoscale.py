@@ -1,0 +1,150 @@
+"""Autoscaling signal for the replica fleet: pure host decision logic.
+
+Turns the per-replica series the router already publishes — queue-wait
+estimate, measured page-drain rate, SLO slack — into ONE number: the
+desired replica count, surfaced as the ``fleet_autoscale_desired_replicas``
+gauge and consumed by :meth:`FleetRouter.apply_scaling_hint` (which can
+*drain* surplus replicas itself via the rolling-restart path, but only
+*report* a deficit — creating replicas needs compiled programs and
+devices this module must not know about).
+
+The decision rule is deliberately boring (ROADMAP item 1 asks for a
+signal, not a controller):
+
+- **pressure**: mean queue-wait across replicas accepting work, divided
+  by ``target_queue_wait_s``.  Above 1.0 the fleet is behind — the raw
+  want is ``ceil(healthy * pressure)`` (proportional: twice the target
+  wait wants twice the healthy capacity).  Negative SLO slack counts as
+  pressure even when waits look fine.
+- **surplus**: pressure under ``scale_down_frac`` shrinks by ONE
+  replica at a time (draining is cheap, re-warming is not).
+- **hysteresis**: the dead band between ``scale_down_frac`` and 1.0
+  holds, a change needs ``sustain`` *consecutive* same-direction
+  observations, and ``cooldown`` observations must pass since the last
+  change — an oscillating load that flips direction every sample resets
+  the streak and never flaps the signal (asserted by the tier-1 test).
+
+Everything is derived from caller-supplied numbers and an internal
+observation counter — no wall clock, no RNG — so the decision log is
+bit-identical across identical seeded runs.  Stdlib-only; listed in
+``analysis/manifest.HOST_ONLY_MODULES``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from .. import obs
+
+__all__ = ["AutoscaleConfig", "AutoscalePolicy"]
+
+
+@dataclass(frozen=True)
+class AutoscaleConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_queue_wait_s: float = 0.5
+    scale_down_frac: float = 0.25
+    sustain: int = 3
+    cooldown: int = 6
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas < min_replicas")
+        if self.target_queue_wait_s <= 0:
+            raise ValueError("target_queue_wait_s must be > 0")
+        if not 0.0 < self.scale_down_frac < 1.0:
+            raise ValueError(
+                f"scale_down_frac must be in (0, 1), got "
+                f"{self.scale_down_frac}")
+        if self.sustain < 1 or self.cooldown < 0:
+            raise ValueError("sustain >= 1 and cooldown >= 0 required")
+
+
+class AutoscalePolicy:
+    """Stateful desired-replica signal with hysteresis + cooldown."""
+
+    def __init__(self, config: AutoscaleConfig, baseline: int):
+        self.config = config
+        self.desired = max(config.min_replicas,
+                           min(config.max_replicas, int(baseline)))
+        self._tick = 0
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_change: int | None = None
+        self.decisions: list = []   # [(tick, desired, reason)]
+
+    # -- core decision ---------------------------------------------------
+
+    def observe(self, queue_waits, *, slo_slack_s=None,
+                healthy: int | None = None) -> int:
+        """One observation: per-replica queue-wait estimates (for the
+        replicas currently accepting work), optionally the worst SLO
+        slack and the accepting-replica count.  Returns (and publishes)
+        the desired replica count."""
+        cfg = self.config
+        tick = self._tick
+        self._tick += 1
+        waits = [float(w) for w in queue_waits]
+        healthy = len(waits) if healthy is None else int(healthy)
+        slack_bad = slo_slack_s is not None and slo_slack_s < 0
+        if not waits:
+            # zero accepting capacity is unconditional pressure
+            raw, reason = self.desired + 1, "no_capacity"
+        else:
+            pressure = (sum(waits) / len(waits)) / cfg.target_queue_wait_s
+            if pressure > 1.0 or slack_bad:
+                raw = max(self.desired + 1 if slack_bad else 0,
+                          math.ceil(max(1, healthy) * max(pressure, 1.0)))
+                reason = "slo_slack" if slack_bad else "queue_wait"
+            elif pressure < cfg.scale_down_frac:
+                raw, reason = self.desired - 1, "surplus"
+            else:
+                raw, reason = self.desired, "hold"
+        raw = max(cfg.min_replicas, min(cfg.max_replicas, raw))
+        if raw > self.desired:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif raw < self.desired:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._down_streak = 0
+        streak = self._up_streak if raw > self.desired else self._down_streak
+        cooled = (self._last_change is None
+                  or tick - self._last_change >= cfg.cooldown)
+        if raw != self.desired and streak >= cfg.sustain and cooled:
+            self.desired = raw
+            self._last_change = tick
+            self._up_streak = self._down_streak = 0
+            self.decisions.append((tick, raw, reason))
+            obs.event("fleet.autoscale", tick=tick, desired=raw,
+                      healthy=healthy, reason=reason)
+        obs.set_gauge("fleet_autoscale_desired_replicas", self.desired)
+        return self.desired
+
+    def observe_fleet(self, router) -> int:
+        """Convenience: pull the inputs straight from a
+        :class:`FleetRouter` — the same queue-wait estimate its
+        ``fleet_replica_queue_wait_s`` gauge publishes, for the replicas
+        its placement logic currently considers eligible."""
+        waits = []
+        for i in router._eligible():
+            r = router.replicas[i]
+            est = getattr(r, "_chunk_s", 0.0)
+            mb = max(1, int(getattr(r, "max_batch", 1)))
+            waits.append(est * (len(r._queue) / mb))
+        return self.observe(waits, healthy=len(waits))
+
+    def describe(self) -> dict:
+        """JSON-able decision log for reports and tests."""
+        return {
+            "desired": self.desired,
+            "observations": self._tick,
+            "decisions": [{"tick": t, "desired": d, "reason": r}
+                          for t, d, r in self.decisions[-64:]],
+        }

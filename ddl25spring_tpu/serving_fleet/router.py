@@ -502,6 +502,7 @@ class FleetRouter:
         if self._orphans:
             finished.update(self._retry_orphans())
         self._publish_gauges()
+        obs.record_samples()
         return finished
 
     def drain(self, *, timeout_s: float | None = None) -> dict:
@@ -575,3 +576,32 @@ class FleetRouter:
         self._draining.discard(i)
         if self.health is not None:
             self.health.reset(i)
+
+    def apply_scaling_hint(self, desired: int, *,
+                           timeout_s: float | None = None) -> dict:
+        """Consume an autoscaling signal (``AutoscalePolicy.observe``'s
+        desired replica count).  Surplus replicas are drained through
+        the rolling-restart path — emptiest first, so the drain is
+        cheap and placement shifts to the survivors; a deficit is only
+        *reported* (``deficit`` > 0 means under-provisioned: creating
+        replicas needs compiled programs the router cannot conjure).
+        Drained replicas stay draining until ``swap_replica``."""
+        desired = max(1, int(desired))
+        active = [i for i in range(len(self.replicas))
+                  if i not in self._dead and i not in self._draining]
+        report = {"desired": desired, "active": len(active),
+                  "drained": [], "deficit": 0, "finished": {}}
+        if desired < len(active):
+            order = sorted(active,
+                           key=lambda i: (self.replicas[i].in_flight, i))
+            for i in order[:len(active) - desired]:
+                report["finished"].update(
+                    self.drain_replica(i, timeout_s=timeout_s))
+                report["drained"].append(i)
+                obs.inc("fleet_autoscale_drained_total", replica=str(i))
+        elif desired > len(active):
+            report["deficit"] = desired - len(active)
+            obs.event("fleet.autoscale_deficit", desired=desired,
+                      active=len(active),
+                      deficit=report["deficit"])
+        return report

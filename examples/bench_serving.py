@@ -274,12 +274,39 @@ def _run_sweep(args, cfg, params, kv_kwargs, loadgen,
         qps_points = [round(peak * f, 4)
                       for f in (0.3, 0.55, 0.8, 1.0, 1.25, 1.6)]
         warmup = False
-    sweep = loadgen.saturation_sweep(
-        make_batcher, qps_points, nr, prompt_fn, budget,
-        dist=args.arrival_dist, seed=args.arrival_seed,
-        warmup=warmup, replay_fn=replay_fn, chaos=chaos)
-    if args.telemetry:
-        obs.flush()
+    # windowed telemetry plane: record series across the sweep so the
+    # knee ships with a burn-rate trajectory, not just a scalar
+    # (docs/OBSERVABILITY.md §time series); batcher/router step hooks
+    # sample into the rings on every decode chunk
+    if not obs.enabled():
+        obs.enable()  # in-process aggregation only (no event stream)
+    rec = obs.TimeSeriesRecorder(capacity=1024)
+    for name in ("serving_queue_depth", "serving_queue_wait_seconds",
+                 "serving_kv_pages_in_use", "serving_requests_total",
+                 "serving_rejected_total", "fleet_replica_queue_wait_s",
+                 "fleet_routed_total"):
+        rec.track(name)
+    monitors = [obs.BurnRateMonitor(rec, obs.SloSpec(
+        name="reject_rate", objective=0.95, kind="ratio",
+        source="serving_rejected_total",
+        total="serving_requests_total"))]
+    if args.slo:
+        monitors.append(obs.BurnRateMonitor(rec, obs.SloSpec(
+            name="queue_wait_p99", objective=0.99, kind="quantile",
+            source="serving_queue_wait_seconds", threshold_s=args.slo)))
+    obs.install_recorder(rec, monitors=monitors)
+    try:
+        sweep = loadgen.saturation_sweep(
+            make_batcher, qps_points, nr, prompt_fn, budget,
+            dist=args.arrival_dist, seed=args.arrival_seed,
+            warmup=warmup, replay_fn=replay_fn, chaos=chaos)
+        if args.telemetry:
+            obs.flush()  # telemetry_summary + the timeseries event
+        burn = {"samples": rec._step,
+                "series_keys": rec.keys(),
+                "monitors": [m.describe() for m in monitors]}
+    finally:
+        obs.uninstall_recorder()
     print(json.dumps({
         "metric": "serving_saturation_sweep",
         "backend": jax.default_backend(),
@@ -291,6 +318,7 @@ def _run_sweep(args, cfg, params, kv_kwargs, loadgen,
                           for pt in sweep["points"]),
             "rerouted": sum(pt.get("rerouted", 0)
                             for pt in sweep["points"])} if fleet else {}),
+        "burn": burn,
         **sweep,
     }), flush=True)
     return 0

@@ -1,0 +1,588 @@
+"""Windowed telemetry plane (obs/timeseries.py, obs/slo.py,
+serving_fleet/autoscale.py): ring-buffer series over the cumulative
+registry, multi-window burn-rate monitors against hand-computed window
+math, the autoscale hysteresis contract (no flapping under an
+oscillating load), and the fleet acceptance scenario — a 3-replica
+fleet on a seeded load trace with one replica degrading then crashing
+mid-run, where the burn alert must fire BEFORE the breaker opens, the
+desired-replica signal must rise while degraded and return to baseline
+after ``swap_replica``, and the whole recorded series must be
+bit-identical across two same-seed runs (nothing in the plane touches a
+wall clock).  Also the tier-1 gates: ``tools/bench_regression.py
+--dry-run`` and ``tools/obs_report.py --since/--last-n``.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ddl25spring_tpu import obs
+from ddl25spring_tpu.serving_fleet import (AutoscaleConfig,
+                                           AutoscalePolicy, BreakerConfig,
+                                           FleetHealth, FleetRouter)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    yield
+    obs.uninstall_recorder()
+    obs.disable()
+
+
+# -- series rings ----------------------------------------------------------
+
+
+def test_series_ring_delta_rate_ewma():
+    r = obs.SeriesRing("counter", capacity=8)
+    for step, v in enumerate((2, 4, 6, 10)):
+        r.append(step, v)
+    assert r.values() == [2, 4, 6, 10]
+    assert r.last() == 10
+    assert r.delta(1) == 4          # 10 - 6
+    assert r.delta(2) == 6          # 10 - 4
+    assert r.delta(99) == 8         # clamped to the whole buffer
+    assert r.rate(2) == pytest.approx(3.0)   # 6 over 2 sample steps
+    # ewma by hand: a=0.5 -> ((2*.5+4*.5)*.5+6*.5)*.5+10*.5 = 7.25
+    assert r.ewma(alpha=0.5) == pytest.approx(7.25)
+    assert r.window(2) == [6, 10]
+
+
+def test_series_ring_capacity_evicts_oldest():
+    r = obs.SeriesRing("gauge", capacity=3)
+    for step in range(10):
+        r.append(step, step * 1.0)
+    assert r.steps() == [7, 8, 9]
+    assert len(r) == 3
+
+
+def test_histogram_ring_windowed_quantile_matches_fresh_histogram():
+    # the bucket-count DIFFERENCE of two cumulative snapshots must give
+    # the same quantile as a fresh histogram fed only the window's
+    # observations (identical bucket math on identical counts);
+    # anything observed before the FIRST snapshot is outside every
+    # window — snapshots are the clock
+    t = obs.enable()
+    h = t.histogram("lat")
+    ring = obs.HistogramRing(capacity=8)
+    ring.append(0, h)                      # baseline, nothing observed
+    for v in (0.01, 0.02, 0.01):           # epoch 1: all under 0.1
+        h.observe(v)
+    ring.append(1, h)
+    second = (0.5, 0.7, 0.9, 0.6, 0.8)
+    for v in second:                       # epoch 2: all over 0.1
+        h.observe(v)
+    ring.append(2, h)
+    ref = obs.Histogram("ref", {})
+    for v in second:
+        ref.observe(v)
+    for q in (0.5, 0.9, 0.99):
+        assert ring.window_quantile(q, window=1) == pytest.approx(
+            ref.quantile(q))
+    assert ring.window_count(1) == 5
+    # all 5 window observations sit in buckets above 0.1
+    assert ring.window_frac_over(0.1, window=1) == pytest.approx(1.0)
+    # since the baseline snapshot: 5 of 8
+    assert ring.window_frac_over(0.1) == pytest.approx(5 / 8)
+    assert ring.window_count() == 8
+
+
+def test_recorder_tracks_and_samples_by_name_and_labels():
+    t = obs.enable()
+    rec = obs.TimeSeriesRecorder(capacity=16)
+    rec.track("reqs")                      # every label set
+    rec.track("wait_s", replica="1")       # pinned label set
+    for i in range(3):
+        obs.inc("reqs", 1, replica="0")
+        obs.inc("reqs", 2, replica="1")
+        obs.set_gauge("wait_s", 0.1 * i, replica="0")
+        obs.set_gauge("wait_s", 0.2 * i, replica="1")
+        rec.sample(t)
+    assert rec.series("reqs", replica="0").values() == [1, 2, 3]
+    assert rec.series("reqs", replica="1").values() == [2, 4, 6]
+    assert rec.series("wait_s", replica="0") is None   # not tracked
+    assert rec.series("wait_s", replica="1").last() == pytest.approx(0.4)
+    assert set(rec.matching("reqs")) == {"reqs{replica=0}",
+                                         "reqs{replica=1}"}
+    snap = rec.snapshot()
+    assert snap["reqs{replica=1}"]["values"] == [2, 4, 6]
+    assert snap["reqs{replica=1}"]["steps"] == [0, 1, 2]
+
+
+def test_recorder_samples_on_span_exit():
+    t = obs.enable()
+    rec = obs.TimeSeriesRecorder(capacity=8)
+    rec.track("work_total")
+    rec.attach(span_names=("job.tick",))
+    try:
+        for _ in range(3):
+            obs.inc("work_total")
+            with obs.span("job.tick"):
+                pass
+            with obs.span("job.other"):    # not sampled
+                pass
+        assert rec.series("work_total").values() == [1, 2, 3]
+    finally:
+        rec.detach()
+
+
+# -- burn-rate math (hand-computed windows) --------------------------------
+
+
+def test_burn_rate_ratio_hand_computed():
+    t = obs.enable()
+    rec = obs.TimeSeriesRecorder(capacity=16)
+    rec.track("bad_total")
+    rec.track("all_total")
+    mon = obs.BurnRateMonitor(
+        rec,
+        obs.SloSpec(name="badness", objective=0.9, kind="ratio",
+                    source="bad_total", total="all_total"),
+        windows=(obs.BurnWindows(fast=1, slow=3, threshold=2.0),))
+    # cumulative (bad, total) per sample; budget = 0.1
+    frames = [(0, 10), (0, 20), (5, 30), (10, 40)]
+    states = []
+    for bad, total in frames:
+        t.counter("bad_total").value = bad
+        t.counter("all_total").value = total
+        rec.sample(t)
+        states.append(mon.evaluate(t)["1/3"])
+    # s0: single sample, no deltas
+    assert states[0]["burn_fast"] == pytest.approx(0.0)
+    assert states[0]["state"] == "ok"
+    # s1: fast = (0/10)/0.1 = 0
+    assert states[1]["burn_fast"] == pytest.approx(0.0)
+    # s2: fast = (5/10)/0.1 = 5; slow clamps to the buffer:
+    #     (5-0)/(30-10)=0.25 -> 2.5; both >= 2 -> burning
+    assert states[2]["burn_fast"] == pytest.approx(5.0)
+    assert states[2]["burn_slow"] == pytest.approx(2.5)
+    assert states[2]["state"] == "burning"
+    # s3: fast = (5/10)/0.1 = 5; slow = (10/30)/0.1 = 10/3
+    assert states[3]["burn_fast"] == pytest.approx(5.0)
+    assert states[3]["burn_slow"] == pytest.approx(10 / 3)
+    assert states[3]["state"] == "burning"
+    # one ok->burning transition = one alert, counted once
+    assert mon.alerts == 1
+    assert mon.first_alert_step == 2
+    snap = t.snapshot()["counter"]
+    assert snap["slo_burn_alerts_total{slo=badness,window=1/3}"][
+        "value"] == 1
+
+
+def test_burn_rate_quantile_hand_computed():
+    t = obs.enable()
+    rec = obs.TimeSeriesRecorder(capacity=16)
+    rec.track("wait_hist")
+    mon = obs.BurnRateMonitor(
+        rec,
+        obs.SloSpec(name="wait_p90", objective=0.9, kind="quantile",
+                    source="wait_hist", threshold_s=0.1),
+        windows=(obs.BurnWindows(fast=1, slow=2, threshold=2.0),))
+    h = t.histogram("wait_hist")
+    rec.sample(t)                          # baseline snapshot
+    for _ in range(10):
+        h.observe(0.01)
+    rec.sample(t)
+    out = mon.evaluate(t)["1/2"]
+    assert out["burn_fast"] == pytest.approx(0.0)
+    for v in (0.01,) * 5 + (0.5,) * 5:
+        h.observe(v)
+    rec.sample(t)
+    out = mon.evaluate(t)["1/2"]
+    # fast window: 5 of 10 observations over 0.1 -> 0.5/0.1 = 5
+    assert out["burn_fast"] == pytest.approx(5.0)
+    # slow window spans both epochs: 5 of 20 -> 0.25/0.1 = 2.5
+    assert out["burn_slow"] == pytest.approx(2.5)
+    assert out["state"] == "burning"
+    assert mon.alerts == 1
+
+
+def test_burn_alert_counts_transitions_not_samples():
+    t = obs.enable()
+    rec = obs.TimeSeriesRecorder(capacity=16)
+    rec.track("bad_total")
+    rec.track("all_total")
+    mon = obs.BurnRateMonitor(
+        rec, obs.SloSpec(name="x", objective=0.9, kind="ratio",
+                         source="bad_total", total="all_total"),
+        windows=(obs.BurnWindows(fast=1, slow=1, threshold=2.0),))
+    # burn, stay burning, recover, burn again -> exactly 2 alerts
+    for bad, total in ((0, 10), (8, 20), (16, 30), (16, 40), (16, 50),
+                       (24, 60)):
+        t.counter("bad_total").value = bad
+        t.counter("all_total").value = total
+        rec.sample(t)
+        mon.evaluate(t)
+    assert mon.alerts == 2
+    assert [h[4] for h in mon.history] == ["burning", "ok", "burning"]
+
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError):
+        obs.SloSpec(name="x", objective=1.5, kind="ratio",
+                    source="a", total="b")
+    with pytest.raises(ValueError):
+        obs.SloSpec(name="x", objective=0.9, kind="nope", source="a")
+    with pytest.raises(ValueError):
+        obs.SloSpec(name="x", objective=0.9, kind="ratio", source="a")
+
+
+# -- autoscale policy ------------------------------------------------------
+
+
+def _policy(**kw):
+    cfg = dict(min_replicas=1, max_replicas=8, target_queue_wait_s=1.0,
+               scale_down_frac=0.25, sustain=3, cooldown=4)
+    cfg.update(kw)
+    return AutoscalePolicy(AutoscaleConfig(**cfg), baseline=3)
+
+
+def test_autoscale_scales_up_under_sustained_pressure():
+    pol = _policy()
+    for _ in range(2):
+        assert pol.observe([2.0, 2.0, 2.0]) == 3   # streak building
+    assert pol.observe([2.0, 2.0, 2.0]) == 6       # ceil(3 * 2.0)
+    assert pol.describe()["decisions"][-1]["reason"] == "queue_wait"
+
+
+def test_autoscale_scales_down_one_step_with_cooldown():
+    pol = _policy(sustain=2, cooldown=3)
+    for _ in range(2):
+        pol.observe([0.1, 0.1, 0.1])               # below 0.25 * target
+    assert pol.desired == 2                        # one step at a time
+    pol.observe([0.1, 0.1])
+    pol.observe([0.1, 0.1])
+    assert pol.desired == 2                        # cooldown holds
+    pol.observe([0.1, 0.1])
+    assert pol.desired == 1
+
+
+def test_autoscale_hysteresis_no_flapping_under_oscillating_load():
+    pol = _policy(sustain=3, cooldown=4)
+    # alternating pressure/surplus never sustains a direction: the
+    # streak resets every sample and the signal must never move
+    series = [2.0 if i % 2 == 0 else 0.05 for i in range(40)]
+    desired = [pol.observe([w, w, w]) for w in series]
+    assert set(desired) == {3}
+    assert pol.describe()["decisions"] == []
+
+
+def test_autoscale_dead_band_holds():
+    pol = _policy()
+    for _ in range(20):
+        pol.observe([0.5, 0.5, 0.5])   # between 0.25 and 1.0 x target
+    assert pol.desired == 3
+
+
+def test_autoscale_slo_slack_counts_as_pressure():
+    pol = _policy(sustain=2, cooldown=0)
+    pol.observe([0.5, 0.5, 0.5], slo_slack_s=-0.1)
+    pol.observe([0.5, 0.5, 0.5], slo_slack_s=-0.1)
+    assert pol.desired == 4
+    assert pol.describe()["decisions"][-1]["reason"] == "slo_slack"
+
+
+def test_autoscale_no_capacity_is_pressure_and_gauge_published():
+    obs.enable()
+    pol = _policy(sustain=1, cooldown=0)
+    pol.observe([])
+    assert pol.desired == 4
+    snap = obs.get().snapshot()["gauge"]
+    assert snap["fleet_autoscale_desired_replicas"]["value"] == 4
+
+
+# -- router scaling hint ---------------------------------------------------
+
+
+class _Rej(Exception):
+    def __init__(self, reason, retry_after_s):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class _SeriesReplica:
+    """Deterministic fake with the exact host surface the gauges and the
+    autoscaler read (``max_batch``/``_queue``/``_chunk_s``/``_drain_pps``)
+    — service pops one request every ``service_every`` steps, so queues
+    build under load without any wall-clock involvement."""
+
+    def __init__(self, cap=64, chunk_s=0.1, service_every=2):
+        self.max_batch = 1
+        self._queue = []
+        self._chunk_s = chunk_s
+        self._drain_pps = 0.0
+        self._cap = cap
+        self._every = service_every
+        self.in_flight = 0
+        self.degraded = False
+        self.crash_next = False
+        self._steps = 0
+
+    def submit(self, rid, prompt, budget, deadline_s=None):
+        if self.degraded or len(self._queue) >= self._cap:
+            raise _Rej("queue_full", 0.05)
+        self._queue.append((rid, list(prompt), budget))
+        self.in_flight += 1
+
+    def step(self):
+        if self.crash_next:
+            raise RuntimeError("injected crash")
+        self._steps += 1
+        if self.degraded:
+            return {}                      # zero progress: a stall
+        done = {}
+        if self._queue and self._steps % self._every == 0:
+            rid, prompt, _b = self._queue.pop(0)
+            done[rid] = prompt
+            self.in_flight -= 1
+        return done
+
+
+def test_apply_scaling_hint_drains_surplus_and_reports_deficit():
+    obs.enable()
+    reps = [_SeriesReplica(service_every=1) for _ in range(3)]
+    router = FleetRouter(reps)
+    for rid in range(4):
+        router.submit(rid, [1 + rid, 2, 3], 2)
+    report = router.apply_scaling_hint(2)
+    assert report["desired"] == 2
+    assert len(report["drained"]) == 1
+    drained = report["drained"][0]
+    assert drained in router._draining
+    assert reps[drained].in_flight == 0
+    # every request routed before the drain still completed
+    out = dict(report["finished"])
+    out.update(router.drain())
+    assert sorted(out) == [0, 1, 2, 3]
+    # scaling above what exists is reported, never invented
+    report = router.apply_scaling_hint(5)
+    assert report["deficit"] == 3          # 2 active, want 5
+    assert report["drained"] == []
+    snap = obs.get().snapshot()["counter"]
+    assert snap[f"fleet_autoscale_drained_total{{replica={drained}}}"][
+        "value"] == 1
+
+
+# -- the fleet acceptance scenario -----------------------------------------
+
+BASELINE = 3
+DEGRADE_TICK, CRASH_TICK, SWAP_TICK = 10, 18, 26
+SPIKE = range(14, 32)
+TICKS = 64
+
+
+def _run_chaos_scenario():
+    """3-replica fleet on a seeded load trace: replica 0 degrades at
+    DEGRADE_TICK (rejects + stalls), crashes at CRASH_TICK, is swapped
+    fresh at SWAP_TICK; arrivals spike during the degradation and stop
+    at tick 32 so the fleet drains.  Returns everything the assertions
+    (and the bit-identity re-run) need."""
+    obs.enable()
+    reps = [_SeriesReplica() for _ in range(3)]
+    health = FleetHealth(3, BreakerConfig(
+        suspect_after=30, open_after=60, half_open_after=1000,
+        latency_factor=1e9))
+    router = FleetRouter(reps, health=health)
+    rec = obs.TimeSeriesRecorder(capacity=256)
+    for name in ("fleet_routed_total", "fleet_rerouted_total",
+                 "fleet_replica_queue_wait_s",
+                 "fleet_autoscale_desired_replicas"):
+        rec.track(name)
+    mon = obs.BurnRateMonitor(
+        rec,
+        obs.SloSpec(name="reroute_rate", objective=0.9, kind="ratio",
+                    source="fleet_rerouted_total",
+                    total="fleet_routed_total"),
+        windows=(obs.BurnWindows(fast=3, slow=6, threshold=2.0),))
+    obs.install_recorder(rec, monitors=(mon,))
+    policy = AutoscalePolicy(AutoscaleConfig(
+        min_replicas=BASELINE, max_replicas=6, target_queue_wait_s=0.35,
+        scale_down_frac=0.5, sustain=2, cooldown=2), baseline=BASELINE)
+    rng = np.random.default_rng(7)
+    open_sample = None
+    desired_series = []
+    rid = 0
+    for tick in range(TICKS):
+        if tick == DEGRADE_TICK:
+            reps[0].degraded = True
+        if tick == CRASH_TICK:
+            reps[0].crash_next = True
+        if tick == SWAP_TICK and 0 in router._dead:
+            router.swap_replica(0, _SeriesReplica())
+        arrivals = 0 if tick >= 32 else (
+            3 if tick in SPIKE else int(rng.integers(1, 3)))
+        for _ in range(arrivals):
+            prompt = [int(x) for x in rng.integers(1, 97, size=4)]
+            try:
+                router.submit(rid, prompt, 2)
+            except Exception:
+                pass
+            rid += 1
+        router.step()
+        if open_sample is None and health.state(0) == "open":
+            open_sample = rec._step - 1
+        desired_series.append(policy.observe_fleet(router))
+    obs.uninstall_recorder()
+    return {
+        "snapshot": rec.snapshot(),
+        "monitor": mon.describe(),
+        "policy": policy.describe(),
+        "desired_series": desired_series,
+        "open_sample": open_sample,
+        "transitions": dict(health.transitions),
+    }
+
+
+def test_fleet_chaos_burn_alert_fires_before_breaker_opens():
+    run = _run_chaos_scenario()
+    mon = run["monitor"]
+    # the crash opened the breaker (and only the crash: strike limits
+    # are out of reach in this scenario)
+    assert run["open_sample"] is not None
+    assert run["transitions"] == {(0, "open"): 1}
+    # the burn-rate monitor saw the degradation trend first
+    assert mon["alerts"] >= 1
+    assert mon["first_alert_step"] is not None
+    assert mon["first_alert_step"] < run["open_sample"]
+
+
+def test_fleet_chaos_desired_replicas_rises_then_returns_to_baseline():
+    run = _run_chaos_scenario()
+    desired = run["desired_series"]
+    # steady before the degradation window
+    assert set(desired[:DEGRADE_TICK]) == {BASELINE}
+    # rises while the fleet runs degraded
+    assert max(desired[DEGRADE_TICK:40]) > BASELINE
+    # and returns to baseline once the swap lands and the queues drain
+    assert desired[-1] == BASELINE
+    # the gauge series recorded the same trajectory (offset one sample:
+    # the policy publishes after the step that samples)
+    gauge = run["snapshot"]["fleet_autoscale_desired_replicas"]["values"]
+    assert max(gauge) == max(desired)
+    assert gauge[-1] == BASELINE
+
+
+def test_fleet_chaos_series_bit_identical_across_seeded_runs():
+    a = _run_chaos_scenario()
+    b = _run_chaos_scenario()
+    assert a["snapshot"] == b["snapshot"]
+    assert a["monitor"] == b["monitor"]
+    assert a["policy"] == b["policy"]
+    assert a["open_sample"] == b["open_sample"]
+
+
+# -- recorder determinism (plain, no fleet) --------------------------------
+
+
+def test_recorder_determinism_two_seeded_runs_identical():
+    def run():
+        t = obs.enable()
+        rec = obs.TimeSeriesRecorder(capacity=64)
+        rec.track("events_total")
+        rec.track("depth")
+        rec.track("lat_hist")
+        rng = np.random.default_rng(11)
+        for _ in range(40):
+            obs.inc("events_total", int(rng.integers(1, 4)))
+            obs.set_gauge("depth", float(rng.integers(0, 9)))
+            obs.observe("lat_hist", float(rng.uniform(0.001, 0.5)))
+            rec.sample(t)
+        return rec.snapshot()
+
+    assert run() == run()
+
+
+# -- tier-1 gates: bench_regression + obs_report windowing -----------------
+
+
+def _run_tool(args, cwd=REPO):
+    return subprocess.run([sys.executable, *args], cwd=cwd,
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_bench_regression_dry_run_gate():
+    # the standing tier-1 gate: the newest real captures must compare
+    # cleanly (device-unreachable captures contribute no cells)
+    proc = _run_tool([str(REPO / "tools" / "bench_regression.py"),
+                      "--dry-run"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "comparing" in proc.stdout or "nothing to compare" \
+        in proc.stdout
+
+
+def _write_capture(root, n, value, krum_ms, gbps):
+    (root / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+        "n": n, "cmd": "bench", "rc": 0, "tail": "",
+        "parsed": {"metric": "m_cpu_trend", "value": value,
+                   "krum_agg": {"shape": [16, 65536], "ms": krum_ms},
+                   "kernels": {"pairwise_dist": {"ms": 5.0,
+                                                 "achieved_gbps": gbps}},
+                   "cohort_scaling": {"world": 1,
+                                      "rounds_per_sec": {"64": 9.0}}},
+    }))
+
+
+def test_bench_regression_flags_regressed_cells(tmp_path):
+    _write_capture(tmp_path, 1, value=10.0, krum_ms=4.0, gbps=12.0)
+    # value -40% (regression), krum ms down (improvement, lower-better),
+    # gbps flat
+    _write_capture(tmp_path, 2, value=6.0, krum_ms=3.0, gbps=12.0)
+    tool = str(REPO / "tools" / "bench_regression.py")
+    proc = _run_tool([tool, "--root", str(tmp_path)])
+    assert proc.returncode == 1
+    assert "REGRESSED" in proc.stdout
+    assert "value" in proc.stdout
+    # the same comparison in dry-run mode reports but passes
+    proc = _run_tool([tool, "--root", str(tmp_path), "--dry-run"])
+    assert proc.returncode == 0
+    # a generous threshold clears it
+    proc = _run_tool([tool, "--root", str(tmp_path),
+                      "--threshold", "0.5"])
+    assert proc.returncode == 0
+
+
+def test_bench_regression_multichip_ok_flip(tmp_path):
+    for n, ok in ((1, True), (2, False)):
+        (tmp_path / f"MULTICHIP_r{n:02d}.json").write_text(json.dumps(
+            {"n_devices": 8, "rc": 0 if ok else 1, "ok": ok,
+             "skipped": False, "tail": ""}))
+    proc = _run_tool([str(REPO / "tools" / "bench_regression.py"),
+                      "--root", str(tmp_path), "--json"])
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout)
+    assert out["regressions"] == 1
+    assert out["cells"][0]["cell"] == "multichip.ok"
+
+
+def test_obs_report_since_and_last_n_window(tmp_path):
+    jsonl = tmp_path / "t.jsonl"
+    t = obs.enable(jsonl)
+    rec = obs.TimeSeriesRecorder(capacity=16)
+    rec.track("fleet_routed_total")
+    obs.install_recorder(rec)
+    for i in range(6):
+        obs.inc("fleet_routed_total", replica="0")
+        rec.sample(t)
+        obs.event("marker", i=i)
+    obs.flush()
+    obs.uninstall_recorder()
+    obs.disable()
+    tool = str(REPO / "tools" / "obs_report.py")
+    full = _run_tool([tool, str(jsonl)])
+    assert full.returncode == 0, full.stderr
+    # the time-series section renders the recorded sparkline
+    assert "time series" in full.stdout
+    assert "fleet_routed_total{replica=0}" in full.stdout
+    windowed = _run_tool([tool, str(jsonl), "--last-n", "2"])
+    assert windowed.returncode == 0, windowed.stderr
+    assert "window: 2 of" in windowed.stdout
+    # --since with a huge trailing window keeps everything
+    since_all = _run_tool([tool, str(jsonl), "--since", "3600"])
+    assert since_all.returncode == 0
+    assert "window:" not in since_all.stdout

@@ -1,0 +1,221 @@
+#!/usr/bin/env python3
+"""Automatic regression gate over the bench capture protocol.
+
+Compares the newest ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` capture
+pair against the previous one (the r06+ measurement protocol of ROADMAP
+item 5) and exits non-zero when any *comparable* cell regresses by more
+than the threshold (10% by default).
+
+Comparable means both captures carry the cell with a finite, non-zero
+previous value.  Device-unreachable captures (``value: 0.0`` with an
+``error`` field) contribute nothing except their ``cpu_fallback`` trend
+cells, so a dead tunnel is never reported as a code regression — that is
+the whole point of the CPU-trend cells riding along in BENCH files.
+
+Cells and their direction:
+
+- ``value`` (rounds/sec) and ``final_test_accuracy_pct`` — higher better;
+- ``kernels.*.achieved_gbps`` higher / ``kernels.*.ms`` lower better;
+- ``krum_agg.ms`` — lower better;
+- ``cohort_scaling.rounds_per_sec.*`` — higher better;
+- ``serving_saturation`` / ``fleet_routing`` ``probe_goodput_rps`` and
+  ``knee_qps`` — higher better;
+- ``fleet_chaos.goodput_retention`` — higher better;
+- MULTICHIP ``ok`` flipping true→false, or ``n_devices`` shrinking.
+
+Zero deps beyond the stdlib (the tier-1 suite runs ``--dry-run`` as a
+gate-of-the-gate).  Exit codes: 0 clean / nothing to compare, 1 at least
+one regression (suppressed by ``--dry-run``), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+import sys
+from pathlib import Path
+
+_NUM = re.compile(r"_r?(\d+)\.json$")
+
+# (dotted path into the parsed dict, higher_is_better); kernels and
+# cohort_scaling fan out over their dynamic keys below
+_SCALAR_CELLS = (
+    ("value", True),
+    ("final_test_accuracy_pct", True),
+    ("krum_agg.ms", False),
+    ("serving_saturation.probe_goodput_rps", True),
+    ("serving_saturation.knee_qps", True),
+    ("fleet_routing.probe_goodput_rps", True),
+    ("fleet_routing.knee_qps", True),
+    ("fleet_chaos.goodput_retention", True),
+)
+
+
+def _capture_index(path: Path) -> int:
+    m = _NUM.search(path.name)
+    return int(m.group(1)) if m else -1
+
+
+def find_captures(root: Path, prefix: str) -> list[Path]:
+    return sorted(root.glob(f"{prefix}_*.json"), key=_capture_index)
+
+
+def _dig(d: dict, dotted: str):
+    cur = d
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _cells_from(parsed: dict, prefix: str = "") -> dict:
+    """``name -> (value, higher_better)`` for every comparable cell in
+    one parsed bench dict (recursing once into ``cpu_fallback``)."""
+    out: dict = {}
+    if not isinstance(parsed, dict):
+        return out
+    dead = "error" in parsed and not parsed.get("value")
+    for dotted, higher in _SCALAR_CELLS:
+        if dead and dotted in ("value", "final_test_accuracy_pct"):
+            continue  # device unreachable: the headline never ran
+        v = _dig(parsed, dotted)
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            out[prefix + dotted] = (float(v), higher)
+    kernels = parsed.get("kernels")
+    if isinstance(kernels, dict):
+        for kname, cell in sorted(kernels.items()):
+            if not isinstance(cell, dict):
+                continue
+            for field, higher in (("achieved_gbps", True), ("ms", False)):
+                v = cell.get(field)
+                if isinstance(v, (int, float)) and math.isfinite(v):
+                    out[f"{prefix}kernels.{kname}.{field}"] = (
+                        float(v), higher)
+    cohort = _dig(parsed, "cohort_scaling.rounds_per_sec")
+    if isinstance(cohort, dict):
+        for size, v in sorted(cohort.items()):
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[f"{prefix}cohort_scaling.rounds_per_sec.{size}"] = (
+                    float(v), True)
+    fb = parsed.get("cpu_fallback")
+    if isinstance(fb, dict) and not prefix:
+        out.update(_cells_from(fb, prefix="cpu_fallback."))
+    return out
+
+
+def compare_bench(prev: dict, new: dict, threshold: float) -> list[dict]:
+    """Per-cell comparison rows; a row regresses when the change in the
+    *bad* direction exceeds ``threshold`` (relative to previous)."""
+    pcells = _cells_from(prev.get("parsed") or {})
+    ncells = _cells_from(new.get("parsed") or {})
+    rows = []
+    for name in sorted(pcells):
+        if name not in ncells:
+            continue
+        pv, higher = pcells[name]
+        nv, _ = ncells[name]
+        if pv == 0:
+            continue  # no meaningful relative change
+        change = (nv - pv) / abs(pv)
+        bad = -change if higher else change
+        rows.append({"cell": name, "prev": pv, "new": nv,
+                     "change_pct": round(change * 100, 2),
+                     "regressed": bad > threshold})
+    return rows
+
+
+def compare_multichip(prev: dict, new: dict) -> list[dict]:
+    rows = []
+    if prev.get("skipped") or new.get("skipped"):
+        return rows
+    if prev.get("ok") and not new.get("ok"):
+        rows.append({"cell": "multichip.ok", "prev": True, "new": False,
+                     "regressed": True})
+    pd, nd = prev.get("n_devices"), new.get("n_devices")
+    if isinstance(pd, int) and isinstance(nd, int) and nd < pd:
+        rows.append({"cell": "multichip.n_devices", "prev": pd, "new": nd,
+                     "regressed": True})
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate the newest bench capture against the previous "
+                    "one (>threshold regression in a comparable cell "
+                    "fails)")
+    ap.add_argument("--root", type=Path,
+                    default=Path(__file__).resolve().parent.parent,
+                    help="directory holding BENCH_*.json / "
+                         "MULTICHIP_*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression that fails the gate "
+                         "(default 0.10 = 10%%)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="report, but always exit 0 (the tier-1 smoke "
+                         "mode)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as one JSON object")
+    args = ap.parse_args()
+    if args.threshold <= 0:
+        print("--threshold must be > 0", file=sys.stderr)
+        return 2
+    if not args.root.is_dir():
+        print(f"no such directory: {args.root}", file=sys.stderr)
+        return 2
+
+    rows: list[dict] = []
+    compared: list[str] = []
+    for prefix, cmp_fn in (("BENCH", compare_bench),
+                           ("MULTICHIP", compare_multichip)):
+        caps = find_captures(args.root, prefix)
+        if len(caps) < 2:
+            continue
+        prev_p, new_p = caps[-2], caps[-1]
+        try:
+            prev = json.loads(prev_p.read_text())
+            new = json.loads(new_p.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"unreadable capture under {prefix}: {e}",
+                  file=sys.stderr)
+            return 2
+        compared.append(f"{prev_p.name} -> {new_p.name}")
+        if cmp_fn is compare_bench:
+            rows.extend(cmp_fn(prev, new, args.threshold))
+        else:
+            rows.extend(cmp_fn(prev, new))
+
+    regressions = [r for r in rows if r["regressed"]]
+    if args.json:
+        print(json.dumps({"compared": compared, "threshold": args.threshold,
+                          "cells": rows,
+                          "regressions": len(regressions)}, indent=2))
+    else:
+        if not compared:
+            print("bench_regression: fewer than two captures — nothing "
+                  "to compare")
+        for line in compared:
+            print(f"comparing {line}")
+        if compared and not rows:
+            print("no comparable cells (device-unreachable captures "
+                  "carry no trend cells)")
+        for r in rows:
+            flag = "REGRESSED" if r["regressed"] else "ok"
+            if "change_pct" in r:
+                print(f"  {r['cell']:<48} {r['prev']:>10g} -> "
+                      f"{r['new']:>10g}  {r['change_pct']:>+7.2f}%  {flag}")
+            else:
+                print(f"  {r['cell']:<48} {r['prev']} -> {r['new']}  "
+                      f"{flag}")
+        if regressions:
+            print(f"{len(regressions)} cell(s) regressed beyond "
+                  f"{args.threshold * 100:.0f}%")
+    if args.dry_run:
+        return 0
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,6 +73,22 @@ def load_merged(paths) -> list[dict]:
     return events
 
 
+def window_events(events: list[dict], *, since=None,
+                  last_n=None) -> list[dict]:
+    """Trailing-window view of a merged event list.  ``since`` > 1e9 is
+    an absolute epoch cutoff; smaller values mean "the last N seconds
+    before the newest event".  ``last_n`` keeps the newest N events and
+    composes with ``since`` (applied second)."""
+    out = events
+    if since is not None and out:
+        newest = max(e.get("ts", 0.0) for e in out)
+        cutoff = since if since > 1e9 else newest - since
+        out = [e for e in out if e.get("ts", 0.0) >= cutoff]
+    if last_n is not None and last_n >= 0:
+        out = out[max(0, len(out) - last_n):]
+    return out
+
+
 def parse_key(disp: str) -> tuple[str, dict]:
     """Split a snapshot display key ``name{k=v,...}`` into (name, labels)."""
     m = _KEY.match(disp)
@@ -91,6 +107,29 @@ def fmt_seconds(s: float) -> str:
     if s < 1.0:
         return f"{s * 1e3:.2f}ms"
     return f"{s:.3f}s"
+
+
+_SPARK = " .:-=+*#@"
+
+
+def sparkline(values, width: int = 48) -> str:
+    """ASCII sparkline of a numeric series (min..max mapped onto a
+    9-level ramp; the series is resampled to ``width`` by taking the max
+    of each chunk so short spikes stay visible)."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        chunk = len(vals) / width
+        vals = [max(vals[int(i * chunk):max(int(i * chunk) + 1,
+                                            int((i + 1) * chunk))])
+                for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[1] * len(vals)
+    return "".join(
+        _SPARK[1 + int((v - lo) / span * (len(_SPARK) - 2))] for v in vals)
 
 
 def fmt_bytes(n: float) -> str:
@@ -408,6 +447,11 @@ def report(events: list[dict], top: int) -> None:
                   f"mean={fmt_seconds(h['sum'] / max(h['count'], 1))} "
                   f"p90={fmt_seconds(hist_quantile(h, 0.90))} "
                   f"max={fmt_seconds(h['max'] or 0)}")
+        q_depth = take(gauges, "serving_queue_depth")
+        if q_depth:
+            st = q_depth[0][1]
+            print(f"  queue depth: last {st['value']:g}  peak "
+                  f"{st.get('max', st['value']):g}")
         # -- SLO block: latency percentiles against the admission
         #    deadline, prefix-cache work skipped, pool residency, and
         #    why admissions were turned away
@@ -535,6 +579,78 @@ def report(events: list[dict], top: int) -> None:
         if replayed is not None:
             print(f"  tokens replayed into continuation prefills: "
                   f"{replayed}")
+
+    # -- time series + SLO burn rate + autoscale -------------------------
+    # rendered from the last ``timeseries`` event (obs.flush with a
+    # recorder installed) plus the streamed transition/decision events
+    ts_events = [e for e in events if e.get("event") == "timeseries"]
+    burn_events = [e for e in events if e.get("event") == "slo.burn"]
+    scale_events = [e for e in events
+                    if e.get("event") in ("fleet.autoscale",
+                                          "fleet.autoscale_deficit")]
+    burn_alerts = take(counters, "slo_burn_alerts_total")
+    desired_g = _value(gauges, "fleet_autoscale_desired_replicas")
+    take(gauges, "fleet_autoscale_desired_replicas")
+    scale_drained = take(counters, "fleet_autoscale_drained_total")
+    if ts_events or burn_events or scale_events or burn_alerts \
+            or desired_g is not None:
+        section("time series (windowed telemetry plane)")
+        if ts_events:
+            series = ts_events[-1].get("series", {})
+            for disp in sorted(series):
+                s = series[disp]
+                if s.get("kind") == "histogram":
+                    vals = s.get("p99", [])
+                    suffix = "p99(w8)"
+                else:
+                    vals = s.get("values", [])
+                    suffix = s.get("kind", "")
+                if not vals:
+                    continue
+                print(f"  {disp:<42} {sparkline(vals)}")
+                print(f"  {'':<42} {suffix} n={len(vals)} "
+                      f"last={vals[-1]:g} min={min(vals):g} "
+                      f"max={max(vals):g}")
+            for mon in ts_events[-1].get("monitors", []):
+                state = "   ".join(f"{w}:{st}"
+                                   for w, st in sorted(
+                                       mon.get("state", {}).items()))
+                print(f"  slo {mon.get('slo', '?')}: "
+                      f"objective={mon.get('objective')}   "
+                      f"alerts={mon.get('alerts', 0)}   {state}")
+        if burn_alerts:
+            total = int(sum(st["value"] for _, st in burn_alerts))
+            parts = "   ".join(
+                f"{lb.get('slo', '?')}[{lb.get('window', '?')}]"
+                f"={st['value']}"
+                for lb, st in sorted(
+                    burn_alerts,
+                    key=lambda ls: (ls[0].get("slo", ""),
+                                    ls[0].get("window", ""))))
+            print(f"  burn alerts: {total}   {parts}")
+        for e in burn_events[-8:]:
+            print(f"  burn {e.get('state', '?'):>7} step "
+                  f"{e.get('step', '?')}: {e.get('slo', '?')} "
+                  f"[{e.get('window', '?')}] fast={e.get('burn_fast')} "
+                  f"slow={e.get('burn_slow')}")
+        if desired_g is not None or scale_events or scale_drained:
+            if desired_g is not None:
+                line = f"  autoscale: desired replicas last={desired_g:g}"
+                if scale_drained:
+                    drained = int(sum(st["value"]
+                                      for _, st in scale_drained))
+                    line += f"   drained={drained}"
+                print(line)
+            for e in scale_events[-8:]:
+                if e.get("event") == "fleet.autoscale":
+                    print(f"  scale tick {e.get('tick', '?')}: desired "
+                          f"-> {e.get('desired', '?')} "
+                          f"(healthy={e.get('healthy', '?')}, "
+                          f"{e.get('reason', '?')})")
+                else:
+                    print(f"  scale deficit: want {e.get('desired', '?')} "
+                          f"have {e.get('active', '?')} "
+                          f"(under-provisioned by {e.get('deficit', '?')})")
 
     # -- speculative decoding --------------------------------------------
     proposed = _value(counters, "spec_proposed_total")
@@ -863,12 +979,30 @@ def main() -> int:
     ap.add_argument("--prom", action="store_true",
                     help="print the last telemetry_summary as Prometheus "
                          "text exposition instead of the report")
+    ap.add_argument("--since", type=float, default=None,
+                    help="window the merged events: an absolute epoch "
+                         "timestamp (> 1e9) keeps events at/after it; a "
+                         "smaller value keeps the trailing N seconds "
+                         "before the newest event")
+    ap.add_argument("--last-n", type=int, default=None,
+                    help="keep only the newest N events after merging "
+                         "(applied after --since)")
     args = ap.parse_args()
     for p in args.jsonl:
         if not p.exists():
             print(f"no such file: {p}", file=sys.stderr)
             return 1
     events = load_merged(args.jsonl)
+    total = len(events)
+    events = window_events(events, since=args.since, last_n=args.last_n)
+    if len(events) != total:
+        print(f"(window: {len(events)} of {total} events"
+              + (f", --since {args.since:g}" if args.since is not None
+                 else "")
+              + (f", --last-n {args.last_n}" if args.last_n is not None
+                 else "")
+              + "; instrument snapshots are cumulative at their flush "
+                "point, not per-window)")
     if args.prom:
         summaries = [e for e in events
                      if e.get("event") == "telemetry_summary"]
