@@ -620,8 +620,12 @@ def run_cpu_trend(nr_rounds: int = 2):
     krum_ms = (time.perf_counter() - t0) * 1e3
     _stamp("cpu trend: cohort scaling cell ...")
     cohort_scaling = _cohort_scaling_cell()
+    _stamp("cpu trend: overlapped combine cell ...")
+    overlap_combine = _overlap_combine_cell()
     _stamp("cpu trend: serving saturation cell ...")
     serving_saturation = _serving_saturation_cell()
+    _stamp("cpu trend: fused decode step cell ...")
+    fused_decode_step = _fused_decode_step_cell()
     _stamp("cpu trend: fleet routing cell ...")
     fleet_routing = _fleet_routing_cell()
     _stamp("cpu trend: fleet chaos cell ...")
@@ -636,7 +640,9 @@ def run_cpu_trend(nr_rounds: int = 2):
         "kernels": kernels,
         "krum_agg": {"shape": [16, 1 << 16], "ms": round(krum_ms, 3)},
         "cohort_scaling": cohort_scaling,
+        "overlap_combine": overlap_combine,
         "serving_saturation": serving_saturation,
+        "fused_decode_step": fused_decode_step,
         "fleet_routing": fleet_routing,
         "fleet_chaos": fleet_chaos,
         "wall_s": round(time.perf_counter() - t_start, 1),
@@ -688,6 +694,104 @@ def _cohort_scaling_cell(cohorts=(64, 256, 1024), rounds_timed: int = 3):
         dt = time.perf_counter() - t0
         out["rounds_per_sec"][str(cohort)] = round(rounds_timed / dt, 4)
     return out
+
+
+def _overlap_combine_cell(cohort: int = 256, rounds_timed: int = 3):
+    """Rounds/sec of the OVERLAPPED sharded round (``overlap_combine=True``
+    with ``client_chunk``: a ring partial combine per client chunk instead
+    of one end-of-round psum — fl/sharding.ring_all_reduce) on the
+    cohort-scaling cell's tiny logistic model.  World 1 on CPU makes the
+    ring a neighbour-exchange identity, but the number still moves when
+    the chunked schedule or the ring combine regresses — comparable only
+    to itself like the other cpu_trend cells."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.fl.engine import (
+        make_fl_round,
+        make_local_sgd_update,
+    )
+    from ddl25spring_tpu.parallel import make_mesh
+
+    per, d, k, bs, chunk = 32, 32, 10, 32, 32
+
+    def loss_fn(params, xb, yb, mask, key):
+        logits = xb @ params["w"] + params["b"]
+        ls = -jax.nn.log_softmax(logits)[jnp.arange(yb.shape[0]), yb]
+        return jnp.sum(ls * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+    update = make_local_sgd_update(loss_fn, 0.05, bs, 1)
+    mesh = make_mesh({"clients": 1}, devices=jax.devices()[:1])
+    params = {"w": jnp.zeros((d, k), jnp.float32),
+              "b": jnp.zeros((k,), jnp.float32)}
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (cohort, per, d), jnp.float32)
+    y = jax.random.randint(key, (cohort, per), 0, k, jnp.int32)
+    counts = jnp.full((cohort,), per, jnp.int32)
+    rf = make_fl_round(update, x, y, counts, cohort, mesh=mesh,
+                       client_chunk=chunk, overlap_combine=True,
+                       device_put_data=False)
+    assert rf.overlap
+    p = rf(params, key, 0)
+    jax.block_until_ready(jax.tree.leaves(p)[0])  # compile + warm
+    t0 = time.perf_counter()
+    for r in range(1, rounds_timed + 1):
+        p = rf(p, key, r)
+    jax.block_until_ready(jax.tree.leaves(p)[0])
+    dt = time.perf_counter() - t0
+    return {"world": 1, "cohort": cohort, "client_chunk": chunk,
+            "rounds_per_sec": round(rounds_timed / dt, 4)}
+
+
+def _fused_decode_step_cell(nr_requests: int = 4, budget: int = 5):
+    """Decode steps/sec of the PAGED streaming batcher under
+    ``decode_impl='fused'`` — the one-Pallas-program inner step
+    (ops/fused_decode_step.py; interpret mode on CPU, so the absolute
+    number is far below any TPU figure).  Steps are counted from the
+    ``serving_fused_decode_steps_total`` counter so the denominator is
+    the actual scan-step count, not a tokens/batch estimate.  The trend
+    that moves when the fused step, the deferred-append forward, or the
+    flash-decode cur-row substitution regresses — comparable only to
+    itself like the other cpu_trend cells."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu import obs
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+
+    cfg = LlamaConfig(vocab_size=128, dmodel=48, nr_heads=4,
+                      nr_kv_heads=2, nr_layers=2, ctx_size=48,
+                      dtype=jnp.float32, decode_impl="fused")
+    params = Llama(cfg).init(jax.random.PRNGKey(0),
+                             jnp.ones((1, 4), jnp.int32))
+
+    def make_batcher():
+        return ContinuousBatcher(cfg, params, max_batch=2,
+                                 prefill_width=8, kv_layout="paged",
+                                 kv_page=8)
+
+    prng = np.random.default_rng(0)
+    prompts = [prng.integers(1, 128,
+                             size=int(prng.integers(3, 8))).tolist()
+               for _ in range(nr_requests)]
+    budgets = [budget] * nr_requests
+    make_batcher().run(prompts, budgets)  # compile + warm
+    t = obs.get()
+    owned = t is None
+    if owned:
+        t = obs.enable()
+    base = t.counter("serving_fused_decode_steps_total").value
+    t0 = time.perf_counter()
+    make_batcher().run(prompts, budgets)
+    dt = time.perf_counter() - t0
+    steps = t.counter("serving_fused_decode_steps_total").value - base
+    if owned:
+        obs.disable()
+    return {"nr_requests": nr_requests, "budget": budget,
+            "decode_steps": int(steps),
+            "steps_per_sec": round(steps / dt, 4)}
 
 
 def _serving_saturation_cell(qps_factors=(0.5, 1.0, 2.0),
@@ -937,11 +1041,35 @@ def _persist_partial_capture(reason: str, args, **extra) -> str | None:
         return None
 
 
+def _queue_pending_capture(reason: str) -> str | None:
+    """Append this invocation's argv to ``results/pending_captures.jsonl``
+    — the device-unreachable run's re-capture ticket.  The sentinel
+    (tools/measure_when_up.sh) drains the queue once the tunnel is back
+    up and phase 1 has landed, so a capture requested against a dead
+    tunnel is re-run under the original flags instead of lost."""
+    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "pending_captures.jsonl")
+        with open(path, "a") as fh:
+            fh.write(json.dumps({
+                "argv": sys.argv[1:],
+                "reason": reason,
+                "elapsed_s": round(time.perf_counter() - _T0, 1),
+            }) + "\n")
+        return path
+    except OSError:
+        return None
+
+
 def _fail_with_cpu_fallback(reason: str, args):
-    """Shared device-unreachable exit: persist the partial capture, land
-    the CPU-fallback trend, emit the one JSON line, exit nonzero."""
+    """Shared device-unreachable exit: persist the partial capture, queue
+    the re-capture ticket, land the CPU-fallback trend, emit the one
+    JSON line, exit nonzero."""
     obs.flush()
     capture = _persist_partial_capture(reason, args)
+    queued = _queue_pending_capture(reason)
     trend: dict = {"error": "cpu fallback disabled"}
     if args.cpu_fallback_timeout_s > 0:
         _stamp("device unreachable -> measuring CPU-fallback trend ...")
@@ -954,7 +1082,7 @@ def _fail_with_cpu_fallback(reason: str, args):
             k: v for k, v in trend.items() if k in ("value", "error")})
         obs.flush()
     _emit_json(0.0, error=reason, partial_capture=capture,
-               cpu_fallback=trend)
+               pending_capture=queued, cpu_fallback=trend)
     # nonzero so scripts/CI keyed on exit status see the failure; daemon
     # probe threads may be wedged in the backend, so skip shutdown
     os._exit(1)

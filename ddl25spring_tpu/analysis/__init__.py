@@ -8,7 +8,9 @@ imported, and this package itself never imports jax):
 - **trace-hygiene** (``TRC*``) — functions reachable from
   jit/pallas_call/shard_map must not branch on tracers, concretize
   (``.item()``/``float()``), call ``np.*`` on traced values, ``print``,
-  or read clocks/RNGs at trace time;
+  or read clocks/RNGs at trace time; ``lax.ppermute`` inside a
+  ``shard_map`` body must name an axis the call site's literal specs
+  mention (``TRC008``);
 - **determinism** (``DET*``) — no unseeded global RNG state, no
   wall-clock-derived seeds or identifiers;
 - **donation-safety** (``DON*``) — no reads of a donated buffer after

@@ -17,7 +17,16 @@ the function's array parameters and from ``jax.*`` calls, then flags:
   ``jax.debug.print``);
 - ``TRC006/TRC007`` — ``time.*`` / ``random.*``/``np.random`` in traced
   code (evaluated once at trace time, then baked in — the retrace
-  lottery).
+  lottery);
+- ``TRC008`` — ``lax.ppermute`` inside a ``shard_map`` body naming an
+  axis the call site's specs never mention (a typo'd axis name fails
+  at run time with an opaque unbound-axis error — or silently permutes
+  over the wrong mesh dimension when the name happens to exist).  Only
+  checked when the ``shard_map`` call spells its axis names as string
+  literals inside ``P(...)``/``PartitionSpec(...)`` specs AND the
+  ``ppermute`` names its axis as a string literal; specs or axis names
+  built from variables (the repo's own ring primitives thread ``axis``
+  through as a parameter) make the check abstain rather than guess.
 
 Heuristics, stated plainly:
 
@@ -524,6 +533,78 @@ def _callsite_statics(call: ast.Call, callee: ast.FunctionDef,
     return statics
 
 
+def _spec_literal_axes(exprs) -> set[str] | None:
+    """Union of literal axis names spelled inside ``P(...)`` /
+    ``PartitionSpec(...)`` calls across the given spec expressions.
+
+    Returns ``None`` (unknown — abstain) when any spec routes an axis
+    through a variable/call, or when no spec literal names an axis at
+    all: an empty literal set proves nothing about the mesh, only a
+    non-empty one gives names to check ``ppermute`` against."""
+    axes: set[str] = set()
+    for expr in exprs:
+        if expr is None:
+            continue
+        for n in ast.walk(expr):
+            if not (isinstance(n, ast.Call)
+                    and terminal_name(n.func) in ("P", "PartitionSpec")):
+                continue
+            for a in list(n.args) + [k.value for k in n.keywords
+                                     if k.arg != "unreduced"]:
+                for c in ast.walk(a):
+                    if isinstance(c, ast.Constant):
+                        if isinstance(c.value, str):
+                            axes.add(c.value)
+                    elif not isinstance(c, (ast.Tuple, ast.List)):
+                        return None  # computed axis name -> abstain
+    return axes or None
+
+
+def _ppermute_axis_arg(call: ast.Call):
+    """The axis_name operand of a ``ppermute`` call (positional slot 1
+    or keyword), or None when absent."""
+    if len(call.args) > 1 and not isinstance(call.args[1], ast.Starred):
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    return None
+
+
+def _check_ppermute_axes(body_ctx, body: ast.AST, axes: set[str],
+                         scope_name: str, findings: list[Finding]):
+    """TRC008: flag ``ppermute`` calls inside a shard_map body whose
+    literal axis_name is not among the call site's literal spec axes."""
+    for n in ast.walk(body):
+        if not (isinstance(n, ast.Call)
+                and terminal_name(n.func) == "ppermute"):
+            continue
+        arg = _ppermute_axis_arg(n)
+        if arg is None:
+            findings.append(Finding(
+                pass_id=PASS_ID, rule="TRC008", path=body_ctx.mi.rel,
+                line=getattr(n, "lineno", 0),
+                scope=f"{body_ctx.mi.name}:{scope_name}",
+                message="ppermute without an axis_name inside a "
+                        "shard_map body (the collective cannot bind to "
+                        "a mesh axis)",
+                detail="ppermute"))
+            continue
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue  # variable axis name: abstain
+        if arg.value not in axes:
+            named = ", ".join(sorted(axes))
+            findings.append(Finding(
+                pass_id=PASS_ID, rule="TRC008", path=body_ctx.mi.rel,
+                line=getattr(n, "lineno", 0),
+                scope=f"{body_ctx.mi.name}:{scope_name}",
+                message=f"ppermute over axis '{arg.value}' but the "
+                        f"enclosing shard_map's specs only name "
+                        f"{{{named}}} (unbound or wrong mesh axis)",
+                detail=arg.value))
+
+
 def run(idx: ProjectIndex) -> list[Finding]:
     findings: list[Finding] = []
     ctxs = {mi.name: ModCtx(mi, idx) for mi in idx.files if mi.name}
@@ -581,6 +662,28 @@ def run(idx: ProjectIndex) -> list[Finding]:
                     resolve_and_enqueue(ctx, scope, first, statics)
                 elif isinstance(first, ast.Lambda):
                     pass  # lambdas get checked via their parent function
+                if t == "shard_map":
+                    kwargs = {k.arg: k.value for k in node.keywords}
+                    specs = [kwargs.get("in_specs"),
+                             kwargs.get("out_specs")]
+                    specs += node.args[2:4]  # positional spec slots
+                    axes = _spec_literal_axes(specs)
+                    if axes is None:
+                        continue
+                    if isinstance(first, ast.Lambda):
+                        body_hit = (ctx, first)
+                    else:
+                        body_hit = resolved_def(ctx, scope, first)
+                    if body_hit is None:
+                        continue
+                    bctx, body = body_hit
+                    sname = bctx.qualname.get(
+                        id(body), getattr(body, "name", None))
+                    if sname is None:
+                        sname = (ctx.qualname.get(id(scope), scope.name)
+                                 if scope is not None else "<module>")
+                    _check_ppermute_axes(bctx, body, axes, sname,
+                                         findings)
 
     # walk the call graph: any referenced in-project function is traced
     out_findings: list[Finding] = []

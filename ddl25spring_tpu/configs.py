@@ -122,6 +122,19 @@ class HflConfig:
     # "0" = off (single-device round, the exact pre-mesh program),
     # "N" = explicitly N devices (fails loudly if unavailable)
     mesh_clients: str = "auto"
+    overlap_combine: bool = False  # sharded rounds: replace the end-of-
+    #                            round psum with per-chunk ppermute ring
+    #                            combines interleaved into the client_chunk
+    #                            scan (fl/sharding.ring_all_reduce) — the
+    #                            neighbour exchanges overlap the next
+    #                            chunk's compute; off/W=1 bit-identical,
+    #                            docs/PERFORMANCE.md §9
+    prefetch_depth: int = 0    # > 0: double-buffered host→device cohort
+    #                            feeding (data/prefetch.py) — round r+1's
+    #                            gather + device_put overlaps round r's
+    #                            compute behind this many buffers; 0 = the
+    #                            synchronous resident-data path (identical
+    #                            draws + params either way)
     zero_server: bool = False  # fedopt only: shard the server optimizer
     #                            state 1/W per replica of the clients mesh
     #                            (parallel/zero.py ZeRO-1 server update);
@@ -201,6 +214,11 @@ class HflConfig:
             raise ValueError(
                 f"val_gate_tolerance must be >= 0, got "
                 f"{self.val_gate_tolerance}"
+            )
+        if self.prefetch_depth < 0:
+            raise ValueError(
+                f"prefetch_depth must be >= 0 (0 = synchronous feeding), "
+                f"got {self.prefetch_depth}"
             )
         if self.mesh_clients != "auto":
             try:

@@ -30,14 +30,28 @@ class PrefetchStream:
             except BaseException as e:  # noqa: BLE001 — relayed to consumer
                 self._error = e
                 item = ("error", e)
+            if item[0] == "error":
+                # a producer that raises AFTER the queue filled must not
+                # spin forever trying to enqueue the error sentinel (the
+                # old deadlock class: consumer waiting while an immortal
+                # producer blocks on a full queue).  Bounded attempts —
+                # the error stays sticky in self._error either way, and
+                # next_batch() raises it once the queue drains.
+                for _ in range(20):
+                    if self._stop.is_set():
+                        break
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                return  # producer ends; consumers re-raise via _error
             while not self._stop.is_set():
                 try:
                     self._q.put(item, timeout=0.1)
                     break
                 except queue.Full:
                     continue
-            if item[0] == "error":
-                return  # producer ends; consumers re-raise via _error
 
     def next_batch(self):
         while True:
@@ -46,15 +60,24 @@ class PrefetchStream:
             try:
                 kind, payload = self._q.get(timeout=0.5)
             except queue.Empty:
-                # don't hang forever if the producer died (its error —
-                # already delivered or not — is sticky in self._error)
+                # don't hang forever if the producer died: its error —
+                # already delivered or not — is sticky in self._error.
+                # Checked BEFORE thread liveness: the producer may still
+                # be inside its bounded error-put window when the queue
+                # runs dry (the stored error is set first, so an empty
+                # queue + set error means no batch is ever coming).
+                if self._error is not None:
+                    raise self._error
                 if not self._thread.is_alive():
-                    raise (self._error or
-                           RuntimeError("prefetch producer exited"))
+                    raise RuntimeError("prefetch producer exited")
                 continue
             if kind == "error":
                 raise payload
             return payload
+
+    # generator protocol: `next(stream)` surfaces batches AND the stored
+    # producer error exactly like next_batch()
+    __next__ = next_batch
 
     def __iter__(self):
         while True:

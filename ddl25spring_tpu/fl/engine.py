@@ -25,11 +25,13 @@ from every loss via masking instead of dynamic shapes.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import obs
 from ..utils.trees import tree_select, tree_weighted_mean
@@ -274,6 +276,8 @@ def make_fl_round(
     robust_stack: str = "float32",
     secagg=None,
     secagg_impl: str = "auto",
+    overlap_combine: bool = False,
+    prefetch_depth: int = 0,
 ):
     """Build the jitted one-round function of a decentralized server.
 
@@ -416,6 +420,36 @@ def make_fl_round(
     must keep ``donate = False``.  Donation is enforced on CPU too (the
     donated buffer is deleted), so tests comparing two rounds from the
     same params must copy first.
+
+    ``overlap_combine = True`` replaces every cross-shard ``psum`` of the
+    cohort-sharded path with the :func:`fl.sharding.ring_all_reduce`
+    neighbour-exchange ring (arXiv 2004.13336's cross-replica-sharding
+    discipline).  With ``client_chunk`` set, the ring combine is issued
+    PER CHUNK inside the scan — chunk c's 2·(W-1) ppermute steps overlap
+    chunk c+1's client-update map, where the single end-of-round psum
+    serializes behind the whole scan.  Exactness: off (default) is the
+    current program bit-for-bit; on at W=1 the ring is the identity
+    (bit-identical again); int/uint32 reductions (fault stats, secagg
+    field sums) stay BITWISE equal to psum at any W; float aggregates
+    differ only in summation order (~1e-7 per combine —
+    docs/PERFORMANCE.md §9).  A no-op when no ``clients`` mesh path is
+    active.
+
+    ``prefetch_depth > 0`` switches host→device feeding to a
+    double-buffered per-round pipeline (``data/prefetch.py``): the client
+    population stays in HOST memory, and a background producer thread
+    replays the cohort draw for round r+1 (the same pure
+    fold_in/sample_clients sequence the jitted program computes — the
+    draw order CANNOT change), gathers its rows, and ``device_put``-s
+    them while round r computes.  The jitted round then indexes the
+    pre-gathered cohort by POSITION instead of gathering from the
+    population, so the installed params are bit-identical to
+    ``prefetch_depth = 0`` (which is today's synchronous resident-data
+    path, untouched).  Host feeding is a per-dispatch protocol:
+    ``round_fn`` raises under an outer trace (bench's fused fori_loop
+    callers must build with ``prefetch_depth = 0``), and out-of-order
+    round indices rebuild the pipeline.  The host pop wait is observed
+    as ``fl_prefetch_wait_seconds``.
     """
     if not 0.0 <= dropout_rate <= 1.0:
         raise ValueError(
@@ -496,6 +530,11 @@ def make_fl_round(
         raise ValueError(
             f"secagg_impl={secagg_impl!r} not in ('auto', 'fused', 'xla')"
         )
+    if prefetch_depth < 0:
+        raise ValueError(
+            f"prefetch_depth={prefetch_depth} must be >= 0 (0 = synchronous "
+            "device-resident feeding, >0 = host-feed pipeline depth)"
+        )
     # the fused Pallas kernel (secagg/kernels.py) collapses encode + mask +
     # survivor-sum into one pass; 'auto' compiles it on TPU only — in
     # interpret mode it is strictly slower than the fused XLA graph, so CPU
@@ -533,8 +572,17 @@ def make_fl_round(
         # a crash/serving-only plan has nothing to inject here; dropping it
         # keeps the compiled round on the exact fault-free program
         fault_plan = None
-    x = jnp.asarray(x)
-    y = jnp.asarray(y)
+    # host-feed mode (prefetch_depth > 0): the population stays in host
+    # memory and each round's cohort is gathered + device_put by the
+    # prefetch pipeline; otherwise the population is a resident device
+    # buffer gathered in-trace (the legacy path, bit-identical)
+    host_feed = prefetch_depth > 0
+    if host_feed:
+        x = np.asarray(x)
+        y = np.asarray(y)
+    else:
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
     counts = jnp.asarray(counts)
     nr_clients = x.shape[0]
 
@@ -591,6 +639,14 @@ def make_fl_round(
         # the XLA graph instead (bit-identical field sums either way)
         secagg_fused = False
 
+    # overlapped combine resolves only where a sharded combine exists; on
+    # the local / GSPMD-constraint paths the flag is a documented no-op.
+    # nr_combines = ring combines per round dispatch (one per chunk on the
+    # streaming path) — the fl_overlap_combine_chunks_total increment and
+    # the ppermute collective signature both read it.
+    overlap = bool(overlap_combine) and use_shard
+    nr_combines = (nr_shard // chunk) if chunk is not None else 1
+
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -599,8 +655,9 @@ def make_fl_round(
         # lower against non-addressable devices where a put would fail; the
         # in-trace with_sharding_constraint still carries the layout
         if device_put_data and nr_clients % mesh.shape[clients_axis] == 0:
-            x = jax.device_put(x, cshard)
-            y = jax.device_put(y, cshard)
+            if not host_feed:
+                x = jax.device_put(x, cshard)
+                y = jax.device_put(y, cshard)
             counts = jax.device_put(counts, cshard)
 
         def constrain(t):
@@ -650,6 +707,11 @@ def make_fl_round(
         # entries beyond nr_sampled are shard padding: real clients that run
         # a local update but contribute weight 0 to the aggregate
         live = jnp.arange(nr_shard) < nr_sampled
+        # host-feed rounds receive the PRE-GATHERED cohort as x/y (the
+        # prefetch pipeline replayed the same sel draw on the host), so
+        # data is indexed by cohort POSITION; counts/keys/masks still
+        # derive from sel either way — no random stream moves
+        data_idx = jnp.arange(nr_shard) if host_feed else sel
 
         if fault_plan is not None:
             # per-client fault draws, a pure function of (plan.seed,
@@ -768,13 +830,15 @@ def make_fl_round(
                 updates = jax.tree.map(_poison, updates)
             return updates
 
-        def client_messages(sel_g, keys_g, mal_g, f_nan_g, f_inf_g):
+        def client_messages(sel_g, idx_g, keys_g, mal_g, f_nan_g, f_inf_g):
             """Gather + GSPMD-constraint wrapper around
             ``messages_from_data`` for the local and sharding-constraint
             paths (the cohort-sharded path gathers once up front and calls
-            ``messages_from_data`` inside its shard_map body instead)."""
-            xs = constrain(jnp.take(x, sel_g, axis=0))
-            ys = constrain(jnp.take(y, sel_g, axis=0))
+            ``messages_from_data`` inside its shard_map body instead).
+            ``idx_g`` indexes the data operands (= ``sel_g`` on the
+            resident path, cohort positions under host feeding)."""
+            xs = constrain(jnp.take(x, idx_g, axis=0))
+            ys = constrain(jnp.take(y, idx_g, axis=0))
             cs = constrain(jnp.take(counts, sel_g, axis=0))
             updates = constrain(messages_from_data(
                 params, xs, ys, cs, keys_g, mal_g, f_nan_g, f_inf_g
@@ -871,8 +935,8 @@ def make_fl_round(
             # gather the cohort's data OUTSIDE shard_map (GSPMD inserts the
             # population→cohort reshard); everything the body needs enters
             # as explicit shard_map operands, never by closure
-            xs = constrain(jnp.take(x, sel, axis=0))
-            ys = constrain(jnp.take(y, sel, axis=0))
+            xs = constrain(jnp.take(x, data_idx, axis=0))
+            ys = constrain(jnp.take(y, data_idx, axis=0))
             cs = constrain(jnp.take(counts, sel, axis=0))
             zb = jnp.zeros((nr_shard,), jnp.bool_)
             if secagg is not None:
@@ -898,20 +962,20 @@ def make_fl_round(
 
         if chunk is not None and not custom_agg:
             return _streaming_linear_round(
-                params, sel, keys, mal, live,
+                params, sel, data_idx, keys, mal, live,
                 (f_keep, f_nan, f_inf, f_late), counts, agg_key,
                 client_messages, screen_and_stats, clip_updates,
                 base_weights, hard_zero, add_dp_noise,
             )
         if chunk is not None and custom_agg:
             return _chunked_stack_round(
-                params, sel, keys, mal, live,
+                params, sel, data_idx, keys, mal, live,
                 (f_keep, f_nan, f_inf, f_late), counts, agg_key,
                 client_messages, screen_and_stats,
             )
 
         # ---- stacked path (client_chunk = 0, the legacy program) ----
-        updates, cs = client_messages(sel, keys, mal, f_nan, f_inf)
+        updates, cs = client_messages(sel, data_idx, keys, mal, f_nan, f_inf)
 
         if secagg is not None:
             return _secagg_aggregate(
@@ -1282,6 +1346,17 @@ def make_fl_round(
         accumulator, per shard)."""
         from . import sharding as shx
 
+        # overlap=off keeps the exact psum combine below (bit-identical to
+        # the current tree); overlap=on routes every cross-shard combine
+        # through the ppermute ring — identity at W=1, int-exact at any W
+        if overlap:
+            def combine(t):
+                return shx.ring_all_reduce(t, clients_axis,
+                                           world=shard_world)
+        else:
+            def combine(t):
+                return shx.reduce_sum(t, clients_axis)
+
         f_keep, f_nan, f_inf, f_late = fmasks
         weights0 = base_weights(cs)  # cohort-global: dropout draw + any()
         zb = jnp.zeros((nr_shard,), jnp.bool_)
@@ -1302,28 +1377,24 @@ def make_fl_round(
                     faulted, stats_l = screen_and_stats(
                         updates, fk_l, fn_l, fi_l, fl_l, live_l
                     )
-                    stats = shx.reduce_sum(stats_l, clients_axis)
+                    stats = combine(stats_l)
                 else:
                     stats = jnp.zeros((4,), jnp.int32)
                 if dp_clip:
                     updates = clip_updates(params, updates)
                 # the stacked path's weight pipeline with the two global
-                # scalars (Σw, #contributing) psum'd before the ONE
+                # scalars (Σw, #contributing) combined before the ONE
                 # normalisation — bitwise the stacked sequence at W=1
                 if fault_plan is not None:
                     w_l = jnp.where(faulted, 0.0, w_l)
                     updates = hard_zero(updates, faulted)
-                wsum = jax.lax.psum(jnp.sum(w_l), clients_axis)
-                nct = jax.lax.psum(
-                    jnp.sum(w_l > 0).astype(jnp.int32), clients_axis
-                )
+                wsum = combine(jnp.sum(w_l))
+                nct = combine(jnp.sum(w_l > 0).astype(jnp.int32))
                 if fault_plan is not None:
                     w_n = w_l / jnp.where(wsum > 0, wsum, 1.0)
                 else:
                     w_n = w_l / wsum
-                aggregate = shx.reduce_sum(
-                    tree_weighted_mean(updates, w_n), clients_axis
-                )
+                aggregate = combine(tree_weighted_mean(updates, w_n))
                 return aggregate, wsum, nct, stats
 
             aggregate, wsum, nct, stats = shx.map_clients(
@@ -1365,23 +1436,37 @@ def make_fl_round(
                         faulted, stats_c = screen_and_stats(
                             updates, fk_c, fn_c, fi_c, fl_c, live_c
                         )
-                        stats = stats + stats_c
+                    else:
+                        stats_c = jnp.zeros((4,), jnp.int32)
                     if dp_clip:
                         updates = clip_updates(params, updates)
                     if fault_plan is not None:
                         w_c = jnp.where(faulted, 0.0, w_c)
                         updates = hard_zero(updates, faulted)
-                    acc = jax.tree.map(
-                        jnp.add, acc, tree_weighted_mean(updates, w_c)
+                    part = (
+                        tree_weighted_mean(updates, w_c), jnp.sum(w_c),
+                        jnp.sum(w_c > 0), stats_c,
                     )
+                    if overlap:
+                        # OVERLAPPED combine: ring-reduce THIS chunk's
+                        # partials inside the scan step — the 2·(W-1)
+                        # ppermute neighbour exchanges pipeline against the
+                        # next chunk's client-update map, and the carry
+                        # accumulates already-combined (replicated) values
+                        part = combine(part)
+                    acc = jax.tree.map(jnp.add, acc, part[0])
                     return (
-                        acc, wsum + jnp.sum(w_c),
-                        nct + jnp.sum(w_c > 0), stats
+                        acc, wsum + part[1], nct + part[2],
+                        stats + part[3],
                     ), None
 
                 (acc, wsum, nct, stats), _ = jax.lax.scan(
                     chunk_body, carry0, scan_xs
                 )
+                if overlap:
+                    # every chunk was combined in-scan; the carry is
+                    # already the replicated cohort-global reduction
+                    return acc, wsum, nct, stats
                 return shx.reduce_sum((acc, wsum, nct, stats), clients_axis)
 
             acc, wsum, nct, stats = shx.map_clients(
@@ -1421,6 +1506,16 @@ def make_fl_round(
         from . import sharding as shx
         from ..secagg import field as sa_field
         from ..secagg import masks as sa_masks
+
+        # uint32 modular sums commute, so the ring combine is BITWISE the
+        # psum at any world size — overlap costs nothing in exactness here
+        if overlap:
+            def combine(t):
+                return shx.ring_all_reduce(t, clients_axis,
+                                           world=shard_world)
+        else:
+            def combine(t):
+                return shx.reduce_sum(t, clients_axis)
 
         xs, ys, cs, keys, mal_a, fn_a, fi_a = shard_data
         grouped = groups is not None
@@ -1474,7 +1569,7 @@ def make_fl_round(
                     ),
                     masked,
                 )
-            out = [shx.reduce_sum(part, clients_axis)]
+            out = [combine(part)]
             if want_plain:
                 if grouped:
 
@@ -1497,7 +1592,7 @@ def make_fl_round(
                         ),
                         enc,
                     )
-                out.append(shx.reduce_sum(pl, clients_axis))
+                out.append(combine(pl))
             return tuple(out)
 
         return shx.map_clients(body, mesh, clients_axis, nr_replicated=7)(
@@ -1505,8 +1600,8 @@ def make_fl_round(
             xs, ys, cs, keys, mal_a, fn_a, fi_a,
         )
 
-    def _streaming_linear_round(params, sel, keys, mal, live, fmasks,
-                                counts, agg_key, client_messages,
+    def _streaming_linear_round(params, sel, data_idx, keys, mal, live,
+                                fmasks, counts, agg_key, client_messages,
                                 screen_and_stats, clip_updates,
                                 base_weights, hard_zero, add_dp_noise):
         """lax.scan over client chunks with a running weighted-sum
@@ -1526,7 +1621,7 @@ def make_fl_round(
         weights0 = base_weights(jnp.take(counts, sel, axis=0))
         zb = jnp.zeros((nr_shard,), jnp.bool_)
         xs_scan = (
-            rs(sel), rs(keys), rs(weights0), rs(live),
+            rs(sel), rs(data_idx), rs(keys), rs(weights0), rs(live),
             rs(mal if mal is not None else zb),
             rs(f_keep if f_keep is not None else zb),
             rs(f_nan if f_nan is not None else zb),
@@ -1542,9 +1637,11 @@ def make_fl_round(
 
         def chunk_body(carry, inp):
             acc, wsum, nct, stats = carry
-            (sel_c, keys_c, w_c, live_c,
+            (sel_c, idx_c, keys_c, w_c, live_c,
              mal_c, fk_c, fn_c, fi_c, fl_c) = inp
-            updates, _ = client_messages(sel_c, keys_c, mal_c, fn_c, fi_c)
+            updates, _ = client_messages(
+                sel_c, idx_c, keys_c, mal_c, fn_c, fi_c
+            )
             if fault_plan is not None:
                 faulted, stats_c = screen_and_stats(
                     updates, fk_c, fn_c, fi_c, fl_c, live_c
@@ -1586,8 +1683,9 @@ def make_fl_round(
         new_params = apply_aggregate(params, aggregate)
         return tree_select(any_survivor, new_params, params), stats
 
-    def _chunked_stack_round(params, sel, keys, mal, live, fmasks, counts,
-                             agg_key, client_messages, screen_and_stats):
+    def _chunked_stack_round(params, sel, data_idx, keys, mal, live,
+                             fmasks, counts, agg_key, client_messages,
+                             screen_and_stats):
         """Robust aggregators genuinely need the full [m, D] matrix, so
         chunking streams the stack CONSTRUCTION instead: per-chunk local
         training (bounding the backward-pass temporaries to chunk·P) writes
@@ -1625,7 +1723,7 @@ def make_fl_round(
         )
         zb = jnp.zeros((nr_shard,), jnp.bool_)
         xs_scan = (
-            jnp.arange(nr_chunks), rs(sel), rs(keys),
+            jnp.arange(nr_chunks), rs(sel), rs(data_idx), rs(keys),
             rs(mal if mal is not None else zb),
             rs(f_keep if f_keep is not None else zb),
             rs(f_nan if f_nan is not None else zb),
@@ -1636,8 +1734,11 @@ def make_fl_round(
 
         def chunk_body(carry, inp):
             bufs, scales, stats = carry
-            ci, sel_c, keys_c, mal_c, fk_c, fn_c, fi_c, fl_c, live_c = inp
-            updates, _ = client_messages(sel_c, keys_c, mal_c, fn_c, fi_c)
+            (ci, sel_c, idx_c, keys_c, mal_c, fk_c, fn_c, fi_c, fl_c,
+             live_c) = inp
+            updates, _ = client_messages(
+                sel_c, idx_c, keys_c, mal_c, fn_c, fi_c
+            )
             if fault_plan is not None:
                 faulted, stats_c = screen_and_stats(
                     updates, fk_c, fn_c, fi_c, fl_c, live_c
@@ -1730,16 +1831,23 @@ def make_fl_round(
         def _psum_sig(params, *_args, **_kw):
             if secagg is not None:
                 # uint32 field-sum tree: 4 bytes/coordinate, ×G group rows
-                coords = sum(
+                calls = tree_nr_leaves(params)
+                nbytes = 4 * sum(
                     int(l.size) for l in jax.tree.leaves(params)
                     if hasattr(l, "size")
-                )
-                return [("psum", tree_nr_leaves(params),
-                         4 * coords * secagg_groups)]
-            # linear: the params-shaped partial-sum tree + wsum + nct +
-            # the (4,) int32 stats vector
-            return [("psum", tree_nr_leaves(params) + 3,
-                     tree_payload_bytes(params) + 24)]
+                ) * secagg_groups
+            else:
+                # linear: the params-shaped partial-sum tree + wsum + nct
+                # + the (4,) int32 stats vector
+                calls = tree_nr_leaves(params) + 3
+                nbytes = tree_payload_bytes(params) + 24
+            if overlap:
+                # ring combine: nr_combines per dispatch, each leaf moving
+                # through 2·(W-1) ppermute steps of payload/W bytes
+                steps = 2 * (shard_world - 1)
+                return [("ppermute", nr_combines * calls * steps,
+                         nr_combines * (nbytes * steps) // shard_world)]
+            return [("psum", calls, nbytes)]
 
         _round_dispatch = instrument_collectives(
             _round, _psum_sig, op="fl.round"
@@ -1808,12 +1916,87 @@ def make_fl_round(
             )
         return int(jnp.sum(mal & live))
 
+    if host_feed:
+        from ..data.prefetch import PrefetchStream
+
+        def _host_cohort(base_key, step):
+            """Eager replay of the jitted round's cohort draw — the same
+            fold_in → split → sample_clients sequence ``_round`` traces
+            (and ``_secagg_host_round`` already replays), so the prefetch
+            pipeline gathers EXACTLY the rows the resident path would
+            have gathered in-trace.  The draw-order oracle the prefetch
+            bit-identity test pins (``round_fn.host_cohort``)."""
+            round_key = jax.random.fold_in(base_key, step)
+            sample_key = jax.random.split(round_key, 4)[0]
+            return np.asarray(
+                sample_clients(sample_key, nr_clients, nr_shard)
+            )
+
+        def _put_cohort(xb, yb):
+            if (mesh is not None
+                    and nr_shard % mesh.shape[clients_axis] == 0):
+                return (jax.device_put(xb, cshard),
+                        jax.device_put(yb, cshard))
+            return jnp.asarray(xb), jnp.asarray(yb)
+
+        class _CohortFeeder:
+            """``next_batch()`` source for PrefetchStream: each pull
+            draws the NEXT round's cohort, gathers its host rows, and
+            starts the device_put — so round r+1's transfer overlaps
+            round r's compute behind ``prefetch_depth`` buffers."""
+
+            def __init__(self, base_key, start):
+                self.base_key = base_key
+                self.round = start
+
+            def next_batch(self):
+                r = self.round
+                self.round = r + 1
+                sel_h = _host_cohort(self.base_key, r)
+                xb, yb = _put_cohort(x[sel_h], y[sel_h])
+                return r, xb, yb
+
+        _feed = {"stream": None, "key": None, "round": -1}
+
+        def _next_feed(base_key, step):
+            # sequential rounds ride the live pipeline; a new base key or
+            # an out-of-order round index rebuilds it from `step` (the
+            # queued cohorts were drawn for rounds that no longer come)
+            if (_feed["stream"] is None or _feed["key"] is not base_key
+                    or _feed["round"] != step):
+                if _feed["stream"] is not None:
+                    _feed["stream"].close()
+                _feed["stream"] = PrefetchStream(
+                    _CohortFeeder(base_key, step), depth=prefetch_depth
+                )
+                _feed["key"] = base_key
+            t0 = time.perf_counter()
+            r, xb, yb = _feed["stream"].next_batch()
+            if obs.enabled():
+                # host wait for the queue pop: ~0 when the producer kept
+                # up, the transfer stall itself when it did not
+                obs.observe(
+                    "fl_prefetch_wait_seconds", time.perf_counter() - t0
+                )
+            _feed["round"] = step + 1
+            return xb, yb
+
     def round_fn(params, base_key, round_idx):
         # telemetry wraps the DISPATCH boundary only; under an outer
         # trace (or with obs disabled) this is the bare jitted call.
         # bench.py's fused fori_loop path uses round_fn.raw directly and
         # is untouched either way.
         tracer = isinstance(round_idx, jax.core.Tracer)
+        if host_feed:
+            if tracer:
+                raise RuntimeError(
+                    "prefetch_depth > 0 feeds each round's cohort from "
+                    "the host and cannot run under an outer trace (fused "
+                    "fori_loop callers); build with prefetch_depth=0"
+                )
+            x_r, y_r = _next_feed(base_key, int(round_idx))
+        else:
+            x_r, y_r = x, y
         if secagg is not None and not tracer:
             # host bookkeeping BEFORE the dispatch: a below-threshold round
             # must be counted as an unmask failure even though the jitted
@@ -1821,14 +2004,14 @@ def make_fl_round(
             if _secagg_host_round(base_key, int(round_idx)):
                 obs.inc("fl_round_rejected_total", reason="secagg_floor")
         if not obs.enabled() or tracer:
-            out = _round_dispatch(params, base_key, round_idx, x, y,
+            out = _round_dispatch(params, base_key, round_idx, x_r, y_r,
                                   counts, mal_mask)
             return out[0] if fault_plan is not None else out
         step = int(round_idx)
         with obs.span("fl.round", round=step) as sp:
             with obs.step_annotation("fl.round", step):
                 out = sp.fence(
-                    _round_dispatch(params, base_key, round_idx, x, y,
+                    _round_dispatch(params, base_key, round_idx, x_r, y_r,
                                     counts, mal_mask)
                 )
         if fault_plan is not None:
@@ -1870,6 +2053,10 @@ def make_fl_round(
                 )["moved"],
             )
         obs.inc("fl_rounds_total")
+        if overlap:
+            # one increment per ring combine issued this round (one per
+            # chunk on the streaming path, one on the stacked path)
+            obs.inc("fl_overlap_combine_chunks_total", nr_combines)
         obs.inc("fl_clients_sampled_total", nr_sampled)
         obs.set_gauge("fl_clients_per_round", nr_sampled)
         if attack is not None:
@@ -1918,6 +2105,16 @@ def make_fl_round(
     # shard_map path is off (no mesh, or a configuration that fell back to
     # the GSPMD-constraint / local path) — bench and tests read this
     round_fn.cohort_shard = shard_world
+    # the RESOLVED overlapped-combine state: True only where a sharded
+    # combine exists to overlap (use_shard), regardless of the flag
+    round_fn.overlap = overlap
+    # host-feed pipeline state: depth 0 = the synchronous resident-data
+    # path; >0 exposes the eager cohort-draw replay as the draw-order
+    # oracle the prefetch bit-identity test compares against.  Note that
+    # under host feeding round_fn.data's x/y are HOST numpy population
+    # arrays and round_fn.raw expects the pre-gathered cohort instead.
+    round_fn.prefetch_depth = prefetch_depth if host_feed else 0
+    round_fn.host_cohort = _host_cohort if host_feed else None
     # the session object (None when off) + a bit-exactness probe for the
     # tests: (masked field sum, independently-computed plaintext field sum,
     # nr_survivors) for one round, no params update
@@ -1927,7 +2124,11 @@ def make_fl_round(
     round_fn.secagg_fused = secagg is not None and secagg_fused
     if secagg is not None:
         def _secagg_oracle(params, base_key, round_idx):
-            return _round(params, base_key, round_idx, x, y, counts,
+            xo, yo = x, y
+            if host_feed:
+                sel_h = _host_cohort(base_key, int(round_idx))
+                xo, yo = _put_cohort(x[sel_h], y[sel_h])
+            return _round(params, base_key, round_idx, xo, yo, counts,
                           mal_mask, oracle=True)
 
         round_fn.secagg_oracle = _secagg_oracle

@@ -58,6 +58,7 @@ def make_fedbuff_round(
     donate: bool = False,
     secagg=None,
     secagg_impl: str = "auto",
+    overlap_combine: bool = False,
     mesh=None,
     clients_axis: str = "clients",
 ):
@@ -98,7 +99,14 @@ def make_fedbuff_round(
     sum, weight sum, and fault stats psum over the axis.  Shard count 1 is
     bitwise the local tick; secagg and collusive-attack ticks, and a
     ``nr_sampled`` not divisible by the axis extent, fall back to the
-    unsharded program."""
+    unsharded program.
+
+    ``overlap_combine`` has ``engine.make_fl_round`` semantics: the
+    sharded tick's psum combines become ``fl.sharding.ring_all_reduce``
+    ppermute rings, issued PER CHUNK inside the streaming scan so the
+    neighbour exchanges overlap the next chunk's client map.  Identity at
+    W=1, int stats exact at any W, float deltas within summation-order
+    tolerance; a no-op off the sharded path."""
     if staleness_window < 1:
         raise ValueError(f"staleness_window must be >= 1, got {staleness_window}")
     if round_deadline_s is not None and round_deadline_s <= 0:
@@ -132,6 +140,10 @@ def make_fedbuff_round(
     )
     shard_world = mesh.shape[clients_axis] if use_shard else 1
     chunk = _resolve_chunk(client_chunk, nr_sampled, shard_world)
+    # overlapped combine resolves only where a sharded combine exists
+    # (engine.make_fl_round's rule); nr_combines = ring combines per tick
+    overlap = bool(overlap_combine) and use_shard
+    nr_combines = (nr_sampled // chunk) if chunk is not None else 1
     if collusive:
         # collusive attacks need the whole delta stack at once (shared
         # coalition statistics) — the streaming scan never materialises it
@@ -294,6 +306,16 @@ def make_fedbuff_round(
             # differ only in float summation order.
             from . import sharding as shx
 
+            # overlap=off keeps the exact psum combine (bit-identical to
+            # the current tree); on routes combines through the ring
+            if overlap:
+                def combine(t):
+                    return shx.ring_all_reduce(t, clients_axis,
+                                               world=shard_world)
+            else:
+                def combine(t):
+                    return shx.reduce_sum(t, clients_axis)
+
             xs_all = jnp.take(x, sel, axis=0)
             ys_all = jnp.take(y, sel, axis=0)
             zb = jnp.zeros((nr_sampled,), jnp.bool_)
@@ -314,18 +336,16 @@ def make_fedbuff_round(
                         deltas, faulted, stats_l = screen(
                             deltas, fk_l, fn_l, fi_l, fl_l
                         )
-                        stats = shx.reduce_sum(stats_l, clients_axis)
+                        stats = combine(stats_l)
                         w_l = jnp.where(faulted, 0.0, w_l)
                     else:
                         stats = jnp.zeros((4,), jnp.int32)
-                    wsum = jax.lax.psum(jnp.sum(w_l), clients_axis)
+                    wsum = combine(jnp.sum(w_l))
                     if fault_plan is not None:
                         w_n = w_l / jnp.where(wsum > 0, wsum, 1.0)
                     else:
                         w_n = w_l / wsum
-                    delta = shx.reduce_sum(
-                        tree_weighted_mean(deltas, w_n), clients_axis
-                    )
+                    delta = combine(tree_weighted_mean(deltas, w_n))
                     return delta, stats
 
                 delta, stats = shx.map_clients(body, mesh, clients_axis)(
@@ -372,16 +392,28 @@ def make_fedbuff_round(
                             deltas, faulted, stats_c = screen(
                                 deltas, fk_c, fn_c, fi_c, fl_c
                             )
-                            stats = stats + stats_c
                             w_c = jnp.where(faulted, 0.0, w_c)
-                        acc = jax.tree.map(
-                            jnp.add, acc, tree_weighted_mean(deltas, w_c)
+                        else:
+                            stats_c = jnp.zeros((4,), jnp.int32)
+                        part = (
+                            tree_weighted_mean(deltas, w_c),
+                            jnp.sum(w_c), stats_c,
                         )
-                        return (acc, wsum + jnp.sum(w_c), stats), None
+                        if overlap:
+                            # ring-combine THIS chunk's partials inside
+                            # the scan step: the ppermute exchanges
+                            # pipeline against the next chunk's map
+                            part = combine(part)
+                        acc = jax.tree.map(jnp.add, acc, part[0])
+                        return (
+                            acc, wsum + part[1], stats + part[2],
+                        ), None
 
                     (acc, wsum, stats), _ = jax.lax.scan(
                         chunk_body, carry0, scan_xs
                     )
+                    if overlap:
+                        return acc, wsum, stats
                     return shx.reduce_sum(
                         (acc, wsum, stats), clients_axis
                     )
@@ -665,8 +697,13 @@ def make_fedbuff_round(
         )
 
         def _psum_sig(history, *_args, **_kw):
-            return [("psum", tree_nr_leaves(history) + 2,
-                     tree_payload_bytes(history) // W + 20)]
+            calls = tree_nr_leaves(history) + 2
+            nbytes = tree_payload_bytes(history) // W + 20
+            if overlap:
+                steps = 2 * (shard_world - 1)
+                return [("ppermute", nr_combines * calls * steps,
+                         nr_combines * (nbytes * steps) // shard_world)]
+            return [("psum", calls, nbytes)]
 
         _tick_dispatch = instrument_collectives(
             _tick, _psum_sig, op="fl.tick"
@@ -744,6 +781,8 @@ def make_fedbuff_round(
         else:
             new_history = out
         obs.inc("fl_rounds_total")
+        if overlap:
+            obs.inc("fl_overlap_combine_chunks_total", nr_combines)
         obs.inc("fl_clients_sampled_total", nr_sampled)
         obs.set_gauge("fl_clients_per_round", nr_sampled)
         if attack is not None:
@@ -771,6 +810,8 @@ def make_fedbuff_round(
     # fallen back) and the resolved chunk — tests and bench read these
     tick.cohort_shard = shard_world
     tick.client_chunk = chunk
+    # the RESOLVED overlapped-combine state (engine round_fn.overlap twin)
+    tick.overlap = overlap
     if secagg is not None:
         def _secagg_oracle(history, base_key, tick_idx):
             return _tick(history, base_key, tick_idx, x, y, counts,
@@ -814,7 +855,8 @@ class FedBuffServer(_DecentralizedServer):
                  fault_plan=None,
                  round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
-                 secagg=None, secagg_impl: str = "auto", mesh=None):
+                 secagg=None, secagg_impl: str = "auto",
+                 overlap_combine: bool = False, mesh=None):
         from .engine import make_local_sgd_update
 
         super().__init__(task, lr, batch_size, client_data, client_fraction,
@@ -833,7 +875,8 @@ class FedBuffServer(_DecentralizedServer):
             attack_fraction=attack_fraction, attack_seed=attack_seed,
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate, secagg=secagg,
-            secagg_impl=secagg_impl, mesh=mesh,
+            secagg_impl=secagg_impl, overlap_combine=overlap_combine,
+            mesh=mesh,
         )
         self.params = init_history(self.params, staleness_window)
         # evaluate the CURRENT version of the stacked history

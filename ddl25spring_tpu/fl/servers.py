@@ -186,7 +186,8 @@ class FedSgdGradientServer(DecentralizedServer):
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
                  robust_stack: str = "float32", secagg=None,
-                 secagg_impl: str = "auto"):
+                 secagg_impl: str = "auto",
+                 overlap_combine: bool = False, prefetch_depth: int = 0):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDGradient"
@@ -209,7 +210,8 @@ class FedSgdGradientServer(DecentralizedServer):
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate,
             robust_stack=robust_stack, secagg=secagg,
-            secagg_impl=secagg_impl,
+            secagg_impl=secagg_impl, overlap_combine=overlap_combine,
+            prefetch_depth=prefetch_depth,
         )
 
 
@@ -227,7 +229,8 @@ class FedSgdWeightServer(DecentralizedServer):
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
                  robust_stack: str = "float32", secagg=None,
-                 secagg_impl: str = "auto"):
+                 secagg_impl: str = "auto",
+                 overlap_combine: bool = False, prefetch_depth: int = 0):
         super().__init__(task, lr, -1, client_data, client_fraction, seed,
                          mesh=mesh)
         self.algorithm = "FedSGDWeight"
@@ -243,7 +246,8 @@ class FedSgdWeightServer(DecentralizedServer):
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate,
             robust_stack=robust_stack, secagg=secagg,
-            secagg_impl=secagg_impl,
+            secagg_impl=secagg_impl, overlap_combine=overlap_combine,
+            prefetch_depth=prefetch_depth,
         )
 
 
@@ -271,7 +275,8 @@ class FedAvgServer(DecentralizedServer):
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, donate: bool = False,
                  robust_stack: str = "float32", secagg=None,
-                 secagg_impl: str = "auto"):
+                 secagg_impl: str = "auto",
+                 overlap_combine: bool = False, prefetch_depth: int = 0):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         self.algorithm = "FedAvg" if prox_mu == 0.0 else "FedProx"
@@ -296,7 +301,8 @@ class FedAvgServer(DecentralizedServer):
             fault_plan=fault_plan, round_deadline_s=round_deadline_s,
             client_chunk=client_chunk, donate=donate,
             robust_stack=robust_stack, secagg=secagg,
-            secagg_impl=secagg_impl,
+            secagg_impl=secagg_impl, overlap_combine=overlap_combine,
+            prefetch_depth=prefetch_depth,
         )
 
 
@@ -325,7 +331,8 @@ class FedOptServer(DecentralizedServer):
                  prox_mu: float = 0.0, dropout_rate: float = 0.0,
                  fault_plan=None, round_deadline_s: float | None = None,
                  client_chunk: int = 0, robust_stack: str = "float32",
-                 secagg=None, secagg_impl: str = "auto"):
+                 secagg=None, secagg_impl: str = "auto",
+                 overlap_combine: bool = False, prefetch_depth: int = 0):
         super().__init__(task, lr, batch_size, client_data, client_fraction,
                          seed, mesh=mesh)
         if server_optimizer not in self.OPTIMIZERS:
@@ -373,6 +380,7 @@ class FedOptServer(DecentralizedServer):
             # would hand XLA a buffer the next line still reads
             client_chunk=client_chunk, robust_stack=robust_stack,
             secagg=secagg, secagg_impl=secagg_impl,
+            overlap_combine=overlap_combine, prefetch_depth=prefetch_depth,
         )
 
         if zero_server:

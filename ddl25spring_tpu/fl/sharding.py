@@ -97,6 +97,83 @@ def reduce_weighted(updates, weights, axis: str = CLIENTS_AXIS):
     return reduce_sum((partial, jnp.sum(weights)), axis)
 
 
+def ring_all_reduce(tree, axis: str = CLIENTS_AXIS, world: int = 1):
+    """Overlap-friendly all-reduce: ring reduce-scatter + ring all-gather
+    built from ``lax.ppermute`` neighbour exchanges instead of one blocking
+    ``psum`` per leaf (the arXiv 2004.13336 cross-replica-sharding
+    discipline).  Issued per cohort chunk inside the ``client_chunk`` scan,
+    the 2·(W-1) pipelined neighbour steps of chunk c overlap chunk c+1's
+    client-update map, where the end-of-round ``psum`` serializes.
+
+    Exactness contract (what tests/test_fl_overlap.py pins):
+
+    - ``world == 1`` is the IDENTITY — bit-identical to ``psum`` and to
+      the overlap=off program by construction;
+    - every shard computes row r of the reduce-scatter as the SAME fixed
+      summation order ``Σ_j parts[(r-j) % W]`` and the all-gather copies
+      that one value verbatim, so the result is bitwise identical across
+      shards (safe under ``out_specs=P()`` with ``check_vma=False``);
+    - integer/uint32 leaves (fault stats, secagg field sums) are modular
+      and order-independent — bitwise equal to ``psum`` at ANY world;
+    - float leaves differ from ``psum`` only in summation order (~1e-7
+      per combine, same class as the chunk-streaming accumulator).
+
+    ``world`` must be the static extent of ``axis`` (the shard_map caller
+    knows it from the mesh); the ring is unrolled ``2·(world-1)`` steps.
+    """
+    if world == 1:
+        return tree
+
+    fwd = [(s, (s + 1) % world) for s in range(world)]
+
+    def ring_leaf(leaf):
+        leaf = jnp.asarray(leaf)
+        shape, dtype = leaf.shape, leaf.dtype
+        flat = leaf.reshape(-1)
+        nr = flat.shape[0]
+        row = -(-nr // world)
+        flat = jnp.pad(flat, (0, world * row - nr))
+        parts = flat.reshape(world, row)
+        idx = jax.lax.axis_index(axis)
+        # Reduce-scatter: after W-1 steps shard s holds the full sum of
+        # row s, accumulated in the shard-independent order Σ_j parts_{s-j}.
+        acc = jnp.take(parts, (idx - 1) % world, axis=0)
+        for k in range(1, world):
+            acc = jax.lax.ppermute(acc, axis, fwd)
+            acc = acc + jnp.take(parts, (idx - 1 - k) % world, axis=0)
+        # All-gather: circulate each finished row W-1 further steps; the
+        # value placed at row (s-k) originated on shard s-k — a verbatim
+        # copy, so all shards assemble the same bits.
+        out = jnp.zeros((world, row), dtype)
+        cur = acc
+        out = jax.lax.dynamic_update_index_in_dim(out, cur, idx, 0)
+        for k in range(1, world):
+            cur = jax.lax.ppermute(cur, axis, fwd)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out, cur, (idx - k) % world, 0)
+        return out.reshape(-1)[:nr].reshape(shape)
+
+    return jax.tree.map(ring_leaf, tree)
+
+
+def ppermute_signature(tree, extra_scalar_leaves: int = 0, world: int = 1,
+                       nr_combines: int = 1):
+    """Host-side collective signature of the overlapped (ring) combine for
+    ``instrument_collectives``: each of the ``nr_combines`` per-chunk
+    combines moves every leaf (plus scalars) through ``2·(W-1)`` ppermute
+    steps, each step carrying ``payload / W`` bytes — the classic ring
+    all-reduce wire volume of ``2·(W-1)/W`` times the payload."""
+    from ..parallel.collectives import tree_nr_leaves, tree_payload_bytes
+
+    if world <= 1:
+        return [("ppermute", 0, 0)]
+    leaves = tree_nr_leaves(tree) + extra_scalar_leaves
+    nbytes = tree_payload_bytes(tree) + 4 * extra_scalar_leaves
+    steps = 2 * (world - 1)
+    return [("ppermute", nr_combines * leaves * steps,
+             nr_combines * (nbytes * steps) // world)]
+
+
 def psum_signature(tree, extra_scalar_leaves: int = 0):
     """Host-side collective signature of one sharded-round dispatch for
     ``parallel.collectives.instrument_collectives``: one logical psum per

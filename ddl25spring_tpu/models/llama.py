@@ -73,13 +73,21 @@ class LlamaConfig:
     weights_int8: bool = False  # serving: matmul kernels stored int8 with
     #                             per-channel scales (models/quant.py);
     #                             params come from quantize_llama_params
-    decode_impl: str = "auto"  # auto | xla | flash-decode.
+    decode_impl: str = "auto"  # auto | xla | flash-decode | fused.
     #                            xla: einsum over the whole cache;
     #                            flash-decode: Pallas, reads only live
-    #                            cache blocks (ops/flash_decode.py).
-    #                            auto resolves to flash-decode on TPU
-    #                            (18/18 Mosaic-validated on hardware +
-    #                            1796 vs 1537 tok/s A/B, round 4 —
+    #                            cache blocks (ops/flash_decode.py);
+    #                            fused: flash-decode attention PLUS one
+    #                            Pallas program per serving step fusing
+    #                            greedy sampling, the paged KV append and
+    #                            the position advance
+    #                            (ops/fused_decode_step.py) — the KV
+    #                            write is DEFERRED out of the model
+    #                            forward into that program.
+    #                            auto resolves to fused on TPU
+    #                            (flash-decode attention was 18/18
+    #                            Mosaic-validated on hardware + 1796 vs
+    #                            1537 tok/s A/B, round 4 —
     #                            results/tpu_validate.txt,
     #                            generate_flash_tpu.txt) and xla
     #                            elsewhere / when seq-sharded / int8-cache
@@ -118,10 +126,10 @@ class LlamaConfig:
                 f"nr_heads={self.nr_heads} (each KV head serves a "
                 "fixed-size group of query heads)"
             )
-        if self.decode_impl not in ("auto", "xla", "flash-decode"):
+        if self.decode_impl not in ("auto", "xla", "flash-decode", "fused"):
             raise ValueError(
                 f"decode_impl={self.decode_impl!r} not in ('auto', 'xla', "
-                "'flash-decode')"
+                "'flash-decode', 'fused')"
             )
         if self.decode_seq_shards > 1 and \
                 self.ctx_size % self.decode_seq_shards:
@@ -129,7 +137,8 @@ class LlamaConfig:
                 f"ctx_size={self.ctx_size} not divisible by "
                 f"decode_seq_shards={self.decode_seq_shards}"
             )
-        if self.decode_seq_shards > 1 and self.decode_impl == "flash-decode":
+        if self.decode_seq_shards > 1 and \
+                self.decode_impl in ("flash-decode", "fused"):
             raise ValueError(
                 "decode_seq_shards > 1 uses its own distributed-merge "
                 "attention and would silently ignore "
@@ -175,20 +184,40 @@ class LlamaConfig:
         return ((h + 127) // 128) * 128  # round up to MXU lane multiple
 
     def resolved_decode_impl(self, backend: str | None = None) -> str:
-        """'auto' → flash-decode on TPU when eligible, xla otherwise.
+        """'auto' → fused on TPU when eligible, xla otherwise.
 
-        Eligibility mirrors the __post_init__ conflicts: the Pallas kernel
-        does not serve the seq-sharded distributed-merge path.  Without a
-        ``backend`` this falls back to ``jax.default_backend()`` — the
-        PROCESS default, not whatever a computation happens to be staged
-        for; the decode entry points (generate / serving / speculative)
-        therefore resolve from their params' actual device via
-        :func:`params_backend` before building the model, so AOT-lowering
-        a TPU decode program from a CPU-backed host picks the right
-        kernel.  Only code that constructs models directly should need to
-        pass ``backend=`` (or pin ``decode_impl``) itself."""
+        Eligibility mirrors the __post_init__ conflicts: the Pallas
+        kernels do not serve the seq-sharded distributed-merge path.
+        Without a ``backend`` this falls back to
+        ``jax.default_backend()`` — the PROCESS default, not whatever a
+        computation happens to be staged for; the decode entry points
+        (generate / serving / speculative) therefore resolve from their
+        params' actual device via :func:`params_backend` before building
+        the model, so AOT-lowering a TPU decode program from a CPU-backed
+        host picks the right kernel.  Only code that constructs models
+        directly should need to pass ``backend=`` (or pin
+        ``decode_impl``) itself."""
         if self.decode_impl != "auto":
             return self.decode_impl
+        backend = backend or jax.default_backend()
+        if backend == "tpu" and self.decode_seq_shards == 1:
+            return "fused"
+        return "xla"
+
+    def decode_attention_impl(self, backend: str | None = None) -> str:
+        """Which ATTENTION kernel the decode step runs.
+
+        'fused' names the serving inner-step fusion (sampling + paged KV
+        append + pos advance in one Pallas program,
+        ops/fused_decode_step.py) — it is not itself an attention
+        implementation.  Under it the cache read rides flash-decode on
+        TPU and the einsum path elsewhere (interpret-mode tests, or an
+        AOT lower from a non-TPU host), with the current step's K/V row
+        substituted in because the fused program appends it only AFTER
+        attention."""
+        impl = self.resolved_decode_impl(backend)
+        if impl != "fused":
+            return impl
         backend = backend or jax.default_backend()
         if backend == "tpu" and self.decode_seq_shards == 1:
             return "flash-decode"
@@ -389,6 +418,23 @@ class Attention(nn.Module):
                     var.value, blk, (0, positions[0]) + trail
                 )
 
+        # decode_impl='fused' defers the paged KV append out of the
+        # forward: the one-Pallas-program serving step
+        # (ops/fused_decode_step.py) scatters this row into the pool
+        # AFTER attention, fused with the sampling argmax and the
+        # position advance.  The rows it must write — exactly what
+        # write() would have stored, post-scrub and post-quant — leave
+        # the forward through the ``pending`` collection
+        # (models/serving.py applies with mutable=["cache", "pending"]).
+        # Attention below substitutes the row in itself, because the
+        # cache it reads does not hold it yet.  Only the paged serving
+        # step defers; generate()'s contiguous cache keeps the in-forward
+        # write.
+        defer = paged and cfg.decode_impl == "fused"
+
+        def stash(name, blk):
+            self.variable("pending", name, lambda: blk[:, 0])
+
         if cfg.kv_cache_int8:
             # serving cache compression: per-(token, head) absmax over the
             # head dim — worst-case per-element error is scale/2 (<=0.4% of
@@ -412,17 +458,27 @@ class Attention(nn.Module):
             cv_s = self.variable("cache", "v_s", zs)
             kq, ks = quant(k)
             vq, vs = quant(v)
-            write(ck_q, kq)
-            write(ck_s, ks)
-            write(cv_q, vq)
-            write(cv_s, vs)
+            if defer:
+                stash("k_q", kq)
+                stash("k_s", ks)
+                stash("v_q", vq)
+                stash("v_s", vs)
+            else:
+                write(ck_q, kq)
+                write(ck_s, ks)
+                write(cv_q, vq)
+                write(cv_s, vs)
         else:
             zeros = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), q.dtype)
             ck = self.variable("cache", "k", zeros)
             cv = self.variable("cache", "v", zeros)
-            write(ck, k)
-            write(cv, v)
-        if cfg.resolved_decode_impl() == "flash-decode" and T == 1:
+            if defer:
+                stash("k", k)
+                stash("v", v)
+            else:
+                write(ck, k)
+                write(cv, v)
+        if cfg.decode_attention_impl() == "flash-decode" and T == 1:
             # Pallas kernel streams only the LIVE cache prefix (scalar-
             # prefetch-clamped DMA); prefill (T > 1) keeps the einsum
             # below.  Per-row positions pass as a (B,) pos vector — each
@@ -435,16 +491,27 @@ class Attention(nn.Module):
             from ..ops.flash_decode import flash_decode_attention
 
             pos_arg = positions[:, 0] if per_row else positions[0]
+            cur = {}
+            if defer:
+                # deferred append: the kernel substitutes the pending row
+                # where k's slot == pos (the cache lacks it)
+                if cfg.kv_cache_int8:
+                    cur = dict(cur_k=kq[:, 0], cur_v=vq[:, 0],
+                               cur_k_scale=ks[:, 0], cur_v_scale=vs[:, 0])
+                else:
+                    cur = dict(cur_k=k[:, 0], cur_v=v[:, 0])
             if cfg.kv_cache_int8:
                 out = flash_decode_attention(
                     q[:, 0], ck_q.value, cv_q.value, pos_arg, pad,
                     cache_k_scale=ck_s.value, cache_v_scale=cv_s.value,
                     prefix_len=prefix_len, block_tables=block_tables,
+                    **cur,
                 )
             else:
                 out = flash_decode_attention(
                     q[:, 0], ck.value, cv.value, pos_arg, pad,
                     prefix_len=prefix_len, block_tables=block_tables,
+                    **cur,
                 )
             return out[:, None]  # (B, 1, H, hd)
         if paged:
@@ -477,6 +544,31 @@ class Attention(nn.Module):
                 cv_q, cv_s = _Paged(cv_q), _Paged(cv_s)
             else:
                 ck, cv = _Paged(ck), _Paged(cv)
+            if defer:
+                # deferred append: inject the pending row at its logical
+                # slot in the gathered view.  Freed/quarantined lanes
+                # (table entry 0 → null page) inject zero, exactly what
+                # the unfused path reads back after writing their row to
+                # the null page and zero-masking it — bitwise parity.
+                p = positions[:, 0]
+                rows = jnp.arange(B)
+                live = block_tables[rows, p // (S // nt)] > 0
+
+                def inject(view, blk):
+                    row = jnp.where(
+                        live.reshape((B,) + (1,) * (blk.ndim - 2)),
+                        blk[:, 0], 0,
+                    )
+                    view.value = view.value.at[rows, p].set(row)
+
+                if cfg.kv_cache_int8:
+                    inject(ck_q, kq)
+                    inject(ck_s, ks)
+                    inject(cv_q, vq)
+                    inject(cv_s, vs)
+                else:
+                    inject(ck, k)
+                    inject(cv, v)
         if cfg.kv_cache_int8:
             # einsum path: dequantize the whole cache up front (XLA fuses
             # the multiply into the operand load)

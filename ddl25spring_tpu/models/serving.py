@@ -207,8 +207,8 @@ def _make_empty_pool(model, kv_page: int):
     return build
 
 
-def _decode_step(model, P: int, params, pad, carry, _=None, *, check=False,
-                 tables=None):
+def _decode_step(model: "nn.Module", P: int, params, pad, carry, _=None, *,
+                 check=False, tables=None):
     """One lockstep greedy decode step for all slots at their own depths —
     the scan body every serving path shares (host batcher chunks, fused
     while_loop, scheduled scan), so the bit-identical-to-generate()
@@ -223,8 +223,32 @@ def _decode_step(model, P: int, params, pad, carry, _=None, *, check=False,
     carry's cache to the PAGED pool layout (models/kv_pool.py): the model
     routes every cache read/write through the block table; the logical
     values the attention math sees are identical, so paged streams stay
-    bit-equal to contiguous ones."""
+    bit-equal to contiguous ones.
+
+    Under ``decode_impl='fused'`` (paged only) the step's tail — argmax,
+    the per-leaf KV append the forward deferred, the position advance —
+    collapses into ONE Pallas program (ops/fused_decode_step.py); the
+    kernel replicates ``jnp.argmax``'s tie/NaN order and the unfused
+    scatter bit for bit, so fused streams stay on the same bit-identity
+    contract (tests/test_serving_fused_step.py)."""
     cache, tok, pos = carry
+    fused = tables is not None and model.config.decode_impl == "fused"
+    if fused:
+        from ..ops.fused_decode_step import fused_decode_step
+
+        logits, state = model.apply(
+            {**params, "cache": cache}, tok[:, None],
+            positions=pos[:, None], pad=pad, prefix_len=P,
+            block_tables=tables, mutable=["cache", "pending"],
+        )
+        nxt, cache, pos = fused_decode_step(
+            logits[:, 0], state["cache"], state["pending"], tables, pos
+        )
+        nxt = nxt.astype(tok.dtype)
+        if check:
+            ok = jnp.isfinite(logits[:, 0]).all(axis=-1)
+            return (cache, nxt, pos), (nxt, ok)
+        return (cache, nxt, pos), nxt
     logits, state = model.apply(
         {**params, "cache": cache}, tok[:, None],
         positions=pos[:, None], pad=pad, prefix_len=P,
@@ -1217,6 +1241,10 @@ class ContinuousBatcher:
                     )
         self.stats["decode_steps"] += K
         self.stats["slot_steps"] += self.max_batch * K
+        if self._paged and self.config.decode_impl == "fused":
+            # each scan step ran the one-Pallas-program inner loop
+            # (ops/fused_decode_step.py)
+            obs.inc("serving_fused_decode_steps_total", K)
         return (toks, ok) if check else toks
 
     def _admit_from(self, pending: list) -> list:

@@ -84,8 +84,24 @@ def _valid_mask(k_pos, pos, pad_b, prefix_len: int):
     return (k_pos <= pos) & real
 
 
-def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
-            *, block_k, scale, nr_k, nr_kv_heads, prefix_len):
+def _cur_row_mask(j, block_k, pos):
+    """(block_k, 1) mask selecting the key slot equal to ``pos`` inside
+    this chunk — the deferred-append substitution point (decode_impl=
+    'fused', models/llama.py): the cache does not hold the current step's
+    row yet, so the kernel splices it in where the unfused path would
+    have read it back."""
+    k_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, 1), 0
+    )
+    return k_pos == pos
+
+
+def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, *rest,
+            block_k, scale, nr_k, nr_kv_heads, prefix_len, has_cur=False):
+    if has_cur:
+        ck_ref, cv_ref, o_ref, m_scr, l_scr, acc = rest
+    else:
+        o_ref, m_scr, l_scr, acc = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
     pos = pos_ref[b]  # per-row positions (speculative decode rows diverge)
@@ -108,7 +124,13 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
         # Mosaic tiling rule always accepts; a (1, hd) head-sliced block is
         # rejected for Hkv > 1 (results/tpu_validate.txt, round 4).
         for h in range(nr_kv_heads):
-            _head_update(h, q_ref[0, h], k_ref[0, :, h, :], v_ref[0, :, h, :],
+            k = k_ref[0, :, h, :]
+            v = v_ref[0, :, h, :]
+            if has_cur:
+                kmask = _cur_row_mask(j, block_k, pos)
+                k = jnp.where(kmask, ck_ref[0, h][None, :], k)
+                v = jnp.where(kmask, cv_ref[0, h][None, :], v)
+            _head_update(h, q_ref[0, h], k, v,
                          valid, scale, m_scr, l_scr, acc)
 
     @pl.when(j == nr_k - 1)
@@ -117,11 +139,15 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc,
 
 
 def _kernel_int8(pos_ref, pad_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
-                 o_ref, m_scr, l_scr, acc, *, block_k, scale, nr_k,
-                 nr_kv_heads, prefix_len):
+                 *rest, block_k, scale, nr_k, nr_kv_heads, prefix_len,
+                 has_cur=False):
     """int8-cache variant: K/V blocks arrive as int8 with per-(token, head)
     scales (models/llama.py ``quant``) and dequantize IN VMEM — the HBM
     stream, where decode's time actually goes, stays 4x smaller."""
+    if has_cur:
+        ck_ref, cks_ref, cv_ref, cvs_ref, o_ref, m_scr, l_scr, acc = rest
+    else:
+        o_ref, m_scr, l_scr, acc = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
     pos = pos_ref[b]
@@ -146,6 +172,17 @@ def _kernel_int8(pos_ref, pad_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
                  * ks_ref[0, :, h][:, None].astype(q.dtype))
             v = (v_ref[0, :, h, :].astype(q.dtype)
                  * vs_ref[0, :, h][:, None].astype(q.dtype))
+            if has_cur:
+                # the pending row dequantizes with ITS scale — the same
+                # int8 value x f32 scale product the unfused path reads
+                # back after its in-forward write, bit for bit
+                kmask = _cur_row_mask(j, block_k, pos)
+                cur_k = (ck_ref[0, h].astype(q.dtype)
+                         * cks_ref[0, h].astype(q.dtype))
+                cur_v = (cv_ref[0, h].astype(q.dtype)
+                         * cvs_ref[0, h].astype(q.dtype))
+                k = jnp.where(kmask, cur_k[None, :], k)
+                v = jnp.where(kmask, cur_v[None, :], v)
             _head_update(h, q, k, v, valid, scale, m_scr, l_scr, acc)
 
     @pl.when(j == nr_k - 1)
@@ -169,6 +206,8 @@ def _paged_kernel(kernel):
 def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
                            cache_k_scale=None, cache_v_scale=None,
                            prefix_len: int = 0, block_tables=None,
+                           cur_k=None, cur_v=None,
+                           cur_k_scale=None, cur_v_scale=None,
                            interpret: bool | None = None):
     """One decode step against the cache, reading only live blocks.
 
@@ -203,6 +242,14 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     holds when ``kv_page`` equals the block size the contiguous call
     would pick (same online-softmax block sequence); other page sizes
     reduce in a different block order — same result to float tolerance.
+
+    ``cur_k``/``cur_v`` ((B, Hkv, hd), both or neither): the CURRENT
+    step's K/V rows when the cache append is deferred (``decode_impl=
+    'fused'``, models/llama.py) — the cache operand lacks slot ``pos``,
+    so the kernel substitutes these rows exactly where the unfused path
+    would have read them back.  An int8 cache additionally takes
+    ``cur_k_scale``/``cur_v_scale`` ((B, Hkv)) and dequantizes the row
+    with them in-kernel.
     """
     from .flash_attention import _resolve_interpret
 
@@ -210,6 +257,11 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
     int8 = cache_k_scale is not None
     if int8 != (cache_v_scale is not None):
         raise ValueError("pass both cache scales or neither")
+    has_cur = cur_k is not None
+    if has_cur != (cur_v is not None):
+        raise ValueError("pass both cur rows or neither")
+    if has_cur and int8 and (cur_k_scale is None or cur_v_scale is None):
+        raise ValueError("an int8 cache's cur rows need both cur scales")
     B, Hq, hd = q.shape
     paged = block_tables is not None
     _, kv1, Hkv, _ = cache_k.shape
@@ -280,9 +332,20 @@ def flash_decode_attention(q, cache_k, cache_v, pos, pad=None, *,
         in_specs += [kv_spec, kv_spec]
         operands += [cache_k, cache_v]
         kernel = _kernel
+    if has_cur:
+        # the pending row rides whole per grid step — tiny ((Hkv, hd))
+        # next to the K/V page DMA it spares the unfused write/read of
+        cur_spec = pl.BlockSpec((1, Hkv, hd), lambda b, j, *s: (b, 0, 0))
+        cur_scale_spec = pl.BlockSpec((1, Hkv), lambda b, j, *s: (b, 0))
+        if int8:
+            in_specs += [cur_spec, cur_scale_spec, cur_spec, cur_scale_spec]
+            operands += [cur_k, cur_k_scale, cur_v, cur_v_scale]
+        else:
+            in_specs += [cur_spec, cur_spec]
+            operands += [cur_k, cur_v]
     kernel = functools.partial(kernel, block_k=block_k, scale=scale,
                                nr_k=nr_k, nr_kv_heads=Hkv,
-                               prefix_len=int(prefix_len))
+                               prefix_len=int(prefix_len), has_cur=has_cur)
     prefetch = [pos, jnp.asarray(pad, jnp.int32)]
     if paged:
         # the table is index-map-only state: _paged_kernel drops its ref so

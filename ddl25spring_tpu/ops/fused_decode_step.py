@@ -1,0 +1,143 @@
+"""Pallas fused serving inner step: sampling + paged KV append + advance.
+
+One decode step in the paged serving loop (models/serving.py) is three
+dependent dispatches' worth of small ops after the model forward: the
+greedy ``argmax`` over the logits, the scatter of this step's K/V rows
+into their physical pages (one ``.at[phys, slot].set`` per cache leaf),
+and the ``pos + 1`` advance.  Each is tiny — the step is LATENCY-bound,
+not FLOP-bound — so their kernel-launch and HBM round-trip overheads
+dominate their useful work.  This module fuses all three into ONE Pallas
+program: per batch row it DMAs exactly one physical page per cache leaf,
+sets the row, picks the token, and bumps the position.
+
+The model forward DEFERS its cache write to get here
+(``decode_impl='fused'``, models/llama.py ``_decode_attention``): the
+post-scrub, post-quant rows leave the forward through the ``pending``
+collection, attention substitutes them in itself (in-kernel for
+flash-decode, view injection for the einsum path), and this program
+performs the append the forward skipped.  The values written are exactly
+what the unfused ``write()`` stores, so the pool stays bit-identical for
+every live lane; freed lanes (block-table row all zero) land their row on
+the reserved null page, same as unfused — never-read content.
+
+Token choice replicates ``jnp.argmax`` EXACTLY, including its tie and
+NaN order (first index of the maximum; any NaN wins over everything and
+the first NaN wins the row): quarantined lanes emit all-NaN logits, and
+greedy serving's bit-identity contract (ServedTokens fused == unfused,
+tests/test_serving_fused_step.py) covers them too.
+
+Grid is one step per batch row; ``pos`` and the block tables ride as
+scalar-prefetch arguments so each row's page DMA is table-routed by the
+BlockSpec index maps.  The pool leaves alias input to output
+(``input_output_aliases``) — untouched pages are never copied, and the
+buffers donate straight through the serving scan carry.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(pos_ref, tbl_ref, logits_ref, *refs, nr, vocab):
+    del tbl_ref  # consumed entirely by the BlockSpec index maps
+    pool_in = refs[:nr]
+    pend = refs[nr:2 * nr]
+    tok_ref = refs[2 * nr]
+    npos_ref = refs[2 * nr + 1]
+    pool_out = refs[2 * nr + 2:]
+    b = pl.program_id(0)
+    p = pos_ref[b]
+
+    # greedy sampling == jnp.argmax, bit for bit: first index of the max,
+    # except any NaN beats everything and the FIRST NaN wins (numpy's
+    # total order, which jnp.argmax inherits — the quarantine path's
+    # all-NaN rows rely on it).  float32 embedding is exact for every
+    # logits dtype served, so comparisons cannot re-tie.
+    row = logits_ref[...].astype(jnp.float32)  # (1, V)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, vocab), 1)
+    isnan = row != row
+    nan_idx = jnp.min(jnp.where(isnan, idx, vocab))
+    max_idx = jnp.min(jnp.where(row == jnp.max(row), idx, vocab))
+    tok_ref[0, 0] = jnp.where(jnp.any(isnan), nan_idx, max_idx)
+    npos_ref[0, 0] = p + 1
+
+    # paged append: each leaf's block is the ONE physical page holding
+    # slot p (table-routed by the index map); copy it through the alias
+    # and set the row — all other pages pass untouched via aliasing
+    for i in range(nr):
+        page = pool_in[i].shape[1]
+        pool_out[i][...] = pool_in[i][...]
+        pool_out[i][0, pl.ds(p % page, 1)] = pend[i][...]
+
+
+def fused_decode_step(logits, pool, pending, block_tables, pos, *,
+                      interpret: bool | None = None):
+    """One fused serving step over a paged KV pool.
+
+    ``logits``: (B, V) this step's final-position logits; ``pool``: the
+    paged cache pytree, leaves (nr_pages, kv_page, ...); ``pending``: the
+    forward's deferred K/V rows (models/llama.py), same tree structure,
+    leaves (B, ...) matching each pool leaf's per-slot shape;
+    ``block_tables``: (B, ctx // kv_page) int32; ``pos``: (B,) int32
+    current slots.  Returns ``(tokens (B,) int32, new_pool, pos + 1)``
+    with ``tokens[b] == jnp.argmax(logits[b])`` and ``new_pool`` equal to
+    the unfused per-leaf ``.at[phys, slot].set(row)`` scatter.
+    """
+    from .flash_attention import _resolve_interpret
+
+    interpret = _resolve_interpret(interpret)
+    pool_leaves, treedef = jax.tree.flatten(pool)
+    pend_leaves = treedef.flatten_up_to(pending)
+    B, V = logits.shape
+    nr = len(pool_leaves)
+    pos = jnp.asarray(pos, jnp.int32)
+    prefetch = [pos, jnp.asarray(block_tables, jnp.int32)]
+
+    def page_map(page, ndim):
+        # the one physical page holding row b's slot pos[b]; freed lanes
+        # (table row zero) route to the null page, same as unfused
+        return lambda b, pos_v, tbl: (
+            (tbl[b, pos_v[b] // page],) + (0,) * (ndim - 1)
+        )
+
+    pool_specs = [
+        pl.BlockSpec((1,) + leaf.shape[1:],
+                     page_map(leaf.shape[1], leaf.ndim))
+        for leaf in pool_leaves
+    ]
+    in_specs = [pl.BlockSpec((1, V), lambda b, pos_v, tbl: (b, 0))]
+    in_specs += pool_specs
+    in_specs += [
+        pl.BlockSpec((1,) + leaf.shape[1:],
+                     lambda b, pos_v, tbl, n=leaf.ndim: (b,) + (0,) * (n - 1))
+        for leaf in pend_leaves
+    ]
+    scalar_spec = pl.BlockSpec((1, 1), lambda b, pos_v, tbl: (b, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(prefetch),
+        grid=(B,),
+        in_specs=in_specs,
+        out_specs=[scalar_spec, scalar_spec] + pool_specs,
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        jax.ShapeDtypeStruct((B, 1), jnp.int32),
+    ] + [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in pool_leaves]
+    # alias each pool input onto its output (input indices count the
+    # scalar-prefetch operands: pos, tables, logits precede the pools)
+    aliases = {3 + i: 2 + i for i in range(nr)}
+    outs = pl.pallas_call(
+        functools.partial(_kernel, nr=nr, vocab=V),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*prefetch, logits, *pool_leaves, *pend_leaves)
+    tokens, new_pos = outs[0][:, 0], outs[1][:, 0]
+    new_pool = jax.tree.unflatten(treedef, outs[2:])
+    return tokens, new_pool, new_pos

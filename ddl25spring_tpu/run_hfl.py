@@ -310,6 +310,9 @@ def build_server(cfg: HflConfig):
                     and not (cfg.checkpoint_dir and cfg.checkpoint_every)),
             secagg=build_secagg(cfg, client_data),
             secagg_impl=cfg.secagg_impl,
+            # fedbuff ticks are async and already host-feed per tick, so
+            # prefetch_depth does not apply; the overlapped combine does
+            overlap_combine=cfg.overlap_combine,
         )
 
     if cfg.algorithm == "scaffold":
@@ -369,7 +372,9 @@ def build_server(cfg: HflConfig):
               round_deadline_s=round_deadline_s,
               client_chunk=cfg.client_chunk, robust_stack=cfg.robust_stack,
               secagg=build_secagg(cfg, client_data),
-              secagg_impl=cfg.secagg_impl)
+              secagg_impl=cfg.secagg_impl,
+              overlap_combine=cfg.overlap_combine,
+              prefetch_depth=cfg.prefetch_depth)
     if cfg.algorithm == "fedsgd":
         return FedSgdGradientServer(task, cfg.lr, client_data,
                                     cfg.client_fraction, cfg.seed,
@@ -428,7 +433,13 @@ def run(cfg: HflConfig):
                  else "")
               + ("; zero-server: optimizer state sharded "
                  f"1/{shard} per replica"
-                 if getattr(server, "zero_server", False) else ""))
+                 if getattr(server, "zero_server", False) else "")
+              + ("; overlapped ring combine"
+                 if getattr(server.round_fn, "overlap", False) else ""))
+    if getattr(server.round_fn, "prefetch_depth", 0):
+        print(f"[feed] host-feed pipeline: prefetch_depth="
+              f"{server.round_fn.prefetch_depth} (round r+1 device_put "
+              "overlaps round r compute)")
     if cfg.val_gate:
         from .resilience import ValidationGate
 

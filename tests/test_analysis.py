@@ -176,6 +176,86 @@ def test_hygiene_negative_idioms_stay_clean(tmp_path):
     assert not found, [(f.rule, f.line, f.message) for f in found]
 
 
+def test_hygiene_trc008_flags_unbound_ppermute_axis(tmp_path):
+    # literal specs name only "data"; the body permutes over "model"
+    # (typo'd / wrong mesh dimension) and one call forgets the axis
+    fs = {"pkg/mod.py": """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            x = jax.lax.ppermute(x, "model", [(0, 1)])   # TRC008
+            return jax.lax.ppermute(x, perm=[(0, 1)])    # TRC008: no axis
+
+        def outer(mesh, x):
+            return shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                             out_specs=P("data"))(x)
+    """}
+    found = lint_fixture(tmp_path, fs, ("trace-hygiene",))
+    hits = [f for f in found if f.rule == "TRC008"]
+    assert len(hits) == 2, [(f.rule, f.line, f.message) for f in found]
+    assert any(f.detail == "model" and "data" in f.message for f in hits)
+    assert any(f.detail == "ppermute" for f in hits)
+
+
+def test_hygiene_trc008_lambda_body_and_matching_axis(tmp_path):
+    # a lambda body is checked in place; a matching literal axis is clean
+    fs = {"pkg/mod.py": """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def bad(mesh, x):
+            return shard_map(
+                lambda v: jax.lax.ppermute(v, "rows", [(0, 1)]),
+                mesh=mesh, in_specs=(P("cols"),), out_specs=P("cols"),
+            )(x)
+
+        def good(mesh, x):
+            return shard_map(
+                lambda v: jax.lax.ppermute(v, "cols", [(0, 1)]),
+                mesh=mesh, in_specs=(P("cols"),), out_specs=P("cols"),
+            )(x)
+    """}
+    found = lint_fixture(tmp_path, fs, ("trace-hygiene",))
+    hits = [f for f in found if f.rule == "TRC008"]
+    assert [f.detail for f in hits] == ["rows"], \
+        [(f.rule, f.line, f.message) for f in found]
+
+
+def test_hygiene_trc008_abstains_on_variable_axes(tmp_path):
+    # the repo's own ring idiom: axis threaded through as a variable —
+    # in both the specs and the ppermute call — must never be flagged
+    fs = {"pkg/mod.py": """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def ring(x, axis):
+            return jax.lax.ppermute(x, axis, [(0, 1)])
+
+        def via_variable_spec(mesh, x, axis):
+            return shard_map(lambda v: ring(v, axis), mesh=mesh,
+                             in_specs=(P(axis),), out_specs=P())(x)
+
+        def via_variable_axis(mesh, x, axis):
+            return shard_map(lambda v: jax.lax.ppermute(v, axis, [(0, 1)]),
+                             mesh=mesh, in_specs=(P("clients"),),
+                             out_specs=P("clients"))(x)
+
+        def replicated_only(mesh, x):
+            # no literal axis named anywhere: nothing to check against
+            return shard_map(
+                lambda v: jax.lax.ppermute(v, "clients", [(0, 1)]),
+                mesh=mesh, in_specs=(P(),), out_specs=P(),
+            )(x)
+    """}
+    found = lint_fixture(tmp_path, fs, ("trace-hygiene",))
+    assert not [f for f in found if f.rule == "TRC008"], \
+        [(f.rule, f.line, f.message) for f in found]
+
+
 # -------------------------------------------------------------------------
 # 1c. determinism fixtures
 # -------------------------------------------------------------------------

@@ -1,0 +1,453 @@
+"""Overlapped chunked combine + host-feed prefetch: hidden, not changed.
+
+``overlap_combine=True`` replaces the sharded round's single end-of-round
+``psum`` with per-chunk ring reduce-scatter/all-gather partial combines
+(``fl/sharding.py ring_all_reduce``) interleaved into the client chunk
+scan — the combine cost rides UNDER the next chunk's compute.  The
+contract mirrors the sharding oracle (tests/test_fl_sharded.py):
+
+- ``overlap_combine`` at shard count 1 is BIT-identical to overlap off
+  (the W=1 ring is the identity);
+- W > 1 float paths agree with overlap-off to float-sum-reorder
+  tolerance, and the ring result is SHARD-INDEPENDENT (every shard holds
+  the same bits — the per-chunk partial combine must not reintroduce
+  per-shard summation orders under the replicated out_specs);
+- secagg's uint32 modular sums are order-independent, so overlapped
+  rounds stay BITWISE identical to local at every world size.
+
+``prefetch_depth > 0`` moves cohort batch assembly onto a host producer
+thread (data/prefetch.py) that device_puts round r+1's rows while round
+r runs.  Sampling stays device-side and draw-order identical, so params
+are BIT-identical to the synchronous path at any depth.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.data.prefetch import PrefetchStream
+from ddl25spring_tpu.data.split import ClientDatasets
+from ddl25spring_tpu.fl.engine import make_fl_round, make_local_sgd_update
+from ddl25spring_tpu.fl.fedbuff import init_history, make_fedbuff_round
+from ddl25spring_tpu.fl.sharding import ring_all_reduce
+from ddl25spring_tpu.fl.task import Task
+from ddl25spring_tpu.parallel import make_mesh
+from ddl25spring_tpu.resilience.faults import FaultPlan
+from ddl25spring_tpu.secagg.protocol import SecAgg
+
+# same tiny logistic-regression geometry as tests/test_fl_sharded.py
+N, PER, D, K, BS = 12, 16, 8, 4, 8
+NR_SAMPLED = 8
+_rng = np.random.default_rng(42)
+X = _rng.normal(size=(N, PER, D)).astype(np.float32)
+Y = _rng.integers(0, K, size=(N, PER)).astype(np.int32)
+COUNTS = np.full((N,), PER, np.int32)
+COUNTS[0] = PER - 3
+COUNTS[5] = PER - 5
+
+P0 = {"w": jnp.zeros((D, K), jnp.float32),
+      "b": jnp.zeros((K,), jnp.float32)}
+KEY = jax.random.PRNGKey(3)
+
+
+def loss_fn(params, xb, yb, mask, key):
+    logits = xb @ params["w"] + params["b"]
+    ls = -jax.nn.log_softmax(logits)[jnp.arange(yb.shape[0]), yb]
+    return jnp.sum(ls * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+UPDATE = make_local_sgd_update(loss_fn, 0.05, BS, 1)
+
+
+def clients_mesh(w):
+    return make_mesh({"clients": w}, devices=jax.devices()[:w])
+
+
+def build(mesh=None, **kw):
+    return make_fl_round(UPDATE, X, Y, COUNTS, NR_SAMPLED,
+                         device_put_data=False, mesh=mesh, **kw)
+
+
+def run_rounds(rf, nr=3, p0=P0):
+    p = p0
+    for r in range(nr):
+        p = rf(p, KEY, r)
+    return p
+
+
+def max_err(a, b):
+    return max(float(jnp.max(jnp.abs(x - y)))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def trees_bitwise(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# --- ring all-reduce primitive ---------------------------------------------
+
+
+@pytest.mark.parametrize("world", [1, 2, 4, 8])
+def test_ring_all_reduce_matches_psum(world):
+    """RS+AG == psum to float tolerance, and the result is the SAME BITS
+    on every shard (the property the overlap correctness rests on)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ddl25spring_tpu.parallel.compat import shard_map
+
+    mesh = clients_mesh(world)
+    rng = np.random.default_rng(0)
+    tree = {
+        "a": jnp.asarray(rng.normal(size=(world, 5, 3)), jnp.float32),
+        "s": jnp.asarray(rng.normal(size=(world,)), jnp.float32),
+        "u": jnp.asarray(
+            rng.integers(0, 2**32, size=(world, 7), dtype=np.uint32)),
+    }
+
+    def body(t):
+        ring = ring_all_reduce(t, "clients", world=world)
+        ps = jax.tree.map(
+            lambda l: jax.lax.psum(l, "clients"), t)
+        return ring, ps
+
+    ring, ps = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P("clients"), tree),),
+        out_specs=(jax.tree.map(lambda _: P("clients"), tree),) * 2,
+        check_vma=False,
+    ))(tree)
+    # every shard's copy identical -> comparing the stacked (W, ...) axes
+    for name, leaf in ring.items():
+        per_shard = np.asarray(leaf).reshape((world, -1))
+        assert (per_shard == per_shard[0]).all(), name
+    # uint32 modular sums are order-independent: exactly psum's bits
+    assert np.array_equal(np.asarray(ring["u"]), np.asarray(ps["u"]))
+    if world == 1:
+        assert trees_bitwise(ring, ps)
+    else:
+        assert max_err(
+            {k: ring[k] for k in ("a", "s")},
+            {k: ps[k] for k in ("a", "s")}) < 1e-5
+
+
+# --- engine: overlapped rounds == plain rounds -----------------------------
+
+
+@pytest.mark.parametrize("chunk", [0, 4], ids=["stacked", "chunk4"])
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_overlap_matches_plain_sharded(world, chunk):
+    rf_off = build(mesh=clients_mesh(world), client_chunk=chunk)
+    rf_on = build(mesh=clients_mesh(world), client_chunk=chunk,
+                  overlap_combine=True)
+    assert rf_on.overlap
+    p_off = run_rounds(rf_off)
+    p_on = run_rounds(rf_on)
+    err = max_err(p_off, p_on)
+    if world == 1:
+        # the W=1 ring is the identity: overlap changes NOTHING
+        assert err == 0.0
+    else:
+        assert err < 1e-6
+    # and both still track the local oracle
+    assert max_err(run_rounds(build(client_chunk=chunk)), p_on) < 1e-6
+
+
+def test_overlap_without_mesh_is_inert():
+    rf = build(overlap_combine=True)
+    assert not rf.overlap
+    assert trees_bitwise(run_rounds(rf), run_rounds(build()))
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_overlap_fault_stats_order_exact(world):
+    plan = FaultPlan(seed=7, drop=0.2, nan=0.1)
+    rf_off = build(mesh=clients_mesh(world), fault_plan=plan,
+                   round_deadline_s=1.0)
+    rf_on = build(mesh=clients_mesh(world), fault_plan=plan,
+                  round_deadline_s=1.0, overlap_combine=True)
+    for r in range(2):
+        p_off, s_off = rf_off.raw(P0, KEY, r, *rf_off.data)
+        p_on, s_on = rf_on.raw(P0, KEY, r, *rf_on.data)
+        # int32 stats ride the same ring: order-exact, so EXACTLY equal
+        assert np.array_equal(np.asarray(s_off), np.asarray(s_on))
+        assert max_err(p_off, p_on) < 1e-6
+
+
+# --- secagg: modular sums are order-independent -> bitwise at any W --------
+
+
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_overlap_secagg_bitwise(world):
+    def secagg_round(mesh, **kw):
+        sa = SecAgg(N, NR_SAMPLED, counts=np.asarray(COUNTS), clip=4.0,
+                    seed=3)
+        return make_fl_round(UPDATE, X, Y, COUNTS, NR_SAMPLED, mesh=mesh,
+                             device_put_data=False, secagg=sa,
+                             fault_plan=FaultPlan(seed=7, drop=0.2),
+                             round_deadline_s=1.0, **kw)
+
+    rf_local = secagg_round(None)
+    rf_on = secagg_round(clients_mesh(world), overlap_combine=True)
+    assert rf_on.overlap == (world >= 1)
+    f_l, p_l, s_l = rf_local.secagg_oracle(P0, KEY, 1)
+    f_s, p_s, s_s = rf_on.secagg_oracle(P0, KEY, 1)
+    assert trees_bitwise(f_l, f_s), "masked field sums diverged"
+    assert trees_bitwise(p_l, p_s), "plaintext field sums diverged"
+    assert np.array_equal(np.asarray(s_l), np.asarray(s_s))
+    # whole rounds: pure function of the modular sum -> still bitwise
+    assert max_err(secagg_round(None)(P0, KEY, 0),
+                   secagg_round(clients_mesh(world),
+                                overlap_combine=True)(P0, KEY, 0)) == 0.0
+
+
+# --- fedbuff ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("chunk", [0, 4], ids=["plain", "chunk4"])
+@pytest.mark.parametrize("world", [1, 4])
+def test_fedbuff_overlap_matches_plain(world, chunk):
+    def tick(mesh, **kw):
+        return make_fedbuff_round(UPDATE, X, Y, COUNTS, NR_SAMPLED,
+                                  staleness_window=3,
+                                  fault_plan=FaultPlan(seed=7, drop=0.2),
+                                  round_deadline_s=1.0, mesh=mesh, **kw)
+
+    tk_off = tick(clients_mesh(world), client_chunk=chunk)
+    tk_on = tick(clients_mesh(world), client_chunk=chunk,
+                 overlap_combine=True)
+    assert tk_on.overlap
+    h_off = init_history(P0, 3)
+    h_on = init_history(P0, 3)
+    for r in range(3):
+        h_off = tk_off(h_off, KEY, r)
+        h_on = tk_on(h_on, KEY, r)
+    err = max_err(h_off, h_on)
+    if world == 1:
+        assert err == 0.0
+    else:
+        assert err < 1e-6
+
+
+# --- host-feed prefetch: bit-identical at any depth ------------------------
+
+
+@pytest.mark.parametrize("chunk", [0, 4], ids=["stacked", "chunk4"])
+@pytest.mark.parametrize("depth", [1, 2])
+def test_prefetch_bit_identical(depth, chunk):
+    rf_sync = build(client_chunk=chunk)
+    rf_feed = build(client_chunk=chunk, prefetch_depth=depth)
+    assert rf_feed.prefetch_depth == depth
+    assert rf_sync.prefetch_depth == 0
+    assert trees_bitwise(run_rounds(rf_sync), run_rounds(rf_feed))
+
+
+def test_prefetch_with_sharded_and_overlap_bit_identical():
+    mesh = clients_mesh(4)
+    want = run_rounds(build(mesh=mesh, client_chunk=4))
+    got = run_rounds(build(mesh=mesh, client_chunk=4, prefetch_depth=2))
+    assert trees_bitwise(want, got)
+    both = run_rounds(build(mesh=mesh, client_chunk=4, prefetch_depth=2,
+                            overlap_combine=True))
+    assert max_err(want, both) < 1e-6
+
+
+def test_prefetch_host_cohort_oracle():
+    """The host-side replay draws the SAME cohort the device program
+    samples — the property the whole feed path's bit-identity rests on
+    — and is deterministic per (key, round)."""
+    rf = build(prefetch_depth=1)
+    a = rf.host_cohort(KEY, 0)
+    b = rf.host_cohort(KEY, 0)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (NR_SAMPLED,)
+    assert ((a >= 0) & (a < N)).all()
+    # distinct rounds draw distinct cohorts (fold_in separation)
+    assert not np.array_equal(a, rf.host_cohort(KEY, 1))
+    # synchronous rounds have no host replay to drift
+    assert build().host_cohort is None
+
+
+def test_prefetch_validation_and_trace_guard():
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        build(prefetch_depth=-1)
+    rf = build(prefetch_depth=1)
+    with pytest.raises(RuntimeError, match="prefetch"):
+        jax.jit(rf)(P0, KEY, 0)
+
+
+# --- prefetch stream: producer death must not deadlock ---------------------
+
+
+class _DyingSource:
+    def __init__(self, yield_n):
+        self.yield_n = yield_n
+        self.n = 0
+
+    def next_batch(self):
+        if self.n >= self.yield_n:
+            raise RuntimeError("boom")
+        self.n += 1
+        return self.n
+
+
+def test_prefetch_stream_relays_producer_error():
+    s = PrefetchStream(_DyingSource(2), depth=4)
+    assert s.next_batch() == 1
+    assert next(s) == 2  # __next__ alias shares the error discipline
+    with pytest.raises(RuntimeError, match="boom"):
+        s.next_batch()
+    s.close()
+
+
+def test_prefetch_stream_producer_death_with_full_queue_no_deadlock():
+    """Regression: a producer that raises while the queue is FULL used to
+    spin forever trying to enqueue the error sentinel; the consumer then
+    waited on a queue that never drained.  The error is sticky now — the
+    consumer must surface it even if the sentinel never fit."""
+    s = PrefetchStream(_DyingSource(1), depth=1)
+    # let the producer fill the queue, raise, and exhaust its bounded
+    # error-put window (20 x 0.1 s)
+    deadline = time.monotonic() + 10
+    while s._thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not s._thread.is_alive(), "producer must exit, not spin"
+    got = []
+    done = threading.Event()
+
+    def consume():
+        got.append(s.next_batch())       # the one real batch
+        try:
+            s.next_batch()
+        except RuntimeError as e:
+            got.append(str(e))
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    assert done.wait(10), "consumer deadlocked on dead producer"
+    assert got[0] == 1 and "boom" in got[1]
+    s.close()
+
+
+# --- tools/mem_estimate.py --overlap tier-1 smoke --------------------------
+
+
+def test_mem_estimate_overlap_cell():
+    """The --overlap AOT cell compiles both rounds and holds its claims:
+    W=1 overlap is program-identical (the ring is the identity, same
+    temp bytes), W>1 stays within the 2x temp-bytes bound the cell
+    asserts internally, and the ppermute wire signature is the ring's
+    2*(W-1)/W volume."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "mem_estimate",
+        Path(__file__).resolve().parent.parent / "tools" / "mem_estimate.py",
+    )
+    me = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(me)
+
+    out = me.overlap_estimate(16, 8, 2, [1, 2])
+    cells = {c["world"]: c for c in out["cells"]}
+    assert set(cells) == {1, 2}
+    w1 = cells[1]
+    assert w1["nr_ppermutes"] == 0 and w1["ppermute_wire_bytes"] == 0
+    assert w1["temp_bytes_overlap"] == w1["temp_bytes_plain"]
+    w2 = cells[2]
+    # 2 leaves x 2*(W-1) steps x nr_combines(=2 chunks of 2 in a 4-row
+    # shard) ppermutes, each step moving payload/W bytes
+    assert w2["nr_ppermutes"] == 8
+    assert w2["ppermute_wire_bytes"] > 0
+    assert 0 < w2["temp_bytes_overlap"] <= 2 * w2["temp_bytes_plain"] + (
+        1 << 20)
+
+
+# --- all five servers: overlapped combine == plain at every world ----------
+
+
+def _tiny_task():
+    return Task(
+        init=lambda key: {"w": jnp.zeros((D, K), jnp.float32),
+                          "b": jnp.zeros((K,), jnp.float32)},
+        loss_fn=loss_fn,
+        score_fn=lambda params, x: x @ params["w"] + params["b"],
+        test_x=X[0], test_y=Y[0],
+    )
+
+
+CD = ClientDatasets(x=X, y=Y, counts=COUNTS)
+FRACTION = NR_SAMPLED / N
+
+
+def _fedsgd_grad(mesh, overlap):
+    from ddl25spring_tpu.fl.servers import FedSgdGradientServer
+
+    return FedSgdGradientServer(
+        _tiny_task(), lr=0.05, client_data=CD, client_fraction=FRACTION,
+        seed=0, mesh=mesh, overlap_combine=overlap)
+
+
+def _fedsgd_weight(mesh, overlap):
+    from ddl25spring_tpu.fl.servers import FedSgdWeightServer
+
+    return FedSgdWeightServer(
+        _tiny_task(), lr=0.05, client_data=CD, client_fraction=FRACTION,
+        seed=0, mesh=mesh, overlap_combine=overlap)
+
+
+def _fedavg(mesh, overlap):
+    from ddl25spring_tpu.fl.servers import FedAvgServer
+
+    return FedAvgServer(
+        _tiny_task(), lr=0.05, batch_size=BS, client_data=CD,
+        client_fraction=FRACTION, nr_local_epochs=2, seed=0, mesh=mesh,
+        overlap_combine=overlap)
+
+
+def _fedopt(mesh, overlap):
+    from ddl25spring_tpu.fl.servers import FedOptServer
+
+    return FedOptServer(
+        _tiny_task(), lr=0.05, batch_size=BS, client_data=CD,
+        client_fraction=FRACTION, nr_local_epochs=1, seed=0,
+        server_optimizer="adam", server_lr=0.01, mesh=mesh,
+        overlap_combine=overlap)
+
+
+def _fedbuff(mesh, overlap):
+    from ddl25spring_tpu.fl.fedbuff import FedBuffServer
+
+    return FedBuffServer(
+        _tiny_task(), lr=0.05, batch_size=BS, client_data=CD,
+        client_fraction=FRACTION, nr_local_epochs=1, seed=0,
+        staleness_window=2, mesh=mesh, overlap_combine=overlap)
+
+
+@pytest.mark.parametrize("build_server", [
+    _fedsgd_grad, _fedsgd_weight, _fedavg, _fedopt, _fedbuff,
+], ids=["fedsgd_grad", "fedsgd_weight", "fedavg", "fedopt", "fedbuff"])
+@pytest.mark.parametrize("world", [1, 4])
+def test_server_overlap_matches_plain(build_server, world):
+    """Every server's overlapped round tracks its plain sharded round:
+    bit-identical at W=1 (the singleton ring is the identity), float
+    summation-order tolerance at W=4 — including cross-round server
+    state (FedOpt moments, FedBuff history)."""
+    mesh = clients_mesh(world)
+    plain, over = build_server(mesh, False), build_server(mesh, True)
+    p_p, p_o = plain.params, over.params
+    for r in range(2):
+        p_p = plain.round_fn(p_p, plain.run_key, r)
+        p_o = over.round_fn(p_o, over.run_key, r)
+    err = max_err(p_p, p_o)
+    if world == 1:
+        assert err == 0.0
+    else:
+        assert err < 1e-6
+    for key, val in plain.extra_state().items():
+        assert max_err(val, over.extra_state()[key]) < 1e-6

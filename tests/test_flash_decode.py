@@ -194,9 +194,10 @@ def test_decode_impl_auto_resolution():
 
     cfg = LlamaConfig(decode=True)
     assert cfg.decode_impl == "auto"
-    # CPU test backend -> xla
+    # CPU test backend -> xla; on TPU auto goes all the way to the fused
+    # serving inner step (ops/fused_decode_step.py)
     assert cfg.resolved_decode_impl() == (
-        "flash-decode" if jax.default_backend() == "tpu" else "xla"
+        "fused" if jax.default_backend() == "tpu" else "xla"
     )
     # ineligible shapes resolve to xla even on TPU
     assert dataclasses.replace(
@@ -206,7 +207,7 @@ def test_decode_impl_auto_resolution():
     # in-stream): auto treats them like any other cache
     assert dataclasses.replace(
         cfg, kv_cache_int8=True
-    ).resolved_decode_impl(backend="tpu") == "flash-decode"
+    ).resolved_decode_impl(backend="tpu") == "fused"
     # explicit settings are never overridden
     assert dataclasses.replace(
         cfg, decode_impl="flash-decode"
@@ -214,6 +215,15 @@ def test_decode_impl_auto_resolution():
     assert dataclasses.replace(
         cfg, decode_impl="xla"
     ).resolved_decode_impl() == "xla"
+    # 'fused' is a serving-loop fusion, not an attention impl: the cache
+    # read under it rides flash-decode on TPU and the einsum elsewhere
+    fcfg = dataclasses.replace(cfg, decode_impl="fused")
+    assert fcfg.resolved_decode_impl() == "fused"
+    assert fcfg.decode_attention_impl(backend="tpu") == "flash-decode"
+    assert fcfg.decode_attention_impl(backend="cpu") == "xla"
+    assert dataclasses.replace(
+        cfg, decode_impl="flash-decode"
+    ).decode_attention_impl(backend="cpu") == "flash-decode"
 
 
 def _xla_decode_prefix(q, ck, cv, pos, pad, prefix_len):

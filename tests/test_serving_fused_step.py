@@ -1,0 +1,244 @@
+"""Fused serving inner step oracle: ``decode_impl='fused'`` == unfused.
+
+The fused step (ops/fused_decode_step.py) collapses the paged decode
+step's tail — greedy argmax, the deferred per-leaf KV append, the
+position advance — into one Pallas program, and the model forward under
+it substitutes the current K/V row into attention itself
+(models/llama.py ``_decode_attention``).  The bit-identity contract is
+the same one the paged layout carries against contiguous
+(tests/test_serving_paged.py): every trajectory the unfused paged
+batcher produces — staggered admissions, EOS + chunked decode, int8
+cache, deadline evictions, poison quarantine — must come back
+BIT-identical with ``decode_impl='fused'`` (interpret mode here; the
+same program text runs compiled on TPU).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+from ddl25spring_tpu.models.serving import ContinuousBatcher
+from ddl25spring_tpu.ops.fused_decode_step import fused_decode_step
+
+CFG = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                  nr_layers=2, ctx_size=48)
+FUSED = dataclasses.replace(CFG, decode_impl="fused")
+PAGED = {"kv_layout": "paged", "kv_page": 8}
+
+
+@pytest.fixture(scope="module")
+def setup():
+    prompt = jnp.ones((1, 4), jnp.int32)
+    return Llama(CFG).init(
+        jax.random.PRNGKey(0), prompt, positions=jnp.arange(4)
+    )
+
+
+def _prompts(seed=3, sizes=(3, 7, 4, 8, 5)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 97, size=n).tolist() for n in sizes]
+
+
+def _streams(served):
+    return [(list(s), getattr(s, "status", "ok")) for s in served]
+
+
+def _pair(params, cfg=CFG, fused=FUSED, **kwargs):
+    unfused = ContinuousBatcher(cfg, params, max_batch=2, prefill_width=8,
+                                **PAGED, **kwargs)
+    got = ContinuousBatcher(fused, params, max_batch=2, prefill_width=8,
+                            **PAGED, **kwargs)
+    return unfused, got
+
+
+# -- config surface --------------------------------------------------------
+
+
+def test_fused_config_validation():
+    with pytest.raises(ValueError, match="decode_impl"):
+        LlamaConfig(decode_impl="fusedd")
+    # the fused step does not serve the seq-sharded distributed merge
+    with pytest.raises(ValueError, match="decode_seq_shards"):
+        LlamaConfig(ctx_size=256, decode_seq_shards=2, decode_impl="fused")
+
+
+# -- kernel unit oracle ----------------------------------------------------
+
+
+def test_fused_step_kernel_matches_reference():
+    """argmax (ties, NaN rows, all -inf), scatter, and advance all equal
+    the unfused jnp formulation, leaf for leaf and bit for bit."""
+    B, V, page, nt, Hkv, hd = 4, 13, 8, 3, 2, 5
+    nr_pages = B * nt + 1
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((B, V)).astype(np.float32)
+    logits[1, 3] = logits[1, 9] = logits[1].max() + 1.0   # exact tie
+    logits[2, :] = np.nan                                 # quarantined lane
+    logits[3, :5] = np.nan                                # first-NaN wins
+    pool = {
+        "k": rng.standard_normal((nr_pages, page, Hkv, hd)).astype(
+            np.float32),
+        "s": rng.standard_normal((nr_pages, page, Hkv)).astype(np.float32),
+        "q8": rng.integers(-127, 127, (nr_pages, page, Hkv, hd)).astype(
+            np.int8),
+    }
+    pending = {
+        "k": rng.standard_normal((B, Hkv, hd)).astype(np.float32),
+        "s": rng.standard_normal((B, Hkv)).astype(np.float32),
+        "q8": rng.integers(-127, 127, (B, Hkv, hd)).astype(np.int8),
+    }
+    tables = rng.permutation(B * nt).reshape(B, nt).astype(np.int32) + 1
+    tables[2] = 0                                         # freed lane
+    pos = np.asarray([0, 7, 13, 22], np.int32)
+    toks, new_pool, new_pos = fused_decode_step(
+        jnp.asarray(logits), jax.tree.map(jnp.asarray, pool),
+        jax.tree.map(jnp.asarray, pending), jnp.asarray(tables),
+        jnp.asarray(pos), interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(toks), np.argmax(logits, axis=-1))
+    np.testing.assert_array_equal(np.asarray(new_pos), pos + 1)
+    rows = np.arange(B)
+    phys = tables[rows, pos // page]
+    for name, leaf in pool.items():
+        want = leaf.copy()
+        want[phys, pos % page] = pending[name]
+        np.testing.assert_array_equal(np.asarray(new_pool[name]), want)
+
+
+def test_fused_step_untouched_pages_survive_aliasing():
+    """Pages other than the one holding each row's slot pass through the
+    input/output alias unmodified — the kernel never copies them."""
+    B, V, page, nt = 2, 5, 4, 4
+    rng = np.random.default_rng(1)
+    pool = {"k": rng.standard_normal((B * nt + 1, page, 3)).astype(
+        np.float32)}
+    pending = {"k": rng.standard_normal((B, 3)).astype(np.float32)}
+    tables = np.arange(B * nt).reshape(B, nt).astype(np.int32) + 1
+    pos = np.asarray([5, 14], np.int32)
+    _, new_pool, _ = fused_decode_step(
+        jnp.asarray(rng.standard_normal((B, V)).astype(np.float32)),
+        jax.tree.map(jnp.asarray, pool),
+        jax.tree.map(jnp.asarray, pending),
+        jnp.asarray(tables), jnp.asarray(pos), interpret=True)
+    got = np.asarray(new_pool["k"])
+    touched = set(tables[np.arange(B), pos // page])
+    for p in range(B * nt + 1):
+        if p not in touched:
+            np.testing.assert_array_equal(got[p], pool["k"][p])
+
+
+# -- flash-decode current-row substitution ---------------------------------
+
+
+def test_flash_decode_cur_row_substitution_matches_written_cache():
+    """The deferred-append operands reproduce the unfused read-back: a
+    cache WITH the row written equals a row-less cache + cur_k/cur_v,
+    bit for bit (same blocks, same online-softmax order)."""
+    from ddl25spring_tpu.ops.flash_decode import flash_decode_attention
+
+    B, S, Hq, Hkv, hd = 3, 64, 4, 2, 8
+    ks = jax.random.split(jax.random.key(2), 5)
+    q = jax.random.normal(ks[0], (B, Hq, hd))
+    ck = jax.random.normal(ks[1], (B, S, Hkv, hd))
+    cv = jax.random.normal(ks[2], (B, S, Hkv, hd))
+    cur_k = jax.random.normal(ks[3], (B, Hkv, hd))
+    cur_v = jax.random.normal(ks[4], (B, Hkv, hd))
+    pos = jnp.asarray([0, 17, S - 1])
+    pad = jnp.asarray([0, 3, 10])
+    rows = jnp.arange(B)
+    full_k = ck.at[rows, pos].set(cur_k)
+    full_v = cv.at[rows, pos].set(cur_v)
+    want = flash_decode_attention(q, full_k, full_v, pos, pad,
+                                  interpret=True)
+    # the cache operand holds GARBAGE at the current slot: substitution
+    # must fully mask it out
+    hole_k = ck.at[rows, pos].set(jnp.nan)
+    hole_v = cv.at[rows, pos].set(jnp.nan)
+    got = flash_decode_attention(q, hole_k, hole_v, pos, pad,
+                                 cur_k=cur_k, cur_v=cur_v, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_flash_decode_cur_row_substitution_int8():
+    from ddl25spring_tpu.ops.flash_decode import flash_decode_attention
+
+    B, S, Hq, Hkv, hd = 2, 32, 4, 2, 8
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((B, Hq, hd)), jnp.float32)
+    ck = jnp.asarray(rng.integers(-127, 127, (B, S, Hkv, hd)), jnp.int8)
+    cv = jnp.asarray(rng.integers(-127, 127, (B, S, Hkv, hd)), jnp.int8)
+    ks = jnp.asarray(rng.random((B, S, Hkv)) + 0.1, jnp.float32)
+    vs = jnp.asarray(rng.random((B, S, Hkv)) + 0.1, jnp.float32)
+    cur_k = jnp.asarray(rng.integers(-127, 127, (B, Hkv, hd)), jnp.int8)
+    cur_v = jnp.asarray(rng.integers(-127, 127, (B, Hkv, hd)), jnp.int8)
+    cur_ks = jnp.asarray(rng.random((B, Hkv)) + 0.1, jnp.float32)
+    cur_vs = jnp.asarray(rng.random((B, Hkv)) + 0.1, jnp.float32)
+    pos = jnp.asarray([5, 20])
+    rows = jnp.arange(B)
+    want = flash_decode_attention(
+        q, ck.at[rows, pos].set(cur_k), cv.at[rows, pos].set(cur_v), pos,
+        cache_k_scale=ks.at[rows, pos].set(cur_ks),
+        cache_v_scale=vs.at[rows, pos].set(cur_vs), interpret=True)
+    got = flash_decode_attention(
+        q, ck, cv, pos, cache_k_scale=ks, cache_v_scale=vs,
+        cur_k=cur_k, cur_v=cur_v, cur_k_scale=cur_ks, cur_v_scale=cur_vs,
+        interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    with pytest.raises(ValueError, match="cur"):
+        flash_decode_attention(q, ck, cv, pos, cache_k_scale=ks,
+                               cache_v_scale=vs, cur_k=cur_k, cur_v=cur_v,
+                               interpret=True)
+
+
+# -- end-to-end bit-identity across the paged serving matrix ---------------
+
+
+def test_fused_matches_unfused_staggered(setup):
+    unfused, fused = _pair(setup)
+    prompts = _prompts()
+    want = unfused.run(prompts, 6)
+    got = fused.run(prompts, 6)
+    assert _streams(got) == _streams(want)
+    assert fused._pool.pages_in_use == 0
+
+
+def test_fused_matches_unfused_eos_chunked(setup):
+    unfused, fused = _pair(setup, eos_id=5, decode_chunk=4)
+    prompts = _prompts()
+    budgets = [9, 4, 7, 6, 8]
+    assert _streams(fused.run(prompts, budgets)) == \
+        _streams(unfused.run(prompts, budgets))
+
+
+def test_fused_matches_unfused_int8(setup):
+    cfg8 = dataclasses.replace(CFG, kv_cache_int8=True)
+    f8 = dataclasses.replace(cfg8, decode_impl="fused")
+    unfused, fused = _pair(setup, cfg=cfg8, fused=f8)
+    prompts = _prompts()
+    assert _streams(fused.run(prompts, 5)) == \
+        _streams(unfused.run(prompts, 5))
+
+
+def test_fused_matches_unfused_deadline_eviction(setup):
+    unfused, fused = _pair(setup)
+    prompts = _prompts()
+    want = unfused.run(prompts, 6, deadline_s=1e-9)
+    got = fused.run(prompts, 6, deadline_s=1e-9)
+    assert _streams(got) == _streams(want)
+    assert all(s == "timed_out" for _, s in _streams(got))
+
+
+def test_fused_matches_unfused_poison_quarantine(setup):
+    poisoned = jax.tree_util.tree_map_with_path(
+        lambda kp, leaf: leaf.at[0, 0].set(jnp.nan)
+        if "lm_head" in jax.tree_util.keystr(kp) else leaf, setup)
+    unfused, fused = _pair(poisoned, poison_guard=True, eos_id=96)
+    prompts = _prompts()
+    want = unfused.run(prompts, 6)
+    got = fused.run(prompts, 6)
+    assert _streams(got) == _streams(want)
+    assert all(s == "poisoned" for _, s in _streams(got))

@@ -18,6 +18,9 @@ Cells and their direction:
 - ``kernels.*.achieved_gbps`` higher / ``kernels.*.ms`` lower better;
 - ``krum_agg.ms`` — lower better;
 - ``cohort_scaling.rounds_per_sec.*`` — higher better;
+- ``overlap_combine.rounds_per_sec`` / ``fused_decode_step.steps_per_sec``
+  — higher better (the overlapped ring combine and the one-Pallas-program
+  serving inner step);
 - ``serving_saturation`` / ``fleet_routing`` ``probe_goodput_rps`` and
   ``knee_qps`` — higher better;
 - ``fleet_chaos.goodput_retention`` — higher better;
@@ -45,6 +48,8 @@ _SCALAR_CELLS = (
     ("value", True),
     ("final_test_accuracy_pct", True),
     ("krum_agg.ms", False),
+    ("overlap_combine.rounds_per_sec", True),
+    ("fused_decode_step.steps_per_sec", True),
     ("serving_saturation.probe_goodput_rps", True),
     ("serving_saturation.knee_qps", True),
     ("fleet_routing.probe_goodput_rps", True),

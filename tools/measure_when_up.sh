@@ -46,6 +46,33 @@ EOF
     fi
     python tools/tpu_trend.py --bench results/bench_tpu_lean.json \
       >> "$LOG" 2>&1
+    # ---- drain queued captures: bench argvs that failed against a dead
+    # tunnel (bench.py's _queue_pending_capture appends one line per
+    # device-unreachable run).  Rename-then-drain so a capture that dies
+    # mid-drain re-queues itself into a fresh file instead of looping.
+    if [ -s results/pending_captures.jsonl ]; then
+      mv results/pending_captures.jsonl results/pending_captures.draining
+      QN=0
+      while IFS= read -r line; do
+        QN=$((QN+1))
+        if ! printf '%s' "$line" | \
+             python -c 'import json,sys; json.load(sys.stdin)' \
+             >/dev/null 2>&1; then
+          echo "$(date +%H:%M:%S) queued capture $QN malformed — skipped" \
+            >> "$LOG"
+          continue
+        fi
+        mapfile -t QARGS < <(printf '%s' "$line" | python -c \
+          'import json,sys
+for a in json.load(sys.stdin)["argv"]:
+    print(a)')
+        timeout 1800 python bench.py "${QARGS[@]}" \
+          > "results/bench_requeued_$QN.json" 2>> "$LOG"; rc=$?
+        echo "$(date +%H:%M:%S) queued capture $QN re-run (exit $rc):" \
+          "${QARGS[*]}" >> "$LOG"
+      done < results/pending_captures.draining
+      rm -f results/pending_captures.draining
+    fi
     # per-run failure marker (grepping the append-only LOG would match
     # stale failures from previous sentinel runs)
     SERVING_FAIL=$(mktemp)

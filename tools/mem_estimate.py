@@ -40,7 +40,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # --cohort-shard / --tp-kv compile SPMD programs over several virtual CPU
 # devices; the flag must land in XLA_FLAGS BEFORE the backend initialises
-if "--cohort-shard" in sys.argv or "--tp-kv" in sys.argv:
+if "--cohort-shard" in sys.argv or "--tp-kv" in sys.argv \
+        or "--overlap" in sys.argv:
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
@@ -56,7 +57,7 @@ import jax.numpy as jnp  # noqa: E402
 
 
 def _tiny_mlp_round(nr_clients: int, nr_sampled: int, chunk: int,
-                    mesh=None):
+                    mesh=None, overlap: bool = False):
     """A deliberately small FL round (logistic regression, synthetic data)
     whose compile time is seconds — enough to show the stack-vs-chunk
     scaling because the update-stack bytes dominate the tiny params."""
@@ -76,7 +77,8 @@ def _tiny_mlp_round(nr_clients: int, nr_sampled: int, chunk: int,
     update = make_local_sgd_update(loss_fn, 0.05, bs, 1)
     rf = make_fl_round(update, x, y, counts, nr_sampled=nr_sampled,
                        device_put_data=False, client_chunk=chunk,
-                       donate=mesh is None, mesh=mesh)
+                       donate=mesh is None, mesh=mesh,
+                       overlap_combine=overlap)
     params = {"w": jax.ShapeDtypeStruct((d, k), jnp.float32),
               "b": jax.ShapeDtypeStruct((k,), jnp.float32)}
     return rf, params
@@ -491,6 +493,60 @@ def cohort_shard_estimate(nr_clients: int, nr_sampled: int, chunk: int,
     return {"cells": cells, "zero_server": zero_rows}
 
 
+def overlap_estimate(nr_clients: int, nr_sampled: int, chunk: int,
+                     worlds) -> dict:
+    """AOT memory of the OVERLAPPED sharded round (``overlap_combine=True``
+    — a ring partial combine per client chunk, fl/sharding.ring_all_reduce,
+    instead of one end-of-round psum) next to the plain sharded round at
+    each world size W.  The check that hiding the combine does not COST
+    memory: the ring's in-flight send/recv buffers are sized by one
+    param-tree shard, so per-device temp bytes must stay within 2x of the
+    plain sharded round's (asserted below) — plus the host-side ppermute
+    wire signature (2·(W-1)/W of the payload per combine) that
+    ``instrument_collectives`` accounts."""
+    from ddl25spring_tpu.fl.engine import _tree_bytes
+    from ddl25spring_tpu.fl.sharding import ppermute_signature
+    from ddl25spring_tpu.parallel import make_mesh
+
+    nr_devices = len(jax.devices())
+    worlds = [w for w in worlds if w <= nr_devices]
+    d, k = 64, 10
+    params = {"w": jax.ShapeDtypeStruct((d, k), jnp.float32),
+              "b": jax.ShapeDtypeStruct((k,), jnp.float32)}
+    rows = []
+    for w in worlds:
+        mesh = make_mesh({"clients": w}, devices=jax.devices()[:w])
+        plain = estimate(
+            lambda c: _tiny_mlp_round(nr_clients, nr_sampled, c,
+                                      mesh=mesh), chunk)
+        ov = estimate(
+            lambda c: _tiny_mlp_round(nr_clients, nr_sampled, c,
+                                      mesh=mesh, overlap=True), chunk)
+        nr_combines = max(1, (chunk and nr_sampled // w // chunk) or 1)
+        (_, nr_ppermutes, wire_bytes), = ppermute_signature(
+            params, world=w, nr_combines=nr_combines)
+        rows.append({
+            "world": w,
+            "temp_bytes_plain": plain["temp_bytes"],
+            "temp_bytes_overlap": ov["temp_bytes"],
+            "argument_bytes_plain": plain["argument_bytes"],
+            "argument_bytes_overlap": ov["argument_bytes"],
+            "nr_ppermutes": nr_ppermutes,
+            "ppermute_wire_bytes": wire_bytes,
+        })
+        # the ring must not balloon the compiled program: its buffers are
+        # shard-sized, so a large multiple here is a regression, not noise
+        # (small absolute slack floor: the tiny model's temp bytes are KBs
+        # and layout rounding alone can double them)
+        assert (ov["temp_bytes"]
+                <= 2 * plain["temp_bytes"] + (1 << 20)), (
+            f"overlapped round temp bytes at W={w} "
+            f"({ov['temp_bytes']:,} B) exceed 2x the plain sharded "
+            f"round's ({plain['temp_bytes']:,} B) + 1 MiB slack"
+        )
+    return {"chunk": chunk, "cells": rows}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--target", default="cpu",
@@ -518,6 +574,12 @@ def main(argv=None) -> int:
                          "across --worlds (virtual CPU devices), plus the "
                          "ZeRO server-optimizer per-replica footprint; "
                          "asserts the ~Wx drops at W=4")
+    ap.add_argument("--overlap", action="store_true",
+                    help="estimate the OVERLAPPED sharded round instead "
+                         "(overlap_combine=True: per-chunk ring combines) "
+                         "vs the plain sharded round across --worlds; "
+                         "asserts the ring stays within 2x plain temp "
+                         "bytes and reports the ppermute wire signature")
     ap.add_argument("--kv-pages", action="store_true",
                     help="estimate the serving decode's resident-KV bytes "
                          "instead: contiguous (max_batch, ctx) cache vs "
@@ -571,6 +633,23 @@ def main(argv=None) -> int:
                   file=sys.stderr)
         print(json.dumps({
             "metric": "cohort_shard_memory_estimate",
+            "target": args.target,
+            **out,
+        }))
+        return 0
+
+    if args.overlap:
+        worlds = [int(w) for w in args.worlds.split(",") if w.strip()]
+        out = overlap_estimate(args.clients, args.sampled, args.chunk,
+                               worlds)
+        for r in out["cells"]:
+            print(f"  W={r['world']}: temp plain "
+                  f"{r['temp_bytes_plain']:>12,} B   overlap "
+                  f"{r['temp_bytes_overlap']:>12,} B   "
+                  f"ppermutes {r['nr_ppermutes']:>4}   wire "
+                  f"{r['ppermute_wire_bytes']:>8,} B", file=sys.stderr)
+        print(json.dumps({
+            "metric": "overlap_memory_estimate",
             "target": args.target,
             **out,
         }))

@@ -429,6 +429,8 @@ def report(events: list[dict], top: int) -> None:
     take(counters, "serving_prefix_hit_tokens_total")
     pages = _pick(gauges, "serving_kv_pages_in_use")
     take(gauges, "serving_kv_pages_in_use")
+    fused_steps = _value(counters, "serving_fused_decode_steps_total")
+    take(counters, "serving_fused_decode_steps_total")
     reject_reasons = take(counters, "serving_reject_reason_total")
     if (nr_req is not None or req_hist or reject_reasons
             or pfx_hits is not None or pages):
@@ -472,6 +474,9 @@ def report(events: list[dict], top: int) -> None:
             snap = pages[0][1]
             print(f"  kv pages in use: last {snap['value']:.0f}   "
                   f"peak {snap.get('max', snap['value']):.0f}")
+        if fused_steps is not None:
+            print(f"  fused decode steps (one-Pallas-program inner "
+                  f"loop): {fused_steps}")
         if reject_reasons:
             parts = "   ".join(
                 f"{labels.get('reason', '?')}={state['value']}"
@@ -678,8 +683,11 @@ def report(events: list[dict], top: int) -> None:
     fl_stack_pr = _value(gauges, "fl_update_stack_bytes_per_replica")
     fl_zero_w = _value(gauges, "fl_zero_server_world")
     fl_opt_pr = _value(gauges, "fl_server_opt_bytes_per_replica")
+    fl_overlap = _value(counters, "fl_overlap_combine_chunks_total")
+    fl_feed_hist = take(hists, "fl_prefetch_wait_seconds")
     for n in ("fl_rounds_total", "fl_clients_sampled_total",
-              "fl_bytes_aggregated_total"):
+              "fl_bytes_aggregated_total",
+              "fl_overlap_combine_chunks_total"):
         take(counters, n)
     for n in ("fl_clients_per_round", "fl_aggregator_dist_bytes",
               "fl_cohort_shard_size", "fl_update_stack_bytes_per_replica",
@@ -707,6 +715,15 @@ def report(events: list[dict], top: int) -> None:
                 line += (f"   optimizer state/replica: "
                          f"{fmt_bytes(fl_opt_pr)}")
             print(line)
+        if fl_overlap is not None:
+            print(f"  overlapped combine: {fl_overlap:.0f} per-chunk "
+                  f"ring partials")
+        if fl_feed_hist:
+            h = fl_feed_hist[0][1]
+            print(f"  prefetch feed wait: count={h['count']} "
+                  f"mean={fmt_seconds(h['sum'] / max(h['count'], 1))} "
+                  f"p90={fmt_seconds(hist_quantile(h, 0.90))} "
+                  f"max={fmt_seconds(h['max'] or 0)}")
 
     # -- collectives -----------------------------------------------------
     coll_calls = take(counters, "collective_calls_total")
