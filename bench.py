@@ -1068,7 +1068,13 @@ def _fail_with_cpu_fallback(reason: str, args):
     the re-capture ticket, land the CPU-fallback trend, emit the one
     JSON line, exit nonzero."""
     obs.flush()
-    capture = _persist_partial_capture(reason, args)
+    fr = obs.flight()
+    flight_dump = None
+    if fr is not None:
+        p = fr.dump("probe_death", telemetry=obs.get(), detail=reason)
+        flight_dump = str(p) if p is not None else None
+    capture = _persist_partial_capture(reason, args,
+                                       flight_dump=flight_dump)
     queued = _queue_pending_capture(reason)
     trend: dict = {"error": "cpu fallback disabled"}
     if args.cpu_fallback_timeout_s > 0:
@@ -1296,6 +1302,10 @@ def main():
         obs.trace.ensure()  # adopt DDL25_TRACEPARENT or start a new trace
         from ddl25spring_tpu.obs import watchdog as obs_watchdog
         obs_watchdog.install()
+        # black box for the probe-death path: recent events dump next to
+        # bench_partial_capture.json when the device never comes up
+        obs.install_flight(out_dir=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "results"))
         _stamp(f"telemetry -> {args.telemetry} "
                f"(trace {obs.trace.trace_id()})")
 

@@ -37,6 +37,9 @@ HOST_ONLY_MODULES = (
     # windowed telemetry plane (ring-buffer series + burn-rate monitors)
     "ddl25spring_tpu.obs.timeseries",
     "ddl25spring_tpu.obs.slo",
+    # request traces + crash flight recorder (postmortems run anywhere)
+    "ddl25spring_tpu.obs.reqtrace",
+    "ddl25spring_tpu.obs.flight",
     # host-side secure-aggregation accounting (Shamir, field budgets,
     # session bookkeeping — the jnp mask math lives in masks/kernels)
     "ddl25spring_tpu.secagg",

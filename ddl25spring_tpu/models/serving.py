@@ -659,24 +659,43 @@ class ContinuousBatcher:
 
     def _obs_admitted(self, admissions):
         """Queue-wait per admitted request: admission is when a request
-        stops waiting and starts occupying a lane."""
+        stops waiting and starts occupying a lane.  The wait histogram
+        carries the request's trace id as its exemplar, so a burning
+        queue-wait SLO window links straight to offending traces."""
         if not self._req_ts:
             return
+        rt = obs.reqtrace()
         now = time.perf_counter()
         for _s, rid, _p, _b in admissions:
             t0 = self._req_ts.get(rid)
-            if t0 is not None:
-                obs.observe("serving_queue_wait_seconds", now - t0)
+            if t0 is None:
+                continue
+            wait = now - t0
+            obs.observe("serving_queue_wait_seconds", wait,
+                        exemplar=(rt.trace_id_of(rid)
+                                  if rt is not None else None))
+            if rt is not None:
+                rt.note(rid, "admit",
+                        replica=getattr(self, "_replica_ix", None),
+                        seconds=wait)
 
     def _obs_finish(self, rids):
         """Request latency at the moment tokens became host-visible."""
         if not self._req_ts:
             return
+        rt = obs.reqtrace()
         now = time.perf_counter()
         for rid in rids:
             t0 = self._req_ts.pop(rid, None)
-            if t0 is not None:
-                obs.observe("serving_request_seconds", now - t0)
+            if t0 is None:
+                continue
+            obs.observe("serving_request_seconds", now - t0,
+                        exemplar=(rt.trace_id_of(rid)
+                                  if rt is not None else None))
+            if rt is not None:
+                rt.note(rid, "finish",
+                        replica=getattr(self, "_replica_ix", None),
+                        seconds=now - t0)
 
     # -- paged-pool + prefix bookkeeping ---------------------------------
 
@@ -942,6 +961,11 @@ class ContinuousBatcher:
                 obs.inc("serving_timed_out_total")
                 obs.event("serving.timed_out", rid=repr(sl.request_id),
                           emitted=len(sl.emitted))
+                rt = obs.reqtrace()
+                if rt is not None:
+                    rt.note(sl.request_id, "timed_out",
+                            replica=getattr(self, "_replica_ix", None),
+                            emitted=len(sl.emitted))
                 self._deadlines.pop(sl.request_id, None)
                 self._release_pages(s)
                 self.slots[s] = _Slot()
@@ -980,6 +1004,11 @@ class ContinuousBatcher:
                                   self._pool.pages_in_use)
             obs.inc("serving_poisoned_total")
             obs.event("serving.poisoned", rid=repr(sl.request_id), slot=s)
+            rt = obs.reqtrace()
+            if rt is not None:
+                rt.note(sl.request_id, "poisoned",
+                        replica=getattr(self, "_replica_ix", None),
+                        emitted=len(sl.emitted))
             self._deadlines.pop(sl.request_id, None)
             self.slots[s] = _Slot()
         if rids:
@@ -1282,12 +1311,18 @@ class ContinuousBatcher:
             sl.emitted = [first_i]
             sl.done_eos = self.eos_id >= 0 and first_i == self.eos_id
 
-    def _sync_chunk_bookkeep(self, active, toks):
+    def _sync_chunk_bookkeep(self, active, toks, chunk_t0=None):
         """Fetch one decode chunk's tokens and append them to each active
-        slot up to its budget / EOS (host-int bookkeeping)."""
+        slot up to its budget / EOS (host-int bookkeeping).  ``chunk_t0``
+        (the dispatch-entry perf_counter, streaming path only) times the
+        whole chunk through its sync point here for request traces."""
         toks_host = jax.device_get(toks)
+        rt = obs.reqtrace()
+        secs = (time.perf_counter() - chunk_t0
+                if rt is not None and chunk_t0 is not None else 0.0)
         for s in active:
             sl = self.slots[s]
+            booked = 0
             for j in range(toks_host.shape[1]):
                 if sl.budget <= 0 or sl.done_eos:
                     break
@@ -1295,8 +1330,14 @@ class ContinuousBatcher:
                 tok = int(toks_host[s, j])
                 sl.emitted.append(tok)
                 sl.budget -= 1
+                booked += 1
                 if tok == self.eos_id:
                     sl.done_eos = True
+            if rt is not None and booked:
+                rt.note(sl.request_id, "decode",
+                        replica=getattr(self, "_replica_ix", None),
+                        seconds=secs, tokens=booked,
+                        emitted=len(sl.emitted))
 
     # -- streaming interface (requests arrive over time) ------------------
 
@@ -1359,8 +1400,13 @@ class ContinuousBatcher:
                     f"wait ~{wait:.3f}s, bound by {bound}); retry in "
                     f"~{retry_after:.3f}s", retry_after,
                 )
-        if obs.enabled():
+        rt = obs.reqtrace()
+        if obs.enabled() or rt is not None:
             self._req_ts[rid] = time.perf_counter()
+        if rt is not None:
+            rt.note(rid, "submit",
+                    replica=getattr(self, "_replica_ix", None),
+                    tokens=len(prompt), budget=budget)
         if deadline_s is not None:
             self._deadlines[rid] = float(deadline_s)
         if budget == 0:
@@ -1413,7 +1459,7 @@ class ContinuousBatcher:
                 active = [s for s in active if not self.slots[s].free]
             else:
                 toks = out
-            self._sync_chunk_bookkeep(active, toks)
+            self._sync_chunk_bookkeep(active, toks, chunk_t0=t_chunk)
             dt = time.perf_counter() - t_chunk
             self._chunk_s = (0.8 * self._chunk_s + 0.2 * dt
                              if self._chunk_s else dt)

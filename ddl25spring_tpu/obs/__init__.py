@@ -38,9 +38,12 @@ import sys
 import time
 from pathlib import Path
 
+from . import core as _core
 from . import trace
 from .core import (DEFAULT_BUCKETS, NULL_SPAN, Counter, Gauge, Histogram,
                    Telemetry)
+from .flight import FlightRecorder
+from .reqtrace import ReqTraceRecorder, RequestTrace
 from .slo import BurnRateMonitor, BurnWindows, SloSpec
 from .timeseries import HistogramRing, SeriesRing, TimeSeriesRecorder
 
@@ -49,8 +52,11 @@ __all__ = [
     "trace",
     "TimeSeriesRecorder", "SeriesRing", "HistogramRing",
     "BurnRateMonitor", "BurnWindows", "SloSpec",
+    "ReqTraceRecorder", "RequestTrace", "FlightRecorder",
     "enable", "disable", "enabled", "get",
     "install_recorder", "uninstall_recorder", "recorder", "monitors",
+    "install_reqtrace", "uninstall_reqtrace", "reqtrace",
+    "install_flight", "uninstall_flight", "flight",
     "record_samples",
     "span", "inc", "observe", "set_gauge", "event", "flush", "render_prom",
     "step_annotation",
@@ -59,6 +65,8 @@ __all__ = [
 _T: Telemetry | None = None
 _RECORDER: TimeSeriesRecorder | None = None
 _MONITORS: tuple = ()
+_REQTRACE: ReqTraceRecorder | None = None
+_FLIGHT: FlightRecorder | None = None
 
 
 class _JsonlSink:
@@ -149,6 +157,63 @@ def monitors() -> tuple:
     return _MONITORS
 
 
+def install_reqtrace(rt: ReqTraceRecorder | None = None, *,
+                     seed: int = 0) -> ReqTraceRecorder:
+    """Install the process-global request-trace recorder the serving /
+    fleet call sites feed (``obs.reqtrace()`` guards them — with none
+    installed, request tracing costs one global read and the serving
+    paths are bit-identical to an uninstrumented build).  The recorder
+    streams ``req.<phase>`` span events through the active registry, so
+    install AFTER :func:`enable` for JSONL output (structure is recorded
+    either way)."""
+    global _REQTRACE
+    if rt is None:
+        rt = ReqTraceRecorder(seed=seed)
+    rt._get_telemetry = get
+    _REQTRACE = rt
+    return rt
+
+
+def uninstall_reqtrace() -> None:
+    global _REQTRACE
+    _REQTRACE = None
+
+
+def reqtrace() -> ReqTraceRecorder | None:
+    """The installed request-trace recorder, or None — the single read
+    every instrumented call site guards on."""
+    return _REQTRACE
+
+
+def install_flight(fr: FlightRecorder | None = None, *,
+                   capacity: int = 256, out_dir="results") -> FlightRecorder:
+    """Install the process-global crash flight recorder: every telemetry
+    event tees into its bounded rings (via the registry event hook) and
+    ``fleet.replica_failed`` / breaker-open / burn-alert events dump the
+    black box to ``<out_dir>/flightrec_*.json``.  The installed
+    req-trace recorder (if any) is wired in as a dump source, so a dump
+    carries the failover chains of the requests it interrupted."""
+    global _FLIGHT
+    if fr is None:
+        fr = FlightRecorder(capacity, out_dir=out_dir)
+    fr.extra_sources["reqtrace"] = (
+        lambda: _REQTRACE.describe() if _REQTRACE is not None else {})
+    _core.add_event_hook(fr.on_event)
+    _FLIGHT = fr
+    return fr
+
+
+def uninstall_flight() -> None:
+    global _FLIGHT
+    if _FLIGHT is not None:
+        _core.remove_event_hook(_FLIGHT.on_event)
+    _FLIGHT = None
+
+
+def flight() -> FlightRecorder | None:
+    return _FLIGHT
+
+
 def record_samples() -> None:
     """Step hook: snapshot the installed recorder's tracked instruments
     and advance its burn-rate monitors.  A single ``is None`` check when
@@ -157,7 +222,11 @@ def record_samples() -> None:
     t, rec = _T, _RECORDER
     if t is None or rec is None:
         return
-    rec.sample(t)
+    step = rec.sample(t)
+    fr = _FLIGHT
+    if fr is not None:
+        fr.record("samples", "sample", step=step,
+                  values=rec.last_values())
     for m in _MONITORS:
         m.evaluate(t)
 
@@ -175,10 +244,13 @@ def inc(name: str, n=1, **labels):
         t.counter(name, **labels).inc(n)
 
 
-def observe(name: str, value, **labels):
+def observe(name: str, value, exemplar=None, **labels):
+    """Record one histogram observation; ``exemplar`` (a request trace
+    id in practice) is retained per bucket per window — the link a burn
+    alert follows back to offending traces."""
     t = _T
     if t is not None:
-        t.histogram(name, **labels).observe(value)
+        t.histogram(name, **labels).observe(value, exemplar)
 
 
 def set_gauge(name: str, value, **labels):

@@ -43,6 +43,25 @@ def remove_span_exit_hook(fn):
         pass
 
 
+# Hooks invoked for EVERY event the registry streams (flight recorders
+# teeing a black-box ring and checking dump triggers): ``fn(telemetry,
+# event, fields)``.  Same contract as the span-exit hooks — truthiness
+# guard on the hot path, exceptions swallowed.  Hooks run whether or not
+# a sink is attached, so a flight recorder works without a JSONL file.
+_EVENT_HOOKS: list = []
+
+
+def add_event_hook(fn):
+    _EVENT_HOOKS.append(fn)
+
+
+def remove_event_hook(fn):
+    try:
+        _EVENT_HOOKS.remove(fn)
+    except ValueError:
+        pass
+
+
 # Fixed log-spaced latency buckets: four per decade over [1 µs, 1000 s] —
 # wide enough for a single decode dispatch and a whole FL round alike, and
 # FIXED so histograms from different runs/processes are always mergeable.
@@ -105,17 +124,66 @@ class Gauge:
         return {"value": self.value, "max": self.max}
 
 
+class _ExemplarState:
+    """Per-bucket exemplars for one histogram: the max-value observation
+    and a seeded size-1 reservoir, per window (a window is the span
+    between two ``window_snapshot`` calls — the time-series recorder
+    snapshots at every sample) plus an all-time max that rides in the
+    aggregate snapshot.  The reservoir replacement rule is a blake2b
+    hash of ``(histogram name, bucket, nth observation)`` — uniform-ish
+    1/n replacement with NO RNG, so two seeded runs keep identical
+    exemplars (the determinism pass forbids wall clocks and unseeded
+    randomness in this module)."""
+
+    __slots__ = ("seed", "win_max", "win_res", "all_max", "_n")
+
+    def __init__(self, seed: str):
+        self.seed = seed
+        self.win_max: dict = {}    # bucket -> (value, exemplar id)
+        self.win_res: dict = {}    # bucket -> (value, exemplar id)
+        self.all_max: dict = {}    # bucket -> (value, exemplar id)
+        self._n: dict = {}         # bucket -> window observation count
+
+    def offer(self, bucket: int, v: float, eid) -> None:
+        cur = self.win_max.get(bucket)
+        if cur is None or v > cur[0]:
+            self.win_max[bucket] = (v, eid)
+        cur = self.all_max.get(bucket)
+        if cur is None or v > cur[0]:
+            self.all_max[bucket] = (v, eid)
+        n = self._n.get(bucket, 0) + 1
+        self._n[bucket] = n
+        if n == 1 or int(_trace._hash_hex(
+                f"{self.seed}:{bucket}:{n}", 4), 16) % n == 0:
+            self.win_res[bucket] = (v, eid)
+
+    def window_snapshot(self) -> dict:
+        """``{bucket: {"max": [v, id], "res": [v, id]}}`` for the window
+        just ended; resets the window state (all-time max persists)."""
+        out = {b: {"max": list(m), "res": list(self.win_res.get(b, m))}
+               for b, m in self.win_max.items()}
+        self.win_max = {}
+        self.win_res = {}
+        self._n = {}
+        return out
+
+
 class Histogram:
     """Fixed-bucket latency/size histogram (log-spaced by default).
 
     Stores per-bucket counts plus count/sum/min/max; :meth:`quantile`
     interpolates within the matched bucket (log-spaced buckets keep the
     relative error of that interpolation bounded by the bucket ratio,
-    ~1.78x at the default four-per-decade spacing)."""
+    ~1.78x at the default four-per-decade spacing).
+
+    ``observe(v, exemplar=...)`` additionally retains, per bucket per
+    window, the exemplar id (a request trace id in practice) of the
+    max-value and of one seeded-reservoir observation — the link from a
+    burning SLO window back to the concrete offending traces."""
 
     kind = "histogram"
     __slots__ = ("name", "labels", "bounds", "counts", "count", "total",
-                 "min", "max")
+                 "min", "max", "exemplars")
 
     def __init__(self, name: str, labels: dict, bounds=DEFAULT_BUCKETS):
         self.name = name
@@ -126,13 +194,20 @@ class Histogram:
         self.total = 0.0
         self.min = None
         self.max = None
+        self.exemplars: _ExemplarState | None = None
 
-    def observe(self, v: float):
-        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+    def observe(self, v: float, exemplar=None):
+        i = bisect.bisect_left(self.bounds, v)
+        self.counts[i] += 1
         self.count += 1
         self.total += v
         self.min = v if self.min is None else min(self.min, v)
         self.max = v if self.max is None else max(self.max, v)
+        if exemplar is not None:
+            ex = self.exemplars
+            if ex is None:
+                ex = self.exemplars = _ExemplarState(self.name)
+            ex.offer(i, v, exemplar)
 
     @property
     def mean(self) -> float:
@@ -153,16 +228,32 @@ class Histogram:
                 return lo + (hi - lo) * frac
         return self.max
 
+    def _bucket_key(self, i: int) -> str:
+        return "+Inf" if i == len(self.bounds) else repr(self.bounds[i])
+
+    def exemplar_window_snapshot(self) -> dict:
+        """Window exemplars keyed by bucket index, resetting the window
+        (what :class:`~ddl25spring_tpu.obs.timeseries.HistogramRing`
+        captures per sample); {} when exemplars were never offered."""
+        ex = self.exemplars
+        return ex.window_snapshot() if ex is not None else {}
+
     def snapshot(self):
-        return {
+        out = {
             "count": self.count, "sum": self.total,
             "min": self.min, "max": self.max,
             "buckets": {
                 # sparse: only non-empty buckets, keyed by upper bound
-                ("+Inf" if i == len(self.bounds) else repr(self.bounds[i])): c
+                self._bucket_key(i): c
                 for i, c in enumerate(self.counts) if c
             },
         }
+        if self.exemplars is not None and self.exemplars.all_max:
+            out["exemplars"] = {
+                self._bucket_key(b): [v, eid]
+                for b, (v, eid) in sorted(self.exemplars.all_max.items())
+            }
+        return out
 
 
 class _Span:
@@ -323,6 +414,12 @@ class Telemetry:
     def event(self, event: str, **fields):
         if self.sink is not None:
             self.sink.log(event, **fields)
+        if _EVENT_HOOKS:
+            for fn in list(_EVENT_HOOKS):
+                try:
+                    fn(self, event, fields)
+                except Exception:
+                    pass
 
     def span(self, name: str, **fields) -> _SpanCtx:
         """Context manager timing the enclosed block: wall time always

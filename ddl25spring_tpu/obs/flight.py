@@ -1,0 +1,155 @@
+"""Crash flight recorder: a bounded "black box" for the serving fleet.
+
+When a replica crashes its in-memory state vanishes — the breaker
+timeline says *that* it died, nothing says *what the fleet was doing*.
+The :class:`FlightRecorder` keeps bounded rings of recent activity and
+writes them to ``results/flightrec_*.json`` the moment something goes
+wrong, so a postmortem always has the last N events even when the
+process that produced them is gone.
+
+Channels (each an independent ring of ``capacity`` records):
+
+* ``events``     — every telemetry event, teed via the registry's
+  event hook (``obs.core.add_event_hook``) regardless of sink;
+* ``replica:<i>`` — the same events, routed by their ``replica`` field
+  (breaker transitions, failures, req-trace phases on that replica);
+* ``router``     — routing decisions the router records explicitly
+  (placements, re-routes, failovers, orphan re-placements);
+* ``samples``    — per-step last-values of the installed
+  :class:`~ddl25spring_tpu.obs.timeseries.TimeSeriesRecorder` series
+  (written by ``obs.record_samples``).
+
+Dump triggers (checked on every teed event):
+
+* ``fleet.replica_failed``                    -> ``replica_failed``
+* ``fleet.breaker`` with ``to == "open"``     -> ``breaker_open``
+* ``slo.burn`` with ``state == "burning"``    -> ``burn_alert``
+
+Each dump is one JSON file ``<prefix>_<n>_<reason>.json`` with the ring
+contents, the trigger, a registry snapshot and any extra sources wired
+in (``obs.install_flight`` adds the installed req-trace recorder's
+summary) — ``tools/obs_postmortem.py`` merges it with trace/metrics
+JSONL into a root-cause report.  Dump filenames are counter-sequenced,
+never wall-clock-derived, so seeded chaos runs dump to stable names.
+
+Stdlib-only and jax-import-free (``analysis/manifest.HOST_ONLY_MODULES``);
+never imports the :mod:`ddl25spring_tpu.obs` package root — the registry
+reaches it through the event hook and explicit ``telemetry=`` arguments.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import time
+from collections import deque
+from pathlib import Path
+
+__all__ = ["FlightRecorder"]
+
+# event -> (reason, field predicate) for automatic dumps
+_TRIGGERS = {
+    "fleet.replica_failed": ("replica_failed", None),
+    "fleet.breaker": ("breaker_open", ("to", "open")),
+    "slo.burn": ("burn_alert", ("state", "burning")),
+}
+
+
+class FlightRecorder:
+    """Bounded rings of recent fleet activity, dumped on crashes.
+
+    ``capacity`` bounds every channel independently; ``max_dumps``
+    bounds files written per process (a crash loop must not fill the
+    disk — suppressed dumps are counted, not written).  ``out_dir`` is
+    where dumps land (default ``results/``, created lazily).
+    """
+
+    def __init__(self, capacity: int = 256, *, out_dir="results",
+                 prefix: str = "flightrec", max_dumps: int = 16):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.out_dir = Path(out_dir)
+        self.prefix = prefix
+        self.max_dumps = max_dumps
+        self._channels: dict = {}
+        self._seq = itertools.count()
+        self._dump_seq = itertools.count()
+        self.dumps: list = []          # Paths written, in order
+        self.suppressed = 0            # dumps skipped past max_dumps
+        # name -> zero-arg callable returning a JSON-able payload,
+        # invoked at dump time (obs.install_flight wires "reqtrace")
+        self.extra_sources: dict = {}
+
+    # -- rings -----------------------------------------------------------
+
+    def channel(self, name: str) -> deque:
+        q = self._channels.get(name)
+        if q is None:
+            q = self._channels[name] = deque(maxlen=self.capacity)
+        return q
+
+    def record(self, channel: str, kind: str, **fields) -> dict:
+        """Append one record to ``channel``.  ``seq`` is a process-wide
+        monotone counter, so merged channels re-interleave exactly."""
+        rec = {"seq": next(self._seq), "kind": kind, **fields}
+        self.channel(channel).append(rec)
+        return rec
+
+    # -- event hook (wired by obs.install_flight) ------------------------
+
+    def on_event(self, telemetry, event: str, fields: dict) -> None:
+        """Tee one telemetry event into the rings and dump when it is a
+        trigger.  Called from ``Telemetry.event`` via the registry event
+        hook; exceptions are swallowed there, but keep this cheap —
+        every event pays it while a recorder is installed."""
+        if event == "telemetry_summary":
+            return                      # bulky, reconstructable from dump
+        rec = {"seq": next(self._seq), "kind": event, **fields}
+        self.channel("events").append(rec)
+        r = fields.get("replica")
+        if r is not None:
+            self.channel(f"replica:{r}").append(rec)
+        trig = _TRIGGERS.get(event)
+        if trig is not None:
+            reason, pred = trig
+            if pred is None or fields.get(pred[0]) == pred[1]:
+                self.dump(reason, telemetry=telemetry,
+                          trigger={"event": event, **fields})
+
+    # -- dumps -----------------------------------------------------------
+
+    def dump(self, reason: str, *, telemetry=None, **context) -> Path | None:
+        """Write the black box to ``<out_dir>/<prefix>_<n>_<reason>.json``
+        and return the path (None when ``max_dumps`` suppressed it)."""
+        if len(self.dumps) >= self.max_dumps:
+            self.suppressed += 1
+            return None
+        n = next(self._dump_seq)
+        payload = {
+            "reason": reason,
+            "dump_seq": n,
+            "ts": round(time.time(), 3),
+            "context": context,
+            "channels": {name: list(q)
+                         for name, q in sorted(self._channels.items())},
+        }
+        for name, fn in self.extra_sources.items():
+            try:
+                payload[name] = fn()
+            except Exception as e:      # a dump must never take the
+                payload[name] = {"error": repr(e)}  # program down with it
+        if telemetry is not None:
+            payload["summary"] = telemetry.snapshot()
+            telemetry.counter("flightrec_dumps_total", reason=reason).inc()
+        self.out_dir.mkdir(parents=True, exist_ok=True)
+        path = self.out_dir / f"{self.prefix}_{n:03d}_{reason}.json"
+        path.write_text(json.dumps(payload, indent=1, default=repr))
+        self.dumps.append(path)
+        return path
+
+    def describe(self) -> dict:
+        return {"channels": {n: len(q)
+                             for n, q in sorted(self._channels.items())},
+                "dumps": [str(p) for p in self.dumps],
+                "suppressed": self.suppressed}

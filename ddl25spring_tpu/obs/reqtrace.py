@@ -1,0 +1,204 @@
+"""Request-scoped traces: one deterministic waterfall per served request.
+
+Process/phase-scoped spans (:mod:`ddl25spring_tpu.obs.core`) time *code*;
+this module times *requests*: a :class:`RequestTrace` follows one rid
+through fleet placement (``serving_fleet/router.py``), disaggregated
+prefill staging (``serving_fleet/disagg.py``), admission, per-chunk
+decode and finish (``models/serving.py``), and — when a replica dies —
+the salvage/replay failover hops, recording each phase as one host-side
+event in a token-level timing waterfall.
+
+Id scheme (the blake2b construction from :mod:`ddl25spring_tpu.obs.trace`):
+
+* recorder root — ``blake2b("reqtrace:ddl25spring:{seed}")``, 32 hex;
+* per-request ``trace_id`` — ``blake2b("{root}:{rid!r}")``, 32 hex;
+* per-event ``span_id`` — ``blake2b("{trace_id}:{seq}")``, 16 hex, with a
+  per-request monotone ``seq``.
+
+All ids are therefore pure functions of (seed, rid, event order): two
+seeded runs that place/decode/fail-over identically produce bit-identical
+:meth:`ReqTraceRecorder.structure` — ids, event order, counts — while
+wall-clock fields (``t``, ``seconds``) are excluded from the structure
+view.  That is the contract ``tests/test_reqtrace.py`` replays.
+
+When telemetry is enabled the recorder also streams each phase as a
+``span`` event (name ``req.<phase>``) through the registry sink, tagging
+``process`` with the replica index that executed the phase — so
+``obs/export.py`` places each hop of a failed-over request on its own
+Perfetto track and draws flow arrows across the failover boundary, and
+``tools/obs_report.py`` / ``tools/obs_postmortem.py`` reconstruct
+waterfalls and failover chains from the same JSONL everything else uses.
+
+Stdlib-only and jax-import-free — transitively proven by the
+import-purity pass (``analysis/manifest.HOST_ONLY_MODULES``).  Never
+import the :mod:`ddl25spring_tpu.obs` package root from here (it imports
+this module); the registry is handed in by ``obs.install_reqtrace``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+
+from .trace import EPOCH0, _hash_hex
+
+__all__ = ["RequestTrace", "ReqTraceRecorder", "STRUCT_EXCLUDE"]
+
+# Wall-clock-derived event fields, excluded from the deterministic
+# structure view (everything else in an event must replay bit-identically).
+STRUCT_EXCLUDE = ("t", "seconds")
+
+
+class RequestTrace:
+    """The waterfall of one request: an ordered list of phase events.
+
+    Events are plain dicts — ``seq``/``phase``/``span_id``/``parent_id``
+    plus caller fields (``replica``, ``tokens``, ``chunk``, ``kind``...)
+    are deterministic; ``t`` (perf_counter at record time) and
+    ``seconds`` (phase duration, when the caller measured one) are the
+    wall-clock fields :meth:`structure` strips."""
+
+    __slots__ = ("rid", "trace_id", "events", "_seq", "_last_span")
+
+    def __init__(self, rid, trace_id: str):
+        self.rid = rid
+        self.trace_id = trace_id
+        self.events: list = []
+        self._seq = 0
+        self._last_span: str | None = None
+
+    def note(self, phase: str, *, seconds: float = 0.0, **fields) -> dict:
+        """Append one phase event; parent chains to the previous event so
+        exported spans form one flow across replicas/hops."""
+        seq = self._seq
+        self._seq += 1
+        span_id = _hash_hex(f"{self.trace_id}:{seq}", 8)
+        e = {"seq": seq, "phase": phase, "span_id": span_id,
+             "t": time.perf_counter(), "seconds": round(float(seconds), 6)}
+        if self._last_span is not None:
+            e["parent_id"] = self._last_span
+        for k, v in fields.items():
+            if v is not None:
+                e[k] = v
+        self._last_span = span_id
+        self.events.append(e)
+        return e
+
+    def structure(self) -> dict:
+        """The deterministic view: every event minus wall-clock fields."""
+        return {
+            "trace_id": self.trace_id,
+            "events": [{k: v for k, v in e.items()
+                        if k not in STRUCT_EXCLUDE}
+                       for e in self.events],
+        }
+
+    def waterfall(self) -> list:
+        """``(phase, offset_s, seconds, replica)`` rows relative to the
+        first event — the host-side rendering of the timing waterfall."""
+        if not self.events:
+            return []
+        t0 = self.events[0]["t"]
+        return [(e["phase"], round(e["t"] - t0, 6), e["seconds"],
+                 e.get("replica")) for e in self.events]
+
+
+class ReqTraceRecorder:
+    """Registry of :class:`RequestTrace` objects keyed by rid.
+
+    ``seed`` fixes the root hash every per-request trace id derives
+    from; ``capacity`` bounds retained traces (oldest-created evicted
+    first, so a long-lived service cannot leak memory through request
+    churn).  Install process-wide with ``obs.install_reqtrace`` — the
+    instrumented call sites all guard on ``obs.reqtrace() is None``, so
+    with no recorder installed tracing costs one global read and the
+    serving/routing paths are bit-identical to an uninstrumented build.
+    """
+
+    def __init__(self, seed: int = 0, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.seed = int(seed)
+        self.root = _hash_hex(f"reqtrace:ddl25spring:{self.seed}", 16)
+        self.capacity = capacity
+        self._traces: OrderedDict = OrderedDict()
+        # wired by obs.install_reqtrace to the module's registry getter;
+        # left None the recorder never streams (structure still records)
+        self._get_telemetry = None
+
+    # -- traces ----------------------------------------------------------
+
+    def trace(self, rid) -> RequestTrace:
+        """The trace for ``rid``, created on first touch (any phase may
+        be the first a recorder sees — e.g. installed mid-run)."""
+        tr = self._traces.get(rid)
+        if tr is None:
+            tid = _hash_hex(f"{self.root}:{rid!r}", 16)
+            tr = self._traces[rid] = RequestTrace(rid, tid)
+            while len(self._traces) > self.capacity:
+                self._traces.popitem(last=False)
+        return tr
+
+    def trace_id_of(self, rid) -> str:
+        """The deterministic trace id for ``rid`` (creates the trace) —
+        what histogram exemplars carry."""
+        return self.trace(rid).trace_id
+
+    def get(self, rid) -> RequestTrace | None:
+        return self._traces.get(rid)
+
+    def __len__(self) -> int:
+        return len(self._traces)
+
+    def traces(self) -> list:
+        return list(self._traces.values())
+
+    # -- recording -------------------------------------------------------
+
+    def note(self, rid, phase: str, *, replica=None, seconds: float = 0.0,
+             **fields) -> dict:
+        """Record one phase of ``rid``'s waterfall and (telemetry on)
+        stream it as a ``req.<phase>`` span event whose ``process`` is
+        the replica index — the track key Perfetto flow arrows need to
+        cross on failover hops."""
+        tr = self.trace(rid)
+        e = tr.note(phase, seconds=seconds,
+                    replica=None if replica is None else int(replica),
+                    **fields)
+        get = self._get_telemetry
+        t = get() if get is not None else None
+        if t is not None:
+            rec = {k: v for k, v in e.items() if k != "t"}
+            rec["name"] = f"req.{phase}"
+            rec["trace_id"] = tr.trace_id
+            rec["rid"] = repr(rid)
+            rec["process"] = int(replica) if replica is not None else 0
+            rec["start_ts"] = round(
+                EPOCH0 + e["t"] - e["seconds"], 6)
+            rec.pop("phase", None)
+            # the per-request event order survives into the JSONL (as
+            # "req_seq" — "seq" is the flight recorder's ring counter)
+            # so reports re-sort phases causally, not by wall clock
+            rec["req_seq"] = rec.pop("seq")
+            t.event("span", **rec)
+        return e
+
+    # -- export ----------------------------------------------------------
+
+    def structure(self) -> dict:
+        """Deterministic structure of EVERY retained trace, keyed by
+        ``repr(rid)`` — the bit-identity artifact two seeded runs
+        compare."""
+        return {repr(rid): tr.structure()
+                for rid, tr in self._traces.items()}
+
+    def describe(self) -> dict:
+        """JSON-able summary (used by flight-recorder dumps): per-rid
+        trace id, event count and the phases seen, in order."""
+        return {repr(rid): {
+            "trace_id": tr.trace_id,
+            "events": len(tr.events),
+            "phases": [e["phase"] for e in tr.events],
+            "replicas": sorted({e["replica"] for e in tr.events
+                                if e.get("replica") is not None}),
+        } for rid, tr in self._traces.items()}

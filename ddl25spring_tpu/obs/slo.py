@@ -87,7 +87,7 @@ class BurnRateMonitor:
     one alert, not one per sample."""
 
     def __init__(self, recorder: TimeSeriesRecorder, spec: SloSpec,
-                 windows=(BurnWindows(),)):
+                 windows=(BurnWindows(),), exemplar_source: str | None = None):
         self.recorder = recorder
         self.spec = spec
         self.windows = tuple(windows)
@@ -97,6 +97,13 @@ class BurnRateMonitor:
         self.history: list = []      # [(step, label, fast, slow, state)]
         self.alerts = 0
         self.first_alert_step: int | None = None
+        # Histogram whose window exemplars (request trace ids) ride on
+        # burn transitions: quantile SLOs default to their own source;
+        # ratio SLOs name a latency histogram explicitly (counters have
+        # no exemplars to link).
+        self.exemplar_source = exemplar_source or (
+            spec.source if spec.kind == "quantile" else None)
+        self.alert_exemplars: dict = {}  # (step, label) -> [trace ids]
 
     # -- bad-fraction sources --------------------------------------------
 
@@ -120,6 +127,19 @@ class BurnRateMonitor:
                     if isinstance(r, SeriesRing))
         return bad / total if total else 0.0
 
+    def _window_exemplars(self, window: int) -> list:
+        """Trace ids of the exemplar observations inside the burning
+        window, merged across the source histogram's label sets."""
+        if self.exemplar_source is None:
+            return []
+        out: list = []
+        for ring in self.recorder.matching(self.exemplar_source).values():
+            if isinstance(ring, HistogramRing):
+                for eid in ring.window_exemplars(window):
+                    if eid not in out:
+                        out.append(eid)
+        return out
+
     # -- evaluation ------------------------------------------------------
 
     def evaluate(self, telemetry=None) -> dict:
@@ -137,10 +157,13 @@ class BurnRateMonitor:
             state = "burning" if burning else "ok"
             prev = self._state[w.label]
             if state != prev:
+                exemplars: list = []
                 if burning:
                     self.alerts += 1
                     if self.first_alert_step is None:
                         self.first_alert_step = step
+                    exemplars = self._window_exemplars(w.fast)
+                    self.alert_exemplars[(step, w.label)] = exemplars
                     if telemetry is not None:
                         telemetry.counter("slo_burn_alerts_total",
                                           slo=self.spec.name,
@@ -149,7 +172,8 @@ class BurnRateMonitor:
                     telemetry.event("slo.burn", slo=self.spec.name,
                                     window=w.label, step=step, state=state,
                                     burn_fast=round(fast, 4),
-                                    burn_slow=round(slow, 4))
+                                    burn_slow=round(slow, 4),
+                                    exemplars=exemplars)
                 self.history.append((step, w.label, round(fast, 4),
                                      round(slow, 4), state))
             self._state[w.label] = state
@@ -168,7 +192,9 @@ class BurnRateMonitor:
             "state": dict(self._state),
             "transitions": [
                 {"step": s, "window": w, "burn_fast": f, "burn_slow": sl,
-                 "state": st}
+                 "state": st,
+                 **({"exemplars": self.alert_exemplars[(s, w)]}
+                    if (s, w) in self.alert_exemplars else {})}
                 for s, w, f, sl, st in self.history[-64:]
             ],
         }

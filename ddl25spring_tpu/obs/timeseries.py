@@ -100,9 +100,15 @@ class SeriesRing:
 class HistogramRing:
     """Ring of cumulative log-bucket snapshots for one histogram.
 
-    Each sample stores ``(step, counts, count, total)`` where ``counts``
-    is the full per-bucket tuple; windowed views difference two samples,
-    which recovers exactly the observations that landed between them."""
+    Each sample stores ``(step, counts, count, total, exemplars)`` where
+    ``counts`` is the full per-bucket tuple; windowed views difference
+    two samples, which recovers exactly the observations that landed
+    between them.  ``exemplars`` is the histogram's per-window exemplar
+    snapshot (max + seeded reservoir per bucket, {} when the histogram
+    never saw exemplar ids) — sampling CLOSES the histogram's exemplar
+    window, so each ring entry holds exactly the exemplars of its
+    inter-sample interval and :meth:`window_exemplars` can hand a burn
+    alert the trace ids of its bad window."""
 
     __slots__ = ("kind", "bounds", "_q")
 
@@ -115,17 +121,40 @@ class HistogramRing:
         if not self.bounds:
             self.bounds = hist.bounds
         self._q.append((int(step), tuple(hist.counts), hist.count,
-                        hist.total))
+                        hist.total, hist.exemplar_window_snapshot()))
 
     def __len__(self) -> int:
         return len(self._q)
 
     def steps(self) -> list:
-        return [s for s, _c, _n, _t in self._q]
+        return [item[0] for item in self._q]
 
     def counts_series(self) -> list:
         """Cumulative observation count at each sample."""
-        return [n for _s, _c, n, _t in self._q]
+        return [item[2] for item in self._q]
+
+    def window_exemplars(self, window: int | None = None) -> list:
+        """Exemplar ids observed inside the trailing ``window`` sample
+        intervals (the whole ring when None), most-extreme first: the
+        per-bucket max entries ordered by value descending, then the
+        reservoir picks, deduplicated preserving order."""
+        items = list(self._q)
+        if window is not None:
+            items = items[-max(1, int(window)):]
+        maxes: list = []
+        reservoir: list = []
+        for item in items:
+            for _b, entry in sorted(item[4].items()):
+                maxes.append(tuple(entry["max"]))
+                reservoir.append(entry["res"][1])
+        out: list = []
+        for _v, eid in sorted(maxes, key=lambda ve: -ve[0]):
+            if eid not in out:
+                out.append(eid)
+        for eid in reservoir:
+            if eid not in out:
+                out.append(eid)
+        return out
 
     def _window_pair(self, window):
         items = list(self._q)
@@ -284,6 +313,20 @@ class TimeSeriesRecorder:
 
     def keys(self) -> list:
         return sorted(_display(n, lk) for n, lk in self._series)
+
+    def last_values(self) -> dict:
+        """display-name -> latest sampled scalar (counters/gauges: the
+        value; histograms: the cumulative observation count) — the
+        compact per-step record the flight recorder's ``samples``
+        channel keeps."""
+        out: dict = {}
+        for (name, lk), ring in sorted(self._series.items()):
+            if isinstance(ring, HistogramRing):
+                items = list(ring._q)
+                out[_display(name, lk)] = items[-1][2] if items else 0
+            else:
+                out[_display(name, lk)] = ring.last()
+        return out
 
     def snapshot(self) -> dict:
         """JSON-able export: scalar series carry their raw values;

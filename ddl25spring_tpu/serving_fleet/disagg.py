@@ -152,11 +152,18 @@ class PrefillWorker:
         rows[0, :len(prompt)] = prompt
         lengths = np.asarray([len(prompt)], np.int32)
         copy_dst = np.asarray([pages], np.int32)
+        t0 = time.perf_counter()
         with obs.span("serving.prefill_offload", tokens=len(prompt)):
             b.cache, firsts, pads = self._prefill(
                 b.params, b.cache, jnp.asarray(rows),
                 jnp.asarray(lengths), jnp.asarray(copy_dst),
                 b._prefix_cache)
+        rt = obs.reqtrace()
+        if rt is not None:
+            rt.note(rid, "prefill",
+                    replica=getattr(b, "_replica_ix", None),
+                    seconds=time.perf_counter() - t0,
+                    tokens=len(prompt))
         key = self._key(self._seq, prompt)
         self._seq += 1
         b._registry.put(key, pages)  # registry takes the base reference

@@ -122,6 +122,7 @@ class FleetHealth:
         self.transitions[key] = self.transitions.get(key, 0) + 1
         obs.inc("fleet_breaker_transitions_total", replica=str(i),
                 to=state)
+        obs.event("fleet.breaker", replica=i, to=state, tick=self._ticks)
         if state == "open":
             h.opened_at = self._ticks
             h.canary = None

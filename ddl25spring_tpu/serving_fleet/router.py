@@ -158,6 +158,13 @@ class FleetRouter:
             raise ValueError(
                 f"affinity_cap must be >= 1, got {affinity_cap}")
         self.replicas = replicas
+        for i, r in enumerate(replicas):
+            try:
+                # request traces tag decode chunks with the replica that
+                # produced them; fake/frozen replicas may refuse the attr
+                r._replica_ix = i
+            except Exception:
+                pass
         self.max_reroutes = (len(replicas) - 1 if max_reroutes is None
                              else max_reroutes)
         self.affinity_window = affinity_window
@@ -301,6 +308,13 @@ class FleetRouter:
         self.stats["rerouted"] += len(rejections)
         self.stats["routed"] += 1
         obs.inc("fleet_routed_total", replica=str(ix))
+        rt = obs.reqtrace()
+        if rt is not None:
+            rt.note(rid, "placed", replica=ix, reroutes=len(rejections))
+        fr = obs.flight()
+        if fr is not None:
+            fr.record("router", "placed", rid=repr(rid), replica=ix,
+                      reroutes=len(rejections))
         self._note_affinity(head, ix)
         self._owner[rid] = ix
         self._requests[rid] = (tuple(int(t) for t in list(prompt)),
@@ -346,6 +360,13 @@ class FleetRouter:
                 status = getattr(toks, "status", None)
                 toks = (type(toks)(merged, status) if status is not None
                         else merged)
+            rt = obs.reqtrace()
+            if rt is not None:
+                # "deliver" (not "finish" — the batcher notes that): the
+                # stream as the CALLER sees it, salvage stitched back on
+                rt.note(rid, "deliver", replica=ix, tokens=len(toks),
+                        status=getattr(toks, "status", "ok"),
+                        stitched=len(sal) if sal else 0)
             res[rid] = toks
         return res
 
@@ -370,6 +391,7 @@ class FleetRouter:
             partials = getter()
         except Exception:
             partials = {}   # the host side died too; replay from 0
+        rt = obs.reqtrace()
         for rid, owner in list(self._owner.items()):
             if owner != ix:
                 continue
@@ -380,7 +402,20 @@ class FleetRouter:
             # the dying replica only ever streamed the post-salvage tail
             salvaged = (self._salvaged.pop(rid, [])
                         + [int(t) for t in partials.get(rid, ())])
+            if rt is not None:
+                rt.note(rid, "salvage", replica=ix, kind=kind,
+                        tokens=len(salvaged))
             self._orphans.append((rid, salvaged, kind))
+        fr = obs.flight()
+        if fr is not None:
+            fr.record("router", "failover", replica=ix, fault=kind,
+                      orphans=[repr(r) for r, _s, _k in self._orphans])
+        # the event (not just the counter) is what trips the flight
+        # recorder's dump — emit AFTER salvage so the dump carries the
+        # orphan set this failure created
+        obs.event("fleet.replica_failed", replica=ix, kind=kind,
+                  orphans=sum(1 for _r, _s, k in self._orphans
+                              if k == kind))
         return self._retry_orphans()
 
     def _retry_orphans(self) -> dict:
@@ -460,6 +495,16 @@ class FleetRouter:
                 if not _is_rejection(e):
                     raise
                 continue
+            rt = obs.reqtrace()
+            if rt is not None:
+                rt.note(rid, "replay", replica=ix,
+                        mode="continuation" if try_cont else "full",
+                        replayed=len(salvaged))
+            fr = obs.flight()
+            if fr is not None:
+                fr.record("router", "replay", rid=repr(rid), replica=ix,
+                          mode="continuation" if try_cont else "full",
+                          replayed=len(salvaged))
             self._owner[rid] = ix
             self.routing_trace.append((rid, ix))
             if self.health is not None:

@@ -1,0 +1,442 @@
+"""Request traces, exemplars and the crash flight recorder (obs/reqtrace,
+obs/flight, tools/obs_postmortem):
+
+- trace/span ids are pure blake2b functions of (seed, rid, event order),
+  so two seeded chaos runs produce bit-identical ``structure()`` (the
+  wall-clock fields ``t``/``seconds`` are excluded from that view),
+- with no recorder installed the instrumented serving paths are
+  bit-identical to an uninstrumented build — ServedTokens with the full
+  obs stack on equal ServedTokens with everything off,
+- histogram exemplars retain exactly the hand-walked max-latency
+  observation per bucket per window, and a burning SLO window hands its
+  alert the trace ids of the offending requests,
+- a seeded 3-replica chaos run (replica 0 crashes mid-stream) dumps the
+  flight-recorder black box, and ``tools/obs_postmortem.py`` merges dump
+  + JSONL into the failover chain of every interrupted request — with
+  the burn exemplar ids matching those requests' trace ids.
+"""
+
+import bisect
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ddl25spring_tpu import obs
+from ddl25spring_tpu.obs.reqtrace import ReqTraceRecorder
+from ddl25spring_tpu.obs.trace import _hash_hex
+from ddl25spring_tpu.resilience import FaultyReplica, ReplicaFaultSchedule
+from ddl25spring_tpu.serving_fleet import FleetHealth, FleetRouter
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def clean_obs():
+    """Uninstall every process-global obs hook, whatever the test did."""
+    yield
+    obs.uninstall_flight()
+    obs.uninstall_reqtrace()
+    obs.uninstall_recorder()
+    obs.disable()
+
+
+# -- deterministic ids ------------------------------------------------------
+
+
+def test_trace_ids_deterministic_and_span_chained():
+    a, b = ReqTraceRecorder(seed=5), ReqTraceRecorder(seed=5)
+    assert a.root == b.root
+    assert a.trace_id_of("req-1") == b.trace_id_of("req-1")
+    assert ReqTraceRecorder(seed=6).trace_id_of("req-1") != \
+        a.trace_id_of("req-1")
+    tr = a.trace("req-1")
+    e0 = tr.note("submit", tokens=3)
+    e1 = tr.note("decode", seconds=0.5, replica=2, tokens=1)
+    # span ids derive from (trace_id, seq); parents chain the waterfall
+    assert e1["span_id"] == _hash_hex(f"{tr.trace_id}:1", 8)
+    assert "parent_id" not in e0 and e1["parent_id"] == e0["span_id"]
+    # structure strips exactly the wall-clock fields, nothing else
+    for e in tr.structure()["events"]:
+        assert "t" not in e and "seconds" not in e
+        assert "span_id" in e and "phase" in e
+    wf = tr.waterfall()
+    assert [row[0] for row in wf] == ["submit", "decode"]
+    assert wf[1][2] == 0.5 and wf[1][3] == 2
+
+
+def test_recorder_capacity_evicts_oldest():
+    rt = ReqTraceRecorder(seed=0, capacity=2)
+    for rid in ("a", "b", "c"):
+        rt.note(rid, "placed", replica=0)
+    assert len(rt) == 2 and rt.get("a") is None
+    assert sorted(rt.structure()) == ["'b'", "'c'"]
+
+
+# -- chaos fakes (jax-free, copied shape from tests/test_serving_fleet) -----
+
+
+class _FakeSlot:
+    free = False
+
+    def __init__(self, rid, budget, ctx):
+        self.request_id = rid
+        self.budget = budget
+        self.ctx = list(ctx)
+        self.emitted = []
+
+
+class _StreamFake:
+    """Streaming fake replica: one token per active slot per step, a pure
+    function of the slot's full context — continuation submits provably
+    continue the original stream."""
+
+    def __init__(self, max_batch=2):
+        self.max_batch = max_batch
+        self.prefill_width = 64
+        self._queue = []
+        self.slots = []
+
+    @property
+    def in_flight(self):
+        return len(self._queue) + len(self.slots)
+
+    def submit(self, rid, prompt, budget, deadline_s=None):
+        self._queue.append((rid, list(prompt), int(budget)))
+
+    def step(self):
+        while self._queue and len(self.slots) < self.max_batch:
+            rid, prompt, b = self._queue.pop(0)
+            self.slots.append(_FakeSlot(rid, b, prompt))
+        done = {}
+        for sl in list(self.slots):
+            tok = (sum(sl.ctx) + 7 * len(sl.ctx)) % 997
+            sl.ctx.append(tok)
+            sl.emitted.append(tok)
+            if len(sl.emitted) >= sl.budget:
+                done[sl.request_id] = list(sl.emitted)
+                self.slots.remove(sl)
+        return done
+
+
+def _fake_stream(prompt, budget):
+    ctx = list(prompt)
+    out = []
+    for _ in range(budget):
+        tok = (sum(ctx) + 7 * len(ctx)) % 997
+        ctx.append(tok)
+        out.append(tok)
+    return out
+
+
+PROMPTS = [[11], [23, 5], [7, 7, 7], [41]]
+BUDGET = 6
+
+
+def _chaos_drain(seed):
+    """3 fake replicas, replica 0 crashes at step 2 with two requests
+    mid-stream; returns (structure, finished, victims)."""
+    sched = ReplicaFaultSchedule(crash_at=((0, 2),))
+    reps = [FaultyReplica(_StreamFake(), sched, i) for i in range(3)]
+    router = FleetRouter(reps)
+    rt = obs.install_reqtrace(seed=seed)
+    try:
+        for rid, p in enumerate(PROMPTS):
+            router.submit(rid, p, BUDGET)
+        victims = sorted(r for r, ix in router._owner.items() if ix == 0)
+        done = router.drain()
+    finally:
+        obs.uninstall_reqtrace()
+    return rt.structure(), done, victims
+
+
+def test_seeded_chaos_replay_structure_bit_identical(clean_obs):
+    s1, done1, victims = _chaos_drain(seed=7)
+    s2, done2, _ = _chaos_drain(seed=7)
+    assert s1 == s2                       # ids, order, fields — all of it
+    assert {r: list(t) for r, t in done1.items()} == \
+        {r: list(t) for r, t in done2.items()}
+    assert victims, "ranking should place something on replica 0"
+    # every interrupted request's trace records the full failover chain
+    for rid in victims:
+        phases = [e["phase"] for e in s1[repr(rid)]["events"]]
+        assert phases[0] == "placed" and phases[-1] == "deliver"
+        assert "salvage" in phases and "replay" in phases
+    # a different seed relabels every trace but keeps the event shapes
+    s3, _done3, _ = _chaos_drain(seed=8)
+    assert {k: v["trace_id"] for k, v in s1.items()} != \
+        {k: v["trace_id"] for k, v in s3.items()}
+    strip = (lambda s: {k: [{f: x for f, x in e.items()
+                             if f not in ("span_id", "parent_id")}
+                            for e in v["events"]] for k, v in s.items()})
+    assert strip(s1) == strip(s3)
+
+
+# -- tracing off must cost nothing ------------------------------------------
+
+
+def test_tracing_off_serving_fleet_bit_identical(tmp_path, clean_obs):
+    def run(traced):
+        if traced:
+            obs.enable(str(tmp_path / "telemetry.jsonl"))
+            obs.install_reqtrace(seed=1)
+            obs.install_flight(out_dir=tmp_path)
+        try:
+            sched = ReplicaFaultSchedule(crash_at=((0, 2),))
+            reps = [FaultyReplica(_StreamFake(), sched, i)
+                    for i in range(3)]
+            router = FleetRouter(reps, health=FleetHealth(3))
+            for rid, p in enumerate(PROMPTS):
+                router.submit(rid, p, BUDGET)
+            done = router.drain()
+            trace = list(router.routing_trace)
+        finally:
+            obs.uninstall_flight()
+            obs.uninstall_reqtrace()
+            obs.disable()
+        return ({rid: ([int(t) for t in toks],
+                       getattr(toks, "status", "ok"))
+                 for rid, toks in done.items()}, trace)
+
+    base_done, base_trace = run(traced=False)
+    obs_done, obs_trace = run(traced=True)
+    assert obs_done == base_done          # ServedTokens bit-identical
+    assert obs_trace == base_trace        # and every placement decision
+    for rid, p in enumerate(PROMPTS):     # both equal the no-chaos oracle
+        assert base_done[rid][0] == _fake_stream(p, BUDGET)
+
+
+def test_tracing_off_real_batcher_bit_identical(tmp_path, clean_obs):
+    # the instrumented serving sites (submit/admit/decode/finish in
+    # models/serving.py, prefill staging in serving_fleet/disagg.py) all
+    # guard on one global read — with the full obs stack on, the real
+    # batcher's ServedTokens stay bitwise equal to the untraced run
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+    from ddl25spring_tpu.serving_fleet import DisaggregatedBatcher
+
+    cfg = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                      nr_layers=2, ctx_size=48)
+    prompt = jnp.ones((1, 4), jnp.int32)
+    params = Llama(cfg).init(jax.random.PRNGKey(0), prompt,
+                             positions=jnp.arange(4))
+    prompts = [[3, 5, 7], [11, 13], [17, 19, 23, 29]]
+    budgets = [5, 4, 3]
+
+    def run(mk, traced):
+        if traced:
+            obs.enable(str(tmp_path / f"telemetry_{mk.__name__}.jsonl"))
+            obs.install_reqtrace(seed=2)
+            obs.install_flight(out_dir=tmp_path)
+        try:
+            b = mk()
+            for rid, (p, bud) in enumerate(zip(prompts, budgets)):
+                b.submit(rid, p, bud)
+            out = {}
+            while b.in_flight:
+                out.update(b.step())
+            if traced:
+                structure = obs.reqtrace().structure()
+            else:
+                structure = None
+        finally:
+            obs.uninstall_flight()
+            obs.uninstall_reqtrace()
+            obs.disable()
+        return ({rid: ([int(t) for t in toks],
+                       getattr(toks, "status", "ok"))
+                 for rid, toks in out.items()}, structure)
+
+    def base():
+        return ContinuousBatcher(cfg, params, max_batch=2,
+                                 prefill_width=8, kv_layout="paged",
+                                 kv_page=8)
+
+    def disagg():
+        return DisaggregatedBatcher(cfg, params, max_batch=2,
+                                    prefill_width=8, kv_page=8)
+
+    off, _ = run(base, traced=False)
+    on, structure = run(base, traced=True)
+    assert on == off
+    # every request's waterfall walked the full phase vocabulary
+    for rid in range(len(prompts)):
+        phases = [e["phase"] for e in structure[repr(rid)]["events"]]
+        assert phases[0] == "submit" and phases[-1] == "finish"
+        assert "admit" in phases and "decode" in phases
+    # disaggregated prefill additionally records the staging hop
+    d_off, _ = run(disagg, traced=False)
+    d_on, d_structure = run(disagg, traced=True)
+    assert d_on == d_off == off
+    assert any("prefill" in [e["phase"] for e in v["events"]]
+               for v in d_structure.values())
+
+
+# -- exemplars --------------------------------------------------------------
+
+
+def test_window_exemplars_match_hand_walked_max(clean_obs):
+    t = obs.enable()
+    rec = obs.TimeSeriesRecorder(capacity=32)
+    rec.track("lat_s")
+    obs.install_recorder(rec)
+    h = t.histogram("lat_s")
+    # window 1: forgettable observations, closed by the first sample
+    for k, v in enumerate([0.011, 0.012, 0.013]):
+        obs.observe("lat_s", v, exemplar=f"w1-{k}")
+    obs.record_samples()
+    # window 2: hand-walk the max-value observation per bucket
+    values = [0.09, 0.7, 0.013, 0.45, 0.012, 0.7]
+    win_max = {}
+    for k, v in enumerate(values):
+        eid = f"w2-{k}"
+        obs.observe("lat_s", v, exemplar=eid)
+        b = bisect.bisect_left(h.bounds, v)
+        if b not in win_max or v > win_max[b][0]:
+            win_max[b] = (v, eid)
+    obs.record_samples()
+    (ring,) = rec.matching("lat_s").values()
+    got = ring.window_exemplars(1)
+    # per-bucket maxima lead, ordered by value descending; the tie at
+    # 0.7 keeps the FIRST observation (strict > replacement)
+    lead = [eid for _v, eid in
+            sorted(win_max.values(), key=lambda ve: -ve[0])]
+    assert got[: len(lead)] == lead and got[0] == "w2-1"
+    # the sample closed window 1: none of its ids leak into window 2
+    assert not any(e.startswith("w1-") for e in got)
+    # the all-time max per bucket rides in the aggregate snapshot
+    snap = t.snapshot()["histogram"]["lat_s"]["exemplars"]
+    assert [0.7, "w2-1"] in [list(v) for v in snap.values()]
+
+
+# -- the acceptance scenario: chaos -> flight dump -> postmortem ------------
+
+
+def _load_postmortem():
+    spec = importlib.util.spec_from_file_location(
+        "obs_postmortem", REPO / "tools" / "obs_postmortem.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_flight_dump_postmortem_roundtrip(tmp_path, clean_obs):
+    jsonl = tmp_path / "telemetry.jsonl"
+    obs.enable(str(jsonl))
+    rt = obs.install_reqtrace(seed=11)
+    fr = obs.install_flight(out_dir=tmp_path)
+    rec = obs.TimeSeriesRecorder(capacity=64)
+    rec.track("serving_request_seconds")
+    mon = obs.BurnRateMonitor(
+        rec, obs.SloSpec(name="latency", objective=0.5, kind="quantile",
+                         source="serving_request_seconds",
+                         threshold_s=0.1),
+        windows=(obs.BurnWindows(fast=1, slow=2, threshold=1.0),))
+    obs.install_recorder(rec, monitors=(mon,))
+
+    sched = ReplicaFaultSchedule(crash_at=((0, 2),))
+    reps = [FaultyReplica(_StreamFake(), sched, i) for i in range(3)]
+    router = FleetRouter(reps, health=FleetHealth(3))
+    for rid, p in enumerate(PROMPTS):
+        router.submit(rid, p, BUDGET)
+    victims = sorted(r for r, ix in router._owner.items() if ix == 0)
+    assert victims
+    done, steps = {}, 0
+    while router.in_flight:
+        for rid, toks in router.step().items():
+            done[rid] = toks
+            # interrupted requests pay the replay tax: their end-to-end
+            # latency burns the 100ms SLO, clean requests never do.
+            # Distinct victim latencies land in distinct log buckets, so
+            # EACH victim is retained as its bucket's max exemplar.
+            obs.observe("serving_request_seconds",
+                        0.5 + 0.15 * victims.index(rid)
+                        if rid in victims else 0.02,
+                        exemplar=rt.trace_id_of(rid))
+        obs.record_samples()
+        steps += 1
+        assert steps < 100, "fleet failed to drain"
+    obs.flush()
+
+    # chaos exactness survives the full obs stack being on
+    assert sorted(done) == list(range(len(PROMPTS)))
+    for rid, p in enumerate(PROMPTS):
+        assert list(done[rid]) == _fake_stream(p, BUDGET)
+
+    # the black box dumped on every trigger class
+    reasons = {p.name.split("_", 2)[2].removesuffix(".json")
+               for p in fr.dumps}
+    assert {"replica_failed", "breaker_open", "burn_alert"} <= reasons
+    burn_keys = [k for k in mon.alert_exemplars]
+    assert burn_keys, "the victims' latencies must burn the SLO"
+    burn_ids = mon.alert_exemplars[burn_keys[0]]
+    assert {rt.trace_id_of(r) for r in victims} <= set(burn_ids)
+
+    # postmortem on the last dump + JSONL reconstructs the failover
+    # chain of every interrupted request
+    pm = _load_postmortem()
+    dump = pm.load_dump(fr.dumps[-1])
+    assert dump["reqtrace"]            # req-trace summary rode the dump
+    lines = []
+    digest = pm.report(dump, pm.load_jsonl([jsonl]), out=lines.append)
+    assert sorted(digest["interrupted"]) == [repr(r) for r in victims]
+    for rid in victims:
+        chain = digest["interrupted"][repr(rid)]
+        for phase in ("placed", "salvage", "replay", "deliver"):
+            assert phase in chain["phases"], (rid, chain)
+        # admitted at step 0, one token per step, crash at step 2
+        assert chain["replayed"] == 2
+        assert chain["trace_id"] == rt.trace_id_of(rid)
+    # trace ids in the report match the burning window's exemplar ids
+    assert set(digest["burn_exemplars"]) == set(burn_ids)
+    text = "\n".join(lines)
+    for rid in victims:
+        assert rt.trace_id_of(rid) in text
+
+    # the CLI renders the same incident from the files alone
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "obs_postmortem.py"),
+         str(fr.dumps[-1]), "--jsonl", str(jsonl)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert "failover chains" in proc.stdout
+    assert rt.trace_id_of(victims[0]) in proc.stdout
+
+
+def test_obs_postmortem_self_check():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "obs_postmortem.py"),
+         "--self-check"], capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "self-check ok" in proc.stdout
+
+
+# -- flight recorder mechanics ----------------------------------------------
+
+
+def test_flight_dump_bounded_and_sequenced(tmp_path, clean_obs):
+    t = obs.enable()
+    fr = obs.install_flight(capacity=4, out_dir=tmp_path)
+    for k in range(10):
+        obs.event("fleet.breaker", replica=0, to="suspect", tick=k)
+    assert len(fr.channel("events")) == 4       # ring, not a log
+    assert fr.channel("replica:0")              # routed by replica field
+    assert fr.dumps == []                       # suspect never triggers
+    p = fr.dump("probe_death", telemetry=t, detail="sigill")
+    assert p is not None and p.name == "flightrec_000_probe_death.json"
+    payload = json.loads(p.read_text())
+    assert payload["reason"] == "probe_death"
+    assert payload["context"]["detail"] == "sigill"
+    assert [r["tick"] for r in payload["channels"]["events"]] == \
+        [6, 7, 8, 9]
+    assert t.counter("flightrec_dumps_total",
+                     reason="probe_death").value == 1
+    # max_dumps bounds files written; suppression is counted, not fatal
+    fr.max_dumps = 1
+    assert fr.dump("probe_death") is None and fr.suppressed == 1

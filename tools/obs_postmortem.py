@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""Merge a flight-recorder dump with telemetry JSONL into a root-cause
+report.
+
+Usage::
+
+    python tools/obs_postmortem.py results/flightrec_000_replica_failed.json \
+        --jsonl results/telemetry.jsonl
+    python tools/obs_postmortem.py --self-check
+
+The report walks the incident in causal order: the FIRST burn alert
+(with the exemplar traces retained inside the burning window), the
+breaker timeline, and the failover chain of every interrupted request —
+which replica it was placed on, how many streamed tokens were salvaged
+when that replica died, where the continuation replayed, and what the
+caller finally received.  The dump's bounded rings cover the window the
+crashed process could no longer flush; the JSONL (when given) supplies
+the full history, and records present in both are de-duplicated by span
+id.
+
+``--self-check`` synthesizes a burn -> breaker-open -> replica-crash
+incident end to end (histogram exemplars, flight dump, req-trace
+failover phases), reports on it, and validates the result — the tier-1
+smoke (``tests/test_reqtrace.py``) that keeps this tool from rotting.
+Stdlib-only; never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import OrderedDict
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+# -- sources ---------------------------------------------------------------
+
+def load_dump(path) -> dict:
+    d = json.loads(Path(path).read_text())
+    for key in ("reason", "channels"):
+        if key not in d:
+            raise ValueError(f"{path} is not a flight-recorder dump "
+                             f"(missing {key!r})")
+    return d
+
+
+def load_jsonl(paths) -> list:
+    recs: list = []
+    for p in paths:
+        with open(p) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue        # torn tail line from a crash is fine
+    return recs
+
+
+def _iter_events(dump: dict, jsonl: list):
+    """Every event record from both sources in causal order (JSONL first:
+    it is the full history; the ring re-covers its tail).  Yields
+    ``(kind, fields)``."""
+    for rec in jsonl:
+        kind = rec.get("event")
+        if kind:
+            yield kind, rec
+    for rec in sorted(dump.get("channels", {}).get("events", ()),
+                      key=lambda r: r.get("seq", 0)):
+        kind = rec.get("kind")
+        if kind:
+            yield kind, rec
+
+
+def merge_req_events(dump: dict, jsonl: list) -> "OrderedDict":
+    """rid -> ordered ``req.<phase>`` span records, de-duplicated by
+    span id across the two sources."""
+    by_rid: OrderedDict = OrderedDict()
+    seen: set = set()
+    for kind, rec in _iter_events(dump, jsonl):
+        if kind != "span":
+            continue
+        name = str(rec.get("name", ""))
+        if not name.startswith("req."):
+            continue
+        sid = rec.get("span_id")
+        if sid in seen:
+            continue
+        seen.add(sid)
+        e = dict(rec)
+        e["phase"] = name[len("req."):]
+        by_rid.setdefault(rec.get("rid", "?"), []).append(e)
+    for evs in by_rid.values():
+        evs.sort(key=lambda e: e.get("req_seq", 0))
+    return by_rid
+
+
+def first_burn(dump: dict, jsonl: list) -> dict | None:
+    for kind, rec in _iter_events(dump, jsonl):
+        if kind == "slo.burn" and rec.get("state") == "burning":
+            return rec
+    return None
+
+
+def breaker_timeline(dump: dict, jsonl: list) -> list:
+    out: list = []
+    seen: set = set()
+    for kind, rec in _iter_events(dump, jsonl):
+        if kind not in ("fleet.breaker", "fleet.replica_failed"):
+            continue
+        key = (kind, rec.get("replica"), rec.get("to"), rec.get("tick"),
+               rec.get("kind"), rec.get("orphans"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append((kind, rec))
+    return out
+
+
+# -- report ----------------------------------------------------------------
+
+def _chain_row(e: dict) -> str:
+    phase = e["phase"]
+    at = f"@{e['replica']}" if e.get("replica") is not None else ""
+    detail = []
+    for k in ("tokens", "reroutes", "mode", "replayed", "emitted",
+              "status", "stitched", "kind", "budget"):
+        if k in e and e[k] not in (None, 0, "", "ok"):
+            detail.append(f"{k}={e[k]}")
+    return f"{phase}{at}" + (f"({', '.join(detail)})" if detail else "")
+
+
+def report(dump: dict, jsonl: list, out=print) -> dict:
+    """Render the root-cause report; returns the machine-readable digest
+    the self-check (and tests) assert on."""
+    digest: dict = {"reason": dump.get("reason")}
+    out(f"== postmortem: {dump.get('reason')} "
+        f"(dump {dump.get('dump_seq')}) ==")
+    trig = dump.get("context", {}).get("trigger")
+    if trig:
+        out(f"trigger: {json.dumps(trig, sort_keys=True)}")
+
+    reqtrace = dump.get("reqtrace") or {}
+    by_tid = {v.get("trace_id"): (rid, v) for rid, v in reqtrace.items()}
+    req_events = merge_req_events(dump, jsonl)
+
+    out("")
+    out("-- 1. first burn alert --")
+    burn = first_burn(dump, jsonl)
+    if burn is None:
+        out("  (no burn alert on record)")
+    else:
+        out(f"  slo {burn.get('slo')!r} window {burn.get('window')} at "
+            f"step {burn.get('step')}: burn fast={burn.get('burn_fast')} "
+            f"slow={burn.get('burn_slow')}")
+        exemplars = burn.get("exemplars") or []
+        digest["burn_exemplars"] = list(exemplars)
+        if exemplars:
+            out("  exemplar traces in the burning window:")
+            for tid in exemplars:
+                rid, summary = by_tid.get(tid, (None, None))
+                if summary is None:
+                    out(f"    {tid}  (trace not in dump)")
+                else:
+                    out(f"    {tid}  rid={rid} "
+                        f"phases: {' > '.join(summary['phases'])} "
+                        f"replicas={summary['replicas']}")
+        else:
+            out("  (no exemplars retained in the window)")
+
+    out("")
+    out("-- 2. breaker / failure timeline --")
+    timeline = breaker_timeline(dump, jsonl)
+    digest["breaker_opens"] = sum(
+        1 for k, r in timeline
+        if k == "fleet.breaker" and r.get("to") == "open")
+    digest["replicas_failed"] = [
+        r.get("replica") for k, r in timeline
+        if k == "fleet.replica_failed"]
+    if not timeline:
+        out("  (no breaker transitions or failures on record)")
+    for kind, rec in timeline:
+        if kind == "fleet.breaker":
+            out(f"  replica {rec.get('replica')} -> {rec.get('to')} "
+                f"(tick {rec.get('tick')})")
+        else:
+            out(f"  replica {rec.get('replica')} FAILED "
+                f"kind={rec.get('kind')} orphans={rec.get('orphans')}")
+
+    out("")
+    out("-- 3. failover chains (interrupted requests) --")
+    interrupted = [rid for rid, v in reqtrace.items()
+                   if "salvage" in v.get("phases", ())]
+    for rid in req_events:
+        if (any(e["phase"] == "salvage" for e in req_events[rid])
+                and rid not in interrupted):
+            interrupted.append(rid)
+    digest["interrupted"] = {}
+    if not interrupted:
+        out("  (no request was interrupted by a failover)")
+    for rid in interrupted:
+        events = req_events.get(rid, [])
+        summary = reqtrace.get(rid, {})
+        tid = summary.get("trace_id") or next(
+            (e.get("trace_id") for e in events), None)
+        replayed = sum(e.get("replayed", 0) for e in events
+                       if e["phase"] == "replay")
+        chain = ([_chain_row(e) for e in events]
+                 or list(summary.get("phases", ())))
+        digest["interrupted"][rid] = {
+            "trace_id": tid, "replayed": replayed,
+            "phases": [e["phase"] for e in events]
+            or list(summary.get("phases", ()))}
+        out(f"  {rid} (trace {tid}):")
+        out(f"    {' -> '.join(chain)}")
+        out(f"    tokens replayed through failover prefill: {replayed}")
+
+    router = dump.get("channels", {}).get("router", ())
+    if router:
+        out("")
+        out("-- 4. router decisions (ring tail) --")
+        for rec in router:
+            kv = " ".join(f"{k}={v}" for k, v in rec.items()
+                          if k not in ("seq", "kind"))
+            out(f"  seq {rec.get('seq'):>5}  {rec.get('kind'):<9} {kv}")
+    return digest
+
+
+# -- self-check ------------------------------------------------------------
+
+def self_check() -> int:
+    import tempfile
+
+    from ddl25spring_tpu import obs
+
+    problems: list = []
+    with tempfile.TemporaryDirectory() as td:
+        jsonl = str(Path(td) / "telemetry.jsonl")
+        obs.enable(jsonl)
+        rt = obs.install_reqtrace(seed=3)
+        fr = obs.install_flight(out_dir=td)
+        rec = obs.TimeSeriesRecorder(capacity=64)
+        rec.track("serving_request_seconds")
+        mon = obs.BurnRateMonitor(
+            rec, obs.SloSpec(name="latency", objective=0.5,
+                             kind="quantile",
+                             source="serving_request_seconds",
+                             threshold_s=0.1),
+            windows=(obs.BurnWindows(fast=2, slow=3, threshold=1.5),))
+        obs.install_recorder(rec, monitors=(mon,))
+        try:
+            # one clean request, then one that burns the SLO, is placed
+            # on replica 1, salvaged when it dies, and replayed on 2
+            rt.note("r0", "placed", replica=1, reroutes=0)
+            rt.note("r0", "admit", replica=1, seconds=0.01)
+            obs.observe("serving_request_seconds", 0.02,
+                        exemplar=rt.trace_id_of("r0"))
+            obs.record_samples()
+            rt.note("r1", "placed", replica=1, reroutes=1)
+            for step in range(4):
+                rt.note("r1", "decode", replica=1, tokens=2,
+                        emitted=2 * (step + 1))
+                obs.observe("serving_request_seconds", 0.5,
+                            exemplar=rt.trace_id_of("r1"))
+                obs.record_samples()
+            obs.event("fleet.breaker", replica=1, to="open", tick=9)
+            rt.note("r1", "salvage", replica=1, kind="replica_crash",
+                    tokens=8)
+            fr.record("router", "failover", replica=1,
+                      fault="replica_crash", orphans=["'r1'"])
+            obs.event("fleet.replica_failed", replica=1,
+                      kind="replica_crash", orphans=1)
+            rt.note("r1", "replay", replica=2, mode="continuation",
+                    replayed=8)
+            rt.note("r1", "deliver", replica=2, tokens=16, stitched=8)
+            obs.flush()
+        finally:
+            obs.uninstall_recorder()
+            obs.uninstall_flight()
+            obs.uninstall_reqtrace()
+            obs.disable()
+
+        if not fr.dumps:
+            print("self-check FAIL: no flight dump written",
+                  file=sys.stderr)
+            return 1
+        reasons = [p.name.split("_", 2)[2].removesuffix(".json")
+                   for p in fr.dumps]
+        for want in ("burn_alert", "breaker_open", "replica_failed"):
+            if want not in reasons:
+                problems.append(f"no {want} dump (got {reasons})")
+
+        dump = load_dump(fr.dumps[-1])
+        recs = load_jsonl([jsonl])
+        lines: list = []
+        digest = report(dump, recs, out=lines.append)
+
+        r1_tid = dump["reqtrace"].get("'r1'", {}).get("trace_id")
+        if not digest.get("burn_exemplars"):
+            problems.append("burn alert carried no exemplars")
+        elif r1_tid not in digest["burn_exemplars"]:
+            problems.append(
+                f"burning-window exemplars {digest['burn_exemplars']} "
+                f"do not include the slow request's trace {r1_tid}")
+        chain = digest.get("interrupted", {}).get("'r1'")
+        if chain is None:
+            problems.append("interrupted request 'r1' has no "
+                            "failover chain in the report")
+        else:
+            if chain["replayed"] != 8:
+                problems.append(
+                    f"expected 8 replayed tokens, got {chain['replayed']}")
+            for phase in ("salvage", "replay", "deliver"):
+                if phase not in chain["phases"]:
+                    problems.append(f"chain misses phase {phase!r}: "
+                                    f"{chain['phases']}")
+            if chain["trace_id"] != r1_tid:
+                problems.append("chain trace id does not match the "
+                                "dump's reqtrace summary")
+        if digest.get("breaker_opens", 0) < 1:
+            problems.append("breaker timeline shows no open transition")
+        if digest.get("replicas_failed") != [1]:
+            problems.append(
+                f"expected replica 1 failed, got "
+                f"{digest.get('replicas_failed')}")
+
+    if problems:
+        for p in problems:
+            print(f"self-check FAIL: {p}", file=sys.stderr)
+        return 1
+    print(f"self-check ok: {len(reasons)} dumps ({', '.join(reasons)}), "
+          f"{len(lines)} report lines, exemplar->chain round trip holds")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", nargs="?",
+                    help="flight-recorder dump (results/flightrec_*.json)")
+    ap.add_argument("--jsonl", action="append", default=[],
+                    help="telemetry JSONL file(s) to merge (repeatable)")
+    ap.add_argument("--self-check", action="store_true",
+                    help="synthesize an incident, report, validate")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if not args.dump:
+        ap.error("a dump file (or --self-check) is required")
+    report(load_dump(args.dump), load_jsonl(args.jsonl))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -323,6 +323,60 @@ def report_timeline(events: list[dict], top: int) -> None:
         depth += 1
 
 
+def report_requests(events: list[dict], top: int) -> None:
+    """Per-request waterfalls from the ``req.<phase>`` span events an
+    installed ReqTraceRecorder streams: the slowest ``top`` requests by
+    summed phase seconds, each phase on one bar-chart row with its
+    replica — a failover hop reads as the replica column changing
+    mid-waterfall (see docs/OBSERVABILITY.md §request traces)."""
+    reqs: dict = defaultdict(list)
+    for e in events:
+        if (e.get("event") == "span"
+                and str(e.get("name", "")).startswith("req.")):
+            reqs[e.get("rid", e.get("trace_id", "?"))].append(e)
+    if not reqs:
+        return
+
+    def total_s(evs) -> float:
+        return sum(float(e.get("seconds", 0.0)) for e in evs)
+
+    section(f"requests ({len(reqs)} traced; slowest {top} by "
+            "summed phase time)")
+    for rid in sorted(reqs, key=lambda r: -total_s(reqs[r]))[:top]:
+        evs = sorted(reqs[rid],
+                     key=lambda e: (e.get("req_seq", 0),
+                                    _span_start(e) or 0.0))
+        tid = next((e.get("trace_id") for e in evs
+                    if e.get("trace_id")), "?")
+        hops: list = []
+        for e in evs:
+            r = e.get("replica")
+            if r is not None and (not hops or hops[-1] != r):
+                hops.append(r)
+        t0 = min((_span_start(e) or 0.0) for e in evs)
+        tend = max(((_span_start(e) or 0.0)
+                    + float(e.get("seconds", 0.0))) for e in evs)
+        span = max(tend - t0, 1e-9)
+        print(f"  {rid}  trace {tid}  total "
+              f"{fmt_seconds(total_s(evs))}  replicas "
+              f"{'->'.join(str(r) for r in hops) or '-'}")
+        for e in evs:
+            off = (_span_start(e) or 0.0) - t0
+            secs = float(e.get("seconds", 0.0))
+            pos = int(_BAR_WIDTH * off / span)
+            w = max(1, int(_BAR_WIDTH * secs / span)) if secs else 1
+            bar = " " * min(pos, _BAR_WIDTH - 1) \
+                + ("#" if secs else "|") * min(w, _BAR_WIDTH - pos)
+            rep = e.get("replica")
+            extra = "".join(
+                f" {k}={e[k]}" for k in ("tokens", "mode", "replayed",
+                                         "status", "stitched")
+                if k in e)
+            print(f"    {e['name'][4:]:<9} r{rep if rep is not None else '-'}"
+                  f" +{off:8.3f}s {fmt_seconds(secs):>9} "
+                  f"{bar:<{_BAR_WIDTH}}{extra}")
+
+
 def render_prom_snapshot(summary: dict) -> str:
     """The last ``telemetry_summary`` back out as Prometheus text
     exposition — the JSONL-side inverse of obs.core.Telemetry.render_prom
@@ -638,6 +692,10 @@ def report(events: list[dict], top: int) -> None:
                   f"{e.get('step', '?')}: {e.get('slo', '?')} "
                   f"[{e.get('window', '?')}] fast={e.get('burn_fast')} "
                   f"slow={e.get('burn_slow')}")
+            # exemplar trace ids retained in the burning window — join
+            # against the requests section / tools/obs_postmortem.py
+            for tid in (e.get("exemplars") or [])[:4]:
+                print(f"        exemplar trace {tid}")
         if desired_g is not None or scale_events or scale_drained:
             if desired_g is not None:
                 line = f"  autoscale: desired replicas last={desired_g:g}"
@@ -833,6 +891,9 @@ def report(events: list[dict], top: int) -> None:
                                      key=lambda ls: -ls[1]["value"]))
             print(f"  rounds rejected (previous params kept / gated): "
                   f"{reasons}")
+
+    # -- per-request waterfalls (req-trace spans) ------------------------
+    report_requests(events, top)
 
     # -- timeline / critical path ----------------------------------------
     report_timeline(events, top)
