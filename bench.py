@@ -630,6 +630,8 @@ def run_cpu_trend(nr_rounds: int = 2):
     fleet_routing = _fleet_routing_cell()
     _stamp("cpu trend: fleet chaos cell ...")
     fleet_chaos = _fleet_chaos_cell()
+    _stamp("cpu trend: fleet rollout cell ...")
+    fleet_rollout = _fleet_rollout_cell()
     print(json.dumps({
         "metric": CPU_TREND_METRIC,
         "value": round(nr_rounds / dt, 4),
@@ -645,6 +647,7 @@ def run_cpu_trend(nr_rounds: int = 2):
         "fused_decode_step": fused_decode_step,
         "fleet_routing": fleet_routing,
         "fleet_chaos": fleet_chaos,
+        "fleet_rollout": fleet_rollout,
         "wall_s": round(time.perf_counter() - t_start, 1),
     }))
     sys.stdout.flush()
@@ -980,6 +983,134 @@ def _fleet_chaos_cell(nr_requests: int = 8):
         "replicas_failed": chaos["replicas_failed"],
         "failed_over": chaos["failed_over"],
         "failover_tokens_replayed": chaos["failover_tokens_replayed"],
+    }
+
+
+def _fleet_rollout_cell(nr_requests: int = 10):
+    """Rolling weight push over a live 3-replica fleet
+    (serving_fleet/rollout.py): the routing-cell workload replayed twice
+    — clean, then with a delta push rolling drain->swap->canary across
+    the replicas mid-trace — plus a seeded BAD push (the canary rejects
+    everything) timed from burn-gate rollback to fleet convergence.
+    ``goodput_retention`` is the push run's completed/sec over the clean
+    run's (zero-drop means the same requests complete either way; the
+    retention is pure push overhead), ``rollback_latency_s`` is the
+    auto-revert cost — the trends that move when the rollout plane
+    regresses."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu.models import loadgen
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+    from ddl25spring_tpu.serving_fleet import (FleetHealth, FleetRouter,
+                                               RolloutConfig,
+                                               WeightPushPlane, version_of)
+
+    cfg = LlamaConfig(vocab_size=128, dmodel=48, nr_heads=4,
+                      nr_kv_heads=2, nr_layers=2, ctx_size=48,
+                      dtype=jnp.float32)
+    params = Llama(cfg).init(jax.random.PRNGKey(0),
+                             jnp.ones((1, 4), jnp.int32))
+    new_params = jax.tree.map(lambda a: a * (1.0 + 5e-4), params)
+    budget = 5
+
+    def make_replica(p=params, slot=None):
+        return ContinuousBatcher(cfg, p, max_batch=2, prefill_width=8,
+                                 kv_layout="paged", kv_page=8)
+
+    def make_fleet():
+        return FleetRouter([make_replica() for _ in range(3)],
+                           health=FleetHealth(3))
+
+    prng = np.random.default_rng(0)
+    prompts = [prng.integers(1, 128,
+                             size=int(prng.integers(3, 8))).tolist()
+               for _ in range(nr_requests)]
+    loadgen.warm(make_replica, prompts, [budget] * nr_requests)
+
+    def drive(router, plane):
+        """Submit one request per step (retrying rejections) while
+        stepping the fleet and ticking the push; returns (completed,
+        wall_s, rollback_latency_s)."""
+        t0 = time.perf_counter()
+        t_rb = rb_latency = None
+        pending = list(enumerate(prompts))
+        done: dict = {}
+        for _ in range(2000):
+            if pending:
+                rid, p = pending[0]
+                try:
+                    router.submit(rid, p, budget)
+                    pending.pop(0)
+                except Exception as e:
+                    if not (hasattr(e, "reason")
+                            and hasattr(e, "retry_after_s")):
+                        raise
+            done.update(router.step())
+            if plane is not None:
+                done.update(plane.tick())
+                ctrl = plane._active
+                if (ctrl is not None and t_rb is None
+                        and ctrl._phase == "rollback"):
+                    t_rb = time.perf_counter()
+                if ctrl is None and t_rb is not None \
+                        and rb_latency is None:
+                    rb_latency = time.perf_counter() - t_rb
+            if not pending and router.in_flight == 0 \
+                    and (plane is None or plane._active is None):
+                break
+        return len(done), time.perf_counter() - t0, rb_latency
+
+    clean_done, clean_s, _ = drive(make_fleet(), None)
+
+    router = make_fleet()
+    plane = WeightPushPlane(router, lambda p, s: make_replica(p, s),
+                            params, config=RolloutConfig(canary_ticks=4))
+    plane.start(plane.bundle_from(new_params))
+    push_done, push_s, _ = drive(router, plane)
+
+    class _Rejected(RuntimeError):
+        reason = "canary_sick"
+        retry_after_s = 0.001
+
+    class _Sick:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def submit(self, rid, prompt, budget, deadline_s=None):
+            raise _Rejected()
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    new_version = version_of(new_params)
+
+    def make_bad(p, slot):
+        rep = make_replica(p, slot)
+        return _Sick(rep) if version_of(p) == new_version else rep
+
+    router_b = make_fleet()
+    plane_b = WeightPushPlane(router_b, make_bad, params,
+                              config=RolloutConfig(canary_ticks=32))
+    plane_b.start(plane_b.bundle_from(new_params))
+    bad_done, _bad_s, rb_latency = drive(router_b, plane_b)
+    rolled_back = plane_b.history[-1][1] == "rolled_back"
+
+    clean_rps = clean_done / max(clean_s, 1e-9)
+    push_rps = push_done / max(push_s, 1e-9)
+    return {
+        "replicas": 3,
+        "requests": nr_requests,
+        "clean_goodput_rps": round(clean_rps, 3),
+        "push_goodput_rps": round(push_rps, 3),
+        "goodput_retention": round(push_rps / max(clean_rps, 1e-9), 3),
+        "push_outcome": plane.history[-1][1],
+        "completed_under_push": push_done,
+        "bad_push_rolled_back": rolled_back,
+        "bad_push_completed": bad_done,
+        "rollback_latency_s": round(rb_latency or 0.0, 4),
     }
 
 

@@ -52,6 +52,7 @@ HOST_ONLY_MODULES = (
     "ddl25spring_tpu.serving_fleet.router",
     "ddl25spring_tpu.serving_fleet.health",
     "ddl25spring_tpu.serving_fleet.autoscale",
+    "ddl25spring_tpu.serving_fleet.rollout",
     # fault scheduling + retry/backoff (wrap arbitrary host callables)
     "ddl25spring_tpu.resilience",
     "ddl25spring_tpu.resilience.faults",

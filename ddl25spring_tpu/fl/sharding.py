@@ -156,6 +156,31 @@ def ring_all_reduce(tree, axis: str = CLIENTS_AXIS, world: int = 1):
     return jax.tree.map(ring_leaf, tree)
 
 
+def ring_broadcast(tree, axis: str = CLIENTS_AXIS, world: int = 1,
+                   source: int = 0):
+    """Broadcast ``source``'s pytree to every shard over the SAME ring
+    schedule as :func:`ring_all_reduce` — the rollout plane's cross-replica
+    weight-delta distribution (arXiv 2004.13336) reuses the reduce path
+    instead of growing a second collective: every shard other than
+    ``source`` contributes zeros, so the ring sum IS the broadcast.
+
+    Exactness: ``world == 1`` is the identity.  Larger worlds are bitwise
+    equal to the source's leaves for every value except IEEE ``-0.0``
+    (``-0.0 + 0.0 == +0.0``, so negative zeros arrive as positive zeros —
+    numerically equal, one sign bit off).  Weight deltas hitting an exact
+    ``-0.0`` are vanishingly rare and the rollout plane's bit-exactness
+    oracle checks the RECONSTRUCTED params, which go through the same
+    addition, so the contract holds where it matters.
+    """
+    if world == 1:
+        return tree
+    masked = jax.tree.map(
+        lambda l: jnp.where(jax.lax.axis_index(axis) == source,
+                            jnp.asarray(l), jnp.zeros_like(l)),
+        tree)
+    return ring_all_reduce(masked, axis, world)
+
+
 def ppermute_signature(tree, extra_scalar_leaves: int = 0, world: int = 1,
                        nr_combines: int = 1):
     """Host-side collective signature of the overlapped (ring) combine for

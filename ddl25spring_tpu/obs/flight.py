@@ -24,6 +24,7 @@ Dump triggers (checked on every teed event):
 * ``fleet.replica_failed``                    -> ``replica_failed``
 * ``fleet.breaker`` with ``to == "open"``     -> ``breaker_open``
 * ``slo.burn`` with ``state == "burning"``    -> ``burn_alert``
+* ``fleet.rollout_rolled_back``               -> ``rollout_rollback``
 
 Each dump is one JSON file ``<prefix>_<n>_<reason>.json`` with the ring
 contents, the trigger, a registry snapshot and any extra sources wired
@@ -52,6 +53,7 @@ _TRIGGERS = {
     "fleet.replica_failed": ("replica_failed", None),
     "fleet.breaker": ("breaker_open", ("to", "open")),
     "slo.burn": ("burn_alert", ("state", "burning")),
+    "fleet.rollout_rolled_back": ("rollout_rollback", None),
 }
 
 

@@ -6,7 +6,10 @@ through fleet placement (``serving_fleet/router.py``), disaggregated
 prefill staging (``serving_fleet/disagg.py``), admission, per-chunk
 decode and finish (``models/serving.py``), and — when a replica dies —
 the salvage/replay failover hops, recording each phase as one host-side
-event in a token-level timing waterfall.
+event in a token-level timing waterfall.  Requests that cross a weight
+push additionally carry ``rollout`` phases (``serving_fleet/rollout.py``:
+``stage`` drain/drain_timeout and the target version), so a waterfall
+shows exactly where a stream rode through a drain or a swap.
 
 Id scheme (the blake2b construction from :mod:`ddl25spring_tpu.obs.trace`):
 

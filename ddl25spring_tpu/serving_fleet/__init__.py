@@ -18,6 +18,11 @@ Three layers (docs/PERFORMANCE.md §8):
 - ``health``  — :class:`FleetHealth`: per-replica circuit breaker
                 (healthy → suspect → open → half-open) fed by the
                 router's step signals (docs/RESILIENCE.md §9).
+- ``rollout`` — :class:`WeightPushPlane` / :class:`RolloutController`:
+                burn-gated rolling weight pushes (drain → swap → canary
+                per replica) with zero-drop auto-rollback and a
+                single-version-at-rest guarantee
+                (docs/RESILIENCE.md §10).
 - ``autoscale`` — :class:`AutoscalePolicy`: desired-replica-count
                 signal from the queue-wait/drain-rate/SLO-slack series
                 with hysteresis + cooldown, consumed by
@@ -35,14 +40,17 @@ from __future__ import annotations
 from .autoscale import AutoscaleConfig, AutoscalePolicy
 from .health import BreakerConfig, FleetHealth
 from .policy import ReplicaSnapshot, rank_replicas, snapshot_replica
+from .rollout import (ParamBundle, RolloutConfig, RolloutController,
+                      WeightPushPlane, version_of)
 from .router import FleetRouter, NoReplicaAvailable
 
 __all__ = [
     "AutoscaleConfig", "AutoscalePolicy",
     "BreakerConfig", "DisaggregatedBatcher", "FleetHealth",
-    "FleetRouter", "NoReplicaAvailable", "PrefillWorker",
-    "ReplicaSnapshot", "TPShardedBatcher", "headsharded_flash_decode",
-    "make_model_mesh", "rank_replicas", "snapshot_replica",
+    "FleetRouter", "NoReplicaAvailable", "ParamBundle", "PrefillWorker",
+    "ReplicaSnapshot", "RolloutConfig", "RolloutController",
+    "TPShardedBatcher", "WeightPushPlane", "headsharded_flash_decode",
+    "make_model_mesh", "rank_replicas", "snapshot_replica", "version_of",
 ]
 
 _LAZY = {

@@ -110,6 +110,11 @@ class FleetHealth:
         self._replicas = [_ReplicaHealth() for _ in range(nr_replicas)]
         self._ticks = 0
         self.transitions: dict = {}   # (replica, to_state) -> count
+        # optional (replica, to_state) callback fired on every
+        # transition — the rollout controller hooks it to catch a canary
+        # breaker opening at the exact tick it happens (chain, don't
+        # replace, if more than one observer needs it)
+        self.on_transition = None
 
     # -- state machine ---------------------------------------------------
 
@@ -123,6 +128,9 @@ class FleetHealth:
         obs.inc("fleet_breaker_transitions_total", replica=str(i),
                 to=state)
         obs.event("fleet.breaker", replica=i, to=state, tick=self._ticks)
+        cb = self.on_transition
+        if cb is not None:
+            cb(i, state)
         if state == "open":
             h.opened_at = self._ticks
             h.canary = None

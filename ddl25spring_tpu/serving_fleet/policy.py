@@ -16,11 +16,18 @@ routing trace is deterministic):
    their affinity or load (``serving_fleet.health``).
 2. **SLO feasibility** — replicas whose estimated admission wait already
    exceeds their SLO would reject; they go last, whatever their affinity.
-3. **Prefix affinity** — a replica that already holds the request's
+3. **Canary preference** — a replica flagged as a rollout canary
+   (``FleetRouter.mark_canary``) ranks FIRST among the feasible,
+   non-suspect ones: the canary window is short and a canary that
+   receives no traffic proves nothing, so the router deliberately
+   steers placements at it while the burn gates watch.  A rejecting or
+   breaker-open canary still re-routes/excludes as usual, so the
+   preference never drops a request.
+4. **Prefix affinity** — a replica that already holds the request's
    prefix pages (ctor ``prefix_tokens``) or served the same prompt head
    recently skips prefill work and reuses warm KV pages.
-4. **Least load** — fewest queued + active requests.
-5. **SLO slack** — at equal load, the replica with the most headroom.
+5. **Least load** — fewest queued + active requests.
+6. **SLO slack** — at equal load, the replica with the most headroom.
 """
 
 from __future__ import annotations
@@ -48,6 +55,7 @@ class ReplicaSnapshot:
     est_wait_s: float = 0.0
     slo_slack_s: float = float("inf")
     health_state: str = "healthy"   # serving_fleet.health breaker state
+    canary: bool = False            # rollout canary: prefer for traffic
 
     @property
     def load(self) -> int:
@@ -67,6 +75,7 @@ def rank_replicas(snapshots) -> list[int]:
         key=lambda s: (
             1 if s.health_state == "suspect" else 0,  # demote suspects
             1 if s.slo_slack_s <= 0.0 else 0,   # would reject: last
+            0 if s.canary else 1,                # steer at the canary
             0 if s.prefix_hit else 1,            # warm prefix first
             s.load,                              # then least loaded
             -s.slo_slack_s,                      # then most headroom
@@ -77,7 +86,8 @@ def rank_replicas(snapshots) -> list[int]:
 
 def snapshot_replica(index: int, batcher, prompt, budget: int, *,
                      affinity_hit: bool = False,
-                     health_state: str = "healthy") -> ReplicaSnapshot:
+                     health_state: str = "healthy",
+                     canary: bool = False) -> ReplicaSnapshot:
     """Build a snapshot from a live batcher by reading HOST state only
     (queue, slots, EWMAs) — no device round trip, no jax import.
 
@@ -108,5 +118,5 @@ def snapshot_replica(index: int, batcher, prompt, budget: int, *,
         index=index, queue_len=queue_len, active=active,
         free_slots=len(slots) - active, prefix_hit=hit,
         est_wait_s=est_wait, slo_slack_s=slack,
-        health_state=health_state,
+        health_state=health_state, canary=canary,
     )

@@ -1,0 +1,890 @@
+"""Live weight-push plane: burn-gated rolling updates, zero-drop rollback.
+
+An FL round's output has no value until a serving fleet runs it, and a
+bad round must never take the fleet down.  This module closes that loop
+(docs/RESILIENCE.md §10): a versioned parameter bundle rolls across a
+running :class:`~ddl25spring_tpu.serving_fleet.router.FleetRouter`
+replica-by-replica — drain, swap, canary — with promotion gated on the
+canary's own burn-rate monitors and automatic, equally zero-drop
+rollback when a gate fires.
+
+Three layers:
+
+- :func:`version_of` / :class:`ParamBundle` — content-addressed param
+  versions (blake2b over every leaf's path, dtype, shape and raw bytes)
+  and the three push payloads: ``full`` params, per-leaf ``delta``, or
+  an ``adapter`` touching a subset of leaves.  Uncompressed bundles
+  carry a bit-exactness guarantee: any leaf whose ``old + delta`` does
+  not reconstruct ``new`` EXACTLY is stored full, so :meth:`ParamBundle
+  .apply` is bitwise — the compression-off oracle the no-op-push test
+  pins.  ``compress=True`` trades that for ~4x smaller payloads via
+  ``parallel/compress.int8_encode`` (lazy jax import; this module stays
+  host-only).
+- :class:`RolloutController` — the tick-driven state machine
+  (``drain -> swap -> canary`` per replica, with ``rollback`` and a
+  final ``converge`` sweep) advanced once per ``router.step()``, so a
+  LIVE load loop keeps submitting while the push proceeds.
+- :class:`WeightPushPlane` — the fleet-facing façade: owns the promoted
+  params + version, builds bundles, runs pushes (non-blocking
+  :meth:`~WeightPushPlane.start` + :meth:`~WeightPushPlane.tick`, or
+  blocking :meth:`~WeightPushPlane.push`), and tracks FL-round
+  freshness (``fleet_rollout_rounds_behind``) via the
+  ``Server.run(on_round=...)`` hook.
+
+Zero-drop contract: a replica is swapped only once its in-flight work
+has drained; a drain that exceeds its tick budget is salvage-and-
+failed-over through the router's exactly-once failover (never dropped,
+never duplicated — the requests re-place as continuation prefills with
+their streamed tokens stitched back on), and the same applies to every
+rollback swap.  Greedy streams are therefore bit-identical across a
+no-op push (old == new params).
+
+Burn-gate ordering vs the breaker: the canary crashing or its breaker
+reaching ``open`` (proven sick) out-ranks the SLO burn gates
+(statistical evidence) — either triggers the same rollback, the
+breaker immediately, the gates only once fast AND slow windows burn.
+A rollback dumps the flight recorder (``fleet.rollout_rolled_back`` is
+a dump trigger) and converges the fleet back to the prior version,
+replacing chaos-killed replicas on the way: ``describe()['versions']``
+is single-valued at rest whatever crashed mid-push.
+
+Host-only (``analysis/manifest.HOST_ONLY_MODULES``): imports numpy but
+never jax at module scope — the int8 and ring-distribution paths
+import lazily inside the functions that need them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import obs
+
+__all__ = ["ParamBundle", "RolloutConfig", "RolloutController",
+           "WeightPushPlane", "distribute_delta", "version_of"]
+
+
+# -- content-addressed versions ------------------------------------------
+
+
+def _flat_items(tree, path: str = ""):
+    """Deterministic (path, leaf) pairs of a nested dict/list/tuple tree
+    — sorted dict keys, positional list indices — with no jax import, so
+    versioning works on numpy trees, jax trees, or a mix."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _flat_items(tree[k], f"{path}/{k}")
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _flat_items(v, f"{path}/{i}")
+    elif tree is None:
+        return
+    else:
+        yield (path or "/"), tree
+
+
+def version_of(tree, *, digest_size: int = 10) -> str:
+    """Content-addressed version id: blake2b over every leaf's path,
+    dtype, shape and raw bytes.  Two trees with identical contents get
+    the same id however they were produced — the property that makes a
+    no-op push (old == new) land on the version already serving."""
+    h = hashlib.blake2b(digest_size=digest_size)
+    for path, leaf in _flat_items(tree):
+        a = np.asarray(leaf)
+        h.update(path.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class ParamBundle:
+    """One versioned weight push: how to turn the fleet's current params
+    into the next version.
+
+    ``entries`` maps leaf path -> one of
+
+    - ``("full", array)``   — replace the leaf outright;
+    - ``("delta", array)``  — add to the leaf (stored only when
+      ``old + delta`` reconstructs ``new`` bit-for-bit; leaves where
+      float rounding breaks that fall back to ``full``);
+    - ``("int8", q, scale)`` — int8-quantized delta
+      (``parallel/compress`` wire format; lossy, so compressed bundles
+      void the exactness oracle).
+
+    Paths absent from ``entries`` pass through untouched — that is the
+    whole point of the ``adapter`` kind (a LoRA-merged subset of
+    leaves).  ``version`` is :func:`version_of` the RECONSTRUCTED
+    target params, so whichever payload kind produced it, the same
+    weights get the same id.
+    """
+
+    KINDS = ("full", "delta", "adapter")
+
+    def __init__(self, kind: str, entries: dict, base_version,
+                 version: str, *, compressed: bool = False,
+                 round_ix=None):
+        if kind not in self.KINDS:
+            raise ValueError(f"kind={kind!r} not in {self.KINDS}")
+        self.kind = kind
+        self.entries = entries
+        self.base_version = base_version
+        self.version = version
+        self.compressed = compressed
+        self.round_ix = round_ix
+
+    # -- builders --------------------------------------------------------
+
+    @classmethod
+    def full(cls, params, *, round_ix=None) -> "ParamBundle":
+        """The whole target tree, leaf by leaf — trivially bit-exact."""
+        entries = {p: ("full", np.asarray(l))
+                   for p, l in _flat_items(params)}
+        return cls("full", entries, None, version_of(params),
+                   round_ix=round_ix)
+
+    @classmethod
+    def delta(cls, old_params, new_params, *, round_ix=None,
+              compress: bool = False, seed: int = 0) -> "ParamBundle":
+        """Per-leaf ``new - old``.  Uncompressed: every leaf is verified
+        to reconstruct bitwise (fallback to full where it cannot).
+        ``compress=True`` stores the delta int8-quantized via
+        ``parallel/compress.int8_encode`` (lazy jax import)."""
+        olds = dict(_flat_items(old_params))
+        news = dict(_flat_items(new_params))
+        if sorted(olds) != sorted(news):
+            raise ValueError("old/new params have different tree paths")
+        entries: dict = {}
+        if compress:
+            import jax                      # noqa: deliberate lazy import
+
+            from ..parallel.compress import int8_encode
+            deltas = {p: np.asarray(news[p]) - np.asarray(olds[p])
+                      for p in sorted(news)}
+            q_tree, s_tree = int8_encode(deltas, jax.random.PRNGKey(seed))
+            for p in sorted(news):
+                q = np.asarray(q_tree[p])
+                if q.dtype == np.int8:
+                    entries[p] = ("int8", q, float(np.asarray(s_tree[p])))
+                else:
+                    entries[p] = ("delta", q)   # pass-through leaf
+        else:
+            for p in sorted(news):
+                o, n = np.asarray(olds[p]), np.asarray(news[p])
+                d = n - o
+                if (o + d).tobytes() == n.tobytes():
+                    entries[p] = ("delta", d)
+                else:
+                    entries[p] = ("full", n)    # rounding broke o+d==n
+        out = cls("delta", entries, version_of(old_params), "",
+                  compressed=compress, round_ix=round_ix)
+        out.version = version_of(out.apply(old_params))
+        return out
+
+    @classmethod
+    def adapter(cls, base_params, updates: dict, *,
+                round_ix=None) -> "ParamBundle":
+        """A subset-of-leaves push (LoRA-merged projections, a new head):
+        ``updates`` maps leaf paths (the ``/a/b`` form :func:`version_of`
+        hashes) to their NEW values; every other leaf passes through."""
+        base = dict(_flat_items(base_params))
+        entries: dict = {}
+        for p in sorted(updates):
+            if p not in base:
+                raise ValueError(f"adapter path {p!r} not in base params")
+            o, n = np.asarray(base[p]), np.asarray(updates[p])
+            d = n - o
+            if (o + d).tobytes() == n.tobytes():
+                entries[p] = ("delta", d)
+            else:
+                entries[p] = ("full", n)
+        out = cls("adapter", entries, version_of(base_params), "",
+                  round_ix=round_ix)
+        out.version = version_of(out.apply(base_params))
+        return out
+
+    # -- application -----------------------------------------------------
+
+    def _apply_leaf(self, path: str, leaf):
+        e = self.entries.get(path)
+        if e is None:
+            return leaf                      # adapter pass-through
+        if e[0] == "full":
+            return e[1]
+        if e[0] == "delta":
+            return np.asarray(leaf) + e[1]
+        # int8: same dequantize as parallel/compress.int8_decode
+        q, scale = e[1], e[2]
+        o = np.asarray(leaf)
+        return o + q.astype(o.dtype) * o.dtype.type(scale)
+
+    def apply(self, params):
+        """The params tree this bundle turns ``params`` into.  Bit-exact
+        when ``compressed`` is False (the oracle
+        :meth:`reconstructs` checks); int8 bundles are lossy."""
+
+        def walk(sub, path):
+            if isinstance(sub, dict):
+                return {k: walk(sub[k], f"{path}/{k}") for k in sub}
+            if isinstance(sub, (list, tuple)):
+                return type(sub)(walk(v, f"{path}/{i}")
+                                 for i, v in enumerate(sub))
+            if sub is None:
+                return None
+            return self._apply_leaf(path or "/", sub)
+
+        return walk(params, "")
+
+    def reconstructs(self, old_params, new_params) -> bool:
+        """Compression-off bit-exactness oracle: does ``apply(old)``
+        reproduce ``new`` byte-for-byte (dtype, shape and bits)?"""
+        got = dict(_flat_items(self.apply(old_params)))
+        want = dict(_flat_items(new_params))
+        if sorted(got) != sorted(want):
+            return False
+        for p in got:
+            a, b = np.asarray(got[p]), np.asarray(want[p])
+            if a.dtype != b.dtype or a.shape != b.shape:
+                return False
+            if a.tobytes() != b.tobytes():
+                return False
+        return True
+
+    @property
+    def payload_bytes(self) -> int:
+        return sum(sum(x.nbytes for x in e[1:] if isinstance(x, np.ndarray))
+                   for e in self.entries.values())
+
+    def describe(self) -> dict:
+        return {"kind": self.kind, "version": self.version,
+                "base_version": self.base_version,
+                "compressed": self.compressed, "round_ix": self.round_ix,
+                "entries": len(self.entries),
+                "payload_bytes": self.payload_bytes}
+
+
+def distribute_delta(tree, mesh, *, axis: str = "clients",
+                     source: int = 0):
+    """Push one host tree across a device mesh via the ring broadcast
+    (``fl/sharding.ring_broadcast`` — the arXiv 2004.13336 cross-replica
+    wire path, reusing ``ring_all_reduce``): the source shard's bits
+    circulate the ``2·(W-1)``-step ppermute ring and every shard ends
+    with them verbatim (zeros are the additive identity, so the reuse of
+    the sum-ring is bitwise except that ``-0.0`` normalizes to ``+0.0``).
+    Returns the tree as numpy, fetched from the replicated output.  Lazy
+    jax import — callers on a jax-free host simply skip distribution."""
+    import jax                               # noqa: deliberate lazy import
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..fl.sharding import ring_broadcast
+    from ..parallel.compat import shard_map
+
+    world = mesh.shape[axis]
+    dev = jax.tree.map(jnp.asarray, tree)
+
+    def body(t):
+        return ring_broadcast(t, axis=axis, world=world, source=source)
+
+    out = shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                    check_vma=False)(dev)
+    return jax.tree.map(np.asarray, out)
+
+
+# -- the rolling push ----------------------------------------------------
+
+
+@dataclass
+class RolloutConfig:
+    """Knobs of one rolling push.
+
+    Everything is counted in router-step TICKS, not wall seconds, so a
+    seeded replay drives the controller deterministically (the same
+    discipline as ``obs/timeseries``).  ``windows`` are the fast/slow
+    burn-window pairs of both canary gates; the defaults trip after a
+    handful of bad samples — canary windows are short, so the gates use
+    much smaller windows than a steady-state SLO monitor would."""
+
+    canary_ticks: int = 16           # canary window length, router steps
+    drain_timeout_ticks: int | None = 256   # None: wait forever
+    reject_objective: float = 0.9    # canary admission-success SLO
+    queue_wait_objective: float = 0.9
+    queue_wait_threshold_s: float = 0.25
+    windows: tuple = (obs.BurnWindows(fast=4, slow=8, threshold=1.0),)
+    holdout_score: object = None     # params -> float, higher is better
+    holdout_margin: float = 0.0      # allowed score drop before reject
+    rollback_on_canary_crash: bool = True
+
+    def validate(self) -> None:
+        if self.canary_ticks < 1:
+            raise ValueError(
+                f"canary_ticks={self.canary_ticks} must be >= 1")
+        if (self.drain_timeout_ticks is not None
+                and self.drain_timeout_ticks < 1):
+            raise ValueError(
+                f"drain_timeout_ticks={self.drain_timeout_ticks} "
+                "must be >= 1 (or None)")
+        for nm, v in (("reject_objective", self.reject_objective),
+                      ("queue_wait_objective", self.queue_wait_objective)):
+            if not 0.0 < v < 1.0:
+                raise ValueError(f"{nm}={v} outside (0, 1)")
+        if not self.windows:
+            raise ValueError("need at least one burn-window pair")
+
+
+class _CanaryProbe:
+    """Transparent wrapper around the canary replica: counts admission
+    outcomes into the controller's PRIVATE telemetry (never the global
+    registry — a push must not need ``obs.enable`` to gate itself) and
+    forwards everything else, so the router, the health tracker and the
+    policy snapshots see the replica unchanged."""
+
+    def __init__(self, inner, ctrl):
+        self.__dict__["inner"] = inner
+        self.__dict__["_ctrl"] = ctrl
+
+    def submit(self, rid, prompt, budget, deadline_s=None):
+        ctrl = self.__dict__["_ctrl"]
+        ctrl._canary_count("submitted")
+        try:
+            return self.__dict__["inner"].submit(
+                rid, prompt, budget, deadline_s=deadline_s)
+        except Exception as e:
+            if hasattr(e, "reason") and hasattr(e, "retry_after_s"):
+                ctrl._canary_count("rejected")
+            raise
+
+    def step(self):
+        return self.__dict__["inner"].step()
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["inner"], name)
+
+    def __setattr__(self, name, value):
+        setattr(self.__dict__["inner"], name, value)
+
+
+class RolloutController:
+    """Tick-driven rolling update of one :class:`FleetRouter`.
+
+    Call :meth:`tick` once per ``router.step()`` — the controller never
+    steps the router itself, so the driving loop keeps submitting live
+    traffic while the push proceeds.  :meth:`tick` returns any requests
+    that finished as a side effect of a forced salvage-and-failover
+    (drain timeout), to merge with ``router.step()``'s output exactly
+    like the blocking ``drain_replica``'s ``.partial``.
+
+    ``make_replica(params, slot)`` builds a fresh replica at the given
+    params for fleet slot ``slot`` (chaos tests wrap the result in their
+    fault schedule here).  Stages per replica, in slot order::
+
+        drain   no new placements (router.begin_drain); in-flight work
+                finishes on the replica; a drain past its tick budget is
+                salvaged-and-failed-over instead of raising
+        swap    router.swap_replica with a new-version replica; the old,
+                cleanly-drained replica is kept for cheap rollback
+        canary  the new replica takes traffic (policy prefers it — a
+                canary that sees no traffic proves nothing) while its
+                burn gates watch reject rate and queue-wait p99; crash /
+                breaker-open rolls back immediately, gate burn rolls
+                back on fast+slow agreement, an uneventful window
+                promotes and the next replica drains
+
+    Rollback reverses completed swaps newest-first through the same
+    drain->swap machinery (zero-drop both directions), then a converge
+    sweep replaces any replica left dead or mixed-version — the fleet is
+    single-versioned at rest no matter what chaos did mid-push.
+    """
+
+    def __init__(self, router, make_replica, bundle: ParamBundle,
+                 base_params, *, config: RolloutConfig | None = None):
+        self.router = router
+        self.make_replica = make_replica
+        self.bundle = bundle
+        self.base_params = base_params
+        self.config = config or RolloutConfig()
+        self.config.validate()
+        self.old_version = bundle.base_version or version_of(base_params)
+        self.new_params = bundle.apply(base_params)
+        self.new_version = bundle.version
+        n = len(router.replicas)
+        self.versions = [self.old_version] * n
+        self.stage = "drain"
+        self.target = 0                  # slot currently being rolled
+        self.outcome: str | None = None  # promoted/rolled_back/rejected
+        self.rollback_reason: str | None = None
+        self.holdout: dict | None = None
+        self.log: list = []              # [(tick, stage, slot, note)]
+        self._tick = 0
+        self._stage_ticks = 0
+        self._old_replicas: dict = {}    # slot -> cleanly drained old
+        self._probe = None
+        self._rb_queue: list = []
+        self._phase = "forward"          # forward | rollback
+        self._breaker_open_tick: int | None = None
+        self._t = obs.Telemetry()        # private canary registry
+        self._rec = None
+        self._monitors: list = []
+        self._prev_hook = None
+        h = router.health
+        if h is not None and hasattr(h, "on_transition"):
+            self._prev_hook = h.on_transition
+            prev = self._prev_hook
+
+            def hook(i, state):
+                if prev is not None:
+                    prev(i, state)
+                self._note_breaker(i, state)
+
+            h.on_transition = hook
+        self._log("start", -1,
+                  f"{bundle.kind} {self.old_version}->{self.new_version}")
+        if not self._validate():
+            self._finish("rejected")
+        else:
+            # the first drain starts NOW: without begin_drain the router
+            # would keep placing on the slot and it could never empty
+            self._begin_drain(self.target)
+
+    # -- bookkeeping -----------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self.stage == "done"
+
+    def _log(self, stage: str, slot: int, note: str = "") -> None:
+        self.log.append((self._tick, stage, slot, note))
+        obs.event("fleet.rollout", stage=stage, replica=slot,
+                  tick=self._tick, version=self.new_version,
+                  note=note)
+
+    def _enter(self, stage: str, note: str = "") -> None:
+        self.stage = stage
+        self._stage_ticks = 0
+        self._log(stage, self.target, note)
+
+    def _note_breaker(self, i: int, state: str) -> None:
+        if (state == "open" and self.stage == "canary"
+                and i == self.target):
+            self._breaker_open_tick = self._tick
+
+    def _canary_count(self, kind: str) -> None:
+        # counted twice on purpose: the PRIVATE registry feeds the burn
+        # gates (isolated per canary window, no obs.enable needed), the
+        # global one feeds dashboards/reports
+        r = str(self.target)
+        if kind == "rejected":
+            self._t.counter("fleet_rollout_canary_rejected_total",
+                            replica=r).inc()
+            obs.inc("fleet_rollout_canary_rejected_total", replica=r)
+        else:
+            self._t.counter("fleet_rollout_canary_submitted_total",
+                            replica=r).inc()
+            obs.inc("fleet_rollout_canary_submitted_total", replica=r)
+
+    def _note_rollout_phase(self, slot: int, stage: str) -> None:
+        """Tag every request in flight on ``slot`` with a ``rollout``
+        phase, so streams that cross a push show the hop in their
+        waterfall (obs/reqtrace)."""
+        rt = obs.reqtrace()
+        if rt is None:
+            return
+        for rid, owner in list(self.router._owner.items()):
+            if owner == slot:
+                rt.note(rid, "rollout", replica=slot, stage=stage,
+                        to_version=self.new_version)
+
+    def _validate(self) -> bool:
+        """Pre-flight holdout gate (the ValidationGate-style score): a
+        bundle that scores measurably worse than the serving params is
+        rejected before it touches a single replica."""
+        score = self.config.holdout_score
+        if score is None:
+            return True
+        s_old = float(score(self.base_params))
+        s_new = float(score(self.new_params))
+        self.holdout = {"old": s_old, "new": s_new}
+        ok = s_new >= s_old - self.config.holdout_margin
+        if not ok:
+            self._log("holdout_reject", -1,
+                      f"score {s_new:.4f} < {s_old:.4f} - "
+                      f"{self.config.holdout_margin}")
+        return ok
+
+    # -- stage machinery -------------------------------------------------
+
+    def _target_params(self):
+        return (self.new_params if self._phase == "forward"
+                else self.base_params)
+
+    def _target_version(self) -> str:
+        return (self.new_version if self._phase == "forward"
+                else self.old_version)
+
+    def _swap(self, slot: int) -> dict:
+        """Drained (or dead) slot -> replica at the phase's version.
+        Returns requests finished by a converge sweep the swap may have
+        triggered (rollback landing on its last slot)."""
+        router = self.router
+        old = router.replicas[slot]
+        clean = (slot not in router._dead
+                 and getattr(old, "in_flight", 1) == 0)
+        if self._phase == "forward":
+            reuse = None
+            self._old_replicas[slot] = old if clean else None
+        else:
+            reuse = self._old_replicas.get(slot)
+        rep = (reuse if reuse is not None
+               else self.make_replica(self._target_params(), slot))
+        direction = self._phase
+        if self._phase == "forward":
+            rep = _CanaryProbe(rep, self)
+            self._probe = rep
+        router.swap_replica(slot, rep)
+        self.versions[slot] = self._target_version()
+        obs.inc("fleet_rollout_swaps_total", direction=direction)
+        if self._phase == "forward":
+            router.mark_canary(slot)
+            self._start_canary(slot)
+            return {}
+        return self._next_rollback()
+
+    def _start_canary(self, slot: int) -> None:
+        cfg = self.config
+        self._t = obs.Telemetry()
+        self._rec = obs.TimeSeriesRecorder(capacity=128)
+        self._rec.track("fleet_rollout_canary_rejected_total")
+        self._rec.track("fleet_rollout_canary_submitted_total")
+        self._rec.track("fleet_rollout_canary_queue_wait_s")
+        self._monitors = [
+            obs.BurnRateMonitor(self._rec, obs.SloSpec(
+                name=f"rollout_canary_reject_r{slot}",
+                objective=cfg.reject_objective, kind="ratio",
+                source="fleet_rollout_canary_rejected_total",
+                total="fleet_rollout_canary_submitted_total"),
+                windows=cfg.windows),
+            obs.BurnRateMonitor(self._rec, obs.SloSpec(
+                name=f"rollout_canary_wait_r{slot}",
+                objective=cfg.queue_wait_objective, kind="quantile",
+                source="fleet_rollout_canary_queue_wait_s",
+                threshold_s=cfg.queue_wait_threshold_s),
+                windows=cfg.windows),
+        ]
+        self._breaker_open_tick = None
+        self._enter("canary")
+
+    def _unwrap_probe(self, slot: int) -> None:
+        """Swap the probe out for its inner replica (same object the
+        router has been stepping — not a swap_replica, which would reset
+        breaker history the canary legitimately earned)."""
+        p = self._probe
+        if p is not None and self.router.replicas[slot] is p:
+            self.router.replicas[slot] = p.__dict__["inner"]
+        self._probe = None
+
+    def _start_rollback(self, reason: str) -> dict:
+        self.rollback_reason = reason
+        slot = self.target
+        self.router.clear_canary(slot)
+        self._unwrap_probe(slot)
+        self._phase = "rollback"
+        # reverse completed swaps newest-first; dead new-version slots
+        # still queue — their "drain" is a no-op and the swap revives
+        self._rb_queue = [i for i in range(len(self.versions) - 1, -1, -1)
+                          if self.versions[i] == self.new_version]
+        obs.event("fleet.rollout_rolled_back", reason=reason,
+                  replica=slot, version=self.new_version,
+                  tick=self._tick)
+        fr = obs.flight()
+        if fr is not None:
+            fr.record("rollout", "rollback", reason=reason, replica=slot,
+                      version=self.new_version)
+        self._log("rollback", slot, reason)
+        return self._next_rollback()
+
+    def _next_rollback(self) -> dict:
+        if not self._rb_queue:
+            # converge BEFORE finishing: chaos may have killed a
+            # bystander still at the old version — revive it so the
+            # fleet is whole and single-versioned at rest
+            out = self._converge()
+            self._finish("rolled_back")
+            return out
+        self.target = self._rb_queue.pop(0)
+        self._begin_drain(self.target)
+        return {}
+
+    def _begin_drain(self, slot: int) -> None:
+        if slot not in self.router._dead:
+            self.router.begin_drain(slot)
+            self._note_rollout_phase(slot, "drain")
+        self._enter("drain")
+
+    def _converge(self) -> dict:
+        """Final sweep: every slot left dead or at a non-final version
+        (chaos mid-push) is replaced at the final version — the single-
+        version-at-rest invariant."""
+        final = self._target_version()
+        out: dict = {}
+        for slot in range(len(self.versions)):
+            dead = slot in self.router._dead
+            if not dead and self.versions[slot] == final:
+                continue
+            if not dead and self.router.replicas[slot].in_flight:
+                # mixed-version slot still holding work: salvage first
+                self._note_rollout_phase(slot, "converge")
+                out.update(self.router.fail_replica(slot))
+            self.router.swap_replica(
+                slot, self.make_replica(self._target_params(), slot))
+            self.versions[slot] = final
+            obs.inc("fleet_rollout_swaps_total", direction="converge")
+            self._log("converge", slot, "replaced")
+        return out
+
+    def _finish(self, outcome: str) -> None:
+        self.outcome = outcome
+        self.stage = "done"
+        obs.inc("fleet_rollout_total", outcome=outcome)
+        if outcome == "rolled_back":
+            obs.inc("fleet_rollout_rolled_back_total")
+        final = (self.new_version if outcome == "promoted"
+                 else self.old_version)
+        if outcome != "rejected":
+            obs.set_gauge("fleet_rollout_version_info", 1,
+                          version=final, kind=self.bundle.kind)
+            other = (self.old_version if outcome == "promoted"
+                     else self.new_version)
+            if other != final:
+                obs.set_gauge("fleet_rollout_version_info", 0,
+                              version=other, kind=self.bundle.kind)
+        h = self.router.health
+        if h is not None and hasattr(h, "on_transition"):
+            h.on_transition = self._prev_hook
+        self._log("done", -1, outcome)
+
+    # -- the tick --------------------------------------------------------
+
+    def tick(self) -> dict:
+        """Advance one router step; returns requests finished by forced
+        failovers this tick (merge with ``router.step()``'s output)."""
+        if self.done:
+            return {}
+        self._tick += 1
+        self._stage_ticks += 1
+        if self.stage == "drain":
+            return self._tick_drain()
+        if self.stage == "canary":
+            return self._tick_canary()
+        return {}
+
+    def _tick_drain(self) -> dict:
+        slot, cfg, router = self.target, self.config, self.router
+        out: dict = {}
+        if slot in router._dead:
+            pass                               # nothing to drain
+        elif router.replicas[slot].in_flight:
+            if (cfg.drain_timeout_ticks is not None
+                    and self._stage_ticks > cfg.drain_timeout_ticks):
+                # salvage-and-failover instead of raising: the budget is
+                # spent, so the stragglers re-place elsewhere exactly-
+                # once (their streamed tokens stitched back on) and the
+                # swap proceeds — zero drops either way
+                self._note_rollout_phase(slot, "drain_timeout")
+                obs.inc("fleet_rollout_drain_timeout_total",
+                        replica=str(slot))
+                self._log("drain_timeout", slot,
+                          f"{router.replicas[slot].in_flight} in flight")
+                out.update(router.fail_replica(slot))
+            else:
+                return out
+        out.update(self._swap(slot))
+        return out
+
+    def _tick_canary(self) -> dict:
+        slot, cfg = self.target, self.config
+        router = self.router
+        if slot in router._dead:
+            if cfg.rollback_on_canary_crash:
+                return self._start_rollback("canary_crashed")
+            return self._promote_target()
+        if (self._breaker_open_tick is not None
+                or (router.health is not None
+                    and router.health.state(slot) == "open")):
+            return self._start_rollback("canary_breaker_open")
+        rep = router.replicas[slot]
+        est = float(getattr(rep, "_chunk_s", 0.0) or 0.0)
+        mb = max(1, int(getattr(rep, "max_batch", 1)))
+        wait = est * len(getattr(rep, "_queue", ())) / mb
+        self._t.histogram("fleet_rollout_canary_queue_wait_s",
+                          replica=str(slot)).observe(wait)
+        obs.observe("fleet_rollout_canary_queue_wait_s", wait,
+                    replica=str(slot))
+        self._rec.sample(self._t)
+        burning = None
+        for m in self._monitors:
+            verdict = m.evaluate(obs.get())
+            if any(v["state"] == "burning" for v in verdict.values()):
+                burning = m.spec.name
+        if burning is not None:
+            return self._start_rollback(f"burn_gate:{burning}")
+        if self._stage_ticks >= cfg.canary_ticks:
+            return self._promote_target()
+        return {}
+
+    def _promote_target(self) -> dict:
+        slot = self.target
+        self.router.clear_canary(slot)
+        self._unwrap_probe(slot)
+        self._log("promoted", slot)
+        self.target += 1
+        if self.target >= len(self.versions):
+            out = self._converge()
+            self._finish("promoted")
+            return out
+        self._begin_drain(self.target)
+        return {}
+
+    def describe(self) -> dict:
+        return {
+            "stage": self.stage, "outcome": self.outcome,
+            "phase": self._phase, "target": self.target,
+            "old_version": self.old_version,
+            "new_version": self.new_version,
+            "versions": list(self.versions),
+            "rollback_reason": self.rollback_reason,
+            "holdout": self.holdout, "ticks": self._tick,
+            "bundle": self.bundle.describe(),
+            "log": list(self.log[-32:]),
+        }
+
+
+class WeightPushPlane:
+    """The fleet-facing weight-push surface: owns the promoted params and
+    version, builds bundles against them, runs rolling pushes, and
+    tracks FL-round freshness.
+
+    Wire an FL server in with ``server.run(nr_rounds,
+    on_round=plane.on_round)`` — every round advances the
+    ``fleet_rollout_rounds_behind`` gauge — then push a round's output
+    with :meth:`push_round` (or build a bundle and :meth:`push` /
+    :meth:`start` it directly).  Only a PROMOTED push moves
+    ``plane.params``; a rollback leaves the plane exactly where it was.
+    """
+
+    def __init__(self, router, make_replica, params, *,
+                 config: RolloutConfig | None = None):
+        self.router = router
+        self.make_replica = make_replica
+        self.params = params
+        self.version = version_of(params)
+        self.config = config or RolloutConfig()
+        self.serving_round: int | None = None
+        self.latest_round: int | None = None
+        self.history: list = []   # [(version, outcome, round_ix)]
+        self._active: RolloutController | None = None
+
+    # -- bundles ---------------------------------------------------------
+
+    def bundle_from(self, new_params, *, kind: str = "delta",
+                    compress: bool = False, round_ix=None,
+                    seed: int = 0) -> ParamBundle:
+        if kind == "full":
+            return ParamBundle.full(new_params, round_ix=round_ix)
+        if kind == "delta":
+            return ParamBundle.delta(self.params, new_params,
+                                     compress=compress, round_ix=round_ix,
+                                     seed=seed)
+        raise ValueError(
+            f"kind={kind!r}: build adapter bundles with "
+            "ParamBundle.adapter (they need explicit leaf paths)")
+
+    # -- pushes ----------------------------------------------------------
+
+    def start(self, bundle: ParamBundle) -> RolloutController:
+        """Begin a non-blocking rolling push; call :meth:`tick` after
+        every ``router.step()`` until ``controller.done``."""
+        if self._active is not None and not self._active.done:
+            raise RuntimeError("a rollout is already in progress")
+        ctrl = RolloutController(self.router, self.make_replica, bundle,
+                                 self.params, config=self.config)
+        self._active = ctrl
+        if ctrl.done:              # holdout-rejected before stage one
+            self._commit(ctrl)
+        return ctrl
+
+    def tick(self) -> dict:
+        if self._active is None:
+            return {}
+        out = self._active.tick()
+        if self._active.done:
+            self._commit(self._active)
+        return out
+
+    def _commit(self, ctrl: RolloutController) -> None:
+        if ctrl.outcome == "promoted":
+            self.params = ctrl.new_params
+            self.version = ctrl.new_version
+            if ctrl.bundle.round_ix is not None:
+                self.serving_round = ctrl.bundle.round_ix
+        self.history.append((ctrl.new_version, ctrl.outcome,
+                             ctrl.bundle.round_ix))
+        self._active = None
+        self._update_freshness()
+
+    def push(self, bundle: ParamBundle, *,
+             max_steps: int = 100_000) -> dict:
+        """Blocking convenience over a quiet (or already-loaded) fleet:
+        step + tick until the controller lands.  Requests finished along
+        the way — including drain-timeout salvage results, the
+        ``.partial`` merge of the blocking drain contract — come back in
+        ``finished``."""
+        ctrl = self.start(bundle)
+        finished: dict = {}
+        steps = 0
+        while not ctrl.done:
+            finished.update(self.router.step())
+            finished.update(self.tick())
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"rollout did not land within {max_steps} steps "
+                    f"(stage={ctrl.stage}, target={ctrl.target})")
+        return {"outcome": ctrl.outcome, "finished": finished,
+                "controller": ctrl}
+
+    # -- FL-round freshness ----------------------------------------------
+
+    def on_round(self, round_ix: int, result=None) -> None:
+        """``Server.run(on_round=...)`` hook: a new round exists; the
+        fleet is now (at least) one round behind until it is pushed."""
+        if self.latest_round is None or round_ix > self.latest_round:
+            self.latest_round = round_ix
+        self._update_freshness()
+
+    def push_round(self, round_ix: int, new_params, *,
+                   kind: str = "delta", compress: bool = False,
+                   seed: int = 0) -> dict:
+        """Push one FL round's params: build the bundle against the
+        promoted params and run it to completion."""
+        self.on_round(round_ix)
+        bundle = self.bundle_from(new_params, kind=kind,
+                                  compress=compress, round_ix=round_ix,
+                                  seed=seed)
+        return self.push(bundle)
+
+    def _update_freshness(self) -> None:
+        if self.latest_round is None:
+            return
+        serving = -1 if self.serving_round is None else self.serving_round
+        obs.set_gauge("fleet_rollout_rounds_behind",
+                      max(0, self.latest_round - serving))
+
+    def describe(self) -> dict:
+        return {"version": self.version,
+                "serving_round": self.serving_round,
+                "latest_round": self.latest_round,
+                "active": (self._active.describe()
+                           if self._active is not None else None),
+                "history": list(self.history[-16:])}

@@ -171,6 +171,7 @@ class FleetRouter:
         self.affinity_cap = affinity_cap
         self.health = health
         self._affinity: dict = {}   # prompt head -> last replica (LRU)
+        self._canary: set = set()   # canary slots (rollout plane)
         self._owner: dict = {}      # in-flight rid -> replica index
         self._requests: dict = {}   # rid -> (prompt, budget, deadline_s)
         self._salvaged: dict = {}   # failed-over rid -> tokens replayed
@@ -265,6 +266,7 @@ class FleetRouter:
             i, self.replicas[i], prompt, int(max_new_tokens),
             affinity_hit=self._affinity.get(head) == i,
             health_state=self._health_state(i),
+            canary=i in self._canary,
         ) for i in eligible]
         order = policy.rank_replicas(snaps)
         state = {"attempt": 0}
@@ -378,6 +380,11 @@ class FleetRouter:
         the failover (salvage already covered their whole budget)."""
         self._dead.add(ix)
         self._draining.discard(ix)
+        # purge stale prefix affinity NOW: post-failover placements must
+        # not chase prefix hits into a cache that no longer exists (and
+        # the affinity_hit telemetry would lie for every one that did)
+        self._affinity = {h: r for h, r in self._affinity.items()
+                          if r != ix}
         self.stats["replicas_failed"] += 1
         kind = getattr(exc, "kind", None) or "replica_crash"
         obs.inc("fleet_replica_failed_total", kind=kind,
@@ -473,6 +480,7 @@ class FleetRouter:
         snaps = [policy.snapshot_replica(
             i, self.replicas[i], prompt, remaining,
             affinity_hit=False, health_state=self._health_state(i),
+            canary=i in self._canary,
         ) for i in eligible]
         for ix in policy.rank_replicas(snaps):
             r = self.replicas[ix]
@@ -580,13 +588,40 @@ class FleetRouter:
             return {}
         return self._fail_over(i, None)
 
+    def begin_drain(self, i: int) -> None:
+        """Non-blocking half of :meth:`drain_replica`: replica ``i``
+        stops receiving new placements NOW, but the caller keeps
+        stepping the fleet itself (the rollout controller's tick loop
+        does this so live traffic flows while the replica empties).
+        No-op on a dead replica; :meth:`end_drain` or
+        :meth:`swap_replica` clears the mark."""
+        if not 0 <= i < len(self.replicas):
+            raise ValueError(f"no replica {i}")
+        if i not in self._dead:
+            self._draining.add(i)
+
+    def end_drain(self, i: int) -> None:
+        """Re-open replica ``i`` for placements (a drain that was
+        abandoned rather than completed by a swap)."""
+        self._draining.discard(i)
+
     def drain_replica(self, i: int, *,
                       timeout_s: float | None = None) -> dict:
         """Graceful drain for a rolling restart: replica ``i`` receives
         no new placements, and the fleet steps until its in-flight work
         completes — zero requests dropped.  Returns everything that
         finished fleet-wide during the drain; the replica is left marked
-        draining (``swap_replica`` clears it)."""
+        draining (``swap_replica`` clears it).
+
+        Timeout contract: on ``timeout_s`` expiry the raised
+        ``TimeoutError`` carries everything that DID finish as
+        ``.partial``, and replica ``i`` is left *draining with work
+        still in flight* — the drain made no destructive move, so the
+        caller chooses the recovery: keep stepping (the work is still
+        progressing), ``end_drain(i)`` to abandon the restart, or
+        ``fail_replica(i)`` to salvage-and-failover the stragglers
+        exactly-once (what the rollout controller's tick-budgeted drain
+        does — merge ``.partial`` with the failover's returns)."""
         if not 0 <= i < len(self.replicas):
             raise ValueError(f"no replica {i}")
         if i in self._dead:
@@ -608,7 +643,10 @@ class FleetRouter:
     def swap_replica(self, i: int, replica) -> None:
         """Replace replica ``i`` (dead or drained) with a fresh one and
         re-open it for placement.  Refuses to discard in-flight work —
-        ``drain_replica``/``fail_replica`` first."""
+        ``drain_replica``/``fail_replica`` first.  The old replica's
+        prefix-affinity entries are purged (the new replica's cache is
+        cold — a stale hit would route into nothing) and its breaker
+        history is reset."""
         if not 0 <= i < len(self.replicas):
             raise ValueError(f"no replica {i}")
         if i not in self._dead and self.replicas[i].in_flight:
@@ -617,10 +655,33 @@ class FleetRouter:
                 "requests in flight — drain_replica() or "
                 "fail_replica() first")
         self.replicas[i] = replica
+        try:
+            # decode chunks must trace as THIS slot (same best-effort
+            # stamp the ctor applies; fake/frozen replicas may refuse)
+            replica._replica_ix = i
+        except Exception:
+            pass
         self._dead.discard(i)
         self._draining.discard(i)
+        self._affinity = {h: r for h, r in self._affinity.items()
+                          if r != i}
         if self.health is not None:
             self.health.reset(i)
+
+    # -- canary marking (rollout plane) ----------------------------------
+
+    def mark_canary(self, i: int) -> None:
+        """Flag replica ``i`` as a rollout canary: the policy PREFERS it
+        among healthy feasible replicas so the canary window actually
+        collects evidence (a canary that sees no traffic proves
+        nothing); rejections re-route onward as usual, so preference
+        never costs a request."""
+        if not 0 <= i < len(self.replicas):
+            raise ValueError(f"no replica {i}")
+        self._canary.add(i)
+
+    def clear_canary(self, i: int) -> None:
+        self._canary.discard(i)
 
     def apply_scaling_hint(self, desired: int, *,
                            timeout_s: float | None = None) -> dict:

@@ -1,0 +1,588 @@
+"""Weight-push plane oracle (serving_fleet/rollout.py).
+
+The rolling push is a REARRANGEMENT of a serving fleet — so its whole
+contract is checkable by value with fake replicas, no model required:
+
+- ``version_of``/``ParamBundle`` are content-addressed and (uncompressed)
+  bit-exact: ``apply(old)`` reproduces ``new`` byte for byte, including
+  leaves where float rounding breaks ``old + (new-old) == new`` (those
+  fall back to full storage),
+- a no-op push (old == new params) over a LIVE seeded load trace leaves
+  every stream bit-identical to the no-push reference, drops nothing,
+  and lands ``fleet_rollout_total{outcome=promoted}`` exactly once,
+- a bad push (canary rejects everything) trips the reject burn gate,
+  auto-rolls back with zero drops, and dumps the flight recorder,
+- seeded ``ReplicaFaultSchedule`` chaos crashing a replica during each
+  rollout stage (drain, canary, bystander, rollback) still converges the
+  fleet to a single version at rest with no dropped/duplicated rids,
+- a drain that exceeds its tick budget salvages-and-fails-over
+  (continuation streams stay exact) instead of raising,
+- ``ring_broadcast`` delivers the source shard's bits to every shard of
+  a real device mesh.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ddl25spring_tpu import obs
+from ddl25spring_tpu.resilience import FaultyReplica, ReplicaFaultSchedule
+from ddl25spring_tpu.serving_fleet import (BreakerConfig, FleetHealth,
+                                           FleetRouter, ParamBundle,
+                                           RolloutConfig, RolloutController,
+                                           WeightPushPlane, version_of)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def clean_obs():
+    """Uninstall every process-global obs hook, whatever the test did."""
+    yield
+    obs.uninstall_flight()
+    obs.uninstall_reqtrace()
+    obs.uninstall_recorder()
+    obs.disable()
+
+
+# -- fakes -----------------------------------------------------------------
+
+
+class _Slot:
+    free = False
+
+    def __init__(self, rid, budget, ctx):
+        self.request_id = rid
+        self.budget = budget
+        self.ctx = list(ctx)
+        self.emitted = []
+
+
+class _VersionedFake:
+    """Streaming fake replica whose token function depends on its params
+    (offset = sum of the ``w`` leaf), so a pushed weight change is
+    visible in the streams — and a no-op push provably is not."""
+
+    def __init__(self, params, max_batch=4):
+        self.offset = int(np.asarray(params["w"]).sum()) % 997
+        self.max_batch = max_batch
+        self.prefill_width = 4096
+        self._queue = []
+        self.slots = []
+
+    @property
+    def in_flight(self):
+        return len(self._queue) + len(self.slots)
+
+    def submit(self, rid, prompt, budget, deadline_s=None):
+        self._queue.append((rid, list(prompt), int(budget)))
+
+    def step(self):
+        while self._queue and len(self.slots) < self.max_batch:
+            rid, prompt, b = self._queue.pop(0)
+            self.slots.append(_Slot(rid, b, prompt))
+        done = {}
+        for sl in list(self.slots):
+            tok = (sum(sl.ctx) + 7 * len(sl.ctx) + self.offset) % 997
+            sl.ctx.append(tok)
+            sl.emitted.append(tok)
+            if len(sl.emitted) >= sl.budget:
+                done[sl.request_id] = list(sl.emitted)
+                self.slots.remove(sl)
+        return done
+
+
+def _stream(prompt, budget, offset):
+    """Reference stream of one _VersionedFake request (no chaos)."""
+    ctx = list(prompt)
+    out = []
+    for _ in range(budget):
+        tok = (sum(ctx) + 7 * len(ctx) + offset) % 997
+        ctx.append(tok)
+        out.append(tok)
+    return out
+
+
+class _Reject(RuntimeError):
+    def __init__(self, reason="canary_sick"):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_s = 0.01
+
+
+class _RejectingFake(_VersionedFake):
+    """A sick new-version replica: every admission rejects (the shape the
+    burn gate's reject-rate SLO is built to catch)."""
+
+    def submit(self, rid, prompt, budget, deadline_s=None):
+        raise _Reject()
+
+
+P_OLD = {"w": np.arange(8, dtype=np.float32),
+         "b": np.ones(3, dtype=np.float32)}
+P_NEW = {"w": np.arange(8, dtype=np.float32) + 2.0,
+         "b": np.ones(3, dtype=np.float32)}
+OFF_OLD = int(P_OLD["w"].sum()) % 997
+OFF_NEW = int(P_NEW["w"].sum()) % 997
+
+
+def _mk(params, slot):
+    return _VersionedFake(params)
+
+
+def _drive(router, plane_or_ctrl, prompts, budget, *, max_steps=600,
+           submit_until=None):
+    """Live load loop: submit one request per step (while any remain),
+    stepping the router and ticking the push after each step — the
+    non-blocking discipline the controller documents.  Returns
+    ``{rid: tokens}`` of everything that finished."""
+    done = {}
+    pending = list(enumerate(prompts))
+    for step in range(max_steps):
+        if pending and (submit_until is None or step < submit_until):
+            rid, p = pending.pop(0)
+            router.submit(rid, p, budget)
+        done.update(router.step())
+        done.update(plane_or_ctrl.tick())
+        ctrl = getattr(plane_or_ctrl, "_active", plane_or_ctrl)
+        if (ctrl is None or ctrl.done) and not pending \
+                and router.in_flight == 0:
+            break
+    return done
+
+
+# -- versions & bundles ----------------------------------------------------
+
+
+def test_version_of_content_addressed():
+    a = {"x": np.arange(4, dtype=np.float32), "y": [np.int32(3)]}
+    b = {"y": [np.int32(3)], "x": np.arange(4, dtype=np.float32)}
+    assert version_of(a) == version_of(b)          # insertion order moot
+    c = {"x": np.arange(4, dtype=np.float64), "y": [np.int32(3)]}
+    assert version_of(a) != version_of(c)          # dtype is identity
+    d = {"x": np.arange(4, dtype=np.float32).reshape(2, 2),
+         "y": [np.int32(3)]}
+    assert version_of(a) != version_of(d)          # shape is identity
+    assert version_of(a) != version_of({"x": a["x"]})
+
+
+def test_delta_bundle_bit_exact_oracle_with_rounding_fallback():
+    rng = np.random.default_rng(0)
+    old = {"w": rng.standard_normal(32).astype(np.float32),
+           "big": np.float32(1e20) * np.ones(4, dtype=np.float32)}
+    new = {"w": (old["w"] * 1.01).astype(np.float32),
+           "big": np.ones(4, dtype=np.float32)}   # 1e20 + d never == 1.0
+    b = ParamBundle.delta(old, new)
+    # the catastrophic-cancellation leaf must have fallen back to full
+    assert b.entries["/big"][0] == "full"
+    assert b.entries["/w"][0] == "delta"
+    assert b.reconstructs(old, new)
+    got = b.apply(old)
+    for p in ("w", "big"):
+        assert got[p].tobytes() == new[p].tobytes()
+    assert b.version == version_of(new)
+    assert b.base_version == version_of(old)
+
+
+def test_delta_bundle_rejects_mismatched_trees():
+    with pytest.raises(ValueError, match="different tree paths"):
+        ParamBundle.delta({"a": np.ones(2)}, {"b": np.ones(2)})
+
+
+def test_full_and_adapter_bundles():
+    full = ParamBundle.full(P_NEW)
+    assert full.version == version_of(P_NEW)
+    assert full.reconstructs(P_OLD, P_NEW)
+    ad = ParamBundle.adapter(P_OLD, {"/w": P_NEW["w"]})
+    assert ad.kind == "adapter"
+    assert len(ad.entries) == 1                    # /b passes through
+    assert ad.reconstructs(P_OLD, P_NEW)
+    assert ad.version == version_of(P_NEW)
+    with pytest.raises(ValueError, match="not in base params"):
+        ParamBundle.adapter(P_OLD, {"/nope": np.ones(1)})
+
+
+def test_compressed_bundle_is_lossy_but_bounded():
+    jax = pytest.importorskip("jax")
+    del jax
+    rng = np.random.default_rng(1)
+    old = {"w": rng.standard_normal(64).astype(np.float32)}
+    new = {"w": old["w"] + 0.1 * rng.standard_normal(64).astype(np.float32)}
+    b = ParamBundle.delta(old, new, compress=True, seed=3)
+    assert b.compressed
+    got = b.apply(old)
+    d = np.abs(got["w"] - new["w"])
+    step = np.abs(new["w"] - old["w"]).max() / 127.0
+    assert d.max() <= 2.0 * step + 1e-7            # one int8 bin + dither
+    # version ids the RECONSTRUCTED target, so apply() is reproducible
+    assert b.version == version_of(got)
+
+
+# -- the no-op push: bit identity over live load ---------------------------
+
+
+def test_noop_push_bit_identical_streams_zero_drop(clean_obs):
+    t = obs.enable()
+    router = FleetRouter([_VersionedFake(P_OLD) for _ in range(3)],
+                         health=FleetHealth(3))
+    plane = WeightPushPlane(router, _mk, P_OLD)
+    v0 = plane.version
+    prompts = [[3 + i, 5, 7] for i in range(24)]
+    bundle = plane.bundle_from(P_OLD)              # old == new: no-op
+    assert bundle.version == v0
+    ctrl = plane.start(bundle)
+    done = _drive(router, plane, prompts, budget=6)
+    # zero drops, zero duplicates, every stream bit-identical to the
+    # no-push reference (the token fn only sees params + context)
+    assert sorted(done) == list(range(len(prompts)))
+    for rid, p in enumerate(prompts):
+        assert list(done[rid]) == _stream(p, 6, OFF_OLD), rid
+    assert ctrl.outcome == "promoted"
+    assert set(ctrl.versions) == {v0}              # single version at rest
+    assert plane.version == v0
+    assert t.counter("fleet_rollout_total", outcome="promoted").value == 1
+    assert t.counter("fleet_rollout_swaps_total",
+                     direction="forward").value == 3
+    assert t.gauge("fleet_rollout_version_info",
+                   version=v0, kind="delta").value == 1
+    assert router._owner == {} and router._orphans == []
+
+
+def test_real_push_promotes_and_switches_streams(clean_obs):
+    t = obs.enable()
+    router = FleetRouter([_VersionedFake(P_OLD) for _ in range(3)])
+    plane = WeightPushPlane(router, _mk, P_OLD)
+    res = plane.push(plane.bundle_from(P_NEW))
+    assert res["outcome"] == "promoted"
+    assert plane.version == version_of(P_NEW)
+    assert all(r.offset == OFF_NEW for r in router.replicas)
+    # post-push traffic decodes with the NEW weights
+    router.submit("after", [9, 9], 4)
+    done = {}
+    while router.in_flight:
+        done.update(router.step())
+    assert list(done["after"]) == _stream([9, 9], 4, OFF_NEW)
+    assert t.counter("fleet_rollout_total", outcome="promoted").value == 1
+
+
+# -- the bad push: burn gate, rollback, flight dump ------------------------
+
+
+def test_bad_push_burn_gated_rollback_zero_drop(clean_obs, tmp_path):
+    t = obs.enable()
+    fr = obs.install_flight(out_dir=tmp_path)
+    router = FleetRouter([_VersionedFake(P_OLD) for _ in range(3)],
+                         health=FleetHealth(3))
+
+    def mk_bad(params, slot):
+        if version_of(params) == version_of(P_NEW):
+            return _RejectingFake(params)
+        return _VersionedFake(params)
+
+    plane = WeightPushPlane(router, mk_bad, P_OLD,
+                            config=RolloutConfig(canary_ticks=64))
+    ctrl = plane.start(plane.bundle_from(P_NEW))
+    prompts = [[2 + i, 11] for i in range(30)]
+    done = _drive(router, plane, prompts, budget=5)
+    # zero drops: every rejected-by-canary submission re-routed onward
+    assert sorted(done) == list(range(len(prompts)))
+    for rid, p in enumerate(prompts):
+        assert list(done[rid]) == _stream(p, 5, OFF_OLD), rid
+    assert ctrl.outcome == "rolled_back"
+    assert ctrl.rollback_reason.startswith("burn_gate:")
+    assert "reject" in ctrl.rollback_reason
+    assert set(ctrl.versions) == {version_of(P_OLD)}
+    assert plane.version == version_of(P_OLD)      # plane kept old params
+    assert all(r.offset == OFF_OLD for r in router.replicas)
+    assert t.counter("fleet_rollout_total",
+                     outcome="rolled_back").value == 1
+    assert t.counter("fleet_rollout_rolled_back_total").value == 1
+    assert t.counter("fleet_rollout_swaps_total",
+                     direction="forward").value == 1
+    assert t.counter("fleet_rollout_swaps_total",
+                     direction="rollback").value == 1
+    # the rollback dumped the black box
+    assert any("rollout_rollback" in p.name for p in fr.dumps)
+
+
+def test_holdout_gate_rejects_before_touching_the_fleet(clean_obs):
+    t = obs.enable()
+    reps = [_VersionedFake(P_OLD) for _ in range(2)]
+    router = FleetRouter(list(reps))
+    worse = {"w": P_OLD["w"] - 5.0, "b": P_OLD["b"]}
+    cfg = RolloutConfig(
+        holdout_score=lambda p: float(np.asarray(p["w"]).mean()))
+    plane = WeightPushPlane(router, _mk, P_OLD, config=cfg)
+    ctrl = plane.start(plane.bundle_from(worse))
+    assert ctrl.done and ctrl.outcome == "rejected"
+    assert router.replicas == reps                 # untouched fleet
+    assert ctrl.holdout["new"] < ctrl.holdout["old"]
+    assert t.counter("fleet_rollout_total", outcome="rejected").value == 1
+    assert plane.version == version_of(P_OLD)
+    assert plane._active is None                   # plane ready to push
+
+
+# -- chaos: single version at rest whatever crashes mid-push ---------------
+
+
+def _chaos_push(stage, *, bad=False):
+    """One seeded chaos scenario: crash a replica while the push is in
+    the given stage; returns (controller, done, router, plane)."""
+    crash_at = {
+        "drain": ((0, 4),),       # the draining replica dies mid-drain
+        "bystander": ((2, 8),),   # an untouched replica dies in canary
+        "rollback": ((1, 6),),    # an old-version replica dies while
+                                  # the bad push is rolling back
+    }.get(stage)
+    sched = (ReplicaFaultSchedule(crash_at=crash_at)
+             if crash_at is not None else None)
+    base = [
+        FaultyReplica(_VersionedFake(P_OLD), sched, i) if sched else
+        _VersionedFake(P_OLD)
+        for i in range(3)]
+    router = FleetRouter(base, health=FleetHealth(3))
+
+    canary_sched = ReplicaFaultSchedule(crash_at=((0, 3),))
+
+    def mk(params, slot):
+        rep = (_RejectingFake(params) if bad
+               and version_of(params) == version_of(P_NEW)
+               else _VersionedFake(params))
+        if stage == "canary" and slot == 0 \
+                and version_of(params) == version_of(P_NEW):
+            return FaultyReplica(rep, canary_sched, 0)
+        return rep
+
+    plane = WeightPushPlane(router, mk, P_OLD,
+                            config=RolloutConfig(canary_ticks=12))
+    prompts = [[4 + i, 13] for i in range(18)]
+    # pre-load the fleet so the first drain is not trivially empty (the
+    # drain-stage crash must land while slot 0 still holds work)
+    done = {}
+    for rid in range(6):
+        router.submit(rid, prompts[rid], 5)
+    done.update(router.step())
+    done.update(router.step())
+    ctrl = plane.start(plane.bundle_from(P_NEW))
+    rest = list(enumerate(prompts))[6:]
+    for step in range(600):
+        if rest:
+            rid, p = rest.pop(0)
+            router.submit(rid, p, 5)
+        done.update(router.step())
+        done.update(plane.tick())
+        if ctrl.done and not rest and router.in_flight == 0:
+            break
+    return ctrl, done, router, plane
+
+
+@pytest.mark.parametrize("stage,bad,outcome", [
+    ("drain", False, "promoted"),       # crash during drain of slot 0
+    ("canary", False, "rolled_back"),   # the canary replica crashes
+    ("bystander", False, "promoted"),   # an uninvolved replica crashes
+    ("rollback", True, "rolled_back"),  # crash while rolling back
+])
+def test_chaos_mid_rollout_single_version_at_rest(stage, bad, outcome,
+                                                  clean_obs):
+    t = obs.enable()
+    ctrl, done, router, plane = _chaos_push(stage, bad=bad)
+    assert ctrl.outcome == outcome
+    final = version_of(P_NEW if outcome == "promoted" else P_OLD)
+    off = OFF_NEW if outcome == "promoted" else OFF_OLD
+    # the invariant: one version at rest, no dead replicas left behind
+    assert set(ctrl.versions) == {final}
+    assert router._dead == set()
+    assert plane.version == final
+    # no dropped, no duplicated rids: every request finishes exactly
+    # once with its FULL budget (a drop would be a missing rid, a
+    # truncation a short stream, a duplicate an overlong one)
+    assert sorted(done) == list(range(18))
+    assert all(len(done[rid]) == 5 for rid in done)
+    # streams that never touched a crashing/swapped replica decode as a
+    # pure single-version stream; ones that crossed a crash are stitched
+    # mixed-version (salvage + continuation) — still exactly once.  The
+    # bulk must match a pure reference by value:
+    exact = sum(1 for rid in done
+                if list(done[rid]) == _stream([4 + rid, 13], 5, off)
+                or list(done[rid]) == _stream([4 + rid, 13], 5,
+                                              OFF_OLD))
+    assert exact >= 12
+    assert t.counter("fleet_rollout_total", outcome=outcome).value == 1
+    assert router._owner == {} and router._orphans == []
+    if outcome == "rolled_back":
+        assert t.counter("fleet_rollout_rolled_back_total").value == 1
+
+
+def test_canary_crash_reason_and_counters(clean_obs):
+    t = obs.enable()
+    ctrl, _done, router, _plane = _chaos_push("canary")
+    assert ctrl.rollback_reason == "canary_crashed"
+    # forward swap of slot 0, then the rollback swap reviving it
+    assert t.counter("fleet_rollout_swaps_total",
+                     direction="forward").value == 1
+    assert t.counter("fleet_rollout_swaps_total",
+                     direction="rollback").value == 1
+    assert router._dead == set()
+
+
+# -- drain timeout: salvage-and-failover, not an exception -----------------
+
+
+def test_drain_timeout_salvages_and_fails_over(clean_obs):
+    t = obs.enable()
+    router = FleetRouter([_VersionedFake(P_OLD) for _ in range(3)])
+    plane = WeightPushPlane(
+        router, _mk, P_OLD,
+        config=RolloutConfig(drain_timeout_ticks=3, canary_ticks=30))
+    # a long request pinned to replica 0 cannot drain inside 3 ticks
+    router.submit("long", [17], 20)
+    assert router._owner["long"] == 0
+    ctrl = plane.start(plane.bundle_from(P_NEW))
+    done = {}
+    for _ in range(400):
+        done.update(router.step())
+        done.update(plane.tick())
+        if ctrl.done and router.in_flight == 0:
+            break
+    assert ctrl.outcome == "promoted"
+    assert t.counter("fleet_rollout_drain_timeout_total",
+                     replica="0").value == 1
+    # the straggler was salvaged (tokens streamed on replica 0 under the
+    # OLD weights) and continued elsewhere — still old weights at that
+    # point, so the whole stream equals the old-params reference
+    assert list(done["long"]) == _stream([17], 20, OFF_OLD)
+    assert router.stats["failed_over"] == 1
+    assert set(ctrl.versions) == {version_of(P_NEW)}
+
+
+# -- FL-round freshness ----------------------------------------------------
+
+
+def test_plane_round_freshness_gauge_and_push_round(clean_obs):
+    t = obs.enable()
+    router = FleetRouter([_VersionedFake(P_OLD) for _ in range(2)])
+    plane = WeightPushPlane(router, _mk, P_OLD)
+    plane.on_round(0)
+    plane.on_round(2)                              # rounds exist, unserved
+    g = t.gauge("fleet_rollout_rounds_behind")
+    assert g.value == 3                            # serving none (-1)
+    res = plane.push_round(2, P_NEW)
+    assert res["outcome"] == "promoted"
+    assert plane.serving_round == 2
+    assert g.value == 0
+    assert plane.history[-1] == (version_of(P_NEW), "promoted", 2)
+
+
+def test_plane_refuses_concurrent_pushes(clean_obs):
+    router = FleetRouter([_VersionedFake(P_OLD) for _ in range(2)])
+    plane = WeightPushPlane(router, _mk, P_OLD)
+    plane.start(plane.bundle_from(P_NEW))
+    with pytest.raises(RuntimeError, match="already in progress"):
+        plane.start(plane.bundle_from(P_NEW))
+
+
+# -- reqtrace: the rollout phase in the waterfall --------------------------
+
+
+def test_requests_crossing_a_push_carry_rollout_phases(clean_obs):
+    obs.enable()
+    rt = obs.install_reqtrace(seed=3)
+    router = FleetRouter([_VersionedFake(P_OLD) for _ in range(2)])
+    plane = WeightPushPlane(router, _mk, P_OLD,
+                            config=RolloutConfig(canary_ticks=4))
+    router.submit("r0", [5, 5], 12)                # rides through the push
+    plane.start(plane.bundle_from(P_NEW))
+    done = {}
+    for _ in range(200):
+        done.update(router.step())
+        done.update(plane.tick())
+        if router.in_flight == 0 and plane._active is None:
+            break
+    events = rt.trace("r0").events
+    phases = [e["phase"] for e in events]
+    assert "rollout" in phases
+    ev = next(e for e in events if e["phase"] == "rollout")
+    assert ev["stage"] == "drain"
+    assert ev["to_version"] == version_of(P_NEW)
+
+
+# -- ring broadcast on a real device mesh ----------------------------------
+
+
+def test_ring_broadcast_world1_is_identity():
+    from ddl25spring_tpu.fl.sharding import ring_broadcast
+    tree = {"w": np.arange(3, dtype=np.float32)}
+    assert ring_broadcast(tree, world=1) is tree
+
+
+def test_ring_broadcast_delivers_source_bits_to_all_shards():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ddl25spring_tpu.fl.sharding import ring_broadcast
+    from ddl25spring_tpu.parallel import make_mesh
+    from ddl25spring_tpu.parallel.compat import shard_map
+
+    mesh = make_mesh({"clients": 4})
+
+    def body():
+        me = jax.lax.axis_index("clients")
+        tree = {"w": (me + 1) * jnp.arange(1, 6, dtype=jnp.float32),
+                "n": (me + 1) * jnp.ones((), jnp.int32)}
+        return ring_broadcast(tree, world=4, source=2)
+
+    out = shard_map(body, mesh=mesh, in_specs=(), out_specs=P(),
+                    check_vma=False)()
+    # out_specs=P() asserts all shards agree; values must be source 2's
+    np.testing.assert_array_equal(
+        np.asarray(out["w"]), 3.0 * np.arange(1, 6, dtype=np.float32))
+    assert int(out["n"]) == 3
+
+
+def test_distribute_delta_roundtrips_host_tree():
+    from ddl25spring_tpu.parallel import make_mesh
+    from ddl25spring_tpu.serving_fleet.rollout import distribute_delta
+
+    mesh = make_mesh({"clients": 4})
+    rng = np.random.default_rng(7)
+    tree = {"w": rng.standard_normal(10).astype(np.float32),
+            "k": np.arange(6, dtype=np.int32)}
+    out = distribute_delta(tree, mesh)
+    for k in tree:
+        assert out[k].tobytes() == tree[k].tobytes(), k
+
+
+# -- tooling: the rollout section of obs_report ----------------------------
+
+
+def test_obs_report_shows_rollout_section(clean_obs, tmp_path, capsys):
+    jsonl = tmp_path / "rollout.jsonl"
+    obs.enable(str(jsonl))
+    router = FleetRouter([_VersionedFake(P_OLD) for _ in range(2)])
+
+    def mk_bad(params, slot):
+        if version_of(params) == version_of(P_NEW):
+            return _RejectingFake(params)
+        return _VersionedFake(params)
+
+    plane = WeightPushPlane(router, mk_bad, P_OLD,
+                            config=RolloutConfig(canary_ticks=32))
+    plane.start(plane.bundle_from(P_NEW))
+    _drive(router, plane, [[6 + i] for i in range(16)], budget=4)
+    plane.on_round(0)
+    obs.flush()
+    obs.disable()
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from obs_report import load_events, report
+
+        report(load_events(jsonl), top=8)
+    finally:
+        sys.path.remove(str(REPO / "tools"))
+    text = capsys.readouterr().out
+    assert "== weight pushes" in text
+    assert "rolled_back=1" in text
+    assert "rollback" in text

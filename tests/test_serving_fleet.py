@@ -617,6 +617,30 @@ def test_owner_lifecycle_no_stale_entries():
     router2.drain()
 
 
+def test_affinity_purged_on_swap_and_fail():
+    # regression: swap_replica/fail_replica used to leave _affinity
+    # entries pointing at the replaced/dead replica, so post-swap
+    # placements chased prefix hits into a cache that no longer exists
+    # (and affinity_hit telemetry lied for every one that did)
+    router = FleetRouter([_StreamFake(), _StreamFake()])
+    head = [5, 5, 5]
+    router.submit("a", head, 2)
+    ix = router._owner["a"]
+    router.drain()
+    assert router._affinity == {router._head_key(head): ix}
+    router.drain_replica(ix)
+    router.swap_replica(ix, _StreamFake())
+    assert router._affinity == {}            # swap purged the stale hit
+    # same via the failover path
+    other = 1 - ix
+    router.submit("b", head, 2)
+    assert router._owner["b"] in (ix, other)
+    victim = router._owner["b"]
+    router.fail_replica(victim)
+    assert all(r != victim for r in router._affinity.values())
+    router.drain()
+
+
 def test_drain_timeout_attaches_partial():
     sched = ReplicaFaultSchedule(hang_at=((0, 1),), hang_steps=10 ** 6)
     reps = [FaultyReplica(_StreamFake(), sched, i) for i in range(2)]

@@ -24,6 +24,9 @@ Cells and their direction:
 - ``serving_saturation`` / ``fleet_routing`` ``probe_goodput_rps`` and
   ``knee_qps`` — higher better;
 - ``fleet_chaos.goodput_retention`` — higher better;
+- ``fleet_rollout.goodput_retention`` — higher better — and
+  ``fleet_rollout.rollback_latency_s`` — lower better (the weight-push
+  plane's overhead under live load and its auto-revert cost);
 - MULTICHIP ``ok`` flipping true→false, or ``n_devices`` shrinking.
 
 Zero deps beyond the stdlib (the tier-1 suite runs ``--dry-run`` as a
@@ -55,6 +58,8 @@ _SCALAR_CELLS = (
     ("fleet_routing.probe_goodput_rps", True),
     ("fleet_routing.knee_qps", True),
     ("fleet_chaos.goodput_retention", True),
+    ("fleet_rollout.goodput_retention", True),
+    ("fleet_rollout.rollback_latency_s", False),
 )
 
 

@@ -639,6 +639,60 @@ def report(events: list[dict], top: int) -> None:
             print(f"  tokens replayed into continuation prefills: "
                   f"{replayed}")
 
+    # -- weight pushes (serving_fleet/rollout.py) ------------------------
+    pushes = take(counters, "fleet_rollout_total")
+    rollbacks = _value(counters, "fleet_rollout_rolled_back_total")
+    take(counters, "fleet_rollout_rolled_back_total")
+    swaps = take(counters, "fleet_rollout_swaps_total")
+    drain_to = take(counters, "fleet_rollout_drain_timeout_total")
+    canary_sub = take(counters, "fleet_rollout_canary_submitted_total")
+    canary_rej = take(counters, "fleet_rollout_canary_rejected_total")
+    take(hists, "fleet_rollout_canary_queue_wait_s")
+    behind = _value(gauges, "fleet_rollout_rounds_behind")
+    take(gauges, "fleet_rollout_rounds_behind")
+    version_info = take(gauges, "fleet_rollout_version_info")
+    rb_events = [e for e in events
+                 if e.get("event") == "fleet.rollout_rolled_back"]
+    if pushes or swaps or rb_events:
+        section("weight pushes (rollout plane)")
+        if pushes:
+            by_outcome = "   ".join(
+                f"{lb.get('outcome', '?')}={int(st['value'])}"
+                for lb, st in sorted(
+                    pushes, key=lambda ls: ls[0].get("outcome", "")))
+            total = int(sum(st["value"] for _, st in pushes))
+            print(f"  pushes: {total}   {by_outcome}   "
+                  f"rolled_back={int(rollbacks or 0)}")
+        if swaps:
+            parts = "   ".join(
+                f"{lb.get('direction', '?')}={int(st['value'])}"
+                for lb, st in sorted(
+                    swaps, key=lambda ls: ls[0].get("direction", "")))
+            print(f"  replica swaps: {parts}")
+        if drain_to:
+            parts = "   ".join(
+                f"r{lb.get('replica', '?')}={int(st['value'])}"
+                for lb, st in sorted(
+                    drain_to, key=lambda ls: ls[0].get("replica", "")))
+            print(f"  drain timeouts (salvaged-and-failed-over): {parts}")
+        if canary_sub or canary_rej:
+            sub = int(sum(st["value"] for _, st in canary_sub))
+            rej = int(sum(st["value"] for _, st in canary_rej))
+            frac = f" ({rej / sub:.0%} rejected)" if sub else ""
+            print(f"  canary traffic: submitted={sub} "
+                  f"rejected={rej}{frac}")
+        for e in rb_events:
+            print(f"  rollback: reason={e.get('reason', '?')} "
+                  f"replica={e.get('replica', '?')} "
+                  f"version={e.get('version', '?')}")
+        if version_info:
+            serving = [lb.get("version", "?") for lb, st in version_info
+                       if st["value"] == 1]
+            if serving:
+                print(f"  serving version: {'  '.join(sorted(serving))}")
+        if behind is not None:
+            print(f"  rounds behind (fl freshness): {int(behind)}")
+
     # -- time series + SLO burn rate + autoscale -------------------------
     # rendered from the last ``timeseries`` event (obs.flush with a
     # recorder installed) plus the streamed transition/decision events
