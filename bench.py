@@ -323,6 +323,54 @@ def timed_rounds(server, nr_rounds: int, fused: bool = True,
     return rates
 
 
+def _calibrate_costs(server, rounds: int = 6) -> dict:
+    """Profile ``rounds`` sequential (unfused) engine rounds through the
+    step profiler and fit ``results/calib_*.json`` — the same fit
+    ``tools/calibrate.py`` runs offline, done in-process here so one
+    ``--calibrate-costs`` bench invocation lands both the capture and
+    the versioned cost model (the queued-capture protocol re-runs this
+    argv on the next live TPU window, refreshing device calibration
+    automatically)."""
+    import jax
+
+    from ddl25spring_tpu.obs import fit_cost_model, save_calibration
+
+    # one unprofiled warmup: the sequential dispatch may compile fresh
+    # (timed_rounds defaults to the fused fori_loop program)
+    params = jax.block_until_ready(
+        server.round_fn(server.params, server.run_key, 0))
+    prof = obs.install_profiler(seed=0)
+    try:
+        for r in range(1, rounds + 1):
+            params = server.round_fn(params, server.run_key, r)
+        jax.block_until_ready(params)
+    finally:
+        obs.uninstall_profiler()
+    capture = prof.capture()
+    results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+    os.makedirs(results, exist_ok=True)
+    backend = jax.default_backend()
+    cap_path = os.path.join(results, f"profile_capture_{backend}.json")
+    with open(cap_path, "w") as f:
+        json.dump(capture, f, sort_keys=True)
+    model = fit_cost_model(capture)
+    t = obs.get()
+    if t is not None:
+        # the freshness anchor obs_report's calibration line reads:
+        # rounds served at capture time vs rounds served now
+        model.extras["captured_at_rounds"] = int(
+            t.counter("fl_rounds_total").value)
+    calib_path = save_calibration(model, results)
+    phase = model.phases.get("fl.round") or {}
+    return {"capture": os.path.basename(cap_path),
+            "artifact": os.path.basename(calib_path),
+            "model_version": model.version[:12],
+            "nr_samples": model.source.get("nr_samples", 0),
+            "fl_round_mean_s": phase.get("mean_seconds"),
+            "fit_mean_rel_err": phase.get("fit_mean_rel_err")}
+
+
 def measure_cpu_baseline():
     """Rounds/sec of the REFERENCE architecture on this container's CPU: a
     sequential Python loop over the 26 sampled clients (hfl_complete.py's
@@ -632,6 +680,8 @@ def run_cpu_trend(nr_rounds: int = 2):
     fleet_chaos = _fleet_chaos_cell()
     _stamp("cpu trend: fleet rollout cell ...")
     fleet_rollout = _fleet_rollout_cell()
+    _stamp("cpu trend: capacity model cell ...")
+    capacity_model = _capacity_model_cell()
     print(json.dumps({
         "metric": CPU_TREND_METRIC,
         "value": round(nr_rounds / dt, 4),
@@ -648,6 +698,7 @@ def run_cpu_trend(nr_rounds: int = 2):
         "fleet_routing": fleet_routing,
         "fleet_chaos": fleet_chaos,
         "fleet_rollout": fleet_rollout,
+        "capacity_model": capacity_model,
         "wall_s": round(time.perf_counter() - t_start, 1),
     }))
     sys.stdout.flush()
@@ -795,6 +846,83 @@ def _fused_decode_step_cell(nr_requests: int = 4, budget: int = 5):
     return {"nr_requests": nr_requests, "budget": budget,
             "decode_steps": int(steps),
             "steps_per_sec": round(steps / dt, 4)}
+
+
+def _capacity_model_cell(nr_requests: int = 8, budget: int = 8):
+    """Predicted-vs-measured quality of the calibrated step-cost model
+    (obs/capacity.py) on the PAGED streaming batcher: profile one seeded
+    workload through the step() path, fit the deterministic cost model,
+    then score a second identical workload against its predictions.
+    ``mean_rel_err`` is the number ``bench_regression`` gates
+    (lower better) — calibration-quality regressions block like perf
+    regressions.  The scoring run ALSO drives the installed
+    ``CapacityScorer``, so the ``capacity_model_error`` gauge is
+    exercised on every trend capture, not just in tests."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu import obs
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+
+    cfg = LlamaConfig(vocab_size=128, dmodel=48, nr_heads=4,
+                      nr_kv_heads=2, nr_layers=2, ctx_size=48,
+                      dtype=jnp.float32)
+    params = Llama(cfg).init(jax.random.PRNGKey(0),
+                             jnp.ones((1, 4), jnp.int32))
+
+    def make_batcher():
+        return ContinuousBatcher(cfg, params, max_batch=2,
+                                 prefill_width=8, kv_layout="paged",
+                                 kv_page=8)
+
+    prng = np.random.default_rng(0)
+    prompts = [prng.integers(1, 128,
+                             size=int(prng.integers(3, 8))).tolist()
+               for _ in range(nr_requests)]
+    budgets = [budget] * nr_requests
+
+    def drive(batcher):
+        for i, (p, b) in enumerate(zip(prompts, budgets)):
+            batcher.submit(i, p, b)
+        return batcher.drain()
+
+    drive(make_batcher())  # compile + warm
+    prof = obs.install_profiler(seed=0)
+    drive(make_batcher())
+    capture = prof.capture()
+    obs.uninstall_profiler()
+    model = obs.fit_cost_model(capture, min_samples=2)
+
+    owned = obs.get() is None
+    t = obs.enable() if owned else obs.get()
+    scorer = obs.install_capacity(model=model, threshold=1e9, window=4)
+    prof2 = obs.install_profiler(seed=1)
+    drive(make_batcher())
+    scored = prof2.capture()
+    obs.uninstall_profiler()
+    obs.uninstall_capacity()
+    gauge = t.gauge("capacity_model_error",
+                    phase="serving.decode").value
+    if owned:
+        obs.disable()
+
+    errs = []
+    for phase, groups in (scored.get("phases") or {}).items():
+        for g in groups:
+            for s in g["seconds"]:
+                pred = model.predict(phase, **g["covariates"])
+                if pred is not None and s > 0:
+                    errs.append(abs(pred - s) / s)
+    mean_rel_err = (sum(errs) / len(errs)) if errs else 0.0
+    return {"nr_requests": nr_requests, "budget": budget,
+            "nr_samples": len(errs),
+            "model_version": model.version[:12],
+            "gauge_rel_err": round(float(gauge), 4),
+            "mean_rel_err": round(mean_rel_err, 4),
+            "windowed_err": {p: round(v, 4)
+                             for p, v in sorted(scorer.last_error.items())}}
 
 
 def _serving_saturation_cell(qps_factors=(0.5, 1.0, 2.0),
@@ -1401,6 +1529,15 @@ def main():
                          "(JAX_PLATFORMS=cpu or no non-CPU device "
                          "registered) — for deliberate CPU measurements "
                          "only; the headline metric assumes a TPU")
+    ap.add_argument("--calibrate-costs", action="store_true",
+                    help="after the timed rounds, profile a few "
+                         "sequential engine rounds through the step "
+                         "profiler and write results/profile_capture_"
+                         "<backend>.json + results/calib_*.json (the "
+                         "step-cost model the capacity plane and the "
+                         "ROADMAP-5 fleet twin consume); rides the "
+                         "queued-capture protocol so the next live TPU "
+                         "window refreshes device calibration")
     ap.add_argument("--deadline-s", type=float, default=1500.0,
                     help="no-progress (idle) cap after the device probe: if "
                          "no milestone or transfer-chunk stamp lands for "
@@ -1537,6 +1674,16 @@ def main():
     else:
         rates = timed_rounds(server, args.rounds,
                              fused=not args.no_fused, trials=args.trials)
+    calibration = None
+    if args.calibrate_costs:
+        _stamp("timed rounds done; cost-model calibration ...")
+        try:
+            calibration = _calibrate_costs(server,
+                                           rounds=max(3, args.rounds // 2))
+        except Exception as e:  # noqa: BLE001 — calibration is a rider;
+            # its crash must not void the headline capture
+            calibration = {"error": f"{type(e).__name__}: {e}"}
+        _stamp(f"calibration done: {calibration.get('artifact')}")
     _stamp("timed rounds done; kernel microbench ...")
     try:
         kernels = kernel_microbench()
@@ -1572,6 +1719,7 @@ def main():
                spread_pct=round(spread_pct, 2),
                first_execution_rps=round(rates[0], 4),
                kernels=kernels,
+               **({"calibration": calibration} if calibration else {}),
                **stack_bytes)
 
 

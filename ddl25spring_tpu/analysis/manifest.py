@@ -40,6 +40,10 @@ HOST_ONLY_MODULES = (
     # request traces + crash flight recorder (postmortems run anywhere)
     "ddl25spring_tpu.obs.reqtrace",
     "ddl25spring_tpu.obs.flight",
+    # cost-attribution profile plane (step profiler + calibrated
+    # cost/capacity models — the fleet-twin calibration input)
+    "ddl25spring_tpu.obs.profile",
+    "ddl25spring_tpu.obs.capacity",
     # host-side secure-aggregation accounting (Shamir, field budgets,
     # session bookkeeping — the jnp mask math lives in masks/kernels)
     "ddl25spring_tpu.secagg",

@@ -2004,16 +2004,38 @@ def make_fl_round(
             if _secagg_host_round(base_key, int(round_idx)):
                 obs.inc("fl_round_rejected_total", reason="secagg_floor")
         if not obs.enabled() or tracer:
-            out = _round_dispatch(params, base_key, round_idx, x_r, y_r,
-                                  counts, mal_mask)
+            prof = None if tracer else obs.profiler()
+            if prof is None:
+                out = _round_dispatch(params, base_key, round_idx, x_r, y_r,
+                                      counts, mal_mask)
+                return out[0] if fault_plan is not None else out
+            # profiler-only path: fence so the sample covers the device
+            # work (block_until_ready returns the same arrays — round
+            # outputs stay bit-identical to the unprofiled dispatch)
+            t_round = time.perf_counter()
+            out = jax.block_until_ready(
+                _round_dispatch(params, base_key, round_idx, x_r, y_r,
+                                counts, mal_mask))
+            prof.record("fl.round",
+                        seconds=time.perf_counter() - t_round,
+                        cohort=nr_sampled, shards=shard_world,
+                        chunk=chunk or 0)
             return out[0] if fault_plan is not None else out
         step = int(round_idx)
+        prof = obs.profiler()
+        t_round = time.perf_counter() if prof is not None else 0.0
         with obs.span("fl.round", round=step) as sp:
             with obs.step_annotation("fl.round", step):
                 out = sp.fence(
                     _round_dispatch(params, base_key, round_idx, x_r, y_r,
                                     counts, mal_mask)
                 )
+        if prof is not None:
+            # the fence above already blocked, so this is the same
+            # device-inclusive duration the profiler-only path records
+            prof.record("fl.round", seconds=time.perf_counter() - t_round,
+                        cohort=nr_sampled, shards=shard_world,
+                        chunk=chunk or 0)
         if fault_plan is not None:
             new_params, stats = out
             _obs_round_faults(stats)

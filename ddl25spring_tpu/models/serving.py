@@ -1173,6 +1173,19 @@ class ContinuousBatcher:
                     dt = time.perf_counter() - t_chunk
                     self._chunk_s = (0.8 * self._chunk_s + 0.2 * dt
                                      if self._chunk_s else dt)
+                    prof = obs.profiler()
+                    if prof is not None:
+                        prof.record(
+                            "serving.decode", seconds=dt,
+                            occupancy=len(active), batch=self.max_batch,
+                            chunk=K,
+                            pages=(self._pool.pages_in_use
+                                   if self._paged else 0))
+                    cap = obs.capacity()
+                    if cap is not None:
+                        cap.observe("serving.decode", dt,
+                                    occupancy=len(active),
+                                    batch=self.max_batch, chunk=K)
                 eager_guard = ok_dev is not None and (eos_mode or fenced)
                 if eager_guard:
                     # eager containment (the per-chunk block is already
@@ -1441,7 +1454,17 @@ class ContinuousBatcher:
             ))
         group = self._admit_from(self._queue)
         if group:
+            prof = obs.profiler()
+            t_admit = time.perf_counter() if prof is not None else 0.0
             self._sync_admit_bookkeep(group, self._admit_group(group))
+            if prof is not None:
+                prof.record(
+                    "serving.prefill",
+                    seconds=time.perf_counter() - t_admit,
+                    group=len(group),
+                    tokens=sum(len(p) for _s, _r, p, _b in group),
+                    width=self.prefill_width,
+                    pages=self._pool.pages_in_use if self._paged else 0)
         self._harvest(finished, resolve=True)
         self._evict_expired(finished)
         active = [s for s, sl in enumerate(self.slots) if not sl.free]
@@ -1463,6 +1486,18 @@ class ContinuousBatcher:
             dt = time.perf_counter() - t_chunk
             self._chunk_s = (0.8 * self._chunk_s + 0.2 * dt
                              if self._chunk_s else dt)
+            prof = obs.profiler()
+            if prof is not None:
+                prof.record(
+                    "serving.decode", seconds=dt,
+                    occupancy=len(active), batch=self.max_batch,
+                    chunk=self.decode_chunk,
+                    pages=self._pool.pages_in_use if self._paged else 0)
+            cap = obs.capacity()
+            if cap is not None:
+                cap.observe("serving.decode", dt,
+                            occupancy=len(active), batch=self.max_batch,
+                            chunk=self.decode_chunk)
             self._harvest(finished, resolve=True)
             self._evict_expired(finished)
         if finished and obs.enabled():

@@ -42,7 +42,11 @@ from . import core as _core
 from . import trace
 from .core import (DEFAULT_BUCKETS, NULL_SPAN, Counter, Gauge, Histogram,
                    Telemetry)
+from .capacity import (CapacityModel, CapacityScorer, CostModel,
+                       fit_cost_model, load_calibration, roofline_join,
+                       save_calibration)
 from .flight import FlightRecorder
+from .profile import StepProfiler
 from .reqtrace import ReqTraceRecorder, RequestTrace
 from .slo import BurnRateMonitor, BurnWindows, SloSpec
 from .timeseries import HistogramRing, SeriesRing, TimeSeriesRecorder
@@ -53,10 +57,15 @@ __all__ = [
     "TimeSeriesRecorder", "SeriesRing", "HistogramRing",
     "BurnRateMonitor", "BurnWindows", "SloSpec",
     "ReqTraceRecorder", "RequestTrace", "FlightRecorder",
+    "StepProfiler", "CostModel", "CapacityModel", "CapacityScorer",
+    "fit_cost_model", "save_calibration", "load_calibration",
+    "roofline_join",
     "enable", "disable", "enabled", "get",
     "install_recorder", "uninstall_recorder", "recorder", "monitors",
     "install_reqtrace", "uninstall_reqtrace", "reqtrace",
     "install_flight", "uninstall_flight", "flight",
+    "install_profiler", "uninstall_profiler", "profiler",
+    "install_capacity", "uninstall_capacity", "capacity",
     "record_samples",
     "span", "inc", "observe", "set_gauge", "event", "flush", "render_prom",
     "step_annotation",
@@ -67,6 +76,8 @@ _RECORDER: TimeSeriesRecorder | None = None
 _MONITORS: tuple = ()
 _REQTRACE: ReqTraceRecorder | None = None
 _FLIGHT: FlightRecorder | None = None
+_PROFILER: StepProfiler | None = None
+_CAPACITY: CapacityScorer | None = None
 
 
 class _JsonlSink:
@@ -212,6 +223,65 @@ def uninstall_flight() -> None:
 
 def flight() -> FlightRecorder | None:
     return _FLIGHT
+
+
+def install_profiler(prof: StepProfiler | None = None, *, seed: int = 0,
+                     capacity: int = 256) -> StepProfiler:
+    """Install the process-global step-cost profiler the serving / FL
+    call sites feed (``obs.profiler()`` guards them — with none
+    installed, profiling costs one global read and the decode/round
+    paths are bit-identical to an uninstrumented build).  The profiler
+    counts samples through the active registry
+    (``profile_samples_total``), so install AFTER :func:`enable` for
+    metrics (rings record either way)."""
+    global _PROFILER
+    if prof is None:
+        prof = StepProfiler(seed=seed, capacity=capacity)
+    prof._get_telemetry = get
+    _PROFILER = prof
+    return prof
+
+
+def uninstall_profiler() -> None:
+    global _PROFILER
+    _PROFILER = None
+
+
+def profiler() -> StepProfiler | None:
+    """The installed step-cost profiler, or None — the single read every
+    instrumented step guards on."""
+    return _PROFILER
+
+
+def install_capacity(scorer: CapacityScorer | None = None, *,
+                     model=None, threshold: float = 0.5,
+                     window: int = 32, sustain: int = 2) -> CapacityScorer:
+    """Install the process-global capacity scorer wrapping a calibrated
+    :class:`CostModel` / :class:`CapacityModel`.  The autoscaler and
+    router policy query it for predicted service/wait times
+    (``obs.capacity()`` guards them); instrumented steps feed it
+    measured durations, publishing ``capacity_model_error`` gauges and
+    recalibration-hint events through the active registry."""
+    global _CAPACITY
+    if scorer is None:
+        if model is None:
+            raise ValueError("install_capacity needs a scorer or a model")
+        scorer = CapacityScorer(model, threshold=threshold,
+                                window=window, sustain=sustain)
+    scorer._get_telemetry = get
+    _CAPACITY = scorer
+    return scorer
+
+
+def uninstall_capacity() -> None:
+    global _CAPACITY
+    _CAPACITY = None
+
+
+def capacity() -> CapacityScorer | None:
+    """The installed capacity scorer, or None — queried by the
+    autoscaler / policy and fed by the instrumented steps."""
+    return _CAPACITY
 
 
 def record_samples() -> None:

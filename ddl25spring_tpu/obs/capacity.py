@@ -1,0 +1,376 @@
+"""Calibrated cost models and the capacity plane they power.
+
+Consumes :class:`~ddl25spring_tpu.obs.profile.StepProfiler` captures and
+produces three host-side artifacts:
+
+* :func:`fit_cost_model` — a deterministic per-phase least-squares fit
+  (piecewise: one linear model per phase, intercept-only fallback on a
+  singular design) over the numeric covariates of a capture.  Pure
+  Python floats, normal equations solved by Gaussian elimination with
+  partial pivoting — no numpy, no RNG, no wall clock — so the same
+  capture always yields the byte-identical versioned :class:`CostModel`
+  artifact (``results/calib_*.json``, written by ``tools/calibrate.py``)
+  that ROADMAP item 5's fleet twin replays as its calibration input.
+
+* :class:`CapacityModel` / :class:`CapacityScorer` — the query surface
+  the autoscaler (``serving_fleet/autoscale.py``) and router policy
+  (``serving_fleet/policy.py``) use for predicted service time and queue
+  wait per placement, plus the continuous predicted-vs-measured scoring
+  loop: every ``window`` observations the scorer publishes a
+  ``capacity_model_error{phase}`` gauge, and ``sustain`` consecutive
+  over-``threshold`` windows fire one ``capacity.recalibrate_hint``
+  event (counted by ``capacity_recalibrate_hints_total{phase}``) — drift
+  is detected, never assumed away.
+
+* :func:`roofline_join` — measured per-phase seconds joined against AOT
+  flops/bytes (``tools/northstar_aot_costs.py``) and chip peaks
+  (``tools/chip_peaks.py``) into %-of-peak attribution rows, rendered by
+  ``tools/obs_report.py``.
+
+Stdlib-only and jax-import-free — transitively proven by the
+import-purity pass (``analysis/manifest.HOST_ONLY_MODULES``).  Never
+import the :mod:`ddl25spring_tpu.obs` package root from here; the
+registry is handed to the scorer by ``obs.install_capacity``.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import deque
+from pathlib import Path
+
+from .trace import _hash_hex
+
+__all__ = ["CostModel", "CapacityModel", "CapacityScorer",
+           "fit_cost_model", "save_calibration", "load_calibration",
+           "roofline_join", "CALIB_SCHEMA"]
+
+CALIB_SCHEMA = "ddl25spring.calib.v1"
+
+# Service-time floor: a fitted plane can extrapolate below zero at small
+# covariates; capacity queries clamp here instead of going negative.
+_PREDICT_FLOOR_S = 1e-9
+
+
+def _round_sig(x: float, sig: int = 12) -> float:
+    """Deterministic significant-digit rounding for persisted floats."""
+    return float(f"{float(x):.{sig}g}")
+
+
+def _canonical(obj) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def _solve(a: list, b: list) -> list | None:
+    """Solve ``a @ x = b`` by Gaussian elimination with partial pivoting
+    (pure floats, deterministic).  None when the system is singular —
+    the caller falls back to an intercept-only model."""
+    n = len(b)
+    m = [list(map(float, row)) + [float(b[i])] for i, row in enumerate(a)]
+    for col in range(n):
+        piv = max(range(col, n), key=lambda r: abs(m[r][col]))
+        if abs(m[piv][col]) < 1e-12:
+            return None
+        if piv != col:
+            m[col], m[piv] = m[piv], m[col]
+        inv = 1.0 / m[col][col]
+        for r in range(n):
+            if r != col and m[r][col] != 0.0:
+                f = m[r][col] * inv
+                for c in range(col, n + 1):
+                    m[r][c] -= f * m[col][c]
+    return [m[i][n] / m[i][i] for i in range(n)]
+
+
+def _phase_rows(groups: list) -> list:
+    """Flatten one phase's covariate groups to ``(covariates, y)`` rows
+    in canonical capture order."""
+    rows = []
+    for g in groups:
+        cov = g.get("covariates") or {}
+        for y in g.get("seconds") or ():
+            rows.append((cov, float(y)))
+    return rows
+
+
+def _fit_phase(groups: list, min_samples: int) -> dict:
+    """Least-squares fit of one phase: seconds ~ 1 + numeric covariates.
+
+    Non-numeric covariates are ignored (they partition, not scale);
+    constant-valued features are dropped (they alias the intercept);
+    under ``min_samples`` rows, or on a singular design, the model
+    degrades to intercept-only (the phase mean)."""
+    rows = _phase_rows(groups)
+    n = len(rows)
+    mean_y = (sum(y for _, y in rows) / n) if n else 0.0
+
+    # numeric features + their means (predict-time fill for absent covs)
+    names = sorted({k for cov, _ in rows for k, v in cov.items()
+                    if isinstance(v, (int, float)) and not isinstance(v, bool)})
+    means, keep = {}, []
+    for f in names:
+        vals = [float(cov[f]) for cov, _ in rows if f in cov]
+        mu = sum(vals) / len(vals)
+        means[f] = _round_sig(mu)
+        if any(abs(v - mu) > 1e-12 for v in vals):
+            keep.append(f)
+
+    coef = None
+    if n >= max(min_samples, len(keep) + 1) and keep:
+        xs = [[1.0] + [float(cov.get(f, means[f])) for f in keep]
+              for cov, _ in rows]
+        ys = [y for _, y in rows]
+        k = len(keep) + 1
+        ata = [[sum(x[i] * x[j] for x in xs) for j in range(k)]
+               for i in range(k)]
+        atb = [sum(x[i] * y for x, y in zip(xs, ys)) for i in range(k)]
+        coef = _solve(ata, atb)
+    if coef is None:
+        keep, coef = [], [mean_y]
+
+    # training-set error of the model actually kept
+    abs_err = rel_err = 0.0
+    rel_n = 0
+    for cov, y in rows:
+        x = [1.0] + [float(cov.get(f, means[f])) for f in keep]
+        pred = sum(c * v for c, v in zip(coef, x))
+        abs_err += abs(pred - y)
+        if y > 0:
+            rel_err += abs(pred - y) / y
+            rel_n += 1
+    return {
+        "features": keep,
+        "coef": [_round_sig(c) for c in coef],
+        "cov_means": means,
+        "nr_samples": n,
+        "mean_seconds": _round_sig(mean_y),
+        "fit_mean_abs_err_s": _round_sig(abs_err / n) if n else 0.0,
+        "fit_mean_rel_err": _round_sig(rel_err / rel_n) if rel_n else 0.0,
+    }
+
+
+class CostModel:
+    """Versioned per-phase step-cost model (the ``calib_*.json`` payload).
+
+    ``version`` is the blake2b of the canonical capture JSON, so a model
+    names exactly the measurements it was fitted from; ``phases`` maps
+    phase name to the fitted-coefficient record of :func:`_fit_phase`.
+    Loading and predicting are stdlib-only — the fleet twin and the
+    serving policy query this without ever importing jax."""
+
+    def __init__(self, version: str, phases: dict, *, source: dict | None = None,
+                 extras: dict | None = None):
+        self.version = version
+        self.phases = phases
+        self.source = source or {}
+        self.extras = extras or {}
+
+    # -- queries ---------------------------------------------------------
+
+    def predict(self, phase: str, **covariates) -> float | None:
+        """Predicted step seconds for ``phase`` under ``covariates``
+        (absent covariates fill with their capture means), clamped to a
+        positive floor; None for a phase the capture never saw."""
+        pm = self.phases.get(phase)
+        if pm is None:
+            return None
+        x = [1.0] + [float(covariates.get(f, pm["cov_means"].get(f, 0.0)))
+                     for f in pm["features"]]
+        y = sum(c * v for c, v in zip(pm["coef"], x))
+        return max(y, _PREDICT_FLOOR_S)
+
+    def phase_mean(self, phase: str) -> float | None:
+        pm = self.phases.get(phase)
+        return None if pm is None else pm["mean_seconds"]
+
+    # -- (de)serialization ----------------------------------------------
+
+    def to_json(self) -> dict:
+        doc = {"schema": CALIB_SCHEMA, "version": self.version,
+               "phases": self.phases, "source": self.source}
+        doc.update(self.extras)
+        return doc
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "CostModel":
+        if doc.get("schema") != CALIB_SCHEMA:
+            raise ValueError(f"not a {CALIB_SCHEMA} document: "
+                             f"schema={doc.get('schema')!r}")
+        extras = {k: v for k, v in doc.items()
+                  if k not in ("schema", "version", "phases", "source")}
+        return cls(doc["version"], doc["phases"],
+                   source=doc.get("source"), extras=extras)
+
+
+def fit_cost_model(capture: dict, *, min_samples: int = 4) -> CostModel:
+    """Fit a :class:`CostModel` from a :meth:`StepProfiler.capture`
+    document.  Deterministic: version and coefficients are pure
+    functions of the capture bytes."""
+    version = _hash_hex(f"calib:{_canonical(capture)}", 16)
+    phases = {p: _fit_phase(groups, min_samples)
+              for p, groups in sorted((capture.get("phases") or {}).items())}
+    source = {"schema": capture.get("schema"), "seed": capture.get("seed"),
+              "root": capture.get("root"),
+              "nr_samples": sum(pm["nr_samples"] for pm in phases.values())}
+    return CostModel(version, phases, source=source)
+
+
+def save_calibration(model: CostModel, out_dir, *,
+                     roofline: list | None = None) -> Path:
+    """Persist ``model`` as ``<out_dir>/calib_<version12>.json`` —
+    sorted keys, fixed float rounding, no timestamps, so the same
+    capture always writes the byte-identical artifact."""
+    doc = model.to_json()
+    if roofline is not None:
+        doc["roofline"] = roofline
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    path = out_dir / f"calib_{model.version[:12]}.json"
+    path.write_text(json.dumps(doc, sort_keys=True, indent=2) + "\n")
+    return path
+
+
+def load_calibration(path) -> CostModel:
+    return CostModel.from_json(json.loads(Path(path).read_text()))
+
+
+class CapacityModel:
+    """Placement-level capacity queries over a :class:`CostModel`.
+
+    ``predict_service_s`` is the expected decode-step cost for a
+    replica shape; ``predict_wait_s`` scales it by queue depth over
+    batch slots — the same shape as the batcher's own
+    ``_admission_wait_estimate``, but available *before* a replica has
+    served anything (the autoscaler's cold-start blind spot)."""
+
+    def __init__(self, model: CostModel, *, decode_phase: str = "serving.decode"):
+        self.model = model
+        self.decode_phase = decode_phase
+
+    def predict_service_s(self, **covariates) -> float | None:
+        return self.model.predict(self.decode_phase, **covariates)
+
+    def predict_wait_s(self, queue_len: int, max_batch: int,
+                       **covariates) -> float | None:
+        svc = self.predict_service_s(**covariates)
+        if svc is None:
+            return None
+        return svc * (int(queue_len) / max(1, int(max_batch)))
+
+    def describe(self) -> dict:
+        return {"version": self.model.version,
+                "decode_phase": self.decode_phase,
+                "phases": sorted(self.model.phases)}
+
+
+class CapacityScorer:
+    """Continuous predicted-vs-measured scoring of a capacity model.
+
+    Call sites feed every measured step through :meth:`observe`; each
+    full ``window`` publishes the mean relative error as the
+    ``capacity_model_error{phase}`` gauge, and ``sustain`` consecutive
+    windows above ``threshold`` fire one ``capacity.recalibrate_hint``
+    event + ``capacity_recalibrate_hints_total{phase}`` — the signal
+    that the next live TPU window should refresh ``calib_*.json``
+    (satellite: the queued-capture protocol carries that refresh).
+    """
+
+    def __init__(self, model: CapacityModel | CostModel, *,
+                 threshold: float = 0.5, window: int = 32, sustain: int = 2):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if sustain < 1:
+            raise ValueError(f"sustain must be >= 1, got {sustain}")
+        if isinstance(model, CostModel):
+            model = CapacityModel(model)
+        self.model = model
+        self.threshold = float(threshold)
+        self.window = int(window)
+        self.sustain = int(sustain)
+        self._acc: dict = {}          # phase -> [err_sum, n]
+        self._bad: dict = {}          # phase -> consecutive bad windows
+        self.last_error: dict = {}    # phase -> last windowed mean rel err
+        self.hints: deque = deque(maxlen=64)
+        # wired by obs.install_capacity to the module's registry getter
+        self._get_telemetry = None
+
+    def observe(self, phase: str, measured_s: float,
+                **covariates) -> float | None:
+        """Score one measured step against its prediction; returns the
+        relative error (None when the model has no such phase or the
+        measurement is degenerate)."""
+        pred = self.model.model.predict(phase, **covariates)
+        measured_s = float(measured_s)
+        if pred is None or measured_s <= 0.0:
+            return None
+        rel = abs(pred - measured_s) / measured_s
+        acc = self._acc.setdefault(phase, [0.0, 0])
+        acc[0] += rel
+        acc[1] += 1
+        if acc[1] >= self.window:
+            self._close_window(phase, acc[0] / acc[1])
+            self._acc[phase] = [0.0, 0]
+        return rel
+
+    def _close_window(self, phase: str, mean_rel: float) -> None:
+        self.last_error[phase] = mean_rel
+        get = self._get_telemetry
+        t = get() if get is not None else None
+        if t is not None:
+            t.gauge("capacity_model_error", phase=phase).set(mean_rel)
+        if mean_rel > self.threshold:
+            bad = self._bad.get(phase, 0) + 1
+            if bad >= self.sustain:
+                hint = {"phase": phase,
+                        "mean_rel_err": round(mean_rel, 6),
+                        "threshold": self.threshold,
+                        "windows": bad,
+                        "model_version": self.model.model.version}
+                self.hints.append(hint)
+                if t is not None:
+                    t.counter("capacity_recalibrate_hints_total",
+                              phase=phase).inc()
+                    t.event("capacity.recalibrate_hint", **hint)
+                bad = 0
+            self._bad[phase] = bad
+        else:
+            self._bad[phase] = 0
+
+    def describe(self) -> dict:
+        return {"model_version": self.model.model.version,
+                "threshold": self.threshold, "window": self.window,
+                "sustain": self.sustain,
+                "last_error": {p: round(v, 6)
+                               for p, v in sorted(self.last_error.items())},
+                "hints": list(self.hints)}
+
+
+def roofline_join(measured_s: dict, phase_costs: dict, peaks: dict) -> list:
+    """Join measured per-phase seconds with AOT flops/bytes and chip
+    peaks into %-of-peak attribution rows.
+
+    ``measured_s``: phase -> mean step seconds (profiler or gauges);
+    ``phase_costs``: phase -> {"flops": f, "bytes": b} (AOT analysis);
+    ``peaks``: {"flops_per_s": ..., "hbm_bytes_per_s": ...} (chip_peaks
+    ``effective_peaks``).  A phase is ``compute``-bound when its ideal
+    flops time exceeds its ideal bytes time, ``memory``-bound otherwise.
+    """
+    pf = float(peaks.get("flops_per_s") or 0.0)
+    pb = float(peaks.get("hbm_bytes_per_s") or 0.0)
+    rows = []
+    for phase in sorted(set(measured_s) & set(phase_costs)):
+        sec = float(measured_s[phase])
+        if sec <= 0.0:
+            continue
+        flops = float(phase_costs[phase].get("flops") or 0.0)
+        byts = float(phase_costs[phase].get("bytes") or 0.0)
+        row = {"phase": phase, "seconds": _round_sig(sec, 6),
+               "flops": flops, "bytes": byts}
+        if pf > 0:
+            row["pct_peak_flops"] = _round_sig(100.0 * flops / sec / pf, 4)
+        if pb > 0:
+            row["pct_peak_hbm"] = _round_sig(100.0 * byts / sec / pb, 4)
+        if pf > 0 and pb > 0:
+            row["bound"] = "compute" if flops / pf >= byts / pb else "memory"
+        rows.append(row)
+    return rows

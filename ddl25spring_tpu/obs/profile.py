@@ -1,0 +1,169 @@
+"""Step-cost profile plane: structured cost samples per phase covariate.
+
+The telemetry stack (:mod:`ddl25spring_tpu.obs.core`) says *what
+happened*; this module records *what it cost and under what shape*: a
+:class:`StepProfiler` collects ``(phase, covariates) -> duration``
+samples from the fleet batcher decode/prefill steps
+(``models/serving.py``), the FL round loop (``fl/engine.py``) and any
+other instrumented step, into bounded per-covariate-group rings.  The
+covariates are the knobs a cost model can regress on — batch occupancy,
+decode chunk, context/page count, cohort size, shard world — so a
+capture is directly the training set for the deterministic least-squares
+fit in :mod:`ddl25spring_tpu.obs.capacity` (and the calibration input
+ROADMAP item 5's discrete-event fleet twin replays).
+
+Installation follows the request-trace pattern
+(:mod:`ddl25spring_tpu.obs.reqtrace`): ``obs.install_profiler()`` sets a
+process-global recorder, every call site guards on a single
+``obs.profiler() is None`` read, and with no profiler installed the
+serving and FL paths are bit-identical to an uninstrumented build (the
+contract ``tests/test_profile.py`` replays against the real
+``ContinuousBatcher`` and FL engine).
+
+Captures (:meth:`StepProfiler.capture`) are deterministic in structure:
+groups are emitted in canonical covariate order, not insertion order, so
+two runs that record the same samples produce the same JSON document.
+Wall-clock *values* (the durations) are of course measured — determinism
+here means the artifact layout, which is what the versioned-fit contract
+of ``tools/calibrate.py`` needs.
+
+Stdlib-only and jax-import-free — transitively proven by the
+import-purity pass (``analysis/manifest.HOST_ONLY_MODULES``).  Never
+import the :mod:`ddl25spring_tpu.obs` package root from here (it imports
+this module); the registry is handed in by ``obs.install_profiler``.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+
+from .trace import _hash_hex
+
+__all__ = ["StepProfiler", "PROFILE_SCHEMA",
+           "PHASE_DECODE", "PHASE_PREFILL", "PHASE_FL_ROUND"]
+
+PROFILE_SCHEMA = "ddl25spring.profile.v1"
+
+# Canonical phase names shared by the instrumented call sites, the
+# calibration fit and the capacity model — string-typed on purpose so
+# ad-hoc phases (bench cells, tests) need no registration.
+PHASE_DECODE = "serving.decode"
+PHASE_PREFILL = "serving.prefill"
+PHASE_FL_ROUND = "fl.round"
+
+
+def _cov_key(covariates: dict) -> tuple:
+    """Canonical hashable key for one covariate assignment."""
+    return tuple(sorted(covariates.items()))
+
+
+class StepProfiler:
+    """Bounded rings of step durations keyed by (phase, covariates).
+
+    ``capacity`` bounds samples retained per covariate group;
+    ``max_groups`` bounds distinct groups (oldest-touched evicted first)
+    so an unbounded covariate (a raw queue length, say) cannot leak
+    memory.  Install process-wide with ``obs.install_profiler`` — the
+    instrumented call sites all guard on ``obs.profiler() is None``, so
+    with no profiler installed profiling costs one global read and the
+    serving/FL paths are bit-identical to an uninstrumented build.
+    """
+
+    def __init__(self, seed: int = 0, capacity: int = 256,
+                 max_groups: int = 1024):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if max_groups < 1:
+            raise ValueError(f"max_groups must be >= 1, got {max_groups}")
+        self.seed = int(seed)
+        self.root = _hash_hex(f"profile:ddl25spring:{self.seed}", 16)
+        self.capacity = int(capacity)
+        self.max_groups = int(max_groups)
+        self._rings: OrderedDict = OrderedDict()
+        # wired by obs.install_profiler to the module's registry getter;
+        # left None the profiler never streams (samples still record)
+        self._get_telemetry = None
+
+    # -- recording -------------------------------------------------------
+
+    def record(self, phase: str, *, seconds: float, **covariates) -> None:
+        """Record one step duration under its covariate assignment and
+        (telemetry on) count it in ``profile_samples_total{phase}``.
+
+        Covariate values must be JSON-able scalars (int/float/str/bool);
+        they become the regression features of the cost-model fit, so
+        prefer small-cardinality shape knobs over raw identifiers."""
+        key = (str(phase), _cov_key(covariates))
+        ring = self._rings.get(key)
+        if ring is None:
+            ring = deque(maxlen=self.capacity)
+            self._rings[key] = ring
+            while len(self._rings) > self.max_groups:
+                self._rings.popitem(last=False)
+        else:
+            self._rings.move_to_end(key)
+        ring.append(float(seconds))
+        get = self._get_telemetry
+        t = get() if get is not None else None
+        if t is not None:
+            t.counter("profile_samples_total", phase=str(phase)).inc()
+
+    # -- introspection ---------------------------------------------------
+
+    def __len__(self) -> int:
+        return sum(len(r) for r in self._rings.values())
+
+    def nr_groups(self) -> int:
+        return len(self._rings)
+
+    def phases(self) -> list:
+        return sorted({phase for phase, _ in self._rings})
+
+    def phase_mean_seconds(self, phase: str) -> float | None:
+        """Mean duration across every retained sample of ``phase`` (the
+        measured side of the roofline join), or None if unseen."""
+        total, n = 0.0, 0
+        for (p, _), ring in self._rings.items():
+            if p == phase:
+                total += sum(ring)
+                n += len(ring)
+        return (total / n) if n else None
+
+    # -- export ----------------------------------------------------------
+
+    def capture(self) -> dict:
+        """The capture document ``tools/calibrate.py`` fits: per phase, a
+        canonically-ordered list of covariate groups with their retained
+        duration samples.  Structure (keys, group order, sample counts)
+        is a pure function of what was recorded — insertion order never
+        leaks into the artifact."""
+        phases: dict = {}
+        for (phase, cov), ring in self._rings.items():
+            phases.setdefault(phase, []).append(
+                {"covariates": dict(cov),
+                 "seconds": [round(s, 9) for s in ring]})
+        for groups in phases.values():
+            groups.sort(key=lambda g: _cov_key(g["covariates"]))
+        return {
+            "schema": PROFILE_SCHEMA,
+            "seed": self.seed,
+            "root": self.root,
+            "phases": {p: phases[p] for p in sorted(phases)},
+        }
+
+    def describe(self) -> dict:
+        """JSON-able summary (flight-recorder dumps, reports): per phase,
+        group and sample counts plus the mean duration."""
+        out: dict = {}
+        for (phase, _), ring in self._rings.items():
+            d = out.setdefault(phase, {"groups": 0, "samples": 0,
+                                       "total_s": 0.0})
+            d["groups"] += 1
+            d["samples"] += len(ring)
+            d["total_s"] += sum(ring)
+        for d in out.values():
+            n = d.pop("samples")
+            tot = d.pop("total_s")
+            d["samples"] = n
+            d["mean_seconds"] = round(tot / n, 9) if n else 0.0
+        return {p: out[p] for p in sorted(out)}

@@ -131,12 +131,27 @@ class AutoscalePolicy:
         """Convenience: pull the inputs straight from a
         :class:`FleetRouter` — the same queue-wait estimate its
         ``fleet_replica_queue_wait_s`` gauge publishes, for the replicas
-        its placement logic currently considers eligible."""
+        its placement logic currently considers eligible.
+
+        Replicas that have not decoded yet (``_chunk_s == 0``, the
+        cold-start blind spot) fall back to the calibrated capacity
+        model when one is installed (``obs.capacity()``) — a freshly
+        added replica then contributes its *predicted* wait instead of
+        an optimistic zero."""
+        cap = obs.capacity()
         waits = []
         for i in router._eligible():
             r = router.replicas[i]
             est = getattr(r, "_chunk_s", 0.0)
             mb = max(1, int(getattr(r, "max_batch", 1)))
+            if not est and cap is not None:
+                w = cap.model.predict_wait_s(
+                    len(r._queue), mb,
+                    occupancy=mb, batch=mb,
+                    chunk=getattr(r, "decode_chunk", 0) or 0)
+                if w is not None:
+                    waits.append(w)
+                    continue
             waits.append(est * (len(r._queue) / mb))
         return self.observe(waits, healthy=len(waits))
 

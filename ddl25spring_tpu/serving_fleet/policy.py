@@ -87,7 +87,8 @@ def rank_replicas(snapshots) -> list[int]:
 def snapshot_replica(index: int, batcher, prompt, budget: int, *,
                      affinity_hit: bool = False,
                      health_state: str = "healthy",
-                     canary: bool = False) -> ReplicaSnapshot:
+                     canary: bool = False,
+                     capacity_model=None) -> ReplicaSnapshot:
     """Build a snapshot from a live batcher by reading HOST state only
     (queue, slots, EWMAs) — no device round trip, no jax import.
 
@@ -95,6 +96,12 @@ def snapshot_replica(index: int, batcher, prompt, budget: int, *,
     routed here before); it ORs with the replica's ctor-level shared
     prefix, which is the stronger signal (precomputed pages, prefill
     skipped entirely).
+
+    ``capacity_model`` (an ``obs.CapacityModel``-shaped object, duck
+    typed so this module stays import-free) refines ``est_wait_s`` for
+    replicas that have not decoded yet: the batcher's own estimate rides
+    its chunk-time EWMA, which is a placeholder until the first chunk,
+    so a calibrated prediction replaces it on cold replicas only.
     """
     hit = bool(affinity_hit)
     ptoks = getattr(batcher, "_prefix_tokens", None)
@@ -112,6 +119,14 @@ def snapshot_replica(index: int, batcher, prompt, budget: int, *,
     estimate = getattr(batcher, "_admission_wait_estimate", None)
     if estimate is not None and budget > 0:
         est_wait, _bound = estimate(budget)
+        if capacity_model is not None and not getattr(batcher, "_chunk_s",
+                                                      0.0):
+            mb = max(1, int(getattr(batcher, "max_batch", 1)))
+            w = capacity_model.predict_wait_s(
+                queue_len, mb, occupancy=mb, batch=mb,
+                chunk=getattr(batcher, "decode_chunk", 0) or 0)
+            if w is not None:
+                est_wait = float(w)
         if slo is not None:
             slack = float(slo) - est_wait
     return ReplicaSnapshot(

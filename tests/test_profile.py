@@ -1,0 +1,507 @@
+"""Cost-attribution profile plane (obs/profile.py, obs/capacity.py,
+tools/calibrate.py):
+
+- the step profiler's capture is a pure function of what was recorded
+  (insertion order never leaks), rings and group counts are bounded,
+- the deterministic least-squares fit recovers planted linear
+  coefficients exactly and degrades to intercept-only on thin or
+  singular data; two runs of ``tools/calibrate.py`` over the same
+  capture write the byte-identical versioned ``calib_*.json``, and the
+  artifact loads & predicts in a process that never imports jax,
+- with no profiler installed the instrumented serving and FL paths are
+  bit-identical to an uninstrumented build — ServedTokens from the real
+  ``ContinuousBatcher`` and FL round outputs from the real engine,
+- the capacity scorer is scored, not trusted: sustained drift past the
+  threshold fires the ``capacity.recalibrate_hint`` event and counter,
+  and the autoscaler / router policy consult the model exactly on cold
+  replicas (``_chunk_s == 0``) and nowhere else.
+"""
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ddl25spring_tpu import obs
+from ddl25spring_tpu.obs.capacity import (CALIB_SCHEMA, CapacityModel,
+                                          CapacityScorer, CostModel,
+                                          fit_cost_model, load_calibration,
+                                          roofline_join, save_calibration)
+from ddl25spring_tpu.obs.profile import StepProfiler
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def clean_obs():
+    yield
+    obs.uninstall_profiler()
+    obs.uninstall_capacity()
+    obs.disable()
+
+
+def _capture_from(samples, seed=0):
+    """Build a capture by recording ``(phase, cov, seconds)`` rows."""
+    prof = StepProfiler(seed=seed)
+    for phase, cov, s in samples:
+        prof.record(phase, seconds=s, **cov)
+    return prof.capture()
+
+
+# -- profiler mechanics ------------------------------------------------------
+
+
+def test_profiler_capture_canonical_and_seeded():
+    rows = [("serving.decode", {"occupancy": o, "chunk": 4}, 0.01 * o)
+            for o in (1, 2, 3)]
+    a = _capture_from(rows, seed=3)
+    b = _capture_from(list(reversed(rows)), seed=3)  # insertion order flipped
+    assert a == b
+    assert a["schema"] == "ddl25spring.profile.v1"
+    # the root is a pure function of the seed, like the req-trace root
+    assert a["root"] == StepProfiler(seed=3).root
+    assert a["root"] != _capture_from(rows, seed=4)["root"]
+    # groups come out in canonical covariate order
+    covs = [g["covariates"]["occupancy"]
+            for g in a["phases"]["serving.decode"]]
+    assert covs == sorted(covs)
+
+
+def test_profiler_bounds_rings_and_evicts_groups():
+    with pytest.raises(ValueError):
+        StepProfiler(capacity=0)
+    with pytest.raises(ValueError):
+        StepProfiler(max_groups=0)
+    prof = StepProfiler(capacity=2, max_groups=2)
+    for k in range(5):
+        prof.record("p", seconds=float(k), occupancy=1)
+    # ring keeps only the newest ``capacity`` samples
+    (group,) = prof.capture()["phases"]["p"]
+    assert group["seconds"] == [3.0, 4.0]
+    # a third distinct covariate group evicts the oldest-touched one
+    prof.record("p", seconds=1.0, occupancy=2)
+    prof.record("p", seconds=1.0, occupancy=1)   # touch group 1 again
+    prof.record("p", seconds=1.0, occupancy=3)   # evicts occupancy=2
+    assert prof.nr_groups() == 2
+    occs = {g["covariates"]["occupancy"]
+            for g in prof.capture()["phases"]["p"]}
+    assert occs == {1, 3}
+    assert prof.phases() == ["p"]
+    assert prof.phase_mean_seconds("missing") is None
+
+
+def test_profiler_counts_samples_through_registry(clean_obs):
+    t = obs.enable()
+    prof = obs.install_profiler(seed=0)
+    assert obs.profiler() is prof
+    prof.record("serving.decode", seconds=0.01, occupancy=1)
+    prof.record("serving.decode", seconds=0.02, occupancy=2)
+    prof.record("fl.round", seconds=0.5, cohort=8)
+    assert t.counter("profile_samples_total",
+                     phase="serving.decode").value == 2
+    assert t.counter("profile_samples_total", phase="fl.round").value == 1
+    assert len(prof) == 3
+    d = prof.describe()
+    assert d["fl.round"]["samples"] == 1
+    obs.uninstall_profiler()
+    assert obs.profiler() is None
+
+
+# -- deterministic fit -------------------------------------------------------
+
+
+def test_fit_recovers_planted_linear_model():
+    # seconds = 0.01 + 0.002*occupancy + 0.0005*chunk, exactly; a string
+    # covariate and a constant covariate must not perturb the fit
+    rows = []
+    for occ in (1, 2, 3, 4):
+        for chunk in (4, 8):
+            rows.append(("serving.decode",
+                         {"occupancy": occ, "chunk": chunk,
+                          "layout": "paged", "batch": 8},
+                         0.01 + 0.002 * occ + 0.0005 * chunk))
+    model = fit_cost_model(_capture_from(rows), min_samples=4)
+    pm = model.phases["serving.decode"]
+    assert pm["features"] == ["chunk", "occupancy"]   # sorted, batch dropped
+    assert pm["fit_mean_rel_err"] < 1e-9
+    got = model.predict("serving.decode", occupancy=3, chunk=8)
+    assert got == pytest.approx(0.01 + 0.006 + 0.004, rel=1e-9)
+    # absent covariates fill with capture means — still a finite answer
+    filled = model.predict("serving.decode", occupancy=2)
+    assert filled == pytest.approx(0.01 + 0.004 + 0.0005 * 6, rel=1e-9)
+    assert model.predict("unknown.phase") is None
+    assert model.phase_mean("serving.decode") == pytest.approx(
+        sum(s for _, _, s in rows) / len(rows), rel=1e-9)
+
+
+def test_fit_falls_back_to_intercept_only():
+    # under min_samples: the phase mean, no features
+    thin = _capture_from([("p", {"occupancy": k}, 0.1 * (k + 1))
+                          for k in range(3)])
+    pm = fit_cost_model(thin, min_samples=8).phases["p"]
+    assert pm["features"] == [] and len(pm["coef"]) == 1
+    assert pm["coef"][0] == pytest.approx(0.2)
+    # singular design (two perfectly collinear covariates) must not
+    # crash — Gaussian elimination detects it and degrades the same way
+    co = _capture_from([("p", {"a": k, "b": 2 * k}, 0.1) for k in range(6)])
+    pm = fit_cost_model(co, min_samples=2).phases["p"]
+    assert pm["coef"][0] == pytest.approx(0.1)
+    # prediction clamps at the positive floor, never negative
+    down = _capture_from([("p", {"x": k}, 0.5 - 0.1 * k) for k in range(5)])
+    m = fit_cost_model(down, min_samples=2)
+    assert m.predict("p", x=100) > 0
+
+
+def test_cost_model_version_and_roundtrip(tmp_path):
+    rows = [("p", {"x": k}, 0.01 * (k + 1)) for k in range(6)]
+    cap = _capture_from(rows)
+    m1 = fit_cost_model(cap)
+    m2 = fit_cost_model(cap)
+    assert m1.version == m2.version
+    assert m1.version != fit_cost_model(
+        _capture_from(rows[:-1])).version      # different capture, new name
+    # save twice -> byte-identical artifact named by the version
+    p1 = save_calibration(m1, tmp_path / "a")
+    p2 = save_calibration(m2, tmp_path / "b")
+    assert p1.name == f"calib_{m1.version[:12]}.json" == p2.name
+    assert p1.read_bytes() == p2.read_bytes()
+    loaded = load_calibration(p1)
+    assert loaded.version == m1.version
+    assert loaded.predict("p", x=3) == pytest.approx(
+        m1.predict("p", x=3), rel=1e-12)
+    with pytest.raises(ValueError):
+        CostModel.from_json({"schema": "nope", "version": "v", "phases": {}})
+
+
+def test_calibrate_cli_byte_identical_and_jax_free(tmp_path):
+    cap = _capture_from([("serving.decode", {"occupancy": o, "chunk": 4},
+                          0.01 + 0.002 * o)
+                         for o in (1, 2, 3, 4, 1, 2, 3, 4)])
+    cap_path = tmp_path / "capture.json"
+    cap_path.write_text(json.dumps(cap))
+    outs = []
+    for sub in ("r1", "r2"):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "calibrate.py"),
+             str(cap_path), "--out-dir", str(tmp_path / sub),
+             "--min-samples", "2", "--no-roofline"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        outs.append(Path(proc.stdout.strip().splitlines()[-1]))
+    assert outs[0].name == outs[1].name
+    assert outs[0].read_bytes() == outs[1].read_bytes()
+    # the artifact loads and predicts without jax ever being imported —
+    # the fleet-twin / router consumption contract
+    check = (
+        "import json, sys\n"
+        "from ddl25spring_tpu.obs.capacity import load_calibration\n"
+        f"m = load_calibration({str(outs[0])!r})\n"
+        "p = m.predict('serving.decode', occupancy=2, chunk=4)\n"
+        "assert p is not None and p > 0, p\n"
+        "assert 'jax' not in sys.modules\n"
+        "print('jaxfree ok', m.version[:12])\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", check],
+                          capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert "jaxfree ok" in proc.stdout
+
+
+# -- roofline join -----------------------------------------------------------
+
+
+def test_roofline_join_hand_computed():
+    peaks = {"flops_per_s": 2.0e12, "hbm_bytes_per_s": 1.0e11}
+    rows = roofline_join(
+        {"fl.round": 1.0, "serving.decode": 0.0, "orphan": 1.0},
+        {"fl.round": {"flops": 1.0e12, "bytes": 2.0e10},
+         "serving.decode": {"flops": 1, "bytes": 1},
+         "other": {"flops": 1, "bytes": 1}},
+        peaks)
+    # zero-seconds and unjoined phases drop out
+    assert [r["phase"] for r in rows] == ["fl.round"]
+    row = rows[0]
+    assert row["pct_peak_flops"] == pytest.approx(50.0)
+    assert row["pct_peak_hbm"] == pytest.approx(20.0)
+    assert row["bound"] == "compute"   # 0.5s ideal flops > 0.2s ideal bytes
+    # flip the balance -> memory bound
+    (mrow,) = roofline_join({"p": 1.0},
+                            {"p": {"flops": 1.0e11, "bytes": 9.0e10}}, peaks)
+    assert mrow["bound"] == "memory"
+    # missing peaks: join still emits the raw row, no pct/bound fields
+    (bare,) = roofline_join({"p": 1.0}, {"p": {"flops": 1, "bytes": 1}}, {})
+    assert "pct_peak_flops" not in bare and "bound" not in bare
+
+
+# -- capacity queries & the drift contract ----------------------------------
+
+
+def _decode_model(svc=0.01):
+    """A cost model whose decode prediction is exactly ``svc``."""
+    cap = _capture_from([("serving.decode", {"occupancy": 1}, svc)
+                         for _ in range(4)])
+    return fit_cost_model(cap, min_samples=2)
+
+
+def test_capacity_model_wait_math():
+    cm = CapacityModel(_decode_model(svc=0.01))
+    assert cm.predict_service_s(occupancy=1) == pytest.approx(0.01)
+    assert cm.predict_wait_s(6, 2, occupancy=1) == pytest.approx(0.03)
+    assert cm.predict_wait_s(0, 2, occupancy=1) == 0.0
+    other = CapacityModel(_decode_model(), decode_phase="not.recorded")
+    assert other.predict_service_s() is None
+    assert other.predict_wait_s(4, 2) is None
+
+
+def test_scorer_validation_and_install(clean_obs):
+    with pytest.raises(ValueError):
+        CapacityScorer(_decode_model(), window=0)
+    with pytest.raises(ValueError):
+        CapacityScorer(_decode_model(), sustain=0)
+    with pytest.raises(ValueError):
+        obs.install_capacity()
+    sc = obs.install_capacity(model=_decode_model())
+    assert obs.capacity() is sc
+    obs.uninstall_capacity()
+    assert obs.capacity() is None
+
+
+def test_sustained_drift_fires_recalibrate_hint(tmp_path, clean_obs):
+    jsonl = tmp_path / "telemetry.jsonl"
+    t = obs.enable(str(jsonl))
+    model = _decode_model(svc=0.01)
+    sc = obs.install_capacity(model=model, threshold=0.2, window=4,
+                              sustain=2)
+    # accurate observations: gauge publishes per window, no hint
+    for _ in range(4):
+        assert sc.observe("serving.decode", 0.01, occupancy=1) == \
+            pytest.approx(0.0, abs=1e-6)
+    assert t.gauge("capacity_model_error",
+                   phase="serving.decode").value == pytest.approx(
+        0.0, abs=1e-6)
+    assert not sc.hints
+    # measured 2x the prediction: rel err 0.5 > threshold, but ONE bad
+    # window must not hint yet (sustain=2)
+    for _ in range(4):
+        sc.observe("serving.decode", 0.02, occupancy=1)
+    assert not sc.hints
+    # the second consecutive bad window fires exactly one hint
+    for _ in range(4):
+        sc.observe("serving.decode", 0.02, occupancy=1)
+    assert len(sc.hints) == 1
+    hint = sc.hints[0]
+    assert hint["phase"] == "serving.decode"
+    assert hint["model_version"] == model.version
+    assert hint["mean_rel_err"] == pytest.approx(0.5)
+    assert t.counter("capacity_recalibrate_hints_total",
+                     phase="serving.decode").value == 1
+    assert t.gauge("capacity_model_error",
+                   phase="serving.decode").value == pytest.approx(0.5)
+    # the event rode the JSONL stream for obs_report
+    obs.flush()
+    events = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    assert any(e.get("event") == "capacity.recalibrate_hint"
+               for e in events)
+    # degenerate / unknown observations score nothing
+    assert sc.observe("serving.decode", 0.0, occupancy=1) is None
+    assert sc.observe("never.seen", 0.01) is None
+    d = sc.describe()
+    assert d["model_version"] == model.version and len(d["hints"]) == 1
+
+
+class _ColdReplica:
+    """Router-shaped fake: never decoded (``_chunk_s == 0``)."""
+
+    def __init__(self, queue_len):
+        self._chunk_s = 0.0
+        self._queue = list(range(queue_len))
+        self.max_batch = 2
+        self.decode_chunk = 0
+
+
+class _FakeRouter:
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+    def _eligible(self):
+        return range(len(self.replicas))
+
+
+def test_autoscale_cold_replicas_use_capacity_model(clean_obs):
+    from ddl25spring_tpu.serving_fleet import AutoscaleConfig, AutoscalePolicy
+
+    seen = []
+
+    class _Spy(AutoscalePolicy):
+        def observe(self, queue_waits, **kw):
+            seen.append(list(queue_waits))
+            return super().observe(queue_waits, **kw)
+
+    pol = _Spy(AutoscaleConfig(), baseline=2)
+    router = _FakeRouter([_ColdReplica(6), _ColdReplica(0)])
+    # without a capacity model the cold replicas report an optimistic 0
+    pol.observe_fleet(router)
+    assert seen[-1] == [0.0, 0.0]
+    # with one installed, the queued cold replica contributes its
+    # PREDICTED wait: svc * queue_len / max_batch = 0.01 * 6 / 2
+    obs.install_capacity(model=_decode_model(svc=0.01))
+    pol.observe_fleet(router)
+    assert seen[-1] == [pytest.approx(0.03), pytest.approx(0.0)]
+    # a warm replica keeps its own measured estimate
+    warm = _ColdReplica(4)
+    warm._chunk_s = 0.5
+    pol.observe_fleet(_FakeRouter([warm]))
+    assert seen[-1] == [pytest.approx(0.5 * 4 / 2)]
+
+
+class _PolicyBatcher:
+    """Host-state-only fake batcher for ``snapshot_replica``."""
+
+    def __init__(self, chunk_s):
+        self._chunk_s = chunk_s
+        self._queue = [1, 2, 3, 4]
+        self.slots = []
+        self.max_batch = 2
+        self.decode_chunk = 0
+        self.slo_deadline_s = None
+
+    def _admission_wait_estimate(self, budget):
+        return self._chunk_s * 7.0, "lower-bound"
+
+
+def test_policy_snapshot_cold_replica_uses_capacity_model():
+    from ddl25spring_tpu.serving_fleet.policy import snapshot_replica
+
+    cm = CapacityModel(_decode_model(svc=0.01))
+    # cold replica: the model's prediction replaces the placeholder 0
+    cold = snapshot_replica(0, _PolicyBatcher(0.0), [1, 2], 4,
+                            capacity_model=cm)
+    assert cold.est_wait_s == pytest.approx(0.01 * 4 / 2)
+    # same replica without the model keeps the batcher's own estimate
+    bare = snapshot_replica(0, _PolicyBatcher(0.0), [1, 2], 4)
+    assert bare.est_wait_s == 0.0
+    # a warm replica is never overridden
+    warm = snapshot_replica(0, _PolicyBatcher(0.1), [1, 2], 4,
+                            capacity_model=cm)
+    assert warm.est_wait_s == pytest.approx(0.7)
+
+
+# -- profiling off must cost nothing (the acceptance criterion) --------------
+
+
+def test_profiling_off_real_batcher_bit_identical(clean_obs):
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+
+    cfg = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                      nr_layers=2, ctx_size=48)
+    prompt = jnp.ones((1, 4), jnp.int32)
+    params = Llama(cfg).init(jax.random.PRNGKey(0), prompt,
+                             positions=jnp.arange(4))
+    prompts = [[3, 5, 7], [11, 13], [17, 19, 23, 29]]
+    budgets = [5, 4, 3]
+
+    def run(profiled):
+        prof = obs.install_profiler(seed=0) if profiled else None
+        try:
+            b = ContinuousBatcher(cfg, params, max_batch=2, prefill_width=8,
+                                  kv_layout="paged", kv_page=8)
+            for rid, (p, bud) in enumerate(zip(prompts, budgets)):
+                b.submit(rid, p, bud)
+            out = {}
+            while b.in_flight:
+                out.update(b.step())
+            capture = prof.capture() if prof else None
+        finally:
+            obs.uninstall_profiler()
+        return ({rid: ([int(t) for t in toks],
+                       getattr(toks, "status", "ok"))
+                 for rid, toks in out.items()}, capture)
+
+    off, _ = run(profiled=False)
+    on, capture = run(profiled=True)
+    assert on == off                       # ServedTokens bit-identical
+    # and the profiled run actually measured both serving phases, with
+    # the covariates the calibration fit regresses on
+    assert {"serving.decode", "serving.prefill"} <= set(capture["phases"])
+    dec = capture["phases"]["serving.decode"]
+    assert sum(len(g["seconds"]) for g in dec) > 0
+    assert all({"occupancy", "batch", "chunk", "pages"} <=
+               set(g["covariates"]) for g in dec)
+    # a capture this small still round-trips through the fit
+    model = fit_cost_model(capture, min_samples=2)
+    assert model.predict("serving.decode", occupancy=1) is not None
+
+
+def test_profiling_off_fl_round_bit_identical(clean_obs):
+    import jax
+
+    from ddl25spring_tpu.data import load_mnist, split_dataset
+    from ddl25spring_tpu.fl import FedSgdGradientServer, mnist_task
+
+    ds = load_mnist(n_train=256, n_test=64)
+    task = mnist_task(ds.test_x, ds.test_y)
+    clients = split_dataset(ds.train_x, ds.train_y, nr_clients=4, iid=True,
+                            seed=0)
+
+    def one_round(profiled):
+        prof = obs.install_profiler(seed=0) if profiled else None
+        try:
+            server = FedSgdGradientServer(task, lr=0.05, client_data=clients,
+                                          client_fraction=0.5, seed=7)
+            p1 = server.round_fn(server.params, server.run_key, 0)
+            capture = prof.capture() if prof else None
+        finally:
+            obs.uninstall_profiler()
+        return jax.tree.leaves(p1), capture
+
+    base, _ = one_round(profiled=False)
+    prof_leaves, capture = one_round(profiled=True)
+    import numpy as np
+    for a, b in zip(base, prof_leaves):
+        assert np.array_equal(np.asarray(a), np.asarray(b))   # bitwise
+    (group,) = capture["phases"]["fl.round"]
+    assert group["covariates"] == {"cohort": 2, "shards": 1, "chunk": 0}
+    assert len(group["seconds"]) == 1
+
+
+# -- the regression-gate cell ------------------------------------------------
+
+
+def _load_bench_regression():
+    spec = importlib.util.spec_from_file_location(
+        "bench_regression", REPO / "tools" / "bench_regression.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_regression_capacity_cell_scaled_threshold():
+    br = _load_bench_regression()
+
+    def wrap(err):
+        return {"parsed": {"value": 1.0,
+                           "cpu_fallback": {
+                               "capacity_model": {"mean_rel_err": err}}}}
+
+    # +50% on the error is CPU noise: under the 10x-scaled gate
+    rows = br.compare_bench(wrap(0.10), wrap(0.15), threshold=0.10)
+    cell = {r["cell"]: r for r in rows}[
+        "cpu_fallback.capacity_model.mean_rel_err"]
+    assert not cell["regressed"]
+    # but a multiple-of-itself jump trips it (>= 10 * 10%)
+    rows = br.compare_bench(wrap(0.10), wrap(0.25), threshold=0.10)
+    cell = {r["cell"]: r for r in rows}[
+        "cpu_fallback.capacity_model.mean_rel_err"]
+    assert cell["regressed"]
+    # the headline cell still gates at the unscaled threshold
+    rows = br.compare_bench(
+        {"parsed": {"value": 1.0}}, {"parsed": {"value": 0.8}},
+        threshold=0.10)
+    assert rows[0]["cell"] == "value" and rows[0]["regressed"]

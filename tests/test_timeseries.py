@@ -92,6 +92,100 @@ def test_histogram_ring_windowed_quantile_matches_fresh_histogram():
     assert ring.window_count() == 8
 
 
+def test_histogram_quantile_edge_cases():
+    import bisect
+
+    t = obs.enable()
+    h = t.histogram("edge_lat")
+    # empty histogram: every quantile is 0.0, never a crash
+    for q in (0.0, 0.5, 1.0):
+        assert h.quantile(q) == 0.0
+    # all observations in one interior bucket: q=0 pins the bucket's
+    # lower edge, q=1 its upper edge, and the interpolation walks
+    # linearly between them
+    for _ in range(4):
+        h.observe(0.05)
+    i = bisect.bisect_left(h.bounds, 0.05)
+    lo = h.bounds[i - 1] if i > 0 else 0.0
+    hi = h.bounds[i]
+    assert h.quantile(0.0) == pytest.approx(lo)
+    assert h.quantile(1.0) == pytest.approx(hi)
+    assert h.quantile(0.5) == pytest.approx(lo + (hi - lo) * 0.5)
+    # overflow bucket: its "upper edge" is the observed max, so q=1 on
+    # an out-of-range observation returns exactly that value
+    g = t.histogram("edge_big")
+    big = h.bounds[-1] * 10
+    g.observe(big)
+    assert g.quantile(1.0) == pytest.approx(big)
+    assert g.max == big
+    # a single observation lands every quantile in its own bucket
+    s = t.histogram("edge_one")
+    s.observe(0.2)
+    j = bisect.bisect_left(s.bounds, 0.2)
+    slo = s.bounds[j - 1] if j > 0 else 0.0
+    shi = s.bounds[j] if j < len(s.bounds) else s.max
+    for q in (0.01, 0.5, 0.99):
+        assert slo <= s.quantile(q) <= shi
+
+
+def test_series_ring_window_boundaries():
+    r = obs.SeriesRing("counter", capacity=4)
+    # fewer than two samples: delta/rate are identically 0
+    assert r.delta(1) == 0 and r.rate(1) == 0.0
+    r.append(0, 5)
+    assert r.delta(1) == 0 and r.rate(1) == 0.0
+    for step, v in ((1, 7), (2, 10), (3, 14)):
+        r.append(step, v)
+    # window exactly the buffer span and anything beyond both clamp to
+    # the oldest held sample — no index error, no silent wrap
+    assert r.delta(3) == r.delta(99) == 14 - 5
+    # window 0 coerces to 1 (the minimum meaningful window)
+    assert r.delta(0) == r.delta(1) == 14 - 10
+    assert r.rate(0) == pytest.approx(4.0)
+    # rate guards a zero step span (duplicate sample index)
+    dup = obs.SeriesRing("gauge", capacity=4)
+    dup.append(5, 1.0)
+    dup.append(5, 3.0)
+    assert dup.rate(1) == 0.0
+    # window(n) clamps like delta and floors n at 1
+    assert r.window(99) == [5, 7, 10, 14]
+    assert r.window(0) == [14]
+
+
+def test_histogram_ring_window_boundaries():
+    t = obs.enable()
+    h = t.histogram("wb_lat")
+    ring = obs.HistogramRing(capacity=8)
+    # empty ring: every windowed view is a zero, not a crash
+    assert ring.window_count() == 0
+    assert ring.window_frac_over(0.1) == 0.0
+    assert ring.window_quantile(0.5) == 0.0
+    # one snapshot: no base to difference against, so the "window" is
+    # everything the histogram ever saw
+    h.observe(0.02)
+    h.observe(0.3)
+    ring.append(0, h)
+    assert ring.window_count() == 2
+    assert ring.window_count(window=5) == 2
+    assert ring.window_frac_over(0.1) == pytest.approx(0.5)
+    # two snapshots, window=None: base is the FIRST snapshot, so the
+    # pre-baseline observations are outside every window
+    h.observe(0.4)
+    ring.append(1, h)
+    assert ring.window_count() == 1
+    assert ring.window_count(window=1) == 1
+    # window >= ring span clamps to the oldest snapshot, same answer
+    assert ring.window_count(window=99) == 1
+    # window=0 coerces to 1 like SeriesRing
+    assert ring.window_count(window=0) == 1
+    # an empty window (two identical snapshots) is 0-count and its
+    # frac/quantile stay 0.0 rather than dividing by zero
+    ring.append(2, h)
+    assert ring.window_count(window=1) == 0
+    assert ring.window_frac_over(0.1, window=1) == 0.0
+    assert ring.window_quantile(0.9, window=1) == 0.0
+
+
 def test_recorder_tracks_and_samples_by_name_and_labels():
     t = obs.enable()
     rec = obs.TimeSeriesRecorder(capacity=16)

@@ -27,6 +27,10 @@ Cells and their direction:
 - ``fleet_rollout.goodput_retention`` — higher better — and
   ``fleet_rollout.rollback_latency_s`` — lower better (the weight-push
   plane's overhead under live load and its auto-revert cost);
+- ``capacity_model.mean_rel_err`` — lower better (predicted-vs-measured
+  error of the calibrated step-cost model on the serving trend cell;
+  gated at 10x the base threshold because the healthy value is a small
+  ratio measured from CPU timing jitter);
 - MULTICHIP ``ok`` flipping true→false, or ``n_devices`` shrinking.
 
 Zero deps beyond the stdlib (the tier-1 suite runs ``--dry-run`` as a
@@ -45,8 +49,12 @@ from pathlib import Path
 
 _NUM = re.compile(r"_r?(\d+)\.json$")
 
-# (dotted path into the parsed dict, higher_is_better); kernels and
-# cohort_scaling fan out over their dynamic keys below
+# (dotted path into the parsed dict, higher_is_better[, threshold_scale]);
+# kernels and cohort_scaling fan out over their dynamic keys below.  The
+# optional third element scales the gate threshold for cells whose
+# healthy run-to-run noise exceeds the default band (the capacity-model
+# error is a small ratio measured from CPU timing jitter: only a
+# multiple-of-itself jump means the calibration fit regressed).
 _SCALAR_CELLS = (
     ("value", True),
     ("final_test_accuracy_pct", True),
@@ -60,6 +68,7 @@ _SCALAR_CELLS = (
     ("fleet_chaos.goodput_retention", True),
     ("fleet_rollout.goodput_retention", True),
     ("fleet_rollout.rollback_latency_s", False),
+    ("capacity_model.mean_rel_err", False, 10.0),
 )
 
 
@@ -82,18 +91,21 @@ def _dig(d: dict, dotted: str):
 
 
 def _cells_from(parsed: dict, prefix: str = "") -> dict:
-    """``name -> (value, higher_better)`` for every comparable cell in
-    one parsed bench dict (recursing once into ``cpu_fallback``)."""
+    """``name -> (value, higher_better, threshold_scale)`` for every
+    comparable cell in one parsed bench dict (recursing once into
+    ``cpu_fallback``)."""
     out: dict = {}
     if not isinstance(parsed, dict):
         return out
     dead = "error" in parsed and not parsed.get("value")
-    for dotted, higher in _SCALAR_CELLS:
+    for spec in _SCALAR_CELLS:
+        dotted, higher = spec[0], spec[1]
+        scale = spec[2] if len(spec) > 2 else 1.0
         if dead and dotted in ("value", "final_test_accuracy_pct"):
             continue  # device unreachable: the headline never ran
         v = _dig(parsed, dotted)
         if isinstance(v, (int, float)) and math.isfinite(v):
-            out[prefix + dotted] = (float(v), higher)
+            out[prefix + dotted] = (float(v), higher, scale)
     kernels = parsed.get("kernels")
     if isinstance(kernels, dict):
         for kname, cell in sorted(kernels.items()):
@@ -103,13 +115,13 @@ def _cells_from(parsed: dict, prefix: str = "") -> dict:
                 v = cell.get(field)
                 if isinstance(v, (int, float)) and math.isfinite(v):
                     out[f"{prefix}kernels.{kname}.{field}"] = (
-                        float(v), higher)
+                        float(v), higher, 1.0)
     cohort = _dig(parsed, "cohort_scaling.rounds_per_sec")
     if isinstance(cohort, dict):
         for size, v in sorted(cohort.items()):
             if isinstance(v, (int, float)) and math.isfinite(v):
                 out[f"{prefix}cohort_scaling.rounds_per_sec.{size}"] = (
-                    float(v), True)
+                    float(v), True, 1.0)
     fb = parsed.get("cpu_fallback")
     if isinstance(fb, dict) and not prefix:
         out.update(_cells_from(fb, prefix="cpu_fallback."))
@@ -118,22 +130,23 @@ def _cells_from(parsed: dict, prefix: str = "") -> dict:
 
 def compare_bench(prev: dict, new: dict, threshold: float) -> list[dict]:
     """Per-cell comparison rows; a row regresses when the change in the
-    *bad* direction exceeds ``threshold`` (relative to previous)."""
+    *bad* direction exceeds ``threshold`` (relative to previous, scaled
+    by the cell's own threshold multiplier)."""
     pcells = _cells_from(prev.get("parsed") or {})
     ncells = _cells_from(new.get("parsed") or {})
     rows = []
     for name in sorted(pcells):
         if name not in ncells:
             continue
-        pv, higher = pcells[name]
-        nv, _ = ncells[name]
+        pv, higher, scale = pcells[name]
+        nv, _, _ = ncells[name]
         if pv == 0:
             continue  # no meaningful relative change
         change = (nv - pv) / abs(pv)
         bad = -change if higher else change
         rows.append({"cell": name, "prev": pv, "new": nv,
                      "change_pct": round(change * 100, 2),
-                     "regressed": bad > threshold})
+                     "regressed": bad > threshold * scale})
     return rows
 
 

@@ -1,0 +1,187 @@
+#!/usr/bin/env python3
+"""Fit a versioned step-cost model from a profiler capture.
+
+Consumes the JSON document :meth:`obs.StepProfiler.capture` writes
+(``bench.py --calibrate-costs`` produces ``results/profile_capture_*.json``
+on device and the CPU-trend cells produce in-memory equivalents), fits the
+deterministic per-phase least-squares model of
+:mod:`ddl25spring_tpu.obs.capacity`, and persists it as
+``results/calib_<version>.json`` — sorted keys, fixed rounding, no
+timestamps, so the same capture always writes the byte-identical artifact
+(the contract ``tests/test_profile.py`` replays by running this tool
+twice).  The artifact is the calibration input for the ROADMAP item-5
+discrete-event fleet twin and loads back through
+``obs.load_calibration`` in a jax-import-free process.
+
+Optionally embeds a roofline section joining the capture's measured
+per-phase mean seconds against AOT flops/bytes
+(``results/northstar_aot_costs.txt``, the ``tools/northstar_aot_costs.py``
+artifact) and chip peaks (``results/chip_peaks_tpu.json``,
+``tools/chip_peaks.py``), plus a verbatim ``tools/mem_estimate.py`` JSON
+line — the same join ``tools/obs_report.py`` renders live.
+
+Usage:
+    python tools/calibrate.py results/profile_capture_tpu.json
+    python tools/calibrate.py CAPTURE --aot fl.round=flax+flax \\
+        --peaks results/chip_peaks_tpu.json \\
+        --aot-costs results/northstar_aot_costs.txt
+    python tools/calibrate.py CAPTURE --out-dir results --json
+
+Zero deps beyond the stdlib + the (stdlib-only) obs package; never
+imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO))
+
+from ddl25spring_tpu.obs import (fit_cost_model,  # noqa: E402
+                                 roofline_join, save_calibration)
+
+_AOT_LINE = re.compile(
+    r"^---\s+(?P<var>\S+):\s+compile\s+\S+\s+"
+    r"flops\s+(?P<flops>\S+)\s+bytes\s+(?P<bytes>\S+)\s*$")
+
+
+def parse_aot_costs(path: Path) -> dict:
+    """``variant -> {"flops", "bytes"}`` from the northstar AOT costs
+    text artifact (``--- <variant>: compile <s>s  flops <f>  bytes <b>``
+    header lines; the op dumps between them are ignored)."""
+    out: dict = {}
+    for line in path.read_text().splitlines():
+        m = _AOT_LINE.match(line)
+        if m:
+            out[m.group("var")] = {"flops": float(m.group("flops")),
+                                   "bytes": float(m.group("bytes"))}
+    return out
+
+
+def phase_means(capture: dict) -> dict:
+    """Measured mean seconds per phase, straight from the capture."""
+    out = {}
+    for phase, groups in sorted((capture.get("phases") or {}).items()):
+        total = n = 0
+        for g in groups:
+            secs = g.get("seconds") or ()
+            total += sum(secs)
+            n += len(secs)
+        if n:
+            out[phase] = total / n
+    return out
+
+
+def build_roofline(capture: dict, *, peaks_path: Path | None,
+                   aot_path: Path | None, aot_map: dict,
+                   mem_json: Path | None) -> list | None:
+    """The optional roofline block: None unless the peak + AOT inputs
+    resolve (a CPU-trend calibration has neither and stays lean)."""
+    if peaks_path is None or aot_path is None:
+        return None
+    if not peaks_path.is_file() or not aot_path.is_file():
+        return None
+    peaks_doc = json.loads(peaks_path.read_text())
+    peaks = peaks_doc.get("effective_peaks") or {}
+    variants = parse_aot_costs(aot_path)
+    if not variants:
+        return None
+    costs = {}
+    for phase, var in sorted(aot_map.items()):
+        if var in variants:
+            costs[phase] = variants[var]
+    rows = roofline_join(phase_means(capture), costs, peaks)
+    block: dict = {"peaks": peaks, "rows": rows,
+                   "aot_source": aot_path.name,
+                   "variants": sorted(variants)}
+    if mem_json is not None and mem_json.is_file():
+        try:
+            block["mem_estimate"] = json.loads(mem_json.read_text())
+        except json.JSONDecodeError:
+            pass
+    return [block]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="Fit results/calib_*.json from a StepProfiler "
+                    "capture (deterministic: same capture -> identical "
+                    "bytes)")
+    ap.add_argument("capture", type=Path,
+                    help="profiler capture JSON (bench.py "
+                         "--calibrate-costs output)")
+    ap.add_argument("--out-dir", type=Path, default=Path("results"),
+                    help="directory for calib_<version>.json "
+                         "(default: results)")
+    ap.add_argument("--min-samples", type=int, default=4,
+                    help="rows below which a phase degrades to its "
+                         "mean (default 4)")
+    ap.add_argument("--peaks", type=Path,
+                    default=_REPO / "results/chip_peaks_tpu.json",
+                    help="chip_peaks JSON for the roofline join "
+                         "(default: the repo artifact, wherever the "
+                         "tool is run from)")
+    ap.add_argument("--aot-costs", type=Path,
+                    default=_REPO / "results/northstar_aot_costs.txt",
+                    help="northstar_aot_costs text artifact (default: "
+                         "the repo artifact)")
+    ap.add_argument("--aot", action="append", default=[],
+                    metavar="PHASE=VARIANT",
+                    help="map a capture phase onto an AOT costs variant "
+                         "(repeatable; e.g. fl.round=flax+flax)")
+    ap.add_argument("--mem-json", type=Path, default=None,
+                    help="mem_estimate JSON line to embed verbatim in "
+                         "the roofline block")
+    ap.add_argument("--no-roofline", action="store_true",
+                    help="skip the roofline join even when inputs exist")
+    ap.add_argument("--json", action="store_true",
+                    help="print the artifact document to stdout too")
+    args = ap.parse_args()
+
+    if not args.capture.is_file():
+        print(f"no such capture: {args.capture}", file=sys.stderr)
+        return 2
+    try:
+        capture = json.loads(args.capture.read_text())
+    except json.JSONDecodeError as e:
+        print(f"unreadable capture: {e}", file=sys.stderr)
+        return 2
+    aot_map = {}
+    for spec in args.aot:
+        if "=" not in spec:
+            print(f"--aot expects PHASE=VARIANT, got {spec!r}",
+                  file=sys.stderr)
+            return 2
+        phase, var = spec.split("=", 1)
+        aot_map[phase] = var
+
+    model = fit_cost_model(capture, min_samples=args.min_samples)
+    roofline = None if args.no_roofline else build_roofline(
+        capture, peaks_path=args.peaks, aot_path=args.aot_costs,
+        aot_map=aot_map, mem_json=args.mem_json)
+    path = save_calibration(model, args.out_dir, roofline=roofline)
+
+    nr = model.source.get("nr_samples", 0)
+    print(f"calibrated {len(model.phases)} phase(s) from {nr} sample(s) "
+          f"-> {path}", file=sys.stderr)
+    for phase in sorted(model.phases):
+        pm = model.phases[phase]
+        feats = ",".join(pm["features"]) or "(intercept only)"
+        print(f"  {phase:<18} n={pm['nr_samples']:<5} "
+              f"mean={pm['mean_seconds']:.6f}s  "
+              f"rel_err={pm['fit_mean_rel_err']:.3f}  features={feats}",
+              file=sys.stderr)
+    if args.json:
+        print(path.read_text(), end="")
+    else:
+        print(str(path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

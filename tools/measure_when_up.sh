@@ -200,6 +200,14 @@ for a in json.load(sys.stdin)["argv"]:
       python bench.py --deadline-s 900 --norm-impl lean \
       --conv-impl im2col --remat; rc=$?
     echo "$(date +%H:%M:%S) im2col+remat bench done (exit $rc)" >> "$LOG"
+    # cost-model calibration: refresh the device-calibrated step-cost
+    # model (results/profile_capture_tpu.json + results/calib_*.json —
+    # the capacity plane's predictions and the ROADMAP-5 fleet twin both
+    # read it; obs_report's freshness line goes stale without this)
+    capture_r4 1800 results/bench_tpu_calib.json \
+      python bench.py --deadline-s 900 --norm-impl lean \
+      --calibrate-costs; rc=$?
+    echo "$(date +%H:%M:%S) cost-model calibration done (exit $rc)" >> "$LOG"
     nohup /root/repo/tools/tpu_watch.sh >/dev/null 2>&1 &
     echo "$(date +%H:%M:%S) sentinel finished" >> "$LOG"
     exit 0

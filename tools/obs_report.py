@@ -417,7 +417,7 @@ def render_prom_snapshot(summary: dict) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
-def report(events: list[dict], top: int) -> None:
+def report(events: list[dict], top: int, calib: dict | None = None) -> None:
     kinds = defaultdict(int)
     for e in events:
         kinds[e.get("event", "?")] += 1
@@ -995,6 +995,72 @@ def report(events: list[dict], top: int) -> None:
         print("  note: XLA counts scan/fori bodies once; FLOPs are a "
               "lower bound (bench.py cost_breakdown)")
 
+    # -- cost models & capacity (profile plane) --------------------------
+    prof_samples = take(counters, "profile_samples_total")
+    cap_err = take(gauges, "capacity_model_error")
+    recal_hints = take(counters, "capacity_recalibrate_hints_total")
+    hint_evs = [e for e in events
+                if e.get("event") == "capacity.recalibrate_hint"]
+    if calib or prof_samples or cap_err or recal_hints or hint_evs:
+        section("cost models & capacity (profile plane)")
+        if calib:
+            ver = str(calib.get("version", "?"))[:12]
+            src = calib.get("source") or {}
+            print(f"  cost model calib_{ver} "
+                  f"({src.get('nr_samples', '?')} samples, "
+                  f"{len(calib.get('phases') or {})} phases)")
+            for phase, pm in sorted((calib.get("phases") or {}).items()):
+                feats = ",".join(pm.get("features") or ()) or "intercept"
+                print(f"    {phase:<18} n={pm.get('nr_samples', 0):<5} "
+                      f"mean={fmt_seconds(pm.get('mean_seconds', 0))}  "
+                      f"fit_rel_err={pm.get('fit_mean_rel_err', 0):.3f}  "
+                      f"[{feats}]")
+            for block in calib.get("roofline") or ():
+                for row in block.get("rows") or ():
+                    line = (f"    roofline {row['phase']}: "
+                            f"{fmt_seconds(row['seconds'])} measured")
+                    if "pct_peak_flops" in row:
+                        line += f", {row['pct_peak_flops']:.1f}% of peak FLOP/s"
+                    if "pct_peak_hbm" in row:
+                        line += f", {row['pct_peak_hbm']:.1f}% of peak HBM BW"
+                    if "bound" in row:
+                        line += f"  ({row['bound']}-bound)"
+                    print(line)
+            # calibration freshness: rounds elapsed since the capture
+            rounds_now = _value(counters, "fl_rounds_total")
+            take(counters, "fl_rounds_total")
+            at = calib.get("captured_at_rounds")
+            if at is not None and rounds_now is not None:
+                print(f"    freshness: captured at round {int(at)}, "
+                      f"now {int(rounds_now)} — "
+                      f"{max(0, int(rounds_now) - int(at))} round(s) old")
+            elif rounds_now is not None:
+                print(f"    freshness: capture round unknown "
+                      f"({int(rounds_now)} rounds in this window)")
+        if prof_samples:
+            parts = ", ".join(
+                f"{lb.get('phase', '?')} x{st['value']}"
+                for lb, st in sorted(prof_samples,
+                                     key=lambda ls: ls[0].get("phase", "")))
+            print(f"  profiler samples: {parts}")
+        if cap_err:
+            for lb, st in sorted(cap_err,
+                                 key=lambda ls: ls[0].get("phase", "")):
+                print(f"  capacity_model_error[{lb.get('phase', '?')}] = "
+                      f"{st['value']:.3f} (windowed mean rel err, "
+                      f"predicted vs measured)")
+        if recal_hints or hint_evs:
+            n = sum(st["value"] for _, st in recal_hints) if recal_hints \
+                else len(hint_evs)
+            line = f"  RECALIBRATION HINTS: {n}"
+            if hint_evs:
+                last = hint_evs[-1]
+                line += (f" — last: {last.get('phase', '?')} drifted to "
+                         f"{last.get('mean_rel_err', 0):.3f} "
+                         f"(threshold {last.get('threshold', 0):g})")
+            print(line + "  — re-run bench.py --calibrate-costs on the "
+                         "next device window")
+
     # -- runtime watchdogs -----------------------------------------------
     comp = take(counters, "jax_compilations_total")
     fun_comp = take(counters, "jax_function_compiles_total")
@@ -1108,6 +1174,10 @@ def main() -> int:
                          "(needs jax; the JSONL part never does)")
     ap.add_argument("--top", type=int, default=8,
                     help="rows in the trace by-opcode table")
+    ap.add_argument("--calib", type=Path, default=None,
+                    help="calib_*.json cost-model artifact for the "
+                         "cost-models section (default: the newest "
+                         "results/calib_*.json, if any)")
     ap.add_argument("--prom", action="store_true",
                     help="print the last telemetry_summary as Prometheus "
                          "text exposition instead of the report")
@@ -1143,8 +1213,22 @@ def main() -> int:
             return 1
         sys.stdout.write(render_prom_snapshot(summaries[-1]["summary"]))
         return 0
+    calib = None
+    calib_path = args.calib
+    if calib_path is None:
+        candidates = sorted(
+            (Path(__file__).resolve().parent.parent / "results").glob(
+                "calib_*.json"),
+            key=lambda p: p.stat().st_mtime)
+        calib_path = candidates[-1] if candidates else None
+    if calib_path is not None and calib_path.is_file():
+        try:
+            calib = json.loads(calib_path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"(unreadable calib artifact {calib_path}: {e})",
+                  file=sys.stderr)
     print("telemetry report: " + ", ".join(str(p) for p in args.jsonl))
-    report(events, args.top)
+    report(events, args.top, calib=calib)
     if args.trace is not None:
         report_trace(args.trace, args.top)
     return 0
