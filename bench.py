@@ -682,6 +682,8 @@ def run_cpu_trend(nr_rounds: int = 2):
     fleet_rollout = _fleet_rollout_cell()
     _stamp("cpu trend: capacity model cell ...")
     capacity_model = _capacity_model_cell()
+    _stamp("cpu trend: kv quant/tiered cell ...")
+    kv_quant_tiered = _kv_quant_tiered_cell()
     print(json.dumps({
         "metric": CPU_TREND_METRIC,
         "value": round(nr_rounds / dt, 4),
@@ -699,6 +701,7 @@ def run_cpu_trend(nr_rounds: int = 2):
         "fleet_chaos": fleet_chaos,
         "fleet_rollout": fleet_rollout,
         "capacity_model": capacity_model,
+        "kv_quant_tiered": kv_quant_tiered,
         "wall_s": round(time.perf_counter() - t_start, 1),
     }))
     sys.stdout.flush()
@@ -923,6 +926,79 @@ def _capacity_model_cell(nr_requests: int = 8, budget: int = 8):
             "mean_rel_err": round(mean_rel_err, 4),
             "windowed_err": {p: round(v, 4)
                              for p, v in sorted(scorer.last_error.items())}}
+
+
+def _kv_quant_tiered_cell(nr_requests: int = 4, budget: int = 12):
+    """Goodput and device-resident KV bytes per stream of the PAGED
+    streaming batcher across the pool storage layouts
+    (``kv_dtype=`` + the host spill tier, docs/PERFORMANCE.md §12):
+    f32, int8, and int8 with spill on over a deliberately small
+    ``kv_pages`` so cold streams park.  ``resident_kv_per_stream``
+    prices the pool's page high-water mark at the layout's per-page
+    bytes over the concurrent slots — the ratio the ISSUE's 2-8x
+    streams-per-chip claim cashes out as: ~3x from the int8 byte width
+    alone at this tiny head_dim, more once parking lowers the page
+    peak.  ``tokens_per_sec`` is the goodput trend bench_regression
+    gates alongside it (quantization must buy residency, not cost
+    throughput)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu.models import kv_pool
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+
+    cfg = LlamaConfig(vocab_size=128, dmodel=48, nr_heads=4,
+                      nr_kv_heads=2, nr_layers=2, ctx_size=48,
+                      dtype=jnp.float32)
+    params = Llama(cfg).init(jax.random.PRNGKey(0),
+                             jnp.ones((1, 4), jnp.int32))
+    prng = np.random.default_rng(0)
+    prompts = [prng.integers(1, 128,
+                             size=int(prng.integers(3, 8))).tolist()
+               for _ in range(nr_requests)]
+    budgets = [budget] * nr_requests
+    variants = {
+        "f32": {"kv_dtype": "f32"},
+        "int8": {"kv_dtype": "int8"},
+        "int8_spill": {"kv_dtype": "int8", "spill": "host",
+                       "spill_after": 1, "spill_prefetch": 1,
+                       "kv_pages": 4},
+    }
+    cells = {}
+    for name, kw in variants.items():
+        def make_batcher():
+            return ContinuousBatcher(cfg, params, max_batch=2,
+                                     prefill_width=8, kv_layout="paged",
+                                     kv_page=8, **kw)
+
+        make_batcher().run(prompts, budgets)  # compile + warm
+        b = make_batcher()
+        t0 = time.perf_counter()
+        toks = b.run(prompts, budgets)
+        dt = time.perf_counter() - t0
+        nr_tok = sum(len(v) for v in toks)
+        page_b = kv_pool.kv_bytes(
+            8, cfg.nr_layers, cfg.kv_heads, cfg.head_dim,
+            dtype="int8" if name.startswith("int8") else "f32")
+        cells[name] = {
+            "tokens_per_sec": round(nr_tok / dt, 4),
+            "device_pages_peak": b._pool.pages_peak,
+            "resident_kv_per_stream": page_b * b._pool.pages_peak // 2,
+        }
+    drop = (cells["f32"]["resident_kv_per_stream"]
+            / cells["int8_spill"]["resident_kv_per_stream"])
+    assert drop >= 3.0, (
+        f"int8+spill resident KV per stream dropped only {drop:.2f}x vs "
+        "f32, expected >= 3x (page math is deterministic — this is a "
+        "pool-accounting regression, not noise)"
+    )
+    return {**cells,
+            "resident_drop_f32_vs_int8_spill": round(drop, 3),
+            "goodput_ratio_int8_spill_vs_f32": round(
+                cells["int8_spill"]["tokens_per_sec"]
+                / cells["f32"]["tokens_per_sec"], 3)}
 
 
 def _serving_saturation_cell(qps_factors=(0.5, 1.0, 2.0),

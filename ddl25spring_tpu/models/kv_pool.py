@@ -26,6 +26,15 @@ masks page-0 content out — models/llama.py ``_decode_attention``).
 prefix token ids: requests sharing a system prompt map their block-table
 heads onto the same read-only pages (one extra refcount each) and skip
 that prefill work entirely (``serving_prefix_hits_total``).
+
+The TIERED pool (models/serving.py ``spill="host"``) adds a second,
+host-RAM residency class: cold streams' written pages leave the device
+pool entirely (their bytes live in pinned host buffers until prefetched
+back) while this allocator keeps counting them via ``spilled_pages`` —
+``pages_in_use`` stays the DEVICE-resident count, ``pages_in_use +
+spilled_pages`` is the total across tiers.  Refcount semantics never
+change: a spilled page was *freed* here (its device frame is reusable);
+the spill tier owns the bytes, not the frame (docs/PERFORMANCE.md §12).
 """
 
 from __future__ import annotations
@@ -42,7 +51,7 @@ class KVPagePool:
     allocate); ``free`` raises on double-free or on page 0, because a
     bookkeeping bug here silently corrupts live requests' KV."""
 
-    __slots__ = ("nr_pages", "pages_peak", "_rc", "_free")
+    __slots__ = ("nr_pages", "pages_peak", "spilled_pages", "_rc", "_free")
 
     def __init__(self, nr_pages: int):
         if nr_pages < 2:
@@ -54,6 +63,12 @@ class KVPagePool:
         # pool between scheduler steps (loadgen) miss allocations freed
         # within one step, so the pool records its own peak
         self.pages_peak = 0
+        # host-tier accounting: page-sized byte buffers currently parked
+        # in the spill tier.  These pages were FREED here (their device
+        # frames are reusable) — the counter exists so residency telemetry
+        # and the SLO admission estimate can see total stream pages
+        # without walking the tier (serving_kv_resident_pages{tier}).
+        self.spilled_pages = 0
         self._rc = [0] * nr_pages
         # pop() hands out pages in ascending order from a fresh pool;
         # freed pages are reused LIFO — deterministic either way, which is
@@ -109,31 +124,97 @@ class KVPagePool:
     def refcount(self, page: int) -> int:
         return self._rc[page]
 
+    @property
+    def resident_pages(self) -> int:
+        """Device-tier pages in use — the ``tier="device"`` gauge value
+        (``spilled_pages`` is the ``tier="host"`` companion)."""
+        return self.pages_in_use
+
+    def note_spill(self, n: int) -> None:
+        """Record ``n`` pages entering the host tier (their device frames
+        were just freed — callers free() first, then note)."""
+        if n < 0:
+            raise ValueError(f"cannot spill {n} pages")
+        self.spilled_pages += n
+
+    def note_unspill(self, n: int) -> None:
+        """Record ``n`` pages leaving the host tier (prefetched back into
+        freshly allocated device frames, or their stream evicted)."""
+        if n < 0 or n > self.spilled_pages:
+            raise ValueError(
+                f"unspill of {n} pages with {self.spilled_pages} spilled"
+            )
+        self.spilled_pages -= n
+
 
 def pages_needed(prompt_window: int, budget: int, kv_page: int, *,
-                 prefix_len: int = 0, decode_chunk: int = 1) -> int:
+                 prefix_len: int = 0, decode_chunk: int = 1,
+                 spill: bool = False) -> int:
     """Private pages one request needs for its whole trajectory: logical
     slots ``[prefix_len // kv_page * kv_page, prefix_len + prompt_window +
     budget + decode_chunk - 1)`` minus the shared whole-prefix head pages.
     The chunk tail mirrors ``_validate_workload``'s ctx formula — chunked
     decode scratch-writes up to ``decode_chunk - 1`` slots past the budget
-    before the slot recycles, and those writes need real pages too."""
+    before the slot recycles, and those writes need real pages too.
+
+    ``spill=True`` returns the DEVICE-RESIDENT floor under the tiered
+    pool instead of the full trajectory: the prefill window plus one
+    decode chunk of headroom.  A tiered scheduler can park any stream
+    past that point (its cold pages ride the host tier), so the SLO
+    admission estimate must not price every queued request at its full
+    trajectory — that sum assumes all of them hold device pages
+    simultaneously, which is exactly what spilling makes unnecessary.
+    Total residency across tiers is still the ``spill=False`` number."""
     overrun = (decode_chunk - 1) if budget > 0 else 0
-    top = prefix_len + prompt_window + budget + overrun
+    if spill:
+        top = prefix_len + prompt_window + min(budget + overrun,
+                                               decode_chunk)
+    else:
+        top = prefix_len + prompt_window + budget + overrun
     return -(-top // kv_page) - prefix_len // kv_page
 
 
+# layout-knob name (models/serving.py ``kv_dtype=``) -> (value itemsize,
+# carries int8 scale planes).  "f32" doubles as "native": a bf16 model's
+# cache leaves are already bf16 and the knob leaves them alone.
+KV_DTYPES = {"f32": (4, False), "bf16": (2, False), "int8": (1, True)}
+
+
 def kv_bytes(nr_tokens: int, nr_layers: int, kv_heads: int, head_dim: int,
-             *, itemsize: int = 4, int8: bool = False) -> int:
+             *, itemsize: int = 4, int8: bool = False,
+             dtype: str | None = None) -> int:
     """Analytic resident-KV bytes for ``nr_tokens`` cached slots: K + V
     per layer (int8 adds the two float32 per-(token, head) scale planes).
     ``nr_tokens`` is ``max_batch * ctx_size`` for the contiguous layout
     and ``nr_pages * kv_page`` for the paged pool — the formula both
-    docs/PERFORMANCE.md §7 and mem_estimate ``--kv-pages`` quote."""
+    docs/PERFORMANCE.md §7 and mem_estimate ``--kv-pages`` quote.
+    ``dtype`` accepts the serving layout knob names (``KV_DTYPES``) and
+    overrides ``itemsize``/``int8``."""
+    if dtype is not None:
+        try:
+            itemsize, int8 = KV_DTYPES[dtype]
+        except KeyError:
+            raise ValueError(
+                f"unknown kv dtype {dtype!r} (one of {sorted(KV_DTYPES)})"
+            ) from None
     per_tok = 2 * kv_heads * head_dim * (1 if int8 else itemsize)
     if int8:
         per_tok += 2 * kv_heads * 4  # k_s / v_s float32 scales
     return nr_tokens * nr_layers * per_tok
+
+
+def tiered_kv_bytes(device_tokens: int, host_tokens: int, nr_layers: int,
+                    kv_heads: int, head_dim: int, *,
+                    dtype: str = "f32") -> dict:
+    """Bytes-per-tier for the tiered pool: ``device`` is the pool tree's
+    resident footprint, ``host`` prices spilled page bytes at the SAME
+    per-token rate (a spilled page is a verbatim copy of its pool rows —
+    including the scale planes at int8, which is what makes the
+    spill→prefetch round trip bit-exact).  The mem_estimate ``--kv-pages``
+    table and docs/PERFORMANCE.md §12 quote this split."""
+    one = lambda n: kv_bytes(n, nr_layers, kv_heads, head_dim, dtype=dtype)
+    dev, host = one(device_tokens), one(host_tokens)
+    return {"device": dev, "host": host, "total": dev + host}
 
 
 @dataclass

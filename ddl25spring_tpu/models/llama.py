@@ -104,6 +104,14 @@ class LlamaConfig:
     #                              per-token read bill vs bf16 (4x vs f32).
     #                              Values quantize at the write; the read
     #                              dequant fuses into the attention einsum.
+    kv_cache_dtype: str | None = None  # serving: decode KV cache STORAGE
+    #                              dtype ("bfloat16"; None = compute
+    #                              dtype).  Halves an f32 cache; values
+    #                              cast at the write, reads promote back
+    #                              inside the attention einsum.  The
+    #                              models/serving.py kv_dtype="bf16"
+    #                              layout knob sets this; mutually
+    #                              exclusive with kv_cache_int8.
     decode_seq_shards: int = 1  # >1: KV cache sharded over `seq_axis`
     #                             (parallel/sp.py make_sp_generate) — each
     #                             device owns ctx_size/shards cache slots;
@@ -149,6 +157,22 @@ class LlamaConfig:
             raise ValueError(
                 "kv_cache_int8 is not yet wired into the seq-sharded "
                 "decode path; shard a float cache or serve unsharded"
+            )
+        if self.kv_cache_dtype not in (None, "bfloat16"):
+            raise ValueError(
+                f"kv_cache_dtype={self.kv_cache_dtype!r} not in (None, "
+                "'bfloat16') — int8 storage is its own knob "
+                "(kv_cache_int8: values need scale planes, not just a cast)"
+            )
+        if self.kv_cache_dtype is not None and self.kv_cache_int8:
+            raise ValueError(
+                "kv_cache_dtype and kv_cache_int8 are mutually exclusive "
+                "storage layouts for the same cache"
+            )
+        if self.kv_cache_dtype is not None and self.decode_seq_shards > 1:
+            raise ValueError(
+                "kv_cache_dtype is not wired into the seq-sharded decode "
+                "path (same restriction as kv_cache_int8)"
             )
         if self.moe_dispatch not in ("dense", "capacity"):
             raise ValueError(
@@ -469,7 +493,16 @@ class Attention(nn.Module):
                 write(cv_q, vq)
                 write(cv_s, vs)
         else:
-            zeros = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), q.dtype)
+            cdtype = (jnp.bfloat16 if cfg.kv_cache_dtype == "bfloat16"
+                      else q.dtype)
+            if cdtype != k.dtype:
+                # storage-dtype cast ONCE, before every consumer forks
+                # (write / pending stash / flash cur-row / deferred
+                # inject): they must all see the exact stored value or
+                # the deferred and in-forward paths would diverge
+                k = k.astype(cdtype)
+                v = v.astype(cdtype)
+            zeros = lambda: jnp.zeros((B, S, Hkv, cfg.head_dim), cdtype)
             ck = self.variable("cache", "k", zeros)
             cv = self.variable("cache", "v", zeros)
             if defer:

@@ -72,7 +72,9 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import queue
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import jax
@@ -80,6 +82,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..data.prefetch import PrefetchStream
 from . import kv_pool
 from .llama import Llama, LlamaConfig
 
@@ -134,6 +137,106 @@ class _Slot:
     @property
     def free(self) -> bool:
         return self.request_id is None
+
+
+@dataclass
+class _ParkedStream:
+    """Host-side remainder of one SPILLED stream (the tiered pool,
+    ``spill="host"``): everything a fresh lane needs to resume decoding.
+    ``host_pages`` is the ``jax.device_get`` copy of the stream's written
+    pool pages — a VERBATIM byte copy of the pool rows (int8 values and
+    their scale planes included), which is what makes the spill→prefetch
+    round trip bit-exact.  ``tok``/``pos``/``pad`` are device scalars
+    sliced from the lane vectors at park time (never fetched; restored
+    with ``.at[slot].set``), so parking adds exactly one blocking copy:
+    the page bytes."""
+
+    rid: object
+    emitted: list
+    budget: int
+    total: int
+    ok_refs: list
+    deadline: float | None
+    n_pages: int        # private pages to re-allocate at resume
+    n_written: int      # leading pages whose bytes ride the host tier
+    host_pages: object  # device_get pool-leaf tree, (n_written, pg, ...)
+    tok: object
+    pos: object
+    pad: object
+    enq_step: int | None = None  # scheduler step the upload was enqueued
+    dead: bool = False           # evicted while parked (staged copy dropped)
+
+
+class _UploadFeed:
+    """Work-queue adapter between the scheduler and ``PrefetchStream``'s
+    producer thread: the producer blocks here until the scheduler enqueues
+    a parked stream, then performs the host→device transfer
+    (``jnp.asarray`` over the saved page bytes) OFF the scheduler thread —
+    that transfer overlapping the current decode chunk is the whole point
+    of routing resumes through data/prefetch.py."""
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self._closed = False
+
+    def put(self, handle) -> None:
+        self._q.put(handle)
+
+    def close(self) -> None:
+        self._closed = True
+
+    def next_batch(self):
+        while True:
+            try:
+                h = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._closed:
+                    raise RuntimeError("spill tier closed")
+                continue
+            return h, jax.tree.map(jnp.asarray, h.host_pages)
+
+
+class _SpillTier:
+    """The staging pipeline of the tiered KV pool — park/resume POLICY
+    lives on the batcher; this owns only the double-buffered host→device
+    upload path (``PrefetchStream`` over an :class:`_UploadFeed`, depth =
+    ``spill_prefetch``).  ``depth=0`` disables lookahead entirely: every
+    resume stages synchronously and counts as ``late``."""
+
+    def __init__(self, depth: int):
+        self.depth = max(0, int(depth))
+        self._feed = _UploadFeed()
+        self._stream = (PrefetchStream(self._feed, depth=self.depth)
+                        if self.depth else None)
+
+    def enqueue(self, handle: _ParkedStream, step: int) -> None:
+        """Initiate staging for ``handle`` at scheduler step ``step`` —
+        the hit/late accounting is by INITIATION LEAD (enqueued on an
+        earlier step than the resume consuming it = hit), not wall-clock
+        timing, so the counters are deterministic."""
+        if self._stream is None:
+            return
+        handle.enq_step = step
+        self._feed.put(handle)
+
+    def collect(self, handle: _ParkedStream):
+        """The staged device page tree for ``handle``.  Consumption is
+        FIFO in enqueue order (resume order IS park order); entries whose
+        stream was evicted while parked (``dead``) are drained and
+        dropped.  Falls back to a synchronous upload when the handle was
+        never enqueued (depth 0, or resume outran the lookahead)."""
+        if self._stream is None or handle.enq_step is None:
+            return jax.tree.map(jnp.asarray, handle.host_pages)
+        while True:
+            got, tree = self._stream.next_batch()
+            if got is handle:
+                return tree
+            assert got.dead, "spill prefetch consumed out of order"
+
+    def close(self) -> None:
+        self._feed.close()
+        if self._stream is not None:
+            self._stream.close()
 
 
 def _right_aligned_prefill(model, W: int, P: int, params, prompt_row,
@@ -462,7 +565,9 @@ class ContinuousBatcher:
                  max_queue: int | None = None, poison_guard: bool = False,
                  fault_plan=None, kv_layout: str = "contiguous",
                  kv_page: int = 16, kv_pages: int | None = None,
-                 prefix_tokens=None, slo_deadline_s: float | None = None):
+                 prefix_tokens=None, slo_deadline_s: float | None = None,
+                 kv_dtype: str = "f32", spill: str = "off",
+                 spill_after: int = 2, spill_prefetch: int = 2):
         # ``params`` is the full variables dict ({"params": ...}), the same
         # contract as models.generate.generate / speculative_generate.
         # ``decode_chunk``: tokens per decode dispatch — admissions happen
@@ -498,6 +603,22 @@ class ContinuousBatcher:
         # ``slo_deadline_s`` admission SLO: reject (with a drain-rate
         #                   derived ``retry_after_s``) requests whose
         #                   estimated queue + pool wait already exceeds it.
+        #
+        # Tiered / quantized pool (docs/PERFORMANCE.md §12):
+        # ``kv_dtype``      pool storage dtype — "f32" (native: the pool
+        #                   stores the compute dtype, bit-identical to the
+        #                   pre-knob batcher), "bf16", or "int8" (pages
+        #                   quantize per-(token, head), scale planes ride
+        #                   the pool tree, kernels dequantize in-VMEM);
+        # ``spill``         "off" or "host" — park cold streams' written
+        #                   pages in host RAM when admission is blocked on
+        #                   the pool, prefetch them back (double-buffered,
+        #                   data/prefetch.py) when a lane + pages free up;
+        # ``spill_after``   decode chunks a stream must have run before it
+        #                   is park-eligible (the cold-age threshold);
+        # ``spill_prefetch`` host→device staging lookahead depth (0 = no
+        #                   lookahead: every resume stages synchronously
+        #                   and counts as ``late``).
         if config.decode_seq_shards > 1:
             raise NotImplementedError(
                 "continuous batching over the sequence-sharded cache: use "
@@ -508,6 +629,45 @@ class ContinuousBatcher:
                 f"kv_layout must be 'contiguous' or 'paged', got "
                 f"{kv_layout!r}"
             )
+        if kv_dtype not in kv_pool.KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {sorted(kv_pool.KV_DTYPES)}, "
+                f"got {kv_dtype!r}"
+            )
+        if kv_dtype != "f32" and kv_layout != "paged":
+            raise ValueError(
+                f"kv_dtype={kv_dtype!r} is a paged-pool layout knob "
+                "(kv_layout='paged'); the contiguous cache stores the "
+                "compute dtype"
+            )
+        self.kv_dtype = kv_dtype
+        if kv_dtype == "int8":
+            # reuse the existing int8 cache path wholesale (models/
+            # llama.py ``quant``, ops/flash_decode.py ``_kernel_int8``):
+            # pool leaves become int8 pages plus f32 per-(token-in-page,
+            # head) scale planes, upcast INSIDE the consuming kernels —
+            # the f32 copy of the pool never exists.  Replaced before
+            # ``with_resolved_decode_impl`` / prefix precompute so the
+            # compiled programs and the prefix cache share the layout.
+            config = dataclasses.replace(config, kv_cache_int8=True)
+        elif kv_dtype == "bf16":
+            config = dataclasses.replace(config, kv_cache_dtype="bfloat16")
+        if spill not in ("off", "host"):
+            raise ValueError(f"spill must be 'off' or 'host', got {spill!r}")
+        if spill != "off" and kv_layout != "paged":
+            raise ValueError("spill='host' requires kv_layout='paged' "
+                             "(the contiguous cache has no pool to tier)")
+        if spill_after < 1:
+            raise ValueError(
+                f"spill_after must be >= 1 (a stream must decode at least "
+                f"one chunk before it can be cold), got {spill_after}"
+            )
+        if spill_prefetch < 0:
+            raise ValueError(
+                f"spill_prefetch must be >= 0, got {spill_prefetch}"
+            )
+        self._spill_on = spill == "host"
+        self.spill_after = int(spill_after)
         self.config = config
         self.params = params
         self.max_batch = max_batch
@@ -654,6 +814,23 @@ class ContinuousBatcher:
         # while telemetry is enabled; queue-wait and request-latency
         # histograms are derived from these host-side)
         self._req_ts: dict = {}
+        # tiered-pool state (``spill="host"``; docs/PERFORMANCE.md §12).
+        # Parked streams in park order — resume is head-of-line FIFO over
+        # this deque, with priority over fresh admissions — plus the
+        # host→device staging pipeline and the per-slot cold-age counters
+        # (decode chunks since admission).  All of it is inert when spill
+        # is off: the deque stays empty and no code path below touches
+        # device state, preserving the bit-identity contract.
+        self._parked: deque = deque()
+        self._tier = _SpillTier(spill_prefetch) if self._spill_on else None
+        self._slot_age = [0] * max_batch
+        self._sched_step = 0
+        self._int8 = kv_dtype == "int8"
+        # per-page quantized bytes (K + V int8 values + f32 scale planes,
+        # all layers) — the serving_kv_dequant_bytes_total unit
+        self._page_qbytes = (kv_pool.kv_bytes(
+            self.kv_page, config.nr_layers, config.kv_heads,
+            config.head_dim, dtype="int8") if self._int8 else 0)
 
     # -- telemetry (all no-ops while ddl25spring_tpu.obs is disabled) ----
 
@@ -716,11 +893,16 @@ class ContinuousBatcher:
             )
         return p[n:]
 
-    def _pages_needed(self, budget: int) -> int:
-        """Private pages one admission holds for its whole trajectory."""
+    def _pages_needed(self, budget: int, *, resident: bool = False) -> int:
+        """Private pages one admission holds for its whole trajectory;
+        ``resident=True`` prices the DEVICE-resident floor under the
+        tiered pool instead (kv_pool.pages_needed ``spill=``) — what the
+        SLO admission estimate charges queued-ahead requests when cold
+        pages can spill."""
         return kv_pool.pages_needed(
             self.prefill_width, budget, self.kv_page,
             prefix_len=self.prefix_len, decode_chunk=self.decode_chunk,
+            spill=resident,
         )
 
     def _check_pool_capacity(self, budgets, label=None):
@@ -765,6 +947,161 @@ class ContinuousBatcher:
         if obs.enabled():
             obs.set_gauge("serving_kv_pages_in_use",
                           self._pool.pages_in_use)
+            obs.set_gauge("serving_kv_resident_pages",
+                          self._pool.resident_pages, tier="device")
+
+    # -- tiered pool: park / prefetch / resume (spill="host") ------------
+
+    def _obs_kv_residency(self):
+        """Per-tier residency gauges: ``tier="device"`` is the pool's
+        allocated pages, ``tier="host"`` the spilled page buffers."""
+        if obs.enabled():
+            obs.set_gauge("serving_kv_resident_pages",
+                          self._pool.resident_pages, tier="device")
+            obs.set_gauge("serving_kv_resident_pages",
+                          self._pool.spilled_pages, tier="host")
+
+    def _park_slot(self, s: int):
+        """Spill slot ``s``'s stream to the host tier: device_get its
+        WRITTEN pages (a verbatim byte copy, scale planes included — the
+        one blocking copy parking costs; budget-mode pipelining pays this
+        fence only when a spill actually triggers), free the lane and ALL
+        its pages (head reference included), and append the parked handle.
+        The freed frames are what the blocked admission gets."""
+        sl = self.slots[s]
+        hp = self._head_len
+        pg = self.kv_page
+        private = [int(p) for p in self._tables[s, hp:] if p > 0]
+        # content extent is host-known without a fetch: prefill wrote
+        # [0, P+W) and every chunk since advanced all lanes by K
+        written = (self.prefix_len + self.prefill_width
+                   + self._slot_age[s] * self.decode_chunk)
+        n_written = min(len(private), max(0, -(-written // pg) - hp))
+        h = _ParkedStream(
+            rid=sl.request_id, emitted=sl.emitted, budget=sl.budget,
+            total=sl.total, ok_refs=sl.ok_refs, deadline=sl.deadline,
+            n_pages=len(private), n_written=n_written, host_pages=None,
+            tok=self.tokens[s], pos=self.pos[s], pad=self.pad[s],
+        )
+        if n_written:
+            ix = jnp.asarray(private[:n_written], jnp.int32)
+            h.host_pages = jax.device_get(
+                jax.tree.map(lambda big: big[ix], self.cache))
+        if hp and self._tables[s, 0] > 0:
+            self._pool.free(self._head_pages)
+        if private:
+            self._pool.free(private)
+        self._tables[s, :] = 0
+        self._pool.note_spill(n_written)
+        self.slots[s] = _Slot()
+        self._slot_age[s] = 0
+        self._parked.append(h)
+        obs.inc("serving_kv_spills_total", n_written)
+        self._obs_kv_residency()
+
+    def _make_room(self, need: int):
+        """Park cold streams until ``need`` pages are free or nobody is
+        park-eligible.  Victim order is ascending slot index over active,
+        non-quarantined, unfinished slots that have decoded at least
+        ``spill_after`` chunks — deterministic, so the whole trajectory
+        stays a pure function of the request sequence."""
+        while self._pool.free_pages < need:
+            victim = None
+            for s, sl in enumerate(self.slots):
+                if (sl.free or s in self._quarantined or sl.done_eos
+                        or sl.budget <= 0):
+                    continue
+                if self._slot_age[s] < self.spill_after:
+                    continue
+                victim = s
+                break
+            if victim is None:
+                return
+            self._park_slot(victim)
+
+    def _prefetch_ahead(self):
+        """Initiate host→device staging for the next ``spill_prefetch``
+        parked streams (resume order is FIFO, so the lookahead window is
+        the deque head).  Runs right after admissions so the producer
+        thread's uploads overlap the decode chunk below — a resume that
+        consumes an upload initiated on an EARLIER step counts as a
+        prefetch ``hit``."""
+        if self._tier is None or self._tier.depth == 0:
+            return
+        for i, h in enumerate(self._parked):
+            if i >= self._tier.depth:
+                break
+            if h.enq_step is None and h.n_written:
+                self._tier.enqueue(h, self._sched_step)
+
+    def _resume_parked(self):
+        """Re-admit parked streams — head-of-line FIFO over the parked
+        deque, called BEFORE fresh admissions each step so resumed
+        streams have first claim on freed pages.  The staged bytes are
+        written into freshly allocated frames verbatim (same dtypes,
+        scale planes included), so the logical KV view — and therefore
+        every subsequent greedy token — is identical to never having
+        parked."""
+        if not self._parked:
+            return
+        free = [s for s, sl in enumerate(self.slots)
+                if sl.free and s not in self._quarantined]
+        hp = self._head_len
+        while self._parked and free:
+            h = self._parked[0]
+            if self._pool.free_pages < h.n_pages:
+                # head-of-line ON PURPOSE, like _admit_from: resuming a
+                # smaller parked stream first would make trajectories
+                # depend on pool timing
+                break
+            self._parked.popleft()
+            s = free.pop(0)
+            pages = self._pool.alloc(h.n_pages)
+            if self._head_pages:
+                if self._prefix_tokens is not None:
+                    self._registry.acquire(self._prefix_tokens)
+                else:
+                    self._pool.share(self._head_pages)
+                self._tables[s, :hp] = self._head_pages
+            self._tables[s, hp:hp + len(pages)] = pages
+            self._tables[s, hp + len(pages):] = 0
+            hit = h.enq_step is not None and h.enq_step < self._sched_step
+            if h.n_written:
+                staged = self._tier.collect(h)
+                ix = jnp.asarray(pages[:h.n_written], jnp.int32)
+                self.cache = jax.tree.map(
+                    lambda big, st: big.at[ix].set(st), self.cache, staged)
+            self.tokens = self.tokens.at[s].set(h.tok)
+            self.pos = self.pos.at[s].set(h.pos)
+            self.pad = self.pad.at[s].set(h.pad)
+            sl = self.slots[s]
+            sl.request_id = h.rid
+            sl.emitted = h.emitted
+            sl.budget = h.budget
+            sl.total = h.total
+            sl.done_eos = False
+            sl.ok_refs = h.ok_refs
+            sl.deadline = h.deadline
+            self._slot_age[s] = 0
+            self._pool.note_unspill(h.n_written)
+            obs.inc("serving_kv_prefetch_total",
+                    result="hit" if hit else "late")
+            self._obs_kv_residency()
+
+    def _spillable_pages(self) -> int:
+        """Device pages held by park-eligible streams — pages a spill
+        pass could free WITHOUT waiting for a completion (the SLO
+        admission estimate credits these against the pool deficit)."""
+        hp = self._head_len
+        n = 0
+        for s, sl in enumerate(self.slots):
+            if (sl.free or s in self._quarantined or sl.done_eos
+                    or sl.budget <= 0):
+                continue
+            if self._slot_age[s] < self.spill_after:
+                continue
+            n += int((self._tables[s, hp:] > 0).sum())
+        return n
 
     def _reject(self, reason: str, message: str, retry_after: float):
         obs.inc("serving_rejected_total")
@@ -784,9 +1121,17 @@ class ContinuousBatcher:
         wait = est_chunk * (len(self._queue) / self.max_batch)
         bound = "slo"
         if self._paged:
-            ahead = sum(self._pages_needed(b) for _r, _p, b in self._queue)
+            # under the tiered pool the queued-ahead demand is priced at
+            # each request's device-RESIDENT floor (its cold pages can
+            # spill), and pages held by already-cold streams count as
+            # free-able — otherwise the estimate rejects requests whose
+            # pages the spill pass would hand over immediately
+            ahead = sum(self._pages_needed(b, resident=self._spill_on)
+                        for _r, _p, b in self._queue)
             deficit = (self._pages_needed(budget) + ahead
                        - self._pool.free_pages)
+            if self._spill_on and deficit > 0:
+                deficit -= self._spillable_pages()
             if deficit > 0:
                 pool_wait = (deficit / self._drain_pps
                              if self._drain_pps > 0
@@ -878,6 +1223,7 @@ class ContinuousBatcher:
             sl.total = budget
             sl.done_eos = False
             sl.ok_refs = []
+            self._slot_age[s] = 0
             # injected stall (fault plan): the request's deadline is
             # already behind it — evicted at the next chunk boundary
             rel = self._deadlines.get(rid)
@@ -969,6 +1315,35 @@ class ContinuousBatcher:
                 self._deadlines.pop(sl.request_id, None)
                 self._release_pages(s)
                 self.slots[s] = _Slot()
+        if self._parked:
+            # parked streams keep their deadline while spilled: eviction
+            # marks the handle dead (its staged upload, if any, is
+            # drained and dropped at the next collect) and releases the
+            # host-tier accounting — no device pages are involved
+            for h in list(self._parked):
+                if h.deadline is None:
+                    continue
+                if now is None:
+                    now = time.perf_counter()
+                if now >= h.deadline:
+                    if h.ok_refs:
+                        self._okrefs[h.rid] = h.ok_refs
+                    finished[h.rid] = h.emitted
+                    self._status[h.rid] = "timed_out"
+                    rids.append(h.rid)
+                    obs.inc("serving_timed_out_total")
+                    obs.event("serving.timed_out", rid=repr(h.rid),
+                              emitted=len(h.emitted), parked=True)
+                    rt = obs.reqtrace()
+                    if rt is not None:
+                        rt.note(h.rid, "timed_out",
+                                replica=getattr(self, "_replica_ix", None),
+                                emitted=len(h.emitted))
+                    self._deadlines.pop(h.rid, None)
+                    h.dead = True
+                    self._parked.remove(h)
+                    self._pool.note_unspill(h.n_written)
+                    self._obs_kv_residency()
         if rids:
             self._obs_finish(rids)
 
@@ -1143,18 +1518,21 @@ class ContinuousBatcher:
         with obs.span("serving.run", requests=len(requests),
                       mode="eos" if eos_mode else "budget"):
             while len(finished) < len(requests):
+                self._sched_step += 1
+                self._resume_parked()
                 group = self._admit_from(pending)
                 if group:
                     firsts = self._admit_group(group)
                     if eos_mode:
                         self._sync_admit_bookkeep(group, firsts)
+                self._prefetch_ahead()
                 self._harvest(finished, resolve=eos_mode)
                 if fenced:
                     self._evict_expired(finished)
                 active = [s for s, sl in enumerate(self.slots)
                           if not sl.free]
                 if not active:
-                    if pending and self._quarantined:
+                    if (pending or self._parked) and self._quarantined:
                         # admission starved with every usable slot
                         # quarantined: scrub the poisoned rows and retry
                         self.scrub()
@@ -1283,6 +1661,17 @@ class ContinuousBatcher:
                     )
         self.stats["decode_steps"] += K
         self.stats["slot_steps"] += self.max_batch * K
+        if self._spill_on:
+            for s, sl in enumerate(self.slots):
+                if not sl.free:
+                    self._slot_age[s] += 1
+        if self._int8 and obs.enabled():
+            # every decode step streams the resident quantized pages
+            # through the in-kernel upcast; count the bytes so the
+            # roofline attribution can see the dequant traffic
+            pages_read = int((self._tables > 0).sum())
+            obs.inc("serving_kv_dequant_bytes_total",
+                    K * pages_read * self._page_qbytes)
         if self._paged and self.config.decode_impl == "fused":
             # each scan step ran the one-Pallas-program inner loop
             # (ops/fused_decode_step.py)
@@ -1294,7 +1683,14 @@ class ContinuousBatcher:
         admission group handed to _admit_group (empty if none).
         Quarantined slots (poison guard) stay out of rotation — their
         cache rows hold non-finite state a new request's decode would
-        read through attention."""
+        read through attention.
+
+        With ``spill="host"`` a head-of-line request blocked on the pool
+        first parks cold streams (:meth:`_make_room`) — freeing their
+        lane AND their pages — so total in-flight streams can exceed both
+        ``max_batch`` and what the device pool could hold at once."""
+        if self._paged and self._spill_on and pending:
+            self._make_room(self._pages_needed(pending[0][2]))
         free = [s for s, sl in enumerate(self.slots)
                 if sl.free and s not in self._quarantined]
         group = []
@@ -1356,9 +1752,12 @@ class ContinuousBatcher:
 
     @property
     def in_flight(self) -> int:
-        """Requests submitted but not yet returned by step()/drain()."""
+        """Requests submitted but not yet returned by step()/drain() —
+        parked (spilled) streams included: they hold no lane or device
+        pages, but they are very much still being served."""
         active = sum(1 for sl in self.slots if not sl.free)
-        return len(self._queue) + len(self._instant) + active
+        return (len(self._queue) + len(self._instant) + active
+                + len(self._parked))
 
     def submit(self, rid, prompt, max_new_tokens: int,
                deadline_s: float | None = None) -> None:
@@ -1440,6 +1839,8 @@ class ContinuousBatcher:
         finished: dict = dict(self._instant)
         self._instant.clear()
         self._obs_finish(list(finished))  # zero-budget instants
+        self._sched_step += 1
+        self._resume_parked()
         if self._deadlines or self._hit_rids:
             # SLO-driven admission order: tightest deadline slack first
             # (the clock starts at admission, so a request's slack IS its
@@ -1465,10 +1866,12 @@ class ContinuousBatcher:
                     tokens=sum(len(p) for _s, _r, p, _b in group),
                     width=self.prefill_width,
                     pages=self._pool.pages_in_use if self._paged else 0)
+        self._prefetch_ahead()
         self._harvest(finished, resolve=True)
         self._evict_expired(finished)
         active = [s for s, sl in enumerate(self.slots) if not sl.free]
-        if not active and self._queue and self._quarantined:
+        if (not active and (self._queue or self._parked)
+                and self._quarantined):
             # every usable slot quarantined while requests wait: scrub
             # the poisoned rows so the next step can admit
             self.scrub()

@@ -37,6 +37,16 @@ generation ≡ xla at max_err 0.0, results/tpu_validate.txt; 1796 vs 1537
 tok/s A/B, results/generate_flash_tpu.txt).  Since that capture the
 default is ``LlamaConfig.decode_impl="auto"``: flash-decode on TPU when
 eligible, xla on other backends / seq-sharded / int8-cache decode.
+
+Quantized pages (the serving pool's ``kv_dtype="int8"`` layout knob,
+docs/PERFORMANCE.md §12) ride ``_kernel_int8``: page tiles stream from
+HBM as int8 alongside their per-(token, head) f32 scale planes, upcast
+INSIDE the kernel against the f32 VMEM accumulator, and the appended row
+is re-quantized at the write site (models/llama.py ``quant``) — no f32
+copy of the pool ever exists, in HBM or VMEM.  The weight-update-sharding
+discipline (arXiv 2004.13336) at page granularity: keep the compact form
+resident, materialize full precision only inside the consuming
+computation.
 """
 
 from __future__ import annotations

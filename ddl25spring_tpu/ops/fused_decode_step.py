@@ -31,6 +31,17 @@ scalar-prefetch arguments so each row's page DMA is table-routed by the
 BlockSpec index maps.  The pool leaves alias input to output
 (``input_output_aliases``) — untouched pages are never copied, and the
 buffers donate straight through the serving scan carry.
+
+The program is GENERIC over the pool's leaf set and dtypes, which is how
+the quantized layout (serving ``kv_dtype="int8"``, docs/PERFORMANCE.md
+§12) rides through unchanged: the ``pending`` rows arrive ALREADY
+re-quantized by the forward's write site (models/llama.py ``quant`` —
+int8 values plus their per-(token, head) scale rows are just more
+leaves), so the append scatters compact bytes and the f32 copy of the
+pool never exists here either.  Spill/prefetch (the tiered pool) is
+invisible at this layer by design — parking happens between dispatches,
+and a resumed stream's pages hold verbatim bytes at fresh physical
+indices the block tables already route.
 """
 
 from __future__ import annotations

@@ -113,6 +113,22 @@ def quantize_int8(tree, key):
     return int8_decode(q, s, like=tree)
 
 
+def int8_error_bound(absmax, *, stochastic: bool = False):
+    """Worst-case per-element dequantization error of the symmetric int8
+    scheme used everywhere in this repo (``scale = absmax / 127``): one
+    full quantization step ``scale`` under stochastic rounding
+    (:func:`int8_encode` — unbiased, so the wire average cancels), half a
+    step ``scale / 2`` under round-to-nearest (the serving KV cache,
+    models/llama.py ``quant`` — deterministic, so greedy decode replays
+    bit-identically).  The serving pool applies this at PAGE granularity:
+    its scale planes are per-(token-in-page, head), so ``absmax`` there is
+    each cached row's own max — the per-page divergence oracle
+    tests/test_serving_paged.py pins against this bound.  Accepts scalars
+    or arrays; pure arithmetic, usable host-side."""
+    step = absmax / 127.0
+    return step if stochastic else step / 2.0
+
+
 def init_compression_state(params, mesh, axis: str = "data"):
     """Zero error-feedback residual: one residual per shard, stored with an
     explicit leading shard axis (leaf shape ``(W,) + param.shape``) and

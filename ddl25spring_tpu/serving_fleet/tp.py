@@ -85,6 +85,14 @@ class TPShardedBatcher(ContinuousBatcher):
         W = int(mesh.shape[model_axis])
         kv_heads = config.nr_kv_heads or config.nr_heads
         if W > 1:
+            if kwargs.get("spill", "off") != "off":
+                raise NotImplementedError(
+                    "spill='host' over a head-sharded pool: parking "
+                    "device_gets and re-uploads whole pool pages, which "
+                    "would gather/rescatter every shard through the host "
+                    "— spill on the TP replica is future work (kv_dtype "
+                    "including int8 composes fine: the scale planes "
+                    "shard on the same head axis)")
             if config.nr_heads % W or kv_heads % W:
                 raise ValueError(
                     f"nr_heads={config.nr_heads} / kv_heads={kv_heads} "

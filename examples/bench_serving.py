@@ -33,8 +33,15 @@ points (the batcher's program cache is keyed on shapes, not instances).
 If the device dies mid-run, the partial capture lands in
 ``results/bench_partial_capture.json`` like bench.py's.
 
+``--kv-dtype`` / ``--spill`` select the pool storage layout and the
+host spill tier (paged only; docs/PERFORMANCE.md §12): sweeping
+``--kv-dtype int8 --spill host`` against f32 at a pinned ``--kv-pages``
+is how the knee-moves-right claim is captured — same device page
+budget, more concurrent streams resident.
+
 Run: python examples/bench_serving.py [--batch 4] [--requests 16]
          [--dmodel 288] [--cpu] [--sweep] [--kv-layout paged]
+         [--kv-dtype int8] [--spill host] [--kv-pages N]
 """
 
 from __future__ import annotations
@@ -101,6 +108,23 @@ def main() -> int:
                          "the sweep (paged = block-table pool)")
     ap.add_argument("--kv-page", type=int, default=16,
                     help="tokens per KV page when --kv-layout paged")
+    ap.add_argument("--kv-pages", type=int, default=None,
+                    help="pool size in pages when --kv-layout paged "
+                         "(default sizes for max_batch full contexts); "
+                         "pin it to compare sweep knees at FIXED pool "
+                         "budget across --kv-dtype settings")
+    ap.add_argument("--kv-dtype", choices=("f32", "bf16", "int8"),
+                    default="f32",
+                    help="pool storage layout (paged only): int8 packs "
+                         "values + per-page scales at ~1/4 the f32 "
+                         "bytes (docs/PERFORMANCE.md §12)")
+    ap.add_argument("--spill", choices=("off", "host"), default="off",
+                    help="tiered pool: park cold streams' pages to host "
+                         "buffers under page pressure and prefetch them "
+                         "back (paged only)")
+    ap.add_argument("--spill-after", type=int, default=2,
+                    help="decode chunks a stream must sit resident "
+                         "before it may be parked")
     ap.add_argument("--sweep", action="store_true",
                     help="run the closed-loop saturation sweep instead "
                          "of the contender race; emits one JSON curve "
@@ -183,11 +207,23 @@ def main() -> int:
         jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32),
         positions=jnp.arange(4),
     )
-    kv_kwargs = ({"kv_layout": "paged", "kv_page": args.kv_page}
-                 if args.kv_layout == "paged" else {})
+    if args.kv_layout == "paged":
+        kv_kwargs = {"kv_layout": "paged", "kv_page": args.kv_page,
+                     "kv_dtype": args.kv_dtype, "spill": args.spill,
+                     "spill_after": args.spill_after}
+        if args.kv_pages is not None:
+            kv_kwargs["kv_pages"] = args.kv_pages
+    elif args.kv_dtype != "f32" or args.spill != "off":
+        raise SystemExit("--kv-dtype / --spill need --kv-layout paged "
+                         "(the quantized + tiered pool is a paged-pool "
+                         "layout)")
+    else:
+        kv_kwargs = {}
     print(f"backend={jax.default_backend()} d={args.dmodel} "
           f"B={args.batch} requests={args.requests} "
-          f"new=[{args.min_new},{args.max_new}] kv={args.kv_layout}",
+          f"new=[{args.min_new},{args.max_new}] kv={args.kv_layout}"
+          + (f"/{args.kv_dtype} spill={args.spill}"
+             if args.kv_layout == "paged" else ""),
           flush=True)
 
     try:
@@ -312,6 +348,9 @@ def _run_sweep(args, cfg, params, kv_kwargs, loadgen,
         "backend": jax.default_backend(),
         "batch": args.batch, "kv_layout": args.kv_layout,
         "kv_page": args.kv_page if kv_kwargs else None,
+        "kv_dtype": args.kv_dtype if kv_kwargs else None,
+        "spill": args.spill if kv_kwargs else None,
+        "kv_pages": args.kv_pages if kv_kwargs else None,
         "budget": budget, "max_queue": args.max_queue,
         "slo_s": args.slo, "replicas": args.replicas,
         **({"routed": sum(pt.get("routed", 0)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from ddl25spring_tpu import obs
 from ddl25spring_tpu.models import kv_pool, loadgen
 from ddl25spring_tpu.models.generate import precompute_prefix
 from ddl25spring_tpu.models.llama import Llama, LlamaConfig
@@ -362,3 +363,225 @@ def test_arrival_trace_seeded_and_mean_one():
         loadgen.arrival_trace(10, 1.0, "uniform", 0)
     with pytest.raises(ValueError):
         loadgen.arrival_trace(10, 1.0, "pareto", 0, alpha=1.0)
+
+# -- quantized pages + the tiered pool (kv_dtype= / spill=) ----------------
+
+
+SPILL = {"spill": "host", "spill_after": 1, "kv_pages": 4}
+
+
+def test_pages_needed_spill_resident_floor():
+    # device-resident floor: budget counts only up to one decode chunk
+    # past the prefill window — the rest can ride the host tier
+    assert kv_pool.pages_needed(8, 12, 8, decode_chunk=4) == 3
+    assert kv_pool.pages_needed(8, 12, 8, decode_chunk=4, spill=True) == 2
+    # zero budget: nothing to park, the floors agree
+    assert kv_pool.pages_needed(8, 0, 8, spill=True) == \
+        kv_pool.pages_needed(8, 0, 8)
+    # shared prefix head pages count against neither tier
+    assert kv_pool.pages_needed(8, 12, 8, prefix_len=16, spill=True) == 2
+
+
+def test_kv_bytes_dtype_variants_and_tiered_split():
+    base = kv_pool.kv_bytes(64, 2, 2, 12)
+    assert kv_pool.kv_bytes(64, 2, 2, 12, dtype="f32") == base
+    assert kv_pool.kv_bytes(64, 2, 2, 12, dtype="bf16") == base // 2
+    i8 = kv_pool.kv_bytes(64, 2, 2, 12, dtype="int8")
+    # int8 values at one byte plus two float32 per-(token, head) scale
+    # planes — the exact pool-tree bytes mem_estimate cross-checks AOT
+    assert i8 == 64 * 2 * (2 * 2 * 12 + 2 * 2 * 4)
+    t = kv_pool.tiered_kv_bytes(48, 16, 2, 2, 12, dtype="int8")
+    assert t["device"] + t["host"] == t["total"] == i8
+    with pytest.raises(ValueError, match="unknown kv dtype"):
+        kv_pool.kv_bytes(8, 1, 1, 8, dtype="fp4")
+
+
+def test_kv_dtype_knob_validation(setup):
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                          kv_dtype="int8")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                          **PAGED, kv_dtype="fp4")
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                          spill="host")
+
+
+def test_int8_pool_bounded_divergence_oracle():
+    # ONE layer, so the prompt-window K/V entering the cache are computed
+    # purely from embeddings — identical whatever the storage dtype — and
+    # the quantized pool's error is checkable value for value against the
+    # documented per-(token-in-page, head) bound: half an absmax/127 step
+    # (parallel/compress.int8_error_bound).
+    from ddl25spring_tpu.parallel.compress import int8_error_bound
+
+    cfg1 = dataclasses.replace(CFG, nr_layers=1)
+    params = Llama(cfg1).init(jax.random.PRNGKey(0),
+                              jnp.ones((1, 4), jnp.int32),
+                              positions=jnp.arange(4))
+    prompt = _prompts()[1]          # length 7: rows 0..6 of one page
+    assert len(prompt) == 7
+
+    def run(dt):
+        b = ContinuousBatcher(cfg1, params, max_batch=2, prefill_width=8,
+                              **PAGED, kv_dtype=dt)
+        out = b.run([prompt], 4)
+        assert len(out[0]) == 4
+        return b
+
+    def by_name(tree):
+        leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+        return {path[-1].key: np.asarray(leaf) for path, leaf in leaves}
+
+    ref = by_name(run("f32").cache)
+    qd = by_name(run("int8").cache)
+    # page allocation is host logic, independent of the storage dtype:
+    # the prompt lands on the same physical page in both pools — the one
+    # with the most written rows (the decode tail page has fewer)
+    page = int(np.argmax((qd["k_s"] > 0).sum(axis=1)))
+    diverged = 0.0
+    for name_q, name_s, name_r in (("k_q", "k_s", "k"),
+                                   ("v_q", "v_s", "v")):
+        want = ref[name_r][page, :7]                      # (7, Hkv, hd)
+        scales = qd[name_s][page, :7]                     # (7, Hkv)
+        deq = qd[name_q][page, :7].astype(np.float32) * scales[..., None]
+        bound = int8_error_bound(np.abs(want).max(axis=-1))
+        assert (np.abs(deq - want) <= bound[..., None] + 1e-6).all()
+        diverged = max(diverged, float(np.abs(deq - want).max()))
+    assert diverged > 0.0           # lossy, bounded — not accidentally f32
+
+
+def test_spill_identity_and_instruments(setup):
+    # the tiered pool is pure placement: parking round-trips verbatim
+    # bytes, so ServedTokens under page pressure == the uncontended pool,
+    # and the spill/prefetch instruments account every park and resume
+    prompts = _prompts()
+    want = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                             **PAGED).run(prompts, 6)
+    t = obs.enable()
+    try:
+        sp = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                               **PAGED, **SPILL, spill_prefetch=1)
+        got = sp.run(prompts, 6)
+        spills = t.counter("serving_kv_spills_total").value
+        hit = t.counter("serving_kv_prefetch_total", result="hit").value
+        late = t.counter("serving_kv_prefetch_total", result="late").value
+    finally:
+        obs.disable()
+    assert _streams(got) == _streams(want)
+    assert spills > 0 and hit + late > 0
+    assert sp._pool.pages_in_use == 0 and sp._pool.spilled_pages == 0
+    assert not sp._parked
+
+
+def test_spill_late_prefetch_counted_not_corrupted(setup):
+    # spill_prefetch=0 disables the staging thread entirely: every
+    # resume uploads synchronously and counts as "late" — and the
+    # streams still match (lateness is a latency property, never a
+    # correctness one)
+    prompts = _prompts()
+    want = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                             **PAGED).run(prompts, 6)
+    t = obs.enable()
+    try:
+        sp = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                               **PAGED, **SPILL, spill_prefetch=0)
+        got = sp.run(prompts, 6)
+        hit = t.counter("serving_kv_prefetch_total", result="hit").value
+        late = t.counter("serving_kv_prefetch_total", result="late").value
+    finally:
+        obs.disable()
+    assert _streams(got) == _streams(want)
+    assert late > 0 and hit == 0
+    assert sp._pool.pages_in_use == 0 and sp._pool.spilled_pages == 0
+
+
+def test_spill_park_resume_roundtrip_bit_exact(setup):
+    # the page bytes that come back from the host tier are the page
+    # bytes that went out — compared leaf for leaf at the fresh
+    # physical indices, before any further decode touches them
+    sp = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                           **PAGED, spill="host", spill_after=1,
+                           spill_prefetch=0)
+    sp.submit("r", _prompts()[1], 8)
+    sp.step()                       # admit + first decode chunk
+    s = next(i for i, sl in enumerate(sp.slots)
+             if not sl.free and sl.request_id == "r")
+    sp._park_slot(s)
+    h = sp._parked[0]
+    n = h.n_written
+    assert n > 0 and sp._pool.spilled_pages == n
+    assert sp._pool.pages_in_use == 0   # the lane gave everything back
+    snap = jax.tree.map(lambda a: np.asarray(a).copy(), h.host_pages)
+    sp._resume_parked()
+    assert not sp._parked and sp._pool.spilled_pages == 0
+    s2 = next(i for i, sl in enumerate(sp.slots)
+              if not sl.free and sl.request_id == "r")
+    ix = np.asarray([p for p in sp._tables[s2] if p > 0][:n])
+    got = jax.device_get(jax.tree.map(lambda big: big[ix], sp.cache))
+    for a, b in zip(jax.tree.leaves(snap), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    out = sp.drain()                # and the stream still finishes
+    assert len(out["r"]) == 8
+    assert sp._pool.pages_in_use == 0
+
+
+def test_spill_no_leak_across_evict_and_quarantine(setup):
+    # deadline-evict a PARKED stream: the handle dies, the host-tier
+    # accounting releases, and no device pages are involved
+    sp = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                           **PAGED, spill="host", spill_after=1,
+                           spill_prefetch=0)
+    sp.submit("r", _prompts()[1], 8)
+    sp.step()
+    s = next(i for i, sl in enumerate(sp.slots)
+             if not sl.free and sl.request_id == "r")
+    sp._park_slot(s)
+    assert sp._pool.spilled_pages > 0
+    sp._parked[0].deadline = 0.0
+    fin = {}
+    sp._evict_expired(fin, now=1.0)
+    assert "r" in fin and sp._status["r"] == "timed_out"
+    assert not sp._parked
+    assert sp._pool.pages_in_use == 0 and sp._pool.spilled_pages == 0
+    # quarantined lanes are never park victims, and the quarantine pool
+    # accounting is untouched by the spill tier
+    poisoned = jax.tree_util.tree_map_with_path(
+        lambda kp, leaf: leaf.at[0, 0].set(jnp.nan)
+        if "lm_head" in jax.tree_util.keystr(kp) else leaf, setup)
+    q = ContinuousBatcher(CFG, poisoned, max_batch=2, prefill_width=8,
+                          poison_guard=True, eos_id=96, **PAGED, **SPILL)
+    got = q.run(_prompts(), 6)
+    assert all(st == "poisoned" for _, st in _streams(got))
+    held = sum(len(ps) for ps in q._qpages.values())
+    assert q._pool.pages_in_use == held and q._pool.spilled_pages == 0
+    q.scrub()
+    assert q._pool.pages_in_use == 0 and not q._parked
+
+
+def test_tp2_int8_pool_parity_and_spill_guard(setup):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    from ddl25spring_tpu.serving_fleet import TPShardedBatcher
+
+    prompts = _prompts()
+    want = ContinuousBatcher(CFG, setup, max_batch=2, prefill_width=8,
+                             **PAGED, kv_dtype="int8").run(prompts, 6)
+    tp2 = TPShardedBatcher(CFG, setup, tp_world=2, max_batch=2,
+                           prefill_width=8, **PAGED, kv_dtype="int8")
+    got = tp2.run(prompts, 6)
+    assert _streams(got) == _streams(want)
+    # the quantized pool is PHYSICALLY head-split, scale planes included:
+    # int8 value leaves at Hkv/W heads, f32 scale leaves on the same axis
+    shard_shapes = tp2.kv_shard_shapes()
+    kv_heads = CFG.nr_kv_heads or CFG.nr_heads
+    assert any(len(s) == 4 and s[2] == kv_heads // 2
+               for s in shard_shapes)
+    assert any(len(s) == 3 and s[2] == kv_heads // 2
+               for s in shard_shapes)
+    assert tp2._pool.pages_in_use == 0
+    # spill over a head-sharded pool is explicitly future work
+    with pytest.raises(NotImplementedError, match="spill"):
+        TPShardedBatcher(CFG, setup, tp_world=2, max_batch=2,
+                         prefill_width=8, **PAGED, spill="host")

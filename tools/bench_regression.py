@@ -31,6 +31,12 @@ Cells and their direction:
   error of the calibrated step-cost model on the serving trend cell;
   gated at 10x the base threshold because the healthy value is a small
   ratio measured from CPU timing jitter);
+- ``kv_quant_tiered.*.tokens_per_sec``,
+  ``kv_quant_tiered.resident_drop_f32_vs_int8_spill`` and
+  ``kv_quant_tiered.goodput_ratio_int8_spill_vs_f32`` — higher better
+  (the quantized/tiered KV pool cell: per-layout goodput, the
+  device-resident KV-per-stream drop int8+spill buys, and how much
+  goodput the spill tier costs);
 - MULTICHIP ``ok`` flipping true→false, or ``n_devices`` shrinking.
 
 Zero deps beyond the stdlib (the tier-1 suite runs ``--dry-run`` as a
@@ -69,6 +75,11 @@ _SCALAR_CELLS = (
     ("fleet_rollout.goodput_retention", True),
     ("fleet_rollout.rollback_latency_s", False),
     ("capacity_model.mean_rel_err", False, 10.0),
+    ("kv_quant_tiered.f32.tokens_per_sec", True),
+    ("kv_quant_tiered.int8.tokens_per_sec", True),
+    ("kv_quant_tiered.int8_spill.tokens_per_sec", True),
+    ("kv_quant_tiered.resident_drop_f32_vs_int8_spill", True),
+    ("kv_quant_tiered.goodput_ratio_int8_spill_vs_f32", True),
 )
 
 

@@ -7,6 +7,8 @@
 # Phase 1 (round-5 priorities, highest value first):
 #   1. north-star bench, lean, multi-trial    -> results/bench_tpu_lean.json
 #   2. serving three-way battery              -> results/serving_tpu.txt
+#      + kv-quant knee battery (f32/int8/int8+spill at fixed pool)
+#                                              -> results/serving_kvquant_tpu.txt
 #   3. distilled-draft speculative grid       -> results/spec_distilled_tpu.txt
 #   4. int8-KV long-context A/B               -> results/generate_kv8_long_tpu.txt
 #   5. north-star xprof trace + summary       -> results/northstar_trace_summary.*
@@ -85,6 +87,25 @@ for a in json.load(sys.stdin)["argv"]:
     echo "$(date +%H:%M:%S) serving battery done (exit $rc)" >> "$LOG"
     python tools/tpu_trend.py --serving results/serving_tpu.txt \
       >> "$LOG" 2>&1
+    # quantized/tiered KV pool knee comparison at a FIXED page budget
+    # (docs/PERFORMANCE.md §12): same --kv-pages, f32 baseline vs int8
+    # vs int8 + host spill — the int8+spill knee must sit right of f32's
+    KVQ_FAIL=$(mktemp)
+    ( timeout 1200 python examples/bench_serving.py --sweep \
+        --kv-layout paged --kv-pages 24 --kv-dtype f32 \
+        2>> "$LOG" || { echo "f32 rc=$?" >> "$KVQ_FAIL";
+                        echo "KVQUANT-RUN-FAILED dt=f32" >> "$LOG"; }
+      timeout 1200 python examples/bench_serving.py --sweep \
+        --kv-layout paged --kv-pages 24 --kv-dtype int8 \
+        2>> "$LOG" || { echo "int8 rc=$?" >> "$KVQ_FAIL";
+                        echo "KVQUANT-RUN-FAILED dt=int8" >> "$LOG"; }
+      timeout 1200 python examples/bench_serving.py --sweep \
+        --kv-layout paged --kv-pages 24 --kv-dtype int8 --spill host \
+        2>> "$LOG" || { echo "int8+spill rc=$?" >> "$KVQ_FAIL";
+                        echo "KVQUANT-RUN-FAILED dt=int8+spill" >> "$LOG"; }
+    ) > results/serving_kvquant_tpu.txt
+    rc=0; [ -s "$KVQ_FAIL" ] && rc=1; rm -f "$KVQ_FAIL"
+    echo "$(date +%H:%M:%S) kv-quant knee battery done (exit $rc)" >> "$LOG"
     # two attempts: a transport drop (observed 2026-08-02) resumes from
     # the bench's host-side param cache + 25-step snapshots on retry
     # instead of restarting cold.  tmp-then-install per attempt so a

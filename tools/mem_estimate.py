@@ -308,6 +308,128 @@ def kv_pages_estimate(occupancies, *, max_batch: int = 8, ctx: int = 256,
     return rows
 
 
+def kv_quant_estimate(dtypes=("f32", "bf16", "int8"), *, max_batch: int = 8,
+                      ctx: int = 256, kv_page: int = 16,
+                      spill_fraction: float = 0.5, device=None) -> list:
+    """AOT argument-bytes cross-check of the QUANTIZED paged pool
+    (models/serving.py ``kv_dtype=``): compile the same paged decode step
+    with the pool stored f32 / bf16 / int8 and read XLA's
+    ``memory_analysis()`` argument bytes per variant.  Each variant's
+    pool tree bytes must equal the extended ``kv_pool.kv_bytes`` analytic
+    EXACTLY (pages × dtype itemsize + the int8 per-(token, head) scale
+    planes), and the compiled argument-byte delta between f32 and each
+    variant must match the analytic pool delta — the drop is a
+    compiled-program property, not a formula.  Asserts the ~4× resident
+    drop at int8 (docs/PERFORMANCE.md §12; 2·Hkv·hd bytes + 8·Hkv of
+    scales per token vs 8·Hkv·hd at f32 — ≥ 3.5× for hd ≥ 64).
+
+    ``spill_fraction`` additionally reports the tiered split
+    (``kv_pool.tiered_kv_bytes``): device-resident vs host-tier bytes if
+    that fraction of pool tokens rides the spill tier.  Host bytes are
+    analytic by construction — a spilled page is a verbatim byte copy of
+    its pool rows, so the rate per token is identical."""
+    import dataclasses
+    import functools
+
+    from ddl25spring_tpu.models import kv_pool
+    from ddl25spring_tpu.models import serving as srv
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+
+    # hd=128 (the serving-realistic head width the §12 bytes model
+    # quotes); at tiny head dims the int8 scale planes eat the win and
+    # the ~4× claim would be untestable
+    base = LlamaConfig(vocab_size=128, dmodel=256, nr_heads=2,
+                       nr_kv_heads=2, nr_layers=2, ctx_size=ctx,
+                       decode_impl="xla")
+    B = max_batch
+    nr_pages = B * (ctx // kv_page) + 1  # full occupancy + null page
+    tree_bytes = lambda t: sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree.leaves(t))
+    jit_kw = {"device": device} if device is not None else {}
+    rows = []
+    for name in dtypes:
+        if name == "int8":
+            cfg = dataclasses.replace(base, kv_cache_int8=True)
+        elif name == "bf16":
+            cfg = dataclasses.replace(base, kv_cache_dtype="bfloat16")
+        elif name == "f32":
+            cfg = base
+        else:
+            raise ValueError(f"unknown kv dtype {name!r}")
+        params = jax.eval_shape(Llama(cfg).init, jax.random.key(0),
+                                jnp.zeros((1, 4), jnp.int32))
+        model = Llama(dataclasses.replace(cfg, decode=True))
+
+        def decode(params, pool, tok, pos, pad, tables):
+            logits, state = model.apply(
+                {**params, "cache": pool}, tok[:, None],
+                positions=pos[:, None], pad=pad, prefix_len=0,
+                block_tables=tables, mutable=["cache"],
+            )
+            return jnp.argmax(logits[:, 0], axis=-1), state["cache"]
+
+        tok = jax.ShapeDtypeStruct((B,), jnp.int32)
+        pos = jax.ShapeDtypeStruct((B,), jnp.int32)
+        pad = jax.ShapeDtypeStruct((B,), jnp.int32)
+        cache = jax.eval_shape(
+            functools.partial(srv._empty_cache_of, model, B), params)
+        pool = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(
+                (nr_pages, kv_page) + a.shape[2:], a.dtype), cache)
+        tables = jax.ShapeDtypeStruct((B, ctx // kv_page), jnp.int32)
+        compiled = jax.jit(decode, **jit_kw).lower(
+            params, pool, tok, pos, pad, tables).compile()
+        args_b = int(getattr(compiled.memory_analysis(),
+                             "argument_size_in_bytes", 0))
+        pool_b = tree_bytes(pool)
+        analytic = kv_pool.kv_bytes(
+            nr_pages * kv_page, cfg.nr_layers, cfg.kv_heads,
+            cfg.head_dim, dtype=name)
+        assert pool_b == analytic, (
+            f"{name} pool tree is {pool_b:,} B but the kv_bytes analytic "
+            f"says {analytic:,} B — the extended formula drifted from the "
+            "cache layout"
+        )
+        spill_tokens = int(spill_fraction * nr_pages * kv_page)
+        tiered = kv_pool.tiered_kv_bytes(
+            nr_pages * kv_page - spill_tokens, spill_tokens,
+            cfg.nr_layers, cfg.kv_heads, cfg.head_dim, dtype=name)
+        rows.append({
+            "kv_dtype": name,
+            "nr_pages": nr_pages,
+            "pool_kv_bytes": pool_b,
+            "argument_bytes": args_b,
+            "spill_fraction": spill_fraction,
+            "tiered_device_bytes": tiered["device"],
+            "tiered_host_bytes": tiered["host"],
+        })
+    by_name = {r["kv_dtype"]: r for r in rows}
+    if "f32" in by_name:
+        f32 = by_name["f32"]
+        for r in rows:
+            if r is f32:
+                continue
+            # params/tables/scalars are identical across variants, so the
+            # compiled argument delta IS the pool delta
+            delta_args = f32["argument_bytes"] - r["argument_bytes"]
+            delta_kv = f32["pool_kv_bytes"] - r["pool_kv_bytes"]
+            assert abs(delta_args - delta_kv) <= max(4096, delta_kv // 50), (
+                f"compiled argument delta {delta_args:,} B at "
+                f"{r['kv_dtype']} diverges from the analytic pool delta "
+                f"{delta_kv:,} B"
+            )
+            r["kv_drop_vs_f32"] = round(
+                f32["pool_kv_bytes"] / r["pool_kv_bytes"], 3)
+        if "int8" in by_name:
+            drop = by_name["int8"]["kv_drop_vs_f32"]
+            assert drop >= 3.5, (
+                f"int8 resident KV dropped only {drop}x vs f32, expected "
+                "~4x (>= 3.5x at hd=128)"
+            )
+    return rows
+
+
 def tp_kv_estimate(worlds, *, max_batch: int = 8, ctx: int = 256,
                    kv_page: int = 16) -> list:
     """AOT argument-bytes cross-check of the TP head-partitioned KV pool
@@ -583,11 +705,20 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-pages", action="store_true",
                     help="estimate the serving decode's resident-KV bytes "
                          "instead: contiguous (max_batch, ctx) cache vs "
-                         "the paged pool at --kv-occupancy fractions; "
-                         "asserts the >=4x data drop at 25%% occupancy")
+                         "the paged pool at --kv-occupancy fractions, "
+                         "plus the quantized/tiered pool at --kv-dtypes; "
+                         "asserts the >=4x data drop at 25%% occupancy "
+                         "and the ~4x int8 resident drop")
     ap.add_argument("--kv-occupancy", default="1.0,0.5,0.25",
                     help="comma-separated pool occupancy fractions for "
                          "--kv-pages")
+    ap.add_argument("--kv-dtypes", default="f32,bf16,int8",
+                    help="comma-separated pool storage dtypes for the "
+                         "--kv-pages quantized/tiered rows (serving "
+                         "kv_dtype names); empty string skips them")
+    ap.add_argument("--kv-spill-fraction", type=float, default=0.5,
+                    help="fraction of pool tokens priced on the host "
+                         "tier in the --kv-pages tiered-bytes column")
     ap.add_argument("--kv-batch", type=int, default=8,
                     help="serving max_batch for --kv-pages")
     ap.add_argument("--kv-ctx", type=int, default=256,
@@ -688,12 +819,28 @@ def main(argv=None) -> int:
                   f"(+tables {r['table_bytes']:,} B)   "
                   f"data drop {r['kv_data_drop']}x   "
                   f"total drop {r['kv_total_drop']}x", file=sys.stderr)
+        dtypes = [d.strip() for d in args.kv_dtypes.split(",") if d.strip()]
+        qrows = kv_quant_estimate(
+            dtypes, max_batch=args.kv_batch, ctx=args.kv_ctx,
+            kv_page=args.kv_page,
+            spill_fraction=args.kv_spill_fraction,
+            device=device) if dtypes else []
+        for r in qrows:
+            drop = r.get("kv_drop_vs_f32")
+            print(f"  kv_dtype={r['kv_dtype']:<5} pool "
+                  f"{r['pool_kv_bytes']:>10,} B   args "
+                  f"{r['argument_bytes']:>12,} B   tiered "
+                  f"{r['tiered_device_bytes']:>9,}/"
+                  f"{r['tiered_host_bytes']:,} B dev/host"
+                  + (f"   drop {drop}x" if drop else ""), file=sys.stderr)
         print(json.dumps({
             "metric": "kv_pages_memory_estimate",
             "target": args.target,
             "max_batch": args.kv_batch, "ctx_size": args.kv_ctx,
             "kv_page": args.kv_page,
             "occupancies": rows,
+            "spill_fraction": args.kv_spill_fraction,
+            "dtypes": qrows,
         }))
         return 0
 

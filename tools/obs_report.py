@@ -486,8 +486,15 @@ def report(events: list[dict], top: int, calib: dict | None = None) -> None:
     fused_steps = _value(counters, "serving_fused_decode_steps_total")
     take(counters, "serving_fused_decode_steps_total")
     reject_reasons = take(counters, "serving_reject_reason_total")
+    resident = take(gauges, "serving_kv_resident_pages")
+    spills = _value(counters, "serving_kv_spills_total")
+    take(counters, "serving_kv_spills_total")
+    prefetches = take(counters, "serving_kv_prefetch_total")
+    dequant_b = _value(counters, "serving_kv_dequant_bytes_total")
+    take(counters, "serving_kv_dequant_bytes_total")
     if (nr_req is not None or req_hist or reject_reasons
-            or pfx_hits is not None or pages):
+            or pfx_hits is not None or pages or resident
+            or spills is not None):
         section("serving")
         if nr_req is not None:
             print(f"  requests served: {nr_req}   tokens: {nr_tok}"
@@ -528,6 +535,27 @@ def report(events: list[dict], top: int, calib: dict | None = None) -> None:
             snap = pages[0][1]
             print(f"  kv pages in use: last {snap['value']:.0f}   "
                   f"peak {snap.get('max', snap['value']):.0f}")
+        # -- tiered / quantized pool: where the pages live, how the
+        #    spill tier behaved, and the in-kernel dequant traffic
+        if resident:
+            parts = "   ".join(
+                f"{labels.get('tier', '?')}: last {state['value']:.0f} "
+                f"peak {state.get('max', state['value']):.0f}"
+                for labels, state in sorted(
+                    resident, key=lambda kv: kv[0].get("tier", "")))
+            print(f"  tiered pool pages: {parts}")
+        if spills is not None or prefetches:
+            by_result = {labels.get("result", "?"): int(state["value"])
+                         for labels, state in prefetches}
+            hit, late = by_result.get("hit", 0), by_result.get("late", 0)
+            verdict = ("" if hit + late == 0 else
+                       "   (prefetch ahead of decode)" if late == 0 else
+                       f"   ({late} resumed synchronously)")
+            print(f"  spill tier: {int(spills or 0)} pages parked to "
+                  f"host   resumes hit={hit} late={late}{verdict}")
+        if dequant_b is not None:
+            print(f"  int8 pages dequantized in-kernel: "
+                  f"{fmt_bytes(dequant_b)}")
         if fused_steps is not None:
             print(f"  fused decode steps (one-Pallas-program inner "
                   f"loop): {fused_steps}")
