@@ -680,6 +680,8 @@ def run_cpu_trend(nr_rounds: int = 2):
     fleet_chaos = _fleet_chaos_cell()
     _stamp("cpu trend: fleet rollout cell ...")
     fleet_rollout = _fleet_rollout_cell()
+    _stamp("cpu trend: multi-tenant serving cell ...")
+    multi_tenant_serving = _multi_tenant_serving_cell()
     _stamp("cpu trend: capacity model cell ...")
     capacity_model = _capacity_model_cell()
     _stamp("cpu trend: kv quant/tiered cell ...")
@@ -700,6 +702,7 @@ def run_cpu_trend(nr_rounds: int = 2):
         "fleet_routing": fleet_routing,
         "fleet_chaos": fleet_chaos,
         "fleet_rollout": fleet_rollout,
+        "multi_tenant_serving": multi_tenant_serving,
         "capacity_model": capacity_model,
         "kv_quant_tiered": kv_quant_tiered,
         "wall_s": round(time.perf_counter() - t_start, 1),
@@ -1315,6 +1318,98 @@ def _fleet_rollout_cell(nr_requests: int = 10):
         "bad_push_rolled_back": rolled_back,
         "bad_push_completed": bad_done,
         "rollback_latency_s": round(rb_latency or 0.0, 4),
+    }
+
+
+def _multi_tenant_serving_cell(nr_requests: int = 12, budget: int = 5):
+    """Batched multi-LoRA serving (models/serving.py ``adapter_slots=``,
+    models/adapter_pool.py): one tiny-llama paged batcher with 2 tenant
+    slots drives the same prompt set twice — all null-adapter (the
+    single-tenant baseline, bitwise the base model) then round-robin
+    over 3 tenants, so the pool LRU-evicts cold adapters and re-fetches
+    their factors under load.  ``goodput_ratio_vs_single_tenant`` prices
+    the per-row factor gather + install churn,
+    ``adapter_miss_rate`` the residency pressure — the trends that move
+    when the adapter plane regresses."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+    from ddl25spring_tpu.models.lora import slice_adapter
+    from ddl25spring_tpu.models.serving import ContinuousBatcher
+
+    cfg = LlamaConfig(vocab_size=128, dmodel=48, nr_heads=4,
+                      nr_kv_heads=2, nr_layers=2, ctx_size=48,
+                      dtype=jnp.float32, lora_rank=4)
+    base_cfg = dataclasses.replace(cfg, lora_rank=0)
+    params = Llama(base_cfg).init(jax.random.PRNGKey(0),
+                                  jnp.ones((1, 4), jnp.int32))
+    # tenant factors in the slice_adapter wire format, perturbed per
+    # tenant so installs move real bytes
+    wire = slice_adapter(Llama(cfg).init(jax.random.PRNGKey(1),
+                                         jnp.ones((1, 4), jnp.int32)))
+    leaves, treedef = jax.tree.flatten(wire)
+    adapters = {}
+    for t in (1, 2, 3):
+        key = jax.random.PRNGKey(100 + t)
+        adapters[t] = jax.tree.unflatten(treedef, [
+            0.05 * jax.random.normal(jax.random.fold_in(key, i),
+                                     l.shape, l.dtype)
+            for i, l in enumerate(leaves)])
+
+    bat = ContinuousBatcher(cfg, params, max_batch=2, prefill_width=8,
+                            kv_layout="paged", kv_page=8,
+                            adapter_slots=3)
+    for t, ad in adapters.items():
+        bat.register_adapter(t, ad, scale=0.5)
+
+    prng = np.random.default_rng(0)
+    prompts = [prng.integers(1, 128,
+                             size=int(prng.integers(3, 8))).tolist()
+               for _ in range(nr_requests)]
+
+    def drive(assign, base_rid):
+        done: dict = {}
+        for i, p in enumerate(prompts):
+            bat.submit(base_rid + i, p, budget, adapter_id=assign(i))
+        t0 = time.perf_counter()
+        for _ in range(4000):
+            done.update(bat.step())
+            if len(done) == nr_requests:
+                break
+        return len(done), time.perf_counter() - t0
+
+    # skewed traffic (Zipf-ish: t1 hot, t3 cold) so the 2 tenant slots
+    # see both hits and eviction misses — a pure round-robin over 3
+    # tenants would thrash to a constant 100% miss rate, which cannot
+    # trend
+    skew = (1, 1, 1, 2, 2, 3)
+    drive(lambda i: 0, 0)                       # jit warmup: null path
+    drive(lambda i: skew[i % 6], 500)           # warmup: install path
+    null_done, null_s = drive(lambda i: 0, 1000)
+    pool0 = bat._adapters.describe()
+    mt_done, mt_s = drive(lambda i: skew[i % 6], 2000)
+    pool1 = bat._adapters.describe()
+
+    null_tps = null_done * budget / max(null_s, 1e-9)
+    mt_tps = mt_done * budget / max(mt_s, 1e-9)
+    misses = pool1["misses"] - pool0["misses"]
+    evictions = pool1["evictions"] - pool0["evictions"]
+    return {
+        "requests": nr_requests,
+        "tenants": 3,
+        "adapter_slots": 3,
+        "budget": budget,
+        "single_tenant_tps": round(null_tps, 3),
+        "goodput_tps": round(mt_tps, 3),
+        "goodput_ratio_vs_single_tenant": round(
+            mt_tps / max(null_tps, 1e-9), 3),
+        "adapter_misses": misses,
+        "adapter_evictions": evictions,
+        "adapter_miss_rate": round(misses / max(mt_done, 1), 3),
     }
 
 

@@ -57,6 +57,10 @@ HOST_ONLY_MODULES = (
     "ddl25spring_tpu.serving_fleet.health",
     "ddl25spring_tpu.serving_fleet.autoscale",
     "ddl25spring_tpu.serving_fleet.rollout",
+    "ddl25spring_tpu.serving_fleet.tenants",
+    # adapter residency bookkeeping (pure host: dict/LRU state + the
+    # adapter_bytes analytic; the jnp factor math lives in models/lora)
+    "ddl25spring_tpu.models.adapter_pool",
     # fault scheduling + retry/backoff (wrap arbitrary host callables)
     "ddl25spring_tpu.resilience",
     "ddl25spring_tpu.resilience.faults",

@@ -5,6 +5,7 @@ from .privacy import (
 )
 from .engine import (
     make_local_sgd_update,
+    make_lora_local_update,
     make_full_batch_grad,
     make_fl_round,
     make_evaluator,
@@ -20,11 +21,13 @@ from .servers import (
     FedSgdGradientServer,
     FedSgdWeightServer,
     FedAvgServer,
+    FedLoRAAvgServer,
     FedOptServer,
 )
 
 __all__ = [
     "make_local_sgd_update",
+    "make_lora_local_update",
     "make_full_batch_grad",
     "make_fl_round",
     "dp_epsilon",
@@ -41,6 +44,7 @@ __all__ = [
     "FedSgdGradientServer",
     "FedSgdWeightServer",
     "FedAvgServer",
+    "FedLoRAAvgServer",
     "FedOptServer",
     "FedBuffServer",
     "ScaffoldServer",

@@ -124,6 +124,43 @@ def make_local_sgd_update(
     return update
 
 
+def make_lora_local_update(
+    loss_fn: LossFn,
+    base_params,
+    lr: float,
+    batch_size: int,
+    nr_epochs: int,
+    unroll_threshold: int | None = None,
+):
+    """Local SGD over ONLY a LoRA adapter subtree.
+
+    Returns ``update(adapter, x, y, count, key) -> adapter`` — the same
+    shape :func:`make_local_sgd_update` returns, but the params tree the
+    round carries is the ``models.lora.slice_adapter`` subtree (just the
+    ``lora_A``/``lora_B`` leaves).  The frozen ``base_params`` (a
+    LoRA-config tree: ``Llama(config_with_lora_rank).init``) rides as a
+    closure constant; each loss evaluation grafts the live factors back
+    with ``apply_adapter`` and differentiates through that graft, so
+    gradients flow only into the low-rank factors.
+
+    This is the structural form of a trainable mask: because the round's
+    params ARE the adapter, everything downstream of ``make_fl_round``
+    — secure aggregation over the flattened message, DP clip/noise,
+    delta compression, dropout renormalisation — composes over the
+    low-rank factors with zero changes, and the wire cost per client is
+    the factor bytes, not the model's.
+    """
+    from ..models.lora import apply_adapter  # engine stays model-agnostic
+
+    def lora_loss(adapter, x, y, mask, key):
+        return loss_fn(apply_adapter(base_params, adapter), x, y, mask,
+                       key)
+
+    return make_local_sgd_update(
+        lora_loss, lr, batch_size, nr_epochs, unroll_threshold
+    )
+
+
 def run_local_sgd(loss_fn, lr, batch_size, nr_epochs, unroll_threshold,
                   params, x, y, count, key, grad_hook=None):
     """The shared E-epochs shuffled-minibatch SGD loop (see

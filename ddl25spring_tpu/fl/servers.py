@@ -31,6 +31,7 @@ from .engine import (
     make_fl_round,
     make_full_batch_grad,
     make_local_sgd_update,
+    make_lora_local_update,
 )
 from .task import Task
 
@@ -304,6 +305,75 @@ class FedAvgServer(DecentralizedServer):
             secagg_impl=secagg_impl, overlap_combine=overlap_combine,
             prefetch_depth=prefetch_depth,
         )
+
+
+class FedLoRAAvgServer(DecentralizedServer):
+    """Federated LoRA: FedAvg's exact round machinery, but the params
+    tree the round carries is ONLY the adapter subtree.
+
+    ``task.init`` must return a LoRA-config tree (``lora_rank > 0`` —
+    e.g. ``Llama`` with ``lora_rank=8``); the ctor freezes it as the
+    base and replaces ``self.params`` with ``slice_adapter`` of it, so
+    client sampling, secure aggregation (over the flattened low-rank
+    factors), DP clip/noise, dropout renormalisation, and delta
+    compression all run over the adapter with zero engine changes.
+    Zero-init ``lora_B`` makes round 0's adapter a bitwise no-op on the
+    model, matching serving's reserved null adapter.
+
+    The promoted adapter is the per-tenant serving artifact: feed
+    ``self.params`` (``slice_adapter`` wire format) straight to
+    ``serving_fleet.tenants.TenantAdapterPlane.push_tenant_round``.
+    ``test()`` evaluates the FULL model (base + live adapter).
+    """
+
+    def __init__(self, task: Task, lr: float, batch_size: int,
+                 client_data: ClientDatasets, client_fraction: float,
+                 nr_local_epochs: int, seed: int,
+                 aggregator=None, mesh=None, dropout_rate: float = 0.0,
+                 dp_clip: float = 0.0, dp_noise_mult: float = 0.0,
+                 compress: str = "none", compress_ratio: float = 0.01,
+                 secagg=None, secagg_impl: str = "auto"):
+        super().__init__(task, lr, batch_size, client_data, client_fraction,
+                         seed, mesh=mesh)
+        self.algorithm = "FedLoRA"
+        if dp_clip:
+            self.algorithm = "DP-" + self.algorithm
+        self.nr_local_epochs = nr_local_epochs
+        if client_data.max_samples % batch_size != 0:
+            raise ValueError(
+                "client_data must be stacked with pad_multiple=batch_size "
+                f"(max_samples={client_data.max_samples}, "
+                f"batch={batch_size})"
+            )
+        from ..models.lora import apply_adapter, slice_adapter
+
+        self._apply_adapter = apply_adapter
+        self.base_params = self.params      # frozen LoRA-config tree
+        self.params = slice_adapter(self.params)
+        client_update = make_lora_local_update(
+            task.loss_fn, self.base_params, lr, batch_size,
+            nr_local_epochs,
+        )
+        self.round_fn = make_fl_round(
+            client_update,
+            client_data.x, client_data.y, client_data.counts,
+            self.nr_clients_per_round,
+            aggregator=aggregator,
+            mesh=mesh, dropout_rate=dropout_rate,
+            dp_clip=dp_clip, dp_noise_mult=dp_noise_mult,
+            # adapter server: the client message is its factor delta
+            compress=compress, compress_ratio=compress_ratio,
+            compress_deltas=True,
+            secagg=secagg, secagg_impl=secagg_impl,
+        )
+
+    def full_params(self):
+        """Base tree with the live federated factors grafted in — what
+        the serving side merges/installs."""
+        return self._apply_adapter(self.base_params, self.params)
+
+    def test(self) -> float:
+        return float(self._evaluate(self.full_params()))
 
 
 class FedOptServer(DecentralizedServer):

@@ -1,0 +1,225 @@
+"""Per-tenant LoRA adapter pool — KV-page discipline for adapter slots.
+
+The multi-LoRA batcher (models/serving.py ``adapter_slots=N``) keeps one
+``MultiLoRADense`` stack of N adapter slots in HBM next to the KV page
+pool.  This module is the HOST-side bookkeeping for those slots, run
+with exactly the ``kv_pool`` machinery so operators reason about one
+residency model for both planes:
+
+- slot 0 is RESERVED for the null adapter (all-zero factors — the
+  bitwise base-model contract), like the pool's reserved null page;
+- every in-flight stream holding a tenant's adapter REFCOUNTS its slot
+  (``acquire``/``release``), so a busy adapter can never be evicted out
+  from under a decode step;
+- cold unpinned slots are evicted LRU when a new tenant needs a slot
+  (``serving_adapter_evictions_total``), and an evicted tenant's return
+  is a MISS (``serving_adapter_misses_total``) served by re-fetching the
+  factors from the host-side store and re-installing them — the
+  spill-pool park/resume story, one level up;
+- ``pin``/``unpin`` exempt a tenant from eviction (the head-page pin).
+
+The pool is jax-free (HOST_ONLY in the manifest): it decides WHICH slot
+a tenant occupies; the batcher owns the device write
+(``lora.install_adapter``).  :func:`adapter_bytes` is the analytic HBM
+cost of the stacks — cross-checked against AOT argument bytes by
+``tools/mem_estimate.py --adapter-pool`` — and feeds the shared-budget
+sizing: the batcher shrinks its default KV page count by the pages the
+stacks displace (``kv_pool.pages_displaced``).
+"""
+
+from __future__ import annotations
+
+from .. import obs
+
+NULL_ADAPTER = 0    # reserved slot: the all-zero null adapter
+
+
+class AdapterPool:
+    """Slot bookkeeping for one replica's adapter stacks.
+
+    ``store`` maps ``tenant -> (adapter, scale, round_ix)`` and is the
+    re-fetch source on a miss; it may be SHARED across replicas (the
+    tenants plane passes one dict to every ``make_replica``).  The pool
+    never copies adapter payloads — it hands them back to the batcher,
+    which installs them on device.
+    """
+
+    def __init__(self, nr_slots: int, *, store: dict | None = None):
+        if nr_slots < 2:
+            raise ValueError(
+                f"nr_slots={nr_slots}: need slot 0 (null) plus at least "
+                "one tenant slot")
+        self.nr_slots = nr_slots
+        self.store: dict = store if store is not None else {}
+        self._slot_of: dict = {}               # tenant -> slot
+        self._tenant_of: dict[int, object] = {}  # slot -> tenant
+        self._refs = [0] * nr_slots
+        self._pinned: set[int] = set()
+        self._clock = 0
+        self._last_used = [0] * nr_slots       # LRU stamp per slot
+        self.misses = 0
+        self.evictions = 0
+        self.installs = 0
+
+    # -- host store ------------------------------------------------------
+
+    def put(self, tenant, adapter, scale: float, round_ix=None) -> None:
+        """(Re)register a tenant's factors in the host store.  A
+        RESIDENT tenant's slot is NOT rewritten here — the caller
+        decides whether to hot-swap in place (single-replica flows) or
+        roll the new version through the rollout plane (fleets)."""
+        if tenant == NULL_ADAPTER:
+            raise ValueError("tenant 0 is the reserved null adapter")
+        self.store[tenant] = (adapter, float(scale), round_ix)
+
+    # -- residency -------------------------------------------------------
+
+    def slot_of(self, tenant):
+        """The tenant's resident slot, or None."""
+        return self._slot_of.get(tenant)
+
+    def resident(self, tenant) -> bool:
+        return tenant in self._slot_of
+
+    @property
+    def resident_tenants(self):
+        return sorted(self._slot_of, key=lambda t: self._slot_of[t])
+
+    def seed(self, tenant, slot: int) -> None:
+        """Mark a tenant resident WITHOUT an install — the factors are
+        already in the params (a rollout-plane replica built from
+        pre-stacked params).  Refcount starts at zero."""
+        if not 0 < slot < self.nr_slots:
+            raise ValueError(f"slot {slot} out of range")
+        if slot in self._tenant_of or tenant in self._slot_of:
+            raise ValueError(
+                f"seed({tenant!r}, {slot}): slot or tenant already "
+                "resident")
+        self._slot_of[tenant] = slot
+        self._tenant_of[slot] = tenant
+        self._clock += 1
+        self._last_used[slot] = self._clock
+
+    def can_admit(self, tenant) -> bool:
+        """Would ``acquire(tenant)`` succeed right now?  The batcher's
+        admission gate — head-of-line waits on this exactly like it
+        waits on free KV pages."""
+        if tenant == NULL_ADAPTER or tenant in self._slot_of:
+            return True
+        return tenant in self.store and self._find_slot() is not None
+
+    def acquire(self, tenant):
+        """Take a stream's reference on ``tenant``'s slot.
+
+        Returns ``(slot, entry)`` where ``entry`` is None for a
+        residency hit and the ``(adapter, scale, round_ix)`` store entry
+        when the caller must install the factors first (a miss — cold
+        tenant, possibly after evicting another).  Returns ``None`` when
+        no slot can be freed (every slot busy or pinned): the admission
+        stays queued.  Tenant 0 needs no slot and no refcount."""
+        if tenant == NULL_ADAPTER:
+            return NULL_ADAPTER, None
+        slot = self._slot_of.get(tenant)
+        if slot is not None:
+            self._refs[slot] += 1
+            self._touch(slot)
+            return slot, None
+        if tenant not in self.store:
+            raise KeyError(
+                f"adapter_id {tenant!r} is not registered (put() it "
+                "first)")
+        slot = self._find_slot()
+        if slot is None:
+            return None
+        old = self._tenant_of.pop(slot, None)
+        if old is not None:
+            del self._slot_of[old]
+            self.evictions += 1
+            obs.inc("serving_adapter_evictions_total")
+        self.misses += 1
+        obs.inc("serving_adapter_misses_total")
+        self._slot_of[tenant] = slot
+        self._tenant_of[slot] = tenant
+        self._refs[slot] = 1
+        self.installs += 1
+        self._touch(slot)
+        return slot, self.store[tenant]
+
+    def release(self, tenant) -> None:
+        """Drop one stream's reference (stream finished/evicted)."""
+        if tenant == NULL_ADAPTER:
+            return
+        slot = self._slot_of.get(tenant)
+        if slot is None or self._refs[slot] <= 0:
+            raise ValueError(
+                f"release({tenant!r}): tenant not resident or refcount "
+                "already zero")
+        self._refs[slot] -= 1
+
+    def pin(self, tenant) -> None:
+        slot = self._slot_of.get(tenant)
+        if slot is None:
+            raise ValueError(f"pin({tenant!r}): tenant not resident")
+        self._pinned.add(slot)
+
+    def unpin(self, tenant) -> None:
+        slot = self._slot_of.get(tenant)
+        if slot is not None:
+            self._pinned.discard(slot)
+
+    # -- internals -------------------------------------------------------
+
+    def _touch(self, slot: int) -> None:
+        self._clock += 1
+        self._last_used[slot] = self._clock
+
+    def _find_slot(self):
+        """A free slot, else the LRU cold (refcount 0, unpinned)
+        resident one, else None."""
+        for s in range(1, self.nr_slots):
+            if s not in self._tenant_of:
+                return s
+        cold = [s for s in self._tenant_of
+                if self._refs[s] == 0 and s not in self._pinned]
+        if not cold:
+            return None
+        return min(cold, key=lambda s: self._last_used[s])
+
+    def describe(self) -> dict:
+        return {
+            "nr_slots": self.nr_slots,
+            "resident": {t: s for t, s in sorted(self._slot_of.items(),
+                                                 key=lambda kv: kv[1])},
+            "refs": {s: r for s, r in enumerate(self._refs) if r},
+            "pinned": sorted(self._pinned),
+            "store_tenants": sorted(self.store),
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "installs": self.installs,
+        }
+
+
+def adapter_bytes(config, nr_slots: int | None = None, *,
+                  itemsize: int = 4) -> int:
+    """Analytic HBM bytes of the MultiLoRADense stacks for ``config``.
+
+    Per dense site with shape ``(d_in, d_out)`` each slot costs
+    ``rank * (d_in + d_out) * itemsize`` for its ``A``/``B`` factors
+    plus ``itemsize`` for its scale entry.  The sites are the seven
+    per-block matmuls (wq, wk, wv, wo, w1, w3, w2) plus ``lm_head`` —
+    exactly where ``_dense_cls`` places the stacks.  Cross-checked
+    leaf-exactly and against compiled argument bytes by
+    ``tools/mem_estimate.py --adapter-pool``.
+    """
+    n = config.lora_slots if nr_slots is None else nr_slots
+    r = config.lora_rank
+    if n <= 0 or r <= 0:
+        return 0
+    d = config.dmodel
+    kv = config.kv_heads * config.head_dim
+    h = config.hidden_dim
+    sites = [(d, d), (d, kv), (d, kv), (d, d),      # wq wk wv wo
+             (d, h), (d, h), (h, d)] * config.nr_layers
+    sites.append((d, config.vocab_size))            # lm_head
+    per_slot = sum(r * (i + o) * itemsize for i, o in sites)
+    return n * (per_slot + len(sites) * itemsize)

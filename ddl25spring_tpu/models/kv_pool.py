@@ -203,6 +203,18 @@ def kv_bytes(nr_tokens: int, nr_layers: int, kv_heads: int, head_dim: int,
     return nr_tokens * nr_layers * per_tok
 
 
+def pages_displaced(nbytes: int, page_bytes: int) -> int:
+    """KV pages ``nbytes`` of co-resident state displaces from a shared
+    HBM budget (ceil — a partially displaced page is gone).  The
+    multi-LoRA batcher shrinks its default pool by
+    ``pages_displaced(adapter_bytes(config), page_bytes)`` so the adapter
+    stacks and the KV pool together stay inside the footprint the pool
+    alone would have had."""
+    if page_bytes <= 0:
+        raise ValueError(f"page_bytes must be > 0, got {page_bytes}")
+    return -(-max(0, nbytes) // page_bytes)
+
+
 def tiered_kv_bytes(device_tokens: int, host_tokens: int, nr_layers: int,
                     kv_heads: int, head_dim: int, *,
                     dtype: str = "f32") -> dict:

@@ -97,6 +97,17 @@ class LlamaConfig:
     #                            the masked optimizer, B zero-init so the
     #                            adapted model starts as the base model
     lora_alpha: float = 16.0   # adapter scale alpha/r
+    lora_slots: int = 0        # >0: multi-tenant serving — every matmul
+    #                            becomes MultiLoRADense (models/lora.py):
+    #                            ONE shared base kernel plus lora_slots
+    #                            stacked adapters gathered per batch row
+    #                            by adapter_slots at call time.  Slot 0
+    #                            is the reserved null adapter (rows
+    #                            carrying it are bitwise the base
+    #                            model).  Needs lora_rank > 0 (the stack
+    #                            rank); the serving AdapterPool
+    #                            (models/adapter_pool.py) manages which
+    #                            tenant occupies which slot.
     kv_cache_int8: bool = False  # serving: decode KV cache stored int8
     #                              with per-(token, head) absmax scales —
     #                              halves the cache's HBM footprint and,
@@ -185,6 +196,25 @@ class LlamaConfig:
                 "adapters in fp, then merge_lora -> quantize_llama_params "
                 "for serving"
             )
+        if self.lora_slots:
+            if self.lora_slots < 2:
+                raise ValueError(
+                    f"lora_slots={self.lora_slots}: need slot 0 (the "
+                    "reserved null adapter) plus at least one tenant slot"
+                )
+            if not self.lora_rank:
+                raise ValueError(
+                    "lora_slots needs lora_rank > 0 — the stacked "
+                    "adapters share one rank (the MultiLoRADense stack "
+                    "shape)"
+                )
+            if self.nr_experts:
+                raise ValueError(
+                    "lora_slots does not support MoE configs: expert "
+                    "weights live outside the _dense_cls sites the "
+                    "stacks cover, so per-tenant adaptation would "
+                    "silently skip the MLP"
+                )
         if self.weights_int8 and self.nr_experts:
             raise ValueError(
                 "weights_int8 does not support MoE configs: expert weights "
@@ -299,11 +329,18 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, pad=None, prefix_len: int = 0,
-                 block_tables=None):
+                 block_tables=None, adapter_slots=None):
         cfg = self.config
         B, T, _ = x.shape
         mk = _dense_cls(cfg)
-        dense = lambda name, features: mk(features, name)
+        if cfg.lora_slots:
+            # multi-tenant serving: each matmul gathers its row's adapter
+            # from the stacks (adapter_slots is the per-row slot vector;
+            # None keeps every row on the base kernels)
+            dense = lambda name, features: (
+                lambda h, _m=mk(features, name): _m(h, adapter_slots))
+        else:
+            dense = lambda name, features: mk(features, name)
         kv_dim = cfg.kv_heads * cfg.head_dim  # == dmodel for MHA; less (GQA)
         q = dense("wq", cfg.dmodel)(x).reshape(B, T, cfg.nr_heads,
                                                cfg.head_dim)
@@ -744,9 +781,13 @@ class SwiGLU(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_slots=None):
         cfg = self.config
         mk = _dense_cls(cfg)
+        if cfg.lora_slots:
+            base_mk = mk
+            mk = lambda features, name: (
+                lambda h, _m=base_mk(features, name): _m(h, adapter_slots))
         gate = mk(cfg.hidden_dim, "w1")(x)
         up = mk(cfg.hidden_dim, "w3")(x)
         return mk(cfg.dmodel, "w2")(nn.silu(gate) * up)
@@ -757,11 +798,11 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, pad=None, prefix_len: int = 0,
-                 block_tables=None):
+                 block_tables=None, adapter_slots=None):
         cfg = self.config
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions, pad,
-            prefix_len, block_tables,
+            prefix_len, block_tables, adapter_slots,
         )
         h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
         if cfg.nr_experts:
@@ -776,7 +817,7 @@ class Block(nn.Module):
 
             return x + MoEMLP(cfg, cfg.nr_experts, cfg.expert_topk,
                               name="moe")(h)
-        return x + SwiGLU(cfg, name="mlp")(h)
+        return x + SwiGLU(cfg, name="mlp")(h, adapter_slots)
 
 
 def _positions(T: int):
@@ -786,10 +827,18 @@ def _positions(T: int):
 def _dense_cls(cfg: LlamaConfig):
     """Matmul-layer factory: fp ``nn.Dense``; ``QuantDense`` for
     int8-serving configs (models/quant.py); ``LoRADense`` for adapter
-    fine-tuning configs (models/lora.py)."""
+    fine-tuning configs (models/lora.py); ``MultiLoRADense`` for
+    multi-tenant serving configs (``lora_slots > 0``)."""
     if cfg.weights_int8:
         return lambda features, name: QuantDense(
             features, dtype=cfg.dtype, name=name
+        )
+    if cfg.lora_slots:
+        from .lora import MultiLoRADense  # local import avoids a cycle
+
+        return lambda features, name: MultiLoRADense(
+            features, rank=cfg.lora_rank, nr_slots=cfg.lora_slots,
+            dtype=cfg.dtype, name=name,
         )
     if cfg.lora_rank:
         from .lora import LoRADense  # local import avoids a module cycle
@@ -880,7 +929,8 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, pad=None,
-                 prefix_len: int = 0, block_tables=None):
+                 prefix_len: int = 0, block_tables=None,
+                 adapter_slots=None):
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.dmodel,
@@ -893,14 +943,17 @@ class Llama(nn.Module):
         # ``prefix_len`` marks shared prefix-cache slots (generate.py
         # precompute_prefix) that stay visible below the pad window;
         # ``block_tables`` (B, ctx // kv_page) switches decode to the paged
-        # KV-pool layout (models/kv_pool.py, serving kv_layout="paged")
+        # KV-pool layout (models/kv_pool.py, serving kv_layout="paged");
+        # ``adapter_slots`` (B,) gathers each row's LoRA adapter from the
+        # MultiLoRADense stacks (lora_slots > 0 serving configs only)
         pos = _positions(tokens.shape[1]) if positions is None else positions
         block = _block_cls(cfg)
         for i in range(cfg.nr_layers):
             x = block(cfg, name=f"block{i}")(x, pos, pad, prefix_len,
-                                             block_tables)
+                                             block_tables, adapter_slots)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
-        logits = _dense_cls(cfg)(cfg.vocab_size, "lm_head")(x)
+        head = _dense_cls(cfg)(cfg.vocab_size, "lm_head")
+        logits = head(x, adapter_slots) if cfg.lora_slots else head(x)
         return logits.astype(jnp.float32)
 
 

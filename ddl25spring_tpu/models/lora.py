@@ -20,6 +20,19 @@ Three pieces, all config-driven:
   a plain (lora_rank=0) tree for serving — zero inference overhead, and
   the merged model then composes with int8 quantization, TP shardings,
   speculative decoding, everything.
+
+Multi-tenant serving adds a fourth piece: ``LlamaConfig(lora_slots=N)``
+swaps every matmul for :class:`MultiLoRADense`, which stacks N adapters
+next to ONE shared base kernel and gathers ``(A_i, B_i, scale_i)`` per
+batch row at call time — ``x@W + scale_i*(x@A_i)@B_i`` with a hard
+``jnp.where`` guard so rows carrying slot 0 (the reserved null adapter)
+return the base matmul BITWISE, not just within float tolerance.  The
+wire format between training and the stacks is
+:func:`slice_adapter` / :func:`apply_adapter` (adapter-subtree extract /
+re-attach, byte-identical round trip), and
+:func:`stack_adapter_params` / :func:`install_adapter` convert a plain
+serving tree into the stacked layout and hot-write one tenant's factors
+into a slot (the ``models/adapter_pool.AdapterPool`` install path).
 """
 
 from __future__ import annotations
@@ -53,6 +66,60 @@ class LoRADense(nn.Module):
         ).astype(self.dtype)
         x = x.astype(self.dtype)
         return x @ kernel + (self.alpha / self.rank) * ((x @ a) @ b)
+
+
+class MultiLoRADense(nn.Module):
+    """One shared base kernel + ``nr_slots`` stacked LoRA adapters.
+
+    ``lora_A`` is ``(nr_slots, in, rank)``, ``lora_B`` is
+    ``(nr_slots, rank, features)`` and ``lora_scale`` is ``(nr_slots,)``
+    — all ZERO at init, so every slot starts as the null adapter and
+    real tenants are written in with :func:`install_adapter`.  The call
+    takes per-row ``slots`` (int32 ``(batch,)``); each row gathers its
+    own factors and computes ``x@W + scale_i*(x@A_i)@B_i``.  Slot 0 is
+    RESERVED as the null adapter: rows carrying it are routed through a
+    ``jnp.where`` onto the bare base matmul, so a null row is bit-
+    identical to the base model even when ``base + 0.0`` would not be
+    (``-0.0 + 0.0`` rounds to ``+0.0``).  ``slots=None`` skips the
+    adapter math entirely (training / non-serving callers).
+    """
+
+    features: int
+    rank: int
+    nr_slots: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, slots=None):
+        in_dim = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (in_dim, self.features),
+        ).astype(self.dtype)
+        a = self.param(
+            "lora_A", nn.initializers.zeros,
+            (self.nr_slots, in_dim, self.rank),
+        ).astype(self.dtype)
+        b = self.param(
+            "lora_B", nn.initializers.zeros,
+            (self.nr_slots, self.rank, self.features),
+        ).astype(self.dtype)
+        scale = self.param(
+            "lora_scale", nn.initializers.zeros, (self.nr_slots,)
+        ).astype(self.dtype)
+        x = x.astype(self.dtype)
+        base = x @ kernel
+        if slots is None:
+            return base
+        # per-row gather, then the two-step low-rank product — (x@A)@B is
+        # O(T·r·(in+out)) where fusing A@B first would be O(in·out)
+        a_i = jnp.take(a, slots, axis=0)            # (B, in, r)
+        b_i = jnp.take(b, slots, axis=0)            # (B, r, out)
+        s_i = jnp.take(scale, slots, axis=0)        # (B,)
+        delta = jnp.einsum("btd,bdr->btr", x, a_i)
+        delta = jnp.einsum("btr,bro->bto", delta, b_i)
+        out = base + s_i[:, None, None] * delta
+        return jnp.where((slots == 0)[:, None, None], base, out)
 
 
 def lora_trainable_mask(params):
@@ -111,3 +178,149 @@ def merge_lora(params, config):
         return out
 
     return {k: walk(v) for k, v in params.items()}
+
+
+# -- adapter wire format -------------------------------------------------
+#
+# slice_adapter / apply_adapter define THE interchange format between the
+# FL side (rounds over the adapter subtree only), the rollout plane
+# (adapter-kind ParamBundles) and the serving AdapterPool (install into a
+# MultiLoRADense slot): a nested dict mirroring the params tree that
+# keeps exactly the dicts holding lora_A/lora_B and nothing else.
+
+
+def slice_adapter(params):
+    """Extract ONLY the ``lora_A``/``lora_B`` leaves of a LoRA tree,
+    keeping the enclosing dict structure (branches without adapters are
+    pruned).  The result is the adapter wire format: what an FL round
+    trains, what a bundle carries, what :func:`install_adapter` writes
+    into a pool slot.  ``apply_adapter(params, slice_adapter(params))``
+    is byte-identical to ``params`` (the leaves are the same arrays)."""
+
+    def walk(tree):
+        out = {}
+        for name, sub in tree.items():
+            if not isinstance(sub, dict):
+                continue
+            if "lora_A" in sub:
+                out[name] = {"lora_A": sub["lora_A"],
+                             "lora_B": sub["lora_B"]}
+            else:
+                w = walk(sub)
+                if w:
+                    out[name] = w
+        return out
+
+    return walk(params)
+
+
+def apply_adapter(base, adapter):
+    """Re-attach a :func:`slice_adapter` subtree onto ``base``: adapter
+    leaves replace the matching ``lora_A``/``lora_B`` leaves, every
+    other leaf passes through untouched.  Raises when an adapter path
+    has no LoRA site in ``base`` — a silently dropped tenant delta is
+    the failure mode this wire format exists to prevent."""
+
+    def walk(b, a, path):
+        unknown = set(a) - set(b)
+        if unknown:
+            raise ValueError(
+                f"adapter path {path}/{sorted(unknown)[0]} not in base "
+                "params (rank/config mismatch?)")
+        out = {}
+        for name, sub in b.items():
+            if name not in a:
+                out[name] = sub
+            elif "lora_A" in a[name]:
+                if not (isinstance(sub, dict) and "lora_A" in sub):
+                    raise ValueError(
+                        f"{path}/{name} is not a LoRA site in base")
+                out[name] = {**sub, "lora_A": a[name]["lora_A"],
+                             "lora_B": a[name]["lora_B"]}
+            else:
+                out[name] = walk(sub, a[name], f"{path}/{name}")
+        return out
+
+    return walk(base, adapter, "")
+
+
+def stack_adapter_params(params, config):
+    """Convert a plain serving tree (``kernel``-only dense sites) into
+    the :class:`MultiLoRADense` stacked layout for
+    ``LlamaConfig(lora_slots=N)``: every dict holding a ``kernel`` gains
+    zero ``lora_A (N, in, r)`` / ``lora_B (N, r, out)`` /
+    ``lora_scale (N,)`` stacks (all slots start null).  Trees that
+    already carry per-module adapters must be :func:`merge_lora`-d
+    first — stacking would silently drop them."""
+    n, r = config.lora_slots, config.lora_rank
+
+    def walk(tree):
+        out = {}
+        for name, sub in tree.items():
+            if isinstance(sub, dict) and "kernel" in sub:
+                if "lora_scale" in sub:
+                    out[name] = sub          # already stacked
+                    continue
+                if "lora_A" in sub:
+                    raise ValueError(
+                        "params already carry per-module LoRA adapters; "
+                        "merge_lora them before stacking")
+                k = sub["kernel"]
+                out[name] = {
+                    **sub,
+                    "lora_A": jnp.zeros((n, k.shape[0], r), k.dtype),
+                    "lora_B": jnp.zeros((n, r, k.shape[1]), k.dtype),
+                    "lora_scale": jnp.zeros((n,), k.dtype),
+                }
+            elif isinstance(sub, dict):
+                out[name] = walk(sub)
+            else:
+                out[name] = sub
+        return out
+
+    return {k: (walk(v) if isinstance(v, dict) else v)
+            for k, v in params.items()}
+
+
+def install_adapter(stacked, slot, adapter, scale):
+    """Write one tenant's :func:`slice_adapter` factors into ``slot`` of
+    a :func:`stack_adapter_params` tree (functional: returns a new tree
+    touching only the stacked leaves).  ``scale`` is the tenant's
+    ``alpha/rank``.  Slot 0 is the reserved null adapter and refuses
+    installs — its all-zero stacks back the bitwise base-model
+    contract."""
+    if slot == 0:
+        raise ValueError("slot 0 is the reserved null adapter")
+
+    def walk(s, a, path):
+        unknown = set(a) - set(s)
+        if unknown:
+            raise ValueError(
+                f"adapter path {path}/{sorted(unknown)[0]} not in "
+                "stacked params")
+        out = {}
+        for name, sub in s.items():
+            if name not in a:
+                out[name] = sub
+            elif "lora_A" in a[name]:
+                if "lora_scale" not in sub:
+                    raise ValueError(
+                        f"{path}/{name} is not a stacked LoRA site")
+                aa = jnp.asarray(a[name]["lora_A"],
+                                 sub["lora_A"].dtype)
+                bb = jnp.asarray(a[name]["lora_B"],
+                                 sub["lora_B"].dtype)
+                # the stacks may be numpy (a ParamBundle-applied tree
+                # coming back through the rollout plane) — .at needs jnp
+                out[name] = {
+                    **sub,
+                    "lora_A": jnp.asarray(sub["lora_A"]).at[slot].set(aa),
+                    "lora_B": jnp.asarray(sub["lora_B"]).at[slot].set(bb),
+                    "lora_scale": jnp.asarray(
+                        sub["lora_scale"]).at[slot].set(scale),
+                }
+            else:
+                out[name] = walk(sub, a[name], f"{path}/{name}")
+        return out
+
+    return walk(stacked, adapter, "")

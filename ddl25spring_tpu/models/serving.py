@@ -83,7 +83,7 @@ import numpy as np
 
 from .. import obs
 from ..data.prefetch import PrefetchStream
-from . import kv_pool
+from . import kv_pool, lora
 from .llama import Llama, LlamaConfig
 
 
@@ -240,7 +240,7 @@ class _SpillTier:
 
 
 def _right_aligned_prefill(model, W: int, P: int, params, prompt_row,
-                           length, prefix_cache):
+                           length, prefix_cache, adapter=None):
     """prompt_row (W,) right-padded; -> (cache_row_tree, first, pad).
 
     The row is right-ALIGNED into the window (shift by W - length) so the
@@ -255,9 +255,14 @@ def _right_aligned_prefill(model, W: int, P: int, params, prompt_row,
     aligned = jnp.roll(prompt_row, shift)[None, :]  # (1, W)
     pad = shift[None]
     variables = params if P == 0 else {**params, "cache": prefix_cache}
+    # ``adapter`` (scalar per row under vmap) threads the multi-LoRA slot
+    # into the prefill so the prompt runs under the SAME adapter as the
+    # decode steps that follow — kwarg omitted entirely on the base path
+    # so non-LoRA programs stay literally the programs they were
+    kw = {} if adapter is None else {"adapter_slots": adapter[None]}
     logits, state = model.apply(
         variables, aligned, positions=P + jnp.arange(W),
-        pad=pad, prefix_len=P, mutable=["cache"],
+        pad=pad, prefix_len=P, mutable=["cache"], **kw,
     )
     # the last real token sits at slot W-1 (right-aligned), so its
     # logits row IS the next-token distribution
@@ -311,7 +316,7 @@ def _make_empty_pool(model, kv_page: int):
 
 
 def _decode_step(model: "nn.Module", P: int, params, pad, carry, _=None, *,
-                 check=False, tables=None):
+                 check=False, tables=None, adapters=None):
     """One lockstep greedy decode step for all slots at their own depths —
     the scan body every serving path shares (host batcher chunks, fused
     while_loop, scheduled scan), so the bit-identical-to-generate()
@@ -336,6 +341,11 @@ def _decode_step(model: "nn.Module", P: int, params, pad, carry, _=None, *,
     contract (tests/test_serving_fused_step.py)."""
     cache, tok, pos = carry
     fused = tables is not None and model.config.decode_impl == "fused"
+    if fused and adapters is not None:
+        raise NotImplementedError(
+            "multi-LoRA decode is restricted to decode_impl='xla' (the "
+            "batcher forces it); the fused Pallas step has no adapter "
+            "gather")
     if fused:
         from ..ops.fused_decode_step import fused_decode_step
 
@@ -352,10 +362,11 @@ def _decode_step(model: "nn.Module", P: int, params, pad, carry, _=None, *,
             ok = jnp.isfinite(logits[:, 0]).all(axis=-1)
             return (cache, nxt, pos), (nxt, ok)
         return (cache, nxt, pos), nxt
+    kw = {} if adapters is None else {"adapter_slots": adapters}
     logits, state = model.apply(
         {**params, "cache": cache}, tok[:, None],
         positions=pos[:, None], pad=pad, prefix_len=P,
-        block_tables=tables, mutable=["cache"],
+        block_tables=tables, mutable=["cache"], **kw,
     )
     nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
     if check:
@@ -421,15 +432,23 @@ def _paged_programs(model, W: int, P: int, kv_page: int):
 
     @jax.jit
     def admit(params, pool, rows, lengths, slots, tokens, pos, pad,
-              copy_dst, prefix_cache=None):
+              copy_dst, prefix_cache=None, adapters=None):
         """copy_dst (G, n_copy) int32: physical destination page for each
         admitted row's c-th copied logical page.  Pad lanes repeat the
         last real admission (same pages, same data — idempotent), exactly
-        like the contiguous scatter."""
-        row_caches, firsts, pads = jax.vmap(
-            functools.partial(_right_aligned_prefill, model, W, P),
-            in_axes=(None, 0, 0, None),
-        )(params, rows, lengths, prefix_cache)
+        like the contiguous scatter.  ``adapters`` (G,) int32 — the
+        multi-LoRA slot each admitted row prefills under (pad lanes
+        repeat the last real slot, idempotent like the rows)."""
+        if adapters is None:
+            row_caches, firsts, pads = jax.vmap(
+                functools.partial(_right_aligned_prefill, model, W, P),
+                in_axes=(None, 0, 0, None),
+            )(params, rows, lengths, prefix_cache)
+        else:
+            row_caches, firsts, pads = jax.vmap(
+                functools.partial(_right_aligned_prefill, model, W, P),
+                in_axes=(None, 0, 0, None, 0),
+            )(params, rows, lengths, prefix_cache, adapters)
         lo = P // kv_page
         for g in range(rows.shape[0]):
             for c in range(copy_dst.shape[1]):
@@ -448,13 +467,17 @@ def _paged_programs(model, W: int, P: int, kv_page: int):
         return pool, tokens, pos, pad, firsts
 
     @functools.partial(jax.jit, static_argnames=("nr", "check"))
-    def decode(params, pool, tokens, pos, pad, tables, nr=1, check=False):
+    def decode(params, pool, tokens, pos, pad, tables, adapters=None,
+               nr=1, check=False):
         """Contiguous ``decode`` with the block tables riding along — the
         scan body is the same single copy of the math (_decode_step), so
-        the bit-identity contract is structural, not empirical."""
+        the bit-identity contract is structural, not empirical.
+        ``adapters`` (B,) int32 rides along like the tables: the per-slot
+        multi-LoRA gather index (slot 0 = null adapter = base math)."""
         (pool, last, final_pos), ys = jax.lax.scan(
             functools.partial(_decode_step, model, P, params, pad,
-                              check=check, tables=tables),
+                              check=check, tables=tables,
+                              adapters=adapters),
             (pool, tokens, pos), None, length=nr,
         )
         if check:
@@ -567,7 +590,9 @@ class ContinuousBatcher:
                  kv_page: int = 16, kv_pages: int | None = None,
                  prefix_tokens=None, slo_deadline_s: float | None = None,
                  kv_dtype: str = "f32", spill: str = "off",
-                 spill_after: int = 2, spill_prefetch: int = 2):
+                 spill_after: int = 2, spill_prefetch: int = 2,
+                 adapter_slots: int = 0, adapter_store: dict | None = None,
+                 adapter_resident: dict | None = None):
         # ``params`` is the full variables dict ({"params": ...}), the same
         # contract as models.generate.generate / speculative_generate.
         # ``decode_chunk``: tokens per decode dispatch — admissions happen
@@ -619,6 +644,22 @@ class ContinuousBatcher:
         # ``spill_prefetch`` host→device staging lookahead depth (0 = no
         #                   lookahead: every resume stages synchronously
         #                   and counts as ``late``).
+        #
+        # Multi-tenant adapters (docs/PERFORMANCE.md multi-tenant section):
+        # ``adapter_slots``   > 0 turns on batched multi-LoRA decode: the
+        #                   params carry MultiLoRADense stacks of this many
+        #                   slots (slot 0 = reserved null adapter, bitwise
+        #                   the base model) and every submit() may name an
+        #                   ``adapter_id``; residency is managed by
+        #                   models/adapter_pool.AdapterPool with KV-page
+        #                   discipline (refcount/LRU-evict/miss-refetch);
+        # ``adapter_store``   host store ``tenant -> (adapter, scale,
+        #                   round_ix)`` — the miss re-fetch source, shared
+        #                   across a fleet's replicas by the tenants plane;
+        # ``adapter_resident`` ``tenant -> slot`` already INSTALLED in the
+        #                   passed-in (pre-stacked) params — seeded as
+        #                   resident without a device write (how rollout
+        #                   replicas built from pushed params come up hot).
         if config.decode_seq_shards > 1:
             raise NotImplementedError(
                 "continuous batching over the sequence-sharded cache: use "
@@ -666,6 +707,43 @@ class ContinuousBatcher:
             raise ValueError(
                 f"spill_prefetch must be >= 0, got {spill_prefetch}"
             )
+        self.adapter_slots = int(adapter_slots)
+        if self.adapter_slots:
+            if self.adapter_slots < 2:
+                raise ValueError(
+                    f"adapter_slots={adapter_slots}: need slot 0 (the "
+                    "reserved null adapter) plus at least one tenant slot")
+            if kv_layout != "paged":
+                raise ValueError(
+                    "adapter_slots requires kv_layout='paged' — the "
+                    "adapter pool shares the paged pool's residency "
+                    "model (and its HBM budget)")
+            if config.lora_rank <= 0:
+                raise ValueError(
+                    "adapter_slots needs config.lora_rank > 0 (the "
+                    "factor stacks are sized by the rank)")
+            if prefix is not None or prefix_tokens is not None:
+                raise ValueError(
+                    "adapter_slots does not compose with a shared prefix "
+                    "cache: the prefix KV is computed under the BASE "
+                    "model, so a tenant's decode over it would diverge "
+                    "from the merge_lora parity contract")
+            if spill != "off":
+                raise NotImplementedError(
+                    "adapter_slots with spill='host': parked streams "
+                    "would hold adapter refcounts across park/resume — "
+                    "not wired yet")
+            # multi-LoRA decode is an XLA-path feature: the fused Pallas
+            # step has no per-slot adapter gather.  Replaced BEFORE
+            # with_resolved_decode_impl so 'auto' cannot pick fused, and
+            # before _programs sees the config (lora_slots is part of its
+            # lru key, so adapter programs never collide with base ones).
+            config = dataclasses.replace(
+                config, lora_slots=self.adapter_slots, decode_impl="xla")
+            params = lora.stack_adapter_params(params, config)
+        elif adapter_store is not None or adapter_resident:
+            raise ValueError(
+                "adapter_store/adapter_resident need adapter_slots > 0")
         self._spill_on = spill == "host"
         self.spill_after = int(spill_after)
         self.config = config
@@ -739,6 +817,21 @@ class ContinuousBatcher:
                 kv_pages = 1 + self._head_len + max_batch * (
                     self._n_slot_pages - self._head_len
                 )
+                if self.adapter_slots:
+                    # shared HBM budget: the adapter stacks live next to
+                    # the KV pool, so the default pool shrinks by the
+                    # pages they displace (floored at one slot's worst
+                    # case so the batcher can always make progress) —
+                    # adapter_bytes is the analytic the mem_estimate tool
+                    # cross-checks against compiled argument bytes
+                    from .adapter_pool import adapter_bytes
+                    page_bytes = kv_pool.kv_bytes(
+                        pg, config.nr_layers, config.kv_heads,
+                        config.head_dim, dtype=kv_dtype)
+                    shrink = kv_pool.pages_displaced(
+                        adapter_bytes(config), page_bytes)
+                    floor = 1 + self._head_len + self._n_slot_pages
+                    kv_pages = max(floor, kv_pages - shrink)
             self._pool = kv_pool.KVPagePool(int(kv_pages))
             self._registry = kv_pool.PrefixRegistry(self._pool)
             self._tables = np.zeros(
@@ -784,6 +877,24 @@ class ContinuousBatcher:
         self.pad = jnp.zeros((max_batch,), jnp.int32)
         self.tokens = jnp.zeros((max_batch,), jnp.int32)
         self.slots = [_Slot() for _ in range(max_batch)]
+        # multi-tenant adapter state: the pool decides WHICH stack slot a
+        # tenant occupies; ``_adapter_vec`` (host numpy, shipped as an
+        # owned copy per dispatch exactly like the block tables) is the
+        # per-LANE gather index the decode step reads; ``_slot_tenant``
+        # maps lanes back to tenants for idempotent refcount release.
+        if self.adapter_slots:
+            from .adapter_pool import AdapterPool
+            self._adapters = AdapterPool(self.adapter_slots,
+                                         store=adapter_store)
+            if adapter_resident:
+                for t, ps in sorted(adapter_resident.items(),
+                                    key=lambda kv: kv[1]):
+                    self._adapters.seed(t, ps)
+            self._adapter_vec = np.zeros((max_batch,), np.int32)
+        else:
+            self._adapters = None
+            self._adapter_vec = None
+        self._slot_tenant: list = [None] * max_batch
         # resilience state
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
@@ -931,6 +1042,7 @@ class ContinuousBatcher:
         ride on."""
         if not self._paged:
             return
+        self._release_adapter(s)
         hp = self._head_len
         private = [int(p) for p in self._tables[s, hp:] if p > 0]
         if hp and self._tables[s, 0] > 0:
@@ -949,6 +1061,17 @@ class ContinuousBatcher:
                           self._pool.pages_in_use)
             obs.set_gauge("serving_kv_resident_pages",
                           self._pool.resident_pages, tier="device")
+
+    def _release_adapter(self, s: int):
+        """Drop lane ``s``'s adapter reference (idempotent — eviction
+        paths and the normal recycle can both land here) and park the
+        lane's further scratch decodes on the null adapter."""
+        t = self._slot_tenant[s]
+        if t is None:
+            return
+        self._slot_tenant[s] = None
+        self._adapter_vec[s] = 0
+        self._adapters.release(t)
 
     # -- tiered pool: park / prefetch / resume (spill="host") ------------
 
@@ -1126,8 +1249,8 @@ class ContinuousBatcher:
             # spill), and pages held by already-cold streams count as
             # free-able — otherwise the estimate rejects requests whose
             # pages the spill pass would hand over immediately
-            ahead = sum(self._pages_needed(b, resident=self._spill_on)
-                        for _r, _p, b in self._queue)
+            ahead = sum(self._pages_needed(q[2], resident=self._spill_on)
+                        for q in self._queue)
             deficit = (self._pages_needed(budget) + ahead
                        - self._pool.free_pages)
             if self._spill_on and deficit > 0:
@@ -1196,13 +1319,20 @@ class ContinuousBatcher:
         # never block on device results mid-run — is the whole design
         with obs.span("serving.admit", group=G0):
             if self._paged:
-                (self.cache, self.tokens, self.pos, self.pad,
-                 firsts) = self._admit_fn(
+                args = (
                     self.params, self.cache, jnp.asarray(rows),
                     jnp.asarray(lengths), jnp.asarray(slot_ix),
                     self.tokens, self.pos, self.pad,
                     jnp.asarray(copy_dst), self._prefix_cache,
                 )
+                if self._adapters is not None:
+                    # per-lane gather index for the prefill: pad lanes
+                    # repeat the last real slot via slot_ix (idempotent,
+                    # like the rows)
+                    args = args + (
+                        jnp.asarray(self._adapter_vec[slot_ix]),)
+                (self.cache, self.tokens, self.pos, self.pad,
+                 firsts) = self._admit_fn(*args)
                 if obs.enabled():
                     obs.set_gauge("serving_kv_pages_in_use",
                                   self._pool.pages_in_use)
@@ -1385,6 +1515,7 @@ class ContinuousBatcher:
                         replica=getattr(self, "_replica_ix", None),
                         emitted=len(sl.emitted))
             self._deadlines.pop(sl.request_id, None)
+            self._release_adapter(s)
             self.slots[s] = _Slot()
         if rids:
             self._obs_finish(rids)
@@ -1649,6 +1780,10 @@ class ContinuousBatcher:
             # zero-copy, so an in-flight async chunk would read tables the
             # host has already rewritten — ship an owned copy per chunk
             args = args + (jnp.asarray(self._tables.copy()),)
+            if self._adapters is not None:
+                # the adapter lane vector is host numpy the admission path
+                # mutates — same owned-copy rule as the tables
+                args = args + (jnp.asarray(self._adapter_vec.copy()),)
         with obs.span("serving.decode", chunk=K):
             with obs.step_annotation("serving.decode",
                                      self.stats["decode_steps"] // K):
@@ -1696,7 +1831,9 @@ class ContinuousBatcher:
         group = []
         avail = self._pool.free_pages if self._paged else 0
         while pending and free:
-            rid, prompt, budget = pending[0]
+            item = pending[0]
+            rid, prompt, budget = item[0], item[1], item[2]
+            tenant = item[3] if len(item) > 3 else 0
             if self._paged:
                 need = self._pages_needed(budget)
                 if need > avail:
@@ -1705,8 +1842,27 @@ class ContinuousBatcher:
                     # (and so the whole trajectory) depend on pool timing
                     break
                 avail -= need
+            s = free[0]
+            if self._adapters is not None and tenant:
+                acq = self._adapters.acquire(tenant)
+                if acq is None:
+                    # every adapter slot busy or pinned: head-of-line
+                    # wait, exactly like a pool-page deficit
+                    break
+                pslot, entry = acq
+                if entry is not None:
+                    # residency miss: re-fetch the factors from the host
+                    # store and install them into the stack slot the pool
+                    # just freed (possibly evicting a cold tenant) —
+                    # BEFORE the admit dispatch reads self.params
+                    adapter, scale, _r = entry
+                    self.params = lora.install_adapter(
+                        self.params, pslot, adapter, scale)
+                self._adapter_vec[s] = pslot
+                self._slot_tenant[s] = tenant
             pending.pop(0)
-            group.append((free.pop(0), rid, prompt, budget))
+            free.pop(0)
+            group.append((s, rid, prompt, budget))
         return group
 
     def _sync_admit_bookkeep(self, group, firsts):
@@ -1748,6 +1904,46 @@ class ContinuousBatcher:
                         seconds=secs, tokens=booked,
                         emitted=len(sl.emitted))
 
+    # -- multi-tenant adapters (adapter_slots > 0) ------------------------
+
+    def register_adapter(self, tenant, adapter, scale: float = 1.0,
+                         round_ix=None) -> None:
+        """(Re)register ``tenant``'s LoRA factors (``slice_adapter`` wire
+        format) in the host store; if the tenant is currently RESIDENT
+        the new version is hot-swapped into its stack slot in place (the
+        single-replica flow — fleets roll new versions through the
+        rollout plane instead, which rebuilds replicas from pushed
+        params)."""
+        if self._adapters is None:
+            raise ValueError(
+                "register_adapter: this batcher has no adapter pool "
+                "(pass adapter_slots= to the ctor)")
+        self._adapters.put(tenant, adapter, scale, round_ix)
+        pslot = self._adapters.slot_of(tenant)
+        if pslot is not None:
+            self.params = lora.install_adapter(
+                self.params, pslot, adapter, scale)
+
+    def adapter_resident(self, tenant) -> bool:
+        """Whether ``tenant``'s adapter is installed in this batcher's
+        stacks right now — the router's tenant-affinity signal (tenant 0,
+        the null adapter, is always resident)."""
+        if int(tenant) == 0:
+            return True
+        return self._adapters is not None and self._adapters.resident(
+            int(tenant))
+
+    def _obs_adapters(self):
+        """Per-tier adapter residency gauges, mirroring the KV pool's:
+        ``tier="device"`` counts installed stack slots, ``tier="host"``
+        the store entries a miss can re-fetch."""
+        if self._adapters is not None and obs.enabled():
+            obs.set_gauge("serving_adapter_resident",
+                          len(self._adapters.resident_tenants),
+                          tier="device")
+            obs.set_gauge("serving_adapter_resident",
+                          len(self._adapters.store), tier="host")
+
     # -- streaming interface (requests arrive over time) ------------------
 
     @property
@@ -1760,7 +1956,8 @@ class ContinuousBatcher:
                 + len(self._parked))
 
     def submit(self, rid, prompt, max_new_tokens: int,
-               deadline_s: float | None = None) -> None:
+               deadline_s: float | None = None,
+               adapter_id=0) -> None:
         """Enqueue one request under key ``rid`` (any hashable, unique
         among in-flight requests); it joins the running batch at the next
         ``step()`` with a free slot.  Zero budgets resolve to ``[]`` at
@@ -1772,7 +1969,25 @@ class ContinuousBatcher:
         bound — load the caller can see beats latency it can't.
         ``deadline_s`` bounds the request's decode time from admission;
         past it the slot is evicted and the partial stream comes back as
-        :class:`ServedTokens` with status ``timed_out``."""
+        :class:`ServedTokens` with status ``timed_out``.
+
+        ``adapter_id`` (multi-tenant batchers, ``adapter_slots > 0``)
+        names the tenant whose LoRA adapter decodes this request; 0 is
+        the null adapter (bitwise the base model).  Non-zero tenants must
+        be registered (:meth:`register_adapter` or the shared store)
+        before submit; a non-resident tenant's admission waits for an
+        adapter slot exactly like it waits for KV pages."""
+        adapter_id = int(adapter_id)
+        if adapter_id:
+            if self._adapters is None:
+                raise ValueError(
+                    f"adapter_id={adapter_id}: this batcher has no "
+                    "adapter pool (pass adapter_slots= to the ctor)")
+            if not (self._adapters.resident(adapter_id)
+                    or adapter_id in self._adapters.store):
+                raise KeyError(
+                    f"adapter_id {adapter_id} is not registered "
+                    "(register_adapter() it first)")
         if (rid in self._instant
                 or any(q[0] == rid for q in self._queue)
                 or any(sl.request_id == rid for sl in self.slots
@@ -1818,7 +2033,8 @@ class ContinuousBatcher:
         if rt is not None:
             rt.note(rid, "submit",
                     replica=getattr(self, "_replica_ix", None),
-                    tokens=len(prompt), budget=budget)
+                    tokens=len(prompt), budget=budget,
+                    tenant=adapter_id)
         if deadline_s is not None:
             self._deadlines[rid] = float(deadline_s)
         if budget == 0:
@@ -1826,7 +2042,7 @@ class ContinuousBatcher:
             return
         if self._prefix_tokens is not None:
             self._hit_rids.add(rid)
-        self._queue.append((rid, list(prompt), budget))
+        self._queue.append((rid, list(prompt), budget, adapter_id))
 
     def step(self) -> dict:
         """Admit queued requests into free slots, decode ONE chunk, and
@@ -1912,6 +2128,7 @@ class ContinuousBatcher:
             # monitors window over (one sample per decode chunk)
             obs.set_gauge("serving_queue_depth",
                           len(self._queue) + len(self._instant))
+            self._obs_adapters()
         obs.record_samples()
         # tag evicted requests (their partial streams still compare equal
         # to the same plain list); clean completions stay plain lists

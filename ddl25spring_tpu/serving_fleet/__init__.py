@@ -28,6 +28,11 @@ Three layers (docs/PERFORMANCE.md §8):
                 with hysteresis + cooldown, consumed by
                 :meth:`FleetRouter.apply_scaling_hint`
                 (docs/OBSERVABILITY.md §time series).
+- ``tenants`` — :class:`TenantAdapterPlane`: federated LoRA rounds →
+                per-tenant adapter bundles → burn-gated hot-swap into
+                the live replicas' adapter pools, closing the
+                train→serve loop per tenant
+                (docs/PERFORMANCE.md §multi-tenant).
 
 ``policy``, ``router`` and ``health`` are HOST modules and never import
 jax (so routing logic is unit-testable anywhere); importing this package
@@ -43,13 +48,15 @@ from .policy import ReplicaSnapshot, rank_replicas, snapshot_replica
 from .rollout import (ParamBundle, RolloutConfig, RolloutController,
                       WeightPushPlane, version_of)
 from .router import FleetRouter, NoReplicaAvailable
+from .tenants import TenantAdapterPlane
 
 __all__ = [
     "AutoscaleConfig", "AutoscalePolicy",
     "BreakerConfig", "DisaggregatedBatcher", "FleetHealth",
     "FleetRouter", "NoReplicaAvailable", "ParamBundle", "PrefillWorker",
     "ReplicaSnapshot", "RolloutConfig", "RolloutController",
-    "TPShardedBatcher", "WeightPushPlane", "headsharded_flash_decode",
+    "TPShardedBatcher", "TenantAdapterPlane", "WeightPushPlane",
+    "headsharded_flash_decode",
     "make_model_mesh", "rank_replicas", "snapshot_replica", "version_of",
 ]
 

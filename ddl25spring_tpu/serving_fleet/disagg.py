@@ -231,7 +231,9 @@ class DisaggregatedBatcher(ContinuousBatcher):
         group = []
         avail = self._pool.free_pages
         while pending and free:
-            rid, _prompt, budget = pending[0]
+            # queue entries grew an adapter_id field; the disagg replica
+            # has no adapter pool, so only the first three matter here
+            rid, _prompt, budget = pending[0][:3]
             need = (w.tail_of(rid) if w.staged(rid)
                     else self._pages_needed(budget))
             if need > avail:

@@ -23,11 +23,17 @@ routing trace is deterministic):
    steers placements at it while the burn gates watch.  A rejecting or
    breaker-open canary still re-routes/excludes as usual, so the
    preference never drops a request.
-4. **Prefix affinity** — a replica that already holds the request's
+4. **Tenant affinity** — a replica whose adapter pool already holds the
+   request's tenant adapter decodes it without a miss; a miss moves the
+   factor bytes host→device AND may evict another tenant's adapter, so
+   it outranks prefix affinity (whose miss merely recomputes prefill).
+   Null-adapter traffic (``adapter_id=0``) ties on this key everywhere —
+   the base-model ranking is unchanged.
+5. **Prefix affinity** — a replica that already holds the request's
    prefix pages (ctor ``prefix_tokens``) or served the same prompt head
    recently skips prefill work and reuses warm KV pages.
-5. **Least load** — fewest queued + active requests.
-6. **SLO slack** — at equal load, the replica with the most headroom.
+6. **Least load** — fewest queued + active requests.
+7. **SLO slack** — at equal load, the replica with the most headroom.
 """
 
 from __future__ import annotations
@@ -52,6 +58,7 @@ class ReplicaSnapshot:
     active: int
     free_slots: int
     prefix_hit: bool = False
+    tenant_hit: bool = False        # tenant's adapter resident here
     est_wait_s: float = 0.0
     slo_slack_s: float = float("inf")
     health_state: str = "healthy"   # serving_fleet.health breaker state
@@ -76,6 +83,7 @@ def rank_replicas(snapshots) -> list[int]:
             1 if s.health_state == "suspect" else 0,  # demote suspects
             1 if s.slo_slack_s <= 0.0 else 0,   # would reject: last
             0 if s.canary else 1,                # steer at the canary
+            0 if s.tenant_hit else 1,            # resident adapter first
             0 if s.prefix_hit else 1,            # warm prefix first
             s.load,                              # then least loaded
             -s.slo_slack_s,                      # then most headroom
@@ -86,6 +94,7 @@ def rank_replicas(snapshots) -> list[int]:
 
 def snapshot_replica(index: int, batcher, prompt, budget: int, *,
                      affinity_hit: bool = False,
+                     adapter_id: int = 0,
                      health_state: str = "healthy",
                      canary: bool = False,
                      capacity_model=None) -> ReplicaSnapshot:
@@ -104,6 +113,14 @@ def snapshot_replica(index: int, batcher, prompt, budget: int, *,
     so a calibrated prediction replaces it on cold replicas only.
     """
     hit = bool(affinity_hit)
+    # tenant affinity: duck-typed adapter_resident so non-adapter
+    # batchers (and fakes) rank exactly as before; a NON-resident tenant
+    # on an adapter batcher is an honest miss (tenant_hit False), while
+    # adapter_id=0 always hits — null traffic ties everywhere
+    tenant_hit = False
+    if adapter_id:
+        probe = getattr(batcher, "adapter_resident", None)
+        tenant_hit = bool(probe(adapter_id)) if callable(probe) else False
     ptoks = getattr(batcher, "_prefix_tokens", None)
     if ptoks is not None:
         n = len(ptoks)
@@ -132,6 +149,6 @@ def snapshot_replica(index: int, batcher, prompt, budget: int, *,
     return ReplicaSnapshot(
         index=index, queue_len=queue_len, active=active,
         free_slots=len(slots) - active, prefix_hit=hit,
-        est_wait_s=est_wait, slo_slack_s=slack,
+        tenant_hit=tenant_hit, est_wait_s=est_wait, slo_slack_s=slack,
         health_state=health_state, canary=canary,
     )

@@ -345,12 +345,15 @@ class _CanaryProbe:
         self.__dict__["inner"] = inner
         self.__dict__["_ctrl"] = ctrl
 
-    def submit(self, rid, prompt, budget, deadline_s=None):
+    def submit(self, rid, prompt, budget, deadline_s=None, **kw):
+        # **kw forwards tenant routing (adapter_id=) untouched; the
+        # router only passes it when nonzero, so pre-tenant fakes keep
+        # their old call shape
         ctrl = self.__dict__["_ctrl"]
         ctrl._canary_count("submitted")
         try:
             return self.__dict__["inner"].submit(
-                rid, prompt, budget, deadline_s=deadline_s)
+                rid, prompt, budget, deadline_s=deadline_s, **kw)
         except Exception as e:
             if hasattr(e, "reason") and hasattr(e, "retry_after_s"):
                 ctrl._canary_count("rejected")
@@ -796,9 +799,30 @@ class WeightPushPlane:
             return ParamBundle.delta(self.params, new_params,
                                      compress=compress, round_ix=round_ix,
                                      seed=seed)
+        if kind == "adapter":
+            # the leaf paths an adapter bundle needs are exactly the
+            # leaves that CHANGED against the promoted params — for a
+            # multi-tenant round that is the touched tenants' stacked
+            # lora_A/lora_B/lora_scale slices, a fraction of full-tree
+            # wire bytes
+            old = {p: a for p, a in _flat_items(self.params)}
+            updates = {}
+            for path, arr in _flat_items(new_params):
+                o = old.get(path)
+                if o is None:
+                    raise ValueError(
+                        f"adapter bundle: {path} is not a leaf of the "
+                        "promoted params (adapter pushes cannot change "
+                        "the tree structure)")
+                if (np.asarray(o).shape != np.asarray(arr).shape
+                        or np.asarray(o).dtype != np.asarray(arr).dtype
+                        or np.asarray(o).tobytes()
+                        != np.asarray(arr).tobytes()):
+                    updates[path] = arr
+            return ParamBundle.adapter(self.params, updates,
+                                       round_ix=round_ix)
         raise ValueError(
-            f"kind={kind!r}: build adapter bundles with "
-            "ParamBundle.adapter (they need explicit leaf paths)")
+            f"kind={kind!r}: one of 'full', 'delta', 'adapter'")
 
     # -- pushes ----------------------------------------------------------
 

@@ -244,13 +244,20 @@ class FleetRouter:
         return out
 
     def submit(self, rid, prompt, max_new_tokens: int,
-               deadline_s: float | None = None) -> int:
+               deadline_s: float | None = None, adapter_id: int = 0) -> int:
         """Route and submit one request; returns the replica index it
         landed on.  Raises the best (soonest-retry) rejection when every
         candidate replica rejected, or :class:`NoReplicaAvailable` when
-        the breaker/drain state leaves nothing to ask."""
+        the breaker/drain state leaves nothing to ask.
+
+        ``adapter_id`` names the request's tenant (multi-LoRA replicas);
+        placement then prefers replicas whose adapter pool already holds
+        the tenant's factors (tenant affinity,
+        ``fleet_tenant_affinity_hits_total``) — a miss forces the target
+        to re-fetch the factors and possibly evict another tenant's."""
         if rid in self._owner or rid in self._requests:
             raise ValueError(f"request id {rid!r} already in flight")
+        adapter_id = int(adapter_id)
         head = self._head_key(prompt)
         eligible = self._eligible()
         if not eligible:
@@ -265,9 +272,11 @@ class FleetRouter:
         snaps = [policy.snapshot_replica(
             i, self.replicas[i], prompt, int(max_new_tokens),
             affinity_hit=self._affinity.get(head) == i,
+            adapter_id=adapter_id,
             health_state=self._health_state(i),
             canary=i in self._canary,
         ) for i in eligible]
+        hit_of = {s.index: s.tenant_hit for s in snaps}
         order = policy.rank_replicas(snaps)
         state = {"attempt": 0}
         rejections: list = []
@@ -276,8 +285,15 @@ class FleetRouter:
             ix = order[state["attempt"]]
             state["attempt"] += 1
             try:
-                self.replicas[ix].submit(rid, prompt, max_new_tokens,
-                                         deadline_s=deadline_s)
+                if adapter_id:
+                    self.replicas[ix].submit(rid, prompt, max_new_tokens,
+                                             deadline_s=deadline_s,
+                                             adapter_id=adapter_id)
+                else:
+                    # null-adapter traffic uses the pre-tenant call shape,
+                    # so fake/frozen replicas without the kwarg keep working
+                    self.replicas[ix].submit(rid, prompt, max_new_tokens,
+                                             deadline_s=deadline_s)
             except Exception as e:
                 if not _is_rejection(e):
                     raise
@@ -310,9 +326,14 @@ class FleetRouter:
         self.stats["rerouted"] += len(rejections)
         self.stats["routed"] += 1
         obs.inc("fleet_routed_total", replica=str(ix))
+        if adapter_id and hit_of.get(ix):
+            # the request landed where its adapter already lives — the
+            # tenant-affinity win the ranking key exists to produce
+            obs.inc("fleet_tenant_affinity_hits_total")
         rt = obs.reqtrace()
         if rt is not None:
-            rt.note(rid, "placed", replica=ix, reroutes=len(rejections))
+            rt.note(rid, "placed", replica=ix, reroutes=len(rejections),
+                    tenant=adapter_id)
         fr = obs.flight()
         if fr is not None:
             fr.record("router", "placed", rid=repr(rid), replica=ix,
@@ -320,7 +341,7 @@ class FleetRouter:
         self._note_affinity(head, ix)
         self._owner[rid] = ix
         self._requests[rid] = (tuple(int(t) for t in list(prompt)),
-                               int(max_new_tokens), deadline_s)
+                               int(max_new_tokens), deadline_s, adapter_id)
         self.routing_trace.append((rid, ix))
         if self.health is not None:
             self.health.note_placed(ix, rid)
@@ -440,7 +461,7 @@ class FleetRouter:
         finished: dict = {}
         still: list = []
         for rid, salvaged, kind in self._orphans:
-            prompt, budget, deadline_s = self._requests[rid]
+            prompt, budget, deadline_s, adapter_id = self._requests[rid]
             remaining = budget - len(salvaged)
             if remaining <= 0:
                 # the dead replica had already streamed the full budget;
@@ -450,7 +471,7 @@ class FleetRouter:
                 self._count_failover(kind, len(salvaged))
                 continue
             ix = self._place_orphan(rid, prompt, salvaged, remaining,
-                                    deadline_s)
+                                    deadline_s, adapter_id)
             if ix is None:
                 still.append((rid, salvaged, kind))
                 continue
@@ -466,7 +487,7 @@ class FleetRouter:
             obs.inc("fleet_failover_tokens_replayed_total", nr_replayed)
 
     def _place_orphan(self, rid, prompt, salvaged, remaining: int,
-                      deadline_s) -> int | None:
+                      deadline_s, adapter_id: int = 0) -> int | None:
         """Try to land one orphan on a surviving replica.  Preferred
         form: continuation — re-prefill ``prompt + salvaged`` and decode
         only the remaining budget (the salvaged tokens are replayed
@@ -479,7 +500,8 @@ class FleetRouter:
             return None
         snaps = [policy.snapshot_replica(
             i, self.replicas[i], prompt, remaining,
-            affinity_hit=False, health_state=self._health_state(i),
+            affinity_hit=False, adapter_id=adapter_id,
+            health_state=self._health_state(i),
             canary=i in self._canary,
         ) for i in eligible]
         for ix in policy.rank_replicas(snaps):
@@ -488,16 +510,17 @@ class FleetRouter:
             cont = tuple(prompt) + tuple(salvaged)
             try_cont = bool(salvaged) and (pw is None
                                            or len(cont) <= int(pw))
+            kw = {"adapter_id": adapter_id} if adapter_id else {}
             try:
                 if try_cont:
                     r.submit(rid, list(cont), remaining,
-                             deadline_s=deadline_s)
+                             deadline_s=deadline_s, **kw)
                     self._salvaged[rid] = list(salvaged)
                 else:
                     # full replay: drop the salvage, re-decode everything
                     r.submit(rid, list(prompt),
                              remaining + len(salvaged),
-                             deadline_s=deadline_s)
+                             deadline_s=deadline_s, **kw)
                     self._salvaged.pop(rid, None)
             except Exception as e:
                 if not _is_rejection(e):

@@ -1,0 +1,184 @@
+"""Multi-tenant adapter plane: federated LoRA rounds → live replica pools.
+
+This module closes the repo's train→serve loop for per-tenant
+personalization (ROADMAP item 2).  The pieces already exist on both
+sides — federated LoRA rounds over adapter subtrees
+(``fl.servers.FedLoRAAvgServer``: secagg over the low-rank factors, DP
+composing unchanged) and batched multi-LoRA serving
+(``models/serving.py`` ``adapter_slots=``, residency managed by
+``models/adapter_pool.AdapterPool``).  The
+:class:`TenantAdapterPlane` is the connective tissue:
+
+1. each FL cohort's round emits a per-tenant adapter
+   (``slice_adapter`` wire format: just the ``lora_A``/``lora_B``
+   leaves);
+2. :meth:`push_tenant_round` installs the new factors into the
+   plane-assigned stack slots of a COPY of the promoted params and
+   hands it to ``WeightPushPlane.push_round(kind="adapter")`` — the
+   bundle carries only the touched tenants' stacked slices, and the
+   push rides the existing canary/burn-gate/rollback machinery
+   unchanged (a bad adapter auto-rolls back, no request dropped);
+3. replicas rebuilt during the rollout come up with the new factors
+   already resident (``adapter_resident=plane.resident_map()``), and
+   the SHARED host store (``plane.store``) serves every later
+   residency miss at the newest promoted version;
+4. ``fleet_rollout_rounds_behind{tenant=...}`` measures train→serve
+   freshness per tenant end to end (the plane-level gauge keeps the
+   fleet aggregate).
+
+Replica factory contract (same ``make_replica(params, slot)`` shape as
+the rollout plane): build the batcher from the params handed in and
+forward the plane's shared state::
+
+    def make_replica(params, slot):
+        return ContinuousBatcher(cfg, params, ..., kv_layout="paged",
+                                 adapter_slots=plane.nr_slots,
+                                 adapter_store=plane.store,
+                                 adapter_resident=plane.resident_map())
+
+Like ``policy``/``router``, importing this module never imports jax —
+the factor-install work lazy-imports ``models.lora`` inside the push.
+"""
+
+from __future__ import annotations
+
+from .. import obs
+from .rollout import RolloutConfig, WeightPushPlane
+
+__all__ = ["TenantAdapterPlane"]
+
+
+class TenantAdapterPlane:
+    """Owns the tenant→slot assignment, the shared adapter store, and a
+    :class:`WeightPushPlane` over the STACKED base params.
+
+    ``base_params`` may be a plain kernel-only serving tree
+    (``merge_lora`` any per-module adapters first) or already stacked
+    (``lora.stack_adapter_params`` passes stacked trees through);
+    ``config`` must carry ``lora_rank > 0`` and is rewritten with
+    ``lora_slots=nr_slots`` for stacking.  Slot 0 stays the reserved
+    null adapter; the plane assigns tenants STABLE slots 1..N-1 in
+    registration order and refuses new tenants once full — per-replica
+    LRU eviction (the pool's job) handles transient pressure, but a
+    plane-level assignment that moved between pushes would make every
+    in-flight request's gather index a moving target.
+    """
+
+    def __init__(self, router, make_replica, base_params, config,
+                 nr_slots: int, *,
+                 rollout_config: RolloutConfig | None = None):
+        if nr_slots < 2:
+            raise ValueError(
+                f"nr_slots={nr_slots}: need slot 0 (the reserved null "
+                "adapter) plus at least one tenant slot")
+        import dataclasses
+
+        from ..models import lora
+
+        cfg = dataclasses.replace(config, lora_slots=int(nr_slots))
+        self.config = cfg
+        self.nr_slots = int(nr_slots)
+        self.store: dict = {}       # tenant -> (adapter, scale, round_ix)
+        self.slots: dict = {}       # tenant -> stable stack slot
+        self._latest: dict = {}     # tenant -> newest round submitted
+        self._serving: dict = {}    # tenant -> round the fleet serves
+        stacked = lora.stack_adapter_params(base_params, cfg)
+        self.plane = WeightPushPlane(router, make_replica, stacked,
+                                     config=rollout_config)
+        self.router = router
+
+    # -- assignment ------------------------------------------------------
+
+    def slot_of(self, tenant) -> int:
+        """The tenant's stable stack slot, assigning the next free one on
+        first sight; raises when every slot is taken."""
+        if tenant == 0:
+            raise ValueError("tenant 0 is the reserved null adapter")
+        s = self.slots.get(tenant)
+        if s is not None:
+            return s
+        used = set(self.slots.values())
+        for s in range(1, self.nr_slots):
+            if s not in used:
+                self.slots[tenant] = s
+                return s
+        raise ValueError(
+            f"all {self.nr_slots - 1} tenant slots assigned; raise "
+            "nr_slots (plane assignments are stable by design)")
+
+    def resident_map(self) -> dict:
+        """tenant -> slot of every adapter installed in the PROMOTED
+        params — what a freshly built replica seeds its pool with."""
+        return dict(self.slots)
+
+    # -- the closed loop: FL round -> bundle -> rollout -> pools ---------
+
+    def push_tenant_round(self, round_ix: int, tenant_adapters: dict,
+                          *, default_scale: float = 1.0) -> dict:
+        """Push one FL round's per-tenant adapters through the rollout
+        plane.  ``tenant_adapters`` maps ``tenant -> adapter`` or
+        ``tenant -> (adapter, scale)`` (``slice_adapter`` wire format).
+
+        The new factors are installed into the touched tenants' stack
+        slots of a copy of the promoted params; untouched tenants (and
+        the null slot) pass through bitwise, so the adapter bundle's
+        payload is only the changed stacked slices.  On promotion the
+        shared store advances to the new versions (so later residency
+        misses re-fetch the round that is actually serving); on
+        rollback the store, the freshness gauges, and any slot assigned
+        for a brand-new tenant this round all revert — the fleet keeps
+        serving the prior version everywhere.
+        """
+        from ..models import lora
+
+        if not tenant_adapters:
+            raise ValueError("push_tenant_round: no tenant adapters")
+        new_slots = [t for t in tenant_adapters if t not in self.slots]
+        norm = {}
+        for t, entry in tenant_adapters.items():
+            adapter, scale = (entry if isinstance(entry, tuple)
+                              else (entry, default_scale))
+            norm[t] = (adapter, float(scale), self.slot_of(t))
+        prev_latest = dict(self._latest)
+        for t in norm:
+            self._latest[t] = round_ix
+        new_params = self.plane.params
+        for t, (adapter, scale, slot) in sorted(norm.items(),
+                                                key=lambda kv: kv[1][2]):
+            new_params = lora.install_adapter(new_params, slot, adapter,
+                                              scale)
+        res = self.plane.push_round(round_ix, new_params, kind="adapter")
+        if res["outcome"] == "promoted":
+            for t, (adapter, scale, _slot) in norm.items():
+                self.store[t] = (adapter, scale, round_ix)
+                self._serving[t] = round_ix
+        else:
+            # the fleet still serves the prior version: forget this
+            # round's provisional state so freshness and slot assignment
+            # reflect what is actually live
+            self._latest = prev_latest
+            for t in new_slots:
+                self.slots.pop(t, None)
+        self._update_tenant_freshness()
+        return res
+
+    def _update_tenant_freshness(self) -> None:
+        """Per-tenant train→serve freshness, labelled alongside the
+        plane's fleet-aggregate ``fleet_rollout_rounds_behind``."""
+        if not obs.enabled():
+            return
+        for t, latest in self._latest.items():
+            serving = self._serving.get(t, -1)
+            obs.set_gauge("fleet_rollout_rounds_behind",
+                          max(0, latest - serving), tenant=str(t))
+
+    def describe(self) -> dict:
+        return {
+            "nr_slots": self.nr_slots,
+            "tenants": {t: {"slot": s,
+                            "serving_round": self._serving.get(t),
+                            "latest_round": self._latest.get(t)}
+                        for t, s in sorted(self.slots.items(),
+                                           key=lambda kv: kv[1])},
+            "plane": self.plane.describe(),
+        }

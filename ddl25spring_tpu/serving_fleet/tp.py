@@ -85,6 +85,15 @@ class TPShardedBatcher(ContinuousBatcher):
         W = int(mesh.shape[model_axis])
         kv_heads = config.nr_kv_heads or config.nr_heads
         if W > 1:
+            if kwargs.get("adapter_slots", 0):
+                raise NotImplementedError(
+                    "adapter_slots over a TP-sharded replica: the stacked "
+                    "LoRA factors need their own layout (lora_A "
+                    "replicated, lora_B sharded on the output axis like "
+                    "the dense kernel it corrects) plus a sharded "
+                    "install_adapter — multi-LoRA on the TP replica is "
+                    "future work; run adapter serving on single-shard "
+                    "replicas behind the fleet router for now")
             if kwargs.get("spill", "off") != "off":
                 raise NotImplementedError(
                     "spill='host' over a head-sharded pool: parking "

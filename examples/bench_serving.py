@@ -150,6 +150,16 @@ def main() -> int:
                          "seed=7'); the JSON gains a 'chaos' block with "
                          "goodput-under-chaos, failover counts and "
                          "tokens replayed")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="multi-LoRA tenants: add a multi-tenant "
+                         "contender that drives the same workload with "
+                         "per-request adapter_ids over N tenants "
+                         "(adapter_slots=N+1, rank-4 factors; paged "
+                         "only, docs/PERFORMANCE.md §multi-tenant)")
+    ap.add_argument("--tenant-skew", type=float, default=1.0,
+                    help="Zipf exponent for the tenant draw: p(t) ~ "
+                         "t^-skew, so higher = hotter tenant 1 (0 = "
+                         "uniform)")
     ap.add_argument("--arrival-dist", choices=("lognormal", "pareto"),
                     default="lognormal")
     ap.add_argument("--arrival-seed", type=int, default=0)
@@ -197,6 +207,12 @@ def main() -> int:
         dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
         else jnp.float32,
     )
+    if args.tenants and args.kv_layout != "paged":
+        raise SystemExit("--tenants needs --kv-layout paged (the adapter "
+                         "pool shares the paged pool's residency model)")
+    if args.tenants and args.sweep:
+        raise SystemExit("--tenants does not compose with --sweep yet; "
+                         "use the contender race")
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, args.vocab, size=int(n)).tolist()
                for n in rng.integers(4, args.prefill_width,
@@ -467,6 +483,73 @@ def _run_contenders(args, cfg, params, kv_kwargs, prompts, budgets,
         run_spec()  # warmup
         spec_s, _ = timed_median(run_spec)
 
+    # --- multi-tenant (batched multi-LoRA decode) ------------------------
+    # a separate batcher (its decode program threads the per-row adapter
+    # gather) drives the SAME workload twice: all-null (bitwise the base
+    # model — the in-cell baseline) then with skew-drawn tenant ids, so
+    # the ratio prices the gather + factor install churn, not compile
+    tenant_stats = {}
+    if args.tenants:
+        import dataclasses
+
+        from ddl25spring_tpu.models.lora import slice_adapter
+
+        tcfg = dataclasses.replace(cfg, lora_rank=4)
+        tbat = ContinuousBatcher(tcfg, params, max_batch=args.batch,
+                                 prefill_width=args.prefill_width,
+                                 decode_chunk=args.decode_chunk,
+                                 adapter_slots=args.tenants + 1,
+                                 **kv_kwargs)
+        wire = slice_adapter(Llama(tcfg).init(
+            jax.random.PRNGKey(2), jnp.ones((1, 4), jnp.int32),
+            positions=jnp.arange(4)))
+        leaves, treedef = jax.tree.flatten(wire)
+        for t in range(1, args.tenants + 1):
+            key = jax.random.PRNGKey(100 + t)
+            ad = jax.tree.unflatten(treedef, [
+                0.05 * jax.random.normal(jax.random.fold_in(key, i),
+                                         l.shape, l.dtype)
+                for i, l in enumerate(leaves)])
+            tbat.register_adapter(t, ad, scale=0.5)
+        prng = np.random.default_rng(args.arrival_seed)
+        w = np.arange(1, args.tenants + 1, dtype=np.float64) \
+            ** -args.tenant_skew
+        ids = prng.choice(np.arange(1, args.tenants + 1),
+                          size=args.requests, p=w / w.sum())
+        rid_base = [0]
+
+        def run_tenants(assign):
+            rid_base[0] += args.requests
+            base = rid_base[0]
+            done: dict = {}
+            for i, p in enumerate(prompts):
+                tbat.submit(base + i, p, int(budgets[i]),
+                            adapter_id=assign(i))
+            while len(done) < args.requests:
+                done.update(tbat.step())
+            return tbat
+
+        run_tenants(lambda i: 0)                    # warmup: null path
+        run_tenants(lambda i: int(ids[i]))          # warmup: installs
+        tnull_s, _ = timed_median(lambda: run_tenants(lambda i: 0))
+        pool0 = tbat._adapters.describe()
+        tmt_s, _ = timed_median(
+            lambda: run_tenants(lambda i: int(ids[i])))
+        pool1 = tbat._adapters.describe()
+        tenant_stats = {
+            "tenants": args.tenants,
+            "tenant_skew": args.tenant_skew,
+            "adapter_slots": args.tenants + 1,
+            "tenant_null_s": round(tnull_s, 3),
+            "tenant_null_tok_s": round(toks / tnull_s, 1),
+            "multi_tenant_s": round(tmt_s, 3),
+            "multi_tenant_tok_s": round(toks / tmt_s, 1),
+            "tenant_goodput_ratio": round(tnull_s / tmt_s, 3),
+            "adapter_misses": pool1["misses"] - pool0["misses"],
+            "adapter_evictions":
+                pool1["evictions"] - pool0["evictions"],
+        }
+
     occ = (batcher.stats["active_steps"]
            / max(batcher.stats["slot_steps"], 1))
     if args.telemetry:
@@ -492,6 +575,7 @@ def _run_contenders(args, cfg, params, kv_kwargs, prompts, budgets,
         **({"fused_spec_s": round(spec_s, 3),
             "fused_spec_tok_s": round(toks / spec_s, 1)}
            if spec_s is not None else {}),
+        **tenant_stats,
     }), flush=True)
     return 0
 

@@ -1,0 +1,218 @@
+"""Adapter residency pool oracle (models/adapter_pool.py).
+
+The pool is the KV page pool's residency model re-used one level up —
+slots instead of pages, tenants instead of streams — so its whole
+contract is host-checkable by value, no jax required:
+
+- slot 0 is reserved for the null adapter (acquire(0) never takes a
+  slot or a refcount),
+- a resident tenant's acquire is a HIT (no install); a cold tenant's
+  acquire is a MISS that hands back the store entry to install, after
+  LRU-evicting a cold unpinned victim when the pool is full,
+- refcounts and pins make a slot ineligible for eviction; with every
+  slot busy/pinned ``acquire`` returns None (the admission queues),
+- ``adapter_bytes`` is the analytic HBM cost of the stacks, linear in
+  the slot count and zero whenever rank or slots are zero.
+"""
+
+import dataclasses
+
+import pytest
+
+from ddl25spring_tpu.models.adapter_pool import AdapterPool, adapter_bytes
+from ddl25spring_tpu.models.llama import LlamaConfig
+
+
+def _pool(nr_slots=3, tenants=()):
+    pool = AdapterPool(nr_slots)
+    for t in tenants:
+        pool.put(t, {"fake": t}, 1.0, round_ix=0)
+    return pool
+
+
+# -- construction & registration -------------------------------------------
+
+
+@pytest.mark.parametrize("bad", [0, 1, -2])
+def test_pool_needs_null_plus_one_tenant_slot(bad):
+    with pytest.raises(ValueError, match="slot 0"):
+        AdapterPool(bad)
+
+
+def test_put_rejects_the_null_tenant():
+    with pytest.raises(ValueError, match="reserved null adapter"):
+        _pool().put(0, {"fake": 0}, 1.0)
+
+
+def test_acquire_unregistered_tenant_raises():
+    with pytest.raises(KeyError, match="not registered"):
+        _pool().acquire(9)
+
+
+def test_null_adapter_needs_no_slot_and_no_refcount():
+    pool = _pool()
+    assert pool.acquire(0) == (0, None)
+    assert pool.describe()["refs"] == {}
+    pool.release(0)                                # no-op, never raises
+    assert pool.can_admit(0)
+
+
+# -- hit / miss / refcount flow --------------------------------------------
+
+
+def test_cold_acquire_is_a_miss_that_hands_back_the_store_entry():
+    pool = _pool(tenants=[1])
+    slot, entry = pool.acquire(1)
+    assert slot == 1
+    assert entry == ({"fake": 1}, 1.0, 0)          # caller must install
+    assert (pool.misses, pool.installs, pool.evictions) == (1, 1, 0)
+    # second stream on the same tenant: a hit, nothing to install
+    slot2, entry2 = pool.acquire(1)
+    assert (slot2, entry2) == (1, None)
+    assert pool.misses == 1
+    assert pool.describe()["refs"] == {1: 2}
+    pool.release(1)
+    pool.release(1)
+    assert pool.describe()["refs"] == {}
+    assert pool.resident(1)                        # release keeps residency
+
+
+def test_release_errors():
+    pool = _pool(tenants=[1])
+    with pytest.raises(ValueError, match="not resident"):
+        pool.release(1)                            # never acquired
+    pool.acquire(1)
+    pool.release(1)
+    with pytest.raises(ValueError, match="refcount"):
+        pool.release(1)                            # refcount already zero
+
+
+# -- eviction: LRU over cold unpinned slots --------------------------------
+
+
+def test_lru_eviction_of_the_coldest_tenant():
+    pool = _pool(3, tenants=[1, 2, 3])             # 2 tenant slots
+    pool.acquire(1)
+    pool.acquire(2)
+    pool.release(1)
+    pool.release(2)
+    pool.acquire(1)                                # touch 1: now 2 is LRU
+    pool.release(1)
+    slot, entry = pool.acquire(3)
+    assert slot == pool.slot_of(3)
+    assert entry == ({"fake": 3}, 1.0, 0)
+    assert not pool.resident(2)                    # the LRU victim
+    assert pool.resident(1)
+    assert pool.evictions == 1
+    # the evicted tenant's return is itself a miss (re-fetch + install)
+    misses0 = pool.misses
+    pool.release(3)
+    _, entry = pool.acquire(2)
+    assert entry is not None
+    assert pool.misses == misses0 + 1
+
+
+def test_busy_slots_are_not_evictable():
+    pool = _pool(3, tenants=[1, 2, 3])
+    pool.acquire(1)
+    pool.acquire(2)                                # both slots refcounted
+    assert not pool.can_admit(3)
+    assert pool.acquire(3) is None                 # admission stays queued
+    assert not pool.resident(3)
+    pool.release(2)
+    assert pool.can_admit(3)
+    slot, entry = pool.acquire(3)                  # evicts cold 2, not busy 1
+    assert entry is not None
+    assert pool.resident(1) and not pool.resident(2)
+
+
+def test_pin_exempts_from_eviction_and_unpin_restores():
+    pool = _pool(3, tenants=[1, 2, 3])
+    pool.acquire(1)
+    pool.release(1)
+    pool.acquire(2)
+    pool.release(2)                                # 1 is LRU and cold
+    pool.pin(1)
+    pool.acquire(3)                                # must evict 2, not pinned 1
+    assert pool.resident(1) and not pool.resident(2)
+    pool.release(3)
+    pool.pin(3)
+    assert pool.acquire(2) is None                 # everything pinned
+    pool.unpin(3)
+    assert pool.acquire(2) is not None
+    with pytest.raises(ValueError, match="not resident"):
+        pool.pin(9)
+    pool.unpin(9)                                  # unpin is forgiving
+
+
+# -- seeding (rollout-plane replicas come up pre-installed) ----------------
+
+
+def test_seed_marks_resident_without_an_install():
+    pool = _pool(3, tenants=[1])
+    pool.seed(1, 2)
+    assert pool.slot_of(1) == 2
+    assert pool.installs == 0
+    slot, entry = pool.acquire(1)
+    assert (slot, entry) == (2, None)              # a hit, nothing installed
+    assert pool.misses == 0
+
+
+def test_seed_conflicts_raise():
+    pool = _pool(4)
+    pool.seed(1, 1)
+    with pytest.raises(ValueError, match="already resident"):
+        pool.seed(1, 2)                            # tenant already resident
+    with pytest.raises(ValueError, match="already resident"):
+        pool.seed(2, 1)                            # slot already taken
+    with pytest.raises(ValueError, match="out of range"):
+        pool.seed(3, 0)                            # the null slot
+    with pytest.raises(ValueError, match="out of range"):
+        pool.seed(3, 4)
+
+
+def test_describe_is_the_full_residency_picture():
+    pool = _pool(3, tenants=[1, 2])
+    pool.acquire(1)
+    pool.pin(1)
+    d = pool.describe()
+    assert d == {"nr_slots": 3, "resident": {1: 1}, "refs": {1: 1},
+                 "pinned": [1], "store_tenants": [1, 2],
+                 "misses": 1, "evictions": 0, "installs": 1}
+
+
+# -- adapter_bytes: the analytic HBM cost ----------------------------------
+
+CFG = LlamaConfig(vocab_size=128, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                  nr_layers=2, ctx_size=48)
+
+
+def test_adapter_bytes_zero_without_rank_or_slots():
+    assert adapter_bytes(CFG) == 0                          # lora_slots=0
+    assert adapter_bytes(CFG, nr_slots=4) == 0              # lora_rank=0
+    lora = dataclasses.replace(CFG, lora_rank=4)
+    assert adapter_bytes(lora) == 0
+    assert adapter_bytes(lora, nr_slots=0) == 0
+
+
+def test_adapter_bytes_matches_the_site_list_by_hand():
+    r, n = 4, 3
+    lora = dataclasses.replace(CFG, lora_rank=r)
+    d = CFG.dmodel
+    kv = CFG.kv_heads * CFG.head_dim
+    h = CFG.hidden_dim
+    sites = [(d, d), (d, kv), (d, kv), (d, d),
+             (d, h), (d, h), (h, d)] * CFG.nr_layers
+    sites.append((d, CFG.vocab_size))
+    want = n * sum(r * (i + o) * 4 + 4 for i, o in sites)
+    assert adapter_bytes(lora, nr_slots=n) == want
+    # config-carried lora_slots is the default slot count
+    stacked = dataclasses.replace(lora, lora_slots=n)
+    assert adapter_bytes(stacked) == want
+
+
+def test_adapter_bytes_linear_in_slots_and_itemsize():
+    lora = dataclasses.replace(CFG, lora_rank=8)
+    one = adapter_bytes(lora, nr_slots=1)
+    assert adapter_bytes(lora, nr_slots=5) == 5 * one
+    assert adapter_bytes(lora, nr_slots=2, itemsize=2) == one  # bf16 halves

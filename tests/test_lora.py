@@ -153,3 +153,69 @@ def test_lora_on_imported_hf_weights():
 def test_int8_lora_rejected():
     with pytest.raises(ValueError, match="mutually exclusive"):
         dataclasses.replace(BASE, lora_rank=4, weights_int8=True)
+
+
+# -- adapter wire format: slice / apply round trips ------------------------
+
+
+def test_slice_adapter_keeps_only_the_factors(models):
+    from ddl25spring_tpu.models.lora import slice_adapter
+
+    _, lora, _ = models
+    wire = slice_adapter(lora)
+
+    def leaves(tree, path=""):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                yield from leaves(v, f"{path}/{k}")
+            else:
+                yield f"{path}/{k}"
+
+    names = list(leaves(wire))
+    assert names and all(p.endswith(("/lora_A", "/lora_B")) for p in names)
+    assert not any("kernel" in p for p in names)    # no dense weights leak
+
+
+def test_slice_apply_round_trip_is_byte_identical(models):
+    from ddl25spring_tpu.models.lora import apply_adapter, slice_adapter
+
+    _, lora, _ = models
+    back = apply_adapter(lora, slice_adapter(lora))
+    flat_a, td_a = jax.tree.flatten(lora)
+    flat_b, td_b = jax.tree.flatten(back)
+    assert td_a == td_b
+    for a, b in zip(flat_a, flat_b):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    # and slicing the applied tree reproduces the wire bytes too
+    wire = slice_adapter(lora)
+    again = slice_adapter(apply_adapter(lora, wire))
+    for a, b in zip(jax.tree.leaves(wire), jax.tree.leaves(again)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_apply_adapter_error_paths(models):
+    from ddl25spring_tpu.models.lora import apply_adapter, slice_adapter
+
+    base, lora, _ = models
+    wire = slice_adapter(lora)
+    with pytest.raises(ValueError, match="not a LoRA site"):
+        apply_adapter(base, wire)                  # rank/config mismatch
+    bad = {"params": {"nope": {
+        "lora_A": np.zeros((2, 2), np.float32)}}}
+    with pytest.raises(ValueError, match="not in base params"):
+        apply_adapter(lora, bad)
+
+
+def test_stack_refuses_unmerged_per_module_adapters(models):
+    from ddl25spring_tpu.models.lora import (install_adapter,
+                                             stack_adapter_params)
+
+    base, lora, _ = models
+    cfg = dataclasses.replace(LORA, lora_slots=2)
+    with pytest.raises(ValueError, match="merge_lora them before"):
+        stack_adapter_params(lora, cfg)
+    stacked = stack_adapter_params(base, cfg)
+    # stacking is idempotent: an already-stacked tree passes through
+    assert stack_adapter_params(stacked, cfg) is not None
+    with pytest.raises(ValueError, match="reserved null"):
+        install_adapter(stacked, 0, {}, 1.0)

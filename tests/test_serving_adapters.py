@@ -1,0 +1,262 @@
+"""Batched multi-LoRA serving oracle (models/serving.py adapter_slots).
+
+The adapter path's contract is exactness, checked by value against the
+two references that already exist:
+
+- ``adapter_id=0`` (the reserved null adapter) streams BIT-IDENTICAL to
+  the plain paged batcher — the zero factor stacks may add work, never
+  bits,
+- a tenant's stream equals ``merge_lora`` of its adapter served
+  offline (``models.generate``) token for token — single-tenant, mixed
+  batches, and across evict/re-fetch cycles alike,
+- residency is the KV pool's discipline one level up: a cold tenant's
+  admission waits for a slot, eviction is LRU over cold slots, and a
+  re-fetch re-installs from the host store with no drift,
+- the TP-sharded replica REFUSES adapter slots (the stacked gather is
+  not head-split yet) instead of silently serving the base model,
+- the router prefers replicas whose pool already holds the tenant
+  (``fleet_tenant_affinity_hits_total``).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu import obs
+from ddl25spring_tpu.models.generate import generate
+from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+from ddl25spring_tpu.models.lora import (
+    apply_adapter,
+    install_adapter,
+    merge_lora,
+    slice_adapter,
+    stack_adapter_params,
+)
+from ddl25spring_tpu.models.serving import ContinuousBatcher
+from ddl25spring_tpu.serving_fleet import FleetRouter, TPShardedBatcher
+
+CFG = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                  nr_layers=2, ctx_size=48)
+LORA = dataclasses.replace(CFG, lora_rank=4)
+# serving parity with merge_lora needs the training-time alpha/r scale
+SCALE = LORA.lora_alpha / LORA.lora_rank
+PAGED = {"kv_layout": "paged", "kv_page": 8}
+BUDGETS = [6, 5, 4, 6, 3]
+
+
+@pytest.fixture
+def clean_obs():
+    yield
+    obs.disable()
+
+
+def _adapt(base_params, lora_params):
+    """Copy the base kernels into a freshly initialised LoRA tree."""
+
+    def graft(lp, bp):
+        out = {}
+        for k, v in lp.items():
+            if isinstance(v, dict) and "lora_A" in v:
+                out[k] = dict(v, kernel=bp[k]["kernel"])
+            elif isinstance(v, dict):
+                out[k] = graft(v, bp[k])
+            else:
+                out[k] = bp[k]
+        return out
+
+    return {"params": graft(lora_params["params"], base_params["params"])}
+
+
+@pytest.fixture(scope="module")
+def setup():
+    """Base params, three tenants' wire adapters, and their merge_lora
+    twins (the offline parity oracle)."""
+    prompt = jnp.ones((1, 4), jnp.int32)
+    base = Llama(CFG).init(jax.random.PRNGKey(0), prompt,
+                           positions=jnp.arange(4))
+    lora_tree = _adapt(base, Llama(LORA).init(jax.random.PRNGKey(1), prompt,
+                                              positions=jnp.arange(4)))
+    leaves, treedef = jax.tree.flatten(slice_adapter(lora_tree))
+    wires, merged = {}, {}
+    for t in (1, 2, 3):
+        key = jax.random.PRNGKey(40 + t)
+        wires[t] = jax.tree.unflatten(treedef, [
+            0.1 * jax.random.normal(jax.random.fold_in(key, i),
+                                    leaf.shape, leaf.dtype)
+            for i, leaf in enumerate(leaves)])
+        merged[t] = merge_lora(apply_adapter(lora_tree, wires[t]), LORA)
+    return base, wires, merged
+
+
+def _prompts(seed=3, sizes=(3, 7, 4, 8, 5)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 97, size=n).tolist() for n in sizes]
+
+
+def _offline(params, prompt, budget):
+    """Greedy models.generate reference for one request (call shape kept
+    identical to test_serving's _oracle so the jit cache is shared)."""
+    out = generate(CFG, params, jnp.asarray([prompt], jnp.int32), budget)
+    return np.asarray(out)[0, len(prompt):len(prompt) + budget].tolist()
+
+
+def _mkbat(params, slots, **kw):
+    return ContinuousBatcher(LORA, params, max_batch=2, prefill_width=8,
+                             adapter_slots=slots, **PAGED, **kw)
+
+
+def _stream_all(batcher, prompts, budgets, tenants=None):
+    tenants = tenants or [0] * len(prompts)
+    for rid, (p, b, t) in enumerate(zip(prompts, budgets, tenants)):
+        batcher.submit(rid, p, b, adapter_id=t)
+    out = {}
+    while batcher.in_flight:
+        out.update(batcher.step())
+    return {rid: list(map(int, toks)) for rid, toks in out.items()}
+
+
+# -- constructor contract --------------------------------------------------
+
+
+def test_ctor_validation_matrix(setup):
+    base, _, _ = setup
+    with pytest.raises(ValueError, match="slot 0"):
+        _mkbat(base, slots=1)
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(LORA, base, max_batch=2, adapter_slots=2)
+    with pytest.raises(ValueError, match="lora_rank"):
+        ContinuousBatcher(CFG, base, max_batch=2, adapter_slots=2, **PAGED)
+    with pytest.raises(ValueError, match="prefix"):
+        _mkbat(base, slots=2, prefix=("dummy",))
+    with pytest.raises(NotImplementedError, match="spill"):
+        _mkbat(base, slots=2, spill="host")
+    with pytest.raises(ValueError, match="adapter_slots > 0"):
+        ContinuousBatcher(CFG, base, max_batch=2, **PAGED,
+                          adapter_store={1: None})
+
+
+def test_tp_sharded_replica_refuses_adapters(setup):
+    """W>1 refuses the feature rather than mis-serve it (W=1 is the
+    plain batcher, where adapters work — test_null_adapter...)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    base, _, _ = setup
+    with pytest.raises(NotImplementedError, match="TP-sharded"):
+        TPShardedBatcher(LORA, base, tp_world=2, max_batch=2,
+                         prefill_width=8, adapter_slots=2, **PAGED)
+
+
+def test_submit_guards(setup):
+    base, wires, _ = setup
+    plain = ContinuousBatcher(CFG, base, max_batch=2, prefill_width=8,
+                              **PAGED)
+    with pytest.raises(ValueError, match="no adapter pool"):
+        plain.submit(0, [1, 2], 2, adapter_id=1)
+    with pytest.raises(ValueError, match="no adapter pool"):
+        plain.register_adapter(1, wires[1])
+    bat = _mkbat(base, slots=2)
+    with pytest.raises(KeyError, match="not registered"):
+        bat.submit(0, [1, 2], 2, adapter_id=5)
+    assert bat.adapter_resident(0)                 # null: always resident
+    bat.register_adapter(1, wires[1], scale=SCALE)
+    assert not bat.adapter_resident(1)             # in store, not installed
+
+
+# -- exactness oracles -----------------------------------------------------
+
+
+def test_null_adapter_bitwise_identical_to_plain_batcher(setup):
+    base, _, _ = setup
+    prompts = _prompts()
+    plain = ContinuousBatcher(CFG, base, max_batch=2, prefill_width=8,
+                              **PAGED)
+    ad = _mkbat(base, slots=3)
+    assert _stream_all(plain, prompts, BUDGETS) == \
+        _stream_all(ad, prompts, BUDGETS)
+    assert ad._pool.pages_in_use == 0
+
+
+def test_single_tenant_matches_merge_lora_offline(setup):
+    base, wires, merged = setup
+    bat = _mkbat(base, slots=3)
+    bat.register_adapter(1, wires[1], scale=SCALE)
+    prompts = _prompts(seed=5, sizes=(4, 7, 3))
+    done = _stream_all(bat, prompts, [4, 5, 6], tenants=[1, 1, 1])
+    for rid, p in enumerate(prompts):
+        assert done[rid] == _offline(merged[1], p, [4, 5, 6][rid]), rid
+    assert bat._adapters.describe()["misses"] == 1  # one install, then hits
+
+
+def test_mixed_tenant_batch_matches_each_twin(setup):
+    base, wires, merged = setup
+    bat = _mkbat(base, slots=3)                    # both tenants resident
+    for t in (1, 2):
+        bat.register_adapter(t, wires[t], scale=SCALE)
+    prompts = _prompts(seed=7)
+    tenants = [0, 1, 2, 1, 2]
+    done = _stream_all(bat, prompts, BUDGETS, tenants=tenants)
+    for rid, (p, b, t) in enumerate(zip(prompts, BUDGETS, tenants)):
+        want = _offline(base if t == 0 else merged[t], p, b)
+        assert done[rid] == want, (rid, t)
+    assert bat._adapters.describe()["evictions"] == 0
+
+
+def test_evict_and_refetch_cycles_stay_exact(setup):
+    base, wires, merged = setup
+    bat = _mkbat(base, slots=3)                    # 2 tenant slots, 3 tenants
+    for t in (1, 2, 3):
+        bat.register_adapter(t, wires[t], scale=SCALE)
+    order = [1, 2, 3, 1, 3, 2]
+    prompts = _prompts(seed=11, sizes=(4, 4, 4, 4, 4, 4))
+    for rid, (t, p) in enumerate(zip(order, prompts)):
+        bat.submit(rid, p, 4, adapter_id=t)
+        done = {}
+        while bat.in_flight:                       # serial: force cold slots
+            done.update(bat.step())
+        assert done[rid] == _offline(merged[t], p, 4), (rid, t)
+    d = bat._adapters.describe()
+    assert d["misses"] >= 4 and d["evictions"] >= 2
+    assert d["misses"] == d["installs"]
+
+
+def test_seeded_replica_serves_preinstalled_factors(setup):
+    """The rollout-plane shape: params arrive pre-stacked with the
+    factors installed, adapter_resident= seeds the pool — no store
+    round-trip, no install, still exact."""
+    base, wires, merged = setup
+    cfg = dataclasses.replace(LORA, lora_slots=3)
+    params = install_adapter(stack_adapter_params(base, cfg), 1,
+                             wires[1], SCALE)
+    bat = _mkbat(params, slots=3, adapter_resident={1: 1})
+    assert bat.adapter_resident(1)
+    p = _prompts(seed=13, sizes=(5,))[0]
+    done = _stream_all(bat, [p], [3], tenants=[1])
+    assert done[0] == _offline(merged[1], p, 3)
+    assert bat._adapters.describe()["misses"] == 0
+
+
+# -- fleet routing: tenant affinity ----------------------------------------
+
+
+def test_router_prefers_replica_with_resident_tenant(setup, clean_obs):
+    t = obs.enable()
+    base, wires, merged = setup
+    a = _mkbat(base, slots=3)
+    b = _mkbat(base, slots=3)
+    for bat in (a, b):
+        bat.register_adapter(1, wires[1], scale=SCALE)
+    # make tenant 1 RESIDENT on b only
+    done = _stream_all(b, [[5, 9]], [2], tenants=[1])
+    assert b.adapter_resident(1) and not a.adapter_resident(1)
+    router = FleetRouter([a, b])
+    p = _prompts(seed=17, sizes=(4,))[0]
+    router.submit(0, p, 4, adapter_id=1)
+    assert b.in_flight == 1 and a.in_flight == 0   # affinity won placement
+    assert t.counter("fleet_tenant_affinity_hits_total").value == 1
+    out = {}
+    while router.in_flight:
+        out.update(router.step())
+    assert list(map(int, out[0])) == _offline(merged[1], p, 4)

@@ -1,0 +1,430 @@
+"""Multi-tenant adapter plane oracle (serving_fleet/tenants.py).
+
+The plane is host bookkeeping over machinery proven elsewhere (the
+rollout plane's canary/rollback, the batcher's multi-LoRA decode), so
+its own contract splits cleanly:
+
+- slot assignment is STABLE and bounded (fake-replica tests: a tenant
+  keeps its slot across rounds, the plane refuses tenants beyond
+  nr_slots - 1, a rolled-back round reverts the store, the freshness
+  gauges, and any slot it provisionally assigned — with zero dropped
+  requests under live load),
+- and the loop closes END TO END (real model): a seeded federated LoRA
+  round (secagg ON, DP ON) over two tenant cohorts emits per-tenant
+  adapters, ``push_tenant_round`` rolls them through the canary into a
+  live two-replica fleet mid-decode without dropping the in-flight
+  requests, and each tenant's post-swap tokens equal its adapter
+  ``merge_lora``-d and served offline — while null-adapter streams stay
+  bitwise the base model throughout.
+"""
+
+import dataclasses
+import itertools
+
+import numpy as np
+import pytest
+
+from ddl25spring_tpu import obs
+from ddl25spring_tpu.models.llama import LlamaConfig
+from ddl25spring_tpu.serving_fleet import (FleetHealth, FleetRouter,
+                                           RolloutConfig,
+                                           TenantAdapterPlane, version_of)
+
+# -- fakes (test_rollout.py's versioned streaming replica, condensed) ------
+
+
+class _Slot:
+    free = False
+
+    def __init__(self, rid, budget, ctx):
+        self.request_id = rid
+        self.budget = budget
+        self.ctx = list(ctx)
+        self.emitted = []
+
+
+class _Fake:
+    """Streaming fake whose token fn depends on its params' ``w`` leaf —
+    adapter installs leave ``w`` alone, so every version streams the
+    same bits (exactly what a zero-drop rollback must preserve)."""
+
+    def __init__(self, params, max_batch=4):
+        self.offset = int(np.asarray(params["w"]).sum()) % 997
+        self.max_batch = max_batch
+        self.prefill_width = 4096
+        self._queue = []
+        self.slots = []
+
+    @property
+    def in_flight(self):
+        return len(self._queue) + len(self.slots)
+
+    def submit(self, rid, prompt, budget, deadline_s=None, **kw):
+        self._queue.append((rid, list(prompt), int(budget)))
+
+    def step(self):
+        while self._queue and len(self.slots) < self.max_batch:
+            rid, prompt, b = self._queue.pop(0)
+            self.slots.append(_Slot(rid, b, prompt))
+        done = {}
+        for sl in list(self.slots):
+            tok = (sum(sl.ctx) + 7 * len(sl.ctx) + self.offset) % 997
+            sl.ctx.append(tok)
+            sl.emitted.append(tok)
+            if len(sl.emitted) >= sl.budget:
+                done[sl.request_id] = list(sl.emitted)
+                self.slots.remove(sl)
+        return done
+
+
+def _stream(prompt, budget, offset):
+    ctx, out = list(prompt), []
+    for _ in range(budget):
+        tok = (sum(ctx) + 7 * len(ctx) + offset) % 997
+        ctx.append(tok)
+        out.append(tok)
+    return out
+
+
+class _Reject(RuntimeError):
+    def __init__(self):
+        super().__init__("canary_sick")
+        self.reason = "canary_sick"
+        self.retry_after_s = 0.01
+
+
+class _RejectingFake(_Fake):
+    def submit(self, rid, prompt, budget, deadline_s=None, **kw):
+        raise _Reject()
+
+
+@pytest.fixture
+def clean_obs():
+    yield
+    obs.uninstall_flight()
+    obs.uninstall_reqtrace()
+    obs.uninstall_recorder()
+    obs.disable()
+
+
+# a config-shaped tree small enough that the plane's stacking/install
+# work is trivially cheap (the fakes never run the model)
+TINY = LlamaConfig(vocab_size=16, dmodel=4, nr_heads=1, nr_layers=1,
+                   ctx_size=8, lora_rank=2)
+
+
+def _tiny_base():
+    return {"params": {"dense": {"kernel": np.arange(16, dtype=np.float32)
+                                 .reshape(4, 4)}},
+            "w": np.arange(8, dtype=np.float32)}
+
+
+def _wire(seed):
+    rng = np.random.default_rng(seed)
+    return {"params": {"dense": {
+        "lora_A": rng.standard_normal((4, 2)).astype(np.float32),
+        "lora_B": rng.standard_normal((2, 4)).astype(np.float32)}}}
+
+
+def _mk(params, slot):
+    return _Fake(params)
+
+
+# -- slot assignment -------------------------------------------------------
+
+
+def test_plane_needs_a_tenant_slot():
+    with pytest.raises(ValueError, match="slot 0"):
+        TenantAdapterPlane(None, _mk, _tiny_base(), TINY, 1)
+
+
+def test_slot_assignment_stable_and_bounded():
+    router = FleetRouter([_Fake({"w": np.zeros(1)})])
+    plane = TenantAdapterPlane(router, _mk, _tiny_base(), TINY, 3)
+    with pytest.raises(ValueError, match="reserved null"):
+        plane.slot_of(0)
+    assert plane.slot_of("acme") == 1
+    assert plane.slot_of("globex") == 2
+    assert plane.slot_of("acme") == 1              # stable on re-ask
+    with pytest.raises(ValueError, match="slots assigned"):
+        plane.slot_of("initech")
+    assert plane.resident_map() == {"acme": 1, "globex": 2}
+
+
+def test_push_without_adapters_raises():
+    router = FleetRouter([_Fake({"w": np.zeros(1)})])
+    plane = TenantAdapterPlane(router, _mk, _tiny_base(), TINY, 2)
+    with pytest.raises(ValueError, match="no tenant adapters"):
+        plane.push_tenant_round(1, {})
+
+
+# -- promotion advances the store, rollback reverts it ---------------------
+
+
+def test_promoted_round_advances_store_and_freshness(clean_obs):
+    t = obs.enable()
+    base = _tiny_base()
+    router = FleetRouter([_Fake(base) for _ in range(2)])
+    plane = TenantAdapterPlane(router, _mk, base, TINY, 3,
+                               rollout_config=RolloutConfig(canary_ticks=2))
+    res = plane.push_tenant_round(1, {7: _wire(1), 8: (_wire(2), 2.0)})
+    assert res["outcome"] == "promoted"
+    assert plane.slots == {7: 1, 8: 2}
+    _, scale7, round7 = plane.store[7]
+    assert (scale7, round7) == (1.0, 1)            # default_scale
+    assert plane.store[8][1] == 2.0                # explicit (adapter, scale)
+    assert t.gauge("fleet_rollout_rounds_behind", tenant="7").value == 0
+    assert t.gauge("fleet_rollout_rounds_behind", tenant="8").value == 0
+    # round 2 touches tenant 7 only: slot stays, 8's version untouched
+    res2 = plane.push_tenant_round(2, {7: _wire(3)})
+    assert res2["outcome"] == "promoted"
+    assert plane.slots == {7: 1, 8: 2}
+    assert plane.store[7][2] == 2 and plane.store[8][2] == 1
+    d = plane.describe()
+    assert d["tenants"][7] == {"slot": 1, "serving_round": 2,
+                               "latest_round": 2}
+    assert d["tenants"][8] == {"slot": 2, "serving_round": 1,
+                               "latest_round": 1}
+    assert d["plane"]["serving_round"] == 2
+
+
+def test_bad_adapter_round_rolls_back_store_slots_and_streams(clean_obs):
+    """A sick canary (every admission rejects) under live load: the burn
+    gate rolls the round back, the plane reverts the store, the
+    provisional slot for the round's NEW tenant, and the freshness
+    gauges — and no request is dropped along the way."""
+    t = obs.enable()
+    base = _tiny_base()
+    router = FleetRouter([_Fake(base) for _ in range(2)],
+                         health=FleetHealth(2))
+    good, state = set(), {}
+
+    def mk(params, slot):
+        if state.get("arm") and version_of(params) not in good:
+            return _RejectingFake(params)
+        return _Fake(params)
+
+    plane = TenantAdapterPlane(router, mk, base, TINY, 3,
+                               rollout_config=RolloutConfig(canary_ticks=64))
+    good.add(plane.plane.version)
+    res1 = plane.push_tenant_round(1, {7: _wire(1)})
+    assert res1["outcome"] == "promoted"
+    good.add(plane.plane.version)
+    v1 = plane.plane.version
+    off = _Fake(base).offset
+
+    # arm the failure and keep live load flowing: one submit per router
+    # step, exactly the cadence the blocking push drives internally
+    state["arm"] = True
+    rids = itertools.count(100)
+    prompts = {}
+    orig_step = router.step
+
+    def step_with_traffic():
+        rid = next(rids)
+        if rid < 140:
+            p = [2 + rid % 5, 11]
+            try:
+                router.submit(rid, p, 4)
+                prompts[rid] = p
+            except Exception:
+                pass
+        return orig_step()
+
+    router.step = step_with_traffic
+    res2 = plane.push_tenant_round(2, {7: _wire(4), 8: _wire(5)})
+    router.step = orig_step
+
+    assert res2["outcome"] == "rolled_back"
+    ctrl = res2["controller"]
+    assert ctrl.rollback_reason.startswith("burn_gate:")
+    # the plane forgot the round: store, new-tenant slot, freshness
+    assert plane.store[7][2] == 1 and 8 not in plane.store
+    assert plane.slots == {7: 1}
+    assert plane.plane.version == v1
+    assert t.gauge("fleet_rollout_rounds_behind", tenant="7").value == 0
+    # zero drops: every submitted request finished with the old bits
+    done = dict(res2["finished"])
+    while router.in_flight:
+        done.update(router.step())
+    assert sorted(done) == sorted(prompts)
+    for rid, p in prompts.items():
+        assert list(done[rid]) == _stream(p, 4, off), rid
+    # and the next good round goes through on the reverted fleet
+    state.clear()
+    res3 = plane.push_tenant_round(3, {8: _wire(6)})
+    assert res3["outcome"] == "promoted"
+    assert plane.slots == {7: 1, 8: 2}
+
+
+# -- the loop, closed end to end (real model) ------------------------------
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ddl25spring_tpu.data.split import stack_client_datasets  # noqa: E402
+from ddl25spring_tpu.fl.servers import FedLoRAAvgServer  # noqa: E402
+from ddl25spring_tpu.fl.task import Task  # noqa: E402
+from ddl25spring_tpu.models.generate import generate  # noqa: E402
+from ddl25spring_tpu.models.llama import Llama  # noqa: E402
+from ddl25spring_tpu.models.lora import (apply_adapter,  # noqa: E402
+                                         merge_lora, stack_adapter_params)
+from ddl25spring_tpu.models.serving import ContinuousBatcher  # noqa: E402
+from ddl25spring_tpu.secagg.protocol import SecAgg  # noqa: E402
+
+CFG = LlamaConfig(vocab_size=97, dmodel=48, nr_heads=4, nr_kv_heads=2,
+                  nr_layers=2, ctx_size=48)
+LORA = dataclasses.replace(CFG, lora_rank=4)
+SCALE = LORA.lora_alpha / LORA.lora_rank
+NR_SLOTS = 3
+
+
+def _graft(base_params, lora_params):
+    def walk(lp, bp):
+        out = {}
+        for k, v in lp.items():
+            if isinstance(v, dict) and "lora_A" in v:
+                out[k] = dict(v, kernel=bp[k]["kernel"])
+            elif isinstance(v, dict):
+                out[k] = walk(v, bp[k])
+            else:
+                out[k] = bp[k]
+        return out
+
+    return {"params": walk(lora_params["params"], base_params["params"])}
+
+
+@pytest.fixture(scope="module")
+def trees():
+    prompt = jnp.ones((1, 4), jnp.int32)
+    base = Llama(CFG).init(jax.random.PRNGKey(0), prompt,
+                           positions=jnp.arange(4))
+    lora_tree = _graft(base, Llama(LORA).init(jax.random.PRNGKey(1), prompt,
+                                              positions=jnp.arange(4)))
+    return base, lora_tree
+
+
+def _cohort_data(seed):
+    """4 clients x 4 next-token samples (sequence, final-token label)."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(1, 97, size=(16, 8)).astype(np.int32)
+    y = rng.integers(0, 97, size=(16,)).astype(np.int32)
+    subsets = [np.arange(i * 4, (i + 1) * 4) for i in range(4)]
+    return stack_client_datasets(x, y, subsets, pad_multiple=2)
+
+
+def _lm_task(lora_tree, seed):
+    model = Llama(LORA)
+
+    def loss_fn(params, x, y, mask, key):
+        logp = jax.nn.log_softmax(model.apply(params, x)[:, -1, :])
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+    def score_fn(params, x):
+        return model.apply(params, x)[:, -1, :]
+
+    rng = np.random.default_rng(1000 + seed)
+    return Task(init=lambda key: lora_tree, loss_fn=loss_fn,
+                score_fn=score_fn,
+                test_x=rng.integers(1, 97, size=(4, 8)).astype(np.int32),
+                test_y=rng.integers(0, 97, size=(4,)).astype(np.int32))
+
+
+@pytest.fixture(scope="module")
+def fl_round(trees):
+    """One federated LoRA round per tenant cohort — secagg over the
+    low-rank factors, DP clip+noise composing unchanged."""
+    _, lora_tree = trees
+    adapters = {}
+    for tenant in (1, 2):
+        cd = _cohort_data(seed=20 + tenant)
+        sa = SecAgg(4, 2, counts=np.asarray(cd.counts), clip=4.0,
+                    threshold_frac=0.5, seed=3)
+        srv = FedLoRAAvgServer(_lm_task(lora_tree, tenant), lr=0.05,
+                               batch_size=2, client_data=cd,
+                               client_fraction=0.5, nr_local_epochs=1,
+                               seed=10 + tenant, dp_clip=1.0,
+                               dp_noise_mult=0.05, secagg=sa)
+        assert srv.algorithm == "DP-FedLoRA"
+        srv.run(1)
+        adapters[tenant] = jax.tree.map(np.asarray, srv.params)
+        # the round moved the factors: the adapter is not the null one
+        flat = jax.tree.leaves(adapters[tenant])
+        assert max(float(np.abs(leaf).max()) for leaf in flat) > 0
+    return adapters
+
+
+def _offline(params, prompt, budget):
+    # call shape matches test_serving's _oracle: the jit cache is shared
+    out = generate(CFG, params, jnp.asarray([prompt], jnp.int32), budget)
+    return np.asarray(out)[0, len(prompt):len(prompt) + budget].tolist()
+
+
+def test_closed_loop_fl_round_hot_swaps_into_live_fleet(clean_obs, trees,
+                                                        fl_round):
+    t = obs.enable()
+    base, lora_tree = trees
+    state = {}
+
+    def mk(params, slot):
+        plane = state.get("plane")
+        return ContinuousBatcher(
+            LORA, params, max_batch=2, prefill_width=8,
+            kv_layout="paged", kv_page=8, adapter_slots=NR_SLOTS,
+            adapter_store=plane.store if plane else None,
+            adapter_resident=plane.resident_map() if plane else None)
+
+    stacked0 = stack_adapter_params(
+        base, dataclasses.replace(LORA, lora_slots=NR_SLOTS))
+    router = FleetRouter([mk(stacked0, i) for i in range(2)])
+    plane = TenantAdapterPlane(router, mk, base, LORA, NR_SLOTS,
+                               rollout_config=RolloutConfig(canary_ticks=4))
+    state["plane"] = plane
+
+    # live null-adapter load, IN FLIGHT when the push begins: the swap
+    # must drain them out, not drop them
+    rng = np.random.default_rng(19)
+    prompts = [rng.integers(1, 97, size=n).tolist() for n in (3, 7, 4, 8)]
+    budgets = [6, 5, 4, 6]
+    for rid, (p, b) in enumerate(zip(prompts, budgets)):
+        router.submit(rid, p, b)
+    assert router.in_flight == len(prompts)
+
+    res = plane.push_tenant_round(
+        1, {tenant: (ad, SCALE) for tenant, ad in fl_round.items()})
+    assert res["outcome"] == "promoted"
+    done = dict(res["finished"])
+    while router.in_flight:
+        done.update(router.step())
+    assert sorted(done) == list(range(len(prompts)))   # zero drops
+    for rid, (p, b) in enumerate(zip(prompts, budgets)):  # bitwise base
+        assert list(map(int, done[rid])) == _offline(base, p, b), rid
+
+    # every rebuilt replica came up with both tenants' factors resident
+    assert all(r.adapter_resident(tenant)
+               for r in router.replicas for tenant in (1, 2))
+    assert t.gauge("fleet_rollout_rounds_behind", tenant="1").value == 0
+    assert t.gauge("fleet_rollout_rounds_behind", tenant="2").value == 0
+
+    # post-swap, each tenant's tokens equal its adapter merged offline
+    shapes = {1: (7, 5), 2: (3, 6)}                    # (prompt len, budget)
+    for tenant, adapter in fl_round.items():
+        merged = merge_lora(apply_adapter(lora_tree, adapter), LORA)
+        n, b = shapes[tenant]
+        p = rng.integers(1, 97, size=n).tolist()
+        router.submit(100 + tenant, p, b, adapter_id=tenant)
+        out = {}
+        while router.in_flight:
+            out.update(router.step())
+        assert list(map(int, out[100 + tenant])) == _offline(merged, p, b)
+    # residency was seeded from the pushed params: no store re-fetches
+    assert all(r._adapters.misses == 0 for r in router.replicas)
+
+    # the null adapter stays bitwise base AFTER the tenant round landed
+    p = rng.integers(1, 97, size=5).tolist()
+    router.submit(200, p, 3)
+    out = {}
+    while router.in_flight:
+        out.update(router.step())
+    assert list(map(int, out[200])) == _offline(base, p, 3)

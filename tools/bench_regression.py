@@ -27,6 +27,11 @@ Cells and their direction:
 - ``fleet_rollout.goodput_retention`` — higher better — and
   ``fleet_rollout.rollback_latency_s`` — lower better (the weight-push
   plane's overhead under live load and its auto-revert cost);
+- ``multi_tenant_serving.goodput_tps`` and
+  ``multi_tenant_serving.goodput_ratio_vs_single_tenant`` — higher
+  better — and ``multi_tenant_serving.adapter_miss_rate`` — lower
+  better (the batched multi-LoRA decode path's goodput vs the null-
+  adapter baseline and the adapter pool's residency pressure);
 - ``capacity_model.mean_rel_err`` — lower better (predicted-vs-measured
   error of the calibrated step-cost model on the serving trend cell;
   gated at 10x the base threshold because the healthy value is a small
@@ -74,6 +79,9 @@ _SCALAR_CELLS = (
     ("fleet_chaos.goodput_retention", True),
     ("fleet_rollout.goodput_retention", True),
     ("fleet_rollout.rollback_latency_s", False),
+    ("multi_tenant_serving.goodput_tps", True),
+    ("multi_tenant_serving.goodput_ratio_vs_single_tenant", True),
+    ("multi_tenant_serving.adapter_miss_rate", False),
     ("capacity_model.mean_rel_err", False, 10.0),
     ("kv_quant_tiered.f32.tokens_per_sec", True),
     ("kv_quant_tiered.int8.tokens_per_sec", True),

@@ -106,6 +106,13 @@ for a in json.load(sys.stdin)["argv"]:
     ) > results/serving_kvquant_tpu.txt
     rc=0; [ -s "$KVQ_FAIL" ] && rc=1; rm -f "$KVQ_FAIL"
     echo "$(date +%H:%M:%S) kv-quant knee battery done (exit $rc)" >> "$LOG"
+    # multi-tenant adapter serving: the batched multi-LoRA decode path's
+    # goodput vs its own null-adapter baseline under a skewed tenant draw
+    # (docs/PERFORMANCE.md §multi-tenant adapter serving)
+    timeout 1200 python examples/bench_serving.py --kv-layout paged \
+      --tenants 4 --tenant-skew 1.0 \
+      > results/serving_tenants_tpu.txt 2>> "$LOG"; rc=$?
+    echo "$(date +%H:%M:%S) multi-tenant serving done (exit $rc)" >> "$LOG"
     # two attempts: a transport drop (observed 2026-08-02) resumes from
     # the bench's host-side param cache + 25-step snapshots on retry
     # instead of restarting cold.  tmp-then-install per attempt so a

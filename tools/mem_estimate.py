@@ -430,6 +430,107 @@ def kv_quant_estimate(dtypes=("f32", "bf16", "int8"), *, max_batch: int = 8,
     return rows
 
 
+def adapter_pool_estimate(ranks=(4, 8), slot_counts=(2, 4, 8), *,
+                          max_batch: int = 8, ctx: int = 256,
+                          kv_page: int = 16, device=None) -> list:
+    """AOT argument-bytes cross-check of the multi-LoRA adapter stacks
+    (models/serving.py ``adapter_slots=``, models/adapter_pool.py): for
+    each (rank, nr_slots) cell, the ``lora_A``/``lora_B``/``lora_scale``
+    stack leaves of the ``MultiLoRADense`` tree must equal the
+    ``adapter_bytes`` analytic EXACTLY (that analytic prices the KV-page
+    displacement every adapter batcher applies), and the compiled
+    argument-byte delta between the stacked paged decode step and the
+    plain (``lora_slots=0``) one must match it — the pool's HBM cost is
+    a compiled-program property, not a formula.  Also reports
+    ``kv_pool.pages_displaced``: the whole-page KV budget each cell
+    gives up, exactly the ctor shrink in ``ContinuousBatcher``."""
+    import dataclasses
+    import functools
+
+    from ddl25spring_tpu.models import kv_pool
+    from ddl25spring_tpu.models import serving as srv
+    from ddl25spring_tpu.models.adapter_pool import adapter_bytes
+    from ddl25spring_tpu.models.llama import Llama, LlamaConfig
+
+    base = LlamaConfig(vocab_size=128, dmodel=64, nr_heads=4,
+                       nr_kv_heads=2, nr_layers=2, ctx_size=ctx,
+                       decode_impl="xla")
+    B = max_batch
+    nr_pages = B * (ctx // kv_page) + 1  # full occupancy + null page
+    tree_bytes = lambda t: sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree.leaves(t))
+    jit_kw = {"device": device} if device is not None else {}
+
+    def compile_args(cfg, with_slots):
+        params = jax.eval_shape(Llama(cfg).init, jax.random.key(0),
+                                jnp.zeros((1, 4), jnp.int32))
+        model = Llama(dataclasses.replace(cfg, decode=True))
+
+        def decode(params, pool, tok, pos, pad, tables, *slot_arg):
+            kw = {"adapter_slots": slot_arg[0]} if slot_arg else {}
+            logits, state = model.apply(
+                {**params, "cache": pool}, tok[:, None],
+                positions=pos[:, None], pad=pad, prefix_len=0,
+                block_tables=tables, mutable=["cache"], **kw,
+            )
+            return jnp.argmax(logits[:, 0], axis=-1), state["cache"]
+
+        i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)
+        cache = jax.eval_shape(
+            functools.partial(srv._empty_cache_of, model, B), params)
+        pool = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(
+                (nr_pages, kv_page) + a.shape[2:], a.dtype), cache)
+        args = (params, pool, i32(B), i32(B), i32(B),
+                i32(B, ctx // kv_page))
+        if with_slots:
+            args = args + (i32(B),)
+        compiled = jax.jit(decode, **jit_kw).lower(*args).compile()
+        return params, int(getattr(compiled.memory_analysis(),
+                                   "argument_size_in_bytes", 0))
+
+    _, plain_args = compile_args(base, with_slots=False)
+    page_bytes = kv_pool.kv_bytes(kv_page, base.nr_layers, base.kv_heads,
+                                  base.head_dim)
+    rows = []
+    for rank in ranks:
+        for n in slot_counts:
+            cfg = dataclasses.replace(base, lora_rank=rank, lora_slots=n)
+            params, stacked_args = compile_args(cfg, with_slots=True)
+            stacks = [l for p, l in jax.tree_util.tree_leaves_with_path(
+                params) if getattr(p[-1], "key", "") in
+                ("lora_A", "lora_B", "lora_scale")]
+            stack_b = tree_bytes(stacks)
+            analytic = adapter_bytes(cfg)
+            assert stack_b == analytic, (
+                f"rank={rank} slots={n}: stack leaves are {stack_b:,} B "
+                f"but the adapter_bytes analytic says {analytic:,} B — "
+                "the formula drifted from the MultiLoRADense layout"
+            )
+            # the stacked program additionally carries the (B,) int32
+            # slot vector; everything else (params kernels, pool,
+            # scheduler vectors) is identical, so the compiled delta IS
+            # the stack bytes
+            delta_args = stacked_args - plain_args
+            assert abs(delta_args - analytic) <= max(4096,
+                                                     analytic // 50), (
+                f"compiled argument delta {delta_args:,} B at rank="
+                f"{rank} slots={n} diverges from the adapter_bytes "
+                f"analytic {analytic:,} B"
+            )
+            rows.append({
+                "lora_rank": rank,
+                "nr_slots": n,
+                "stack_bytes": stack_b,
+                "argument_bytes_stacked": stacked_args,
+                "argument_bytes_plain": plain_args,
+                "kv_pages_displaced": kv_pool.pages_displaced(
+                    analytic, page_bytes),
+            })
+    return rows
+
+
 def tp_kv_estimate(worlds, *, max_batch: int = 8, ctx: int = 256,
                    kv_page: int = 16) -> list:
     """AOT argument-bytes cross-check of the TP head-partitioned KV pool
@@ -725,6 +826,19 @@ def main(argv=None) -> int:
                     help="serving ctx_size for --kv-pages")
     ap.add_argument("--kv-page", type=int, default=16,
                     help="tokens per KV page for --kv-pages")
+    ap.add_argument("--adapter-pool", action="store_true",
+                    help="estimate the multi-LoRA adapter stacks instead "
+                         "(models/adapter_pool.py): stack-leaf bytes vs "
+                         "the adapter_bytes analytic (exact) and the "
+                         "compiled argument-byte delta of the stacked "
+                         "paged decode vs the plain one across "
+                         "--lora-ranks x --adapter-slots; reports the "
+                         "KV pages each cell displaces")
+    ap.add_argument("--lora-ranks", default="4,8",
+                    help="comma-separated LoRA ranks for --adapter-pool")
+    ap.add_argument("--adapter-slots", default="2,4,8",
+                    help="comma-separated stack slot counts for "
+                         "--adapter-pool")
     ap.add_argument("--tp-kv", action="store_true",
                     help="estimate the TP head-partitioned KV pool "
                          "instead (serving_fleet/tp.py): per-shard AOT "
@@ -783,6 +897,29 @@ def main(argv=None) -> int:
             "metric": "overlap_memory_estimate",
             "target": args.target,
             **out,
+        }))
+        return 0
+
+    if args.adapter_pool:
+        ranks = [int(r) for r in args.lora_ranks.split(",") if r.strip()]
+        slots = [int(s) for s in args.adapter_slots.split(",")
+                 if s.strip()]
+        rows = adapter_pool_estimate(ranks, slots, max_batch=args.kv_batch,
+                                     ctx=args.kv_ctx, kv_page=args.kv_page,
+                                     device=device)
+        for r in rows:
+            print(f"  rank={r['lora_rank']:>2} slots={r['nr_slots']:>2}: "
+                  f"stacks {r['stack_bytes']:>10,} B   args "
+                  f"{r['argument_bytes_stacked']:>12,} B "
+                  f"(plain {r['argument_bytes_plain']:,} B)   "
+                  f"displaces {r['kv_pages_displaced']} KV pages",
+                  file=sys.stderr)
+        print(json.dumps({
+            "metric": "adapter_pool_memory_estimate",
+            "target": args.target,
+            "max_batch": args.kv_batch, "ctx_size": args.kv_ctx,
+            "kv_page": args.kv_page,
+            "cells": rows,
         }))
         return 0
 

@@ -492,8 +492,13 @@ def report(events: list[dict], top: int, calib: dict | None = None) -> None:
     prefetches = take(counters, "serving_kv_prefetch_total")
     dequant_b = _value(counters, "serving_kv_dequant_bytes_total")
     take(counters, "serving_kv_dequant_bytes_total")
+    adapters = take(gauges, "serving_adapter_resident")
+    a_miss = _value(counters, "serving_adapter_misses_total")
+    take(counters, "serving_adapter_misses_total")
+    a_evict = _value(counters, "serving_adapter_evictions_total")
+    take(counters, "serving_adapter_evictions_total")
     if (nr_req is not None or req_hist or reject_reasons
-            or pfx_hits is not None or pages or resident
+            or pfx_hits is not None or pages or resident or adapters
             or spills is not None):
         section("serving")
         if nr_req is not None:
@@ -556,6 +561,17 @@ def report(events: list[dict], top: int, calib: dict | None = None) -> None:
         if dequant_b is not None:
             print(f"  int8 pages dequantized in-kernel: "
                   f"{fmt_bytes(dequant_b)}")
+        # -- multi-LoRA adapter pool: where the tenants' factors live
+        #    and how often admissions had to re-fetch them
+        if adapters or a_miss is not None or a_evict is not None:
+            parts = "   ".join(
+                f"{labels.get('tier', '?')}: last {state['value']:.0f} "
+                f"peak {state.get('max', state['value']):.0f}"
+                for labels, state in sorted(
+                    adapters, key=lambda kv: kv[0].get("tier", "")))
+            print(f"  tenant adapters: {parts or 'none resident'}   "
+                  f"misses {int(a_miss or 0)}   "
+                  f"evictions {int(a_evict or 0)}")
         if fused_steps is not None:
             print(f"  fused decode steps (one-Pallas-program inner "
                   f"loop): {fused_steps}")
@@ -580,8 +596,10 @@ def report(events: list[dict], top: int, calib: dict | None = None) -> None:
              for lb, st in take(gauges, "fleet_replica_drain_pps")}
     offloaded = _value(counters, "serving_prefill_offloaded_total")
     take(counters, "serving_prefill_offloaded_total")
+    tenant_hits = _value(counters, "fleet_tenant_affinity_hits_total")
+    take(counters, "fleet_tenant_affinity_hits_total")
     if routed or rerouted or fleet_rej or q_wait \
-            or offloaded is not None:
+            or tenant_hits is not None or offloaded is not None:
         section("fleet serving")
         if routed:
             total = sum(st["value"] for _, st in routed)
@@ -621,6 +639,9 @@ def report(events: list[dict], top: int, calib: dict | None = None) -> None:
                 if d is not None:
                     line += f"   drain {d['value']:.1f} pages/s"
                 print(line)
+        if tenant_hits is not None:
+            print(f"  tenant-affinity placements (adapter already "
+                  f"resident): {int(tenant_hits)}")
         if offloaded is not None:
             print(f"  prefills offloaded to dedicated workers "
                   f"(disaggregated mode): {offloaded}")
@@ -676,8 +697,13 @@ def report(events: list[dict], top: int, calib: dict | None = None) -> None:
     canary_sub = take(counters, "fleet_rollout_canary_submitted_total")
     canary_rej = take(counters, "fleet_rollout_canary_rejected_total")
     take(hists, "fleet_rollout_canary_queue_wait_s")
-    behind = _value(gauges, "fleet_rollout_rounds_behind")
-    take(gauges, "fleet_rollout_rounds_behind")
+    behind_series = take(gauges, "fleet_rollout_rounds_behind")
+    # unlabeled series = fleet aggregate; {tenant} series come from the
+    # adapter plane (serving_fleet/tenants.py)
+    behind = next((st["value"] for lb, st in behind_series if not lb),
+                  None)
+    behind_tenants = [(lb["tenant"], st) for lb, st in behind_series
+                      if "tenant" in lb]
     version_info = take(gauges, "fleet_rollout_version_info")
     rb_events = [e for e in events
                  if e.get("event") == "fleet.rollout_rolled_back"]
@@ -720,6 +746,11 @@ def report(events: list[dict], top: int, calib: dict | None = None) -> None:
                 print(f"  serving version: {'  '.join(sorted(serving))}")
         if behind is not None:
             print(f"  rounds behind (fl freshness): {int(behind)}")
+        if behind_tenants:
+            parts = "   ".join(
+                f"t{t}={int(st['value'])}"
+                for t, st in sorted(behind_tenants))
+            print(f"  rounds behind by tenant: {parts}")
 
     # -- time series + SLO burn rate + autoscale -------------------------
     # rendered from the last ``timeseries`` event (obs.flush with a
